@@ -1,0 +1,75 @@
+"""skypilot_tpu — a TPU-native workload orchestration framework.
+
+Public API surface mirrors the reference orchestrator's SDK
+(``sky/__init__.py:82-115``): ``Task``, ``Resources``, ``Dag``,
+``launch``, ``exec``, ``status``, ``optimize`` etc. — with the
+schedulable unit being a TPU slice and the on-cluster runtime being our
+own host-agent (no Ray).
+
+Heavy submodules (execution, backends, jobs, serve) are imported
+lazily so `import skypilot_tpu` stays fast and the compute library
+(`skypilot_tpu.models`, `.parallel`, `.ops`) can be used on a TPU host
+without pulling orchestration deps.
+"""
+import importlib
+from typing import TYPE_CHECKING
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget, optimize
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__version__ = '0.1.0'
+
+_LAZY_ATTRS = {
+    # execution pipeline
+    'launch': ('skypilot_tpu.execution', 'launch'),
+    'exec': ('skypilot_tpu.execution', 'exec_'),
+    # core ops
+    'status': ('skypilot_tpu.core', 'status'),
+    'start': ('skypilot_tpu.core', 'start'),
+    'stop': ('skypilot_tpu.core', 'stop'),
+    'down': ('skypilot_tpu.core', 'down'),
+    'autostop': ('skypilot_tpu.core', 'autostop'),
+    'queue': ('skypilot_tpu.core', 'queue'),
+    'cancel': ('skypilot_tpu.core', 'cancel'),
+    'tail_logs': ('skypilot_tpu.core', 'tail_logs'),
+    'download_logs': ('skypilot_tpu.core', 'download_logs'),
+    'job_status': ('skypilot_tpu.core', 'job_status'),
+    'cost_report': ('skypilot_tpu.core', 'cost_report'),
+    # subpackages
+    'jobs': ('skypilot_tpu.jobs', None),
+    'serve': ('skypilot_tpu.serve', None),
+    'data': ('skypilot_tpu.data', None),
+    'models': ('skypilot_tpu.models', None),
+    'ops': ('skypilot_tpu.ops', None),
+    'parallel': ('skypilot_tpu.parallel', None),
+    'check': ('skypilot_tpu.check', 'check'),
+    'Storage': ('skypilot_tpu.data.storage', 'Storage'),
+    'StoreType': ('skypilot_tpu.data.storage', 'StoreType'),
+    'StorageMode': ('skypilot_tpu.data.storage', 'StorageMode'),
+    'ClusterStatus': ('skypilot_tpu.status_lib', 'ClusterStatus'),
+    'JobStatus': ('skypilot_tpu.runtime.job_lib', 'JobStatus'),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY_ATTRS:
+        module_name, attr = _LAZY_ATTRS[name]
+        module = importlib.import_module(module_name)
+        value = module if attr is None else getattr(module, attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = [
+    'Dag',
+    'Optimizer',
+    'OptimizeTarget',
+    'Resources',
+    'Task',
+    'exceptions',
+    'optimize',
+] + list(_LAZY_ATTRS)

@@ -1,0 +1,26 @@
+"""Priced TPU catalog (analog of ``sky/clouds/service_catalog/``)."""
+from skypilot_tpu.catalog.tpu_catalog import (
+    TpuSpec,
+    canonicalize,
+    fuzzy_candidates,
+    get_hourly_cost,
+    get_regions,
+    get_tpu_spec,
+    get_zones,
+    is_tpu,
+    list_accelerators,
+    validate_region_zone,
+)
+
+__all__ = [
+    'TpuSpec',
+    'canonicalize',
+    'fuzzy_candidates',
+    'get_hourly_cost',
+    'get_regions',
+    'get_tpu_spec',
+    'get_zones',
+    'is_tpu',
+    'list_accelerators',
+    'validate_region_zone',
+]

@@ -1,0 +1,191 @@
+"""Generate the TPU catalog CSV.
+
+Analog of the reference's offline catalog ``data_fetchers``
+(``sky/clouds/service_catalog/data_fetchers/fetch_gcp.py:791`` pulls the
+GCP SKUs + TPU pricing APIs). This image has zero egress, so the catalog
+is seeded from public GCP list prices (approximate, per chip-hour) and
+the public slice-topology tables; the fetcher interface is kept so a
+networked deployment can regenerate from the live API.
+
+Run:  python -m skypilot_tpu.catalog.data_gen
+Writes ``skypilot_tpu/catalog/data/tpu_catalog.csv``.
+
+Note: reference's shipped catalog has v6e prices missing (0.0) in some
+regions (``examples/tpu/v6e/README.md:7``); we deliberately fill every
+region so $/token ranking never divides by zero.
+"""
+import csv
+import os
+from typing import Dict, List, Tuple
+
+# Per-generation constants.
+# chips_per_host: hosts in a slice = chips / chips_per_host (min 1).
+# v2/v3/v4/v5p name slices by TensorCore count (2 cores/chip);
+# v5e (v5litepod) and v6e name by chip count.
+GENERATIONS: Dict[str, Dict] = {
+    'v2': dict(cores_naming=True, chips_per_host=4, hbm_gb=8,
+               vcpus_per_host=96, host_mem_gb=334,
+               price_chip_hour=1.125, sizes=[8, 32, 128, 256, 512],
+               regions={
+                   'us-central1': ['b', 'c', 'f'],
+                   'europe-west4': ['a'],
+                   'asia-east1': ['c'],
+               }),
+    'v3': dict(cores_naming=True, chips_per_host=4, hbm_gb=16,
+               vcpus_per_host=96, host_mem_gb=334,
+               price_chip_hour=2.0,
+               sizes=[8, 32, 64, 128, 256, 512, 1024, 2048],
+               regions={
+                   'us-east1': ['d'],
+                   'europe-west4': ['a'],
+               }),
+    'v4': dict(cores_naming=True, chips_per_host=4, hbm_gb=32,
+               vcpus_per_host=240, host_mem_gb=400,
+               price_chip_hour=3.22,
+               sizes=[8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096],
+               regions={
+                   'us-central2': ['b'],
+               }),
+    'v5e': dict(cores_naming=False, chips_per_host=4, hbm_gb=16,
+                vcpus_per_host=112, host_mem_gb=192,
+                price_chip_hour=1.2,
+                sizes=[1, 4, 8, 16, 32, 64, 128, 256],
+                regions={
+                    'us-central1': ['a'],
+                    'us-west4': ['a', 'b'],
+                    'us-east1': ['c'],
+                    'us-east5': ['b'],
+                    'europe-west4': ['b'],
+                    'asia-southeast1': ['b'],
+                }),
+    'v5p': dict(cores_naming=True, chips_per_host=4, hbm_gb=95,
+                vcpus_per_host=208, host_mem_gb=448,
+                price_chip_hour=4.2,
+                sizes=[8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+                       8192, 12288],
+                regions={
+                    'us-east5': ['a'],
+                    'us-central1': ['a'],
+                    'europe-west4': ['b'],
+                }),
+    'v6e': dict(cores_naming=False, chips_per_host=8, hbm_gb=32,
+                vcpus_per_host=180, host_mem_gb=720,
+                price_chip_hour=2.7,
+                sizes=[1, 4, 8, 16, 32, 64, 128, 256],
+                regions={
+                    'us-east1': ['d'],
+                    'us-east5': ['a', 'b'],
+                    'us-central2': ['b'],
+                    'europe-west4': ['a'],
+                    'asia-northeast1': ['b'],
+                }),
+}
+
+# Spot (preemptible TPU) discount factor vs on-demand; GCP's published
+# spot prices for v5e hover around 0.45x (1.20 -> 0.54 $/chip-hr).
+SPOT_FACTOR = 0.45
+
+# Mild per-region price multipliers (non-US regions list slightly
+# higher), mirroring GCP's regional pricing spread.
+REGION_FACTOR = {
+    'europe-west4': 1.1,
+    'asia-east1': 1.16,
+    'asia-southeast1': 1.16,
+    'asia-northeast1': 1.16,
+}
+
+# 2D topologies (v5e/v6e: AxB grids) and 3D (v4/v5p: AxBxC tori).
+TOPO_2D = {1: '1x1', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8',
+           64: '8x8', 128: '8x16', 256: '16x16'}
+
+
+def _topo_3d(chips: int) -> str:
+    # Smallest-surface-area factorization of chips into AxBxC with
+    # dims powers of two (matches GCP default topologies for v4/v5p).
+    best: Tuple[int, ...] = (1, 1, chips)
+    best_surface = None
+    a = 1
+    while a * a * a <= chips:
+        if chips % a == 0:
+            rem = chips // a
+            b = a
+            while b * b <= rem:
+                if rem % b == 0:
+                    c = rem // b
+                    dims = tuple(sorted((a, b, c)))
+                    surface = dims[0] * dims[1] + dims[1] * dims[2] + \
+                        dims[0] * dims[2]
+                    if best_surface is None or surface < best_surface:
+                        best_surface = surface
+                        best = dims
+                b += 1
+        a += 1
+    return 'x'.join(str(d) for d in best)
+
+
+def _num_hosts(gen: str, chips: int, chips_per_host: int) -> int:
+    # v6e quirk (see BASELINE.md / reference examples/tpu/v6e/README.md):
+    # v6e-8 is a single 8-chip host, but v6e-16 is 4 hosts x 4 chips.
+    if gen == 'v6e' and chips > 8:
+        return chips // 4
+    return max(1, chips // chips_per_host)
+
+
+def generate_rows() -> List[Dict]:
+    rows = []
+    for gen, info in GENERATIONS.items():
+        for size in info['sizes']:
+            if info['cores_naming']:
+                # v2/v3/v4/v5p chips carry 2 TensorCores and are named
+                # by core count.
+                cores = size
+                chips = max(1, size // 2)
+            else:
+                # v5e/v6e chips have 1 TensorCore and are named by
+                # chip count.
+                chips = size
+                cores = size
+            hosts = _num_hosts(gen, chips, info['chips_per_host'])
+            if gen in ('v5e', 'v6e'):
+                topo = TOPO_2D.get(chips, '-')
+            else:
+                topo = _topo_3d(chips)
+            for region, zones in info['regions'].items():
+                factor = REGION_FACTOR.get(region, 1.0)
+                price = round(info['price_chip_hour'] * factor * chips, 4)
+                spot = round(price * SPOT_FACTOR, 4)
+                for z in zones:
+                    rows.append({
+                        'AcceleratorName': f'tpu-{gen}-{size}',
+                        'Generation': gen,
+                        'Chips': chips,
+                        'Cores': cores,
+                        'NumHosts': hosts,
+                        'Topology': topo,
+                        'MemoryGBPerChip': info['hbm_gb'],
+                        'vCPUsPerHost': info['vcpus_per_host'],
+                        'HostMemoryGB': info['host_mem_gb'],
+                        'Region': region,
+                        'AvailabilityZone': f'{region}-{z}',
+                        'Price': price,
+                        'SpotPrice': spot,
+                    })
+    return rows
+
+
+def main(out_path: str = None) -> str:
+    if out_path is None:
+        out_path = os.path.join(os.path.dirname(__file__), 'data',
+                                'tpu_catalog.csv')
+    rows = generate_rows()
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    return out_path
+
+
+if __name__ == '__main__':
+    path = main()
+    print(f'Wrote {path}')

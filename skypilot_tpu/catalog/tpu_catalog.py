@@ -1,0 +1,224 @@
+"""TPU accelerator registry + priced catalog queries.
+
+Analog of the reference's ``sky/clouds/service_catalog/common.py:34``
+(CSV-backed catalog with caching) and
+``sky/utils/accelerator_registry.py`` (canonical accelerator names) —
+except TPU slices are THE first-class unit here, not a Ray custom
+resource bolted onto a VM type.
+
+Accelerator naming: ``tpu-<gen>-<size>`` where size is TensorCores for
+v2/v3/v4/v5p (GCP convention) and chips for v5e/v6e. Aliases:
+``tpu-v5litepod-8`` == ``tpu-v5e-8``.
+"""
+import dataclasses
+import functools
+import os
+import re
+from typing import Dict, List, Optional
+
+import pandas as pd
+
+from skypilot_tpu import exceptions
+
+_CATALOG_PATH = os.path.join(os.path.dirname(__file__), 'data',
+                             'tpu_catalog.csv')
+
+_TPU_RE = re.compile(r'^tpu-(v\d+[a-z]*|v5litepod)-(\d+)$')
+
+_GEN_ALIASES = {'v5litepod': 'v5e'}
+
+# Generations whose slice size is named in TensorCores (2 cores/chip).
+_CORES_NAMED_GENS = {'v2', 'v3', 'v4', 'v5p'}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSpec:
+    """Parsed, catalog-resolved description of one TPU slice type."""
+    name: str  # canonical, e.g. 'tpu-v5p-8'
+    generation: str  # 'v5p'
+    chips: int
+    cores: int
+    num_hosts: int
+    topology: str
+    hbm_gb_per_chip: int
+    vcpus_per_host: int
+    host_memory_gb: int
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.num_hosts
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice — cannot be stopped, only torn down
+        (reference constraint: ``sky/clouds/gcp.py:193-203``)."""
+        return self.num_hosts > 1
+
+    @property
+    def total_hbm_gb(self) -> int:
+        return self.hbm_gb_per_chip * self.chips
+
+
+def canonicalize(name: str) -> str:
+    """Normalize an accelerator string: lowercase, resolve aliases."""
+    name = name.lower().strip()
+    m = _TPU_RE.match(name)
+    if m is None:
+        raise exceptions.InvalidSpecError(
+            f'Invalid TPU accelerator {name!r}. Expected the form '
+            f"'tpu-<gen>-<size>', e.g. 'tpu-v5p-8', 'tpu-v6e-16', "
+            "'tpu-v5litepod-4'.")
+    gen, size = m.group(1), m.group(2)
+    gen = _GEN_ALIASES.get(gen, gen)
+    return f'tpu-{gen}-{int(size)}'
+
+
+def is_tpu(name: str) -> bool:
+    try:
+        canonicalize(name)
+        return True
+    except exceptions.InvalidSpecError:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def _read_catalog() -> pd.DataFrame:
+    if not os.path.exists(_CATALOG_PATH):
+        # Self-heal: regenerate from the in-tree seed tables.
+        from skypilot_tpu.catalog import data_gen
+        data_gen.main(_CATALOG_PATH)
+    return pd.read_csv(_CATALOG_PATH)
+
+
+def _rows_for(name: str) -> pd.DataFrame:
+    canonical = canonicalize(name)
+    df = _read_catalog()
+    rows = df[df['AcceleratorName'] == canonical]
+    if rows.empty:
+        candidates = fuzzy_candidates(canonical)
+        hint = f' Did you mean: {", ".join(candidates)}?' if candidates \
+            else ''
+        raise exceptions.ResourcesUnavailableError(
+            f'TPU type {canonical!r} not found in catalog.{hint}',
+            no_failover=True)
+    return rows
+
+
+def fuzzy_candidates(name: str, limit: int = 5) -> List[str]:
+    """Closest catalog names, for error messages (analog of the
+    reference catalog's fuzzy-match candidates)."""
+    df = _read_catalog()
+    names = sorted(df['AcceleratorName'].unique())
+    m = _TPU_RE.match(name)
+    if m:
+        gen = _GEN_ALIASES.get(m.group(1), m.group(1))
+        same_gen = [n for n in names if n.startswith(f'tpu-{gen}-')]
+        if same_gen:
+            return same_gen[:limit]
+        # Unknown generation (e.g. 'v5x'): suggest same major version.
+        major = re.match(r'v\d+', gen)
+        if major:
+            near = [n for n in names
+                    if n.startswith(f'tpu-{major.group(0)}')]
+            if near:
+                return near[:limit]
+    return names[:limit]
+
+
+def get_tpu_spec(name: str) -> TpuSpec:
+    row = _rows_for(name).iloc[0]
+    return TpuSpec(
+        name=row['AcceleratorName'],
+        generation=row['Generation'],
+        chips=int(row['Chips']),
+        cores=int(row['Cores']),
+        num_hosts=int(row['NumHosts']),
+        topology=row['Topology'],
+        hbm_gb_per_chip=int(row['MemoryGBPerChip']),
+        vcpus_per_host=int(row['vCPUsPerHost']),
+        host_memory_gb=int(row['HostMemoryGB']),
+    )
+
+
+def list_accelerators(
+        gpus_only: bool = False,
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None) -> Dict[str, List[Dict]]:
+    """All catalog entries grouped by accelerator name (analog of
+    ``sky/clouds/service_catalog`` list_accelerators; feeds
+    ``show-tpus`` CLI)."""
+    del gpus_only  # no GPUs in a TPU-native catalog
+    df = _read_catalog()
+    if name_filter:
+        df = df[df['AcceleratorName'].str.contains(name_filter,
+                                                   regex=True)]
+    if region_filter:
+        df = df[df['Region'] == region_filter]
+    out: Dict[str, List[Dict]] = {}
+    for name, group in df.groupby('AcceleratorName'):
+        # One summary entry per region.
+        entries = []
+        for region, rgroup in group.groupby('Region'):
+            row = rgroup.iloc[0]
+            entries.append({
+                'accelerator': name,
+                'generation': row['Generation'],
+                'chips': int(row['Chips']),
+                'num_hosts': int(row['NumHosts']),
+                'topology': row['Topology'],
+                'hbm_gb': int(row['MemoryGBPerChip']) * int(row['Chips']),
+                'region': region,
+                'price': float(row['Price']),
+                'spot_price': float(row['SpotPrice']),
+            })
+        out[str(name)] = entries
+    return out
+
+
+def get_hourly_cost(name: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    """Hourly price of the whole slice (all chips)."""
+    rows = _rows_for(name)
+    if zone is not None:
+        rows = rows[rows['AvailabilityZone'] == zone]
+    elif region is not None:
+        rows = rows[rows['Region'] == region]
+    if rows.empty:
+        where = zone or region
+        raise exceptions.ResourcesUnavailableError(
+            f'TPU type {canonicalize(name)!r} not offered in {where!r}.',
+            no_failover=True)
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(rows[col].min())
+
+
+def get_regions(name: str, use_spot: bool = False) -> List[str]:
+    """Regions offering this slice type, cheapest first."""
+    rows = _rows_for(name)
+    col = 'SpotPrice' if use_spot else 'Price'
+    by_region = rows.groupby('Region')[col].min().sort_values()
+    return list(by_region.index)
+
+
+def get_zones(name: str, region: str) -> List[str]:
+    rows = _rows_for(name)
+    rows = rows[rows['Region'] == region]
+    return sorted(rows['AvailabilityZone'].unique())
+
+
+def validate_region_zone(name: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    rows = _rows_for(name)
+    if region is not None and region not in set(rows['Region']):
+        raise exceptions.InvalidSpecError(
+            f'{canonicalize(name)} is not offered in region {region!r}. '
+            f'Available: {sorted(set(rows["Region"]))}')
+    if zone is not None:
+        if region is not None and not zone.startswith(region):
+            raise exceptions.InvalidSpecError(
+                f'Zone {zone!r} is not in region {region!r}.')
+        if zone not in set(rows['AvailabilityZone']):
+            raise exceptions.InvalidSpecError(
+                f'{canonicalize(name)} is not offered in zone {zone!r}. '
+                f'Available: {sorted(set(rows["AvailabilityZone"]))}')

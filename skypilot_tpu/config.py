@@ -1,0 +1,114 @@
+"""Layered user configuration (analog of ``sky/skypilot_config.py:1-259``).
+
+Config file: ``~/.skypilot_tpu/config.yaml`` (override path with
+``SKYTPU_CONFIG``). Nested keys are addressed as tuples:
+``get_nested(('gcp', 'project_id'), None)``.
+
+Layering order (later wins), same shape as the reference:
+  1. config file
+  2. per-task ``experimental.config_overrides`` (applied by execution)
+  3. explicit ``override_configs`` context
+"""
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+CONFIG_PATH = '~/.skypilot_tpu/config.yaml'
+ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
+
+_dict: Optional[Dict[str, Any]] = None
+_loaded_path: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _load() -> None:
+    global _dict, _loaded_path
+    path = os.environ.get(ENV_VAR_CONFIG, CONFIG_PATH)
+    path = os.path.expanduser(path)
+    _loaded_path = path
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            _dict = yaml.safe_load(f) or {}
+    else:
+        _dict = {}
+
+
+def _ensure_loaded() -> Dict[str, Any]:
+    global _dict
+    with _lock:
+        if _dict is None:
+            _load()
+        assert _dict is not None
+        return _dict
+
+
+def reload_config() -> None:
+    global _dict
+    with _lock:
+        _dict = None
+
+
+def loaded() -> bool:
+    return bool(_ensure_loaded())
+
+
+def loaded_config_path() -> Optional[str]:
+    _ensure_loaded()
+    return _loaded_path
+
+
+def get_nested(keys: Iterable[str], default_value: Any) -> Any:
+    d: Any = _ensure_loaded()
+    for k in keys:
+        if isinstance(d, dict) and k in d:
+            d = d[k]
+        else:
+            return default_value
+    return d
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the config dict with ``keys`` set to ``value``
+    (does not persist to disk)."""
+    d = copy.deepcopy(_ensure_loaded())
+    cur = d
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    cur[keys[-1]] = value
+    return d
+
+
+def _recursive_update(base: Dict[str, Any],
+                      override: Dict[str, Any]) -> Dict[str, Any]:
+    for k, v in override.items():
+        if (isinstance(v, dict) and isinstance(base.get(k), dict)):
+            _recursive_update(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+@contextlib.contextmanager
+def override_config(overrides: Optional[Dict[str, Any]]):
+    """Temporarily overlay ``overrides`` onto the loaded config.
+
+    Analog of the reference's per-task ``experimental.config_overrides``
+    (``sky/skypilot_config.py`` docstring).
+    """
+    global _dict
+    if not overrides:
+        yield
+        return
+    with _lock:
+        original = _ensure_loaded()
+        merged = _recursive_update(copy.deepcopy(original), overrides)
+        _dict = merged
+    try:
+        yield
+    finally:
+        with _lock:
+            _dict = original

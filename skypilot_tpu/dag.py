@@ -1,0 +1,92 @@
+"""Dag: a DAG of Tasks (analog of ``sky/dag.py:11``).
+
+Context-manager builder; only chain DAGs are executed by managed jobs
+(same restriction as the reference: ``sky/execution.py:180`` allows a
+single task per launch; chains run under the jobs controller).
+"""
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+
+class Dag:
+    """Directed acyclic graph of Tasks."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List = []
+
+    def add(self, task) -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.tasks.remove(task)
+        self.graph.remove_node(task)
+
+    def add_edge(self, op1, op2) -> None:
+        assert op1 in self.graph.nodes
+        assert op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        task_info = ', '.join(map(repr, self.tasks))
+        return f'DAG:\n  {task_info}'
+
+    def get_graph(self):
+        return self.graph
+
+    def is_chain(self) -> bool:
+        """Linear chain check (reference ``sky/dag.py:58``)."""
+        nodes = list(self.graph.nodes)
+        out_degrees = [self.graph.out_degree(n) for n in nodes]
+        in_degrees = [self.graph.in_degree(n) for n in nodes]
+        return (len(nodes) <= 1 or
+                (all(d <= 1 for d in out_degrees) and
+                 all(d <= 1 for d in in_degrees) and
+                 nx.is_weakly_connected(self.graph)))
+
+
+class _DagContext(threading.local):
+    """Per-thread DAG stack. threading.local only isolates INSTANCE
+    attributes, so the stack must be assigned in __init__ (which runs
+    once per accessing thread), not as class attributes."""
+
+    def __init__(self):
+        super().__init__()
+        self._current_dag: Optional[Dag] = None
+        self._previous_dags: List[Dag] = []
+
+    def push_dag(self, dag: Dag):
+        if self._current_dag is not None:
+            self._previous_dags.append(self._current_dag)
+        self._current_dag = dag
+
+    def pop_dag(self) -> Optional[Dag]:
+        old_dag = self._current_dag
+        if self._previous_dags:
+            self._current_dag = self._previous_dags.pop()
+        else:
+            self._current_dag = None
+        return old_dag
+
+    def get_current_dag(self) -> Optional[Dag]:
+        return self._current_dag
+
+
+_dag_context = _DagContext()
+push_dag = _dag_context.push_dag
+pop_dag = _dag_context.pop_dag
+get_current_dag = _dag_context.get_current_dag

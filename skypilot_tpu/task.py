@@ -1,0 +1,269 @@
+"""Task: the declarative unit of work.
+
+Analog of ``sky/task.py:171`` (Task) — name, setup, run, num_nodes,
+envs, workdir, file_mounts, storage_mounts, a set of candidate
+Resources, and an optional service spec. YAML round-trip mirrors the
+reference's schema (``sky/task.py:347`` from_yaml_config /
+``:1104`` to_yaml_config), with ``num_nodes`` meaning *slices* — each
+slice already spans ``tpu_spec.num_hosts`` hosts, and the runtime runs
+one process per host (reference ``num_ips_per_node`` semantics,
+``sky/backends/cloud_vm_ray_backend.py:2551,5076``).
+"""
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+_VALID_NAME_REGEX = '[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*'
+_VALID_NAME_DESCR = ('ASCII characters and may contain lowercase and '
+                     'uppercase letters, digits, underscores, periods, '
+                     'and dashes.')
+
+_RunFn = Callable[[int, List[str]], Optional[str]]
+
+
+class Task:
+    """A coarse-grained stage: setup + run commands over N nodes."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, _RunFn]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        event_callback: Optional[str] = None,
+    ):
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self.envs = dict(envs) if envs else {}
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        self.file_mounts: Optional[Dict[str, str]] = file_mounts
+        self.storage_mounts: Dict[str, Any] = {}
+        self.event_callback = event_callback
+        self.service: Optional[Any] = None  # SkyServiceSpec analog
+        self.resources: Set[Resources] = {Resources()}
+        self.estimated_runtime_seconds: Optional[float] = None
+        # Inputs/outputs for DAG egress-cost estimation (reference
+        # ``sky/task.py`` set_inputs/set_outputs).
+        self.inputs: Optional[str] = None
+        self.outputs: Optional[str] = None
+        self.estimated_inputs_size_gigabytes: Optional[float] = None
+        self.estimated_outputs_size_gigabytes: Optional[float] = None
+        self._validate()
+
+        # Registers into an active Dag context if one exists.
+        from skypilot_tpu import dag as dag_lib
+        active = dag_lib.get_current_dag()
+        if active is not None:
+            active.add(self)
+
+    # -- validation -----------------------------------------------------
+
+    def _validate(self):
+        if self.name is not None and not re.fullmatch(
+                _VALID_NAME_REGEX, self.name):
+            raise exceptions.InvalidSpecError(
+                f'Invalid task name {self.name!r}. Name must consist of '
+                + _VALID_NAME_DESCR)
+        if self.num_nodes < 1:
+            raise exceptions.InvalidSpecError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.run is not None and not isinstance(self.run, str) and \
+                not callable(self.run):
+            raise exceptions.InvalidSpecError(
+                'run must be a string of commands or a callable '
+                f'(num_nodes, ips) -> command; got {type(self.run)}')
+        if self.setup is not None and not isinstance(self.setup, str):
+            raise exceptions.InvalidSpecError(
+                f'setup must be a string, got {type(self.setup)}')
+        if self.workdir is not None:
+            expanded = os.path.expanduser(self.workdir)
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidSpecError(
+                    f'workdir must be an existing directory, got '
+                    f'{self.workdir!r}')
+        for k in self.envs:
+            if not re.fullmatch(r'[A-Za-z_][A-Za-z0-9_]*', k):
+                raise exceptions.InvalidSpecError(
+                    f'Invalid env var name {k!r}')
+
+    # -- resources ------------------------------------------------------
+
+    def set_resources(self, resources: Union[Resources, Set[Resources],
+                                             List[Resources]]) -> 'Task':
+        if isinstance(resources, Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        return self
+
+    def set_envs(self, envs: Dict[str, str]) -> 'Task':
+        self.envs.update(envs)
+        self._validate()
+        return self
+
+    def update_envs(self, envs: Optional[Dict[str, str]]) -> 'Task':
+        if envs:
+            self.envs.update(envs)
+        return self
+
+    @property
+    def use_spot(self) -> bool:
+        return any(r.use_spot for r in self.resources)
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]
+                        ) -> 'Task':
+        self.file_mounts = file_mounts
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        if self.file_mounts is None:
+            self.file_mounts = {}
+        self.file_mounts.update(file_mounts)
+        return self
+
+    def set_storage_mounts(self, storage_mounts) -> 'Task':
+        self.storage_mounts = storage_mounts or {}
+        return self
+
+    # -- YAML -----------------------------------------------------------
+
+    @staticmethod
+    def from_yaml(yaml_path: str) -> 'Task':
+        config = common_utils.read_yaml(os.path.expanduser(yaml_path))
+        if isinstance(config, str):
+            raise exceptions.InvalidSpecError(
+                'YAML loaded as str, not as dict: is the file empty or '
+                'malformed?')
+        return Task.from_yaml_config(config or {})
+
+    @staticmethod
+    def from_yaml_config(config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        """Build from a parsed YAML dict (reference
+        ``sky/task.py:347``), with ``$VAR``/``${VAR}`` substitution in
+        the string fields using ``envs`` (+ CLI overrides), mirroring
+        ``_fill_in_env_vars`` (``sky/task.py:73``)."""
+        config = dict(config or {})
+        envs = dict(config.get('envs') or {})
+        if env_overrides:
+            envs.update(env_overrides)
+        config['envs'] = envs
+        for key in ('setup', 'run', 'workdir'):
+            val = config.get(key)
+            if isinstance(val, str):
+                config[key] = _substitute_env_vars(val, envs)
+        for k, v in envs.items():
+            if v is None:
+                raise exceptions.InvalidSpecError(
+                    f'Env var {k!r} has no value. Set it in the YAML or '
+                    f'pass --env {k}=<value>.')
+
+        task = Task(
+            name=config.pop('name', None),
+            setup=config.pop('setup', None),
+            run=config.pop('run', None),
+            envs=config.pop('envs', None),
+            workdir=config.pop('workdir', None),
+            num_nodes=config.pop('num_nodes', None),
+            file_mounts=config.pop('file_mounts', None),
+            event_callback=config.pop('event_callback', None),
+        )
+        resources_config = config.pop('resources', None)
+        task.set_resources(Resources.from_yaml_config(resources_config))
+
+        storage_config = config.pop('storage_mounts', None)
+        if storage_config:
+            from skypilot_tpu.data import storage as storage_lib
+            mounts = {}
+            for mount_path, one in storage_config.items():
+                mounts[mount_path] = storage_lib.Storage.from_yaml_config(
+                    one)
+            task.set_storage_mounts(mounts)
+
+        service_config = config.pop('service', None)
+        if service_config is not None:
+            from skypilot_tpu.serve import service_spec
+            task.service = service_spec.SkyServiceSpec.from_yaml_config(
+                service_config)
+
+        config.pop('inputs', None)
+        config.pop('outputs', None)
+        if config:
+            raise exceptions.InvalidSpecError(
+                f'Unknown task fields: {sorted(config)}')
+        return task
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out['name'] = self.name
+        if len(self.resources) == 1:
+            rc = next(iter(self.resources)).to_yaml_config()
+            if rc:
+                out['resources'] = rc
+        elif len(self.resources) > 1:
+            out['resources'] = {
+                'any_of': [r.to_yaml_config() for r in self.resources]
+            }
+        if self.num_nodes != 1:
+            out['num_nodes'] = self.num_nodes
+        if self.workdir:
+            out['workdir'] = self.workdir
+        if self.setup:
+            out['setup'] = self.setup
+        if isinstance(self.run, str):
+            out['run'] = self.run
+        if self.envs:
+            out['envs'] = dict(self.envs)
+        if self.file_mounts:
+            out['file_mounts'] = dict(self.file_mounts)
+        if self.storage_mounts:
+            out['storage_mounts'] = {
+                path: s.to_yaml_config()
+                for path, s in self.storage_mounts.items()
+            }
+        if self.service is not None:
+            out['service'] = self.service.to_yaml_config()
+        return out
+
+    # -- misc -----------------------------------------------------------
+
+    def sync_storage_mounts(self) -> None:
+        """Upload COPY-mode storage and translate storage mounts to
+        file mounts (reference ``sky/task.py:951``)."""
+        for _, storage in self.storage_mounts.items():
+            storage.construct()
+
+    def __repr__(self) -> str:
+        name = self.name or '<unnamed>'
+        accels = sorted({r.accelerator for r in self.resources
+                         if r.accelerator is not None})
+        accel_str = f', {accels}' if accels else ''
+        return f'Task({name}{accel_str}, num_nodes={self.num_nodes})'
+
+
+def _substitute_env_vars(text: str, envs: Dict[str, str]) -> str:
+    """Replace ``$VAR`` / ``${VAR}`` for declared env vars only (others
+    are left for the shell at runtime)."""
+
+    def repl(m: 're.Match') -> str:
+        var = m.group(1) or m.group(2)
+        if var in envs and envs[var] is not None:
+            return str(envs[var])
+        return m.group(0)
+
+    return re.sub(r'\$\{([A-Za-z_][A-Za-z0-9_]*)\}'
+                  r'|\$([A-Za-z_][A-Za-z0-9_]*)', repl, text)

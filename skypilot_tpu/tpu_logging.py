@@ -1,0 +1,98 @@
+"""Logging setup (analog of ``sky/sky_logging.py:1-179``).
+
+One library-wide logger tree rooted at ``skypilot_tpu``, a newline-aware
+formatter so multi-line subprocess output stays aligned, and env-gated
+debug verbosity (SKYTPU_DEBUG=1).
+"""
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_FORMATTER = None
+_setup_lock = threading.Lock()
+_initialized = False
+
+
+def _debug_enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+class NewLineFormatter(logging.Formatter):
+    """Pads continuation lines so multi-line messages stay readable."""
+
+    def format(self, record):
+        msg = super().format(record)
+        if record.message != '':
+            parts = msg.split(record.message)
+            msg = msg.replace('\n', '\r\n' + parts[0])
+        return msg
+
+
+def _root_logger() -> logging.Logger:
+    return logging.getLogger('skypilot_tpu')
+
+
+def _setup():
+    global _initialized, _FORMATTER
+    with _setup_lock:
+        if _initialized:
+            return
+        root = _root_logger()
+        root.setLevel(logging.DEBUG)
+        handler = logging.StreamHandler(sys.stdout)
+        handler.flush = sys.stdout.flush  # type: ignore[method-assign]
+        handler.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
+        _FORMATTER = NewLineFormatter(FORMAT, datefmt=DATE_FORMAT)
+        handler.setFormatter(_FORMATTER)
+        root.addHandler(handler)
+        root.propagate = False
+        _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup()
+    return logging.getLogger(name)
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress all library log output inside the block."""
+    root = _root_logger()
+    previous = root.level
+    handlers_levels = [(h, h.level) for h in root.handlers]
+    try:
+        root.setLevel(logging.CRITICAL + 1)
+        for h, _ in handlers_levels:
+            h.setLevel(logging.CRITICAL + 1)
+        yield
+    finally:
+        root.setLevel(previous)
+        for h, lvl in handlers_levels:
+            h.setLevel(lvl)
+
+
+def is_silent() -> bool:
+    return _root_logger().level > logging.CRITICAL
+
+
+def print_exception_no_traceback():
+    """Context manager that hides tracebacks for user-facing errors."""
+    return _PrintExceptionNoTraceback()
+
+
+class _PrintExceptionNoTraceback(contextlib.AbstractContextManager):
+
+    def __enter__(self):
+        if not _debug_enabled():
+            sys.tracebacklimit = 0
+        return self
+
+    def __exit__(self, *args):
+        if hasattr(sys, 'tracebacklimit'):
+            del sys.tracebacklimit
+        return False

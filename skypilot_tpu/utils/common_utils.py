@@ -1,0 +1,191 @@
+"""Shared helpers (analog of ``sky/utils/common_utils.py:1-718``).
+
+User hashing, on-cloud cluster-name mangling, retry/backoff, yaml dump
+helpers.
+"""
+import getpass
+import hashlib
+import os
+import random
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+import yaml
+
+USER_HASH_LENGTH = 8
+CLUSTER_NAME_VALID_REGEX = r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$'
+_user_hash: Optional[str] = None
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash, used to namespace cloud resources.
+
+    Analog of the reference's user hash persisted in
+    ``~/.sky/user_hash``; here ``~/.skypilot_tpu/user_hash``.
+    """
+    global _user_hash
+    if _user_hash is not None:
+        return _user_hash
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env and re.fullmatch(r'[0-9a-f]+', env):
+        _user_hash = env
+        return _user_hash
+    path = os.path.expanduser('~/.skypilot_tpu/user_hash')
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            content = f.read().strip()
+        if re.fullmatch(r'[0-9a-f]+', content):
+            _user_hash = content
+            return _user_hash
+    seed = f'{getpass.getuser()}+{socket.gethostname()}+{uuid.getnode()}'
+    _user_hash = hashlib.md5(seed.encode()).hexdigest()[:USER_HASH_LENGTH]
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(_user_hash)
+    return _user_hash
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def is_valid_cluster_name(name: Optional[str]) -> bool:
+    return name is not None and bool(
+        re.fullmatch(CLUSTER_NAME_VALID_REGEX, name))
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not is_valid_cluster_name(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: ensure it matches '
+            f'{CLUSTER_NAME_VALID_REGEX} (alphanumeric, -_., starts with '
+            'a letter).')
+
+
+def make_cluster_name_on_cloud(display_name: str,
+                               max_length: int = 35) -> str:
+    """Append the user hash and truncate so the cloud-side name is
+    unique per user and within cloud naming limits (analog of
+    ``sky/utils/common_utils.py`` make_cluster_name_on_cloud)."""
+    user_hash = get_user_hash()
+    name = re.sub(r'[^a-z0-9-]', '-', display_name.lower())
+    suffix = f'-{user_hash}'
+    budget = max_length - len(suffix)
+    if len(name) > budget:
+        digest = hashlib.md5(name.encode()).hexdigest()[:4]
+        name = name[:budget - 5] + '-' + digest
+    return name + suffix
+
+
+class Backoff:
+    """Exponential backoff with jitter (analog of common_utils.Backoff)."""
+
+    MULTIPLIER = 1.6
+    JITTER = 0.4
+
+    def __init__(self, initial_backoff: float = 5.0,
+                 max_backoff_factor: int = 5):
+        self._initial = True
+        self._backoff = 0.0
+        self._initial_backoff = initial_backoff
+        self._max_backoff = max_backoff_factor * initial_backoff
+
+    def current_backoff(self) -> float:
+        if self._initial:
+            self._initial = False
+            self._backoff = min(self._initial_backoff, self._max_backoff)
+        else:
+            self._backoff = min(self._backoff * self.MULTIPLIER,
+                                self._max_backoff)
+        self._backoff += random.uniform(-self.JITTER * self._backoff,
+                                        self.JITTER * self._backoff)
+        return self._backoff
+
+
+def retry(fn: Callable, max_retries: int = 3,
+          initial_backoff: float = 1.0) -> Any:
+    backoff = Backoff(initial_backoff)
+    for attempt in range(max_retries):
+        try:
+            return fn()
+        except Exception:  # pylint: disable=broad-except
+            if attempt == max_retries - 1:
+                raise
+            time.sleep(backoff.current_backoff())
+
+
+def dump_yaml_str(config: Any) -> str:
+
+    class LineBreakDumper(yaml.SafeDumper):
+
+        def write_line_break(self, data=None):
+            super().write_line_break(data)
+            if len(self.indents) == 1:
+                super().write_line_break()
+
+    return yaml.dump(config, Dumper=LineBreakDumper, sort_keys=False,
+                     default_flow_style=False)
+
+
+def dump_yaml(path: str, config: Any) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(path, encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str):
+    with open(path, encoding='utf-8') as f:
+        return list(yaml.safe_load_all(f))
+
+
+def fill_template(template: str, variables: Dict[str, Any]) -> str:
+    import jinja2
+    return jinja2.Template(template,
+                           undefined=jinja2.StrictUndefined).render(
+                               **variables)
+
+
+def format_float(num: float, precision: int = 1) -> str:
+    if num < 1:
+        return f'{num:.{precision}f}'
+    unit_list = [(1e9, 'B'), (1e6, 'M'), (1e3, 'K')]
+    for unit, suffix in unit_list:
+        if num >= unit:
+            return f'{num / unit:.{precision}f}{suffix}'
+    return str(round(num, precision))
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    splits = s.split(' ')
+    if len(splits[0]) > max_length:
+        return s[:max_length - 3] + '...'
+    prefix = ''
+    for part in splits:
+        if len(prefix) + len(part) + 1 > max_length:
+            break
+        prefix += part + ' '
+    return prefix.rstrip() + '...'
+
+
+def get_pretty_entrypoint() -> str:
+    import sys
+    argv = list(sys.argv)
+    if not argv:
+        return ''
+    argv[0] = os.path.basename(argv[0])
+    return ' '.join(argv)
+
+
+def class_fullname(cls) -> str:
+    return f'{cls.__module__}.{cls.__name__}'

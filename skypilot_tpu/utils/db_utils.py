@@ -1,0 +1,60 @@
+"""Tiny sqlite helpers (analog of ``sky/utils/db_utils.py``)."""
+import contextlib
+import os
+import sqlite3
+import threading
+from typing import Callable, Optional
+
+
+@contextlib.contextmanager
+def safe_cursor(db_path: str):
+    """Open, yield a cursor, commit, close — per-call connection so
+    multiple processes can share the database."""
+    conn = sqlite3.connect(os.path.expanduser(db_path), timeout=10)
+    cursor = conn.cursor()
+    try:
+        yield cursor
+    finally:
+        cursor.close()
+        conn.commit()
+        conn.close()
+
+
+def add_column_to_table(cursor: sqlite3.Cursor, conn: sqlite3.Connection,
+                        table_name: str, column_name: str,
+                        column_type: str,
+                        default_value=None) -> None:
+    """Idempotent ALTER TABLE ADD COLUMN for schema migrations."""
+    for row in cursor.execute(f'PRAGMA table_info({table_name})'):
+        if row[1] == column_name:
+            return
+    stmt = f'ALTER TABLE {table_name} ADD COLUMN {column_name} {column_type}'
+    if default_value is not None:
+        stmt += f' DEFAULT {default_value!r}'
+    cursor.execute(stmt)
+    conn.commit()
+
+
+class SQLiteConn(threading.local):
+    """Thread-local sqlite connection with a creation hook."""
+
+    def __init__(self, db_path: str,
+                 create_table: Callable[[sqlite3.Cursor, sqlite3.Connection],
+                                        None]):
+        super().__init__()
+        self.db_path = os.path.expanduser(db_path)
+        dirname = os.path.dirname(self.db_path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        self.conn = sqlite3.connect(self.db_path, timeout=10)
+        self.cursor = self.conn.cursor()
+        create_table(self.cursor, self.conn)
+
+    def execute_and_commit(self, sql: str, params: Optional[tuple] = None):
+        try:
+            if params is None:
+                self.cursor.execute(sql)
+            else:
+                self.cursor.execute(sql, params)
+        finally:
+            self.conn.commit()

@@ -1,0 +1,21 @@
+"""Environment-variable flags (analog of ``sky/utils/env_options.py:6``)."""
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = 'SKYTPU_DEV'
+    SHOW_DEBUG_INFO = 'SKYTPU_DEBUG'
+    DISABLE_LOGGING = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+    MINIMIZE_LOGGING = 'SKYTPU_MINIMIZE_LOGGING'
+    # Internal: running on the on-cluster runtime (not the client).
+    IS_REMOTE_CLUSTER = 'SKYTPU_IS_REMOTE'
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, '0') == '1'
+
+    # Allow `if Options.SHOW_DEBUG_INFO:` style via __bool__ on value
+    # lookup helpers.
+    @property
+    def env_key(self) -> str:
+        return self.value

@@ -1,0 +1,84 @@
+"""Terminal output helpers (analog of ``sky/utils/ux_utils.py`` +
+``cli_utils/status_utils.py`` table rendering), stdlib-only."""
+import contextlib
+import sys
+from typing import List, Sequence
+
+
+class Table:
+    """Minimal left-aligned text table (prettytable is not vendored)."""
+
+    def __init__(self, field_names: Sequence[str]):
+        self.field_names = list(field_names)
+        self.rows: List[List[str]] = []
+
+    def add_row(self, row: Sequence) -> None:
+        assert len(row) == len(self.field_names), (row, self.field_names)
+        self.rows.append([str(c) for c in row])
+
+    def get_string(self) -> str:
+        widths = [len(h) for h in self.field_names]
+        for row in self.rows:
+            for i, cell in enumerate(row):
+                widths[i] = max(widths[i], len(_strip_ansi(cell)))
+        lines = []
+        header = '  '.join(
+            h.ljust(widths[i]) for i, h in enumerate(self.field_names))
+        lines.append(header)
+        for row in self.rows:
+            lines.append('  '.join(
+                cell + ' ' * (widths[i] - len(_strip_ansi(cell)))
+                for i, cell in enumerate(row)).rstrip())
+        return '\n'.join(lines)
+
+    def __str__(self) -> str:
+        return self.get_string()
+
+
+def _strip_ansi(s: str) -> str:
+    import re
+    return re.sub(r'\x1b\[[0-9;]*m', '', s)
+
+
+BOLD = '\033[1m'
+RESET_BOLD = '\033[0m'
+DIM = '\033[2m'
+
+
+def bold(s: str) -> str:
+    return f'{BOLD}{s}{RESET_BOLD}'
+
+
+def dim(s: str) -> str:
+    return f'{DIM}{s}{RESET_BOLD}'
+
+
+@contextlib.contextmanager
+def print_exception_no_traceback():
+    try:
+        if sys.gettrace() is None:  # keep tracebacks under a debugger
+            sys.tracebacklimit = 0
+        yield
+    finally:
+        if hasattr(sys, 'tracebacklimit'):
+            del sys.tracebacklimit
+
+
+@contextlib.contextmanager
+def spinner(message: str):
+    """Rich status spinner when on a tty; plain log line otherwise.
+
+    Exceptions raised inside the block always propagate unchanged."""
+    status_ctx = None
+    if sys.stdout.isatty():
+        try:
+            import rich.console
+            status_ctx = rich.console.Console().status(message)
+        except Exception:  # pylint: disable=broad-except
+            status_ctx = None
+    if status_ctx is None:
+        print(message, flush=True)
+        yield
+    else:
+        with status_ctx:
+            yield

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax import so
+sharding/mesh tests run anywhere (the driver separately validates the
+multi-chip path via ``__graft_entry__.dryrun_multichip``). Also points
+the client state DB at a tmpdir so tests never touch ~/.skypilot_tpu.
+"""
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_state(tmp_path, monkeypatch):
+    """Every test gets a fresh state dir / config."""
+    monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
+    monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'config.yaml'))
+    monkeypatch.setenv('SKYTPU_USER_HASH', 'deadbeef')
+    from skypilot_tpu import config as config_lib
+    config_lib.reload_config()
+    yield
+    config_lib.reload_config()

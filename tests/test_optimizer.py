@@ -1,0 +1,200 @@
+"""Optimizer tests (model: ``tests/test_optimizer_dryruns.py`` and the
+random-DAG brute-force equality test
+``tests/test_optimizer_random_dag.py`` of the reference)."""
+import random
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions, optimize
+from skypilot_tpu.optimizer import OptimizeTarget
+
+
+def _optimize_quiet(dag, **kwargs):
+    return optimize(dag, quiet=True, **kwargs)
+
+
+class TestSingleTask:
+
+    def test_picks_cheapest_region(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _optimize_quiet(dag)
+        best = task.best_resources
+        assert best.region is not None
+        # Cheapest v5e region is a US one (non-US carry a multiplier).
+        assert best.region.startswith('us-')
+
+    def test_respects_region_pin(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources(
+                Resources(accelerators='tpu-v5e-8',
+                          region='europe-west4'))
+        _optimize_quiet(dag)
+        assert task.best_resources.region == 'europe-west4'
+
+    def test_any_of_picks_cheapest_type(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources({
+                Resources(accelerators='tpu-v5e-8'),
+                Resources(accelerators='tpu-v5p-8'),
+            })
+        _optimize_quiet(dag)
+        # v5e-8 (8 chips x $1.2) = $9.6/hr < v5p-8 (4 chips x $4.2) =
+        # $16.8/hr.
+        assert task.best_resources.accelerator == 'tpu-v5e-8'
+
+    def test_spot_preferred_when_requested(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources(
+                Resources(accelerators='tpu-v5p-8', use_spot=True))
+        _optimize_quiet(dag)
+        assert task.best_resources.use_spot
+
+    def test_cpu_vm_for_no_accelerator(self):
+        with Dag() as dag:
+            task = Task(name='controller', run='x')
+        _optimize_quiet(dag)
+        assert task.best_resources.accelerator is None
+        assert task.best_resources.cloud == 'gcp'
+
+    def test_blocked_region_skipped(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _optimize_quiet(dag)
+        cheapest = task.best_resources.region
+        blocked = {Resources(accelerators='tpu-v5e-8',
+                             region=cheapest)}
+        with Dag() as dag2:
+            task2 = Task(name='t', run='x')
+            task2.set_resources(Resources(accelerators='tpu-v5e-8'))
+        _optimize_quiet(dag2, blocked_resources=blocked)
+        assert task2.best_resources.region != cheapest
+
+    def test_infeasible_raises(self):
+        with Dag() as dag:
+            task = Task(name='t', run='x')
+            task.set_resources(Resources(accelerators='tpu-v4-8'))
+        # Block v4's only region.
+        blocked = {Resources(accelerators='tpu-v4-8',
+                             region='us-central2')}
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            _optimize_quiet(dag, blocked_resources=blocked)
+
+
+class TestChainDag:
+
+    def test_egress_pulls_same_region(self):
+        """Two-stage chain with large intermediate data should
+        co-locate even if stage 2 alone would pick another region."""
+        with Dag() as dag:
+            t1 = Task(name='produce', run='x')
+            t1.set_resources(
+                Resources(accelerators='tpu-v5e-8',
+                          region='europe-west4'))
+            t1.estimated_outputs_size_gigabytes = 10000.0
+            t2 = Task(name='consume', run='x')
+            t2.set_resources(Resources(accelerators='tpu-v5e-8'))
+            dag.add_edge(t1, t2)
+        _optimize_quiet(dag)
+        assert t2.best_resources.region == 'europe-west4'
+
+    def test_no_egress_picks_cheapest(self):
+        with Dag() as dag:
+            t1 = Task(name='a', run='x')
+            t1.set_resources(
+                Resources(accelerators='tpu-v5e-8',
+                          region='europe-west4'))
+            t2 = Task(name='b', run='x')
+            t2.set_resources(Resources(accelerators='tpu-v5e-8'))
+            dag.add_edge(t1, t2)
+        _optimize_quiet(dag)
+        assert t2.best_resources.region.startswith('us-')
+
+
+class TestRandomDagBruteForce:
+    """Property test mirroring the reference's
+    test_optimizer_random_dag: chain-DP result equals brute force."""
+
+    def test_dp_equals_brute_force(self):
+        rng = random.Random(42)
+        accels = ['tpu-v5e-8', 'tpu-v6e-8', 'tpu-v5p-8', 'tpu-v3-8']
+        for trial in range(5):
+            n = rng.randint(2, 4)
+            with Dag() as dag:
+                tasks = []
+                prev = None
+                for i in range(n):
+                    t = Task(name=f't{trial}-{i}', run='x')
+                    chosen = rng.sample(accels, rng.randint(1, 2))
+                    t.set_resources(
+                        {Resources(accelerators=a) for a in chosen})
+                    t.estimated_outputs_size_gigabytes = \
+                        rng.choice([0.0, 5000.0])
+                    if prev is not None:
+                        dag.add_edge(prev, t)
+                    prev = t
+                    tasks.append(t)
+            assert dag.is_chain()
+            _optimize_quiet(dag)
+            dp_cost = sum(
+                t.best_resources.get_hourly_price() * t.num_nodes
+                for t in tasks)
+
+            # Brute force over the same candidate space.
+            from skypilot_tpu import optimizer as opt
+            cands = {
+                t: opt._enumerate_candidates(t, set()) for t in tasks
+            }
+            plan = opt._optimize_exhaustive(dag, cands,
+                                            OptimizeTarget.COST)
+            bf_total = sum(c.total_cost for c in plan.values())
+            for (u, v) in dag.graph.edges:
+                bf_total += opt._edge_cost(u, plan[u], plan[v],
+                                           OptimizeTarget.COST)
+            # And the DP total with edge costs:
+            dp_plan = {t: next(c for c in cands[t]
+                               if c.resources == t.best_resources)
+                       for t in tasks}
+            dp_total = sum(c.total_cost for c in dp_plan.values())
+            for (u, v) in dag.graph.edges:
+                dp_total += opt._edge_cost(u, dp_plan[u], dp_plan[v],
+                                           OptimizeTarget.COST)
+            assert dp_total == pytest.approx(bf_total), (
+                f'trial {trial}: DP {dp_total} != BF {bf_total}; '
+                f'dp picked {dp_cost}')
+
+
+class TestReviewRegressions:
+    """Regressions for the round-1 code-review findings."""
+
+    def test_zone_pin_without_region(self):
+        with Dag() as dag:
+            t = Task(name='t', run='x')
+            t.set_resources(
+                Resources(accelerators='tpu-v5e-8', zone='us-east5-b'))
+        _optimize_quiet(dag)
+        assert t.best_resources.region == 'us-east5'
+        assert t.best_resources.zone == 'us-east5-b'
+
+    def test_blocklist_does_not_block_larger_slice(self):
+        blocked = {Resources(accelerators='tpu-v5p-8',
+                             region='us-east5')}
+        with Dag() as dag:
+            t = Task(name='t', run='x')
+            t.set_resources(
+                Resources(accelerators='tpu-v5p-16', region='us-east5'))
+        _optimize_quiet(dag, blocked_resources=blocked)
+        assert t.best_resources.accelerator == 'tpu-v5p-16'
+
+    def test_cpu_vm_cost_scales_with_nodes(self):
+        from skypilot_tpu import optimizer as opt
+        t1 = Task(name='one', run='x')
+        t4 = Task(name='four', run='x', num_nodes=4)
+        c1 = opt._enumerate_candidates(t1, set())[0]
+        c4 = opt._enumerate_candidates(t4, set())[0]
+        assert c4.cost_per_hour == pytest.approx(4 * c1.cost_per_hour)

@@ -1,0 +1,212 @@
+"""Resources + catalog tests (model:
+``tests/unit_tests/test_resources.py`` and the tpu cases in
+``tests/test_optimizer_dryruns.py`` of the reference)."""
+import pytest
+
+from skypilot_tpu import Resources, catalog, exceptions
+
+
+class TestAcceleratorParsing:
+
+    def test_basic(self):
+        r = Resources(accelerators='tpu-v5p-8')
+        assert r.accelerator == 'tpu-v5p-8'
+        spec = r.tpu_spec
+        assert spec.chips == 4
+        assert spec.cores == 8
+        assert spec.num_hosts == 1
+        assert spec.generation == 'v5p'
+
+    def test_dict_form(self):
+        r = Resources(accelerators={'tpu-v6e-16': 1})
+        assert r.accelerator == 'tpu-v6e-16'
+
+    def test_dict_count_must_be_one(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources(accelerators={'tpu-v6e-16': 2})
+
+    def test_v5litepod_alias(self):
+        r = Resources(accelerators='tpu-v5litepod-8')
+        assert r.accelerator == 'tpu-v5e-8'
+
+    def test_case_insensitive(self):
+        r = Resources(accelerators='TPU-V6E-8')
+        assert r.accelerator == 'tpu-v6e-8'
+
+    def test_invalid_name(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources(accelerators='a100-8')
+
+    def test_unknown_size_suggests_candidates(self):
+        r = Resources.__new__(Resources)
+        with pytest.raises(exceptions.ResourcesUnavailableError,
+                           match='tpu-v5p'):
+            catalog.get_tpu_spec('tpu-v5p-7')
+        del r
+
+    def test_pod_detection(self):
+        assert not catalog.get_tpu_spec('tpu-v5p-8').is_pod
+        assert catalog.get_tpu_spec('tpu-v5p-256').is_pod
+        # v6e quirk: v6e-8 is single host, v6e-16 is 4 hosts.
+        assert catalog.get_tpu_spec('tpu-v6e-8').num_hosts == 1
+        assert catalog.get_tpu_spec('tpu-v6e-16').num_hosts == 4
+
+    def test_hosts_math_v5p(self):
+        spec = catalog.get_tpu_spec('tpu-v5p-256')
+        assert spec.chips == 128
+        assert spec.num_hosts == 32
+        assert spec.chips_per_host == 4
+
+
+class TestRegionZoneValidation:
+
+    def test_valid_region(self):
+        Resources(accelerators='tpu-v5p-8', region='us-east5')
+
+    def test_invalid_region(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources(accelerators='tpu-v4-8', region='us-east1')
+
+    def test_zone_must_match_region(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources(accelerators='tpu-v5p-8', region='us-east5',
+                      zone='us-central1-a')
+
+    def test_cloud_gcp_only(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources(cloud='aws', accelerators='tpu-v5p-8')
+
+
+class TestPricing:
+
+    def test_spot_cheaper(self):
+        od = Resources(accelerators='tpu-v5e-8').get_hourly_price()
+        spot = Resources(accelerators='tpu-v5e-8',
+                         use_spot=True).get_hourly_price()
+        assert 0 < spot < od
+
+    def test_price_scales_with_chips(self):
+        small = Resources(accelerators='tpu-v5p-8').get_hourly_price()
+        big = Resources(accelerators='tpu-v5p-32').get_hourly_price()
+        assert abs(big / small - 4.0) < 0.01
+
+    def test_get_cost(self):
+        r = Resources(accelerators='tpu-v5e-4')
+        assert r.get_cost(3600) == pytest.approx(r.get_hourly_price())
+
+    def test_v6e_price_never_zero(self):
+        # The reference catalog ships v6e rows priced 0.0 in some
+        # regions (examples/tpu/v6e/README.md:7); ours must not.
+        for region in catalog.get_regions('tpu-v6e-8'):
+            assert catalog.get_hourly_cost('tpu-v6e-8', False,
+                                           region) > 0
+            assert catalog.get_hourly_cost('tpu-v6e-8', True,
+                                           region) > 0
+
+
+class TestLessDemandingThan:
+
+    def test_same(self):
+        a = Resources(accelerators='tpu-v5p-8')
+        assert a.less_demanding_than(a)
+
+    def test_smaller_slice_fits_bigger_cluster(self):
+        small = Resources(accelerators='tpu-v5p-8')
+        big = Resources(accelerators='tpu-v5p-16')
+        assert small.less_demanding_than(big)
+        assert not big.less_demanding_than(small)
+
+    def test_generation_mismatch(self):
+        a = Resources(accelerators='tpu-v5p-8')
+        b = Resources(accelerators='tpu-v5e-8')
+        assert not a.less_demanding_than(b)
+
+    def test_region_pin(self):
+        pinned = Resources(accelerators='tpu-v5p-8', region='us-east5')
+        other = Resources(accelerators='tpu-v5p-8',
+                          region='us-central1')
+        assert not pinned.less_demanding_than(other)
+
+
+class TestYamlRoundTrip:
+
+    def test_round_trip(self):
+        r = Resources(accelerators='tpu-v5p-8', region='us-east5',
+                      use_spot=True, disk_size=256, ports=[8888])
+        r2 = next(iter(Resources.from_yaml_config(r.to_yaml_config())))
+        assert r == r2
+
+    def test_any_of(self):
+        out = Resources.from_yaml_config({
+            'any_of': [{'accelerators': 'tpu-v5e-8'},
+                       {'accelerators': 'tpu-v6e-8'}]
+        })
+        assert len(out) == 2
+        assert {r.accelerator for r in out} == {'tpu-v5e-8',
+                                                'tpu-v6e-8'}
+
+    def test_accelerator_list(self):
+        out = Resources.from_yaml_config(
+            {'accelerators': ['tpu-v5e-8', 'tpu-v5p-8']})
+        assert len(out) == 2
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Resources.from_yaml_config({'nonsense_field': 1})
+
+    def test_reference_accelerator_args_compat(self):
+        out = Resources.from_yaml_config({
+            'accelerators': 'tpu-v2-8',
+            'accelerator_args': {'runtime_version': 'tpu-vm-base'},
+        })
+        r = next(iter(out))
+        assert r.runtime_version == 'tpu-vm-base'
+
+
+class TestDeployVariables:
+
+    def test_deploy_vars(self):
+        r = Resources(accelerators='tpu-v5p-16', region='us-east5')
+        v = r.make_deploy_variables('mycluster-deadbeef')
+        assert v['accelerator_type'] == 'v5p-16'
+        assert v['num_hosts'] == 2
+        assert v['runtime_version'] == 'v2-alpha-tpuv5'
+
+    def test_gcp_accelerator_type_v5e(self):
+        r = Resources(accelerators='tpu-v5e-16')
+        v = r.make_deploy_variables('c')
+        assert v['accelerator_type'] == 'v5litepod-16'
+
+    def test_gcp_accelerator_type_v6e(self):
+        r = Resources(accelerators='tpu-v6e-16')
+        v = r.make_deploy_variables('c')
+        assert v['accelerator_type'] == 'v6e-16'
+
+
+class TestCatalogListing:
+
+    def test_list_accelerators(self):
+        out = catalog.list_accelerators(name_filter='v5p')
+        assert 'tpu-v5p-8' in out
+        entry = out['tpu-v5p-8'][0]
+        assert entry['chips'] == 4
+
+    def test_regions_sorted_by_price(self):
+        regions = catalog.get_regions('tpu-v5e-8')
+        costs = [catalog.get_hourly_cost('tpu-v5e-8', False, r)
+                 for r in regions]
+        assert costs == sorted(costs)
+
+
+def test_hash_eq_consistent_for_dict_fields():
+    a = Resources(accelerators='tpu-v5e-8', labels={'a': '1', 'b': '2'})
+    b = Resources(accelerators='tpu-v5e-8', labels={'b': '2', 'a': '1'})
+    assert a == b
+    assert hash(a) == hash(b)
+    assert len({a, b}) == 1
+
+
+def test_v5e_v6e_cores_equal_chips():
+    # v5e/v6e chips have a single TensorCore.
+    assert catalog.get_tpu_spec('tpu-v5e-8').cores == 8
+    assert catalog.get_tpu_spec('tpu-v6e-16').cores == 16

@@ -1,0 +1,75 @@
+"""Client state DB tests (model: ``tests/test_global_user_state.py``)."""
+import time
+
+from skypilot_tpu import state, status_lib
+
+
+class FakeHandle:
+
+    def __init__(self, name):
+        self.cluster_name = name
+        self.num_hosts = 2
+        self.launched_resources = None
+
+
+def test_add_get_remove_cluster():
+    state.add_or_update_cluster('c1', FakeHandle('c1'), None, ready=True)
+    rec = state.get_cluster_from_name('c1')
+    assert rec is not None
+    assert rec['status'] == status_lib.ClusterStatus.UP
+    assert rec['handle'].cluster_name == 'c1'
+
+    state.update_cluster_status('c1', status_lib.ClusterStatus.INIT)
+    assert state.get_cluster_from_name('c1')['status'] == \
+        status_lib.ClusterStatus.INIT
+
+    state.remove_cluster('c1', terminate=False)
+    assert state.get_cluster_from_name('c1')['status'] == \
+        status_lib.ClusterStatus.STOPPED
+
+    state.remove_cluster('c1', terminate=True)
+    assert state.get_cluster_from_name('c1') is None
+
+
+def test_autostop():
+    state.add_or_update_cluster('c2', FakeHandle('c2'), None, ready=True)
+    state.set_cluster_autostop_value('c2', 30, to_down=True)
+    rec = state.get_cluster_from_name('c2')
+    assert rec['autostop'] == 30
+    assert rec['to_down'] is True
+
+
+def test_usage_intervals_and_history():
+    state.add_or_update_cluster('c3', FakeHandle('c3'), None, ready=True)
+    rec = state.get_cluster_from_name('c3')
+    assert len(rec['usage_intervals']) == 1
+    start, end = rec['usage_intervals'][0]
+    assert end is None
+    time.sleep(0.01)
+    state.remove_cluster('c3', terminate=True)
+    hist = state.get_clusters_from_history()
+    entry = next(h for h in hist if h['name'] == 'c3')
+    assert entry['duration'] >= 0
+    assert entry['num_nodes'] == 2
+
+
+def test_list_clusters_ordering():
+    state.add_or_update_cluster('a', FakeHandle('a'), None, ready=True)
+    state.add_or_update_cluster('b', FakeHandle('b'), None, ready=False)
+    names = [c['name'] for c in state.get_clusters()]
+    assert set(names) == {'a', 'b'}
+
+
+def test_enabled_clouds_cache():
+    assert state.get_enabled_clouds() == []
+    state.set_enabled_clouds(['gcp'])
+    assert state.get_enabled_clouds() == ['gcp']
+
+
+def test_storage_records():
+    state.add_or_update_storage('bkt', {'name': 'bkt'}, 'READY')
+    assert state.get_storage_names_start_with('bk') == ['bkt']
+    recs = state.get_storage()
+    assert recs[0]['name'] == 'bkt'
+    state.remove_storage('bkt')
+    assert state.get_storage() == []

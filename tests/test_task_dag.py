@@ -1,0 +1,155 @@
+"""Task YAML parsing + Dag tests (model: ``tests/test_yaml_parser.py``
+of the reference)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+
+
+def _write(tmp_path, content):
+    p = tmp_path / 'task.yaml'
+    p.write_text(textwrap.dedent(content))
+    return str(p)
+
+
+class TestTaskYaml:
+
+    def test_minimal(self, tmp_path):
+        task = Task.from_yaml(_write(tmp_path, """\
+            name: train
+            run: echo hello
+        """))
+        assert task.name == 'train'
+        assert task.run == 'echo hello'
+        assert task.num_nodes == 1
+
+    def test_full(self, tmp_path):
+        task = Task.from_yaml(_write(tmp_path, """\
+            name: finetune
+            resources:
+              accelerators: tpu-v5p-8
+              use_spot: true
+            num_nodes: 1
+            envs:
+              MODEL: llama3-8b
+            setup: pip list
+            run: |
+              python train.py --model $MODEL
+        """))
+        r = next(iter(task.resources))
+        assert r.accelerator == 'tpu-v5p-8'
+        assert r.use_spot
+        assert 'llama3-8b' in task.run  # env substituted
+
+    def test_env_substitution_braces(self, tmp_path):
+        task = Task.from_yaml(_write(tmp_path, """\
+            envs:
+              X: foo
+            run: echo ${X} $X $UNDECLARED
+        """))
+        assert task.run == 'echo foo foo $UNDECLARED'
+
+    def test_env_override(self):
+        task = Task.from_yaml_config(
+            {'envs': {'X': 'a'}, 'run': 'echo $X'},
+            env_overrides={'X': 'b'})
+        assert task.run == 'echo b'
+
+    def test_null_env_rejected(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Task.from_yaml_config({'envs': {'X': None},
+                                   'run': 'echo hi'})
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Task.from_yaml_config({'run': 'x', 'bogus': 1})
+
+    def test_invalid_name(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Task(name='has space')
+
+    def test_round_trip(self, tmp_path):
+        task = Task.from_yaml(_write(tmp_path, """\
+            name: t1
+            resources:
+              accelerators: tpu-v6e-8
+            num_nodes: 2
+            setup: echo setup
+            run: echo run
+            envs:
+              A: b
+        """))
+        config = task.to_yaml_config()
+        task2 = Task.from_yaml_config(config)
+        assert task2.name == task.name
+        assert task2.num_nodes == 2
+        assert task2.setup == task.setup
+        assert {r.accelerator for r in task2.resources} == {'tpu-v6e-8'}
+
+    def test_multiple_candidate_resources(self):
+        task = Task.from_yaml_config({
+            'run': 'x',
+            'resources': {
+                'any_of': [{'accelerators': 'tpu-v5e-8'},
+                           {'accelerators': 'tpu-v6e-8'}]
+            }
+        })
+        assert len(task.resources) == 2
+
+
+class TestDag:
+
+    def test_context_registration(self):
+        with Dag() as dag:
+            t1 = Task(name='a', run='echo a')
+            t2 = Task(name='b', run='echo b')
+        assert dag.tasks == [t1, t2]
+
+    def test_chain(self):
+        with Dag() as dag:
+            t1 = Task(name='a', run='x')
+            t2 = Task(name='b', run='x')
+            t3 = Task(name='c', run='x')
+            dag.add_edge(t1, t2)
+            dag.add_edge(t2, t3)
+        assert dag.is_chain()
+
+    def test_not_chain(self):
+        with Dag() as dag:
+            t1 = Task(name='a', run='x')
+            t2 = Task(name='b', run='x')
+            t3 = Task(name='c', run='x')
+            dag.add_edge(t1, t2)
+            dag.add_edge(t1, t3)
+        assert not dag.is_chain()
+
+    def test_single_task_is_chain(self):
+        with Dag() as dag:
+            Task(name='a', run='x')
+        assert dag.is_chain()
+
+
+def test_dag_context_is_thread_local():
+    import threading
+    errors = []
+
+    def worker(idx):
+        try:
+            with Dag() as d:
+                t = Task(name=f'w{idx}', run='x')
+                assert d.tasks == [t]
+                with Dag() as inner:
+                    t2 = Task(name=f'w{idx}inner', run='x')
+                    assert inner.tasks == [t2]
+                assert d.tasks == [t]
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors, errors
