@@ -1,0 +1,26 @@
+"""In-tree JAX model family (flagship: Llama 3.x).
+
+The reference ships models only as recipe YAMLs pulling HF/torch
+(``llm/llama-3_1-finetuning``, ``examples/tpu/v6e/train-llama3-8b.yaml``);
+here the models are first-class JAX code so recipes, bench, and serving
+share one TPU-native implementation.
+"""
+from skypilot_tpu.models.llama import (
+    CONFIGS,
+    LlamaConfig,
+    forward,
+    get_config,
+    init_params,
+    loss_fn,
+    param_sharding_rules,
+)
+
+__all__ = [
+    'CONFIGS',
+    'LlamaConfig',
+    'forward',
+    'get_config',
+    'init_params',
+    'loss_fn',
+    'param_sharding_rules',
+]

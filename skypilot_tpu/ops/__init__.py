@@ -1,0 +1,14 @@
+"""TPU compute kernels (Pallas) + XLA fallbacks.
+
+The reference orchestrator ships no kernels (its compute path is
+user-supplied torch; see SURVEY.md §2.11) — this package is the
+TPU-native compute library that replaces the reference's recipe
+dependencies (flash-attn inside vLLM/axolotl images) with in-tree
+JAX/Pallas implementations.
+"""
+from skypilot_tpu.ops.attention import (
+    dot_product_attention,
+    flash_attention,
+)
+
+__all__ = ['dot_product_attention', 'flash_attention']

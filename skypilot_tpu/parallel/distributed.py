@@ -1,0 +1,73 @@
+"""Multi-host JAX bootstrap from the runtime's env contract.
+
+The reference injects ``SKYPILOT_NODE_RANK`` / ``SKYPILOT_NODE_IPS``
+and lets user YAML wire torchrun's NCCL rendezvous
+(``sky/backends/cloud_vm_ray_backend.py:601-657``,
+``examples/resnet_distributed_torch.yaml:20-27``). Here the contract
+feeds ``jax.distributed.initialize`` directly: the coordinator is host
+0 of the slice, collectives ride ICI within a slice and DCN across
+slices — no NCCL, no rendezvous server.
+
+Env contract (set by the on-cluster runtime, see
+``skypilot_tpu/runtime/env_contract.py``):
+    SKYTPU_NODE_RANK       0-based host index
+    SKYTPU_NUM_NODES       total host count
+    SKYTPU_NODE_IPS        newline-separated host IPs (rank order)
+    SKYTPU_COORDINATOR_PORT  default 8476
+"""
+import os
+from typing import Optional
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+COORDINATOR_PORT_DEFAULT = 8476
+
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'
+ENV_COORDINATOR_PORT = 'SKYTPU_COORDINATOR_PORT'
+
+
+def env_is_multihost() -> bool:
+    return int(os.environ.get(ENV_NUM_NODES, '1')) > 1
+
+
+def coordinator_address() -> Optional[str]:
+    ips = os.environ.get(ENV_NODE_IPS, '').split()
+    if not ips:
+        return None
+    port = os.environ.get(ENV_COORDINATOR_PORT,
+                          str(COORDINATOR_PORT_DEFAULT))
+    return f'{ips[0]}:{port}'
+
+
+def initialize(force: bool = False) -> None:
+    """Call once at program start on every host of the slice.
+
+    No-op on single-host unless ``force``. Idempotent: a second call
+    is ignored (jax.distributed raises if already initialized).
+    """
+    import jax
+
+    if not env_is_multihost() and not force:
+        logger.debug('Single-host run; skipping '
+                     'jax.distributed.initialize.')
+        return
+    addr = coordinator_address()
+    num_processes = int(os.environ.get(ENV_NUM_NODES, '1'))
+    process_id = int(os.environ.get(ENV_NODE_RANK, '0'))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=num_processes,
+            process_id=process_id)
+        logger.info(
+            'jax.distributed initialized: process %d/%d, '
+            'coordinator %s', process_id, num_processes, addr)
+    except RuntimeError as e:
+        if 'already initialized' in str(e):
+            logger.debug('jax.distributed already initialized.')
+        else:
+            raise

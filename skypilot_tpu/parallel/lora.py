@@ -1,0 +1,68 @@
+"""LoRA adapters for the Llama family.
+
+Port target: the reference's flagship finetune recipe
+``llm/llama-3_1-finetuning/lora.yaml`` (torchtune LoRA on
+Llama-3.1-8B). Adapters attach to the q/v projections (torchtune's
+defaults), stored STACKED over layers to match the model's
+``lax.scan`` structure.
+"""
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+
+
+def init_lora(config: llama.LlamaConfig, key: jax.Array, rank: int = 16,
+              dtype=jnp.float32) -> Dict[str, Any]:
+    """A zero-init B / gaussian A pair per projection (standard LoRA
+    init: delta starts at 0)."""
+    L = config.n_layers
+    d = config.dim
+    q_out = config.n_heads * config.head_dim
+    v_out = config.n_kv_heads * config.head_dim
+    kq, kv = jax.random.split(key)
+
+    def a_init(k, out_shape):
+        return (jax.random.normal(k, out_shape, jnp.float32) /
+                math.sqrt(d)).astype(dtype)
+
+    return {
+        'wq_a': a_init(kq, (L, d, rank)),
+        'wq_b': jnp.zeros((L, rank, q_out), dtype),
+        'wv_a': a_init(kv, (L, d, rank)),
+        'wv_b': jnp.zeros((L, rank, v_out), dtype),
+    }
+
+
+def lora_sharding_rules(config: llama.LlamaConfig) -> Dict[str, Any]:
+    """LoRA factors: A shards its input dim on fsdp; B shards its
+    output (head) dim on tp — matching the base wq/wv shardings so no
+    extra collectives appear in the adapter path."""
+    del config
+    return {
+        'wq_a': P(None, 'fsdp', None),
+        'wq_b': P(None, None, 'tp'),
+        'wv_a': P(None, 'fsdp', None),
+        'wv_b': P(None, None, 'tp'),
+    }
+
+
+def merge_lora(params: llama.Params, lora: Dict[str, Any],
+               scale: float = 2.0) -> llama.Params:
+    """Fold adapters into the base weights (for export/serving)."""
+    merged = dict(params)
+    layers = dict(params['layers'])
+    layers['wq'] = (params['layers']['wq'] +
+                    scale * jnp.einsum('ldr,lro->ldo', lora['wq_a'],
+                                       lora['wq_b']).astype(
+                                           params['layers']['wq'].dtype))
+    layers['wv'] = (params['layers']['wv'] +
+                    scale * jnp.einsum('ldr,lro->ldo', lora['wv_a'],
+                                       lora['wv_b']).astype(
+                                           params['layers']['wv'].dtype))
+    merged['layers'] = layers
+    return merged

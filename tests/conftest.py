@@ -8,13 +8,25 @@ the client state DB at a tmpdir so tests never touch ~/.skypilot_tpu.
 import os
 
 # Must happen before any jax import anywhere in the test session.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# The axon TPU plugin self-registers even when JAX_PLATFORMS=cpu, so
+# drop the env var entirely and force the platform via jax.config.
+os.environ.pop('JAX_PLATFORMS', None)
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
 
 import pytest  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+# Numerics tests compare against fp32 references; JAX's default matmul
+# precision is bf16 otherwise.
+jax.config.update('jax_default_matmul_precision', 'highest')
+
+assert jax.default_backend() == 'cpu', jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
 
 
 @pytest.fixture(autouse=True)

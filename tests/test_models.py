@@ -1,0 +1,181 @@
+"""Model + attention numerics tests (CPU, 8 virtual devices)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import attention as attn
+
+
+class TestAttention:
+
+    @pytest.mark.parametrize('hkv', [4, 2, 1])
+    def test_gqa_matches_mha_expansion(self, hkv):
+        """GQA path == expanding KV heads and running MHA."""
+        key = jax.random.PRNGKey(0)
+        b, t, h, d = 2, 16, 4, 8
+        kq, kk, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, t, h, d))
+        k = jax.random.normal(kk, (b, t, hkv, d))
+        v = jax.random.normal(kv_, (b, t, hkv, d))
+        out = attn.dot_product_attention(q, k, v, causal=True)
+        k_full = jnp.repeat(k, h // hkv, axis=2)
+        v_full = jnp.repeat(v, h // hkv, axis=2)
+        ref = attn.dot_product_attention(q, k_full, v_full, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_causality(self):
+        """Changing future tokens must not change past outputs."""
+        key = jax.random.PRNGKey(1)
+        b, t, h, d = 1, 8, 2, 4
+        q = jax.random.normal(key, (b, t, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d))
+        v = jax.random.normal(jax.random.PRNGKey(3), (b, t, h, d))
+        out1 = attn.dot_product_attention(q, k, v, causal=True)
+        k2 = k.at[:, -1].set(99.0)
+        v2 = v.at[:, -1].set(99.0)
+        out2 = attn.dot_product_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(out1[:, :-1], out2[:, :-1],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_flash_fallback_matches_reference(self):
+        """On CPU flash_attention falls back to the XLA reference."""
+        key = jax.random.PRNGKey(4)
+        b, t, h, d = 2, 32, 4, 8
+        q = jax.random.normal(key, (b, t, h, d))
+        out = attn.flash_attention(q, q, q, causal=True)
+        ref = attn.dot_product_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_pallas_kernel_on_cpu_interpreter(self):
+        """The Pallas kernel itself (interpret mode unavailable here;
+        exercised via TPU bench) — verify the vjp wrapper's math by
+        running the custom backward against autodiff of the
+        reference."""
+        key = jax.random.PRNGKey(5)
+        bh, t, d = 4, 64, 16
+        q = jax.random.normal(key, (bh, t, d))
+        k = jax.random.normal(jax.random.PRNGKey(6), (bh, t, d))
+        v = jax.random.normal(jax.random.PRNGKey(7), (bh, t, d))
+        do = jax.random.normal(jax.random.PRNGKey(8), (bh, t, d))
+        scale = d ** -0.5
+
+        def ref_fn(q, k, v):
+            # reference attention on [BH, T, D] (single head folded)
+            out = attn.dot_product_attention(
+                q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
+                causal=True, scale=scale)
+            return out[:, :, 0, :]
+
+        out_ref, vjp_ref = jax.vjp(ref_fn, q, k, v)
+        dq_ref, dk_ref, dv_ref = vjp_ref(do)
+
+        # Use the custom bwd rule directly with reference lse.
+        logits = jnp.einsum('btd,bsd->bts', q * scale, k)
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        logits = jnp.where(mask[None], logits, -1e30)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        dq, dk, dv = attn._flash_bwd_rule(
+            True, scale, 128, 128, (q, k, v, out_ref, lse), do)
+        np.testing.assert_allclose(dq, dq_ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(dk, dk_ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(dv, dv_ref, rtol=1e-4, atol=1e-4)
+
+
+class TestLlama:
+
+    def setup_method(self):
+        self.config = llama.get_config('tiny')
+        self.params = llama.init_params(self.config,
+                                        jax.random.PRNGKey(0))
+
+    def test_forward_shapes(self):
+        tokens = jnp.ones((2, 16), jnp.int32)
+        logits = llama.forward(self.params, tokens, self.config)
+        assert logits.shape == (2, 16, self.config.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality_end_to_end(self):
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                    self.config.vocab_size)
+        logits1 = llama.forward(self.params, tokens, self.config)
+        tokens2 = tokens.at[0, -1].set(
+            (tokens[0, -1] + 1) % self.config.vocab_size)
+        logits2 = llama.forward(self.params, tokens2, self.config)
+        np.testing.assert_allclose(logits1[0, :-1], logits2[0, :-1],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_loss_decreases_with_sgd(self):
+        """Few steps of full-param training on a repeated batch."""
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0,
+                                    self.config.vocab_size)
+        batch = {'tokens': tokens}
+
+        @jax.jit
+        def step(params):
+            loss, grads = jax.value_and_grad(llama.loss_fn)(
+                params, batch, self.config)
+            params = jax.tree.map(lambda p, g: p - 0.5 * g, params,
+                                  grads)
+            return params, loss
+
+        params = self.params
+        losses = []
+        for _ in range(5):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_loss_mask(self):
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                    self.config.vocab_size)
+        full = llama.loss_fn(self.params, {'tokens': tokens},
+                             self.config)
+        masked = llama.loss_fn(
+            self.params,
+            {'tokens': tokens,
+             'loss_mask': jnp.ones_like(tokens)}, self.config)
+        np.testing.assert_allclose(full, masked, rtol=1e-5)
+
+    def test_param_count_8b(self):
+        cfg = llama.get_config('llama3-8b')
+        n = cfg.num_params()
+        assert 7.5e9 < n < 8.5e9, n
+
+    def test_sharding_rules_cover_params(self):
+        rules = llama.param_sharding_rules(self.config)
+        p_struct = jax.tree_util.tree_structure(self.params)
+        r_struct = jax.tree_util.tree_structure(
+            rules, is_leaf=lambda x: isinstance(
+                x, type(rules['embed'])))
+        assert p_struct == r_struct
+
+    def test_lora_zero_init_is_identity(self):
+        from skypilot_tpu.parallel import lora as lora_lib
+        tokens = jnp.ones((1, 8), jnp.int32)
+        adapters = lora_lib.init_lora(self.config,
+                                      jax.random.PRNGKey(9), rank=4)
+        base = llama.forward(self.params, tokens, self.config)
+        with_lora = llama.forward(self.params, tokens, self.config,
+                                  lora=adapters)
+        np.testing.assert_allclose(base, with_lora, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_lora_merge_matches_runtime(self):
+        from skypilot_tpu.parallel import lora as lora_lib
+        key = jax.random.PRNGKey(10)
+        adapters = lora_lib.init_lora(self.config, key, rank=4)
+        # Make B nonzero so the adapters do something.
+        adapters['wq_b'] = jax.random.normal(
+            key, adapters['wq_b'].shape) * 0.02
+        adapters['wv_b'] = jax.random.normal(
+            key, adapters['wv_b'].shape) * 0.02
+        tokens = jax.random.randint(jax.random.PRNGKey(11), (1, 8), 0,
+                                    self.config.vocab_size)
+        runtime = llama.forward(self.params, tokens, self.config,
+                                lora=adapters, lora_scale=2.0)
+        merged = lora_lib.merge_lora(self.params, adapters, scale=2.0)
+        folded = llama.forward(merged, tokens, self.config)
+        np.testing.assert_allclose(runtime, folded, rtol=1e-3,
+                                   atol=1e-3)

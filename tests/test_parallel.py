@@ -1,0 +1,115 @@
+"""Sharded training tests on the virtual 8-device CPU mesh.
+
+This is the rebuild's answer to the reference's biggest testing gap
+(SURVEY.md §4.5): distributed behavior unit-tested without hardware.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import (MeshConfig, auto_mesh_config,
+                                   build_train_step, init_train_state,
+                                   make_mesh)
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture(scope='module')
+def tiny_config():
+    return llama.get_config('tiny')
+
+
+class TestMesh:
+
+    def test_auto_mesh_defaults_to_fsdp(self):
+        cfg = auto_mesh_config(8)
+        assert cfg.fsdp == 8
+        assert cfg.num_devices == 8
+
+    def test_auto_mesh_tp(self):
+        cfg = auto_mesh_config(8, tp=4)
+        assert cfg.tp == 4 and cfg.fsdp == 2
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            auto_mesh_config(8, tp=3)
+
+    def test_make_mesh(self):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
+        assert mesh.shape == {'dp': 2, 'fsdp': 2, 'tp': 2, 'sp': 1}
+
+    def test_batch_size_per_device(self):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+        assert mesh_lib.batch_size_per_device(16, mesh) == 2
+        with pytest.raises(ValueError):
+            mesh_lib.batch_size_per_device(7, mesh)
+
+
+class TestShardedTraining:
+
+    def _run_steps(self, mesh_config, tiny_config, n_steps=3,
+                   lora_rank=None):
+        mesh = make_mesh(mesh_config)
+        state, shardings = init_train_state(
+            tiny_config, mesh, jax.random.PRNGKey(0),
+            lora_rank=lora_rank)
+        step = build_train_step(tiny_config, mesh, shardings)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                    tiny_config.vocab_size)
+        losses = []
+        for _ in range(n_steps):
+            state, metrics = step(state, {'tokens': tokens})
+            losses.append(float(metrics['loss']))
+        return state, losses
+
+    def test_fsdp8_loss_decreases(self, tiny_config):
+        _, losses = self._run_steps(MeshConfig(fsdp=8), tiny_config)
+        assert losses[-1] < losses[0], losses
+
+    def test_fsdp_params_actually_sharded(self, tiny_config):
+        mesh = make_mesh(MeshConfig(fsdp=8))
+        state, _ = init_train_state(tiny_config, mesh,
+                                    jax.random.PRNGKey(0))
+        # lm_head [d, vocab] shards d over fsdp.
+        shard_shape = state.params['lm_head'].sharding.shard_shape(
+            state.params['lm_head'].shape)
+        assert shard_shape[0] == tiny_config.dim // 8
+
+    def test_tp_fsdp_matches_pure_fsdp(self, tiny_config):
+        """Same seed, different mesh layouts → same loss trajectory
+        (SPMD correctness of the sharding rules)."""
+        _, fsdp_losses = self._run_steps(MeshConfig(fsdp=8),
+                                         tiny_config)
+        _, mixed_losses = self._run_steps(
+            MeshConfig(dp=2, fsdp=2, tp=2), tiny_config)
+        np.testing.assert_allclose(fsdp_losses, mixed_losses,
+                                   rtol=2e-3)
+
+    def test_lora_only_trains_adapters(self, tiny_config):
+        mesh = make_mesh(MeshConfig(fsdp=8))
+        state, shardings = init_train_state(
+            tiny_config, mesh, jax.random.PRNGKey(0), lora_rank=4)
+        step = build_train_step(tiny_config, mesh, shardings)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                    tiny_config.vocab_size)
+        # Copy to host BEFORE the step: donate_argnums invalidates the
+        # input state's buffers.
+        params_before = jax.tree.map(np.asarray, state.params)
+        lora_before = jax.tree.map(np.asarray, state.lora)
+        state2, metrics = step(state, {'tokens': tokens})
+        assert np.isfinite(metrics['loss'])
+        # Base params unchanged, adapters changed.
+        params_after = jax.tree.map(np.asarray, state2.params)
+        for b, a in zip(jax.tree.leaves(params_before),
+                        jax.tree.leaves(params_after)):
+            np.testing.assert_array_equal(b, a)
+        assert any(
+            not np.array_equal(b, np.asarray(a))
+            for b, a in zip(jax.tree.leaves(lora_before),
+                            jax.tree.leaves(state2.lora)))
+
+    def test_lora_loss_decreases(self, tiny_config):
+        _, losses = self._run_steps(MeshConfig(fsdp=8), tiny_config,
+                                    n_steps=4, lora_rank=4)
+        assert losses[-1] < losses[0], losses
