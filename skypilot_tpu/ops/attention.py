@@ -80,10 +80,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = pl.load(k_ref, (pl.ds(kb * block_k, block_k),
-                                slice(None))).astype(jnp.float32)
-        v_blk = pl.load(v_ref, (pl.ds(kb * block_k, block_k),
-                                slice(None))).astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(
+            jnp.float32)
         s = jnp.dot(q, k_blk.T,
                     preferred_element_type=jnp.float32)  # [Bq, Bk]
         if causal:
@@ -113,7 +113,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    # lse block is (8, block_q): broadcast over the 8 padding sublanes
+    # (f32 min tile is (8, 128); a squeezed/1-sublane block is
+    # rejected by Mosaic).
+    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[...] = jnp.broadcast_to(lse[None, :], lse_ref.shape)
 
 
 def _flash_fwd_pallas(q, k, v, *, scale, causal, block_q, block_k):
@@ -130,6 +134,8 @@ def _flash_fwd_pallas(q, k, v, *, scale, causal, block_q, block_k):
 
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, block_k=block_k, seq_k=s)
+    # lse is stored [BH, 8, T]: 8 identical sublanes so the block
+    # (8, block_q) meets the f32 (8, 128) min-tile constraint.
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -140,14 +146,14 @@ def _flash_fwd_pallas(q, k, v, *, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, 8, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 8, t), jnp.float32),
         ],
     )(q, k, v)
-    return out, lse
+    return out, lse[:, 0, :]
 
 
 # ---------------------------------------------------------------------
@@ -168,11 +174,9 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, residuals, do):
-    """Standard flash-attention backward recompute, expressed in XLA
-    (fused fine by Mosaic/XLA; a hand-written bwd kernel is a later
-    optimization)."""
-    q, k, v, out, lse = residuals
+def _flash_bwd_chunk(causal, scale, q, k, v, out, lse, do):
+    """Backward recompute for one BH-chunk. Materializes [bh, T, S]
+    probabilities for the chunk only."""
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
@@ -184,14 +188,48 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, residuals, do):
         t_, s_ = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((t_, s_), dtype=bool), k=s_ - t_)
         s = jnp.where(mask[None], s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])  # [BH, T, S]
+    p = jnp.exp(s - lse[..., None])  # [bh, T, S]
     dv = jnp.einsum('bts,btd->bsd', p, dof)
     dp = jnp.einsum('btd,bsd->bts', dof, vf)
-    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [BH,T,1]
+    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [bh,T,1]
     ds = p * (dp - delta)
     dq = jnp.einsum('bts,bsd->btd', ds, kf) * scale
     dk = jnp.einsum('bts,btd->bsd', ds, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# Cap the fp32 [chunk, T, S] recompute temp at ~1 GB.
+_BWD_TEMP_BYTES = 1 << 30
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, residuals, do):
+    """Flash-attention backward: recompute probabilities from (q, k,
+    v, lse), scanned over chunks of the batch*heads dim so the O(T^2)
+    temp never exceeds ~1 GB (full materialization OOMed a v5e-1 at
+    batch 16 x 32 heads x 2048^2). A blockwise Pallas bwd kernel is
+    the planned upgrade for long-context."""
+    del block_q, block_k
+    q, k, v, out, lse = residuals
+    bh, t, _ = q.shape
+    s_len = k.shape[1]
+    per_row = t * s_len * 4
+    chunk = max(1, min(bh, _BWD_TEMP_BYTES // per_row))
+    while bh % chunk != 0:
+        chunk -= 1
+    if chunk == bh:
+        return _flash_bwd_chunk(causal, scale, q, k, v, out, lse, do)
+
+    def body(args):
+        qc, kc, vc, oc, lc, dc = args
+        return _flash_bwd_chunk(causal, scale, qc, kc, vc, oc, lc, dc)
+
+    n = bh // chunk
+    reshape = lambda x: x.reshape((n, chunk) + x.shape[1:])
+    dq, dk, dv = jax.lax.map(
+        body, (reshape(q), reshape(k), reshape(v), reshape(out),
+               reshape(lse), reshape(do)))
+    unshape = lambda x: x.reshape((bh,) + x.shape[2:])
+    return unshape(dq), unshape(dk), unshape(dv)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
