@@ -1,0 +1,162 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+Long-context is absent from the reference (SURVEY.md §5: no ring
+attention / context parallel anywhere in its tree) — this is new,
+TPU-native scope: the sequence dimension is sharded over the ``sp``
+mesh axis; K/V blocks rotate around the ring via ``lax.ppermute``
+(neighbor exchanges ride ICI), each step combining a local blockwise
+attention with the running online-softmax accumulator. HBM per device
+stays O(T/n), enabling sequence lengths that cannot fit one chip.
+
+All math is differentiable (plain XLA inside ``shard_map``), so
+``jax.grad`` works through the ring — gradients flow via the
+transposed ppermute collectives automatically.
+
+Usage inside shard_map (see ``ring_attention_sharded`` for the
+wrapper):
+
+    out = ring_attention(q, k, v, axis_name='sp')
+
+q, k, v: [B, T_local, H, D] per device; causal over GLOBAL positions.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, scale, mode, q_offset, k_offset):
+    """Unnormalized blockwise attention + running-softmax stats.
+
+    mode: 0 = causal (diagonal block), 1 = full (kv strictly before
+    q), 2 = skip (kv strictly after q — masked out entirely).
+    Returns (numerator [B,T,H,D] fp32, m [B,H,T], l [B,H,T]).
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    hkv = k.shape[2]
+    groups = h // hkv
+    qg = q.reshape(b, t, hkv, groups, d).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum('bthgd,bshd->bhgts', qg, kf) * scale
+
+    q_pos = q_offset + jnp.arange(t)
+    k_pos = k_offset + jnp.arange(s)
+    if mode == 'causal':
+        mask = q_pos[:, None] >= k_pos[None, :]
+    elif mode == 'full':
+        mask = jnp.ones((t, s), bool)
+    else:  # skip
+        mask = jnp.zeros((t, s), bool)
+    logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
+
+    m = logits.max(axis=-1)  # [B,hkv,G,T]
+    p = jnp.exp(logits - m[..., None])
+    l = p.sum(axis=-1)
+    num = jnp.einsum('bhgts,bshd->bthgd', p, v.astype(jnp.float32))
+    return num.reshape(b, t, h, d), m.reshape(b, h, t), \
+        l.reshape(b, h, t)
+
+
+def _combine(acc, num, m_new, l_new):
+    """Online-softmax merge of a new block into the accumulator.
+
+    m/l are [B,H,T]; numerators are [B,T,H,D]."""
+    num_acc, m_acc, l_acc = acc
+    m = jnp.maximum(m_acc, m_new)
+    a_old = jnp.exp(m_acc - m)
+    a_new = jnp.exp(m_new - m)
+    scale_old = a_old.transpose(0, 2, 1)[..., None]  # [B,T,H,1]
+    scale_new = a_new.transpose(0, 2, 1)[..., None]
+    return (num_acc * scale_old + num * scale_new,
+            m,
+            l_acc * a_old + l_new * a_new)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = 'sp',
+                   scale: Optional[float] = None) -> jax.Array:
+    """Causal ring attention; call inside shard_map with the sequence
+    dim sharded over ``axis_name``."""
+    d = q.shape[-1]
+    t_local = q.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    # Derive accumulators from q (not fresh zeros) so they carry q's
+    # varying-axis type under shard_map — a plain jnp.zeros is
+    # 'invariant' and the fori_loop carry would type-mismatch.
+    num0 = jnp.zeros_like(q, jnp.float32)
+    zero_bht = q.astype(jnp.float32).sum(axis=-1).transpose(0, 2, 1) * 0.0
+    m0 = zero_bht + _NEG_INF
+    l0 = zero_bht
+
+    def ring_step(step, carry):
+        kv, acc = carry
+        k_cur, v_cur = kv
+        # The block currently held came from shard (my_idx - step).
+        src = (my_idx - step) % n
+        q_off = my_idx * t_local
+        k_off = src * t_local
+
+        # Diagonal block: causal mask. Earlier shards: full. Later:
+        # skipped (their contribution is exactly zero for causal
+        # attention). All three are computed via masks so the step
+        # stays a single traced program (no data-dependent control
+        # flow under jit).
+        num_c, m_c, l_c = _block_attention(q, k_cur, v_cur, scale,
+                                           'causal', q_off, k_off)
+        is_diag = src == my_idx
+        is_before = src < my_idx
+        num_f, m_f, l_f = _block_attention(q, k_cur, v_cur, scale,
+                                           'full', q_off, k_off)
+        num_s = jnp.zeros_like(num_c)
+        m_s = jnp.full_like(m_c, _NEG_INF)
+        l_s = jnp.zeros_like(l_c)
+
+        num = jnp.where(is_diag, num_c,
+                        jnp.where(is_before, num_f, num_s))
+        m = jnp.where(is_diag, m_c, jnp.where(is_before, m_f, m_s))
+        l = jnp.where(is_diag, l_c, jnp.where(is_before, l_f, l_s))
+        acc = _combine(acc, num, m, l)
+
+        # Rotate K/V to the next device (neighbor exchange on ICI).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return ((k_nxt, v_nxt), acc)
+
+    (_, (num, m, l)) = jax.lax.fori_loop(
+        0, n, ring_step, ((k, v), (num0, m0, l0)))
+    del m
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = num / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
+                           v: jax.Array,
+                           axis_name: str = 'sp') -> jax.Array:
+    """Convenience wrapper: shard q/k/v over (batch=(dp,fsdp),
+    seq=sp, heads=tp) and run ring attention under shard_map."""
+    from jax import shard_map
+
+    spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    q = jax.device_put(q, NamedSharding(mesh, spec))
+    k = jax.device_put(k, NamedSharding(mesh, spec))
+    v = jax.device_put(v, NamedSharding(mesh, spec))
+    return fn(q, k, v)
