@@ -1,0 +1,11 @@
+"""On-cluster runtime: host agent, job queue, logs, autostop.
+
+Replaces the reference's Ray + skylet stack (SURVEY.md §2.5): instead
+of a Ray GCS/raylet cluster with placement groups, every TPU host runs
+a lightweight host agent (C++ with a Python fallback,
+``runtime/cpp/``), and the head node runs a sqlite job queue + FIFO
+scheduler + gang launcher that starts one process per host with the
+rank/coordinator env contract and kills all ranks if any fails
+(semantics of the reference's ``RayCodeGen.get_or_fail``,
+``sky/backends/cloud_vm_ray_backend.py:314-350``).
+"""

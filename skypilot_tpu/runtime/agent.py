@@ -1,0 +1,179 @@
+"""Per-host agent: Python implementation of the host-agent protocol.
+
+The runtime's replacement for a Ray raylet (SURVEY.md §2.10): every
+host of the slice runs one agent; the head-node driver talks to all
+agents over HTTP to gang-start the task, poll liveness, kill, and
+fetch logs. A native C++ implementation of the same protocol lives in
+``runtime/cpp/host_agent.cc`` (preferred when built — see
+``agent_client.resolve_agent_binary``); this Python one is the
+portable fallback and the executable spec of the protocol.
+
+Protocol (JSON over HTTP):
+    GET  /health                  -> {ok, version, agent}
+    POST /run   {cmd, log_path, env?, cwd?}    -> {proc_id}
+    GET  /status?proc_id=N        -> {running, returncode}
+    POST /kill  {proc_id}         -> {ok}
+    POST /exec  {cmd, timeout?}   -> {returncode, output}   (blocking)
+    GET  /read?path=P&offset=N    -> raw bytes
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+AGENT_VERSION = '1'
+DEFAULT_PORT = 8790
+
+
+class _ProcTable:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._next = 1
+
+    def start(self, cmd: str, log_path: str, env: Dict[str, str],
+              cwd: str) -> int:
+        log_path = os.path.expanduser(log_path)
+        os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        logf = open(log_path, 'ab')
+        cwd = os.path.expanduser(cwd) if cwd else None
+        if cwd and not os.path.isdir(cwd):
+            cwd = None
+        proc = subprocess.Popen(
+            ['/bin/bash', '-c', cmd], stdout=logf,
+            stderr=subprocess.STDOUT, env=full_env, cwd=cwd,
+            start_new_session=True)
+        logf.close()
+        with self._lock:
+            proc_id = self._next
+            self._next += 1
+            self._procs[proc_id] = proc
+        return proc_id
+
+    def status(self, proc_id: int):
+        with self._lock:
+            proc = self._procs.get(proc_id)
+        if proc is None:
+            return {'running': False, 'returncode': None,
+                    'error': 'unknown proc_id'}
+        rc = proc.poll()
+        return {'running': rc is None, 'returncode': rc}
+
+    def kill(self, proc_id: int) -> bool:
+        with self._lock:
+            proc = self._procs.get(proc_id)
+        if proc is None:
+            return False
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return True
+
+
+_procs = _ProcTable()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self):
+        length = int(self.headers.get('Content-Length', '0'))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def do_GET(self):  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        if parsed.path == '/health':
+            self._json({'ok': True, 'version': AGENT_VERSION,
+                        'agent': 'py'})
+        elif parsed.path == '/status':
+            proc_id = int(qs.get('proc_id', ['0'])[0])
+            self._json(_procs.status(proc_id))
+        elif parsed.path == '/read':
+            path = os.path.expanduser(qs.get('path', [''])[0])
+            offset = int(qs.get('offset', ['0'])[0])
+            try:
+                with open(path, 'rb') as f:
+                    f.seek(offset)
+                    data = f.read(1 << 20)
+            except OSError:
+                data = b''
+            self.send_response(200)
+            self.send_header('Content-Type',
+                             'application/octet-stream')
+            self.send_header('Content-Length', str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        else:
+            self._json({'error': 'not found'}, 404)
+
+    def do_POST(self):  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError:
+            self._json({'error': 'bad json'}, 400)
+            return
+        if parsed.path == '/run':
+            proc_id = _procs.start(body['cmd'],
+                                   body.get('log_path', '/dev/null'),
+                                   body.get('env') or {},
+                                   body.get('cwd') or '')
+            self._json({'proc_id': proc_id})
+        elif parsed.path == '/kill':
+            ok = _procs.kill(int(body['proc_id']))
+            self._json({'ok': ok})
+        elif parsed.path == '/exec':
+            timeout = float(body.get('timeout', 600))
+            try:
+                out = subprocess.run(
+                    ['/bin/bash', '-c', body['cmd']],
+                    capture_output=True, text=True, timeout=timeout,
+                    check=False)
+                self._json({'returncode': out.returncode,
+                            'output': (out.stdout or '') +
+                                      (out.stderr or '')})
+            except subprocess.TimeoutExpired:
+                self._json({'returncode': 124, 'output': 'timeout'})
+        else:
+            self._json({'error': 'not found'}, 404)
+
+
+def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0') -> None:
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.serve_forever()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--host', default='0.0.0.0')
+    args = parser.parse_args()
+    serve(args.port, args.host)
+
+
+if __name__ == '__main__':
+    main()

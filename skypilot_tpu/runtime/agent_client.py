@@ -1,0 +1,137 @@
+"""Client for the host-agent protocol (see ``runtime/agent.py``)."""
+import json
+import os
+import subprocess
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+_CPP_AGENT_REL = 'runtime/cpp/host_agent'
+
+
+def resolve_agent_binary() -> Optional[str]:
+    """Path to the native C++ agent if built, else None (Python agent
+    is used)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(here, _CPP_AGENT_REL)
+    if os.path.exists(cand) and os.access(cand, os.X_OK):
+        return cand
+    return None
+
+
+def agent_start_command(port: int) -> str:
+    """Shell command that starts the best available agent on a host."""
+    binary = resolve_agent_binary()
+    if binary is not None:
+        return f'{binary} --port {port}'
+    return f'python -m skypilot_tpu.runtime.agent --port {port}'
+
+
+class AgentClient:
+    """Talks to one host's agent."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._base = f'http://{host}:{port}'
+
+    # -- http helpers ---------------------------------------------------
+
+    def _get(self, path: str, params: Optional[Dict[str, Any]] = None,
+             raw: bool = False, timeout: Optional[float] = None):
+        url = self._base + path
+        if params:
+            url += '?' + urllib.parse.urlencode(params)
+        with urllib.request.urlopen(
+                url, timeout=timeout or self.timeout) as resp:
+            data = resp.read()
+        return data if raw else json.loads(data)
+
+    def _post(self, path: str, body: Dict[str, Any],
+              timeout: Optional[float] = None):
+        req = urllib.request.Request(
+            self._base + path, data=json.dumps(body).encode(),
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(
+                req, timeout=timeout or self.timeout) as resp:
+            return json.loads(resp.read())
+
+    # -- API ------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        return self._get('/health')
+
+    def is_healthy(self) -> bool:
+        try:
+            return bool(self.health().get('ok'))
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def wait_healthy(self, timeout: float = 60.0,
+                     interval: float = 0.25) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.is_healthy():
+                return
+            time.sleep(interval)
+        raise exceptions.FetchClusterInfoError(
+            f'agent {self.host}:{self.port} not healthy after '
+            f'{timeout}s')
+
+    def run(self, cmd: str, log_path: str,
+            env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None) -> int:
+        out = self._post('/run', {'cmd': cmd, 'log_path': log_path,
+                                  'env': env or {}, 'cwd': cwd or ''})
+        return int(out['proc_id'])
+
+    def status(self, proc_id: int) -> Dict[str, Any]:
+        return self._get('/status', {'proc_id': proc_id})
+
+    def kill(self, proc_id: int) -> bool:
+        try:
+            return bool(self._post('/kill',
+                                   {'proc_id': proc_id}).get('ok'))
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def exec(self, cmd: str, timeout: float = 600.0) -> Dict[str, Any]:
+        """Blocking remote command (setup steps)."""
+        return self._post('/exec', {'cmd': cmd, 'timeout': timeout},
+                          timeout=timeout + 10)
+
+    def read_file(self, path: str, offset: int = 0) -> bytes:
+        return self._get('/read', {'path': path, 'offset': offset},
+                         raw=True)
+
+
+def start_local_agent(port: int,
+                      runtime_dir: Optional[str] = None,
+                      use_cpp: Optional[bool] = None
+                      ) -> subprocess.Popen:
+    """Start an agent process on THIS machine (used by the local/fake
+    provisioner and by instance_setup over SSH on real hosts)."""
+    env = dict(os.environ)
+    if runtime_dir:
+        env['SKYTPU_RUNTIME_DIR'] = runtime_dir
+    binary = resolve_agent_binary() if use_cpp in (None, True) else None
+    if use_cpp is True and binary is None:
+        raise FileNotFoundError(
+            'C++ host agent not built; run make -C '
+            'skypilot_tpu/runtime/cpp')
+    if binary is not None:
+        cmd: List[str] = [binary, '--port', str(port)]
+    else:
+        cmd = ['python', '-m', 'skypilot_tpu.runtime.agent', '--port',
+               str(port)]
+    return subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)

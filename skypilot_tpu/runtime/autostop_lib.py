@@ -1,0 +1,64 @@
+"""Autostop configuration on the head node (analog of
+``sky/skylet/autostop_lib.py`` + ``configs.py``).
+
+Config is a JSON file in the runtime dir, written over the agent's
+/exec channel by the client (`x autostop`). The skylet event loop
+checks idleness via the job queue and, when triggered, runs the
+stored stop command — on GCP that command tears the slice down via
+the provisioner from the head node itself (the reference does exactly
+this: ``sky/skylet/events.py:141,235``).
+"""
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.runtime import job_lib
+
+_CONFIG_NAME = 'autostop.json'
+
+
+def _config_path() -> str:
+    return os.path.join(job_lib.runtime_dir(), _CONFIG_NAME)
+
+
+def set_autostop(idle_minutes: int, down: bool,
+                 stop_command: str) -> None:
+    """idle_minutes < 0 disables autostop."""
+    cfg = {
+        'idle_minutes': idle_minutes,
+        'down': down,
+        'stop_command': stop_command,
+        'set_at': time.time(),
+    }
+    os.makedirs(job_lib.runtime_dir(), exist_ok=True)
+    with open(_config_path(), 'w', encoding='utf-8') as f:
+        json.dump(cfg, f)
+
+
+def get_autostop() -> Optional[Dict[str, Any]]:
+    path = _config_path()
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def clear_autostop() -> None:
+    try:
+        os.remove(_config_path())
+    except FileNotFoundError:
+        pass
+
+
+def should_trigger() -> Optional[Dict[str, Any]]:
+    cfg = get_autostop()
+    if cfg is None or cfg['idle_minutes'] < 0:
+        return None
+    # Idleness also counts time since autostop was (re)set, so a
+    # fresh `autostop -i 5` doesn't fire instantly on an old queue.
+    if time.time() - cfg['set_at'] < cfg['idle_minutes'] * 60:
+        return None
+    if not job_lib.is_cluster_idle(cfg['idle_minutes']):
+        return None
+    return cfg

@@ -1,0 +1,570 @@
+// host_agent — native per-host agent for the skypilot_tpu runtime.
+//
+// Implements the host-agent protocol (see runtime/agent.py, the
+// executable spec): an HTTP/JSON server that starts/tracks/kills task
+// processes, executes blocking setup commands, and serves log-file
+// reads. This is the TPU-native replacement for the raylet role in
+// the reference's Ray-based runtime (SURVEY.md §2.10): one agent per
+// TPU host, driven by the head-node gang driver.
+//
+// Build: make -C skypilot_tpu/runtime/cpp
+// Run:   host_agent --port 8790 [--host 0.0.0.0]
+//
+// No external dependencies: POSIX sockets + a minimal JSON
+// parser/writer tailored to the protocol's flat messages.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr const char* kVersion = "1";
+
+// ---------------------------------------------------------------------
+// Minimal JSON: value = object | string | number | bool | null.
+// Supports exactly what the protocol uses (flat objects, one level of
+// nesting for "env").
+// ---------------------------------------------------------------------
+
+struct JsonValue {
+  enum Type { kNull, kBool, kNumber, kString, kObject } type = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::map<std::string, JsonValue> obj;
+};
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+
+  bool Parse(JsonValue* out) { return Value(out) && (Skip(), p_ == s_.size()); }
+
+ private:
+  void Skip() {
+    while (p_ < s_.size() && std::isspace(static_cast<unsigned char>(s_[p_]))) p_++;
+  }
+
+  bool Value(JsonValue* out) {
+    Skip();
+    if (p_ >= s_.size()) return false;
+    char c = s_[p_];
+    if (c == '{') return Object(out);
+    if (c == '"') {
+      out->type = JsonValue::kString;
+      return String(&out->str);
+    }
+    if (c == 't' || c == 'f') return Bool(out);
+    if (c == 'n') {
+      if (s_.compare(p_, 4, "null") == 0) { p_ += 4; out->type = JsonValue::kNull; return true; }
+      return false;
+    }
+    return Number(out);
+  }
+
+  bool Object(JsonValue* out) {
+    out->type = JsonValue::kObject;
+    p_++;  // '{'
+    Skip();
+    if (p_ < s_.size() && s_[p_] == '}') { p_++; return true; }
+    while (true) {
+      Skip();
+      std::string key;
+      if (!String(&key)) return false;
+      Skip();
+      if (p_ >= s_.size() || s_[p_] != ':') return false;
+      p_++;
+      JsonValue v;
+      if (!Value(&v)) return false;
+      out->obj[key] = std::move(v);
+      Skip();
+      if (p_ < s_.size() && s_[p_] == ',') { p_++; continue; }
+      if (p_ < s_.size() && s_[p_] == '}') { p_++; return true; }
+      return false;
+    }
+  }
+
+  bool String(std::string* out) {
+    if (p_ >= s_.size() || s_[p_] != '"') return false;
+    p_++;
+    out->clear();
+    while (p_ < s_.size()) {
+      char c = s_[p_++];
+      if (c == '"') return true;
+      if (c == '\\' && p_ < s_.size()) {
+        char e = s_[p_++];
+        switch (e) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case '/': out->push_back('/'); break;
+          case '\\': out->push_back('\\'); break;
+          case '"': out->push_back('"'); break;
+          case 'u': {  // \uXXXX — handle BMP only (protocol is ASCII-safe)
+            if (p_ + 4 > s_.size()) return false;
+            unsigned code = std::strtoul(s_.substr(p_, 4).c_str(), nullptr, 16);
+            p_ += 4;
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        out->push_back(c);
+      }
+    }
+    return false;
+  }
+
+  bool Bool(JsonValue* out) {
+    out->type = JsonValue::kBool;
+    if (s_.compare(p_, 4, "true") == 0) { p_ += 4; out->b = true; return true; }
+    if (s_.compare(p_, 5, "false") == 0) { p_ += 5; out->b = false; return true; }
+    return false;
+  }
+
+  bool Number(JsonValue* out) {
+    size_t start = p_;
+    while (p_ < s_.size() && (std::isdigit(static_cast<unsigned char>(s_[p_])) ||
+                              strchr("+-.eE", s_[p_]))) p_++;
+    if (start == p_) return false;
+    out->type = JsonValue::kNumber;
+    out->num = std::strtod(s_.substr(start, p_ - start).c_str(), nullptr);
+    return true;
+  }
+
+  const std::string& s_;
+  size_t p_ = 0;
+};
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(c);
+        }
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------
+// Process table.
+// ---------------------------------------------------------------------
+
+struct ProcEntry {
+  pid_t pid = -1;
+  bool exited = false;
+  int returncode = -1;
+};
+
+class ProcTable {
+ public:
+  int Start(const std::string& cmd, const std::string& log_path,
+            const std::map<std::string, JsonValue>& env, const std::string& cwd) {
+    pid_t pid = fork();
+    if (pid < 0) return -1;
+    if (pid == 0) {
+      // Child: own session (so the whole group can be killed), logs
+      // appended to log_path.
+      setsid();
+      std::string expanded = Expand(log_path);
+      MkdirsFor(expanded);
+      int fd = open(expanded.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+      if (fd >= 0) {
+        dup2(fd, STDOUT_FILENO);
+        dup2(fd, STDERR_FILENO);
+        close(fd);
+      }
+      for (const auto& kv : env) {
+        if (kv.second.type == JsonValue::kString) {
+          setenv(kv.first.c_str(), kv.second.str.c_str(), 1);
+        } else if (kv.second.type == JsonValue::kNumber) {
+          char buf[32];
+          std::snprintf(buf, sizeof(buf), "%g", kv.second.num);
+          setenv(kv.first.c_str(), buf, 1);
+        }
+      }
+      if (!cwd.empty()) {
+        std::string c = Expand(cwd);
+        if (chdir(c.c_str()) != 0) { /* fall through to home */ }
+      }
+      execl("/bin/bash", "bash", "-c", cmd.c_str(), nullptr);
+      _exit(127);
+    }
+    std::lock_guard<std::mutex> lock(mu_);
+    int id = next_id_++;
+    procs_[id] = ProcEntry{pid, false, -1};
+    return id;
+  }
+
+  // running, returncode (valid when !running), known
+  void Status(int id, bool* known, bool* running, int* returncode) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = procs_.find(id);
+    if (it == procs_.end()) { *known = false; return; }
+    *known = true;
+    Reap(&it->second);
+    *running = !it->second.exited;
+    *returncode = it->second.returncode;
+  }
+
+  bool Kill(int id) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = procs_.find(id);
+    if (it == procs_.end()) return false;
+    Reap(&it->second);
+    if (!it->second.exited) kill(-it->second.pid, SIGTERM);
+    return true;
+  }
+
+  static std::string Expand(const std::string& path) {
+    if (!path.empty() && path[0] == '~') {
+      const char* home = getenv("HOME");
+      if (home != nullptr) return std::string(home) + path.substr(1);
+    }
+    return path;
+  }
+
+  static void MkdirsFor(const std::string& file_path) {
+    std::string dir = file_path.substr(0, file_path.find_last_of('/'));
+    std::string cur;
+    size_t pos = 0;
+    while (pos != std::string::npos && !dir.empty()) {
+      size_t next = dir.find('/', pos + 1);
+      cur = dir.substr(0, next == std::string::npos ? dir.size() : next);
+      if (!cur.empty()) mkdir(cur.c_str(), 0755);
+      pos = next;
+    }
+  }
+
+ private:
+  void Reap(ProcEntry* e) {
+    if (e->exited) return;
+    int status = 0;
+    pid_t r = waitpid(e->pid, &status, WNOHANG);
+    if (r == e->pid) {
+      e->exited = true;
+      e->returncode = WIFEXITED(status) ? WEXITSTATUS(status)
+                                        : 128 + WTERMSIG(status);
+    }
+  }
+
+  std::mutex mu_;
+  std::map<int, ProcEntry> procs_;
+  int next_id_ = 1;
+};
+
+ProcTable g_procs;
+
+// Blocking exec with timeout; captures combined output.
+int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output) {
+  int pipefd[2];
+  if (pipe(pipefd) != 0) return -1;
+  pid_t pid = fork();
+  if (pid < 0) { close(pipefd[0]); close(pipefd[1]); return -1; }
+  if (pid == 0) {
+    setsid();
+    close(pipefd[0]);
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[1]);
+    execl("/bin/bash", "bash", "-c", cmd.c_str(), nullptr);
+    _exit(127);
+  }
+  close(pipefd[1]);
+  fcntl(pipefd[0], F_SETFL, O_NONBLOCK);
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_s);
+  char buf[4096];
+  int status = 0;
+  bool done = false;
+  while (!done) {
+    ssize_t n;
+    while ((n = read(pipefd[0], buf, sizeof(buf))) > 0) output->append(buf, n);
+    pid_t r = waitpid(pid, &status, WNOHANG);
+    if (r == pid) { done = true; break; }
+    if (std::chrono::steady_clock::now() > deadline) {
+      kill(-pid, SIGKILL);
+      waitpid(pid, &status, 0);
+      close(pipefd[0]);
+      *output += "\n[host_agent] exec timeout\n";
+      return 124;
+    }
+    usleep(20000);
+  }
+  // Drain remaining output.
+  ssize_t n;
+  while ((n = read(pipefd[0], buf, sizeof(buf))) > 0) output->append(buf, n);
+  close(pipefd[0]);
+  return WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+}
+
+// ---------------------------------------------------------------------
+// HTTP plumbing.
+// ---------------------------------------------------------------------
+
+struct Request {
+  std::string method;
+  std::string path;        // path only
+  std::map<std::string, std::string> query;
+  std::string body;
+};
+
+std::string UrlDecode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); ++i) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out.push_back(static_cast<char>(
+          std::strtoul(s.substr(i + 1, 2).c_str(), nullptr, 16)));
+      i += 2;
+    } else if (s[i] == '+') {
+      out.push_back(' ');
+    } else {
+      out.push_back(s[i]);
+    }
+  }
+  return out;
+}
+
+bool ReadRequest(int fd, Request* req) {
+  std::string data;
+  char buf[4096];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) return false;
+    data.append(buf, n);
+    header_end = data.find("\r\n\r\n");
+    if (data.size() > (16u << 20)) return false;
+  }
+  // Request line.
+  size_t line_end = data.find("\r\n");
+  std::string line = data.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 <= sp1) return false;
+  req->method = line.substr(0, sp1);
+  std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  size_t qpos = target.find('?');
+  req->path = target.substr(0, qpos);
+  if (qpos != std::string::npos) {
+    std::string qs = target.substr(qpos + 1);
+    size_t pos = 0;
+    while (pos < qs.size()) {
+      size_t amp = qs.find('&', pos);
+      std::string pair = qs.substr(pos, amp == std::string::npos ? std::string::npos
+                                                                 : amp - pos);
+      size_t eq = pair.find('=');
+      if (eq != std::string::npos) {
+        req->query[UrlDecode(pair.substr(0, eq))] = UrlDecode(pair.substr(eq + 1));
+      }
+      if (amp == std::string::npos) break;
+      pos = amp + 1;
+    }
+  }
+  // Content-Length.
+  size_t content_length = 0;
+  size_t pos = line_end + 2;
+  while (pos < header_end) {
+    size_t eol = data.find("\r\n", pos);
+    std::string h = data.substr(pos, eol - pos);
+    size_t colon = h.find(':');
+    if (colon != std::string::npos) {
+      std::string name = h.substr(0, colon);
+      for (auto& c : name) c = std::tolower(static_cast<unsigned char>(c));
+      if (name == "content-length") {
+        content_length = std::strtoul(h.substr(colon + 1).c_str(), nullptr, 10);
+      }
+    }
+    pos = eol + 2;
+  }
+  req->body = data.substr(header_end + 4);
+  while (req->body.size() < content_length) {
+    ssize_t n = recv(fd, buf, sizeof(buf), 0);
+    if (n <= 0) return false;
+    req->body.append(buf, n);
+  }
+  return true;
+}
+
+void SendResponse(int fd, int code, const std::string& content_type,
+                  const std::string& body) {
+  const char* reason = code == 200 ? "OK" : (code == 404 ? "Not Found" : "Error");
+  char header[256];
+  int hlen = std::snprintf(header, sizeof(header),
+                           "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                           "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                           code, reason, content_type.c_str(), body.size());
+  send(fd, header, hlen, MSG_NOSIGNAL);
+  size_t off = 0;
+  while (off < body.size()) {
+    ssize_t n = send(fd, body.data() + off, body.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) break;
+    off += n;
+  }
+}
+
+void SendJson(int fd, const std::string& json, int code = 200) {
+  SendResponse(fd, code, "application/json", json);
+}
+
+// ---------------------------------------------------------------------
+// Routes.
+// ---------------------------------------------------------------------
+
+void HandleConnection(int fd) {
+  Request req;
+  if (!ReadRequest(fd, &req)) { close(fd); return; }
+
+  if (req.method == "GET" && req.path == "/health") {
+    SendJson(fd, std::string("{\"ok\": true, \"version\": \"") + kVersion +
+                     "\", \"agent\": \"cpp\"}");
+  } else if (req.method == "GET" && req.path == "/status") {
+    int id = std::atoi(req.query["proc_id"].c_str());
+    bool known = false, running = false;
+    int rc = -1;
+    g_procs.Status(id, &known, &running, &rc);
+    if (!known) {
+      SendJson(fd, "{\"running\": false, \"returncode\": null, "
+                   "\"error\": \"unknown proc_id\"}");
+    } else if (running) {
+      SendJson(fd, "{\"running\": true, \"returncode\": null}");
+    } else {
+      char buf[64];
+      std::snprintf(buf, sizeof(buf),
+                    "{\"running\": false, \"returncode\": %d}", rc);
+      SendJson(fd, buf);
+    }
+  } else if (req.method == "GET" && req.path == "/read") {
+    std::string path = ProcTable::Expand(req.query["path"]);
+    long offset = std::atol(req.query["offset"].c_str());
+    std::string data;
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f != nullptr) {
+      fseek(f, offset, SEEK_SET);
+      data.resize(1 << 20);
+      size_t n = fread(&data[0], 1, data.size(), f);
+      data.resize(n);
+      fclose(f);
+    }
+    SendResponse(fd, 200, "application/octet-stream", data);
+  } else if (req.method == "POST") {
+    JsonValue body;
+    JsonParser parser(req.body);
+    if (!parser.Parse(&body) || body.type != JsonValue::kObject) {
+      SendJson(fd, "{\"error\": \"bad json\"}", 400);
+      close(fd);
+      return;
+    }
+    if (req.path == "/run") {
+      std::map<std::string, JsonValue> env;
+      auto it = body.obj.find("env");
+      if (it != body.obj.end() && it->second.type == JsonValue::kObject) {
+        env = it->second.obj;
+      }
+      int id = g_procs.Start(body.obj["cmd"].str, body.obj["log_path"].str, env,
+                             body.obj["cwd"].str);
+      char buf[48];
+      std::snprintf(buf, sizeof(buf), "{\"proc_id\": %d}", id);
+      SendJson(fd, buf);
+    } else if (req.path == "/kill") {
+      bool ok = g_procs.Kill(static_cast<int>(body.obj["proc_id"].num));
+      SendJson(fd, ok ? "{\"ok\": true}" : "{\"ok\": false}");
+    } else if (req.path == "/exec") {
+      double timeout = 600;
+      auto it = body.obj.find("timeout");
+      if (it != body.obj.end() && it->second.type == JsonValue::kNumber) {
+        timeout = it->second.num;
+      }
+      std::string output;
+      int rc = ExecBlocking(body.obj["cmd"].str, timeout, &output);
+      std::string json = "{\"returncode\": " + std::to_string(rc) +
+                         ", \"output\": \"" + JsonEscape(output) + "\"}";
+      SendJson(fd, json);
+    } else {
+      SendJson(fd, "{\"error\": \"not found\"}", 404);
+    }
+  } else {
+    SendJson(fd, "{\"error\": \"not found\"}", 404);
+  }
+  close(fd);
+}
+
+}  // namespace
+
+#include <chrono>
+
+int main(int argc, char** argv) {
+  int port = 8790;
+  std::string host = "0.0.0.0";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (std::strcmp(argv[i], "--port") == 0) port = std::atoi(argv[i + 1]);
+    if (std::strcmp(argv[i], "--host") == 0) host = argv[i + 1];
+  }
+  signal(SIGPIPE, SIG_IGN);
+  // Reap orphaned /run children we never re-query.
+  // (waitpid in ProcTable handles tracked ones.)
+
+  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd < 0) { perror("socket"); return 1; }
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(listen_fd, 64) != 0) { perror("listen"); return 1; }
+  std::fprintf(stderr, "host_agent (cpp) listening on %s:%d\n", host.c_str(),
+               port);
+  while (true) {
+    int fd = accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(HandleConnection, fd).detach();
+  }
+}

@@ -1,0 +1,180 @@
+"""Per-job driver: gang-start the task on every host, enforce
+all-or-nothing.
+
+Replaces the reference's generated Ray driver program
+(``RayCodeGen``, ``sky/backends/cloud_vm_ray_backend.py:221-668``):
+instead of a STRICT_SPREAD placement group + ray tasks, the driver
+POSTs /run to every host agent with the rank env contract, polls
+statuses, and kills all ranks as soon as any rank fails (the
+``get_or_fail`` semantics at ``:314-350``). One process per TPU host
+(``num_ips_per_node`` fan-out, ``:5062-5076``).
+
+Job spec JSON (written by the backend at submit):
+    run_timestamp, task_name, num_nodes, hosts: [{ip, agent_port}],
+    setup_cmd?, run_cmd, envs, num_chips_per_node, workdir, log_dir
+"""
+import argparse
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.runtime import env_contract, job_lib
+from skypilot_tpu.runtime.agent_client import AgentClient
+
+logger = tpu_logging.init_logger(__name__)
+
+POLL_INTERVAL = 0.5
+LOG_FETCH_INTERVAL = 1.0
+
+
+def _load_spec(job_id: int) -> Dict[str, Any]:
+    rec = job_lib.get_job(job_id)
+    assert rec is not None, f'job {job_id} not in DB'
+    spec_path = rec['spec_path']
+    assert spec_path and os.path.exists(spec_path), spec_path
+    with open(spec_path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _run_setup(clients: List[AgentClient], spec: Dict[str, Any],
+               log_dir: str) -> bool:
+    setup_cmd = spec.get('setup_cmd')
+    if not setup_cmd:
+        return True
+    logger.info('Running setup on %d host(s)', len(clients))
+
+    def one(idx_client):
+        idx, client = idx_client
+        out = client.exec(setup_cmd, timeout=3600)
+        with open(os.path.join(log_dir, f'setup-{idx}.log'), 'w',
+                  encoding='utf-8') as f:
+            f.write(out.get('output', ''))
+        return out.get('returncode', 1)
+
+    with ThreadPoolExecutor(max_workers=min(32, len(clients))) as ex:
+        rcs = list(ex.map(one, enumerate(clients)))
+    bad = [i for i, rc in enumerate(rcs) if rc != 0]
+    if bad:
+        logger.error('Setup failed on rank(s) %s', bad)
+        return False
+    return True
+
+
+def _remote_log_path(spec: Dict[str, Any], rank: int) -> str:
+    # Each host writes under ITS runtime dir; the driver pulls from
+    # workers. Worker-side path is sent absolute in the spec.
+    return os.path.join(spec['log_dir'], f'rank-{rank}.log')
+
+
+def run_job(job_id: int) -> job_lib.JobStatus:
+    spec = _load_spec(job_id)
+    hosts = spec['hosts']
+    n = len(hosts)
+    ips = [h['ip'] for h in hosts]
+    log_dir = os.path.expanduser(spec['log_dir'])
+    os.makedirs(log_dir, exist_ok=True)
+    clients = [AgentClient(h['ip'], h['agent_port']) for h in hosts]
+
+    # SETUP phase.
+    job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP)
+    if not _run_setup(clients, spec, log_dir):
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
+        return job_lib.JobStatus.FAILED_SETUP
+
+    # RUN phase: gang start.
+    job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+    task_id = (f'sky-{spec["run_timestamp"]}-'
+               f'{spec.get("task_name") or "task"}')
+    proc_ids: List[int] = []
+    for rank, client in enumerate(clients):
+        env = env_contract.build_env(
+            rank, ips,
+            num_chips_per_node=spec.get('num_chips_per_node', 0),
+            task_id=task_id)
+        env.update(spec.get('envs') or {})
+        proc_id = client.run(spec['run_cmd'],
+                             log_path=_remote_log_path(spec, rank),
+                             env=env, cwd=spec.get('workdir'))
+        proc_ids.append(proc_id)
+    logger.info('Gang-started job %d on %d host(s)', job_id, n)
+
+    # Poll until all succeed or any fails (kill-all-on-failure).
+    offsets = [0] * n
+    run_log = os.path.join(log_dir, 'run.log')
+    last_fetch = 0.0
+    final: job_lib.JobStatus
+    while True:
+        states = [c.status(p) for c, p in zip(clients, proc_ids)]
+        failed = [i for i, s in enumerate(states)
+                  if not s['running'] and s['returncode'] not in (0,)]
+        done = all(not s['running'] for s in states)
+        now = time.time()
+        if now - last_fetch >= LOG_FETCH_INTERVAL or done or failed:
+            offsets = _fetch_logs(clients, spec, offsets, run_log)
+            last_fetch = now
+        if failed:
+            logger.error('Rank(s) %s failed (returncodes %s); killing '
+                         'all ranks', failed,
+                         [states[i]['returncode'] for i in failed])
+            for c, p in zip(clients, proc_ids):
+                c.kill(p)
+            final = job_lib.JobStatus.FAILED
+            break
+        if done:
+            final = job_lib.JobStatus.SUCCEEDED
+            break
+        time.sleep(POLL_INTERVAL)
+
+    job_lib.set_status(job_id, final)
+    return final
+
+
+def _fetch_logs(clients: List[AgentClient], spec: Dict[str, Any],
+                offsets: List[int], run_log: str) -> List[int]:
+    """Incrementally pull each rank's log to the head; rank logs are
+    mirrored into per-rank files and the merged run.log (rank 0
+    unprefixed — it is 'the' job output, matching the reference's
+    driver log; other ranks prefixed)."""
+    new_offsets = list(offsets)
+    with open(run_log, 'a', encoding='utf-8') as merged:
+        for rank, client in enumerate(clients):
+            try:
+                data = client.read_file(_remote_log_path(spec, rank),
+                                        offsets[rank])
+            except OSError:
+                continue
+            if not data:
+                continue
+            new_offsets[rank] = offsets[rank] + len(data)
+            text = data.decode('utf-8', errors='replace')
+            rank_file = os.path.join(
+                os.path.expanduser(spec['log_dir']),
+                f'rank-{rank}.head.log')
+            with open(rank_file, 'a', encoding='utf-8') as f:
+                f.write(text)
+            if rank == 0:
+                merged.write(text)
+            else:
+                for line in text.splitlines(keepends=True):
+                    merged.write(f'(rank {rank}) {line}')
+    return new_offsets
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    try:
+        status = run_job(args.job_id)
+    except Exception:
+        job_lib.set_status(args.job_id,
+                           job_lib.JobStatus.FAILED_DRIVER)
+        raise
+    raise SystemExit(0 if status == job_lib.JobStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""The env contract every task process receives.
+
+Mirrors the reference's contract (``sky/skylet/constants.py:296-299``:
+SKYPILOT_NODE_IPS / NUM_NODES / NODE_RANK / NUM_GPUS_PER_NODE and
+SKYPILOT_TASK_ID ``:73``) with TPU-native additions: chip counts and
+the JAX coordinator address so ``jax.distributed.initialize`` (or
+``skypilot_tpu.parallel.distributed.initialize``) needs no extra
+wiring. Reference-compatible SKYPILOT_* aliases are exported too so
+recipes written against the reference run unchanged.
+"""
+from typing import Dict, List, Optional
+
+COORDINATOR_PORT = 8476
+
+ENV_NODE_RANK = 'SKYTPU_NODE_RANK'
+ENV_NUM_NODES = 'SKYTPU_NUM_NODES'
+ENV_NODE_IPS = 'SKYTPU_NODE_IPS'
+ENV_COORDINATOR_PORT = 'SKYTPU_COORDINATOR_PORT'
+ENV_COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'
+ENV_NUM_CHIPS_PER_NODE = 'SKYTPU_NUM_CHIPS_PER_NODE'
+ENV_TASK_ID = 'SKYTPU_TASK_ID'
+ENV_CLUSTER_INFO = 'SKYTPU_CLUSTER_INFO'
+
+
+def build_env(node_rank: int, node_ips: List[str],
+              num_chips_per_node: int = 0,
+              task_id: Optional[str] = None,
+              coordinator_port: int = COORDINATOR_PORT
+              ) -> Dict[str, str]:
+    """Env for one task process on host ``node_rank``."""
+    ips_str = '\n'.join(node_ips)
+    coordinator = f'{node_ips[0]}:{coordinator_port}'
+    env = {
+        ENV_NODE_RANK: str(node_rank),
+        ENV_NUM_NODES: str(len(node_ips)),
+        ENV_NODE_IPS: ips_str,
+        ENV_COORDINATOR_PORT: str(coordinator_port),
+        ENV_COORDINATOR_ADDRESS: coordinator,
+        ENV_NUM_CHIPS_PER_NODE: str(num_chips_per_node),
+        # Reference-compatible aliases (SKYPILOT_* names,
+        # sky/skylet/constants.py:296-299) so reference recipes work
+        # verbatim.
+        'SKYPILOT_NODE_RANK': str(node_rank),
+        'SKYPILOT_NUM_NODES': str(len(node_ips)),
+        'SKYPILOT_NODE_IPS': ips_str,
+        'SKYPILOT_NUM_GPUS_PER_NODE': str(num_chips_per_node),
+    }
+    if task_id is not None:
+        env[ENV_TASK_ID] = env['SKYPILOT_TASK_ID'] = task_id
+    return env

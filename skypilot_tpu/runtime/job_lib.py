@@ -1,0 +1,304 @@
+"""Head-node job queue + FIFO scheduler (analog of
+``sky/skylet/job_lib.py``).
+
+sqlite DB lives on the head node (``~/.skypilot_tpu/jobs.db``; tests
+point SKYTPU_RUNTIME_DIR elsewhere). Statuses mirror the reference
+(``sky/skylet/job_lib.py:118-159``). The scheduler spawns one driver
+process per job (``skypilot_tpu.runtime.driver``), which gang-starts
+the task on every host and enforces kill-all-on-any-failure.
+"""
+import enum
+import getpass
+import json
+import os
+import signal
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.utils import db_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def runtime_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_RUNTIME_DIR', '~/.skypilot_tpu'))
+
+
+def _db_path() -> str:
+    return os.path.join(runtime_dir(), 'jobs.db')
+
+
+def log_dir_for(run_timestamp: str) -> str:
+    return os.path.join(runtime_dir(), 'sky_logs', run_timestamp)
+
+
+class JobStatus(enum.Enum):
+    """Lifecycle (reference ``sky/skylet/job_lib.py:118-159``)."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'          # user code returned non-zero
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_DRIVER = 'FAILED_DRIVER'  # driver process died
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    @classmethod
+    def nonterminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if not s.is_terminal()]
+
+
+_TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED,
+             JobStatus.FAILED_SETUP, JobStatus.FAILED_DRIVER,
+             JobStatus.CANCELLED}
+
+
+def _create_tables(cursor, conn):
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        job_name TEXT,
+        username TEXT,
+        submitted_at REAL,
+        status TEXT,
+        run_timestamp TEXT,
+        start_at REAL DEFAULT null,
+        end_at REAL DEFAULT null,
+        resources TEXT,
+        pid INTEGER DEFAULT null,
+        spec_path TEXT DEFAULT null)""")
+    conn.commit()
+
+
+_conns: Dict[str, db_utils.SQLiteConn] = {}
+
+
+def _db() -> db_utils.SQLiteConn:
+    path = _db_path()
+    conn = _conns.get(path)
+    if conn is None or conn.db_path != path:
+        conn = db_utils.SQLiteConn(path, _create_tables)
+        _conns[path] = conn
+    return conn
+
+
+# -- queue ops ---------------------------------------------------------
+
+
+def add_job(job_name: Optional[str], run_timestamp: str,
+            resources_str: str = '', spec_path: Optional[str] = None,
+            username: Optional[str] = None) -> int:
+    db = _db()
+    try:
+        db.cursor.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, '
+            'status, run_timestamp, resources, spec_path) '
+            'VALUES (?,?,?,?,?,?,?)',
+            (job_name or '-', username or getpass.getuser(),
+             time.time(), JobStatus.PENDING.value, run_timestamp,
+             resources_str, spec_path))
+        job_id = db.cursor.lastrowid
+    finally:
+        db.conn.commit()
+    assert job_id is not None
+    return int(job_id)
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    db = _db()
+    now = time.time()
+    if status == JobStatus.RUNNING:
+        db.execute_and_commit(
+            'UPDATE jobs SET status=?, start_at=COALESCE(start_at, ?) '
+            'WHERE job_id=?', (status.value, now, job_id))
+    elif status.is_terminal():
+        db.execute_and_commit(
+            'UPDATE jobs SET status=?, end_at=? WHERE job_id=?',
+            (status.value, now, job_id))
+    else:
+        db.execute_and_commit(
+            'UPDATE jobs SET status=? WHERE job_id=?',
+            (status.value, job_id))
+
+
+def set_pid(job_id: int, pid: int) -> None:
+    _db().execute_and_commit('UPDATE jobs SET pid=? WHERE job_id=?',
+                             (pid, job_id))
+
+
+def get_status(job_id: int) -> Optional[JobStatus]:
+    row = _db().cursor.execute(
+        'SELECT status FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    return JobStatus(row[0]) if row else None
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().cursor.execute(
+        'SELECT job_id, job_name, username, submitted_at, status, '
+        'run_timestamp, start_at, end_at, resources, pid, spec_path '
+        'FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    (job_id, job_name, username, submitted_at, status, run_timestamp,
+     start_at, end_at, resources, pid, spec_path) = row
+    return {
+        'job_id': job_id,
+        'job_name': job_name,
+        'username': username,
+        'submitted_at': submitted_at,
+        'status': JobStatus(status),
+        'run_timestamp': run_timestamp,
+        'start_at': start_at,
+        'end_at': end_at,
+        'resources': resources,
+        'pid': pid,
+        'spec_path': spec_path,
+    }
+
+
+def get_jobs(statuses: Optional[List[JobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    db = _db()
+    if statuses is None:
+        rows = db.cursor.execute(
+            'SELECT job_id, job_name, username, submitted_at, status, '
+            'run_timestamp, start_at, end_at, resources, pid, '
+            'spec_path FROM jobs ORDER BY job_id DESC').fetchall()
+    else:
+        qmarks = ','.join('?' * len(statuses))
+        rows = db.cursor.execute(
+            'SELECT job_id, job_name, username, submitted_at, status, '
+            'run_timestamp, start_at, end_at, resources, pid, '
+            f'spec_path FROM jobs WHERE status IN ({qmarks}) '
+            'ORDER BY job_id DESC',
+            tuple(s.value for s in statuses)).fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def get_latest_job_id() -> Optional[int]:
+    row = _db().cursor.execute(
+        'SELECT job_id FROM jobs ORDER BY job_id DESC LIMIT 1'
+    ).fetchone()
+    return int(row[0]) if row else None
+
+
+def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
+    """Cancel given jobs (default: all non-terminal). Kills driver
+    process groups."""
+    if job_ids is None:
+        records = get_jobs(JobStatus.nonterminal_statuses())
+        job_ids = [r['job_id'] for r in records]
+    cancelled = []
+    for job_id in job_ids:
+        rec = get_job(job_id)
+        if rec is None or rec['status'].is_terminal():
+            continue
+        pid = rec['pid']
+        if pid:
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        set_status(job_id, JobStatus.CANCELLED)
+        cancelled.append(job_id)
+    return cancelled
+
+
+def is_cluster_idle(idle_minutes: int) -> bool:
+    """No non-terminal jobs, and the last job ended more than
+    ``idle_minutes`` ago (reference ``job_lib.py:717``)."""
+    active = get_jobs(JobStatus.nonterminal_statuses())
+    if active:
+        return False
+    rows = _db().cursor.execute(
+        'SELECT MAX(COALESCE(end_at, submitted_at)) FROM jobs'
+    ).fetchone()
+    last = rows[0] if rows and rows[0] is not None else 0.0
+    return (time.time() - last) >= idle_minutes * 60
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def update_job_statuses() -> None:
+    """Reconcile: RUNNING/SETTING_UP jobs whose driver died become
+    FAILED_DRIVER (reference ``job_lib.update_job_status:555``)."""
+    for rec in get_jobs([JobStatus.SETTING_UP, JobStatus.RUNNING]):
+        pid = rec['pid']
+        if pid is not None and not _pid_alive(pid):
+            logger.warning('Job %s driver (pid %s) died; marking '
+                           'FAILED_DRIVER', rec['job_id'], pid)
+            set_status(rec['job_id'], JobStatus.FAILED_DRIVER)
+
+
+class FIFOScheduler:
+    """Single-slot FIFO: start the oldest PENDING job if no job is
+    active (a TPU slice is one atomic allocation — concurrent jobs
+    would fight over chips; the reference serializes via Ray resource
+    accounting, we serialize explicitly)."""
+
+    def schedule_step(self) -> Optional[int]:
+        update_job_statuses()
+        active = get_jobs([JobStatus.SETTING_UP, JobStatus.RUNNING,
+                           JobStatus.INIT])
+        if active:
+            return None
+        pending = get_jobs([JobStatus.PENDING])
+        if not pending:
+            return None
+        job = pending[-1]  # oldest (list is DESC)
+        return self._start_driver(job)
+
+    def _start_driver(self, job: Dict[str, Any]) -> int:
+        job_id = job['job_id']
+        set_status(job_id, JobStatus.INIT)
+        log_dir = log_dir_for(job['run_timestamp'])
+        os.makedirs(log_dir, exist_ok=True)
+        driver_log = os.path.join(log_dir, 'driver.log')
+        env = dict(os.environ)
+        env['SKYTPU_RUNTIME_DIR'] = runtime_dir()
+        with open(driver_log, 'a', encoding='utf-8') as f:
+            proc = subprocess.Popen(
+                ['python', '-m', 'skypilot_tpu.runtime.driver',
+                 '--job-id', str(job_id)],
+                stdout=f, stderr=subprocess.STDOUT,
+                start_new_session=True, env=env)
+        set_pid(job_id, proc.pid)
+        logger.debug('Started driver pid %d for job %d', proc.pid,
+                     job_id)
+        return job_id
+
+
+def format_job_queue(records: List[Dict[str, Any]]) -> str:
+    from skypilot_tpu.utils import ux_utils
+    table = ux_utils.Table(['ID', 'NAME', 'USER', 'SUBMITTED',
+                            'STARTED', 'STATUS'])
+    for r in records:
+        table.add_row([
+            r['job_id'], r['job_name'], r['username'],
+            _fmt_ts(r['submitted_at']), _fmt_ts(r['start_at']),
+            r['status'].value
+        ])
+    return table.get_string()
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return '-'
+    return time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(ts))

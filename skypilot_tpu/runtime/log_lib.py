@@ -1,0 +1,133 @@
+"""Run-with-log + tail machinery (analog of ``sky/skylet/log_lib.py``).
+
+``run_with_log`` streams a subprocess's combined stdout/stderr to a
+log file (and optionally the console) line by line;
+``make_task_bash_script`` wraps user commands in a bash script with
+env exports and cwd; ``tail_logs`` follows a growing log file until
+the job reaches a terminal state.
+"""
+import os
+import select
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+SKY_REMOTE_WORKDIR = '~/sky_workdir'
+SKY_LOG_DIR = '~/sky_logs'
+
+
+def run_with_log(cmd: Union[List[str], str],
+                 log_path: str,
+                 *,
+                 stream_logs: bool = False,
+                 cwd: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 shell: bool = False,
+                 line_processor: Optional[Callable[[str], None]] = None,
+                 ) -> int:
+    """Run ``cmd``, teeing combined output to ``log_path``.
+
+    Returns the returncode. The subprocess is its own session leader
+    so cancellation can kill the whole process group (the reference
+    runs jobs under ``subprocess_daemon.py`` for the same reason).
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    with open(log_path, 'a', encoding='utf-8') as fout:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=cwd and os.path.expanduser(cwd),
+            env=env,
+            shell=shell,
+            start_new_session=True,
+            text=True,
+            bufsize=1,
+        )
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            fout.write(line)
+            fout.flush()
+            if stream_logs:
+                sys.stdout.write(line)
+                sys.stdout.flush()
+            if line_processor is not None:
+                line_processor(line)
+        proc.wait()
+        return proc.returncode
+
+
+def make_task_bash_script(codegen: str,
+                          env_vars: Optional[Dict[str, str]] = None
+                          ) -> str:
+    """Wrap user commands in a bash script (reference
+    ``log_lib.make_task_bash_script:230``): strict-ish shell, env
+    exports, cd into the synced workdir."""
+    script = [
+        '#!/bin/bash',
+        'source ~/.bashrc 2>/dev/null || true',
+        'set -o pipefail',
+        f'cd {SKY_REMOTE_WORKDIR} 2>/dev/null || cd ~',
+    ]
+    for k, v in (env_vars or {}).items():
+        script.append(f'export {k}={_shell_quote(v)}')
+    script.append(codegen)
+    return '\n'.join(script) + '\n'
+
+
+def _shell_quote(value: str) -> str:
+    import shlex
+    return shlex.quote(str(value))
+
+
+def write_task_script(codegen: str,
+                      env_vars: Optional[Dict[str, str]] = None,
+                      prefix: str = 'sky_task_') -> str:
+    """Materialize the bash script to a temp file; returns its path."""
+    content = make_task_bash_script(codegen, env_vars)
+    fd, path = tempfile.mkstemp(prefix=prefix, suffix='.sh')
+    with os.fdopen(fd, 'w', encoding='utf-8') as f:
+        f.write(content)
+    os.chmod(path, 0o755)
+    return path
+
+
+def tail_logs(log_path: str,
+              is_done: Callable[[], bool],
+              start_from_beginning: bool = True,
+              poll_interval: float = 0.2,
+              out=None) -> None:
+    """Follow ``log_path`` until ``is_done()`` and the file is fully
+    drained (reference ``log_lib.tail_logs:386`` +
+    ``_follow_job_logs:302``)."""
+    out = out or sys.stdout
+    log_path = os.path.expanduser(log_path)
+    # Wait for the file to appear.
+    while not os.path.exists(log_path):
+        if is_done():
+            return
+        time.sleep(poll_interval)
+    with open(log_path, encoding='utf-8', errors='replace') as f:
+        if not start_from_beginning:
+            f.seek(0, os.SEEK_END)
+        while True:
+            line = f.readline()
+            if line:
+                out.write(line)
+                out.flush()
+                continue
+            if is_done():
+                # Drain whatever arrived between the check and now.
+                rest = f.read()
+                if rest:
+                    out.write(rest)
+                    out.flush()
+                return
+            time.sleep(poll_interval)

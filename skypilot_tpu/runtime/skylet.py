@@ -1,0 +1,52 @@
+"""Head-node event loop (analog of ``sky/skylet/skylet.py:17-33`` +
+``events.py``).
+
+Every tick: run the FIFO scheduler, reconcile dead drivers, check
+autostop. Runs as a daemon started by instance_setup (or the local
+provisioner) on the head host.
+"""
+import argparse
+import subprocess
+import time
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.runtime import autostop_lib, job_lib
+
+logger = tpu_logging.init_logger(__name__)
+
+EVENT_INTERVAL_SECONDS = 5.0
+
+
+def run_once(scheduler: job_lib.FIFOScheduler) -> None:
+    try:
+        scheduler.schedule_step()
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('scheduler step failed')
+    try:
+        cfg = autostop_lib.should_trigger()
+        if cfg is not None:
+            logger.info('Autostop triggered (idle %s min, down=%s); '
+                        'running stop command', cfg['idle_minutes'],
+                        cfg['down'])
+            autostop_lib.clear_autostop()
+            subprocess.Popen(['/bin/bash', '-c', cfg['stop_command']],
+                             start_new_session=True)
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('autostop check failed')
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--interval', type=float,
+                        default=EVENT_INTERVAL_SECONDS)
+    args = parser.parse_args()
+    scheduler = job_lib.FIFOScheduler()
+    logger.info('skylet started (interval %.1fs, runtime dir %s)',
+                args.interval, job_lib.runtime_dir())
+    while True:
+        run_once(scheduler)
+        time.sleep(args.interval)
+
+
+if __name__ == '__main__':
+    main()
