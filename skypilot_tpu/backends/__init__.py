@@ -1,0 +1,5 @@
+"""Execution backends (analog of ``sky/backends/``)."""
+from skypilot_tpu.backends.backend import Backend, ClusterHandle
+from skypilot_tpu.backends.tpu_backend import TpuBackend
+
+__all__ = ['Backend', 'ClusterHandle', 'TpuBackend']

@@ -1,0 +1,84 @@
+"""Backend ABC + cluster handle (analog of
+``sky/backends/backend.py`` and ``CloudVmRayResourceHandle``,
+``sky/backends/cloud_vm_ray_backend.py:2157``)."""
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.resources import Resources
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    """Everything the client needs to talk to a provisioned cluster.
+
+    Pickled into the state DB (like the reference's handle), so keep
+    it plain-data."""
+    cluster_name: str
+    cluster_name_on_cloud: str
+    provider: str
+    region: str
+    zone: Optional[str]
+    launched_resources: Optional[Resources]
+    # Rank-ordered hosts: [{'ip', 'external_ip', 'agent_port',
+    #                       'runtime_dir'}]
+    hosts: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    head_runtime_dir: str = '~/.skypilot_tpu'
+    workdir: str = '~/sky_workdir'
+    num_slices: int = 1
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def head_ip(self) -> Optional[str]:
+        if not self.hosts:
+            return None
+        return self.hosts[0].get('external_ip') or \
+            self.hosts[0].get('ip')
+
+    def head_agent(self):
+        from skypilot_tpu.runtime.agent_client import AgentClient
+        assert self.hosts, 'cluster has no hosts'
+        return AgentClient(self.head_ip,
+                           self.hosts[0]['agent_port'])
+
+    def internal_ips(self) -> List[str]:
+        return [h['ip'] for h in self.hosts]
+
+    @property
+    def num_chips_per_host(self) -> int:
+        res = self.launched_resources
+        if res is None or res.tpu_spec is None:
+            return 0
+        return res.tpu_spec.chips_per_host
+
+
+class Backend:
+    """Template: provision → sync_workdir → setup → execute →
+    teardown (reference ``sky/backends/backend.py``)."""
+
+    NAME = 'backend'
+
+    def provision(self, task, to_provision, *, dryrun: bool,
+                  stream_logs: bool, cluster_name: str,
+                  retry_until_up: bool = False
+                  ) -> Optional[ClusterHandle]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: ClusterHandle, task,
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: ClusterHandle, task, *,
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        raise NotImplementedError
+
+    def teardown(self, handle: ClusterHandle, *, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError

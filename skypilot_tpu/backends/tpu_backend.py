@@ -1,0 +1,317 @@
+"""TpuBackend — the execution engine (analog of ``CloudVmRayBackend``,
+``sky/backends/cloud_vm_ray_backend.py:2621``, minus Ray).
+
+provision: failover engine → cluster info → runtime bring-up (agents +
+skylet) → state DB. execute: job spec → codegen-RPC to the head's job
+queue → FIFO scheduler starts the gang driver. All control flows over
+the host-agent channel; logs stream back over the same channel.
+"""
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, provision, state, status_lib
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backends.backend import Backend, ClusterHandle
+from skypilot_tpu.provision.provisioner import RetryingProvisioner
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime import codegen, job_lib
+from skypilot_tpu.runtime.agent_client import AgentClient
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+_PROVISION_RETRY_GAP_SECONDS = 30
+
+
+class TpuBackend(Backend):
+    NAME = 'tpu'
+
+    # -- provision ------------------------------------------------------
+
+    def provision(self, task: Task, to_provision: Resources, *,
+                  dryrun: bool, stream_logs: bool, cluster_name: str,
+                  retry_until_up: bool = False
+                  ) -> Optional[ClusterHandle]:
+        del stream_logs
+        record = state.get_cluster_from_name(cluster_name)
+        if record is not None and \
+                record['status'] == status_lib.ClusterStatus.UP:
+            handle: ClusterHandle = record['handle']
+            launched = handle.launched_resources
+            reusable = all(
+                r.less_demanding_than(launched) for r in task.resources
+            ) if launched is not None else True
+            if not reusable:
+                raise exceptions.ResourcesMismatchError(
+                    f'Cluster {cluster_name!r} exists with '
+                    f'{launched!r}, which does not satisfy the '
+                    'requested resources. Use a new cluster name or '
+                    'tear this one down.')
+            logger.info('Reusing existing cluster %s', cluster_name)
+            state.update_last_use(cluster_name)
+            return handle
+        if dryrun:
+            return None
+
+        cluster_name_on_cloud = common_utils.make_cluster_name_on_cloud(
+            cluster_name)
+        while True:
+            provisioner = RetryingProvisioner()
+            try:
+                result = provisioner.provision_with_retries(
+                    to_provision, cluster_name, cluster_name_on_cloud,
+                    task.num_nodes)
+                break
+            except exceptions.ResourcesUnavailableError as e:
+                if e.no_failover or not retry_until_up:
+                    raise
+                logger.warning(
+                    'All placements failed (%s); retry_until_up set — '
+                    'sleeping %ds before the next sweep.', e,
+                    _PROVISION_RETRY_GAP_SECONDS)
+                time.sleep(_PROVISION_RETRY_GAP_SECONDS)
+
+        info = result.cluster_info
+        handle = ClusterHandle(
+            cluster_name=cluster_name,
+            cluster_name_on_cloud=cluster_name_on_cloud,
+            provider=result.record.provider,
+            region=result.record.region,
+            zone=result.record.zone,
+            launched_resources=result.final_resources,
+            hosts=[{
+                'ip': inst.internal_ip,
+                'external_ip': inst.external_ip,
+                'agent_port': inst.agent_port,
+                'runtime_dir': inst.tags.get('runtime_dir',
+                                             '~/.skypilot_tpu'),
+            } for inst in info.instances],
+            num_slices=task.num_nodes,
+        )
+        handle.head_runtime_dir = handle.hosts[0]['runtime_dir']
+        if handle.provider == 'local':
+            base = os.path.dirname(handle.head_runtime_dir)
+            handle.workdir = os.path.join(base, 'sky_workdir')
+        state.add_or_update_cluster(cluster_name, handle,
+                                    task.resources, ready=False)
+        self._post_provision_runtime_setup(handle)
+        state.add_or_update_cluster(cluster_name, handle,
+                                    task.resources, ready=True,
+                                    is_launch=False)
+        return handle
+
+    def _post_provision_runtime_setup(self,
+                                      handle: ClusterHandle) -> None:
+        """Agents healthy on every host + skylet running on head
+        (model: ``post_provision_runtime_setup``,
+        ``sky/provision/provisioner.py:631``)."""
+        if handle.provider != 'local':
+            from skypilot_tpu.provision import instance_setup
+            instance_setup.setup_runtime_on_cluster(handle)
+        for h in handle.hosts:
+            AgentClient(h.get('external_ip') or h['ip'],
+                        h['agent_port']).wait_healthy(timeout=120)
+        # Start skylet on the head (idempotent: pgrep first).
+        head = handle.head_agent()
+        skylet_cmd = (
+            f'pgrep -f "skypilot_tpu.runtime.skylet" > /dev/null || '
+            f'SKYTPU_RUNTIME_DIR={handle.head_runtime_dir} '
+            f'nohup python3 -m skypilot_tpu.runtime.skylet '
+            f'>> {handle.head_runtime_dir}/skylet.log 2>&1 &')
+        out = head.exec(skylet_cmd, timeout=30)
+        if out.get('returncode') != 0:
+            logger.warning('skylet start returned %s: %s',
+                           out.get('returncode'), out.get('output'))
+
+    # -- sync / setup ---------------------------------------------------
+
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        source = os.path.expanduser(workdir).rstrip('/') + '/'
+        if handle.provider == 'local':
+            from skypilot_tpu.utils.command_runner import \
+                LocalCommandRunner
+            LocalCommandRunner().rsync(
+                source, handle.workdir.rstrip('/') + '/', up=True)
+            return
+        from skypilot_tpu.provision import instance_setup
+        instance_setup.sync_to_all_hosts(handle, source,
+                                         handle.workdir)
+
+    def setup(self, handle: ClusterHandle, task: Task,
+              detach_setup: bool = False) -> None:
+        """Setup runs at launch via the gang driver's setup phase; the
+        backend stores it in the next job spec instead of a separate
+        SSH pass. Kept as explicit stage for CLI parity."""
+        del handle, task, detach_setup
+
+    # -- execute --------------------------------------------------------
+
+    def execute(self, handle: ClusterHandle, task: Task, *,
+                detach_run: bool = False,
+                dryrun: bool = False,
+                include_setup: bool = True) -> Optional[int]:
+        if dryrun:
+            logger.info('Dryrun: not executing.')
+            return None
+        if task.run is None and (task.setup is None or
+                                 not include_setup):
+            logger.info('Task has no run commands; nothing to '
+                        'execute.')
+            return None
+        run_timestamp = f'sky-{time.strftime("%Y-%m-%d-%H-%M-%S")}-' \
+                        f'{os.getpid()}-{_next_submit_id()}'
+        run_cmd = task.run if isinstance(task.run, str) else ''
+        if callable(task.run):
+            run_cmd = task.run(handle.num_hosts,
+                               handle.internal_ips()) or ''
+        log_dir = os.path.join(handle.head_runtime_dir, 'sky_logs',
+                               run_timestamp)
+        spec: Dict[str, Any] = {
+            'run_timestamp': run_timestamp,
+            'task_name': task.name,
+            'num_nodes': handle.num_hosts,
+            'hosts': [{'ip': h['ip'], 'agent_port': h['agent_port']}
+                      for h in handle.hosts],
+            'setup_cmd': task.setup if include_setup else None,
+            'run_cmd': run_cmd,
+            'envs': dict(task.envs),
+            'num_chips_per_node': handle.num_chips_per_host,
+            'workdir': handle.workdir,
+            'log_dir': log_dir,
+        }
+        accel = handle.launched_resources.accelerator \
+            if handle.launched_resources else None
+        cmd = codegen.add_and_schedule_job(
+            handle.head_runtime_dir, task.name or '-', run_timestamp,
+            accel or 'cpu', spec)
+        out = handle.head_agent().exec(cmd, timeout=120)
+        if out.get('returncode') != 0:
+            raise exceptions.CommandError(
+                out.get('returncode', 1), 'submit job',
+                out.get('output', ''))
+        job_id_str = codegen.parse_tagged(out.get('output', ''),
+                                          'JOB_ID')
+        assert job_id_str is not None, out
+        job_id = int(job_id_str)
+        logger.info('Job %d submitted to %s', job_id,
+                    handle.cluster_name)
+        state.update_last_use(handle.cluster_name)
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    # -- logs / queue ---------------------------------------------------
+
+    def job_status(self, handle: ClusterHandle,
+                   job_id: int) -> Optional[job_lib.JobStatus]:
+        cmd = codegen.get_job_status(handle.head_runtime_dir, job_id)
+        out = handle.head_agent().exec(cmd, timeout=60)
+        value = codegen.parse_tagged(out.get('output', ''), 'STATUS')
+        if value in (None, 'None'):
+            return None
+        return job_lib.JobStatus(value)
+
+    def job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        cmd = codegen.get_job_queue(handle.head_runtime_dir)
+        out = handle.head_agent().exec(cmd, timeout=60)
+        payload = codegen.parse_tagged(out.get('output', ''), 'QUEUE')
+        if payload is None:
+            raise exceptions.CommandError(1, 'queue',
+                                          out.get('output', ''))
+        records = json.loads(payload)
+        for r in records:
+            r['status'] = job_lib.JobStatus(r['status'])
+        return records
+
+    def cancel_jobs(self, handle: ClusterHandle,
+                    job_ids: Optional[List[int]] = None) -> List[int]:
+        cmd = codegen.cancel_jobs(handle.head_runtime_dir, job_ids)
+        out = handle.head_agent().exec(cmd, timeout=60)
+        payload = codegen.parse_tagged(out.get('output', ''),
+                                       'CANCELLED')
+        return json.loads(payload) if payload else []
+
+    def tail_logs(self, handle: ClusterHandle, job_id: int,
+                  out=None, poll_interval: float = 0.5) -> None:
+        """Stream run.log from the head until the job is terminal."""
+        import sys
+        out = out or sys.stdout
+        head = handle.head_agent()
+        cmd = codegen.get_log_path(handle.head_runtime_dir, job_id)
+        resp = head.exec(cmd, timeout=60)
+        log_path = codegen.parse_tagged(resp.get('output', ''), 'LOG')
+        if not log_path:
+            logger.warning('No log path for job %d', job_id)
+            return
+        offset = 0
+        while True:
+            status = self.job_status(handle, job_id)
+            data = head.read_file(log_path, offset)
+            if data:
+                offset += len(data)
+                out.write(data.decode('utf-8', errors='replace'))
+                out.flush()
+            if status is None or status.is_terminal():
+                data = head.read_file(log_path, offset)
+                if data:
+                    out.write(data.decode('utf-8', errors='replace'))
+                    out.flush()
+                return
+            time.sleep(poll_interval)
+
+    # -- autostop / teardown -------------------------------------------
+
+    def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        stop_cmd = (
+            f'SKYTPU_STATE_DIR={os.environ.get("SKYTPU_STATE_DIR", "~/.skypilot_tpu")} '
+            f'python3 -m skypilot_tpu.runtime.self_stop '
+            f'--provider {handle.provider} --region {handle.region} '
+            f'--cluster-name-on-cloud {handle.cluster_name_on_cloud}'
+            + (' --down' if down else ''))
+        cmd = codegen.set_autostop(handle.head_runtime_dir,
+                                   idle_minutes, down, stop_cmd)
+        out = handle.head_agent().exec(cmd, timeout=30)
+        if codegen.parse_tagged(out.get('output', ''),
+                                'AUTOSTOP') != 'ok':
+            raise exceptions.CommandError(1, 'autostop',
+                                          out.get('output', ''))
+        state.set_cluster_autostop_value(handle.cluster_name,
+                                         idle_minutes, down)
+
+    def teardown(self, handle: ClusterHandle, *, terminate: bool,
+                 purge: bool = False) -> None:
+        try:
+            if terminate:
+                provision.terminate_instances(
+                    handle.provider, handle.region,
+                    handle.cluster_name_on_cloud)
+                provision.cleanup_ports(handle.provider, handle.region,
+                                        handle.cluster_name_on_cloud)
+            else:
+                res = handle.launched_resources
+                if res is not None and res.tpu_spec is not None and \
+                        res.tpu_spec.is_pod:
+                    raise exceptions.NotSupportedError(
+                        'TPU pods cannot be stopped (reference '
+                        'constraint sky/clouds/gcp.py:193-203); use '
+                        'down instead.')
+                provision.stop_instances(handle.provider,
+                                         handle.region,
+                                         handle.cluster_name_on_cloud)
+        except exceptions.SkyTpuError:
+            if not purge:
+                raise
+            logger.warning('teardown error ignored (purge=True)')
+        state.remove_cluster(handle.cluster_name, terminate=terminate)
+
+
+_submit_counter = [0]
+
+
+def _next_submit_id() -> int:
+    _submit_counter[0] += 1
+    return _submit_counter[0]

@@ -1,0 +1,41 @@
+"""Credential checking (analog of ``sky/check.py:19``)."""
+from typing import List
+
+from skypilot_tpu import state
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def _check_gcp() -> bool:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.provision.gcp import client as gcp_client
+    try:
+        gcp_client.get_access_token()
+        gcp_client.get_project_id()
+        return True
+    except exceptions.SkyTpuError:
+        return False
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe each cloud's credentials; persist the enabled set."""
+    enabled = []
+    if _check_gcp():
+        enabled.append('gcp')
+        if not quiet:
+            logger.info('GCP: enabled')
+    elif not quiet:
+        logger.info('GCP: no credentials found')
+    # The local fake provider is always available (used by tests and
+    # single-machine smoke runs).
+    enabled.append('local')
+    state.set_enabled_clouds(enabled)
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh() -> List[str]:
+    cached = state.get_enabled_clouds()
+    if cached:
+        return cached
+    return check(quiet=True)

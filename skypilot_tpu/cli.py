@@ -1,0 +1,297 @@
+"""Command-line interface (analog of ``sky/cli.py`` — launch / exec /
+status / stop / start / down / autostop / queue / logs / cancel /
+check / show-tpus / cost-report).
+
+Run as ``python -m skypilot_tpu.cli ...`` or the ``xsky`` console
+script.
+"""
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import core, exceptions, execution
+from skypilot_tpu import catalog as catalog_lib
+from skypilot_tpu.optimizer import OptimizeTarget
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import ux_utils
+
+
+def _parse_env(env: Tuple[str, ...]) -> Dict[str, str]:
+    out = {}
+    for item in env:
+        if '=' in item:
+            k, v = item.split('=', 1)
+            out[k] = v
+        else:
+            out[item] = os.environ.get(item, '')
+    return out
+
+
+def _task_from_entrypoint(entrypoint: Tuple[str, ...],
+                          env: Tuple[str, ...],
+                          accelerator: Optional[str],
+                          num_nodes: Optional[int],
+                          use_spot: Optional[bool],
+                          workdir: Optional[str],
+                          name: Optional[str]) -> Task:
+    """YAML path → Task.from_yaml; else inline command (reference
+    ``_make_task_or_dag_from_entrypoint_with_overrides``,
+    ``sky/cli.py:722``)."""
+    from skypilot_tpu.resources import Resources
+    entry = ' '.join(entrypoint)
+    env_overrides = _parse_env(env)
+    if entry.endswith(('.yaml', '.yml')) and os.path.exists(entry):
+        import yaml
+        with open(entry, encoding='utf-8') as f:
+            config = yaml.safe_load(f) or {}
+        task = Task.from_yaml_config(config, env_overrides)
+    else:
+        task = Task(run=entry or None, envs=env_overrides or None)
+    if name:
+        task.name = name
+    if num_nodes is not None:
+        task.num_nodes = num_nodes
+    if workdir is not None:
+        task.workdir = workdir
+    if accelerator is not None or use_spot is not None:
+        base = next(iter(task.resources))
+        overrides = {}
+        if accelerator is not None:
+            overrides['accelerators'] = accelerator
+        if use_spot is not None:
+            overrides['use_spot'] = use_spot
+        task.set_resources(base.copy(**overrides))
+    return task
+
+
+@click.group()
+@click.version_option('0.1.0', prog_name='skypilot-tpu')
+def cli():
+    """skypilot_tpu: TPU-native workload orchestration."""
+
+
+_task_options = [
+    click.option('--env', multiple=True,
+                 help='Env var KEY=VALUE (or KEY to inherit).'),
+    click.option('--gpus', '--accelerator', 'accelerator',
+                 default=None, help='TPU slice, e.g. tpu-v5p-8.'),
+    click.option('--num-nodes', type=int, default=None,
+                 help='Number of slices.'),
+    click.option('--use-spot/--no-use-spot', default=None),
+    click.option('--workdir', default=None),
+    click.option('--name', '-n', default=None),
+]
+
+
+def _apply(options):
+    def deco(fn):
+        for opt in reversed(options):
+            fn = opt(fn)
+        return fn
+    return deco
+
+
+@cli.command()
+@click.argument('entrypoint', nargs=-1)
+@click.option('--cluster', '-c', default=None)
+@_apply(_task_options)
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--dryrun', is_flag=True)
+@click.option('--idle-minutes-to-autostop', '-i', type=int,
+              default=None)
+@click.option('--down', is_flag=True,
+              help='Tear down after the job (or with -i, on idle).')
+@click.option('--retry-until-up', '-r', is_flag=True)
+@click.option('--fast', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def launch(entrypoint, cluster, env, accelerator, num_nodes, use_spot,
+           workdir, name, detach_run, dryrun, idle_minutes_to_autostop,
+           down, retry_until_up, fast, yes):
+    """Launch a task (YAML file or inline command)."""
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    if not yes and not dryrun and sys.stdin.isatty():
+        click.confirm(f'Launching task on cluster '
+                      f'{cluster or "<auto>"}. Proceed?', default=True,
+                      abort=True)
+    job_id, handle = execution.launch(
+        task, cluster, dryrun=dryrun, detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up, fast=fast)
+    if handle is not None:
+        click.echo(f'Job {job_id} on cluster {handle.cluster_name}')
+
+
+@cli.command(name='exec')
+@click.argument('cluster')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+@click.option('--detach-run', '-d', is_flag=True)
+def exec_cmd(cluster, entrypoint, env, accelerator, num_nodes,
+             use_spot, workdir, name, detach_run):
+    """Run on an existing cluster (skips provision/setup)."""
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    job_id, _ = execution.exec_(task, cluster, detach_run=detach_run)
+    click.echo(f'Job {job_id} on cluster {cluster}')
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True)
+@click.argument('clusters', nargs=-1)
+def status(refresh, clusters):
+    """Show clusters."""
+    records = core.status(list(clusters) or None, refresh=refresh)
+    table = ux_utils.Table(['NAME', 'RESOURCES', 'REGION', 'HOSTS',
+                            'STATUS', 'AUTOSTOP'])
+    for r in records:
+        handle = r['handle']
+        res = handle.launched_resources
+        accel = (res.accelerator or 'cpu-vm') if res else '-'
+        autostop = f'{r["autostop"]}m' if r['autostop'] >= 0 else '-'
+        if r['autostop'] >= 0 and r['to_down']:
+            autostop += ' (down)'
+        table.add_row([r['name'], accel, handle.region,
+                       handle.num_hosts, r['status'].colored_str(),
+                       autostop])
+    click.echo(table.get_string() if records else 'No clusters.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def stop(clusters, yes):
+    """Stop cluster(s) (single-host only; pods must be torn down)."""
+    for name in clusters:
+        if not yes and sys.stdin.isatty():
+            click.confirm(f'Stop {name}?', default=True, abort=True)
+        core.stop(name)
+        click.echo(f'Stopped {name}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+def start(clusters):
+    """Restart stopped cluster(s)."""
+    for name in clusters:
+        core.start(name)
+        click.echo(f'Started {name}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+@click.option('--purge', is_flag=True)
+def down(clusters, yes, purge):
+    """Tear down cluster(s)."""
+    for name in clusters:
+        if not yes and sys.stdin.isatty():
+            click.confirm(f'Tear down {name}?', default=True,
+                          abort=True)
+        core.down(name, purge=purge)
+        click.echo(f'Terminated {name}.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='Idle minutes before stopping; -1 disables.')
+@click.option('--down', 'down_after', is_flag=True,
+              help='Tear down instead of stop.')
+def autostop(cluster, idle_minutes, down_after):
+    """Schedule automatic stop/teardown on idleness."""
+    core.autostop(cluster, idle_minutes, down_after)
+    click.echo(f'Autostop set on {cluster}: {idle_minutes}m '
+               f'({"down" if down_after else "stop"}).')
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Show the cluster's job queue."""
+    records = core.queue(cluster)
+    table = ux_utils.Table(['ID', 'NAME', 'USER', 'STATUS',
+                            'RESOURCES'])
+    for r in records:
+        table.add_row([r['job_id'], r['job_name'], r['username'],
+                       r['status'].value, r['resources']])
+    click.echo(table.get_string() if records else 'No jobs.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int, required=False)
+def logs(cluster, job_id):
+    """Stream a job's logs (latest job if no id given)."""
+    core.tail_logs(cluster, job_id)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True)
+def cancel(cluster, job_ids, all_jobs):
+    """Cancel job(s)."""
+    cancelled = core.cancel(cluster, list(job_ids) or None,
+                            all_jobs=all_jobs or not job_ids)
+    click.echo(f'Cancelled jobs: {cancelled}')
+
+
+@cli.command()
+def check():
+    """Verify cloud credentials."""
+    import skypilot_tpu.check as check_lib
+    enabled = check_lib.check()
+    if enabled:
+        click.echo(f'Enabled clouds: {", ".join(enabled)}')
+    else:
+        click.echo('No clouds enabled. Configure GCP credentials '
+                   '(gcloud auth login).')
+        raise SystemExit(1)
+
+
+@cli.command(name='show-tpus')
+@click.option('--region', default=None)
+@click.argument('name_filter', required=False)
+def show_tpus(region, name_filter):
+    """List TPU slice types, topologies and prices."""
+    entries = catalog_lib.list_accelerators(name_filter=name_filter,
+                                            region_filter=region)
+    table = ux_utils.Table(['TPU', 'CHIPS', 'HOSTS', 'TOPOLOGY',
+                            'HBM', 'REGION', '$/HR', '$/HR (SPOT)'])
+    for _, rows in sorted(entries.items()):
+        for e in rows:
+            table.add_row([
+                e['accelerator'], e['chips'], e['num_hosts'],
+                e['topology'], f'{e["hbm_gb"]}GB', e['region'],
+                f'{e["price"]:.2f}', f'{e["spot_price"]:.2f}'
+            ])
+    click.echo(table.get_string())
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Estimated cost of clusters from recorded usage intervals."""
+    records = core.cost_report()
+    table = ux_utils.Table(['NAME', 'DURATION', 'RESOURCES', 'COST'])
+    for r in records:
+        hours = r['duration'] / 3600
+        res = r['resources']
+        accel = (res.accelerator or 'cpu-vm') if res else '-'
+        cost = f'${r["cost"]:.2f}' if r['cost'] is not None else '-'
+        table.add_row([r['name'], f'{hours:.2f}h', accel, cost])
+    click.echo(table.get_string() if records else 'No usage recorded.')
+
+
+def main():
+    try:
+        cli()
+    except exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        raise SystemExit(1) from e
+
+
+if __name__ == '__main__':
+    main()

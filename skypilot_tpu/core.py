@@ -1,0 +1,197 @@
+"""Core SDK operations (analog of ``sky/core.py:41-907``): each looks
+up the handle in the state DB and drives the backend."""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, provision, state, status_lib
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.backends.backend import ClusterHandle
+from skypilot_tpu.runtime import job_lib
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def _get_handle(cluster_name: str,
+                require_up: bool = True) -> ClusterHandle:
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    if require_up and record['status'] != status_lib.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.',
+            cluster_status=record['status'])
+    return record['handle']
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records; with refresh=True, reconcile against the
+    provider (reference ``refresh_cluster_record``,
+    ``sky/backends/backend_utils.py:2211``)."""
+    records = state.get_clusters()
+    if cluster_names is not None:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+    if refresh:
+        for record in records:
+            handle: ClusterHandle = record['handle']
+            try:
+                statuses = provision.query_instances(
+                    handle.provider, handle.region,
+                    handle.cluster_name_on_cloud)
+            except exceptions.SkyTpuError:
+                continue
+            if not statuses:
+                # Gone from the cloud (preempted/manually deleted).
+                state.remove_cluster(record['name'], terminate=True)
+                record['status'] = None
+                continue
+            values = set(statuses.values())
+            if values == {'running'}:
+                new_status = status_lib.ClusterStatus.UP
+            elif 'stopped' in values:
+                new_status = status_lib.ClusterStatus.STOPPED
+            else:
+                new_status = status_lib.ClusterStatus.INIT
+            if new_status != record['status']:
+                state.update_cluster_status(record['name'], new_status)
+                record['status'] = new_status
+        records = [r for r in records if r['status'] is not None]
+    return records
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name, require_up=False)
+    TpuBackend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    handle = _get_handle(cluster_name, require_up=False)
+    TpuBackend().teardown(handle, terminate=True, purge=purge)
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED single-host cluster."""
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle: ClusterHandle = record['handle']
+    from skypilot_tpu.provision.common import ProvisionConfig
+    from skypilot_tpu.provision.provisioner import bulk_provision
+    res = handle.launched_resources
+    node_config: Dict[str, Any] = {'num_hosts': 1}
+    if res is not None and res.accelerator is not None:
+        node_config = res.make_deploy_variables(
+            handle.cluster_name_on_cloud)
+    bulk_provision(ProvisionConfig(
+        provider=handle.provider, region=handle.region,
+        zone=handle.zone, cluster_name=cluster_name,
+        cluster_name_on_cloud=handle.cluster_name_on_cloud,
+        node_config=node_config))
+    backend = TpuBackend()
+    backend._post_provision_runtime_setup(handle)  # pylint: disable=protected-access
+    state.add_or_update_cluster(cluster_name, handle, None, ready=True)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_after: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    TpuBackend().set_autostop(handle, idle_minutes, down_after)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name)
+    return TpuBackend().job_queue(handle)
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    handle = _get_handle(cluster_name)
+    if all_jobs:
+        job_ids = None
+    return TpuBackend().cancel_jobs(handle, job_ids)
+
+
+def job_status(cluster_name: str,
+               job_id: Optional[int] = None
+               ) -> Optional[job_lib.JobStatus]:
+    handle = _get_handle(cluster_name)
+    backend = TpuBackend()
+    if job_id is None:
+        records = backend.job_queue(handle)
+        if not records:
+            return None
+        job_id = records[0]['job_id']
+    return backend.job_status(handle, job_id)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              out=None) -> None:
+    handle = _get_handle(cluster_name)
+    backend = TpuBackend()
+    if job_id is None:
+        records = backend.job_queue(handle)
+        if not records:
+            raise exceptions.JobError('No jobs on cluster.')
+        job_id = records[0]['job_id']
+    backend.tail_logs(handle, job_id, out=out)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Accumulated cost per (historical) cluster from usage intervals
+    (reference ``sky/core.py:213``)."""
+    out = []
+    for record in state.get_clusters_from_history():
+        res = record['resources']
+        duration = record['duration']
+        cost = None
+        if res is not None:
+            try:
+                cost = res.get_cost(duration)
+            except exceptions.SkyTpuError:
+                cost = None
+        out.append({
+            'name': record['name'],
+            'duration': duration,
+            'num_nodes': record['num_nodes'],
+            'resources': res,
+            'status': record['status'],
+            'cost': cost,
+        })
+    return out
+
+
+def download_logs(cluster_name: str, job_id: int,
+                  local_dir: str) -> str:
+    """Fetch a job's merged run.log to a local directory."""
+    import os
+    handle = _get_handle(cluster_name)
+    backend = TpuBackend()
+    os.makedirs(os.path.expanduser(local_dir), exist_ok=True)
+    target = os.path.join(os.path.expanduser(local_dir),
+                          f'job-{job_id}.log')
+    with open(target, 'w', encoding='utf-8') as f:
+        backend.tail_logs(handle, job_id, out=f)
+    return target
+
+
+def wait_for_job(cluster_name: str, job_id: int,
+                 timeout: float = 600.0,
+                 poll_interval: float = 1.0
+                 ) -> job_lib.JobStatus:
+    """Block until the job reaches a terminal state."""
+    handle = _get_handle(cluster_name)
+    backend = TpuBackend()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        s = backend.job_status(handle, job_id)
+        if s is not None and s.is_terminal():
+            return s
+        time.sleep(poll_interval)
+    raise TimeoutError(
+        f'Job {job_id} on {cluster_name} not terminal after '
+        f'{timeout}s')

@@ -1,0 +1,164 @@
+"""Execution pipeline: the Stage state machine behind launch/exec.
+
+Analog of ``sky/execution.py``: ``Stage`` enum
+(OPTIMIZE→PROVISION→SYNC_WORKDIR→SETUP→EXEC→DOWN, ``:31``),
+``_execute`` orchestration (``:95``), ``launch`` (``:368``) with the
+``fast=True`` short-circuit, ``exec_`` (``:553``) running only
+SYNC_WORKDIR+EXEC against an UP cluster.
+"""
+import enum
+from typing import List, Optional
+
+from skypilot_tpu import exceptions, optimizer, state, status_lib
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backends import TpuBackend
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SETUP = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _execute(task: Task, *, cluster_name: str,
+             stages: Optional[List[Stage]] = None,
+             dryrun: bool = False,
+             stream_logs: bool = True,
+             detach_run: bool = False,
+             optimize_target=optimizer.OptimizeTarget.COST,
+             idle_minutes_to_autostop: Optional[int] = None,
+             down: bool = False,
+             retry_until_up: bool = False,
+             quiet_optimizer: bool = False):
+    stages = stages or list(Stage)
+    backend = TpuBackend()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+
+    # Default-cloud resolution: tasks that don't pin a cloud go to
+    # gcp when credentials exist, else to the local fake provider
+    # (reference: enabled-clouds gate the optimizer's candidates,
+    # sky/check.py:19 + optimizer).
+    if not dryrun and any(r.cloud is None for r in task.resources):
+        import skypilot_tpu.check as check_lib
+        enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+        if 'gcp' not in enabled:
+            task.set_resources({
+                r.copy(cloud='local') if r.cloud is None else r
+                for r in task.resources
+            })
+
+    to_provision: Optional[Resources] = None
+    if Stage.OPTIMIZE in stages:
+        existing = state.get_cluster_from_name(cluster_name)
+        if existing is not None and \
+                existing['status'] == status_lib.ClusterStatus.UP:
+            # Reuse path: no optimization needed (reference skips
+            # optimize for existing clusters).
+            to_provision = existing['handle'].launched_resources
+        else:
+            with Dag() as dag:
+                dag.add(task)
+            optimizer.optimize(dag, optimize_target,
+                               quiet=quiet_optimizer)
+            to_provision = task.best_resources  # type: ignore[attr-defined]
+    if to_provision is None:
+        to_provision = next(iter(task.resources))
+
+    handle = None
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, to_provision, dryrun=dryrun,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+    else:
+        record = state.get_cluster_from_name(cluster_name)
+        assert record is not None, cluster_name
+        handle = record['handle']
+    if dryrun:
+        logger.info('Dryrun finished.')
+        return None, None
+    assert handle is not None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+
+    if task.storage_mounts:
+        task.sync_storage_mounts()
+
+    job_id = None
+    if Stage.EXEC in stages:
+        include_setup = Stage.SETUP in stages
+        job_id = backend.execute(handle, task, detach_run=detach_run,
+                                 include_setup=include_setup)
+
+    if idle_minutes_to_autostop is not None:
+        backend.set_autostop(handle, idle_minutes_to_autostop, down)
+
+    if Stage.DOWN in stages and down and \
+            idle_minutes_to_autostop is None:
+        backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+def launch(task: Task, cluster_name: Optional[str] = None, *,
+           dryrun: bool = False,
+           stream_logs: bool = True,
+           detach_run: bool = False,
+           optimize_target=optimizer.OptimizeTarget.COST,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           retry_until_up: bool = False,
+           fast: bool = False,
+           quiet_optimizer: bool = False):
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Returns (job_id, handle). ``fast=True``: if the cluster is UP,
+    skip provisioning checks entirely (reference
+    ``sky/execution.py:486-527``).
+    """
+    if cluster_name is None:
+        cluster_name = f'sky-{common_utils.get_user_hash()[:4]}-' \
+                       f'{common_utils.get_usage_run_id()[:4]}'
+    stages = None
+    if fast:
+        record = state.get_cluster_from_name(cluster_name)
+        if record is not None and \
+                record['status'] == status_lib.ClusterStatus.UP:
+            stages = [Stage.OPTIMIZE, Stage.PROVISION,
+                      Stage.SYNC_WORKDIR, Stage.EXEC]
+    return _execute(task, cluster_name=cluster_name, stages=stages,
+                    dryrun=dryrun, stream_logs=stream_logs,
+                    detach_run=detach_run,
+                    optimize_target=optimize_target,
+                    idle_minutes_to_autostop=idle_minutes_to_autostop,
+                    down=down, retry_until_up=retry_until_up,
+                    quiet_optimizer=quiet_optimizer)
+
+
+def exec_(task: Task, cluster_name: str, *,
+          dryrun: bool = False,
+          detach_run: bool = False):
+    """Run on an existing UP cluster: SYNC_WORKDIR + EXEC only, no
+    setup re-run (reference ``sky/execution.py:553,636``)."""
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist. Use launch '
+            'first.')
+    if record['status'] != status_lib.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is '
+            f'{record["status"].value}, not UP.',
+            cluster_status=record['status'])
+    return _execute(task, cluster_name=cluster_name,
+                    stages=[Stage.SYNC_WORKDIR, Stage.EXEC],
+                    dryrun=dryrun, detach_run=detach_run)

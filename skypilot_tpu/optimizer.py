@@ -115,11 +115,14 @@ def _enumerate_candidates(task: Task,
     out: List[_Candidate] = []
     for res in task.resources:
         if res.accelerator is None:
-            # CPU-only VM (controller-class).
+            # CPU-only VM (controller-class) — or a local fake
+            # cluster; keep an explicitly chosen cloud.
             price = _CPU_VM_SPOT_PRICE_HOUR if res.use_spot \
                 else _CPU_VM_PRICE_HOUR
-            pinned = res.copy(cloud='gcp',
-                              region=res.region or 'us-central1')
+            default_region = ('local' if res.cloud == 'local'
+                              else 'us-central1')
+            pinned = res.copy(cloud=res.cloud or 'gcp',
+                              region=res.region or default_region)
             if not _is_blocked(pinned, blocked):
                 out.append(_Candidate(pinned, price * task.num_nodes,
                                       runtime))

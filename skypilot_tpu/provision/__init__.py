@@ -1,0 +1,92 @@
+"""Cloud-neutral provisioning interface (analog of
+``sky/provision/__init__.py:33-120``).
+
+Every function dispatches on ``provider`` to
+``skypilot_tpu.provision.<provider>.instance``. Providers: ``gcp``
+(TPU VM/pod slices via tpu.googleapis.com) and ``local`` (fake cloud
+for tests: hosts are agent processes on localhost ports — the
+in-process fake the reference lacks, SURVEY.md §4.5).
+"""
+import functools
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionConfig,
+                                           ProvisionRecord)
+
+_PROVIDERS = ('gcp', 'local')
+
+
+def _impl(provider: str):
+    if provider not in _PROVIDERS:
+        raise ValueError(f'Unknown provider {provider!r}; choose from '
+                         f'{_PROVIDERS}')
+    return importlib.import_module(
+        f'skypilot_tpu.provision.{provider}.instance')
+
+
+def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
+    """Create networks/SAs/firewalls as needed; returns the possibly
+    augmented config."""
+    return _impl(config.provider).bootstrap_config(config)
+
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    return _impl(config.provider).run_instances(config)
+
+
+def wait_instances(provider: str, region: str,
+                   cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    _impl(provider).wait_instances(region, cluster_name_on_cloud, state)
+
+
+def get_cluster_info(provider: str, region: str,
+                     cluster_name_on_cloud: str) -> ClusterInfo:
+    return _impl(provider).get_cluster_info(region,
+                                            cluster_name_on_cloud)
+
+
+def query_instances(provider: str, region: str,
+                    cluster_name_on_cloud: str) -> Dict[str, Any]:
+    """instance_id -> status string."""
+    return _impl(provider).query_instances(region,
+                                           cluster_name_on_cloud)
+
+
+def stop_instances(provider: str, region: str,
+                   cluster_name_on_cloud: str) -> None:
+    _impl(provider).stop_instances(region, cluster_name_on_cloud)
+
+
+def terminate_instances(provider: str, region: str,
+                        cluster_name_on_cloud: str) -> None:
+    _impl(provider).terminate_instances(region, cluster_name_on_cloud)
+
+
+def open_ports(provider: str, region: str, cluster_name_on_cloud: str,
+               ports: List[str]) -> None:
+    _impl(provider).open_ports(region, cluster_name_on_cloud, ports)
+
+
+def cleanup_ports(provider: str, region: str,
+                  cluster_name_on_cloud: str) -> None:
+    _impl(provider).cleanup_ports(region, cluster_name_on_cloud)
+
+
+__all__ = [
+    'ClusterInfo',
+    'InstanceInfo',
+    'ProvisionConfig',
+    'ProvisionRecord',
+    'bootstrap_config',
+    'cleanup_ports',
+    'get_cluster_info',
+    'open_ports',
+    'query_instances',
+    'run_instances',
+    'stop_instances',
+    'terminate_instances',
+    'wait_instances',
+]

@@ -1,0 +1,73 @@
+"""Provisioner shared dataclasses (analog of
+``sky/provision/common.py``)."""
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider needs to create one cluster (slice)."""
+    provider: str                     # 'gcp' | 'local'
+    region: str
+    zone: Optional[str]
+    cluster_name: str                 # display name
+    cluster_name_on_cloud: str        # mangled, user-hash suffixed
+    # From Resources.make_deploy_variables.
+    node_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    count: int = 1                    # slices (each spans num_hosts)
+    ports_to_open: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances."""
+    provider: str
+    region: str
+    zone: Optional[str]
+    cluster_name_on_cloud: str
+    resumed: bool = False             # existing instances reused
+    created_instance_ids: List[str] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One host of the slice."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    agent_port: int = 8790
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """All hosts + which one is head (host 0 of the slice)."""
+    provider: str
+    instances: List[InstanceInfo]
+    head_instance_id: Optional[str] = None
+    ssh_user: str = 'root'
+    ssh_key_path: Optional[str] = None
+    custom_metadata: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def head(self) -> InstanceInfo:
+        for inst in self.instances:
+            if inst.instance_id == self.head_instance_id:
+                return inst
+        return self.instances[0]
+
+    def ips(self, internal: bool = True) -> List[str]:
+        """Rank-ordered IPs, head first."""
+        head = self.head
+        rest = [i for i in self.instances
+                if i.instance_id != head.instance_id]
+        ordered = [head] + rest
+        if internal:
+            return [i.internal_ip for i in ordered]
+        return [i.external_ip or i.internal_ip for i in ordered]
+
+    def num_hosts(self) -> int:
+        return len(self.instances)

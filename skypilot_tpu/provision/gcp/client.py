@@ -1,0 +1,173 @@
+"""Minimal GCP REST client (TPU API v2) — no cloud SDK dependency.
+
+The reference talks to ``tpu.googleapis.com`` v2alpha1 through
+googleapiclient (``sky/provision/gcp/instance_utils.py:1191-1657``);
+this image vendors no cloud SDKs (and the adaptor LazyImport trick,
+``sky/adaptors/common.py:8``, exists precisely because SDKs are
+optional), so we speak REST directly over urllib.
+
+Auth order: GOOGLE_APPLICATION_CREDENTIALS access-token file is NOT
+supported (signing JWTs needs crypto libs) — instead:
+  1. ``gcloud auth print-access-token`` (operator laptops)
+  2. GCE/TPU-VM metadata server (on-cloud identity)
+"""
+import json
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+TPU_API = 'https://tpu.googleapis.com/v2'
+COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+_METADATA_TOKEN_URL = ('http://metadata.google.internal/computeMetadata'
+                       '/v1/instance/service-accounts/default/token')
+_METADATA_PROJECT_URL = ('http://metadata.google.internal/'
+                         'computeMetadata/v1/project/project-id')
+
+_token_cache: Dict[str, Any] = {}
+
+
+def get_access_token() -> str:
+    now = time.time()
+    if _token_cache.get('expiry', 0) - 60 > now:
+        return _token_cache['token']
+    token = _token_from_gcloud() or _token_from_metadata()
+    if token is None:
+        raise exceptions.InvalidCloudConfigError(
+            'No GCP credentials: install gcloud and run '
+            '`gcloud auth login`, or run on a GCE/TPU VM with a '
+            'service account.')
+    _token_cache.update(token)
+    return _token_cache['token']
+
+
+def _token_from_gcloud() -> Optional[Dict[str, Any]]:
+    try:
+        out = subprocess.run(['gcloud', 'auth', 'print-access-token'],
+                             capture_output=True, text=True, timeout=30,
+                             check=False)
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return {'token': out.stdout.strip(), 'expiry': time.time() + 1800}
+
+
+def _token_from_metadata() -> Optional[Dict[str, Any]]:
+    req = urllib.request.Request(_METADATA_TOKEN_URL,
+                                 headers={'Metadata-Flavor': 'Google'})
+    try:
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            data = json.loads(resp.read())
+        return {'token': data['access_token'],
+                'expiry': time.time() + data.get('expires_in', 600)}
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def get_project_id() -> str:
+    from skypilot_tpu import config as config_lib
+    project = config_lib.get_nested(('gcp', 'project_id'), None)
+    if project:
+        return project
+    try:
+        out = subprocess.run(
+            ['gcloud', 'config', 'get-value', 'project'],
+            capture_output=True, text=True, timeout=30, check=False)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        pass
+    req = urllib.request.Request(_METADATA_PROJECT_URL,
+                                 headers={'Metadata-Flavor': 'Google'})
+    try:
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            return resp.read().decode()
+    except (urllib.error.URLError, OSError):
+        pass
+    raise exceptions.InvalidCloudConfigError(
+        'GCP project id not found: set gcp.project_id in '
+        '~/.skypilot_tpu/config.yaml or configure gcloud.')
+
+
+def request(method: str, url: str,
+            body: Optional[Dict[str, Any]] = None,
+            timeout: float = 60.0) -> Dict[str, Any]:
+    """One authenticated JSON request; raises typed errors on 4xx/5xx
+    with TPU-aware stockout/quota classification."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={
+            'Authorization': f'Bearer {get_access_token()}',
+            'Content-Type': 'application/json',
+        })
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+            return json.loads(payload) if payload else {}
+    except urllib.error.HTTPError as e:
+        raise classify_http_error(e) from e
+
+
+def classify_http_error(e: 'urllib.error.HTTPError') -> Exception:
+    """Map GCP errors to the failover taxonomy (model:
+    ``FailoverCloudErrorHandlerV2._gcp_handler``,
+    ``sky/backends/cloud_vm_ray_backend.py:968-1030``): stockout →
+    blocklist zone; quota → blocklist region; permission/config → no
+    failover."""
+    try:
+        detail = json.loads(e.read()).get('error', {})
+    except (ValueError, AttributeError):
+        detail = {}
+    message = detail.get('message', str(e))
+    status = detail.get('status', '')
+    lowered = message.lower()
+    if e.code == 429 or status == 'RESOURCE_EXHAUSTED' or \
+            'quota' in lowered:
+        if 'out of stock' in lowered or 'no more capacity' in lowered \
+                or 'not enough resources' in lowered or \
+                'insufficient capacity' in lowered or \
+                'stockout' in lowered:
+            return exceptions.StockoutError(message, http_code=e.code,
+                                            reason=status)
+        return exceptions.QuotaExceededError(message, http_code=e.code,
+                                             reason=status)
+    if status == 'UNAVAILABLE' or e.code in (500, 503):
+        return exceptions.StockoutError(message, http_code=e.code,
+                                        reason=status)
+    if e.code in (401, 403):
+        return exceptions.InvalidCloudConfigError(message)
+    return exceptions.ApiError(message, http_code=e.code,
+                               reason=status)
+
+
+def wait_operation(op_url: str, timeout: float = 1800.0,
+                   interval: float = 5.0) -> Dict[str, Any]:
+    """Poll a long-running operation until done (model:
+    ``instance_utils.py:1217`` operation polling)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        op = request('GET', op_url)
+        if op.get('done'):
+            err = op.get('error')
+            if err:
+                msg = err.get('message', str(err))
+                lowered = msg.lower()
+                if 'no more capacity' in lowered or \
+                        'out of stock' in lowered or \
+                        'resources are insufficient' in lowered or \
+                        'try a different zone' in lowered:
+                    raise exceptions.StockoutError(msg)
+                if 'quota' in lowered:
+                    raise exceptions.QuotaExceededError(msg)
+                raise exceptions.ApiError(msg)
+            return op
+        time.sleep(interval)
+    raise exceptions.ApiError(f'Operation timed out: {op_url}')

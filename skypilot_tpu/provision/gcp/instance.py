@@ -1,0 +1,265 @@
+"""GCP TPU slice lifecycle over the TPU v2 REST API.
+
+Model: ``GCPTPUVMInstance`` in the reference
+(``sky/provision/gcp/instance_utils.py:1191-1657``): create a TPU VM
+or multi-host pod as ONE ``nodes.create`` call (the slice is the
+atomic gang — no per-VM orchestration), poll the operation, read the
+per-host ``networkEndpoints`` for rank-ordered IPs, map
+stockout/quota errors for the failover engine.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionConfig,
+                                           ProvisionRecord)
+from skypilot_tpu.provision.gcp import client as gcp_client
+
+logger = tpu_logging.init_logger(__name__)
+
+_LABEL_CLUSTER = 'skytpu-cluster'
+
+
+def _node_url(project: str, zone: str, node_id: str = '') -> str:
+    base = (f'{gcp_client.TPU_API}/projects/{project}/locations/'
+            f'{zone}/nodes')
+    return f'{base}/{node_id}' if node_id else base
+
+
+def _pick_zone(config: ProvisionConfig) -> str:
+    if config.zone:
+        return config.zone
+    # Region given: callers (the failover engine) normally iterate
+    # zones explicitly; default to -a.
+    return f'{config.region}-a'
+
+
+def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
+    """Network/SA bootstrap. TPU VMs attach to the 'default' network
+    unless configured; firewall for the agent port is handled in
+    open_ports."""
+    return config
+
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    project = gcp_client.get_project_id()
+    zone = _pick_zone(config)
+    node_id = config.cluster_name_on_cloud
+    node_cfg = config.node_config
+
+    existing = _get_node(project, zone, node_id)
+    if existing is not None:
+        state = existing.get('state')
+        if state == 'READY':
+            logger.info('TPU node %s already READY; reusing.', node_id)
+            return ProvisionRecord(
+                provider='gcp', region=config.region, zone=zone,
+                cluster_name_on_cloud=node_id, resumed=True,
+                created_instance_ids=[node_id])
+        if state in ('STOPPED',):
+            logger.info('Starting stopped TPU node %s', node_id)
+            op = gcp_client.request(
+                'POST', _node_url(project, zone, node_id) + ':start')
+            gcp_client.wait_operation(
+                f'{gcp_client.TPU_API}/{op["name"]}')
+            return ProvisionRecord(
+                provider='gcp', region=config.region, zone=zone,
+                cluster_name_on_cloud=node_id, resumed=True,
+                created_instance_ids=[node_id])
+
+    body: Dict[str, Any] = {
+        'acceleratorType': node_cfg['accelerator_type'],
+        'runtimeVersion': node_cfg['runtime_version'],
+        'networkConfig': {
+            'network': node_cfg.get('network', 'default'),
+            'enableExternalIps': True,
+        },
+        'labels': {_LABEL_CLUSTER: node_id,
+                   **(node_cfg.get('labels') or {})},
+        'metadata': {
+            'ssh-keys': node_cfg.get('ssh_public_key', ''),
+        },
+        'schedulingConfig': {
+            'preemptible': bool(node_cfg.get('use_spot', False)),
+        },
+        'tags': ['skytpu'],
+    }
+    if node_cfg.get('disk_size'):
+        body['dataDisks'] = []  # boot disk size fixed for TPU VMs
+    logger.info('Creating TPU %s (%s) in %s',
+                node_id, node_cfg['accelerator_type'], zone)
+    op = gcp_client.request(
+        'POST', _node_url(project, zone) + f'?nodeId={node_id}', body)
+    gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
+    return ProvisionRecord(provider='gcp', region=config.region,
+                           zone=zone, cluster_name_on_cloud=node_id,
+                           created_instance_ids=[node_id])
+
+
+def _get_node(project: str, zone: str,
+              node_id: str) -> Optional[Dict[str, Any]]:
+    try:
+        return gcp_client.request('GET',
+                                  _node_url(project, zone, node_id))
+    except exceptions.ApiError as e:
+        if e.http_code == 404:
+            return None
+        raise
+    except exceptions.SkyTpuError:
+        raise
+
+
+def _find_node(region: str,
+               cluster_name_on_cloud: str
+               ) -> Optional[Dict[str, Any]]:
+    """Search the region's zones for the node (zone may have been
+    chosen by failover)."""
+    project = gcp_client.get_project_id()
+    for suffix in ('a', 'b', 'c', 'd', 'f'):
+        zone = f'{region}-{suffix}'
+        try:
+            node = _get_node(project, zone, cluster_name_on_cloud)
+        except exceptions.SkyTpuError:
+            continue
+        if node is not None:
+            node['_zone'] = zone
+            return node
+    return None
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    target = state or 'READY'
+    deadline = time.time() + 1800
+    while time.time() < deadline:
+        node = _find_node(region, cluster_name_on_cloud)
+        if node is None:
+            raise exceptions.FetchClusterInfoError(
+                f'TPU {cluster_name_on_cloud} not found in {region}')
+        if node.get('state') == target:
+            return
+        time.sleep(10)
+    raise exceptions.ApiError(
+        f'TPU {cluster_name_on_cloud} did not reach {target}')
+
+
+def get_cluster_info(region: str,
+                     cluster_name_on_cloud: str) -> ClusterInfo:
+    node = _find_node(region, cluster_name_on_cloud)
+    if node is None:
+        raise exceptions.FetchClusterInfoError(
+            f'TPU {cluster_name_on_cloud} not found in {region}')
+    endpoints = node.get('networkEndpoints', [])
+    instances: List[InstanceInfo] = []
+    for i, ep in enumerate(endpoints):
+        external = None
+        access = ep.get('accessConfig') or {}
+        if access.get('externalIp'):
+            external = access['externalIp']
+        instances.append(InstanceInfo(
+            instance_id=f'{cluster_name_on_cloud}-w{i}',
+            internal_ip=ep.get('ipAddress', ''),
+            external_ip=external,
+            tags={'zone': node.get('_zone', '')},
+        ))
+    if not instances:
+        raise exceptions.FetchClusterInfoError(
+            f'TPU {cluster_name_on_cloud} has no network endpoints')
+    return ClusterInfo(
+        provider='gcp', instances=instances,
+        head_instance_id=instances[0].instance_id,
+        custom_metadata={'zone': node.get('_zone'),
+                         'state': node.get('state'),
+                         'accelerator_type':
+                             node.get('acceleratorType')})
+
+
+def query_instances(region: str,
+                    cluster_name_on_cloud: str) -> Dict[str, Any]:
+    node = _find_node(region, cluster_name_on_cloud)
+    if node is None:
+        return {}
+    # One atomic slice: a single logical 'instance'.
+    state_map = {
+        'READY': 'running',
+        'CREATING': 'pending',
+        'STARTING': 'pending',
+        'RESTARTING': 'pending',
+        'STOPPED': 'stopped',
+        'STOPPING': 'stopping',
+        'DELETING': 'terminated',
+        'PREEMPTED': 'terminated',
+        'TERMINATED': 'terminated',
+    }
+    return {cluster_name_on_cloud:
+            state_map.get(node.get('state', ''), 'unknown')}
+
+
+def stop_instances(region: str, cluster_name_on_cloud: str) -> None:
+    node = _find_node(region, cluster_name_on_cloud)
+    if node is None:
+        return
+    if len(node.get('networkEndpoints', [])) > 1:
+        raise exceptions.NotSupportedError(
+            'TPU pods cannot be stopped, only terminated (reference '
+            'constraint: sky/clouds/gcp.py:193-203).')
+    project = gcp_client.get_project_id()
+    op = gcp_client.request(
+        'POST',
+        _node_url(project, node['_zone'], cluster_name_on_cloud) +
+        ':stop')
+    gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
+
+
+def terminate_instances(region: str,
+                        cluster_name_on_cloud: str) -> None:
+    node = _find_node(region, cluster_name_on_cloud)
+    if node is None:
+        return
+    project = gcp_client.get_project_id()
+    op = gcp_client.request(
+        'DELETE',
+        _node_url(project, node['_zone'], cluster_name_on_cloud))
+    gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
+
+
+def open_ports(region: str, cluster_name_on_cloud: str,
+               ports: List[str]) -> None:
+    """Create a firewall rule for the requested ports on the 'skytpu'
+    network tag."""
+    project = gcp_client.get_project_id()
+    rule_name = f'skytpu-{cluster_name_on_cloud}-ports'
+    body = {
+        'name': rule_name,
+        'network': f'projects/{project}/global/networks/default',
+        'direction': 'INGRESS',
+        'allowed': [{
+            'IPProtocol': 'tcp',
+            'ports': [str(p) for p in ports],
+        }],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': ['skytpu'],
+    }
+    try:
+        gcp_client.request(
+            'POST',
+            f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
+            'firewalls', body)
+    except exceptions.ApiError as e:
+        if e.http_code != 409:  # already exists
+            raise
+
+
+def cleanup_ports(region: str, cluster_name_on_cloud: str) -> None:
+    project = gcp_client.get_project_id()
+    rule_name = f'skytpu-{cluster_name_on_cloud}-ports'
+    try:
+        gcp_client.request(
+            'DELETE',
+            f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
+            f'firewalls/{rule_name}')
+    except exceptions.ApiError as e:
+        if e.http_code != 404:
+            raise

@@ -1,0 +1,95 @@
+"""Runtime bring-up on real (SSH) clusters (analog of
+``sky/provision/instance_setup.py``).
+
+Ships the framework to every host (rsync, parallel — the reference
+ships a wheel per launch so remote==client version,
+``sky/backends/wheel_utils.py:140``; we rsync the package tree which
+has the same effect for a pure-source package), then starts the host
+agent on every host. The local fake provider skips all of this.
+"""
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.backends.backend import ClusterHandle
+from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.utils.command_runner import SSHCommandRunner
+
+logger = tpu_logging.init_logger(__name__)
+
+_SSH_USER_DEFAULT = 'skytpu'
+_SSH_KEY_PATH = '~/.ssh/sky-key'
+_REMOTE_PKG_DIR = '~/.skypilot_tpu/wheels/skypilot_tpu'
+_AGENT_PORT = 8790
+
+
+def _runners(handle: ClusterHandle) -> List[SSHCommandRunner]:
+    key = os.path.expanduser(_SSH_KEY_PATH)
+    if not os.path.exists(key):
+        key = None
+    return [
+        SSHCommandRunner(h.get('external_ip') or h['ip'],
+                         _SSH_USER_DEFAULT, key)
+        for h in handle.hosts
+    ]
+
+
+def _package_source_dir() -> str:
+    import skypilot_tpu
+    return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+
+def setup_runtime_on_cluster(handle: ClusterHandle) -> None:
+    """Parallel over hosts: ship package, start agent (idempotent)."""
+    src = _package_source_dir().rstrip('/') + '/'
+    runners = _runners(handle)
+
+    def one(runner: SSHCommandRunner) -> None:
+        runner.run(f'mkdir -p {os.path.dirname(_REMOTE_PKG_DIR)}')
+        runner.rsync(src, _REMOTE_PKG_DIR + '/', up=True)
+        # PYTHONPATH install (no pip dependency on the host image).
+        start = (
+            f'pgrep -f "skypilot_tpu.runtime.agent|host_agent" '
+            f'> /dev/null || ('
+            f'export PYTHONPATH={os.path.dirname(_REMOTE_PKG_DIR)}:'
+            f'$PYTHONPATH; '
+            f'nohup python3 -m skypilot_tpu.runtime.agent '
+            f'--port {_AGENT_PORT} '
+            f'>> ~/.skypilot_tpu/agent.log 2>&1 &)')
+        rc = runner.run(start)
+        if rc != 0:
+            logger.warning('agent start on %s returned %s', runner.ip,
+                           rc)
+
+    with ThreadPoolExecutor(max_workers=min(32,
+                                            len(runners))) as pool:
+        list(pool.map(one, runners))
+
+
+def sync_to_all_hosts(handle: ClusterHandle, source: str,
+                      target: str) -> None:
+    runners = _runners(handle)
+
+    def one(runner: SSHCommandRunner) -> None:
+        runner.run(f'mkdir -p {target}')
+        runner.rsync(source, target.rstrip('/') + '/', up=True)
+
+    with ThreadPoolExecutor(max_workers=min(32,
+                                            len(runners))) as pool:
+        list(pool.map(one, runners))
+
+
+def wait_for_ssh(handle: ClusterHandle, timeout: float = 600.0) -> None:
+    import time
+    runners = _runners(handle)
+    deadline = time.time() + timeout
+    pending = list(runners)
+    while pending and time.time() < deadline:
+        pending = [r for r in pending if not r.check_connection()]
+        if pending:
+            time.sleep(5)
+    if pending:
+        from skypilot_tpu import exceptions
+        raise exceptions.FetchClusterInfoError(
+            f'SSH not reachable on {[r.ip for r in pending]}')

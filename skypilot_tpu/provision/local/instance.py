@@ -1,0 +1,212 @@
+"""Local fake provider: 'hosts' are host-agent processes on localhost.
+
+The in-process fake cloud the reference never had (SURVEY.md §4.5's
+biggest-gap note): a cluster of N hosts is N agent processes on
+distinct localhost ports, so the entire provision → setup → gang-run
+→ autostop path is unit-testable on one machine. Also doubles as a
+failure-injection harness: set ``fail_marker`` in the node_config to
+make run_instances raise StockoutError (for failover tests).
+
+Metadata lives at ``$SKYTPU_STATE_DIR/local_clusters/<name>.json``.
+"""
+import json
+import os
+import signal
+import socket
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionConfig,
+                                           ProvisionRecord)
+from skypilot_tpu.runtime import agent_client
+
+
+def _meta_dir() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    path = os.path.join(base, 'local_clusters')
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _meta_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_meta_dir(), f'{cluster_name_on_cloud}.json')
+
+
+def _load(cluster_name_on_cloud: str) -> Optional[Dict[str, Any]]:
+    path = _meta_path(cluster_name_on_cloud)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding='utf-8') as f:
+        return json.load(f)
+
+
+def _save(cluster_name_on_cloud: str, meta: Dict[str, Any]) -> None:
+    with open(_meta_path(cluster_name_on_cloud), 'w',
+              encoding='utf-8') as f:
+        json.dump(meta, f)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
+    return config
+
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    node_config = config.node_config
+    # Failure injection for failover tests: a marker names zones/
+    # regions that are 'stocked out'.
+    fail_in = node_config.get('fail_in') or []
+    where = config.zone or config.region
+    if where in fail_in or config.region in fail_in:
+        raise exceptions.StockoutError(
+            f'[local] simulated stockout in {where}')
+
+    existing = _load(config.cluster_name_on_cloud)
+    if existing is not None and all(
+            _pid_alive(h['pid']) for h in existing['hosts']):
+        return ProvisionRecord(
+            provider='local', region=config.region, zone=config.zone,
+            cluster_name_on_cloud=config.cluster_name_on_cloud,
+            resumed=True,
+            created_instance_ids=[h['instance_id']
+                                  for h in existing['hosts']])
+
+    num_hosts = int(node_config.get('num_hosts', 1)) * config.count
+    runtime_base = os.path.join(_meta_dir(),
+                                config.cluster_name_on_cloud)
+    hosts = []
+    for i in range(num_hosts):
+        port = _free_port()
+        runtime_dir = os.path.join(runtime_base, f'host-{i}')
+        os.makedirs(runtime_dir, exist_ok=True)
+        proc = agent_client.start_local_agent(port,
+                                              runtime_dir=runtime_dir)
+        hosts.append({
+            'instance_id': f'{config.cluster_name_on_cloud}-{i}',
+            'pid': proc.pid,
+            'port': port,
+            'runtime_dir': runtime_dir,
+        })
+    meta = {
+        'cluster_name_on_cloud': config.cluster_name_on_cloud,
+        'region': config.region,
+        'zone': config.zone,
+        'hosts': hosts,
+        'created_at': time.time(),
+        'node_config': {k: v for k, v in node_config.items()
+                        if isinstance(v, (str, int, float, bool,
+                                          list, dict, type(None)))},
+    }
+    _save(config.cluster_name_on_cloud, meta)
+    return ProvisionRecord(
+        provider='local', region=config.region, zone=config.zone,
+        cluster_name_on_cloud=config.cluster_name_on_cloud,
+        created_instance_ids=[h['instance_id'] for h in hosts])
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, state
+    meta = _load(cluster_name_on_cloud)
+    if meta is None:
+        raise exceptions.FetchClusterInfoError(
+            f'no such local cluster {cluster_name_on_cloud}')
+    for h in meta['hosts']:
+        agent_client.AgentClient('127.0.0.1', h['port']).wait_healthy(
+            timeout=30)
+
+
+def get_cluster_info(region: str,
+                     cluster_name_on_cloud: str) -> ClusterInfo:
+    del region
+    meta = _load(cluster_name_on_cloud)
+    if meta is None:
+        raise exceptions.FetchClusterInfoError(
+            f'no such local cluster {cluster_name_on_cloud}')
+    instances = [
+        InstanceInfo(instance_id=h['instance_id'],
+                     internal_ip='127.0.0.1',
+                     external_ip='127.0.0.1',
+                     agent_port=h['port'],
+                     tags={'runtime_dir': h['runtime_dir']})
+        for h in meta['hosts']
+    ]
+    return ClusterInfo(provider='local', instances=instances,
+                       head_instance_id=instances[0].instance_id,
+                       custom_metadata={'hosts': meta['hosts']})
+
+
+def query_instances(region: str,
+                    cluster_name_on_cloud: str) -> Dict[str, Any]:
+    del region
+    meta = _load(cluster_name_on_cloud)
+    if meta is None:
+        return {}
+    return {
+        h['instance_id']:
+            ('running' if _pid_alive(h['pid']) else 'terminated')
+        for h in meta['hosts']
+    }
+
+
+def stop_instances(region: str, cluster_name_on_cloud: str) -> None:
+    # Local 'hosts' cannot be stopped-and-resumed; treat as terminate
+    # but keep metadata (mirrors TPU pods, which cannot stop either —
+    # reference sky/clouds/gcp.py:193-203).
+    _kill_agents(cluster_name_on_cloud)
+
+
+def terminate_instances(region: str,
+                        cluster_name_on_cloud: str) -> None:
+    del region
+    _kill_agents(cluster_name_on_cloud)
+    try:
+        os.remove(_meta_path(cluster_name_on_cloud))
+    except FileNotFoundError:
+        pass
+    # Remove the runtime base so any surviving skylet notices and
+    # exits (it was started via the agent's /exec under its own
+    # session, so the agent killpg may not reach it).
+    import shutil
+    shutil.rmtree(os.path.join(_meta_dir(), cluster_name_on_cloud),
+                  ignore_errors=True)
+
+
+def _kill_agents(cluster_name_on_cloud: str) -> None:
+    meta = _load(cluster_name_on_cloud)
+    if meta is None:
+        return
+    for h in meta['hosts']:
+        if _pid_alive(h['pid']):
+            try:
+                os.killpg(os.getpgid(h['pid']), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(h['pid'], signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+
+def open_ports(region: str, cluster_name_on_cloud: str,
+               ports) -> None:
+    del region, cluster_name_on_cloud, ports
+
+
+def cleanup_ports(region: str, cluster_name_on_cloud: str) -> None:
+    del region, cluster_name_on_cloud

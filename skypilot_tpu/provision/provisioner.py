@@ -1,0 +1,168 @@
+"""Provision orchestration + the failover retry engine.
+
+``bulk_provision`` (model: ``sky/provision/provisioner.py:100``)
+drives bootstrap → run → wait for one placement and tears down on
+failure. ``RetryingProvisioner`` (model: ``RetryingVmProvisioner``,
+``sky/backends/cloud_vm_ray_backend.py:1156-2120``) walks candidate
+regions/zones cheapest-first, accumulating a blocklist at the right
+granularity from typed errors:
+
+    StockoutError            -> blocklist the zone      (common case!)
+    QuotaExceededError       -> blocklist the region
+    InvalidCloudConfigError  -> abort, no failover
+
+TPU scarcity makes this engine the product (SURVEY.md §7 hard part
+#1): a v5p region can be stocked out for hours while the next region
+has capacity.
+"""
+import dataclasses
+from typing import List, Optional, Set, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.provision.common import (ClusterInfo,
+                                           ProvisionConfig,
+                                           ProvisionRecord)
+from skypilot_tpu.resources import Resources
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def bulk_provision(config: ProvisionConfig) -> ProvisionRecord:
+    """bootstrap → run → wait; teardown on partial failure."""
+    config = provision.bootstrap_config(config)
+    try:
+        record = provision.run_instances(config)
+        provision.wait_instances(config.provider, config.region,
+                                 config.cluster_name_on_cloud)
+        return record
+    except exceptions.SkyTpuError:
+        # Leave no half-created slice behind (model:
+        # provisioner.teardown_cluster on failure, `:199`).
+        try:
+            provision.terminate_instances(
+                config.provider, config.region,
+                config.cluster_name_on_cloud)
+        except exceptions.SkyTpuError:
+            logger.warning('cleanup after failed provision also '
+                           'failed for %s',
+                           config.cluster_name_on_cloud)
+        raise
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    record: ProvisionRecord
+    cluster_info: ClusterInfo
+    final_resources: Resources  # region/zone filled in
+
+
+class RetryingProvisioner:
+    """Failover across zones → regions for one Resources request."""
+
+    def __init__(self,
+                 blocked_resources: Optional[Set[Resources]] = None):
+        self.blocked_resources: Set[Resources] = \
+            set(blocked_resources or set())
+        self.failover_history: List[Exception] = []
+
+    def _candidate_placements(
+            self, to_provision: Resources
+    ) -> List[Tuple[str, Optional[str]]]:
+        """(region, zone) pairs to try, cheapest region first."""
+        if to_provision.cloud == 'local' or \
+                to_provision.accelerator is None:
+            extra = getattr(to_provision, '_extra_config', None) or {}
+            if 'regions' in extra:  # test harness: fake region list
+                return [(r, None) for r in extra['regions']]
+            region = to_provision.region or 'local'
+            return [(region, to_provision.zone)]
+        accel = to_provision.accelerator
+        if to_provision.region is not None:
+            regions = [to_provision.region]
+        else:
+            regions = catalog.get_regions(accel,
+                                          to_provision.use_spot)
+        out: List[Tuple[str, Optional[str]]] = []
+        for region in regions:
+            if to_provision.zone is not None:
+                out.append((region, to_provision.zone))
+                continue
+            for zone in catalog.get_zones(accel, region):
+                out.append((region, zone))
+        return out
+
+    def _is_blocked(self, res: Resources) -> bool:
+        from skypilot_tpu import optimizer
+        return optimizer._is_blocked(  # pylint: disable=protected-access
+            res, self.blocked_resources)
+
+    def provision_with_retries(
+            self, to_provision: Resources, cluster_name: str,
+            cluster_name_on_cloud: str, num_nodes: int
+    ) -> ProvisionResult:
+        provider = to_provision.cloud or 'gcp'
+        placements = self._candidate_placements(to_provision)
+        if not placements:
+            raise exceptions.ResourcesUnavailableError(
+                f'No placement candidates for {to_provision!r}',
+                self.failover_history)
+        for (region, zone) in placements:
+            attempt = to_provision.copy(region=region, zone=zone)
+            if self._is_blocked(attempt):
+                continue
+            node_config = {}
+            if to_provision.accelerator is not None:
+                node_config = attempt.make_deploy_variables(
+                    cluster_name_on_cloud)
+            else:
+                node_config = {'num_hosts': 1}
+            # Thread through provider-specific extras (e.g. the local
+            # provider's failure injection set by tests).
+            node_config.update(getattr(to_provision, '_extra_config',
+                                       None) or {})
+            config = ProvisionConfig(
+                provider=provider, region=region, zone=zone,
+                cluster_name=cluster_name,
+                cluster_name_on_cloud=cluster_name_on_cloud,
+                node_config=node_config, count=num_nodes,
+                ports_to_open=list(to_provision.ports or []))
+            where = zone or region
+            try:
+                record = bulk_provision(config)
+            except exceptions.StockoutError as e:
+                logger.warning('Stockout in %s: %s — blocklisting '
+                               'zone, trying next.', where, e)
+                self.failover_history.append(e)
+                self.blocked_resources.add(
+                    to_provision.copy(region=region, zone=zone))
+                continue
+            except exceptions.QuotaExceededError as e:
+                logger.warning('Quota exhausted in %s: %s — '
+                               'blocklisting region.', region, e)
+                self.failover_history.append(e)
+                self.blocked_resources.add(
+                    to_provision.copy(region=region, zone=None))
+                continue
+            except exceptions.InvalidCloudConfigError as e:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Cloud configuration error: {e}',
+                    self.failover_history, no_failover=True) from e
+            except exceptions.ApiError as e:
+                logger.warning('Provision error in %s: %s — trying '
+                               'next placement.', where, e)
+                self.failover_history.append(e)
+                continue
+            info = provision.get_cluster_info(provider, region,
+                                              cluster_name_on_cloud)
+            final = to_provision.copy(region=record.region,
+                                      zone=record.zone)
+            return ProvisionResult(record=record, cluster_info=info,
+                                   final_resources=final)
+        raise exceptions.ResourcesUnavailableError(
+            f'Failed to provision {to_provision!r} in all '
+            f'{len(placements)} candidate placement(s). History: '
+            f'{[str(e) for e in self.failover_history]}',
+            self.failover_history)

@@ -118,17 +118,21 @@ class Resources:
         self._accelerator = catalog.canonicalize(accelerators)
 
     def _validate(self) -> None:
-        if self._cloud is not None and self._cloud not in ('gcp',):
+        if self._cloud is not None and self._cloud not in ('gcp',
+                                                           'local'):
             raise exceptions.InvalidSpecError(
                 f'Unsupported cloud {self._cloud!r}; this framework is '
-                "TPU-native and currently supports only 'gcp'.")
+                "TPU-native and currently supports 'gcp' (and 'local' "
+                'for the in-process fake provider).')
         if self._spot_recovery not in SPOT_RECOVERY_STRATEGIES:
             raise exceptions.InvalidSpecError(
                 f'Invalid spot_recovery {self._spot_recovery!r}; choose '
                 f'from {SPOT_RECOVERY_STRATEGIES}')
         if self._accelerator is not None:
-            catalog.validate_region_zone(self._accelerator, self._region,
-                                         self._zone)
+            if self._cloud != 'local':
+                # Local fake provider accepts any region string.
+                catalog.validate_region_zone(self._accelerator,
+                                             self._region, self._zone)
             spec = self.tpu_spec
             assert spec is not None
             if spec.is_pod and self._use_spot and \
@@ -272,7 +276,13 @@ class Resources:
             labels=self._labels,
         )
         fields.update(override)
-        return Resources(**fields)
+        new = Resources(**fields)
+        # Provider-specific extras (e.g. the local fake provider's
+        # num_hosts / failure-injection config) survive copies.
+        extra = getattr(self, '_extra_config', None)
+        if extra is not None:
+            new._extra_config = dict(extra)
+        return new
 
     # -- provisioner handoff -------------------------------------------
 

@@ -1,0 +1,35 @@
+"""Head-node self-termination entry (autostop's stop command).
+
+The reference's AutostopEvent mutates the cluster YAML and invokes the
+provisioner from the head node (``sky/skylet/events.py:141,235``); the
+analog here is a tiny CLI the skylet's stored stop command runs:
+terminate (or stop) this cluster via the provision layer.
+"""
+import argparse
+
+from skypilot_tpu import provision
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--provider', required=True)
+    parser.add_argument('--region', required=True)
+    parser.add_argument('--cluster-name-on-cloud', required=True)
+    parser.add_argument('--down', action='store_true',
+                        help='terminate instead of stop')
+    args = parser.parse_args()
+    if args.down:
+        provision.terminate_instances(args.provider, args.region,
+                                      args.cluster_name_on_cloud)
+    else:
+        try:
+            provision.stop_instances(args.provider, args.region,
+                                     args.cluster_name_on_cloud)
+        except Exception:  # pylint: disable=broad-except
+            # Pods cannot stop; fall back to terminate.
+            provision.terminate_instances(args.provider, args.region,
+                                          args.cluster_name_on_cloud)
+
+
+if __name__ == '__main__':
+    main()

@@ -43,7 +43,13 @@ def main():
     scheduler = job_lib.FIFOScheduler()
     logger.info('skylet started (interval %.1fs, runtime dir %s)',
                 args.interval, job_lib.runtime_dir())
+    import os
     while True:
+        if not os.path.isdir(job_lib.runtime_dir()):
+            # Cluster torn down underneath us (local fake provider
+            # removes the runtime dir on terminate).
+            logger.info('runtime dir gone; skylet exiting')
+            return
         run_once(scheduler)
         time.sleep(args.interval)
 

@@ -1,0 +1,197 @@
+"""SSH/rsync command runners (analog of
+``sky/utils/command_runner.py:426-683``).
+
+ControlMaster connection reuse, proxy support, and an rsync wrapper —
+the client→cluster control plane (SURVEY.md §2.12 plane 1). The local
+fake provider bypasses SSH entirely (agents are already local), so
+these are exercised on real clusters only.
+"""
+import hashlib
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+_ssh_control_dir = os.path.expanduser('~/.skypilot_tpu/ssh_control')
+
+
+def ssh_options_list(ssh_private_key: Optional[str],
+                     control_name: Optional[str],
+                     *, connect_timeout: int = 30,
+                     port: int = 22) -> List[str]:
+    opts = [
+        '-o', 'StrictHostKeyChecking=no',
+        '-o', 'UserKnownHostsFile=/dev/null',
+        '-o', 'IdentitiesOnly=yes',
+        '-o', f'ConnectTimeout={connect_timeout}s',
+        '-o', 'ServerAliveInterval=5',
+        '-o', 'ServerAliveCountMax=3',
+        '-o', 'LogLevel=ERROR',
+        '-p', str(port),
+    ]
+    if ssh_private_key:
+        opts += ['-i', ssh_private_key]
+    if control_name:
+        os.makedirs(_ssh_control_dir, exist_ok=True)
+        control_path = os.path.join(_ssh_control_dir, control_name)
+        opts += [
+            '-o', 'ControlMaster=auto',
+            '-o', f'ControlPath={control_path}/%C',
+            '-o', 'ControlPersist=300s',
+        ]
+        os.makedirs(control_path, exist_ok=True)
+    return opts
+
+
+class SSHCommandRunner:
+    """Runs commands / rsyncs files on one remote host."""
+
+    def __init__(self, ip: str, ssh_user: str,
+                 ssh_private_key: Optional[str],
+                 port: int = 22):
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = ssh_private_key
+        self.port = port
+        digest = hashlib.md5(
+            f'{ssh_user}@{ip}:{port}'.encode()).hexdigest()[:10]
+        self._control_name = f'cm-{digest}'
+
+    def _ssh_base(self) -> List[str]:
+        return ['ssh'] + ssh_options_list(
+            self.ssh_private_key, self._control_name,
+            port=self.port) + [f'{self.ssh_user}@{self.ip}']
+
+    def run(self, cmd: Union[str, List[str]], *,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None
+            ) -> Union[int, Tuple[int, str, str]]:
+        if isinstance(cmd, list):
+            cmd = ' '.join(cmd)
+        full = self._ssh_base() + [
+            'bash', '--login', '-c',
+            shlex.quote(f'true && export OMP_NUM_THREADS=1; {cmd}')
+        ]
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        if log_path != '/dev/null':
+            with open(os.path.expanduser(log_path), 'a',
+                      encoding='utf-8') as f:
+                f.write(proc.stdout)
+                f.write(proc.stderr)
+        if stream_logs:
+            print(proc.stdout, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def check_connection(self) -> bool:
+        try:
+            rc = self.run('true', timeout=15)
+        except subprocess.TimeoutExpired:
+            return False
+        return rc == 0
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        """Sync a file/dir. up=True: local → remote. Falls back to a
+        tar-over-ssh pipe when rsync is not installed locally."""
+        import shutil as _shutil
+        remote = f'{self.ssh_user}@{self.ip}'
+        if _shutil.which('rsync'):
+            ssh_cmd = ' '.join(
+                ['ssh'] + [shlex.quote(o) for o in ssh_options_list(
+                    self.ssh_private_key, self._control_name,
+                    port=self.port)])
+            rsync_cmd = [
+                'rsync', '-az', '--delete-excluded',
+                '--exclude', '.git/',
+                '--exclude', '__pycache__/',
+                '-e', ssh_cmd,
+            ]
+            if up:
+                rsync_cmd += [source, f'{remote}:{target}']
+            else:
+                rsync_cmd += [f'{remote}:{source}', target]
+            proc = subprocess.run(rsync_cmd, capture_output=True,
+                                  text=True, check=False)
+        else:
+            ssh_prefix = ' '.join(
+                ['ssh'] + [shlex.quote(o) for o in ssh_options_list(
+                    self.ssh_private_key, self._control_name,
+                    port=self.port)] + [remote])
+            if up:
+                pipe = (
+                    f'tar -C {shlex.quote(source)} '
+                    "--exclude='.git' --exclude='__pycache__' "
+                    f'-cf - . | {ssh_prefix} '
+                    f'"mkdir -p {target} && tar -C {target} -xf -"')
+            else:
+                pipe = (f'{ssh_prefix} "tar -C {source} -cf - ." | '
+                        f'mkdir -p {shlex.quote(target)} && '
+                        f'tar -C {shlex.quote(target)} -xf -')
+            proc = subprocess.run(['/bin/bash', '-c', pipe],
+                                  capture_output=True, text=True,
+                                  check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, 'rsync/tar-sync',
+                f'sync failed: {proc.stderr[-500:]}')
+
+
+class LocalCommandRunner:
+    """Same interface against localhost (local fake provider)."""
+
+    def __init__(self, ip: str = '127.0.0.1'):
+        self.ip = ip
+
+    def run(self, cmd: Union[str, List[str]], *,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None):
+        if isinstance(cmd, list):
+            cmd = ' '.join(cmd)
+        proc = subprocess.run(['/bin/bash', '-c', cmd],
+                              capture_output=True, text=True,
+                              timeout=timeout, check=False)
+        if stream_logs:
+            print(proc.stdout, end='')
+        if require_outputs:
+            return proc.returncode, proc.stdout, proc.stderr
+        return proc.returncode
+
+    def check_connection(self) -> bool:
+        return True
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              log_path: str = '/dev/null') -> None:
+        import shutil as _shutil
+        del up
+        target = os.path.expanduser(target)
+        os.makedirs(target if source.endswith('/') else
+                    (os.path.dirname(target) or '.'), exist_ok=True)
+        if _shutil.which('rsync'):
+            cmd = ['rsync', '-az', '--exclude', '.git/', '--exclude',
+                   '__pycache__/', source, target]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  check=False)
+        else:
+            pipe = (f'tar -C {shlex.quote(source.rstrip("/"))} '
+                    "--exclude='.git' --exclude='__pycache__' "
+                    f'-cf - . | tar -C {shlex.quote(target)} -xf -')
+            proc = subprocess.run(['/bin/bash', '-c', pipe],
+                                  capture_output=True, text=True,
+                                  check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, 'rsync(local)',
+                proc.stderr[-500:])

@@ -1,0 +1,92 @@
+"""CLI tests with click's runner (model: ``tests/test_cli.py`` of the
+reference)."""
+from click.testing import CliRunner
+
+import pytest
+
+from skypilot_tpu import cli
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+class TestCli:
+
+    def test_show_tpus(self, runner):
+        result = runner.invoke(cli.cli, ['show-tpus', 'v5p'])
+        assert result.exit_code == 0, result.output
+        assert 'tpu-v5p-8' in result.output
+        assert 'us-east5' in result.output
+
+    def test_show_tpus_region_filter(self, runner):
+        result = runner.invoke(cli.cli,
+                               ['show-tpus', '--region', 'us-central2'])
+        assert result.exit_code == 0
+        assert 'tpu-v4-8' in result.output
+        assert 'tpu-v5p-8' not in result.output
+
+    def test_status_empty(self, runner):
+        result = runner.invoke(cli.cli, ['status'])
+        assert result.exit_code == 0
+        assert 'No clusters' in result.output
+
+    def test_launch_dryrun_yaml(self, runner, tmp_path):
+        yaml_path = tmp_path / 'task.yaml'
+        yaml_path.write_text(
+            'name: t\nresources:\n  accelerators: tpu-v5e-8\n'
+            'run: echo hi\n')
+        result = runner.invoke(
+            cli.cli, ['launch', str(yaml_path), '--dryrun', '-y'])
+        assert result.exit_code == 0, result.output
+        # Optimizer plan printed.
+        assert 'tpu-v5e-8' in result.output
+
+    def test_launch_inline_dryrun(self, runner):
+        result = runner.invoke(
+            cli.cli, ['launch', 'echo hello', '--dryrun', '-y',
+                      '--accelerator', 'tpu-v6e-8'])
+        assert result.exit_code == 0, result.output
+        assert 'tpu-v6e-8' in result.output
+
+    def test_queue_missing_cluster(self, runner):
+        result = runner.invoke(cli.cli, ['queue', 'nope'])
+        assert result.exit_code != 0
+        assert isinstance(result.exception, Exception)
+
+    def test_cost_report_empty(self, runner):
+        result = runner.invoke(cli.cli, ['cost-report'])
+        assert result.exit_code == 0
+
+    def test_env_parsing(self, runner, tmp_path):
+        yaml_path = tmp_path / 'task.yaml'
+        yaml_path.write_text('envs:\n  X: default\nrun: echo $X\n')
+        result = runner.invoke(
+            cli.cli, ['launch', str(yaml_path), '--dryrun', '-y',
+                      '--env', 'X=override'])
+        assert result.exit_code == 0, result.output
+
+    def test_launch_e2e_local(self, runner):
+        """Full CLI launch on the local fake cloud."""
+        result = runner.invoke(
+            cli.cli,
+            ['launch', 'echo cli-ran-rank-$SKYTPU_NODE_RANK', '-y',
+             '-c', 'clitest', '-d'])
+        assert result.exit_code == 0, result.output
+        from skypilot_tpu import core
+        from skypilot_tpu.runtime import job_lib
+        try:
+            status = core.wait_for_job('clitest', 1, timeout=60)
+            assert status == job_lib.JobStatus.SUCCEEDED
+            logs_result = runner.invoke(cli.cli, ['logs', 'clitest',
+                                                  '1'])
+            assert 'cli-ran-rank-0' in logs_result.output
+            q = runner.invoke(cli.cli, ['queue', 'clitest'])
+            assert 'SUCCEEDED' in q.output
+            st = runner.invoke(cli.cli, ['status'])
+            assert 'clitest' in st.output
+        finally:
+            runner.invoke(cli.cli, ['down', 'clitest', '-y'])
+        st = runner.invoke(cli.cli, ['status'])
+        assert 'clitest' not in st.output

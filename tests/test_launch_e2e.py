@@ -1,0 +1,160 @@
+"""End-to-end launch/exec/queue/logs/autostop/down against the local
+fake cloud — the full stack the reference only covers with real-cloud
+smoke tests (SURVEY.md §4)."""
+import io
+import time
+
+import pytest
+
+from skypilot_tpu import core, exceptions, execution, state, status_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.task import Task
+
+
+def _local_task(run, num_hosts=2, setup=None, envs=None,
+                workdir=None, name='e2e'):
+    task = Task(name=name, run=run, setup=setup, envs=envs,
+                workdir=workdir)
+    res = Resources(cloud='local')
+    res._extra_config = {'num_hosts': num_hosts}  # pylint: disable=protected-access
+    task.set_resources(res)
+    return task
+
+
+@pytest.fixture
+def cluster():
+    """Launch-scoped cluster name; always torn down."""
+    name = 'e2etest'
+    yield name
+    try:
+        core.down(name, purge=True)
+    except exceptions.ClusterDoesNotExist:
+        pass
+
+
+class TestLaunchEndToEnd:
+
+    def test_launch_two_host_gang(self, cluster):
+        task = _local_task(
+            'echo host=$SKYTPU_NODE_RANK/$SKYTPU_NUM_NODES')
+        buf = io.StringIO()
+        job_id, handle = execution.launch(task, cluster,
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        assert handle.num_hosts == 2
+        final = core.wait_for_job(cluster, job_id, timeout=60)
+        assert final == job_lib.JobStatus.SUCCEEDED
+        core.tail_logs(cluster, job_id, out=buf)
+        log = buf.getvalue()
+        assert 'host=0/2' in log
+        assert 'host=1/2' in log
+        # State DB records the cluster UP.
+        rec = state.get_cluster_from_name(cluster)
+        assert rec['status'] == status_lib.ClusterStatus.UP
+
+    def test_exec_reuses_cluster(self, cluster):
+        task = _local_task('echo first')
+        job1, _ = execution.launch(task, cluster, quiet_optimizer=True,
+                                   detach_run=True)
+        core.wait_for_job(cluster, job1, timeout=60)
+        task2 = _local_task('echo second-run')
+        job2, _ = execution.exec_(task2, cluster, detach_run=True)
+        assert job2 == job1 + 1
+        assert core.wait_for_job(cluster, job2, timeout=60) == \
+            job_lib.JobStatus.SUCCEEDED
+
+    def test_setup_runs_before_run(self, cluster):
+        task = _local_task('cat /tmp/skytpu_e2e_setup_marker',
+                           setup='echo marker > '
+                                 '/tmp/skytpu_e2e_setup_marker')
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        assert core.wait_for_job(cluster, job_id, timeout=60) == \
+            job_lib.JobStatus.SUCCEEDED
+
+    def test_failed_job_status(self, cluster):
+        task = _local_task('exit 5', num_hosts=1)
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        assert core.wait_for_job(cluster, job_id, timeout=60) == \
+            job_lib.JobStatus.FAILED
+
+    def test_queue_and_cancel(self, cluster):
+        long_task = _local_task('sleep 120', num_hosts=1)
+        job_id, _ = execution.launch(long_task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        # Wait for RUNNING.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            s = core.job_status(cluster, job_id)
+            if s == job_lib.JobStatus.RUNNING:
+                break
+            time.sleep(0.5)
+        records = core.queue(cluster)
+        assert any(r['job_id'] == job_id and
+                   r['status'] == job_lib.JobStatus.RUNNING
+                   for r in records)
+        cancelled = core.cancel(cluster, all_jobs=True)
+        assert job_id in cancelled
+        final = core.wait_for_job(cluster, job_id, timeout=30)
+        assert final == job_lib.JobStatus.CANCELLED
+
+    def test_workdir_sync(self, cluster, tmp_path):
+        (tmp_path / 'data.txt').write_text('payload-42')
+        task = _local_task('cat data.txt', num_hosts=1,
+                           workdir=str(tmp_path))
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        assert core.wait_for_job(cluster, job_id, timeout=60) == \
+            job_lib.JobStatus.SUCCEEDED
+        buf = io.StringIO()
+        core.tail_logs(cluster, job_id, out=buf)
+        assert 'payload-42' in buf.getvalue()
+
+    def test_down_removes_cluster(self, cluster):
+        task = _local_task('echo hi', num_hosts=1)
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        core.wait_for_job(cluster, job_id, timeout=60)
+        core.down(cluster)
+        assert state.get_cluster_from_name(cluster) is None
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            core.queue(cluster)
+
+    def test_exec_on_missing_cluster_raises(self):
+        task = _local_task('echo x')
+        with pytest.raises(exceptions.ClusterDoesNotExist):
+            execution.exec_(task, 'no-such-cluster')
+
+    def test_status_refresh_detects_dead_cluster(self, cluster):
+        task = _local_task('echo hi', num_hosts=1)
+        job_id, handle = execution.launch(task, cluster,
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        core.wait_for_job(cluster, job_id, timeout=60)
+        # Simulate the cloud losing the cluster (preemption).
+        from skypilot_tpu import provision
+        provision.terminate_instances('local', handle.region,
+                                      handle.cluster_name_on_cloud)
+        records = core.status([cluster], refresh=True)
+        assert records == []
+        assert state.get_cluster_from_name(cluster) is None
+
+    def test_envs_reach_all_ranks(self, cluster):
+        task = _local_task('echo V=$MYVAR rank=$SKYPILOT_NODE_RANK',
+                           envs={'MYVAR': 'hello42'})
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True)
+        core.wait_for_job(cluster, job_id, timeout=60)
+        buf = io.StringIO()
+        core.tail_logs(cluster, job_id, out=buf)
+        log = buf.getvalue()
+        assert 'V=hello42 rank=0' in log
+        assert 'V=hello42 rank=1' in log.replace('(rank 1) ', '')

@@ -1,0 +1,230 @@
+"""Provisioner tests: local fake cloud lifecycle, failover engine,
+GCP error classification (mocked HTTP)."""
+import io
+import json
+import urllib.error
+
+import pytest
+
+from skypilot_tpu import exceptions, provision
+from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.provision.gcp import client as gcp_client
+from skypilot_tpu.provision.provisioner import (RetryingProvisioner,
+                                                bulk_provision)
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime.agent_client import AgentClient
+
+
+def _local_config(name, num_hosts=2, region='local', **extra):
+    return ProvisionConfig(
+        provider='local', region=region, zone=None,
+        cluster_name=name, cluster_name_on_cloud=f'{name}-deadbeef',
+        node_config={'num_hosts': num_hosts, **extra})
+
+
+class TestLocalProvider:
+
+    def test_lifecycle(self):
+        config = _local_config('c1', num_hosts=2)
+        record = bulk_provision(config)
+        assert len(record.created_instance_ids) == 2
+        info = provision.get_cluster_info('local', 'local',
+                                          'c1-deadbeef')
+        assert info.num_hosts() == 2
+        assert info.ips() == ['127.0.0.1', '127.0.0.1']
+        # Agents healthy.
+        for inst in info.instances:
+            assert AgentClient(inst.internal_ip,
+                               inst.agent_port).is_healthy()
+        # Idempotent re-run resumes.
+        record2 = provision.run_instances(config)
+        assert record2.resumed
+        # Terminate kills agents and clears metadata.
+        provision.terminate_instances('local', 'local', 'c1-deadbeef')
+        assert provision.query_instances('local', 'local',
+                                         'c1-deadbeef') == {}
+
+    def test_query_statuses(self):
+        config = _local_config('c2', num_hosts=1)
+        bulk_provision(config)
+        statuses = provision.query_instances('local', 'local',
+                                             'c2-deadbeef')
+        assert list(statuses.values()) == ['running']
+        provision.terminate_instances('local', 'local', 'c2-deadbeef')
+
+    def test_stockout_injection(self):
+        config = _local_config('c3', fail_in=['bad-region'],
+                               region='bad-region')
+        with pytest.raises(exceptions.StockoutError):
+            bulk_provision(config)
+
+
+class TestRetryingProvisioner:
+
+    def _resources(self, regions, fail_in):
+        res = Resources(cloud='local')
+        res._extra_config = {  # pylint: disable=protected-access
+            'regions': regions,
+            'fail_in': fail_in,
+            'num_hosts': 1,
+        }
+        return res
+
+    def test_failover_to_next_region(self):
+        res = self._resources(['r1', 'r2', 'r3'], fail_in=['r1', 'r2'])
+        prov = RetryingProvisioner()
+        result = prov.provision_with_retries(res, 'fo', 'fo-deadbeef',
+                                             num_nodes=1)
+        assert result.record.region == 'r3'
+        assert len(prov.failover_history) == 2
+        assert len(prov.blocked_resources) == 2
+        provision.terminate_instances('local', 'r3', 'fo-deadbeef')
+
+    def test_all_blocked_raises_with_history(self):
+        res = self._resources(['r1', 'r2'], fail_in=['r1', 'r2'])
+        prov = RetryingProvisioner()
+        with pytest.raises(exceptions.ResourcesUnavailableError) as ei:
+            prov.provision_with_retries(res, 'fo2', 'fo2-deadbeef', 1)
+        assert len(ei.value.failover_history) == 2
+
+    def test_gcp_candidates_cheapest_first(self):
+        res = Resources(accelerators='tpu-v5e-8')
+        prov = RetryingProvisioner()
+        placements = prov._candidate_placements(res)
+        regions = []
+        for r, _ in placements:
+            if r not in regions:
+                regions.append(r)
+        from skypilot_tpu import catalog
+        assert regions == catalog.get_regions('tpu-v5e-8', False)
+        # Every placement names a concrete zone.
+        assert all(z is not None for _, z in placements)
+
+    def test_zone_pin_respected(self):
+        res = Resources(accelerators='tpu-v5p-8', region='us-east5',
+                        zone='us-east5-a')
+        prov = RetryingProvisioner()
+        assert prov._candidate_placements(res) == [('us-east5',
+                                                    'us-east5-a')]
+
+
+def _http_error(code, status='', message=''):
+    body = json.dumps(
+        {'error': {'status': status, 'message': message,
+                   'code': code}}).encode()
+    return urllib.error.HTTPError('http://x', code, 'err', {},
+                                  io.BytesIO(body))
+
+
+class TestGcpErrorClassification:
+
+    def test_stockout(self):
+        e = gcp_client.classify_http_error(_http_error(
+            429, 'RESOURCE_EXHAUSTED',
+            'There is no more capacity in the zone'))
+        assert isinstance(e, exceptions.StockoutError)
+
+    def test_quota(self):
+        e = gcp_client.classify_http_error(_http_error(
+            429, 'RESOURCE_EXHAUSTED',
+            'Quota limit tpu-v5p exceeded for project'))
+        assert isinstance(e, exceptions.QuotaExceededError)
+
+    def test_permission(self):
+        e = gcp_client.classify_http_error(_http_error(
+            403, 'PERMISSION_DENIED', 'missing TPU admin role'))
+        assert isinstance(e, exceptions.InvalidCloudConfigError)
+
+    def test_unavailable_maps_to_stockout(self):
+        e = gcp_client.classify_http_error(_http_error(
+            503, 'UNAVAILABLE', 'try again later'))
+        assert isinstance(e, exceptions.StockoutError)
+
+    def test_other(self):
+        e = gcp_client.classify_http_error(_http_error(
+            400, 'INVALID_ARGUMENT', 'bad acceleratorType'))
+        assert isinstance(e, exceptions.ApiError)
+        assert not isinstance(e, exceptions.StockoutError)
+
+
+class TestGcpRunInstancesMocked:
+    """run_instances against a mocked HTTP layer."""
+
+    @pytest.fixture
+    def fake_api(self, monkeypatch):
+        calls = []
+        nodes = {}
+
+        def fake_request(method, url, body=None, timeout=60.0):
+            calls.append((method, url, body))
+            if method == 'POST' and '/nodes?nodeId=' in url:
+                node_id = url.split('nodeId=')[1]
+                zone = url.split('/locations/')[1].split('/')[0]
+                if zone == 'stockout-zone-a':
+                    raise exceptions.StockoutError('no capacity')
+                nodes[node_id] = {
+                    'state': 'READY',
+                    'acceleratorType': body['acceleratorType'],
+                    'networkEndpoints': [
+                        {'ipAddress': '10.0.0.1',
+                         'accessConfig': {'externalIp': '1.2.3.4'}},
+                        {'ipAddress': '10.0.0.2',
+                         'accessConfig': {'externalIp': '1.2.3.5'}},
+                    ],
+                }
+                return {'name': f'projects/p/operations/op-{node_id}'}
+            if method == 'GET' and '/operations/' in url:
+                return {'done': True}
+            if method == 'GET' and '/nodes/' in url:
+                node_id = url.rsplit('/', 1)[1]
+                if node_id in nodes:
+                    return nodes[node_id]
+                raise exceptions.ApiError('not found', http_code=404)
+            if method == 'DELETE':
+                node_id = url.rsplit('/', 1)[1]
+                nodes.pop(node_id, None)
+                return {'name': 'projects/p/operations/op-del',
+                        'done': True}
+            return {}
+
+        monkeypatch.setattr(gcp_client, 'request', fake_request)
+        monkeypatch.setattr(gcp_client, 'get_project_id', lambda: 'p')
+        monkeypatch.setattr(gcp_client, 'wait_operation',
+                            lambda url, **kw: {'done': True})
+        return calls, nodes
+
+    def test_create_and_info(self, fake_api):
+        calls, nodes = fake_api
+        config = ProvisionConfig(
+            provider='gcp', region='us-east5', zone='us-east5-a',
+            cluster_name='train', cluster_name_on_cloud='train-dead',
+            node_config={
+                'accelerator_type': 'v5p-16',
+                'runtime_version': 'v2-alpha-tpuv5',
+                'use_spot': True,
+                'num_hosts': 2,
+            })
+        record = provision.run_instances(config)
+        assert record.created_instance_ids == ['train-dead']
+        assert nodes['train-dead']['acceleratorType'] == 'v5p-16'
+        # Spot flag propagated.
+        create_call = next(c for c in calls
+                           if c[0] == 'POST' and 'nodeId' in c[1])
+        assert create_call[2]['schedulingConfig']['preemptible'] is True
+        # Cluster info: 2 hosts, rank-ordered.
+        info = provision.get_cluster_info('gcp', 'us-east5',
+                                          'train-dead')
+        assert info.num_hosts() == 2
+        assert info.ips() == ['10.0.0.1', '10.0.0.2']
+        assert info.ips(internal=False) == ['1.2.3.4', '1.2.3.5']
+
+    def test_reuse_ready_node(self, fake_api):
+        _, nodes = fake_api
+        nodes['x-dead'] = {'state': 'READY', 'networkEndpoints': []}
+        config = ProvisionConfig(
+            provider='gcp', region='us-east5', zone='us-east5-a',
+            cluster_name='x', cluster_name_on_cloud='x-dead',
+            node_config={'accelerator_type': 'v5e-8',
+                         'runtime_version': 'x'})
+        record = provision.run_instances(config)
+        assert record.resumed
