@@ -244,11 +244,11 @@ def check():
     """Verify cloud credentials."""
     import skypilot_tpu.check as check_lib
     enabled = check_lib.check()
-    if enabled:
-        click.echo(f'Enabled clouds: {", ".join(enabled)}')
-    else:
-        click.echo('No clouds enabled. Configure GCP credentials '
-                   '(gcloud auth login).')
+    click.echo(f'Enabled clouds: {", ".join(enabled)}')
+    if enabled == ['local']:
+        click.echo('No real cloud enabled (only the local fake '
+                   'provider). Configure GCP credentials: '
+                   'gcloud auth login.')
         raise SystemExit(1)
 
 

@@ -100,12 +100,14 @@ def _execute(task: Task, *, cluster_name: str,
         job_id = backend.execute(handle, task, detach_run=detach_run,
                                  include_setup=include_setup)
 
+    # `--down` without an idle threshold means "tear down once the
+    # job is done": expressed as autostop(idle=0, down=True) so it is
+    # safe with detach_run (an immediate teardown would kill the job
+    # that was just submitted).
+    if down and idle_minutes_to_autostop is None:
+        idle_minutes_to_autostop = 0
     if idle_minutes_to_autostop is not None:
         backend.set_autostop(handle, idle_minutes_to_autostop, down)
-
-    if Stage.DOWN in stages and down and \
-            idle_minutes_to_autostop is None:
-        backend.teardown(handle, terminate=True)
     return job_id, handle
 
 

@@ -114,6 +114,12 @@ def request(method: str, url: str,
             return json.loads(payload) if payload else {}
     except urllib.error.HTTPError as e:
         raise classify_http_error(e) from e
+    except (urllib.error.URLError, OSError) as e:
+        # DNS failures / resets / timeouts must stay inside the
+        # SkyTpuError taxonomy so bulk_provision's cleanup and the
+        # failover sweep still run.
+        raise exceptions.ApiError(
+            f'network error talking to {url}: {e}') from e
 
 
 def classify_http_error(e: 'urllib.error.HTTPError') -> Exception:

@@ -107,22 +107,27 @@ def _get_node(project: str, zone: str,
         if e.http_code == 404:
             return None
         raise
-    except exceptions.SkyTpuError:
-        raise
 
 
 def _find_node(region: str,
                cluster_name_on_cloud: str
                ) -> Optional[Dict[str, Any]]:
     """Search the region's zones for the node (zone may have been
-    chosen by failover)."""
+    chosen by failover).
+
+    Only not-found/bad-zone responses are treated as 'not here';
+    auth/quota/API errors propagate so callers (e.g. ``status
+    --refresh``) cannot mistake an outage for a deleted cluster and
+    drop a live, billing slice from the state DB."""
     project = gcp_client.get_project_id()
     for suffix in ('a', 'b', 'c', 'd', 'f'):
         zone = f'{region}-{suffix}'
         try:
             node = _get_node(project, zone, cluster_name_on_cloud)
-        except exceptions.SkyTpuError:
-            continue
+        except exceptions.ApiError as e:
+            if e.http_code in (400, 404):  # nonexistent zone
+                continue
+            raise
         if node is not None:
             node['_zone'] = zone
             return node

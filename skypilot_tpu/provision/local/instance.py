@@ -63,6 +63,14 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def _host_alive(host: Dict[str, Any]) -> bool:
+    """Liveness = the agent answers /health. A pid check alone is
+    wrong here: a SIGTERMed agent whose parent (this process) hasn't
+    reaped it yet is a zombie, and os.kill(pid, 0) still succeeds."""
+    return agent_client.AgentClient('127.0.0.1', host['port'],
+                                    timeout=1).is_healthy()
+
+
 def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
     return config
 
@@ -79,7 +87,7 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
 
     existing = _load(config.cluster_name_on_cloud)
     if existing is not None and all(
-            _pid_alive(h['pid']) for h in existing['hosts']):
+            _host_alive(h) for h in existing['hosts']):
         return ProvisionRecord(
             provider='local', region=config.region, zone=config.zone,
             cluster_name_on_cloud=config.cluster_name_on_cloud,
@@ -160,7 +168,7 @@ def query_instances(region: str,
         return {}
     return {
         h['instance_id']:
-            ('running' if _pid_alive(h['pid']) else 'terminated')
+            ('running' if _host_alive(h) else 'stopped')
         for h in meta['hosts']
     }
 

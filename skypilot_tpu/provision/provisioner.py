@@ -37,6 +37,12 @@ def bulk_provision(config: ProvisionConfig) -> ProvisionRecord:
         record = provision.run_instances(config)
         provision.wait_instances(config.provider, config.region,
                                  config.cluster_name_on_cloud)
+        # Agent port + any user-requested ports must be reachable
+        # from the client (no-op on the local provider).
+        from skypilot_tpu.runtime.agent import DEFAULT_PORT
+        ports = list(config.ports_to_open) + [str(DEFAULT_PORT)]
+        provision.open_ports(config.provider, config.region,
+                             config.cluster_name_on_cloud, ports)
         return record
     except exceptions.SkyTpuError:
         # Leave no half-created slice behind (model:

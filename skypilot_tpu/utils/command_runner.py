@@ -135,8 +135,8 @@ class SSHCommandRunner:
                     f'-cf - . | {ssh_prefix} '
                     f'"mkdir -p {target} && tar -C {target} -xf -"')
             else:
-                pipe = (f'{ssh_prefix} "tar -C {source} -cf - ." | '
-                        f'mkdir -p {shlex.quote(target)} && '
+                pipe = (f'mkdir -p {shlex.quote(target)} && '
+                        f'{ssh_prefix} "tar -C {source} -cf - ." | '
                         f'tar -C {shlex.quote(target)} -xf -')
             proc = subprocess.run(['/bin/bash', '-c', pipe],
                                   capture_output=True, text=True,

@@ -158,3 +158,46 @@ class TestLaunchEndToEnd:
         log = buf.getvalue()
         assert 'V=hello42 rank=0' in log
         assert 'V=hello42 rank=1' in log.replace('(rank 1) ', '')
+
+    def test_stop_start_cycle(self, cluster):
+        """Stop kills agents; start re-provisions with NEW agent
+        ports and the handle must be rebuilt (review regression)."""
+        task = _local_task('echo alive', num_hosts=2)
+        job_id, handle = execution.launch(task, cluster,
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        core.wait_for_job(cluster, job_id, timeout=60)
+        old_ports = [h['agent_port'] for h in handle.hosts]
+        core.stop(cluster)
+        rec = state.get_cluster_from_name(cluster)
+        assert rec['status'] == status_lib.ClusterStatus.STOPPED
+        core.start(cluster)
+        rec = state.get_cluster_from_name(cluster)
+        assert rec['status'] == status_lib.ClusterStatus.UP
+        new_handle = rec['handle']
+        assert len(new_handle.hosts) == 2
+        # New agents must be healthy on the recorded ports.
+        assert new_handle.head_agent().is_healthy()
+        # Execute again on the restarted cluster.
+        task2 = _local_task('echo post-restart')
+        job2, _ = execution.exec_(task2, cluster, detach_run=True)
+        assert core.wait_for_job(cluster, job2, timeout=60) == \
+            job_lib.JobStatus.SUCCEEDED
+        del old_ports
+
+    def test_down_flag_sets_autostop_instead_of_killing_job(
+            self, cluster):
+        """--down with detach must NOT tear down immediately (review
+        regression): it becomes autostop(0, down)."""
+        task = _local_task('sleep 2 && echo done', num_hosts=1)
+        job_id, _ = execution.launch(task, cluster,
+                                     quiet_optimizer=True,
+                                     detach_run=True, down=True)
+        # Cluster still exists right after launch.
+        rec = state.get_cluster_from_name(cluster)
+        assert rec is not None
+        assert rec['autostop'] == 0
+        assert rec['to_down'] is True
+        # And the job completes.
+        assert core.wait_for_job(cluster, job_id, timeout=60) == \
+            job_lib.JobStatus.SUCCEEDED
