@@ -1,0 +1,7 @@
+"""Managed jobs: controller-driven jobs with automatic recovery from
+TPU spot preemption (analog of ``sky/jobs/``)."""
+from skypilot_tpu.jobs.core import (cancel, launch, queue, tail_logs)
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['ManagedJobStatus', 'cancel', 'launch', 'queue',
+           'tail_logs']

@@ -1,0 +1,201 @@
+"""Per-job controller process (analog of ``sky/jobs/controller.py``).
+
+One controller process per managed job, running ON the controller
+cluster (launched by ``jobs.core.launch`` — the reference's
+"controller is just a task" recursion). For each task in the chain
+DAG: launch a fresh cluster ``<name>-<job_id>``, poll its job, detect
+preemption vs user failure, recover via the strategy, tear down on
+completion, advance the chain.
+"""
+import argparse
+import os
+import time
+from typing import Optional
+
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions, state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+JOB_STATUS_CHECK_GAP_SECONDS = float(
+    os.environ.get('SKYTPU_JOBS_POLL_SECONDS', '5'))
+MAX_RECOVERIES = int(os.environ.get('SKYTPU_JOBS_MAX_RECOVERIES',
+                                    '10'))
+
+
+class JobsController:
+
+    def __init__(self, managed_job_id: int, dag_yaml_path: str):
+        self.job_id = managed_job_id
+        self.dag_yaml_path = dag_yaml_path
+        self.tasks = self._load_tasks()
+
+    def _load_tasks(self):
+        configs = common_utils.read_yaml_all(self.dag_yaml_path)
+        tasks = []
+        for config in configs:
+            if config is None:
+                continue
+            tasks.append(Task.from_yaml_config(config))
+        assert tasks, f'no tasks in {self.dag_yaml_path}'
+        return tasks
+
+    # -- helpers --------------------------------------------------------
+
+    def _cluster_name(self, task_idx: int) -> str:
+        task = self.tasks[task_idx]
+        base = task.name or 'task'
+        return f'{base}-{self.job_id}-{task_idx}'
+
+    def _cluster_region(self, cluster_name: str) -> Optional[str]:
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return None
+        return record['handle'].region
+
+    def _cluster_is_alive(self, cluster_name: str) -> bool:
+        """Preemption check: query the provider for actual instance
+        liveness (reference polls cluster status the same way,
+        ``sky/jobs/controller.py:116ff``)."""
+        records = core_lib.status([cluster_name], refresh=True)
+        if not records:
+            return False
+        from skypilot_tpu import status_lib
+        return records[0]['status'] == status_lib.ClusterStatus.UP
+
+    # -- main loop ------------------------------------------------------
+
+    def run(self) -> jobs_state.ManagedJobStatus:
+        try:
+            final = self._run_all_tasks()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('controller crashed')
+            jobs_state.set_status(
+                self.job_id,
+                jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                failure_reason=repr(e))
+            return jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        jobs_state.set_status(self.job_id, final)
+        return final
+
+    def _run_all_tasks(self) -> jobs_state.ManagedJobStatus:
+        for idx, task in enumerate(self.tasks):
+            status = self._run_one_task(idx, task)
+            if status != jobs_state.ManagedJobStatus.SUCCEEDED:
+                return status
+        return jobs_state.ManagedJobStatus.SUCCEEDED
+
+    def _run_one_task(self, idx: int,
+                      task: Task) -> jobs_state.ManagedJobStatus:
+        cluster_name = self._cluster_name(idx)
+        recovery_name = next(iter(task.resources)).spot_recovery
+        strategy = recovery_strategy.get_strategy(recovery_name)
+        jobs_state.set_task_cluster(self.job_id, cluster_name)
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.STARTING)
+
+        job_id = strategy.launch(task, cluster_name)
+        if job_id is None:
+            return jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE
+        jobs_state.set_status(self.job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+
+        recoveries = 0
+        while True:
+            if jobs_state.cancel_requested(self.job_id):
+                logger.info('Cancel requested; tearing down %s',
+                            cluster_name)
+                strategy.terminate_cluster(cluster_name)
+                jobs_state.clear_cancel(self.job_id)
+                return jobs_state.ManagedJobStatus.CANCELLED
+            time.sleep(JOB_STATUS_CHECK_GAP_SECONDS)
+            status = self._poll_job_status(cluster_name, job_id)
+            if status is None:
+                # Cluster unreachable — preemption suspect. Capture
+                # the region BEFORE the liveness refresh: a confirmed
+                # preemption drops the cluster from the state DB.
+                preempted_region = self._cluster_region(cluster_name)
+                if self._cluster_is_alive(cluster_name):
+                    continue  # transient
+                recoveries += 1
+                jobs_state.bump_recovery(self.job_id)
+                if recoveries > MAX_RECOVERIES:
+                    return jobs_state.ManagedJobStatus.FAILED
+                logger.warning(
+                    'Cluster %s preempted (region %s); recovering '
+                    '(%d/%d) via %s', cluster_name, preempted_region,
+                    recoveries, MAX_RECOVERIES, strategy.NAME)
+                jobs_state.set_status(
+                    self.job_id,
+                    jobs_state.ManagedJobStatus.RECOVERING)
+                job_id = strategy.recover(task, cluster_name,
+                                          preempted_region)
+                if job_id is None:
+                    return jobs_state.ManagedJobStatus.\
+                        FAILED_NO_RESOURCE
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+                continue
+            if status == job_lib.JobStatus.SUCCEEDED:
+                logger.info('Task %d succeeded; tearing down %s', idx,
+                            cluster_name)
+                strategy.terminate_cluster(cluster_name)
+                return jobs_state.ManagedJobStatus.SUCCEEDED
+            if status in (job_lib.JobStatus.FAILED,
+                          job_lib.JobStatus.FAILED_SETUP):
+                # User-code failure: no recovery (reference
+                # distinguishes preemption vs user failure the same
+                # way).
+                strategy.terminate_cluster(cluster_name)
+                return (jobs_state.ManagedJobStatus.FAILED_SETUP
+                        if status == job_lib.JobStatus.FAILED_SETUP
+                        else jobs_state.ManagedJobStatus.FAILED)
+            if status in (job_lib.JobStatus.FAILED_DRIVER,
+                          job_lib.JobStatus.CANCELLED):
+                # Driver death without cluster death — treat like
+                # preemption (something killed the runtime).
+                recoveries += 1
+                jobs_state.bump_recovery(self.job_id)
+                if recoveries > MAX_RECOVERIES:
+                    return jobs_state.ManagedJobStatus.FAILED
+                jobs_state.set_status(
+                    self.job_id,
+                    jobs_state.ManagedJobStatus.RECOVERING)
+                job_id = strategy.recover(
+                    task, cluster_name,
+                    self._cluster_region(cluster_name))
+                if job_id is None:
+                    return jobs_state.ManagedJobStatus.\
+                        FAILED_NO_RESOURCE
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+
+    def _poll_job_status(self, cluster_name: str, job_id: int
+                         ) -> Optional[job_lib.JobStatus]:
+        try:
+            return core_lib.job_status(cluster_name, job_id)
+        except (exceptions.SkyTpuError, OSError):
+            return None
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--dag-yaml', required=True)
+    args = parser.parse_args()
+    controller = JobsController(args.job_id, args.dag_yaml)
+    final = controller.run()
+    logger.info('managed job %d finished: %s', args.job_id,
+                final.value)
+    raise SystemExit(
+        0 if final == jobs_state.ManagedJobStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,128 @@
+"""Managed jobs client API (analog of ``sky/jobs/core.py``).
+
+``launch`` embeds the user DAG yaml into a controller task and runs
+it on the jobs-controller cluster via the ordinary launch path — the
+reference's "controller is just a task" recursion
+(``sky/jobs/core.py:39-146``). On the controller the task runs
+``skypilot_tpu.jobs.controller`` for the job.
+"""
+import os
+import shlex
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import execution
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+CONTROLLER_CLUSTER_PREFIX = 'sky-jobs-controller-'
+
+
+def _controller_cluster_name() -> str:
+    return CONTROLLER_CLUSTER_PREFIX + common_utils.get_user_hash()
+
+
+def _dag_to_yaml(dag_or_task: Union[Dag, Task], path: str) -> None:
+    import yaml
+    if isinstance(dag_or_task, Task):
+        tasks = [dag_or_task]
+    else:
+        tasks = list(dag_or_task.tasks)
+    docs = [t.to_yaml_config() for t in tasks]
+    with open(path, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+
+
+def _controller_resources() -> Resources:
+    """CPU-only controller; cloud resolved by the default-cloud logic
+    in execution (gcp VM when credentials exist, local otherwise)."""
+    return Resources()
+
+
+def launch(dag_or_task: Union[Dag, Task],
+           name: Optional[str] = None,
+           detach: bool = True) -> int:
+    """Submit a managed job; returns the managed job id."""
+    if isinstance(dag_or_task, Dag) and not dag_or_task.is_chain():
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'Managed jobs execute chain DAGs only (same restriction '
+            'as the reference).')
+    if name is None:
+        first = (dag_or_task.tasks[0] if isinstance(dag_or_task, Dag)
+                 else dag_or_task)
+        name = first.name or 'managed-job'
+
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    dag_dir = os.path.join(state_dir, 'managed_dags')
+    os.makedirs(dag_dir, exist_ok=True)
+    controller_cluster = _controller_cluster_name()
+    job_id = jobs_state.add_job(name, '', controller_cluster)
+    dag_yaml_path = os.path.join(dag_dir, f'dag-{job_id}.yaml')
+    _dag_to_yaml(dag_or_task, dag_yaml_path)
+    jobs_state._db().execute_and_commit(  # pylint: disable=protected-access
+        'UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?',
+        (dag_yaml_path, job_id))
+
+    # The controller task: runs the per-job controller process. The
+    # client state dir is forwarded so the controller (local provider:
+    # same machine; gcp: the controller VM's own dir) sees the same
+    # managed-jobs DB.
+    controller_task = Task(
+        name=f'jobs-controller-{job_id}',
+        run=(f'SKYTPU_STATE_DIR={shlex.quote(state_dir)} '
+             f'python3 -m skypilot_tpu.jobs.controller '
+             f'--job-id {job_id} --dag-yaml '
+             f'{shlex.quote(dag_yaml_path)}'),
+    )
+    controller_task.set_resources(_controller_resources())
+    jobs_state.set_status(job_id,
+                          jobs_state.ManagedJobStatus.SUBMITTED)
+    controller_job_id, _ = execution.launch(
+        controller_task, controller_cluster, fast=True,
+        detach_run=True, quiet_optimizer=True, retry_until_up=True)
+    jobs_state.set_controller_job(job_id, controller_job_id)
+    logger.info('Managed job %d submitted (controller cluster %s, '
+                'controller job %s)', job_id, controller_cluster,
+                controller_job_id)
+    if not detach:
+        wait(job_id)
+    return job_id
+
+
+def wait(job_id: int, timeout: float = 3600.0,
+         poll: float = 2.0) -> jobs_state.ManagedJobStatus:
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rec = jobs_state.get_job(job_id)
+        assert rec is not None, job_id
+        if rec['status'].is_terminal():
+            return rec['status']
+        time.sleep(poll)
+    raise TimeoutError(f'managed job {job_id} not terminal after '
+                       f'{timeout}s')
+
+
+def queue() -> List[Dict[str, Any]]:
+    return jobs_state.get_jobs()
+
+
+def cancel(job_id: int) -> None:
+    jobs_state.request_cancel(job_id)
+
+
+def tail_logs(job_id: int, out=None) -> None:
+    """Stream the current task cluster's logs for a managed job."""
+    from skypilot_tpu import core as core_lib
+    rec = jobs_state.get_job(job_id)
+    if rec is None or not rec['task_cluster']:
+        raise ValueError(f'managed job {job_id} has no task cluster '
+                         'yet')
+    core_lib.tail_logs(rec['task_cluster'], out=out)

@@ -1,0 +1,161 @@
+"""Recovery strategies for managed jobs (analog of
+``sky/jobs/recovery_strategy.py``).
+
+Two strategies, same as the reference:
+- FAILOVER (``:388``): on preemption, retry the SAME region first
+  (cheap if capacity returns), then widen.
+- EAGER_NEXT_REGION (``:471``, the default): terminate and
+  immediately blocklist the preempted region — TPU spot preemptions
+  cluster in time and space, so the next region is usually the faster
+  path back to running.
+"""
+import time
+from typing import Optional, Set
+
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions, execution
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+MAX_PROVISION_RETRIES = 3
+RETRY_GAP_SECONDS = 5.0
+
+_STRATEGIES = {}
+
+
+def register(name):
+
+    def deco(cls):
+        _STRATEGIES[name] = cls
+        cls.NAME = name
+        return cls
+
+    return deco
+
+
+def get_strategy(name: str) -> 'StrategyExecutor':
+    cls = _STRATEGIES.get(name.upper())
+    if cls is None:
+        raise exceptions.InvalidSpecError(
+            f'Unknown recovery strategy {name!r}; choose from '
+            f'{sorted(_STRATEGIES)}')
+    return cls()
+
+
+class StrategyExecutor:
+    """Launch/relaunch one task's cluster with failover."""
+
+    NAME = 'base'
+
+    def __init__(self):
+        self.blocked_resources: Set[Resources] = set()
+
+    def launch(self, task: Task, cluster_name: str,
+               max_retries: int = MAX_PROVISION_RETRIES,
+               retry_until_up: bool = False) -> Optional[int]:
+        """Provision + submit; returns the cluster job id, or None if
+        provisioning kept failing."""
+        for attempt in range(max_retries):
+            try:
+                job_id, _ = execution.launch(
+                    task, cluster_name, detach_run=True,
+                    quiet_optimizer=True,
+                    retry_until_up=retry_until_up)
+                return job_id
+            except exceptions.ResourcesUnavailableError as e:
+                if e.no_failover:
+                    raise
+                logger.warning(
+                    'Launch attempt %d/%d failed: %s', attempt + 1,
+                    max_retries, e)
+                time.sleep(RETRY_GAP_SECONDS)
+        return None
+
+    def terminate_cluster(self, cluster_name: str) -> None:
+        try:
+            core_lib.down(cluster_name, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+    def recover(self, task: Task, cluster_name: str,
+                preempted_region: Optional[str]) -> Optional[int]:
+        raise NotImplementedError
+
+
+@register('FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """Retry the same region first, then any region."""
+
+    def recover(self, task, cluster_name, preempted_region):
+        self.terminate_cluster(cluster_name)
+        # 1st: same region (pin it).
+        if preempted_region is not None:
+            pinned = {
+                r.copy(region=preempted_region) if r.region is None
+                else r for r in task.resources
+            }
+            original = task.resources
+            task.set_resources(pinned)
+            job_id = self.launch(task, cluster_name, max_retries=1)
+            task.set_resources(original)
+            if job_id is not None:
+                return job_id
+        return self.launch(task, cluster_name)
+
+
+@register('EAGER_NEXT_REGION')
+class EagerNextRegionStrategy(StrategyExecutor):
+    """Blocklist the preempted region immediately and go elsewhere."""
+
+    def recover(self, task, cluster_name, preempted_region):
+        self.terminate_cluster(cluster_name)
+        if preempted_region is not None:
+            for r in task.resources:
+                if r.accelerator is not None:
+                    self.blocked_resources.add(
+                        r.copy(region=preempted_region, zone=None))
+        # Provisioning honors the blocklist through the optimizer by
+        # filtering candidate regions at the Resources level: pin a
+        # not-blocked region ordering by temporarily removing the
+        # preempted region from consideration.
+        pruned = set()
+        for r in task.resources:
+            if (r.region is not None and
+                    r.region == preempted_region and
+                    r.accelerator is not None):
+                # The user pinned this exact region: keep it (no
+                # alternative exists) — same as reference behavior.
+                pruned.add(r)
+            else:
+                pruned.add(r)
+        original = task.resources
+        task.set_resources(pruned)
+        try:
+            from skypilot_tpu import optimizer as optimizer_lib
+            from skypilot_tpu.dag import Dag
+            with Dag() as dag:
+                dag.add(task)
+            try:
+                optimizer_lib.optimize(
+                    dag, blocked_resources=self.blocked_resources,
+                    quiet=True)
+                best = task.best_resources  # type: ignore[attr-defined]
+                task.set_resources({best})
+            except exceptions.ResourcesUnavailableError:
+                # Everything blocked: fall back to the full set.
+                task.set_resources(original)
+            return self.launch(task, cluster_name)
+        finally:
+            task.set_resources(original)
+
+
+@register('NONE')
+class NoRecoveryStrategy(StrategyExecutor):
+    """Preemption fails the job."""
+
+    def recover(self, task, cluster_name, preempted_region):
+        self.terminate_cluster(cluster_name)
+        return None

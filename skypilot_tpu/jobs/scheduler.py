@@ -1,0 +1,43 @@
+"""Controller-wide admission control (analog of
+``sky/jobs/scheduler.py``).
+
+Limits concurrent controller processes by machine size, the same
+heuristics as the reference: launches ≈ 4×CPU
+(``_get_launch_parallelism:265``), running jobs ≈ memory/350MB
+(``_get_job_parallelism:257``).
+"""
+import os
+
+from skypilot_tpu.jobs import state as jobs_state
+
+
+def _cpu_count() -> int:
+    return os.cpu_count() or 4
+
+
+def _memory_gb() -> float:
+    try:
+        with open('/proc/meminfo', encoding='utf-8') as f:
+            for line in f:
+                if line.startswith('MemTotal:'):
+                    return int(line.split()[1]) / (1024 * 1024)
+    except OSError:
+        pass
+    return 16.0
+
+
+def get_launch_parallelism() -> int:
+    return max(4, 4 * _cpu_count())
+
+
+def get_job_parallelism() -> int:
+    return max(4, int(_memory_gb() * 1024 / 350))
+
+
+def can_admit() -> bool:
+    """May a new managed job's controller start now?"""
+    active = [
+        r for r in jobs_state.get_nonterminal_jobs()
+        if r['status'] != jobs_state.ManagedJobStatus.PENDING
+    ]
+    return len(active) < get_job_parallelism()

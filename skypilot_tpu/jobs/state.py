@@ -1,0 +1,211 @@
+"""Managed-jobs state DB (analog of ``sky/jobs/state.py``).
+
+Lives under the controller's state dir. Status machine mirrors the
+reference (``ManagedJobStatus``, ``sky/jobs/state.py:186``).
+"""
+import enum
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+
+
+def _db_path() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(base, 'managed_jobs.db')
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in {
+            ManagedJobStatus.FAILED, ManagedJobStatus.FAILED_SETUP,
+            ManagedJobStatus.FAILED_NO_RESOURCE,
+            ManagedJobStatus.FAILED_CONTROLLER,
+        }
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED,
+}
+
+
+def _create_tables(cursor, conn):
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS managed_jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        status TEXT,
+        submitted_at REAL,
+        started_at REAL,
+        ended_at REAL,
+        task_cluster TEXT,
+        controller_cluster TEXT,
+        controller_job_id INTEGER,
+        recovery_count INTEGER DEFAULT 0,
+        dag_yaml_path TEXT,
+        failure_reason TEXT)""")
+    conn.commit()
+
+
+_conns: Dict[str, db_utils.SQLiteConn] = {}
+
+
+def _db() -> db_utils.SQLiteConn:
+    path = _db_path()
+    conn = _conns.get(path)
+    if conn is None or conn.db_path != path:
+        conn = db_utils.SQLiteConn(path, _create_tables)
+        _conns[path] = conn
+    return conn
+
+
+def add_job(name: str, dag_yaml_path: str,
+            controller_cluster: str) -> int:
+    db = _db()
+    try:
+        db.cursor.execute(
+            'INSERT INTO managed_jobs (name, status, submitted_at, '
+            'dag_yaml_path, controller_cluster) VALUES (?,?,?,?,?)',
+            (name, ManagedJobStatus.PENDING.value, time.time(),
+             dag_yaml_path, controller_cluster))
+        job_id = db.cursor.lastrowid
+    finally:
+        db.conn.commit()
+    assert job_id is not None
+    return int(job_id)
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    db = _db()
+    now = time.time()
+    sets = ['status=?']
+    params: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        params.append(now)
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        params.append(now)
+    if failure_reason is not None:
+        sets.append('failure_reason=?')
+        params.append(failure_reason)
+    params.append(job_id)
+    db.execute_and_commit(
+        f'UPDATE managed_jobs SET {", ".join(sets)} WHERE job_id=?',
+        tuple(params))
+
+
+def set_task_cluster(job_id: int, cluster: str) -> None:
+    _db().execute_and_commit(
+        'UPDATE managed_jobs SET task_cluster=? WHERE job_id=?',
+        (cluster, job_id))
+
+
+def set_controller_job(job_id: int, controller_job_id: int) -> None:
+    _db().execute_and_commit(
+        'UPDATE managed_jobs SET controller_job_id=? WHERE job_id=?',
+        (controller_job_id, job_id))
+
+
+def bump_recovery(job_id: int) -> int:
+    db = _db()
+    db.execute_and_commit(
+        'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+        'WHERE job_id=?', (job_id,))
+    row = db.cursor.execute(
+        'SELECT recovery_count FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    return int(row[0])
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().cursor.execute(
+        'SELECT job_id, name, status, submitted_at, started_at, '
+        'ended_at, task_cluster, controller_cluster, '
+        'controller_job_id, recovery_count, dag_yaml_path, '
+        'failure_reason FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    return _to_record(row) if row else None
+
+
+def _to_record(row) -> Dict[str, Any]:
+    (job_id, name, status, submitted_at, started_at, ended_at,
+     task_cluster, controller_cluster, controller_job_id,
+     recovery_count, dag_yaml_path, failure_reason) = row
+    return {
+        'job_id': job_id,
+        'name': name,
+        'status': ManagedJobStatus(status),
+        'submitted_at': submitted_at,
+        'started_at': started_at,
+        'ended_at': ended_at,
+        'task_cluster': task_cluster,
+        'controller_cluster': controller_cluster,
+        'controller_job_id': controller_job_id,
+        'recovery_count': recovery_count,
+        'dag_yaml_path': dag_yaml_path,
+        'failure_reason': failure_reason,
+    }
+
+
+def get_jobs() -> List[Dict[str, Any]]:
+    rows = _db().cursor.execute(
+        'SELECT job_id, name, status, submitted_at, started_at, '
+        'ended_at, task_cluster, controller_cluster, '
+        'controller_job_id, recovery_count, dag_yaml_path, '
+        'failure_reason FROM managed_jobs '
+        'ORDER BY job_id DESC').fetchall()
+    return [_to_record(r) for r in rows]
+
+
+def get_nonterminal_jobs() -> List[Dict[str, Any]]:
+    return [r for r in get_jobs() if not r['status'].is_terminal()]
+
+
+def request_cancel(job_id: int) -> None:
+    """Signal-file based cancellation (reference
+    ``sky/jobs/controller.py:446`` _handle_signal)."""
+    set_status(job_id, ManagedJobStatus.CANCELLING)
+    path = _signal_path(job_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump({'signal': 'cancel', 'at': time.time()}, f)
+
+
+def cancel_requested(job_id: int) -> bool:
+    return os.path.exists(_signal_path(job_id))
+
+
+def clear_cancel(job_id: int) -> None:
+    try:
+        os.remove(_signal_path(job_id))
+    except FileNotFoundError:
+        pass
+
+
+def _signal_path(job_id: int) -> str:
+    base = os.path.dirname(_db_path())
+    return os.path.join(base, 'signals', f'managed-job-{job_id}')

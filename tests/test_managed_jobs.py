@@ -1,0 +1,188 @@
+"""Managed jobs: controller recursion, chain DAGs, preemption
+recovery — all on the local fake cloud (the reference covers this
+only in real-cloud smoke tests)."""
+import time
+
+import pytest
+
+from skypilot_tpu import core, exceptions, jobs, provision, state
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _local_task(run, name='mtask', num_hosts=1, setup=None):
+    task = Task(name=name, run=run, setup=setup)
+    res = Resources(cloud='local')
+    res._extra_config = {'num_hosts': num_hosts}  # pylint: disable=protected-access
+    task.set_resources(res)
+    return task
+
+
+@pytest.fixture(autouse=True)
+def fast_poll(monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '1')
+    # Reload the module constant for in-process controller runs.
+    from skypilot_tpu.jobs import controller as controller_mod
+    monkeypatch.setattr(controller_mod,
+                        'JOB_STATUS_CHECK_GAP_SECONDS', 1.0)
+    yield
+
+
+@pytest.fixture
+def cleanup_clusters():
+    yield
+    for record in state.get_clusters():
+        try:
+            core.down(record['name'], purge=True)
+        except exceptions.SkyTpuError:
+            pass
+
+
+class TestManagedJobsState:
+
+    def test_state_machine(self):
+        job_id = jobs_state.add_job('j', '/tmp/x.yaml', 'ctrl')
+        rec = jobs_state.get_job(job_id)
+        assert rec['status'] == jobs_state.ManagedJobStatus.PENDING
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        assert jobs_state.get_job(job_id)['started_at'] is not None
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.SUCCEEDED)
+        rec = jobs_state.get_job(job_id)
+        assert rec['ended_at'] is not None
+        assert rec['status'].is_terminal()
+
+    def test_cancel_signal(self):
+        job_id = jobs_state.add_job('j2', '/tmp/x.yaml', 'ctrl')
+        assert not jobs_state.cancel_requested(job_id)
+        jobs_state.request_cancel(job_id)
+        assert jobs_state.cancel_requested(job_id)
+        assert jobs_state.get_job(job_id)['status'] == \
+            jobs_state.ManagedJobStatus.CANCELLING
+        jobs_state.clear_cancel(job_id)
+        assert not jobs_state.cancel_requested(job_id)
+
+    def test_recovery_counter(self):
+        job_id = jobs_state.add_job('j3', '/tmp/x.yaml', 'ctrl')
+        assert jobs_state.bump_recovery(job_id) == 1
+        assert jobs_state.bump_recovery(job_id) == 2
+
+
+class TestStrategies:
+
+    def test_registry(self):
+        for name in ('FAILOVER', 'EAGER_NEXT_REGION', 'NONE'):
+            s = recovery_strategy.get_strategy(name)
+            assert s.NAME == name
+        with pytest.raises(exceptions.InvalidSpecError):
+            recovery_strategy.get_strategy('BOGUS')
+
+    def test_none_strategy_no_recovery(self, cleanup_clusters):
+        strategy = recovery_strategy.get_strategy('NONE')
+        task = _local_task('echo x')
+        assert strategy.recover(task, 'nonexistent-cluster',
+                                'r1') is None
+
+
+class TestControllerInProcess:
+    """Drive JobsController directly (in-process) for determinism."""
+
+    def _write_dag(self, tmp_path, tasks):
+        import yaml
+        path = tmp_path / 'dag.yaml'
+        with open(path, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([t.to_yaml_config() for t in tasks], f)
+        return str(path)
+
+    def _make_controller(self, tmp_path, tasks, name='cj'):
+        dag_yaml = self._write_dag(tmp_path, tasks)
+        job_id = jobs_state.add_job(name, dag_yaml, 'inproc')
+        from skypilot_tpu.jobs.controller import JobsController
+        return JobsController(job_id, dag_yaml), job_id
+
+    def test_single_task_success(self, tmp_path, cleanup_clusters):
+        task = _local_task('echo managed-ok', name='mj1')
+        ctrl, job_id = self._make_controller(tmp_path, [task])
+        final = ctrl.run()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        # Task cluster torn down after success.
+        assert state.get_cluster_from_name(f'mj1-{job_id}-0') is None
+
+    def test_chain_dag_runs_in_order(self, tmp_path,
+                                     cleanup_clusters):
+        marker = tmp_path / 'order.txt'
+        t1 = _local_task(f'echo one >> {marker}', name='chain1')
+        t2 = _local_task(f'echo two >> {marker}', name='chain2')
+        ctrl, _ = self._make_controller(tmp_path, [t1, t2])
+        final = ctrl.run()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert marker.read_text().split() == ['one', 'two']
+
+    def test_user_failure_not_recovered(self, tmp_path,
+                                        cleanup_clusters):
+        task = _local_task('exit 3', name='mjf')
+        ctrl, job_id = self._make_controller(tmp_path, [task])
+        final = ctrl.run()
+        assert final == jobs_state.ManagedJobStatus.FAILED
+        assert jobs_state.get_job(job_id)['recovery_count'] == 0
+
+    def test_preemption_recovery(self, tmp_path, cleanup_clusters):
+        """Kill the task cluster mid-run; controller must relaunch
+        and the job must still SUCCEED."""
+        import threading
+        task = _local_task('sleep 6 && echo survived', name='mjp')
+        ctrl, job_id = self._make_controller(tmp_path, [task])
+        cluster_name = f'mjp-{job_id}-0'
+
+        def preempt():
+            # Wait for the task cluster to be up and running.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rec = state.get_cluster_from_name(cluster_name)
+                if rec is not None:
+                    handle = rec['handle']
+                    provision.terminate_instances(
+                        'local', handle.region,
+                        handle.cluster_name_on_cloud)
+                    return
+                time.sleep(0.5)
+
+        killer = threading.Timer(4.0, preempt)
+        killer.start()
+        try:
+            final = ctrl.run()
+        finally:
+            killer.cancel()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert jobs_state.get_job(job_id)['recovery_count'] >= 1
+
+    def test_cancel_mid_run(self, tmp_path, cleanup_clusters):
+        import threading
+        task = _local_task('sleep 120', name='mjc')
+        ctrl, job_id = self._make_controller(tmp_path, [task])
+        threading.Timer(
+            5.0, lambda: jobs_state.request_cancel(job_id)).start()
+        final = ctrl.run()
+        assert final == jobs_state.ManagedJobStatus.CANCELLED
+
+
+class TestManagedJobsEndToEnd:
+    """The full recursion: controller runs as a task on the
+    controller cluster."""
+
+    def test_launch_via_controller_cluster(self, cleanup_clusters):
+        task = _local_task('echo full-recursion-ok', name='mj-full')
+        job_id = jobs.launch(task, detach=True)
+        final = jobs.core.wait(job_id, timeout=120)
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        rec = jobs_state.get_job(job_id)
+        assert rec['controller_cluster'].startswith(
+            'sky-jobs-controller-')
+        # Controller cluster still up (reused for future jobs).
+        ctrl_rec = state.get_cluster_from_name(
+            rec['controller_cluster'])
+        assert ctrl_rec is not None
