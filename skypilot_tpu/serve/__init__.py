@@ -1,0 +1,7 @@
+"""Serving: autoscaled inference replicas behind a load balancer
+(analog of ``sky/serve/`` SkyServe)."""
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.serve.core import down, status, terminate_replica, up
+
+__all__ = ['SkyServiceSpec', 'down', 'status', 'terminate_replica',
+           'up']

@@ -1,0 +1,124 @@
+"""Autoscalers (analog of ``sky/serve/autoscalers.py``).
+
+``RequestRateAutoscaler``: target = ceil(qps /
+target_qps_per_replica), bounded to [min, max], applied with
+hysteresis — consecutive upscale/downscale observations must persist
+for the configured delays before acting (``:348-545`` in the
+reference).
+"""
+import dataclasses
+import enum
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = tpu_logging.init_logger(__name__)
+
+# QPS measured over this trailing window.
+QPS_WINDOW_SECONDS = 60.0
+
+
+class AutoscalerDecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+    NO_OP = 'no_op'
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    operator: AutoscalerDecisionOperator
+    target_num_replicas: int
+
+
+class Autoscaler:
+
+    def __init__(self, spec: SkyServiceSpec):
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+
+    def collect_request_information(self, request_ts: List[float]
+                                    ) -> None:
+        raise NotImplementedError
+
+    def evaluate_scaling(self, num_ready: int,
+                         now: Optional[float] = None
+                         ) -> AutoscalerDecision:
+        raise NotImplementedError
+
+
+class FixedReplicaAutoscaler(Autoscaler):
+    """No autoscaling: hold min_replicas."""
+
+    def collect_request_information(self, request_ts):
+        pass
+
+    def evaluate_scaling(self, num_ready, now=None):
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP,
+                                  self.spec.min_replicas)
+
+
+class RequestRateAutoscaler(Autoscaler):
+
+    def __init__(self, spec: SkyServiceSpec):
+        super().__init__(spec)
+        assert spec.target_qps_per_replica is not None
+        self.request_timestamps: List[float] = []
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def collect_request_information(self, request_ts: List[float]
+                                    ) -> None:
+        self.request_timestamps.extend(request_ts)
+
+    def _current_qps(self, now: float) -> float:
+        cutoff = now - QPS_WINDOW_SECONDS
+        self.request_timestamps = [
+            t for t in self.request_timestamps if t >= cutoff
+        ]
+        return len(self.request_timestamps) / QPS_WINDOW_SECONDS
+
+    def evaluate_scaling(self, num_ready: int,
+                         now: Optional[float] = None
+                         ) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self._current_qps(now)
+        desired = math.ceil(qps / self.spec.target_qps_per_replica) \
+            if qps > 0 else self.spec.min_replicas
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+
+        if desired > self.target_num_replicas:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= \
+                    self.spec.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_since = None
+                return AutoscalerDecision(
+                    AutoscalerDecisionOperator.SCALE_UP, desired)
+        elif desired < self.target_num_replicas:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= \
+                    self.spec.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_since = None
+                return AutoscalerDecision(
+                    AutoscalerDecisionOperator.SCALE_DOWN, desired)
+        else:
+            self._upscale_since = None
+            self._downscale_since = None
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP,
+                                  self.target_num_replicas)
+
+
+def make_autoscaler(spec: SkyServiceSpec) -> Autoscaler:
+    if spec.target_qps_per_replica is not None and \
+            spec.max_replicas > spec.min_replicas:
+        return RequestRateAutoscaler(spec)
+    return FixedReplicaAutoscaler(spec)

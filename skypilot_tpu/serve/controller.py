@@ -1,0 +1,131 @@
+"""Serve controller: replica manager + autoscaler loop + load
+balancer, one process per service (analog of
+``sky/serve/controller.py`` + ``service.py`` _start).
+"""
+import argparse
+import json
+import os
+import threading
+import time
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.autoscalers import (AutoscalerDecisionOperator,
+                                            make_autoscaler)
+from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
+from skypilot_tpu.serve.replica_managers import ReplicaManager
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+CONTROLLER_SYNC_INTERVAL = float(
+    os.environ.get('SKYTPU_SERVE_SYNC_SECONDS', '5'))
+
+
+class SkyServeController:
+
+    def __init__(self, service_name: str, task: Task,
+                 lb_port: int):
+        assert task.service is not None
+        self.service_name = service_name
+        self.spec: SkyServiceSpec = task.service
+        self.replica_manager = ReplicaManager(service_name, self.spec,
+                                              task)
+        self.autoscaler = make_autoscaler(self.spec)
+        self.load_balancer = SkyServeLoadBalancer(
+            lb_port, self.replica_manager.ready_endpoints)
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.REPLICA_INIT)
+        self.load_balancer.start()
+        serve_state.set_service_endpoint(
+            self.service_name,
+            f'http://127.0.0.1:{self.load_balancer.port}')
+        self.replica_manager.scale_up(self.spec.min_replicas)
+        self._loop()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_once(self) -> None:
+        """One control tick: probe replicas, feed QPS to the
+        autoscaler, apply scaling decisions, maintain service
+        status."""
+        records = self.replica_manager.probe_all()
+        ready = [r for r in records
+                 if r['status'] == ReplicaStatus.READY]
+        self.autoscaler.collect_request_information(
+            self.load_balancer.drain_request_timestamps())
+        decision = self.autoscaler.evaluate_scaling(len(ready))
+        if decision.operator == AutoscalerDecisionOperator.SCALE_UP:
+            need = decision.target_num_replicas - \
+                self.replica_manager.num_nonterminal()
+            if need > 0:
+                logger.info('Autoscaler: scale UP to %d (+%d)',
+                            decision.target_num_replicas, need)
+                self.replica_manager.scale_up(need)
+        elif decision.operator == \
+                AutoscalerDecisionOperator.SCALE_DOWN:
+            extra = self.replica_manager.num_nonterminal() - \
+                decision.target_num_replicas
+            if extra > 0:
+                victims = [r['replica_id'] for r in reversed(records)
+                           if not r['status'].is_terminal()][:extra]
+                logger.info('Autoscaler: scale DOWN to %d (-%s)',
+                            decision.target_num_replicas, victims)
+                self.replica_manager.scale_down(victims)
+        # Replica shortfall from failures (not autoscaling): keep at
+        # least target replicas provisioning.
+        shortfall = self.autoscaler.target_num_replicas - \
+            self.replica_manager.num_nonterminal()
+        if shortfall > 0:
+            self.replica_manager.scale_up(shortfall)
+        status = ServiceStatus.READY if ready else \
+            ServiceStatus.REPLICA_INIT
+        serve_state.set_service_status(self.service_name, status)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('controller tick failed')
+            self._stop.wait(CONTROLLER_SYNC_INTERVAL)
+        # Shutdown: terminate replicas + LB.
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.SHUTTING_DOWN)
+        self.replica_manager.terminate_all()
+        self.load_balancer.stop()
+        serve_state.set_service_status(self.service_name,
+                                       ServiceStatus.DOWN)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--task-yaml', required=True)
+    parser.add_argument('--lb-port', type=int, required=True)
+    args = parser.parse_args()
+    from skypilot_tpu.utils import common_utils
+    config = common_utils.read_yaml(args.task_yaml)
+    task = Task.from_yaml_config(config)
+    serve_state.set_service_controller_pid(args.service_name,
+                                           os.getpid())
+    controller = SkyServeController(args.service_name, task,
+                                    args.lb_port)
+
+    import signal
+
+    def _sigterm(_signum, _frame):
+        controller.stop()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    controller.start()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,142 @@
+"""Serve client API (analog of ``sky/serve/core.py``: up/down/status).
+
+``up`` starts one controller process per service (hosting the replica
+manager, autoscaler and load balancer) and waits for the endpoint.
+The controller runs as a local daemon process of the client machine
+rather than on a controller cluster in this round — replicas are full
+clusters either way; moving the controller itself onto a cluster
+reuses the managed-jobs recursion (see jobs/core.py) and is the
+planned next step.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import common_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def up(task: Task, service_name: Optional[str] = None,
+       wait_ready_timeout: float = 300.0) -> str:
+    """Start a service; returns the endpoint URL."""
+    if task.service is None:
+        raise exceptions.InvalidSpecError(
+            'Task has no service: section.')
+    if service_name is None:
+        service_name = task.name or 'service'
+    common_utils.check_cluster_name_is_valid(service_name)
+    if serve_state.get_service(service_name) is not None:
+        raise exceptions.InvalidSpecError(
+            f'Service {service_name!r} already exists; use update or '
+            'down first.')
+
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(os.path.join(state_dir, 'services'), exist_ok=True)
+    task_yaml = os.path.join(state_dir, 'services',
+                             f'{service_name}.yaml')
+    common_utils.dump_yaml(task_yaml, task.to_yaml_config())
+    serve_state.add_service(service_name,
+                            json.dumps(task.service.to_yaml_config()))
+
+    lb_port = _free_port()
+    log_path = os.path.join(state_dir, 'services',
+                            f'{service_name}.controller.log')
+    env = dict(os.environ)
+    env['SKYTPU_STATE_DIR'] = state_dir
+    with open(log_path, 'a', encoding='utf-8') as logf:
+        proc = subprocess.Popen(
+            ['python3', '-m', 'skypilot_tpu.serve.controller',
+             '--service-name', service_name, '--task-yaml', task_yaml,
+             '--lb-port', str(lb_port)],
+            stdout=logf, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+    serve_state.set_service_controller_pid(service_name, proc.pid)
+
+    endpoint = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + wait_ready_timeout
+    while time.time() < deadline:
+        rec = serve_state.get_service(service_name)
+        if rec is not None and rec['status'] == ServiceStatus.READY:
+            logger.info('Service %s READY at %s', service_name,
+                        endpoint)
+            return endpoint
+        if proc.poll() is not None:
+            raise exceptions.SkyTpuError(
+                f'Serve controller died (see {log_path})')
+        time.sleep(1.0)
+    raise TimeoutError(
+        f'Service {service_name} not READY after '
+        f'{wait_ready_timeout}s (see {log_path})')
+
+
+def down(service_name: str, timeout: float = 120.0) -> None:
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    pid = rec['controller_pid']
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pid = None
+    deadline = time.time() + timeout
+    while pid and time.time() < deadline:
+        rec = serve_state.get_service(service_name)
+        if rec is None or rec['status'] in (ServiceStatus.DOWN,):
+            break
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.5)
+    # Force-clean any replicas the controller did not get to.
+    from skypilot_tpu import core as core_lib
+    for replica in serve_state.get_replicas(service_name):
+        try:
+            core_lib.down(replica['cluster_name'], purge=True)
+        except exceptions.SkyTpuError:
+            pass
+    serve_state.remove_service(service_name)
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    services = ([serve_state.get_service(service_name)]
+                if service_name else serve_state.get_services())
+    out = []
+    for svc in services:
+        if svc is None:
+            continue
+        svc = dict(svc)
+        svc['replicas'] = serve_state.get_replicas(svc['name'])
+        out.append(svc)
+    return out
+
+
+def terminate_replica(service_name: str, replica_id: int) -> None:
+    """Manually kill one replica (the controller will replace it)."""
+    from skypilot_tpu import core as core_lib
+    replicas = serve_state.get_replicas(service_name)
+    target = next((r for r in replicas
+                   if r['replica_id'] == replica_id), None)
+    if target is None:
+        raise exceptions.InvalidSpecError(
+            f'No replica {replica_id} in service {service_name!r}')
+    core_lib.down(target['cluster_name'], purge=True)

@@ -1,0 +1,161 @@
+"""Load balancer: stdlib HTTP proxy in front of ready replicas
+(analog of ``sky/serve/load_balancer.py`` — FastAPI there; stdlib
+ThreadingHTTPServer here since this tree vendors no web framework).
+
+Policies (``sky/serve/load_balancing_policies.py``): round-robin and
+least-load (default).
+"""
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class LoadBalancingPolicy:
+
+    def select(self, endpoints: List[str]) -> Optional[str]:
+        raise NotImplementedError
+
+    def on_request_start(self, endpoint: str) -> None:
+        pass
+
+    def on_request_end(self, endpoint: str) -> None:
+        pass
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self):
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def select(self, endpoints):
+        if not endpoints:
+            return None
+        with self._lock:
+            endpoint = endpoints[self._idx % len(endpoints)]
+            self._idx += 1
+        return endpoint
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Default: route to the replica with fewest in-flight requests."""
+
+    def __init__(self):
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def select(self, endpoints):
+        if not endpoints:
+            return None
+        with self._lock:
+            return min(endpoints,
+                       key=lambda e: self._inflight.get(e, 0))
+
+    def on_request_start(self, endpoint):
+        with self._lock:
+            self._inflight[endpoint] = \
+                self._inflight.get(endpoint, 0) + 1
+
+    def on_request_end(self, endpoint):
+        with self._lock:
+            self._inflight[endpoint] = max(
+                0, self._inflight.get(endpoint, 0) - 1)
+
+
+class SkyServeLoadBalancer:
+    """Listens on the service port, proxies to ready replicas, records
+    request timestamps for the autoscaler's QPS window."""
+
+    def __init__(self, port: int,
+                 get_ready_endpoints: Callable[[], List[str]],
+                 policy: Optional[LoadBalancingPolicy] = None):
+        self.port = port
+        self.get_ready_endpoints = get_ready_endpoints
+        self.policy = policy or LeastLoadPolicy()
+        self.request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def drain_request_timestamps(self) -> List[float]:
+        with self._ts_lock:
+            out = self.request_timestamps
+            self.request_timestamps = []
+        return out
+
+    def start(self) -> None:
+        lb = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _proxy(self, method: str):
+                with lb._ts_lock:  # pylint: disable=protected-access
+                    lb.request_timestamps.append(time.time())
+                endpoint = lb.policy.select(lb.get_ready_endpoints())
+                if endpoint is None:
+                    body = b'No ready replicas.'
+                    self.send_response(503)
+                    self.send_header('Content-Length',
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                length = int(self.headers.get('Content-Length', '0'))
+                data = self.rfile.read(length) if length else None
+                url = endpoint.rstrip('/') + self.path
+                req = urllib.request.Request(url, data=data,
+                                             method=method)
+                for k, v in self.headers.items():
+                    if k.lower() not in ('host', 'content-length'):
+                        req.add_header(k, v)
+                lb.policy.on_request_start(endpoint)
+                try:
+                    with urllib.request.urlopen(req,
+                                                timeout=120) as resp:
+                        payload = resp.read()
+                        self.send_response(resp.status)
+                        for k, v in resp.headers.items():
+                            if k.lower() in ('content-type',):
+                                self.send_header(k, v)
+                        self.send_header('Content-Length',
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                except (urllib.error.URLError, OSError) as e:
+                    body = f'Replica error: {e}'.encode()
+                    self.send_response(502)
+                    self.send_header('Content-Length',
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                finally:
+                    lb.policy.on_request_end(endpoint)
+
+            def do_GET(self):  # noqa: N802
+                self._proxy('GET')
+
+            def do_POST(self):  # noqa: N802
+                self._proxy('POST')
+
+        self._server = ThreadingHTTPServer(('0.0.0.0', self.port),
+                                           Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info('Load balancer listening on :%d', self.port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

@@ -1,0 +1,186 @@
+"""Replica manager (analog of ``sky/serve/replica_managers.py``).
+
+Launches/terminates replica clusters (each replica is an ordinary
+cluster running the service task), probes readiness over HTTP, and
+recovers preempted replicas.
+"""
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class ReplicaManager:
+
+    def __init__(self, service_name: str, spec: SkyServiceSpec,
+                 task: Task):
+        self.service_name = service_name
+        self.spec = spec
+        self.task = task
+        self._next_replica_id = 1
+        self._lock = threading.Lock()
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        # Local-provider port allocation: each replica gets its own
+        # service port (one machine hosts all fake replicas).
+        self._is_local = any(r.cloud == 'local'
+                             for r in task.resources)
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-replica-{replica_id}'
+
+    def _replica_port(self, replica_id: int) -> int:
+        if self._is_local:
+            return self.spec.port + replica_id
+        return self.spec.port
+
+    def scale_up(self, n: int = 1) -> List[int]:
+        ids = []
+        with self._lock:
+            for _ in range(n):
+                replica_id = self._next_replica_id
+                self._next_replica_id += 1
+                ids.append(replica_id)
+        for replica_id in ids:
+            serve_state.upsert_replica(
+                self.service_name, replica_id,
+                self._cluster_name(replica_id),
+                ReplicaStatus.PROVISIONING)
+            thread = threading.Thread(
+                target=self._launch_replica, args=(replica_id,),
+                daemon=True)
+            self._launch_threads[replica_id] = thread
+            thread.start()
+        return ids
+
+    def _launch_replica(self, replica_id: int) -> None:
+        cluster_name = self._cluster_name(replica_id)
+        port = self._replica_port(replica_id)
+        task = Task(
+            name=f'{self.service_name}-r{replica_id}',
+            run=self.task.run,
+            setup=self.task.setup,
+            envs={**self.task.envs,
+                  'SKYTPU_REPLICA_PORT': str(port),
+                  'SKYTPU_REPLICA_ID': str(replica_id)},
+            workdir=self.task.workdir,
+        )
+        task.set_resources(set(self.task.resources))
+        try:
+            execution.launch(task, cluster_name, detach_run=True,
+                             quiet_optimizer=True)
+        except exceptions.SkyTpuError as e:
+            logger.error('Replica %d launch failed: %s', replica_id, e)
+            serve_state.set_replica_status(self.service_name,
+                                           replica_id,
+                                           ReplicaStatus.FAILED)
+            return
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            serve_state.set_replica_status(self.service_name,
+                                           replica_id,
+                                           ReplicaStatus.FAILED)
+            return
+        ip = record['handle'].head_ip
+        endpoint = f'http://{ip}:{port}'
+        serve_state.upsert_replica(self.service_name, replica_id,
+                                   cluster_name,
+                                   ReplicaStatus.STARTING, endpoint)
+
+    def scale_down(self, replica_ids: List[int]) -> None:
+        for replica_id in replica_ids:
+            serve_state.set_replica_status(self.service_name,
+                                           replica_id,
+                                           ReplicaStatus.SHUTTING_DOWN)
+            try:
+                core_lib.down(self._cluster_name(replica_id),
+                              purge=True)
+            except exceptions.ClusterDoesNotExist:
+                pass
+            serve_state.remove_replica(self.service_name, replica_id)
+
+    def terminate_all(self) -> None:
+        for rec in serve_state.get_replicas(self.service_name):
+            self.scale_down([rec['replica_id']])
+
+    # -- probing --------------------------------------------------------
+
+    def probe(self, endpoint: str) -> bool:
+        url = endpoint.rstrip('/') + self.spec.readiness_path
+        try:
+            with urllib.request.urlopen(
+                    url,
+                    timeout=self.spec.readiness_timeout_seconds) as r:
+                return 200 <= r.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    def probe_all(self) -> List[Dict]:
+        """Probe every non-terminal replica; update statuses; detect
+        preemption (cluster gone) and relaunch."""
+        records = serve_state.get_replicas(self.service_name)
+        for rec in records:
+            rid = rec['replica_id']
+            if rec['status'] in (ReplicaStatus.PROVISIONING,
+                                 ReplicaStatus.SHUTTING_DOWN):
+                continue
+            if rec['status'].is_terminal():
+                continue
+            cluster = state.get_cluster_from_name(rec['cluster_name'])
+            if cluster is None:
+                logger.warning('Replica %d cluster gone (preempted); '
+                               'relaunching', rid)
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.PREEMPTED)
+                serve_state.remove_replica(self.service_name, rid)
+                self.scale_up(1)
+                continue
+            ready = rec['endpoint'] is not None and \
+                self.probe(rec['endpoint'])
+            if ready:
+                if rec['status'] != ReplicaStatus.READY:
+                    logger.info('Replica %d READY at %s', rid,
+                                rec['endpoint'])
+                serve_state.set_replica_status(self.service_name, rid,
+                                               ReplicaStatus.READY)
+            else:
+                grace = time.time() - (rec['launched_at'] or 0) < \
+                    self.spec.initial_delay_seconds
+                if rec['status'] == ReplicaStatus.READY:
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.NOT_READY)
+                elif not grace and rec['status'] in (
+                        ReplicaStatus.STARTING,
+                        ReplicaStatus.NOT_READY):
+                    logger.warning(
+                        'Replica %d failed readiness after initial '
+                        'delay', rid)
+                    serve_state.set_replica_status(
+                        self.service_name, rid, ReplicaStatus.FAILED)
+        return serve_state.get_replicas(self.service_name)
+
+    def ready_endpoints(self) -> List[str]:
+        return [
+            r['endpoint']
+            for r in serve_state.get_replicas(self.service_name)
+            if r['status'] == ReplicaStatus.READY and r['endpoint']
+        ]
+
+    def num_nonterminal(self) -> int:
+        return len([
+            r for r in serve_state.get_replicas(self.service_name)
+            if not r['status'].is_terminal() and
+            r['status'] != ReplicaStatus.SHUTTING_DOWN
+        ])

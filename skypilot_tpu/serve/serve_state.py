@@ -1,0 +1,167 @@
+"""Serve-side state DB (analog of ``sky/serve/serve_state.py``)."""
+import enum
+import time
+from typing import Any, Dict, List, Optional
+
+import os
+
+from skypilot_tpu.utils import db_utils
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    FAILED = 'FAILED'
+    PREEMPTED = 'PREEMPTED'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    TERMINATED = 'TERMINATED'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.TERMINATED)
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    DOWN = 'DOWN'
+
+
+def _db_path() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(base, 'serve.db')
+
+
+def _create_tables(cursor, conn):
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS services (
+        name TEXT PRIMARY KEY,
+        status TEXT,
+        created_at REAL,
+        spec_json TEXT,
+        endpoint TEXT,
+        controller_pid INTEGER)""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        cluster_name TEXT,
+        status TEXT,
+        endpoint TEXT,
+        launched_at REAL,
+        PRIMARY KEY (service_name, replica_id))""")
+    conn.commit()
+
+
+_conns: Dict[str, db_utils.SQLiteConn] = {}
+
+
+def _db() -> db_utils.SQLiteConn:
+    path = _db_path()
+    conn = _conns.get(path)
+    if conn is None or conn.db_path != path:
+        conn = db_utils.SQLiteConn(path, _create_tables)
+        _conns[path] = conn
+    return conn
+
+
+def add_service(name: str, spec_json: str) -> None:
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO services (name, status, created_at, '
+        'spec_json) VALUES (?,?,?,?)',
+        (name, ServiceStatus.CONTROLLER_INIT.value, time.time(),
+         spec_json))
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    _db().execute_and_commit(
+        'UPDATE services SET status=? WHERE name=?',
+        (status.value, name))
+
+
+def set_service_endpoint(name: str, endpoint: str) -> None:
+    _db().execute_and_commit(
+        'UPDATE services SET endpoint=? WHERE name=?',
+        (endpoint, name))
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    _db().execute_and_commit(
+        'UPDATE services SET controller_pid=? WHERE name=?',
+        (pid, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().cursor.execute(
+        'SELECT name, status, created_at, spec_json, endpoint, '
+        'controller_pid FROM services WHERE name=?',
+        (name,)).fetchone()
+    if row is None:
+        return None
+    return {
+        'name': row[0],
+        'status': ServiceStatus(row[1]),
+        'created_at': row[2],
+        'spec_json': row[3],
+        'endpoint': row[4],
+        'controller_pid': row[5],
+    }
+
+
+def get_services() -> List[Dict[str, Any]]:
+    rows = _db().cursor.execute('SELECT name FROM services').fetchall()
+    return [get_service(r[0]) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    _db().execute_and_commit('DELETE FROM services WHERE name=?',
+                             (name,))
+    _db().execute_and_commit(
+        'DELETE FROM replicas WHERE service_name=?', (name,))
+
+
+def upsert_replica(service_name: str, replica_id: int,
+                   cluster_name: str, status: ReplicaStatus,
+                   endpoint: Optional[str] = None) -> None:
+    _db().execute_and_commit(
+        'INSERT INTO replicas (service_name, replica_id, '
+        'cluster_name, status, endpoint, launched_at) '
+        'VALUES (?,?,?,?,?,?) '
+        'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
+        'cluster_name=excluded.cluster_name, status=excluded.status, '
+        'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
+        (service_name, replica_id, cluster_name, status.value,
+         endpoint, time.time()))
+
+
+def set_replica_status(service_name: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    _db().execute_and_commit(
+        'UPDATE replicas SET status=? WHERE service_name=? AND '
+        'replica_id=?', (status.value, service_name, replica_id))
+
+
+def get_replicas(service_name: str) -> List[Dict[str, Any]]:
+    rows = _db().cursor.execute(
+        'SELECT replica_id, cluster_name, status, endpoint, '
+        'launched_at FROM replicas WHERE service_name=? '
+        'ORDER BY replica_id', (service_name,)).fetchall()
+    return [{
+        'replica_id': r[0],
+        'cluster_name': r[1],
+        'status': ReplicaStatus(r[2]),
+        'endpoint': r[3],
+        'launched_at': r[4],
+    } for r in rows]
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    _db().execute_and_commit(
+        'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+        (service_name, replica_id))

@@ -1,0 +1,115 @@
+"""Service spec: the ``service:`` YAML section (analog of
+``sky/serve/service_spec.py``)."""
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_PROBE_TIMEOUT_SECONDS = 15
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+
+
+class SkyServiceSpec:
+
+    def __init__(
+        self,
+        readiness_path: str = '/',
+        initial_delay_seconds: int = DEFAULT_INITIAL_DELAY_SECONDS,
+        readiness_timeout_seconds: int = DEFAULT_PROBE_TIMEOUT_SECONDS,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        target_qps_per_replica: Optional[float] = None,
+        upscale_delay_seconds: int = DEFAULT_UPSCALE_DELAY_SECONDS,
+        downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS,
+        port: int = 8080,
+        base_ondemand_fallback_replicas: int = 0,
+    ):
+        if min_replicas < 0:
+            raise exceptions.InvalidSpecError('min_replicas must be '
+                                              '>= 0')
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise exceptions.InvalidSpecError(
+                'max_replicas must be >= min_replicas')
+        if target_qps_per_replica is not None and \
+                target_qps_per_replica <= 0:
+            raise exceptions.InvalidSpecError(
+                'target_qps_per_replica must be > 0')
+        if max_replicas is not None and max_replicas > min_replicas \
+                and target_qps_per_replica is None:
+            raise exceptions.InvalidSpecError(
+                'Autoscaling (max_replicas > min_replicas) requires '
+                'target_qps_per_replica.')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas if max_replicas is not None \
+            else min_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.port = port
+        self.base_ondemand_fallback_replicas = \
+            base_ondemand_fallback_replicas
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]
+                         ) -> 'SkyServiceSpec':
+        config = dict(config or {})
+        probe = config.pop('readiness_probe', '/')
+        if isinstance(probe, str):
+            probe_cfg = {'path': probe}
+        else:
+            probe_cfg = dict(probe)
+        policy = dict(config.pop('replica_policy', {}) or {})
+        replicas = config.pop('replicas', None)
+        if replicas is not None:
+            policy.setdefault('min_replicas', replicas)
+        port = config.pop('port', 8080)
+        if config:
+            raise exceptions.InvalidSpecError(
+                f'Unknown service fields: {sorted(config)}')
+        return cls(
+            readiness_path=probe_cfg.get('path', '/'),
+            initial_delay_seconds=probe_cfg.get(
+                'initial_delay_seconds', DEFAULT_INITIAL_DELAY_SECONDS),
+            readiness_timeout_seconds=probe_cfg.get(
+                'timeout_seconds', DEFAULT_PROBE_TIMEOUT_SECONDS),
+            min_replicas=policy.get('min_replicas', 1),
+            max_replicas=policy.get('max_replicas'),
+            target_qps_per_replica=policy.get(
+                'target_qps_per_replica'),
+            upscale_delay_seconds=policy.get(
+                'upscale_delay_seconds', DEFAULT_UPSCALE_DELAY_SECONDS),
+            downscale_delay_seconds=policy.get(
+                'downscale_delay_seconds',
+                DEFAULT_DOWNSCALE_DELAY_SECONDS),
+            port=int(port),
+            base_ondemand_fallback_replicas=policy.get(
+                'base_ondemand_fallback_replicas', 0),
+        )
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+            },
+            'port': self.port,
+        }
+        rp = out['replica_policy']
+        if self.target_qps_per_replica is not None:
+            rp['target_qps_per_replica'] = self.target_qps_per_replica
+            rp['upscale_delay_seconds'] = self.upscale_delay_seconds
+            rp['downscale_delay_seconds'] = \
+                self.downscale_delay_seconds
+        if self.base_ondemand_fallback_replicas:
+            rp['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+        return out

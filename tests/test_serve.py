@@ -1,0 +1,179 @@
+"""Serve tests: spec, autoscaler decisions (model:
+``tests/test_serve_autoscaler.py``), LB policies, and an end-to-end
+service on the local fake cloud with replica recovery."""
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.serve import autoscalers, load_balancer, serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+class TestServiceSpec:
+
+    def test_yaml_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 10},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                               'target_qps_per_replica': 2.0},
+            'port': 9000,
+        })
+        assert spec.readiness_path == '/health'
+        assert spec.max_replicas == 4
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.port == 9000
+        assert spec2.target_qps_per_replica == 2.0
+
+    def test_shorthand_probe(self):
+        spec = SkyServiceSpec.from_yaml_config(
+            {'readiness_probe': '/ping', 'replicas': 2})
+        assert spec.readiness_path == '/ping'
+        assert spec.min_replicas == 2
+        assert spec.max_replicas == 2
+
+    def test_autoscaling_requires_qps_target(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(min_replicas=1, max_replicas=3)
+
+    def test_bad_replica_counts(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(min_replicas=3, max_replicas=1)
+
+
+class TestAutoscaler:
+
+    def _spec(self, **kw):
+        defaults = dict(min_replicas=1, max_replicas=4,
+                        target_qps_per_replica=1.0,
+                        upscale_delay_seconds=10,
+                        downscale_delay_seconds=20)
+        defaults.update(kw)
+        return SkyServiceSpec(**defaults)
+
+    def test_scale_up_after_delay(self):
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        t0 = 1000.0
+        # 3 QPS sustained -> want 3 replicas.
+        a.collect_request_information(
+            [t0 + i / 3.0 for i in range(180)])
+        d1 = a.evaluate_scaling(1, now=t0 + 60)
+        assert d1.operator == \
+            autoscalers.AutoscalerDecisionOperator.NO_OP  # hysteresis
+        d2 = a.evaluate_scaling(1, now=t0 + 71)
+        assert d2.operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+        assert d2.target_num_replicas == 3
+
+    def test_respects_max(self):
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        t0 = 2000.0
+        a.collect_request_information(
+            [t0 + i / 100.0 for i in range(6000)])  # 100 qps
+        a.evaluate_scaling(1, now=t0 + 60)
+        d = a.evaluate_scaling(1, now=t0 + 71)
+        assert d.target_num_replicas == 4  # capped at max
+
+    def test_scale_down_after_delay(self):
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        a.target_num_replicas = 3
+        t0 = 3000.0
+        d1 = a.evaluate_scaling(3, now=t0)
+        assert d1.operator == \
+            autoscalers.AutoscalerDecisionOperator.NO_OP
+        d2 = a.evaluate_scaling(3, now=t0 + 21)
+        assert d2.operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_DOWN
+        assert d2.target_num_replicas == 1
+
+    def test_oscillation_resets_hysteresis(self):
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        t0 = 4000.0
+        a.collect_request_information(
+            [t0 + i / 3.0 for i in range(180)])
+        a.evaluate_scaling(1, now=t0 + 60)  # starts upscale window
+        # Load vanishes: the QPS window ages out; upscale timer must
+        # reset, not fire.
+        d = a.evaluate_scaling(1, now=t0 + 200)
+        assert d.operator != \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+
+    def test_fixed_autoscaler(self):
+        spec = SkyServiceSpec(min_replicas=2)
+        a = autoscalers.make_autoscaler(spec)
+        assert isinstance(a, autoscalers.FixedReplicaAutoscaler)
+        d = a.evaluate_scaling(0)
+        assert d.target_num_replicas == 2
+
+
+class TestLoadBalancerPolicies:
+
+    def test_round_robin(self):
+        p = load_balancer.RoundRobinPolicy()
+        eps = ['a', 'b', 'c']
+        assert [p.select(eps) for _ in range(4)] == ['a', 'b', 'c',
+                                                     'a']
+        assert p.select([]) is None
+
+    def test_least_load(self):
+        p = load_balancer.LeastLoadPolicy()
+        eps = ['a', 'b']
+        e1 = p.select(eps)
+        p.on_request_start(e1)
+        e2 = p.select(eps)
+        assert e2 != e1
+        p.on_request_start(e2)
+        p.on_request_end(e1)
+        assert p.select(eps) == e1
+
+
+@pytest.mark.slow
+class TestServeEndToEnd:
+
+    def test_service_lifecycle_with_recovery(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        task = Task(
+            name='echo-svc',
+            run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
+                 '--bind 127.0.0.1'))
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=60,
+            readiness_timeout_seconds=3, min_replicas=1, port=18200)
+
+        endpoint = serve_api.up(task, 'echosvc',
+                                wait_ready_timeout=120)
+        try:
+            with urllib.request.urlopen(endpoint, timeout=10) as r:
+                assert r.status == 200
+            replicas = serve_state.get_replicas('echosvc')
+            assert len(replicas) == 1
+            assert replicas[0]['status'] == \
+                serve_state.ReplicaStatus.READY
+
+            # Kill the replica; controller must relaunch a new one.
+            serve_api.terminate_replica('echosvc', 1)
+            deadline = time.time() + 120
+            recovered = False
+            while time.time() < deadline:
+                replicas = serve_state.get_replicas('echosvc')
+                ready = [r for r in replicas if r['status'] ==
+                         serve_state.ReplicaStatus.READY]
+                if ready and ready[0]['replica_id'] != 1:
+                    recovered = True
+                    break
+                time.sleep(1)
+            assert recovered, replicas
+            with urllib.request.urlopen(endpoint, timeout=10) as r:
+                assert r.status == 200
+        finally:
+            serve_api.down('echosvc')
+        assert serve_state.get_service('echosvc') is None
