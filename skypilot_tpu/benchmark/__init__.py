@@ -1,0 +1,6 @@
+"""Benchmark harness (analog of ``sky/benchmark/``): launch the same
+task on N candidate slices in parallel and compare $/step."""
+from skypilot_tpu.benchmark.benchmark_utils import (BenchmarkResult,
+                                                    launch_benchmark)
+
+__all__ = ['BenchmarkResult', 'launch_benchmark']

@@ -1,0 +1,132 @@
+"""Launch one task on several candidate slices and compare (analog of
+``sky/benchmark/benchmark_utils.py`` + ``benchmark_state.py``).
+
+Each candidate gets its own cluster ``bench-<name>-<i>``; the task
+should call ``skypilot_tpu.callbacks`` so per-step timing lands in
+the benchmark log, which is pulled back through the head agent after
+the run. Results: duration, avg step seconds, $/step, $ to K steps.
+"""
+import dataclasses
+import json
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.task import Task
+
+logger = tpu_logging.init_logger(__name__)
+
+CALLBACK_DIR = '~/sky_benchmark_dir'
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    candidate: Resources
+    cluster_name: str
+    job_status: Optional[job_lib.JobStatus] = None
+    duration_seconds: Optional[float] = None
+    num_steps: Optional[int] = None
+    avg_step_seconds: Optional[float] = None
+    price_per_hour: Optional[float] = None
+    cost_per_step: Optional[float] = None
+    error: Optional[str] = None
+
+
+def _run_one(task: Task, candidate: Resources, cluster_name: str,
+             result: BenchmarkResult, timeout: float) -> None:
+    bench_task = Task(name=task.name, run=task.run, setup=task.setup,
+                      envs=dict(task.envs), workdir=task.workdir,
+                      num_nodes=task.num_nodes)
+    bench_task.set_resources(candidate)
+    try:
+        job_id, handle = execution.launch(bench_task, cluster_name,
+                                          detach_run=True,
+                                          quiet_optimizer=True)
+        status = core_lib.wait_for_job(cluster_name, job_id,
+                                       timeout=timeout)
+        result.job_status = status
+        rec = state.get_cluster_from_name(cluster_name)
+        if rec is not None:
+            import time as _time
+            result.duration_seconds = \
+                _time.time() - rec['launched_at']
+        result.price_per_hour = candidate.get_hourly_price() \
+            if candidate.accelerator else None
+        _collect_callback_log(handle, result)
+    except (exceptions.SkyTpuError, TimeoutError) as e:
+        result.error = str(e)
+    finally:
+        try:
+            core_lib.down(cluster_name, purge=True)
+        except exceptions.SkyTpuError:
+            pass
+
+
+def _collect_callback_log(handle, result: BenchmarkResult) -> None:
+    """Pull the callback JSON from the head over the agent channel."""
+    try:
+        head = handle.head_agent()
+        # The callback dir is under the head's HOME (or runtime dir
+        # for the local provider).
+        for base in (CALLBACK_DIR,
+                     f'{handle.head_runtime_dir}/sky_benchmark_dir'):
+            data = head.read_file(f'{base}/skytpu_callback.json')
+            if data:
+                payload = json.loads(data)
+                result.num_steps = payload.get('num_steps')
+                result.avg_step_seconds = payload.get(
+                    'avg_step_seconds')
+                break
+    except (OSError, ValueError):
+        return
+    if result.avg_step_seconds and result.price_per_hour:
+        result.cost_per_step = (result.price_per_hour / 3600.0 *
+                                result.avg_step_seconds)
+
+
+def launch_benchmark(task: Task, candidates: List[Resources],
+                     benchmark_name: str = 'bench',
+                     timeout: float = 3600.0
+                     ) -> List[BenchmarkResult]:
+    """Run the task once per candidate (parallel), returning one
+    result per candidate, cheapest-$-per-step first."""
+    results = []
+    threads = []
+    for i, candidate in enumerate(candidates):
+        cluster_name = f'{benchmark_name}-{i}'
+        result = BenchmarkResult(candidate=candidate,
+                                 cluster_name=cluster_name)
+        results.append(result)
+        t = threading.Thread(target=_run_one,
+                             args=(task, candidate, cluster_name,
+                                   result, timeout))
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    results.sort(key=lambda r: (r.cost_per_step is None,
+                                r.cost_per_step or 0))
+    return results
+
+
+def format_results(results: List[BenchmarkResult]) -> str:
+    from skypilot_tpu.utils import ux_utils
+    table = ux_utils.Table(['CANDIDATE', 'STATUS', 'STEPS',
+                            'SEC/STEP', '$/HR', '$/STEP'])
+    for r in results:
+        accel = r.candidate.accelerator or 'cpu-vm'
+        table.add_row([
+            accel,
+            (r.job_status.value if r.job_status else
+             (r.error or '-')[:30]),
+            r.num_steps if r.num_steps is not None else '-',
+            f'{r.avg_step_seconds:.3f}'
+            if r.avg_step_seconds else '-',
+            f'{r.price_per_hour:.2f}' if r.price_per_hour else '-',
+            f'{r.cost_per_step:.6f}' if r.cost_per_step else '-',
+        ])
+    return table.get_string()

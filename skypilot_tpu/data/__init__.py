@@ -1,0 +1,5 @@
+"""Data layer: bucket storage, FUSE mounts, checkpointing (analog of
+``sky/data/``)."""
+from skypilot_tpu.data.storage import Storage, StorageMode, StoreType
+
+__all__ = ['Storage', 'StorageMode', 'StoreType']

@@ -1,0 +1,85 @@
+"""First-class async checkpointing for spot resumption.
+
+The reference has NO checkpoint code — its pattern is user-level
+(mount a bucket, write checkpoints there; recipes demonstrate it,
+``llm/llama-3_1-finetuning/lora.yaml:24-31``), with
+``SKYPILOT_TASK_ID`` distinguishing runs. This module upgrades that
+pattern to a library: orbax async checkpointing into the mounted
+bucket path, keyed by task id, with restore-latest on (re)start —
+exactly what a managed job needs to survive TPU spot preemption.
+
+Usage in a training loop::
+
+    ckpt = CheckpointManager('/checkpoints')   # a mounted bucket
+    state, start_step = ckpt.restore_or(state)
+    for step in range(start_step, total):
+        state, metrics = train_step(state, batch)
+        ckpt.maybe_save(step, state)
+    ckpt.wait()
+"""
+import os
+from typing import Any, Optional, Tuple
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def task_checkpoint_dir(base_dir: str) -> str:
+    """Namespace checkpoints by the env-contract task id so retries
+    of the same managed job share a lineage while unrelated runs do
+    not collide."""
+    task_id = os.environ.get('SKYTPU_TASK_ID',
+                             os.environ.get('SKYPILOT_TASK_ID',
+                                            'default'))
+    # Recovery runs share the lineage: strip trailing retry counters.
+    return os.path.join(os.path.expanduser(base_dir), task_id)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with sane defaults for slice training."""
+
+    def __init__(self, base_dir: str, save_interval_steps: int = 100,
+                 max_to_keep: int = 3,
+                 use_task_namespace: bool = True):
+        import orbax.checkpoint as ocp
+
+        path = (task_checkpoint_dir(base_dir) if use_task_namespace
+                else os.path.expanduser(base_dir))
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=save_interval_steps,
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=True,
+        )
+        self._manager = ocp.CheckpointManager(path, options=options)
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Save if the step hits the interval; async (training
+        continues while the write streams to the bucket)."""
+        import orbax.checkpoint as ocp
+        return self._manager.save(
+            step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore_or(self, state: Any) -> Tuple[Any, int]:
+        """Restore the latest checkpoint if one exists; returns
+        (state, next_step)."""
+        import orbax.checkpoint as ocp
+        step = self.latest_step()
+        if step is None:
+            return state, 0
+        logger.info('Restoring checkpoint step %d from %s', step,
+                    self.path)
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(state))
+        return restored, step + 1
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()

@@ -1,0 +1,44 @@
+"""FUSE mount command builders (analog of
+``sky/data/mounting_utils.py:25-265``) — GCS-first: gcsfuse."""
+import textwrap
+
+GCSFUSE_VERSION = '2.4.0'
+
+_INSTALL_GCSFUSE = textwrap.dedent('''\
+    if ! command -v gcsfuse > /dev/null; then
+      export GCSFUSE_REPO=gcsfuse-$(lsb_release -c -s 2>/dev/null || echo jammy)
+      echo "deb https://packages.cloud.google.com/apt $GCSFUSE_REPO main" | \\
+        sudo tee /etc/apt/sources.list.d/gcsfuse.list > /dev/null
+      curl -s https://packages.cloud.google.com/apt/doc/apt-key.gpg | \\
+        sudo apt-key add - > /dev/null 2>&1
+      sudo apt-get update -qq && sudo apt-get install -y -qq gcsfuse
+    fi''')
+
+
+def get_gcs_mount_cmd(bucket_name: str, mount_path: str) -> str:
+    """Idempotent gcsfuse mount script, run on every host (the
+    reference wraps mounts in the same check-install-mount shape,
+    ``get_mounting_script:265``)."""
+    return textwrap.dedent(f'''\
+        {_INSTALL_GCSFUSE}
+        sudo mkdir -p {mount_path}
+        sudo chown $(id -u):$(id -g) {mount_path}
+        if ! mountpoint -q {mount_path}; then
+          gcsfuse --implicit-dirs \\
+            --stat-cache-ttl 10s --type-cache-ttl 10s \\
+            --rename-dir-limit 10000 \\
+            {bucket_name} {mount_path}
+        fi''')
+
+
+def get_gcs_copy_cmd(bucket_name: str, mount_path: str) -> str:
+    """COPY mode: one-time sync onto local disk."""
+    return textwrap.dedent(f'''\
+        mkdir -p {mount_path}
+        gsutil -m rsync -r gs://{bucket_name} {mount_path}''')
+
+
+def get_umount_cmd(mount_path: str) -> str:
+    return (f'if mountpoint -q {mount_path}; then '
+            f'fusermount -u {mount_path} || sudo umount {mount_path};'
+            f' fi')

@@ -1,0 +1,205 @@
+"""Storage abstraction (analog of ``sky/data/storage.py:473``).
+
+GCS-first (the TPU-native cloud); the store executes transfers with
+the ``gsutil``/``gcloud storage`` CLIs, and MOUNT mode renders a
+gcsfuse mount script run on every host
+(``skypilot_tpu/data/mounting_utils.py``).
+"""
+import enum
+import os
+import re
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, state
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,220}[a-z0-9]$')
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        if url.startswith('gs://'):
+            return cls.GCS
+        raise exceptions.StorageSourceError(
+            f'Unsupported store URL {url!r} (gs:// only — this '
+            'framework is GCS-first).')
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+def validate_bucket_name(name: str) -> None:
+    if not _BUCKET_NAME_RE.fullmatch(name) or '..' in name:
+        raise exceptions.StorageNameError(
+            f'Invalid bucket name {name!r}: must be 3-222 chars of '
+            'lowercase letters, numbers, dashes, dots, underscores; '
+            'start/end alphanumeric.')
+    if name.startswith('goog') or 'google' in name:
+        raise exceptions.StorageNameError(
+            f'Bucket name {name!r} may not contain "google" or start '
+            'with "goog" (GCS restriction).')
+
+
+class GcsStore:
+    """One GCS bucket (analog of ``GcsStore``,
+    ``sky/data/storage.py:1725``)."""
+
+    def __init__(self, name: str, source: Optional[str] = None,
+                 region: str = 'us-central1'):
+        validate_bucket_name(name)
+        self.name = name
+        self.source = source
+        self.region = region
+
+    @property
+    def url(self) -> str:
+        return f'gs://{self.name}'
+
+    def _run(self, cmd: List[str], timeout: float = 600.0
+             ) -> subprocess.CompletedProcess:
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout, check=False)
+        except FileNotFoundError as e:
+            raise exceptions.StorageError(
+                f'{cmd[0]} CLI not found; install the Google Cloud '
+                'SDK.') from e
+
+    def exists(self) -> bool:
+        out = self._run(['gsutil', 'ls', '-b', self.url])
+        return out.returncode == 0
+
+    def create(self) -> None:
+        out = self._run(['gsutil', 'mb', '-l', self.region, self.url])
+        if out.returncode != 0 and 'already exists' not in out.stderr:
+            raise exceptions.StorageBucketCreateError(
+                f'mb failed: {out.stderr[-300:]}')
+
+    def delete(self) -> None:
+        out = self._run(['gsutil', '-m', 'rm', '-r', self.url],
+                        timeout=3600)
+        if out.returncode != 0 and 'BucketNotFound' not in out.stderr:
+            raise exceptions.StorageBucketDeleteError(
+                f'rm failed: {out.stderr[-300:]}')
+
+    def upload(self, source: str) -> None:
+        """Batch upload a local dir (``gsutil -m rsync``, the same
+        mechanism the reference uses)."""
+        source = os.path.expanduser(source)
+        if not os.path.exists(source):
+            raise exceptions.StorageSourceError(
+                f'Source path {source!r} does not exist.')
+        if os.path.isdir(source):
+            cmd = ['gsutil', '-m', 'rsync', '-r', '-x', r'\.git/.*',
+                   source, self.url]
+        else:
+            cmd = ['gsutil', 'cp', source, self.url]
+        out = self._run(cmd, timeout=24 * 3600)
+        if out.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'upload failed: {out.stderr[-300:]}')
+
+    def download(self, target: str) -> None:
+        os.makedirs(os.path.expanduser(target), exist_ok=True)
+        out = self._run(['gsutil', '-m', 'rsync', '-r', self.url,
+                         os.path.expanduser(target)],
+                        timeout=24 * 3600)
+        if out.returncode != 0:
+            raise exceptions.StorageError(
+                f'download failed: {out.stderr[-300:]}')
+
+
+class Storage:
+    """User-facing storage spec: name/source/mode (analog of
+    ``Storage``, ``sky/data/storage.py:473``).
+
+    YAML (``storage_mounts:`` in a task):
+        /data:
+          name: my-bucket
+          source: ~/local/dir     # optional: upload on construct
+          mode: MOUNT | COPY
+          store: gcs
+    """
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 store: StoreType = StoreType.GCS,
+                 persistent: bool = True):
+        if name is None and source is None:
+            raise exceptions.StorageSourceError(
+                'Storage needs a name or a source.')
+        if source is not None and source.startswith('gs://'):
+            bucket = source[len('gs://'):].split('/')[0]
+            if name is not None and name != bucket:
+                raise exceptions.StorageNameError(
+                    f'name {name!r} conflicts with source bucket '
+                    f'{bucket!r}')
+            name = bucket
+            source = None  # the bucket itself is the source of truth
+        assert name is not None
+        validate_bucket_name(name)
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.store_type = store
+        self.persistent = persistent
+        self.store = GcsStore(name, source)
+
+    def construct(self) -> None:
+        """Ensure the bucket exists; upload local source if given
+        (called from Task.sync_storage_mounts)."""
+        if not self.store.exists():
+            self.store.create()
+        if self.source is not None:
+            self.store.upload(self.source)
+        state.add_or_update_storage(self.name,
+                                    {'name': self.name,
+                                     'store': self.store_type.value},
+                                    'READY')
+
+    def delete(self) -> None:
+        self.store.delete()
+        state.remove_storage(self.name)
+
+    # -- YAML -----------------------------------------------------------
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        config = dict(config or {})
+        mode = StorageMode(config.pop('mode', 'MOUNT').upper())
+        store = StoreType(config.pop('store', 'GCS').upper())
+        name = config.pop('name', None)
+        source = config.pop('source', None)
+        persistent = config.pop('persistent', True)
+        if config:
+            raise exceptions.StorageError(
+                f'Unknown storage fields: {sorted(config)}')
+        return cls(name=name, source=source, mode=mode, store=store,
+                   persistent=persistent)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {'name': self.name,
+                               'mode': self.mode.value}
+        if self.source:
+            out['source'] = self.source
+        if self.store_type != StoreType.GCS:
+            out['store'] = self.store_type.value
+        if not self.persistent:
+            out['persistent'] = False
+        return out
+
+    def mount_command(self, mount_path: str) -> str:
+        from skypilot_tpu.data import mounting_utils
+        if self.mode == StorageMode.MOUNT:
+            return mounting_utils.get_gcs_mount_cmd(self.name,
+                                                    mount_path)
+        return mounting_utils.get_gcs_copy_cmd(self.name, mount_path)

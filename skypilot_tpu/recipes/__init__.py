@@ -1,0 +1,15 @@
+"""In-tree JAX ports of the reference's recipes (SURVEY.md §2.11).
+
+| Reference recipe | Port |
+|---|---|
+| ``llm/llama-3_1-finetuning`` (torchtune LoRA) | ``recipes.finetune`` |
+| ``examples/tpu/v6e/train-llama3-8b.yaml`` (HF FSDP) | ``recipes.finetune --full-ft`` |
+| ``examples/nccl_test.yaml`` (NCCL allreduce busbw) | ``recipes.allreduce_bench`` (ICI) |
+| ``examples/tpu/tpuvm_mnist.yaml`` | ``recipes.mnist`` |
+| ``llm/vllm`` serving | ``recipes.serve_model`` |
+| ``examples/resnet_distributed_torch.yaml`` (DDP) | ``recipes.finetune --dp N`` (pure data parallel) |
+
+Each recipe bootstraps multi-host via
+``skypilot_tpu.parallel.distributed.initialize()`` from the runtime's
+env contract — no torchrun, no NCCL.
+"""

@@ -1,0 +1,72 @@
+"""ICI/DCN allreduce bandwidth check.
+
+TPU-native port of the reference's ``examples/nccl_test.yaml``
+(nccl-tests all_reduce_perf: algbw/busbw over sizes): a ``psum`` over
+all chips via ``shard_map``, timed across payload sizes. Within a
+slice the collective rides ICI; across slices, DCN. Used as the
+first-boot interconnect sanity gate (SURVEY.md §5).
+
+    python -m skypilot_tpu.recipes.allreduce_bench --max-mb 256
+"""
+import argparse
+import functools
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--min-mb', type=float, default=1)
+    parser.add_argument('--max-mb', type=float, default=256)
+    parser.add_argument('--trials', type=int, default=5)
+    args = parser.parse_args()
+
+    from skypilot_tpu.parallel import distributed
+    distributed.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = jax.device_count()
+    mesh = Mesh(np.asarray(jax.devices()), ('x',))
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P('x'),
+                       out_specs=P('x'))
+    def allreduce(x):
+        return jax.lax.psum(x, 'x') / n
+
+    if jax.process_index() == 0:
+        print(f'# allreduce over {n} chips '
+              f'({jax.devices()[0].device_kind})')
+        print(f'{"size":>10} {"time_ms":>10} {"algbw_GBps":>11} '
+              f'{"busbw_GBps":>11}')
+
+    size_mb = args.min_mb
+    while size_mb <= args.max_mb:
+        count = int(size_mb * 1e6 / 4)  # fp32 elements TOTAL
+        per_dev = max(1, count // n) * n
+        x = jnp.ones((per_dev,), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P('x')))
+        allreduce(x).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.trials):
+            out = allreduce(x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / args.trials
+        bytes_total = per_dev * 4
+        # Same convention as nccl-tests: algbw = S/t; busbw =
+        # algbw * 2(n-1)/n for ring allreduce.
+        algbw = bytes_total / dt / 1e9
+        busbw = algbw * 2 * (n - 1) / n
+        if jax.process_index() == 0:
+            print(f'{bytes_total:>10} {dt * 1e3:>10.3f} '
+                  f'{algbw:>11.2f} {busbw:>11.2f}')
+        size_mb *= 4
+
+
+if __name__ == '__main__':
+    main()

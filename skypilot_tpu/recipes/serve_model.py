@@ -1,0 +1,100 @@
+"""Model serving replica (stdlib HTTP).
+
+Port of the reference's serving recipes (``llm/vllm/service.yaml``,
+JetStream on v6e): a replica process exposing ``/`` (readiness) and
+``/generate`` (greedy decode) over the in-tree Llama implementation.
+Runs under ``x serve up`` — the service spec's port arrives via
+``SKYTPU_REPLICA_PORT``.
+
+    python -m skypilot_tpu.recipes.serve_model --model tiny
+"""
+import argparse
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='tiny')
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_REPLICA_PORT', '8080')))
+    parser.add_argument('--max-new-tokens', type=int, default=32)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+
+    config = llama.get_config(args.model)
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+
+    @jax.jit
+    def next_token(params, tokens):
+        logits = llama.forward(params, tokens, config)
+        return logits[:, -1].argmax(-1)
+
+    lock = threading.Lock()
+
+    def generate(prompt_ids, max_new):
+        tokens = jnp.asarray([prompt_ids], jnp.int32)
+        out = []
+        with lock:
+            for _ in range(max_new):
+                nxt = int(next_token(params, tokens)[0])
+                out.append(nxt)
+                tokens = jnp.concatenate(
+                    [tokens, jnp.asarray([[nxt]], jnp.int32)], axis=1)
+                if tokens.shape[1] >= config.max_seq_len:
+                    break
+        return out
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, fmt, *largs):
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == '/':
+                self._json({'status': 'ok', 'model': args.model})
+            else:
+                self._json({'error': 'not found'}, 404)
+
+        def do_POST(self):  # noqa: N802
+            if self.path != '/generate':
+                self._json({'error': 'not found'}, 404)
+                return
+            length = int(self.headers.get('Content-Length', '0'))
+            try:
+                body = json.loads(self.rfile.read(length))
+                prompt_ids = [int(t) % config.vocab_size
+                              for t in body['prompt_ids']]
+                max_new = min(int(body.get('max_new_tokens',
+                                           args.max_new_tokens)), 512)
+            except (ValueError, KeyError) as e:
+                self._json({'error': f'bad request: {e}'}, 400)
+                return
+            out = generate(prompt_ids, max_new)
+            self._json({'output_ids': out})
+
+    # Warm the compile before declaring readiness.
+    generate([1, 2, 3], 1)
+    server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
+    print(f'serve_model ready on :{args.port} (model {args.model})')
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,125 @@
+"""Chrome-trace-event tracing (analog of ``sky/utils/timeline.py``).
+
+``@timeline.event`` decorates functions; spans are written to a
+Chrome trace JSON at process exit when SKYTPU_DEBUG=1 (load in
+chrome://tracing or Perfetto). FileLockEvent wraps lock acquisition
+the same way the reference wraps provisioning filelocks.
+"""
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_events: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def _enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+def _trace_path() -> str:
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(base, f'timeline-{os.getpid()}.json')
+
+
+def _record(name: str, phase: str, ts_us: float,
+            args: Optional[Dict[str, Any]] = None) -> None:
+    global _registered
+    with _lock:
+        _events.append({
+            'name': name,
+            'ph': phase,
+            'ts': ts_us,
+            'pid': os.getpid(),
+            'tid': threading.get_ident() % (1 << 31),
+            **({'args': args} if args else {}),
+        })
+        if not _registered:
+            _registered = True
+            atexit.register(save)
+
+
+class Event:
+    """Context manager emitting a begin/end span."""
+
+    def __init__(self, name: str,
+                 args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        if _enabled():
+            _record(self.name, 'B', time.time() * 1e6, self.args)
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled():
+            _record(self.name, 'E', time.time() * 1e6)
+        return False
+
+
+def event(name_or_fn=None):
+    """Decorator: ``@timeline.event`` or ``@timeline.event('name')``."""
+
+    def deco(fn: Callable, name: Optional[str] = None):
+        span = name or f'{fn.__module__}.{fn.__qualname__}'
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(span):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    if callable(name_or_fn):
+        return deco(name_or_fn)
+    return lambda fn: deco(fn, name_or_fn)
+
+
+class FileLockEvent:
+    """Wrap a filelock acquisition so lock-wait time shows in the
+    trace (reference wraps cluster-status locks the same way)."""
+
+    def __init__(self, lockfile: str):
+        import filelock
+        self._lockfile = lockfile
+        self._lock = filelock.FileLock(lockfile)
+
+    def acquire(self):
+        with Event(f'filelock.wait {self._lockfile}'):
+            self._lock.acquire()
+        if _enabled():
+            _record(f'filelock.hold {self._lockfile}', 'B',
+                    time.time() * 1e6)
+
+    def release(self):
+        self._lock.release()
+        if _enabled():
+            _record(f'filelock.hold {self._lockfile}', 'E',
+                    time.time() * 1e6)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    if not _events:
+        return None
+    path = path or _trace_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _lock:
+        payload = {'traceEvents': list(_events)}
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+    return path

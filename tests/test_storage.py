@@ -1,0 +1,103 @@
+"""Storage + mounting + checkpoint tests (no network: validation,
+command generation, checkpoint round trip on local disk)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data.storage import (Storage, StorageMode, StoreType,
+                                       validate_bucket_name)
+
+
+class TestStorageSpec:
+
+    def test_from_gs_url(self):
+        s = Storage(source='gs://my-bucket/sub')
+        assert s.name == 'my-bucket'
+        assert s.source is None
+
+    def test_name_conflict(self):
+        with pytest.raises(exceptions.StorageNameError):
+            Storage(name='other', source='gs://my-bucket')
+
+    def test_bucket_name_validation(self):
+        validate_bucket_name('good-bucket-1')
+        for bad in ('UPPER', 'a', 'has space', 'google-things',
+                    'googbucket', 'a..b'):
+            with pytest.raises(exceptions.StorageNameError):
+                validate_bucket_name(bad)
+
+    def test_requires_name_or_source(self):
+        with pytest.raises(exceptions.StorageSourceError):
+            Storage()
+
+    def test_non_gcs_rejected(self):
+        with pytest.raises(exceptions.StorageSourceError):
+            StoreType.from_url('s3://bucket')
+
+    def test_yaml_round_trip(self):
+        s = Storage.from_yaml_config({'name': 'bkt', 'mode': 'COPY'})
+        assert s.mode == StorageMode.COPY
+        s2 = Storage.from_yaml_config(s.to_yaml_config())
+        assert s2.name == 'bkt'
+        assert s2.mode == StorageMode.COPY
+
+    def test_unknown_field(self):
+        with pytest.raises(exceptions.StorageError):
+            Storage.from_yaml_config({'name': 'bkt', 'bogus': 1})
+
+
+class TestMountCommands:
+
+    def test_mount_cmd_idempotent_shape(self):
+        cmd = mounting_utils.get_gcs_mount_cmd('bkt', '/data')
+        assert 'gcsfuse' in cmd
+        assert 'mountpoint -q /data' in cmd
+        assert 'bkt /data' in cmd
+
+    def test_copy_cmd(self):
+        cmd = mounting_utils.get_gcs_copy_cmd('bkt', '/data')
+        assert 'gsutil -m rsync -r gs://bkt /data' in cmd
+
+    def test_storage_mount_command_mode(self):
+        s = Storage(name='bkt', mode=StorageMode.MOUNT)
+        assert 'gcsfuse' in s.mount_command('/data')
+        s2 = Storage(name='bkt', mode=StorageMode.COPY)
+        assert 'rsync' in s2.mount_command('/data')
+
+
+class TestCheckpointManager:
+
+    def test_save_restore_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'test-task-1')
+        from skypilot_tpu.data.checkpoint import CheckpointManager
+
+        state = {'params': {'w': jnp.arange(8.0)},
+                 'step': jnp.zeros((), jnp.int32)}
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1,
+                                max_to_keep=2)
+        restored, start = mgr.restore_or(state)
+        assert start == 0
+        state2 = {'params': {'w': jnp.arange(8.0) * 2},
+                  'step': jnp.ones((), jnp.int32)}
+        assert mgr.maybe_save(1, state2)
+        mgr.wait()
+        mgr.close()
+
+        # A NEW manager (fresh process semantics) restores step 1.
+        mgr2 = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        restored, start = mgr2.restore_or(state)
+        assert start == 2
+        np.testing.assert_allclose(np.asarray(restored['params']['w']),
+                                   np.arange(8.0) * 2)
+        mgr2.close()
+
+    def test_task_namespacing(self, tmp_path, monkeypatch):
+        from skypilot_tpu.data.checkpoint import task_checkpoint_dir
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'job-a')
+        a = task_checkpoint_dir(str(tmp_path))
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'job-b')
+        b = task_checkpoint_dir(str(tmp_path))
+        assert a != b
