@@ -240,13 +240,19 @@ def forward_hidden(params: Params, tokens: jax.Array,
                    positions: Optional[jax.Array] = None,
                    attn_impl=None,
                    lora: Optional[Params] = None,
-                   lora_scale: float = 1.0) -> jax.Array:
+                   lora_scale: float = 1.0,
+                   activation_sharding=None) -> jax.Array:
     """tokens [B, T] int32 -> final hidden states [B, T, D]
     (post-final-norm, compute dtype).
 
     Master params may be fp32; compute happens in ``config.dtype``
     (bf16 on the MXU). ``lora`` is an optional pytree of stacked
     [L, ...] adapters trained with the base frozen.
+
+    ``activation_sharding``: optional PartitionSpec for [B, T, D]
+    activations — used by sequence parallelism to pin the T axis onto
+    the 'sp' mesh axis (ring attention supplies the cross-shard
+    communication).
     """
     if attn_impl is None:
         attn_impl = lambda q, k, v: attention_ops.flash_attention(
@@ -261,6 +267,8 @@ def forward_hidden(params: Params, tokens: jax.Array,
     cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
 
     x = cparams['embed'][tokens]  # [B, T, D] gather
+    if activation_sharding is not None:
+        x = jax.lax.with_sharding_constraint(x, activation_sharding)
 
     def scan_body(carry, scanned):
         layer_params, layer_lora = scanned
@@ -310,7 +318,9 @@ LOSS_CHUNK = 512
 def loss_fn(params: Params, batch: Dict[str, jax.Array],
             config: LlamaConfig,
             lora: Optional[Params] = None,
-            lora_scale: float = 1.0) -> jax.Array:
+            lora_scale: float = 1.0,
+            attn_impl=None,
+            activation_sharding=None) -> jax.Array:
     """Causal LM cross-entropy over positions predicting
     ``tokens[:, 1:]`` (mask-aware if batch has 'loss_mask').
 
@@ -321,7 +331,9 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     """
     tokens = batch['tokens']
     hidden = forward_hidden(params, tokens[:, :-1], config, lora=lora,
-                            lora_scale=lora_scale)
+                            lora_scale=lora_scale,
+                            attn_impl=attn_impl,
+                            activation_sharding=activation_sharding)
     targets = tokens[:, 1:]
     mask = batch.get('loss_mask')
     mask = (jnp.ones_like(targets, jnp.float32) if mask is None

@@ -132,6 +132,21 @@ def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
     return state, state_shardings
 
 
+def make_ring_attention_impl(mesh: Mesh, axis_name: str = 'sp'):
+    """attn_impl for sequence parallelism: ring attention under
+    shard_map, composing with the auto-sharded jit around it. q/k/v
+    are [B, T, H, D] with T sharded on 'sp' and H on 'tp'."""
+    from jax import shard_map
+
+    from skypilot_tpu.ops import ring_attention as ring
+
+    spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
+    fn = shard_map(
+        functools.partial(ring.ring_attention, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn
+
+
 def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                      state_shardings: TrainState,
                      optimizer: Optional[
@@ -141,17 +156,28 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                      ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                    Tuple[TrainState, Dict[str, jax.Array]]]:
     """The full training step: loss → grad → optimizer update, jitted
-    with explicit in/out shardings."""
+    with explicit in/out shardings.
+
+    When the mesh has an ``sp`` axis > 1, activations shard their
+    sequence dim over it and attention runs as ring attention
+    (long-context: per-device memory stays O(T / sp))."""
     if optimizer is None:
         optimizer = default_optimizer()
     is_lora = state_shardings.lora is not None
+
+    use_sp = mesh.shape.get('sp', 1) > 1
+    attn_impl = make_ring_attention_impl(mesh) if use_sp else None
+    act_sharding = NamedSharding(
+        mesh, P(('dp', 'fsdp'), 'sp', None)) if use_sp else None
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         if is_lora:
             def loss_of(lora_p):
                 return llama.loss_fn(
                     jax.lax.stop_gradient(state.params), batch, config,
-                    lora=lora_p, lora_scale=lora_scale)
+                    lora=lora_p, lora_scale=lora_scale,
+                    attn_impl=attn_impl,
+                    activation_sharding=act_sharding)
 
             loss, grads = jax.value_and_grad(loss_of)(state.lora)
             updates, new_opt = optimizer.update(grads, state.opt_state,
@@ -162,7 +188,9 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                                    opt_state=new_opt, lora=new_lora)
         else:
             def loss_of(params):
-                return llama.loss_fn(params, batch, config)
+                return llama.loss_fn(
+                    params, batch, config, attn_impl=attn_impl,
+                    activation_sharding=act_sharding)
 
             loss, grads = jax.value_and_grad(loss_of)(state.params)
             updates, new_opt = optimizer.update(grads, state.opt_state,
