@@ -330,14 +330,24 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     chip's HBM at batch 16 (observed: 15.7 GB fp32).
     """
     tokens = batch['tokens']
-    hidden = forward_hidden(params, tokens[:, :-1], config, lora=lora,
+    # Run the forward on the FULL sequence so the activation length T
+    # stays divisible by the 'sp' mesh axis under sequence parallelism
+    # (ring attention shard_map requires even T shards). Position T-1
+    # has no next-token target; it is masked out below instead of
+    # sliced off.
+    hidden = forward_hidden(params, tokens, config, lora=lora,
                             lora_scale=lora_scale,
                             attn_impl=attn_impl,
                             activation_sharding=activation_sharding)
-    targets = tokens[:, 1:]
+    pad = jnp.zeros_like(tokens[:, :1])
+    targets = jnp.concatenate([tokens[:, 1:], pad], axis=1)
     mask = batch.get('loss_mask')
-    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
-            else mask[:, 1:].astype(jnp.float32))
+    mask = (jnp.ones_like(tokens, jnp.float32) if mask is None
+            else mask.astype(jnp.float32))
+    # Shift: position i predicts token i+1, so it contributes iff the
+    # *target* position is unmasked; the final position never does.
+    mask = jnp.concatenate(
+        [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1)
     lm_head = params['lm_head'].astype(config.dtype)
 
     b, t, d = hidden.shape

@@ -108,18 +108,35 @@ def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
             lora_lib.lora_sharding_rules(config), mesh)
         trainable_shardings = lora_shardings
 
-    def opt_sharding_for(shape_leaf):
-        # Match by shape against trainable leaves.
-        for leaf, shard in zip(
-                jax.tree_util.tree_leaves(
-                    state_shape.lora if lora_rank is not None
-                    else state_shape.params),
-                jax.tree_util.tree_leaves(trainable_shardings)):
-            if leaf.shape == shape_leaf.shape:
+    # Match opt-state leaves (Adam mu/nu mirror the trainable tree) to
+    # their param's sharding by TREE PATH, not shape: wq and wo share a
+    # shape but have transposed shardings, so shape matching would pin
+    # wo's moments to wq's layout and reshard every step.
+    trainable_shape = (state_shape.lora if lora_rank is not None
+                       else state_shape.params)
+    trainable_by_path = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            trainable_shape)[0]:
+        shard = trainable_shardings
+        for path_key in path:
+            shard = shard[path_key.key]
+        trainable_by_path[tuple(str(k) for k in path)] = (
+            leaf.shape, shard)
+
+    def opt_sharding_for(path, shape_leaf):
+        opt_path = tuple(str(k) for k in path)
+        # The params-shaped subtree sits at some suffix of the opt
+        # path (e.g. opt_state[1].mu['layers']['wq'] ends with the
+        # param path ('layers', 'wq')).
+        for ppath, (pshape, shard) in trainable_by_path.items():
+            if (len(ppath) <= len(opt_path)
+                    and opt_path[-len(ppath):] == ppath
+                    and pshape == shape_leaf.shape):
                 return shard
         return NamedSharding(mesh, P())
 
-    opt_shardings = jax.tree.map(opt_sharding_for, state_shape.opt_state)
+    opt_shardings = jax.tree_util.tree_map_with_path(
+        opt_sharding_for, state_shape.opt_state)
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()),
         params=param_shardings,
