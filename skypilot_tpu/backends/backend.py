@@ -26,6 +26,9 @@ class ClusterHandle:
     head_runtime_dir: str = '~/.skypilot_tpu'
     workdir: str = '~/sky_workdir'
     num_slices: int = 1
+    # Per-cluster shared secret for the host-agent control plane,
+    # minted at provision; every agent request must present it.
+    agent_token: Optional[str] = None
 
     @property
     def num_hosts(self) -> int:
@@ -38,11 +41,24 @@ class ClusterHandle:
         return self.hosts[0].get('external_ip') or \
             self.hosts[0].get('ip')
 
-    def head_agent(self):
+    def agent_client(self, host_index: int):
+        """Client for host ``host_index``'s agent, from the CLIENT
+        side. On remote clouds the agent port is never opened publicly
+        — traffic rides an SSH local port-forward (reference model:
+        SSH-only control plane, ``sky/utils/command_runner.py:426``)."""
         from skypilot_tpu.runtime.agent_client import AgentClient
         assert self.hosts, 'cluster has no hosts'
-        return AgentClient(self.head_ip,
-                           self.hosts[0]['agent_port'])
+        host = self.hosts[host_index]
+        token = getattr(self, 'agent_token', None)
+        if self.provider in ('local',):
+            addr = host.get('external_ip') or host.get('ip')
+            return AgentClient(addr, host['agent_port'], token=token)
+        from skypilot_tpu.runtime import tunnels
+        addr, port = tunnels.get_endpoint(self, host_index)
+        return AgentClient(addr, port, token=token)
+
+    def head_agent(self):
+        return self.agent_client(0)
 
     def internal_ips(self) -> List[str]:
         return [h['ip'] for h in self.hosts]

@@ -17,7 +17,6 @@ from skypilot_tpu.backends.backend import Backend, ClusterHandle
 from skypilot_tpu.provision.provisioner import RetryingProvisioner
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.runtime import codegen, job_lib
-from skypilot_tpu.runtime.agent_client import AgentClient
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
 
@@ -58,12 +57,16 @@ class TpuBackend(Backend):
 
         cluster_name_on_cloud = common_utils.make_cluster_name_on_cloud(
             cluster_name)
+        # Per-cluster shared secret for the agent control plane: every
+        # agent request must present it (the agents execute shell).
+        import secrets
+        agent_token = secrets.token_hex(16)
         while True:
             provisioner = RetryingProvisioner()
             try:
                 result = provisioner.provision_with_retries(
                     to_provision, cluster_name, cluster_name_on_cloud,
-                    task.num_nodes)
+                    task.num_nodes, agent_token=agent_token)
                 break
             except exceptions.ResourcesUnavailableError as e:
                 if e.no_failover or not retry_until_up:
@@ -75,6 +78,11 @@ class TpuBackend(Backend):
                 time.sleep(_PROVISION_RETRY_GAP_SECONDS)
 
         info = result.cluster_info
+        # A resumed cluster keeps the token its agents were started
+        # with (the provider reports it in custom_metadata).
+        existing_token = (info.custom_metadata or {}).get('agent_token')
+        if existing_token:
+            agent_token = existing_token
         handle = ClusterHandle(
             cluster_name=cluster_name,
             cluster_name_on_cloud=cluster_name_on_cloud,
@@ -90,6 +98,7 @@ class TpuBackend(Backend):
                                              '~/.skypilot_tpu'),
             } for inst in info.instances],
             num_slices=task.num_nodes,
+            agent_token=agent_token,
         )
         handle.head_runtime_dir = handle.hosts[0]['runtime_dir']
         if handle.provider == 'local':
@@ -111,15 +120,19 @@ class TpuBackend(Backend):
         if handle.provider != 'local':
             from skypilot_tpu.provision import instance_setup
             instance_setup.setup_runtime_on_cluster(handle)
-        for h in handle.hosts:
-            AgentClient(h.get('external_ip') or h['ip'],
-                        h['agent_port']).wait_healthy(timeout=120)
-        # Start skylet on the head (idempotent: pgrep first).
+        for i in range(handle.num_hosts):
+            handle.agent_client(i).wait_healthy(timeout=120)
+        # Start skylet on the head (idempotent: pgrep first). Both the
+        # pattern ([s]kylet bracket) and the start text ('s'kylet
+        # quote, stripped by bash before exec) are spelled so the
+        # guard never matches the shell running this very command —
+        # a plain spelling of either makes the guard self-match and
+        # skylet never starts.
         head = handle.head_agent()
         skylet_cmd = (
-            f'pgrep -f "skypilot_tpu.runtime.skylet" > /dev/null || '
+            f'pgrep -f "skypilot_tpu.runtime.[s]kylet" > /dev/null || '
             f'SKYTPU_RUNTIME_DIR={handle.head_runtime_dir} '
-            f'nohup python3 -m skypilot_tpu.runtime.skylet '
+            f"nohup python3 -m skypilot_tpu.runtime.'s'kylet "
             f'>> {handle.head_runtime_dir}/skylet.log 2>&1 &')
         out = head.exec(skylet_cmd, timeout=30)
         if out.get('returncode') != 0:
@@ -175,6 +188,10 @@ class TpuBackend(Backend):
             'num_nodes': handle.num_hosts,
             'hosts': [{'ip': h['ip'], 'agent_port': h['agent_port']}
                       for h in handle.hosts],
+            # Head-side driver authenticates to worker agents with the
+            # cluster token (the spec lives on the head's disk, the
+            # same trust domain as the agents' own token files).
+            'agent_token': getattr(handle, 'agent_token', None),
             'setup_cmd': task.setup if include_setup else None,
             'run_cmd': run_cmd,
             'envs': dict(task.envs),
@@ -306,6 +323,8 @@ class TpuBackend(Backend):
             if not purge:
                 raise
             logger.warning('teardown error ignored (purge=True)')
+        from skypilot_tpu.runtime import tunnels
+        tunnels.close_tunnels(handle.cluster_name)
         state.remove_cluster(handle.cluster_name, terminate=terminate)
 
 

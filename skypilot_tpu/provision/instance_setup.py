@@ -40,22 +40,72 @@ def _package_source_dir() -> str:
     return os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
 
 
+_REMOTE_TOKEN_FILE = '~/.skypilot_tpu/agent_token'
+# pgrep/pkill -f patterns use the [b]racket trick: the pattern text in
+# the invoking remote shell's own cmdline does not match itself, so
+# the guard never self-matches (a plain pattern always would — the
+# remote command runs as `bash -c '<cmd containing the pattern>'`).
+_AGENT_PATTERN = 'skypilot_tpu.runtime.[a]gent|host_[a]gent'
+
+
+def _read_remote_token(runner: SSHCommandRunner) -> str:
+    rc, out, _ = runner.run(
+        f'cat {_REMOTE_TOKEN_FILE} 2>/dev/null || true',
+        require_outputs=True)
+    return out.strip() if rc == 0 else ''
+
+
 def setup_runtime_on_cluster(handle: ClusterHandle) -> None:
-    """Parallel over hosts: ship package, start agent (idempotent)."""
+    """Parallel over hosts: ship package + agent token, start agent.
+
+    Token lifecycle: if the HEAD already holds a token (cluster
+    provisioned before), the cluster ADOPTS it — running agents and
+    their in-flight jobs survive relaunches. Hosts whose token file
+    differs (or is missing) get the head's token installed and their
+    agent restarted. The token ships via rsync of a 0600 temp file,
+    never on a command line (argv is world-readable via ps) and never
+    via cloud metadata (readable by other project members on GCP)."""
+    import tempfile
+
     src = _package_source_dir().rstrip('/') + '/'
     runners = _runners(handle)
+    token = getattr(handle, 'agent_token', None)
+    if token and runners:
+        existing = _read_remote_token(runners[0])
+        if existing:
+            token = existing
+            handle.agent_token = existing
 
     def one(runner: SSHCommandRunner) -> None:
         runner.run(f'mkdir -p {os.path.dirname(_REMOTE_PKG_DIR)}')
         runner.rsync(src, _REMOTE_PKG_DIR + '/', up=True)
+        token_flag = ''
+        if token:
+            if _read_remote_token(runner) != token:
+                fd, tmp = tempfile.mkstemp()
+                try:
+                    os.fchmod(fd, 0o600)
+                    with os.fdopen(fd, 'w') as f:
+                        f.write(token)
+                    runner.run('mkdir -p ~/.skypilot_tpu')
+                    runner.rsync(tmp, _REMOTE_TOKEN_FILE, up=True)
+                finally:
+                    os.unlink(tmp)
+                runner.run(f'chmod 600 {_REMOTE_TOKEN_FILE}; '
+                           f'pkill -f "{_AGENT_PATTERN}" || true')
+            token_flag = f'--token-file {_REMOTE_TOKEN_FILE} '
         # PYTHONPATH install (no pip dependency on the host image).
+        # The 'a'gent quoting (stripped by bash before exec) keeps the
+        # start text from matching _AGENT_PATTERN in the guard's own
+        # cmdline — a plain spelling makes the pgrep self-match and
+        # the agent never starts.
         start = (
-            f'pgrep -f "skypilot_tpu.runtime.agent|host_agent" '
+            f'pgrep -f "{_AGENT_PATTERN}" '
             f'> /dev/null || ('
             f'export PYTHONPATH={os.path.dirname(_REMOTE_PKG_DIR)}:'
             f'$PYTHONPATH; '
-            f'nohup python3 -m skypilot_tpu.runtime.agent '
-            f'--port {_AGENT_PORT} '
+            f"nohup python3 -m skypilot_tpu.runtime.'a'gent "
+            f'--port {_AGENT_PORT} {token_flag}'
             f'>> ~/.skypilot_tpu/agent.log 2>&1 &)')
         rc = runner.run(start)
         if rc != 0:

@@ -63,12 +63,14 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
-def _host_alive(host: Dict[str, Any]) -> bool:
+def _host_alive(host: Dict[str, Any],
+                token: Optional[str] = None) -> bool:
     """Liveness = the agent answers /health. A pid check alone is
     wrong here: a SIGTERMed agent whose parent (this process) hasn't
     reaped it yet is a zombie, and os.kill(pid, 0) still succeeds."""
     return agent_client.AgentClient('127.0.0.1', host['port'],
-                                    timeout=1).is_healthy()
+                                    timeout=1,
+                                    token=token).is_healthy()
 
 
 def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
@@ -87,7 +89,8 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
 
     existing = _load(config.cluster_name_on_cloud)
     if existing is not None and all(
-            _host_alive(h) for h in existing['hosts']):
+            _host_alive(h, existing.get('agent_token'))
+            for h in existing['hosts']):
         return ProvisionRecord(
             provider='local', region=config.region, zone=config.zone,
             cluster_name_on_cloud=config.cluster_name_on_cloud,
@@ -98,13 +101,15 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
     num_hosts = int(node_config.get('num_hosts', 1)) * config.count
     runtime_base = os.path.join(_meta_dir(),
                                 config.cluster_name_on_cloud)
+    agent_token = node_config.get('agent_token')
     hosts = []
     for i in range(num_hosts):
         port = _free_port()
         runtime_dir = os.path.join(runtime_base, f'host-{i}')
         os.makedirs(runtime_dir, exist_ok=True)
         proc = agent_client.start_local_agent(port,
-                                              runtime_dir=runtime_dir)
+                                              runtime_dir=runtime_dir,
+                                              token=agent_token)
         hosts.append({
             'instance_id': f'{config.cluster_name_on_cloud}-{i}',
             'pid': proc.pid,
@@ -116,6 +121,7 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         'region': config.region,
         'zone': config.zone,
         'hosts': hosts,
+        'agent_token': agent_token,
         'created_at': time.time(),
         'node_config': {k: v for k, v in node_config.items()
                         if isinstance(v, (str, int, float, bool,
@@ -136,8 +142,9 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
         raise exceptions.FetchClusterInfoError(
             f'no such local cluster {cluster_name_on_cloud}')
     for h in meta['hosts']:
-        agent_client.AgentClient('127.0.0.1', h['port']).wait_healthy(
-            timeout=30)
+        agent_client.AgentClient(
+            '127.0.0.1', h['port'],
+            token=meta.get('agent_token')).wait_healthy(timeout=30)
 
 
 def get_cluster_info(region: str,
@@ -157,7 +164,13 @@ def get_cluster_info(region: str,
     ]
     return ClusterInfo(provider='local', instances=instances,
                        head_instance_id=instances[0].instance_id,
-                       custom_metadata={'hosts': meta['hosts']})
+                       custom_metadata={
+                           'hosts': meta['hosts'],
+                           # Source of truth for the token: a resumed
+                           # cluster keeps the token its agents were
+                           # started with.
+                           'agent_token': meta.get('agent_token'),
+                       })
 
 
 def query_instances(region: str,
@@ -168,7 +181,8 @@ def query_instances(region: str,
         return {}
     return {
         h['instance_id']:
-            ('running' if _host_alive(h) else 'stopped')
+            ('running' if _host_alive(h, meta.get('agent_token'))
+             else 'stopped')
         for h in meta['hosts']
     }
 

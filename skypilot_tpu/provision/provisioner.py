@@ -37,12 +37,14 @@ def bulk_provision(config: ProvisionConfig) -> ProvisionRecord:
         record = provision.run_instances(config)
         provision.wait_instances(config.provider, config.region,
                                  config.cluster_name_on_cloud)
-        # Agent port + any user-requested ports must be reachable
-        # from the client (no-op on the local provider).
-        from skypilot_tpu.runtime.agent import DEFAULT_PORT
-        ports = list(config.ports_to_open) + [str(DEFAULT_PORT)]
-        provision.open_ports(config.provider, config.region,
-                             config.cluster_name_on_cloud, ports)
+        # Only USER-requested ports are opened. The agent port is
+        # deliberately NOT exposed: agent traffic is token-
+        # authenticated and rides an SSH tunnel from the client
+        # (runtime/tunnels.py) / VPC-internal IPs from the head.
+        if config.ports_to_open:
+            provision.open_ports(config.provider, config.region,
+                                 config.cluster_name_on_cloud,
+                                 list(config.ports_to_open))
         return record
     except exceptions.SkyTpuError:
         # Leave no half-created slice behind (model:
@@ -107,7 +109,8 @@ class RetryingProvisioner:
 
     def provision_with_retries(
             self, to_provision: Resources, cluster_name: str,
-            cluster_name_on_cloud: str, num_nodes: int
+            cluster_name_on_cloud: str, num_nodes: int,
+            agent_token: Optional[str] = None
     ) -> ProvisionResult:
         provider = to_provision.cloud or 'gcp'
         placements = self._candidate_placements(to_provision)
@@ -129,6 +132,8 @@ class RetryingProvisioner:
             # provider's failure injection set by tests).
             node_config.update(getattr(to_provision, '_extra_config',
                                        None) or {})
+            if agent_token is not None:
+                node_config['agent_token'] = agent_token
             config = ProvisionConfig(
                 provider=provider, region=region, zone=zone,
                 cluster_name=cluster_name,

@@ -15,8 +15,18 @@ Protocol (JSON over HTTP):
     POST /kill  {proc_id}         -> {ok}
     POST /exec  {cmd, timeout?}   -> {returncode, output}   (blocking)
     GET  /read?path=P&offset=N    -> raw bytes
+
+Authentication: the agent executes arbitrary shell, so every request
+(including /health) must carry the per-cluster shared secret in the
+``X-SkyTpu-Token`` header when the agent was started with a token
+(``--token-file`` or ``SKYTPU_AGENT_TOKEN``). The token is minted at
+provision time and shipped to hosts over SSH; the agent port is never
+opened to the internet (the client reaches it through an SSH tunnel —
+the reference's control plane is likewise SSH-only,
+``sky/utils/command_runner.py:426``).
 """
 import argparse
+import hmac
 import json
 import os
 import signal
@@ -24,10 +34,34 @@ import subprocess
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
+from typing import Dict, Optional
 
 AGENT_VERSION = '1'
 DEFAULT_PORT = 8790
+TOKEN_HEADER = 'X-SkyTpu-Token'
+
+_token: Optional[str] = None
+
+
+def _load_token(token_file: Optional[str]) -> Optional[str]:
+    """Fail CLOSED: a configured-but-empty token (truncated file,
+    empty env var) is a refusal to start, never auth-disabled."""
+    if token_file:
+        with open(os.path.expanduser(token_file),
+                  encoding='utf-8') as f:
+            token = f.read().strip()
+        if not token:
+            raise ValueError(f'token file {token_file} is empty; '
+                             'refusing to start unauthenticated')
+        return token
+    env_token = os.environ.get('SKYTPU_AGENT_TOKEN')
+    if env_token is not None:
+        token = env_token.strip()
+        if not token:
+            raise ValueError('SKYTPU_AGENT_TOKEN is set but empty; '
+                             'refusing to start unauthenticated')
+        return token
+    return None
 
 
 class _ProcTable:
@@ -103,7 +137,16 @@ class _Handler(BaseHTTPRequestHandler):
             return {}
         return json.loads(self.rfile.read(length))
 
+    def _authorized(self) -> bool:
+        if _token is None:
+            return True
+        got = self.headers.get(TOKEN_HEADER, '')
+        return hmac.compare_digest(got, _token)
+
     def do_GET(self):  # noqa: N802
+        if not self._authorized():
+            self._json({'error': 'unauthorized'}, 401)
+            return
         parsed = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(parsed.query)
         if parsed.path == '/health':
@@ -131,6 +174,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'error': 'not found'}, 404)
 
     def do_POST(self):  # noqa: N802
+        if not self._authorized():
+            self._json({'error': 'unauthorized'}, 401)
+            return
         parsed = urllib.parse.urlparse(self.path)
         try:
             body = self._read_body()
@@ -162,7 +208,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'error': 'not found'}, 404)
 
 
-def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0') -> None:
+def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0',
+          token: Optional[str] = None) -> None:
+    global _token
+    if token is not None:
+        _token = token
     server = ThreadingHTTPServer((host, port), _Handler)
     server.serve_forever()
 
@@ -171,8 +221,12 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
     parser.add_argument('--host', default='0.0.0.0')
+    parser.add_argument('--token-file', default=None,
+                        help='File holding the shared-secret token; '
+                             'requests must present it in the '
+                             f'{TOKEN_HEADER} header.')
     args = parser.parse_args()
-    serve(args.port, args.host)
+    serve(args.port, args.host, token=_load_token(args.token_file))
 
 
 if __name__ == '__main__':

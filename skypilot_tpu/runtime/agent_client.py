@@ -26,32 +26,48 @@ def resolve_agent_binary() -> Optional[str]:
     return None
 
 
-def agent_start_command(port: int) -> str:
+def agent_start_command(port: int,
+                        token_file: Optional[str] = None) -> str:
     """Shell command that starts the best available agent on a host."""
     binary = resolve_agent_binary()
     if binary is not None:
-        return f'{binary} --port {port}'
-    return f'python -m skypilot_tpu.runtime.agent --port {port}'
+        cmd = f'{binary} --port {port}'
+    else:
+        cmd = f'python -m skypilot_tpu.runtime.agent --port {port}'
+    if token_file:
+        cmd += f' --token-file {token_file}'
+    return cmd
 
 
 class AgentClient:
-    """Talks to one host's agent."""
+    """Talks to one host's agent. ``token`` is the per-cluster shared
+    secret (minted at provision); it is sent on every request and the
+    agent rejects requests without it."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 token: Optional[str] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.token = token
         self._base = f'http://{host}:{port}'
 
     # -- http helpers ---------------------------------------------------
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {'Content-Type': 'application/json'}
+        if self.token:
+            headers['X-Skytpu-Token'] = self.token
+        return headers
 
     def _get(self, path: str, params: Optional[Dict[str, Any]] = None,
              raw: bool = False, timeout: Optional[float] = None):
         url = self._base + path
         if params:
             url += '?' + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, headers=self._headers())
         with urllib.request.urlopen(
-                url, timeout=timeout or self.timeout) as resp:
+                req, timeout=timeout or self.timeout) as resp:
             data = resp.read()
         return data if raw else json.loads(data)
 
@@ -59,7 +75,7 @@ class AgentClient:
               timeout: Optional[float] = None):
         req = urllib.request.Request(
             self._base + path, data=json.dumps(body).encode(),
-            headers={'Content-Type': 'application/json'})
+            headers=self._headers())
         with urllib.request.urlopen(
                 req, timeout=timeout or self.timeout) as resp:
             return json.loads(resp.read())
@@ -115,10 +131,14 @@ class AgentClient:
 
 def start_local_agent(port: int,
                       runtime_dir: Optional[str] = None,
-                      use_cpp: Optional[bool] = None
+                      use_cpp: Optional[bool] = None,
+                      token: Optional[str] = None
                       ) -> subprocess.Popen:
     """Start an agent process on THIS machine (used by the local/fake
-    provisioner and by instance_setup over SSH on real hosts)."""
+    provisioner and by instance_setup over SSH on real hosts). Local
+    agents bind 127.0.0.1 only; ``token`` (if given) is written to
+    ``<runtime_dir>/agent_token`` (0600) and enforced on every
+    request."""
     env = dict(os.environ)
     if runtime_dir:
         env['SKYTPU_RUNTIME_DIR'] = runtime_dir
@@ -132,6 +152,15 @@ def start_local_agent(port: int,
     else:
         cmd = ['python', '-m', 'skypilot_tpu.runtime.agent', '--port',
                str(port)]
+    cmd += ['--host', '127.0.0.1']
+    if token:
+        token_dir = os.path.expanduser(runtime_dir or '~/.skypilot_tpu')
+        os.makedirs(token_dir, exist_ok=True)
+        token_file = os.path.join(token_dir, 'agent_token')
+        with open(token_file, 'w', encoding='utf-8') as f:
+            f.write(token)
+        os.chmod(token_file, 0o600)
+        cmd += ['--token-file', token_file]
     return subprocess.Popen(cmd, env=env, start_new_session=True,
                             stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)

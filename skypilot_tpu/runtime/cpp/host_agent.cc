@@ -350,7 +350,23 @@ struct Request {
   std::string path;        // path only
   std::map<std::string, std::string> query;
   std::string body;
+  std::string token;       // X-SkyTpu-Token header, if present
 };
+
+// Per-cluster shared secret (empty = auth disabled). Loaded in main()
+// from --token-file / SKYTPU_AGENT_TOKEN; every request must present
+// it (the agent executes arbitrary shell).
+std::string g_token;
+
+bool TokenEquals(const std::string& a, const std::string& b) {
+  // Constant-time compare.
+  if (a.size() != b.size()) return false;
+  unsigned char diff = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    diff |= static_cast<unsigned char>(a[i]) ^ static_cast<unsigned char>(b[i]);
+  }
+  return diff == 0;
+}
 
 std::string UrlDecode(const std::string& s) {
   std::string out;
@@ -416,6 +432,10 @@ bool ReadRequest(int fd, Request* req) {
       for (auto& c : name) c = std::tolower(static_cast<unsigned char>(c));
       if (name == "content-length") {
         content_length = std::strtoul(h.substr(colon + 1).c_str(), nullptr, 10);
+      } else if (name == "x-skytpu-token") {
+        std::string value = h.substr(colon + 1);
+        size_t start = value.find_first_not_of(" \t");
+        req->token = start == std::string::npos ? "" : value.substr(start);
       }
     }
     pos = eol + 2;
@@ -457,6 +477,12 @@ void SendJson(int fd, const std::string& json, int code = 200) {
 void HandleConnection(int fd) {
   Request req;
   if (!ReadRequest(fd, &req)) { close(fd); return; }
+
+  if (!g_token.empty() && !TokenEquals(req.token, g_token)) {
+    SendJson(fd, "{\"error\": \"unauthorized\"}", 401);
+    close(fd);
+    return;
+  }
 
   if (req.method == "GET" && req.path == "/health") {
     SendJson(fd, std::string("{\"ok\": true, \"version\": \"") + kVersion +
@@ -539,9 +565,40 @@ void HandleConnection(int fd) {
 int main(int argc, char** argv) {
   int port = 8790;
   std::string host = "0.0.0.0";
+  std::string token_file;
   for (int i = 1; i < argc - 1; ++i) {
     if (std::strcmp(argv[i], "--port") == 0) port = std::atoi(argv[i + 1]);
     if (std::strcmp(argv[i], "--host") == 0) host = argv[i + 1];
+    if (std::strcmp(argv[i], "--token-file") == 0) token_file = argv[i + 1];
+  }
+  if (!token_file.empty()) {
+    FILE* f = fopen(ProcTable::Expand(token_file).c_str(), "rb");
+    if (f == nullptr) {
+      std::fprintf(stderr, "cannot read token file %s\n", token_file.c_str());
+      return 1;
+    }
+    char buf[256];
+    size_t n = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+    g_token.assign(buf, n);
+    while (!g_token.empty() &&
+           (g_token.back() == '\n' || g_token.back() == '\r' ||
+            g_token.back() == ' ')) {
+      g_token.pop_back();
+    }
+    if (g_token.empty()) {
+      // Fail CLOSED: a configured-but-empty token means a broken
+      // install, not "auth off".
+      std::fprintf(stderr, "token file %s is empty; refusing to start\n",
+                   token_file.c_str());
+      return 1;
+    }
+  } else if (const char* env_token = std::getenv("SKYTPU_AGENT_TOKEN")) {
+    g_token = env_token;
+    if (g_token.empty()) {
+      std::fprintf(stderr, "SKYTPU_AGENT_TOKEN set but empty; refusing to start\n");
+      return 1;
+    }
   }
   signal(SIGPIPE, SIG_IGN);
   // Reap orphaned /run children we never re-query.

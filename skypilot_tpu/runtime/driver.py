@@ -76,7 +76,9 @@ def run_job(job_id: int) -> job_lib.JobStatus:
     ips = [h['ip'] for h in hosts]
     log_dir = os.path.expanduser(spec['log_dir'])
     os.makedirs(log_dir, exist_ok=True)
-    clients = [AgentClient(h['ip'], h['agent_port']) for h in hosts]
+    token = spec.get('agent_token')
+    clients = [AgentClient(h['ip'], h['agent_port'], token=token)
+               for h in hosts]
 
     # SETUP phase.
     job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP)

@@ -1,0 +1,130 @@
+"""SSH local port-forwards for the agent control plane.
+
+On remote clouds the host-agent port is NEVER opened in the firewall:
+the client reaches each host's agent through an SSH tunnel
+(``ssh -N -L <local>:127.0.0.1:<agent_port> user@host``), so the
+control plane is exactly as reachable as SSH — the reference's model
+(its control plane is SSH itself, ``sky/utils/command_runner.py:426``).
+Inside the cluster the head's driver talks to worker agents over VPC-
+internal IPs (not routable from the internet), authenticated by the
+per-cluster token.
+
+Tunnels are cached per (cluster, host) and re-created if the ssh
+process died. ``_tunnel_command`` is module-level so tests can swap in
+a non-ssh forwarder.
+"""
+import atexit
+import socket
+import subprocess
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+SSH_USER = 'skytpu'
+SSH_KEY_PATH = '~/.ssh/sky-key'
+
+_lock = threading.Lock()
+# (cluster_name, host_index) -> (local_port, Popen)
+_tunnels: Dict[Tuple[str, int], Tuple[int, subprocess.Popen]] = {}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _port_listening(port: int, timeout: float = 0.5) -> bool:
+    try:
+        with socket.create_connection(('127.0.0.1', port),
+                                      timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def _tunnel_command(remote_addr: str, remote_port: int,
+                    local_port: int) -> List[str]:
+    import os
+    return [
+        'ssh',
+        '-o', 'StrictHostKeyChecking=no',
+        '-o', 'UserKnownHostsFile=/dev/null',
+        '-o', 'IdentitiesOnly=yes',
+        '-o', 'ExitOnForwardFailure=yes',
+        '-o', 'ServerAliveInterval=30',
+        '-i', os.path.expanduser(SSH_KEY_PATH),
+        '-N',
+        '-L', f'{local_port}:127.0.0.1:{remote_port}',
+        f'{SSH_USER}@{remote_addr}',
+    ]
+
+
+def get_endpoint(handle, host_index: int,
+                 timeout: float = 30.0) -> Tuple[str, int]:
+    """(addr, port) on localhost that forwards to the host's agent.
+
+    The lock is held for the whole call (including tunnel bring-up) so
+    concurrent callers for the same host share one tunnel instead of
+    racing to spawn duplicates and leaking the loser."""
+    key = (handle.cluster_name, host_index)
+    with _lock:
+        cached = _tunnels.get(key)
+        if cached is not None:
+            local_port, proc = cached
+            if proc.poll() is None and _port_listening(local_port):
+                return ('127.0.0.1', local_port)
+            # Dead tunnel — clean up and rebuild.
+            if proc.poll() is None:
+                proc.terminate()
+            del _tunnels[key]
+
+        host = handle.hosts[host_index]
+        remote_addr = host.get('external_ip') or host['ip']
+        local_port = _free_port()
+        cmd = _tunnel_command(remote_addr, host['agent_port'],
+                              local_port)
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL,
+                                start_new_session=True)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise exceptions.FetchClusterInfoError(
+                    f'SSH tunnel to {remote_addr} exited with '
+                    f'{proc.returncode}')
+            if _port_listening(local_port):
+                _tunnels[key] = (local_port, proc)
+                return ('127.0.0.1', local_port)
+            time.sleep(0.2)
+        proc.terminate()
+        raise exceptions.FetchClusterInfoError(
+            f'SSH tunnel to {remote_addr}:{host["agent_port"]} did '
+            f'not come up within {timeout}s')
+
+
+def close_tunnels(cluster_name: str) -> None:
+    """Tear down all tunnels for a cluster (on down/stop)."""
+    with _lock:
+        for key in [k for k in _tunnels if k[0] == cluster_name]:
+            _, proc = _tunnels.pop(key)
+            if proc.poll() is None:
+                proc.terminate()
+
+
+def _close_all() -> None:
+    """Tunnels are per-process; never leak detached ssh processes past
+    our own exit (registered with atexit)."""
+    with _lock:
+        for _, proc in _tunnels.values():
+            if proc.poll() is None:
+                proc.terminate()
+        _tunnels.clear()
+
+
+atexit.register(_close_all)

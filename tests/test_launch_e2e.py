@@ -201,3 +201,30 @@ class TestLaunchEndToEnd:
         # And the job completes.
         assert core.wait_for_job(cluster, job_id, timeout=60) == \
             job_lib.JobStatus.SUCCEEDED
+
+
+class TestSkyletOnCluster:
+
+    def test_skylet_starts_on_head(self, cluster):
+        """Regression: the skylet-start guard must not self-match (a
+        plain pgrep pattern or plain module path in the start text
+        makes the guard see its own shell and skip the start)."""
+        task = _local_task('echo hi', num_hosts=1)
+        job_id, handle = execution.launch(task, cluster,
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        core.wait_for_job(cluster, job_id, timeout=60)
+        head = handle.head_agent()
+        deadline = time.time() + 15
+        count = 0
+        while time.time() < deadline:
+            out = head.exec(
+                'pgrep -fc "skypilot_tpu.runtime.[s]kylet" || true')
+            count = int(out['output'].strip() or 0)
+            if count >= 1:
+                break
+            time.sleep(0.5)
+        assert count >= 1, 'skylet not running on head'
+        assert head.exec(
+            f'test -f {handle.head_runtime_dir}/skylet.log'
+        )['returncode'] == 0

@@ -286,3 +286,163 @@ class TestAutostop:
     def test_disabled(self, runtime_env):
         autostop_lib.set_autostop(-1, down=False, stop_command='true')
         assert autostop_lib.should_trigger() is None
+
+
+@pytest.fixture(params=['py', 'cpp'])
+def token_agent(request, tmp_path):
+    """A running agent of each implementation with token auth on."""
+    if request.param == 'cpp' and not _cpp_agent_available():
+        pytest.skip('C++ agent not built')
+    port = _free_port()
+    token = 's3cret-cluster-token'
+    proc = agent_client.start_local_agent(
+        port, runtime_dir=str(tmp_path),
+        use_cpp=(request.param == 'cpp'), token=token)
+    authed = AgentClient('127.0.0.1', port, token=token)
+    authed.wait_healthy(timeout=15)
+    yield port, token
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestAgentAuth:
+    """The agent executes arbitrary shell; with a token configured it
+    must reject every request that does not present it."""
+
+    def test_rejects_missing_token(self, token_agent):
+        import urllib.error
+        port, _ = token_agent
+        bare = AgentClient('127.0.0.1', port)
+        assert not bare.is_healthy()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            bare.run('echo pwned', '/dev/null')
+        assert err.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as err:
+            bare.exec('echo pwned')
+        assert err.value.code == 401
+
+    def test_rejects_wrong_token(self, token_agent):
+        import urllib.error
+        port, _ = token_agent
+        wrong = AgentClient('127.0.0.1', port, token='wrong-token')
+        assert not wrong.is_healthy()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            wrong.run('echo pwned', '/dev/null')
+        assert err.value.code == 401
+
+    def test_accepts_correct_token(self, token_agent, tmp_path):
+        port, token = token_agent
+        client = AgentClient('127.0.0.1', port, token=token)
+        assert client.is_healthy()
+        out = client.exec('echo ok-$((40+2))')
+        assert out['returncode'] == 0
+        assert 'ok-42' in out['output']
+
+    def test_token_file_is_private(self, token_agent, tmp_path):
+        token_file = tmp_path / 'agent_token'
+        assert token_file.exists()
+        assert (token_file.stat().st_mode & 0o777) == 0o600
+
+
+class TestTunnels:
+    """Client-side agent access on remote clouds rides an SSH local
+    port-forward; exercised here with a python TCP forwarder standing
+    in for ssh -N -L."""
+
+    def test_tunnel_endpoint(self, tmp_path, monkeypatch):
+        import sys
+
+        from skypilot_tpu.backends.backend import ClusterHandle
+        from skypilot_tpu.runtime import tunnels
+
+        port = _free_port()
+        token = 'tunnel-token'
+        agent_proc = agent_client.start_local_agent(
+            port, runtime_dir=str(tmp_path), token=token)
+        AgentClient('127.0.0.1', port, token=token).wait_healthy(15)
+
+        forwarder = (
+            'import socket, sys, threading\n'
+            'lp, rp = int(sys.argv[1]), int(sys.argv[2])\n'
+            's = socket.socket(); '
+            's.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n'
+            "s.bind(('127.0.0.1', lp)); s.listen(8)\n"
+            'def pipe(a, b):\n'
+            '    while True:\n'
+            '        d = a.recv(65536)\n'
+            '        if not d: break\n'
+            '        b.sendall(d)\n'
+            '    try: b.shutdown(socket.SHUT_WR)\n'
+            '    except OSError: pass\n'
+            'while True:\n'
+            '    c, _ = s.accept()\n'
+            "    u = socket.create_connection(('127.0.0.1', rp))\n"
+            '    threading.Thread(target=pipe, args=(c, u), '
+            'daemon=True).start()\n'
+            '    threading.Thread(target=pipe, args=(u, c), '
+            'daemon=True).start()\n')
+
+        def fake_tunnel_cmd(remote_addr, remote_port, local_port):
+            del remote_addr
+            return [sys.executable, '-c', forwarder, str(local_port),
+                    str(remote_port)]
+
+        monkeypatch.setattr(tunnels, '_tunnel_command',
+                            fake_tunnel_cmd)
+        handle = ClusterHandle(
+            cluster_name='tuntest', cluster_name_on_cloud='tuntest',
+            provider='gcp', region='r', zone=None,
+            launched_resources=None,
+            hosts=[{'ip': '10.0.0.2', 'external_ip': '127.0.0.1',
+                    'agent_port': port}],
+            agent_token=token)
+        try:
+            addr, lport = tunnels.get_endpoint(handle, 0)
+            assert addr == '127.0.0.1'
+            assert lport != port
+            # Same (addr, port) comes back from the cache.
+            assert tunnels.get_endpoint(handle, 0) == (addr, lport)
+            # The handle's client rides the tunnel and authenticates.
+            client = handle.agent_client(0)
+            assert client.port == lport
+            out = client.exec('echo via-$((20+3))')
+            assert 'via-23' in out['output']
+        finally:
+            tunnels.close_tunnels('tuntest')
+            agent_proc.terminate()
+            agent_proc.wait(timeout=5)
+        assert ('tuntest', 0) not in tunnels._tunnels
+
+
+class TestEmptyTokenFailsClosed:
+    """A configured-but-empty token must refuse to start, never run
+    unauthenticated."""
+
+    @pytest.mark.parametrize('impl', ['py', 'cpp'])
+    def test_empty_token_file_refuses_start(self, impl, tmp_path):
+        if impl == 'cpp' and not _cpp_agent_available():
+            pytest.skip('C++ agent not built')
+        token_file = tmp_path / 'agent_token'
+        token_file.write_text('')
+        port = _free_port()
+        if impl == 'cpp':
+            cmd = [agent_client.resolve_agent_binary(), '--port',
+                   str(port), '--token-file', str(token_file)]
+        else:
+            import sys
+            cmd = [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
+                   '--port', str(port), '--token-file',
+                   str(token_file)]
+        proc = subprocess.run(cmd, capture_output=True, timeout=15,
+                              check=False)
+        assert proc.returncode != 0
+
+    def test_empty_env_token_refuses_start(self):
+        import sys
+        env = dict(os.environ)
+        env['SKYTPU_AGENT_TOKEN'] = ''
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
+             '--port', str(_free_port())],
+            capture_output=True, timeout=15, env=env, check=False)
+        assert proc.returncode != 0
