@@ -86,6 +86,10 @@ class Backend:
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
         raise NotImplementedError
 
+    def sync_file_mounts(self, handle: ClusterHandle, file_mounts,
+                         storage_mounts) -> None:
+        raise NotImplementedError
+
     def setup(self, handle: ClusterHandle, task,
               detach_setup: bool = False) -> None:
         raise NotImplementedError

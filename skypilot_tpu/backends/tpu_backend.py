@@ -129,11 +129,17 @@ class TpuBackend(Backend):
         # a plain spelling of either makes the guard self-match and
         # skylet never starts.
         head = handle.head_agent()
+        # Guard scoped to THIS runtime dir (the local fake cloud runs
+        # many "hosts" per machine; a global guard would let the first
+        # cluster's skylet suppress every later cluster's).
+        rdir = handle.head_runtime_dir
         skylet_cmd = (
-            f'pgrep -f "skypilot_tpu.runtime.[s]kylet" > /dev/null || '
-            f'SKYTPU_RUNTIME_DIR={handle.head_runtime_dir} '
+            f'pgrep -f "skypilot_tpu.runtime.[s]kylet '
+            f'--runtime-dir {rdir}" > /dev/null || '
+            f'SKYTPU_RUNTIME_DIR={rdir} '
             f"nohup python3 -m skypilot_tpu.runtime.'s'kylet "
-            f'>> {handle.head_runtime_dir}/skylet.log 2>&1 &')
+            f'--runtime-dir {rdir} '
+            f'>> {rdir}/skylet.log 2>&1 &')
         out = head.exec(skylet_cmd, timeout=30)
         if out.get('returncode') != 0:
             logger.warning('skylet start returned %s: %s',
@@ -152,6 +158,75 @@ class TpuBackend(Backend):
         from skypilot_tpu.provision import instance_setup
         instance_setup.sync_to_all_hosts(handle, source,
                                          handle.workdir)
+
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]
+                         ) -> None:
+        """Materialize ``file_mounts`` and ``storage_mounts`` on EVERY
+        host (analog of ``_sync_file_mounts`` + the storage-mount
+        script execution, ``sky/backends/cloud_vm_ray_backend.py:3138``
+        + ``sky/data/mounting_utils.py:265``).
+
+        - file_mounts with a local source: rsync to each host.
+        - file_mounts with a gs:// source: each host pulls directly
+          from GCS (no client-side detour).
+        - storage_mounts: run the store's idempotent mount script
+          (gcsfuse for MOUNT, gsutil rsync for COPY) on each host via
+          the agent channel.
+        """
+        file_mounts = file_mounts or {}
+        storage_mounts = storage_mounts or {}
+        for target, source in file_mounts.items():
+            if source.startswith('gs://'):
+                cmd = (f'mkdir -p $(dirname {target}) && '
+                       f'gsutil -m cp -r {source} {target}')
+                self._run_on_all_hosts(handle, cmd, timeout=600)
+                continue
+            src = os.path.expanduser(source)
+            if not os.path.exists(src):
+                raise exceptions.StorageSourceError(
+                    f'file_mount source {source!r} does not exist')
+            is_dir = os.path.isdir(src)
+            if handle.provider == 'local':
+                from skypilot_tpu.utils.command_runner import \
+                    LocalCommandRunner
+                runner = LocalCommandRunner()
+                if is_dir:
+                    runner.rsync(src.rstrip('/') + '/',
+                                 target.rstrip('/') + '/', up=True)
+                else:
+                    runner.rsync(src, target, up=True)
+            else:
+                from skypilot_tpu.provision import instance_setup
+                if is_dir:
+                    instance_setup.sync_to_all_hosts(
+                        handle, src.rstrip('/') + '/', target)
+                else:
+                    instance_setup.sync_file_to_all_hosts(
+                        handle, src, target)
+        for path, storage in storage_mounts.items():
+            cmd = storage.mount_command(path)
+            self._run_on_all_hosts(handle, cmd, timeout=900)
+            logger.info('Storage %s %s at %s on %d host(s)',
+                        storage.name, storage.mode.value.lower(),
+                        path, handle.num_hosts)
+
+    def _run_on_all_hosts(self, handle: ClusterHandle, cmd: str,
+                          timeout: float = 600.0) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(i: int):
+            out = handle.agent_client(i).exec(cmd, timeout=timeout)
+            return i, out
+
+        with ThreadPoolExecutor(
+                max_workers=min(32, handle.num_hosts)) as pool:
+            for i, out in pool.map(one, range(handle.num_hosts)):
+                if out.get('returncode') != 0:
+                    raise exceptions.CommandError(
+                        out.get('returncode', 1),
+                        f'run on host {i}', out.get('output', ''))
 
     def setup(self, handle: ClusterHandle, task: Task,
               detach_setup: bool = False) -> None:

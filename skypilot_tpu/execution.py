@@ -24,6 +24,7 @@ class Stage(enum.Enum):
     OPTIMIZE = enum.auto()
     PROVISION = enum.auto()
     SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
     SETUP = enum.auto()
     EXEC = enum.auto()
     DOWN = enum.auto()
@@ -91,8 +92,16 @@ def _execute(task: Task, *, cluster_name: str,
     if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
         backend.sync_workdir(handle, task.workdir)
 
-    if task.storage_mounts:
-        task.sync_storage_mounts()
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        if task.storage_mounts:
+            # Client side: ensure buckets exist, upload sources.
+            task.sync_storage_mounts()
+        # Cluster side: rsync file mounts, run mount scripts on every
+        # host (reference: cloud_vm_ray_backend.py:3138 sync stage +
+        # mounting_utils.py:265 mount script).
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
 
     job_id = None
     if Stage.EXEC in stages:

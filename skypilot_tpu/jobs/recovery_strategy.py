@@ -72,6 +72,20 @@ class StrategyExecutor:
                     'Launch attempt %d/%d failed: %s', attempt + 1,
                     max_retries, e)
                 time.sleep(RETRY_GAP_SECONDS)
+            except (exceptions.CommandError, OSError) as e:
+                # Cluster died mid-launch (e.g. spot preemption while
+                # the job submit was in flight): reconcile the state
+                # DB so the next attempt re-provisions instead of
+                # reusing a dead handle, then retry.
+                logger.warning(
+                    'Launch attempt %d/%d lost the cluster '
+                    'mid-submit (%s); reconciling and retrying.',
+                    attempt + 1, max_retries, e)
+                try:
+                    core_lib.status([cluster_name], refresh=True)
+                except exceptions.SkyTpuError:
+                    pass
+                time.sleep(RETRY_GAP_SECONDS)
         return None
 
     def terminate_cluster(self, cluster_name: str) -> None:
@@ -91,7 +105,8 @@ class FailoverStrategy(StrategyExecutor):
 
     def recover(self, task, cluster_name, preempted_region):
         self.terminate_cluster(cluster_name)
-        # 1st: same region (pin it).
+        # 1st: same region (pin it). try/finally so a no_failover
+        # error from launch() cannot leave the pinned set behind.
         if preempted_region is not None:
             pinned = {
                 r.copy(region=preempted_region) if r.region is None
@@ -99,8 +114,11 @@ class FailoverStrategy(StrategyExecutor):
             }
             original = task.resources
             task.set_resources(pinned)
-            job_id = self.launch(task, cluster_name, max_retries=1)
-            task.set_resources(original)
+            try:
+                job_id = self.launch(task, cluster_name,
+                                     max_retries=1)
+            finally:
+                task.set_resources(original)
             if job_id is not None:
                 return job_id
         return self.launch(task, cluster_name)
@@ -117,22 +135,10 @@ class EagerNextRegionStrategy(StrategyExecutor):
                 if r.accelerator is not None:
                     self.blocked_resources.add(
                         r.copy(region=preempted_region, zone=None))
-        # Provisioning honors the blocklist through the optimizer by
-        # filtering candidate regions at the Resources level: pin a
-        # not-blocked region ordering by temporarily removing the
-        # preempted region from consideration.
-        pruned = set()
-        for r in task.resources:
-            if (r.region is not None and
-                    r.region == preempted_region and
-                    r.accelerator is not None):
-                # The user pinned this exact region: keep it (no
-                # alternative exists) — same as reference behavior.
-                pruned.add(r)
-            else:
-                pruned.add(r)
+        # The blocklist steers the optimizer to a not-blocked
+        # placement; a user-pinned region stays pinned (no
+        # alternative exists — same as reference behavior).
         original = task.resources
-        task.set_resources(pruned)
         try:
             from skypilot_tpu import optimizer as optimizer_lib
             from skypilot_tpu.dag import Dag

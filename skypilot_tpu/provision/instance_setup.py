@@ -130,6 +130,21 @@ def sync_to_all_hosts(handle: ClusterHandle, source: str,
         list(pool.map(one, runners))
 
 
+def sync_file_to_all_hosts(handle: ClusterHandle, source: str,
+                           target: str) -> None:
+    """Single-file variant (file_mounts with a file source)."""
+    runners = _runners(handle)
+
+    def one(runner: SSHCommandRunner) -> None:
+        parent = os.path.dirname(target.rstrip('/')) or '.'
+        runner.run(f'mkdir -p {parent}')
+        runner.rsync(source, target, up=True)
+
+    with ThreadPoolExecutor(max_workers=min(32,
+                                            len(runners))) as pool:
+        list(pool.map(one, runners))
+
+
 def wait_for_ssh(handle: ClusterHandle, timeout: float = 600.0) -> None:
     import time
     runners = _runners(handle)

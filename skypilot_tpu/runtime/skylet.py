@@ -39,7 +39,15 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--interval', type=float,
                         default=EVENT_INTERVAL_SECONDS)
+    parser.add_argument('--runtime-dir', default=None,
+                        help='Runtime dir to serve. Also an argv '
+                             'marker so the start guard can pgrep '
+                             'for THIS dir\'s skylet (the local fake '
+                             'cloud runs many hosts per machine).')
     args = parser.parse_args()
+    if args.runtime_dir:
+        import os as _os
+        _os.environ['SKYTPU_RUNTIME_DIR'] = args.runtime_dir
     scheduler = job_lib.FIFOScheduler()
     logger.info('skylet started (interval %.1fs, runtime dir %s)',
                 args.interval, job_lib.runtime_dir())

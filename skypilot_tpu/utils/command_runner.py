@@ -103,9 +103,28 @@ class SSHCommandRunner:
     def rsync(self, source: str, target: str, *, up: bool,
               log_path: str = '/dev/null') -> None:
         """Sync a file/dir. up=True: local → remote. Falls back to a
-        tar-over-ssh pipe when rsync is not installed locally."""
+        tar-over-ssh pipe (dirs) or cat-over-ssh (single file) when
+        rsync is not installed locally."""
         import shutil as _shutil
         remote = f'{self.ssh_user}@{self.ip}'
+        if up and not _shutil.which('rsync') and \
+                os.path.isfile(os.path.expanduser(source)):
+            ssh_prefix = ' '.join(
+                ['ssh'] + [shlex.quote(o) for o in ssh_options_list(
+                    self.ssh_private_key, self._control_name,
+                    port=self.port)] + [remote])
+            parent = os.path.dirname(target.rstrip('/')) or '.'
+            pipe = (f'cat {shlex.quote(os.path.expanduser(source))} | '
+                    f'{ssh_prefix} "mkdir -p {parent} && '
+                    f'cat > {target}"')
+            proc = subprocess.run(['/bin/bash', '-c', pipe],
+                                  capture_output=True, text=True,
+                                  check=False)
+            if proc.returncode != 0:
+                raise exceptions.CommandError(
+                    proc.returncode, 'file-sync',
+                    proc.stderr[-500:])
+            return
         if _shutil.which('rsync'):
             ssh_cmd = ' '.join(
                 ['ssh'] + [shlex.quote(o) for o in ssh_options_list(
@@ -176,7 +195,12 @@ class LocalCommandRunner:
               log_path: str = '/dev/null') -> None:
         import shutil as _shutil
         del up
+        source_exp = os.path.expanduser(source)
         target = os.path.expanduser(target)
+        if os.path.isfile(source_exp.rstrip('/')):
+            os.makedirs(os.path.dirname(target) or '.', exist_ok=True)
+            _shutil.copy2(source_exp.rstrip('/'), target)
+            return
         os.makedirs(target if source.endswith('/') else
                     (os.path.dirname(target) or '.'), exist_ok=True)
         if _shutil.which('rsync'):

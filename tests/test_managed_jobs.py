@@ -186,3 +186,70 @@ class TestManagedJobsEndToEnd:
         ctrl_rec = state.get_cluster_from_name(
             rec['controller_cluster'])
         assert ctrl_rec is not None
+
+
+class TestCheckpointRecoveryViaStorage:
+    """The TPU-spot headline pattern: task checkpoints to a mounted
+    bucket; on preemption the recovered run resumes from it
+    (reference: managed jobs + MOUNT-mode storage)."""
+
+    def test_preempt_resume_from_mounted_checkpoint(
+            self, tmp_path, cleanup_clusters, monkeypatch):
+        import threading
+
+        from skypilot_tpu.data.storage import Storage, StorageMode
+
+        bucket_dir = tmp_path / 'fake-bucket'
+        mount_path = tmp_path / 'mnt' / 'ckpt'
+
+        monkeypatch.setattr(Storage, 'construct', lambda self: None)
+        monkeypatch.setattr(
+            Storage, 'mount_command',
+            lambda self, path: (
+                f'mkdir -p {bucket_dir} && '
+                f'mkdir -p $(dirname {path}) && '
+                f'ln -sfn {bucket_dir} {path}'))
+
+        # First run: writes the checkpoint, then idles long enough to
+        # be preempted. Recovered run: sees the checkpoint, finishes.
+        run = (f'if [ -f {mount_path}/done.ckpt ]; then '
+               f'echo resumed-from-ckpt; exit 0; fi; '
+               f'echo step-1 > {mount_path}/done.ckpt; sleep 8')
+        task = _local_task(run, name='mjckpt')
+        task.set_storage_mounts(
+            {str(mount_path): Storage(name='fake-bucket',
+                                      mode=StorageMode.MOUNT)})
+
+        dag_yaml = str(tmp_path / 'dag.yaml')
+        import yaml
+        with open(dag_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([t.to_yaml_config() for t in [task]],
+                               f)
+        job_id = jobs_state.add_job('mjckpt', dag_yaml, 'inproc')
+        from skypilot_tpu.jobs.controller import JobsController
+        ctrl = JobsController(job_id, dag_yaml)
+        cluster_name = f'mjckpt-{job_id}-0'
+
+        def preempt():
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rec = state.get_cluster_from_name(cluster_name)
+                if rec is not None and (bucket_dir /
+                                        'done.ckpt').exists():
+                    handle = rec['handle']
+                    provision.terminate_instances(
+                        'local', handle.region,
+                        handle.cluster_name_on_cloud)
+                    return
+                time.sleep(0.5)
+
+        killer = threading.Timer(2.0, preempt)
+        killer.start()
+        try:
+            final = ctrl.run()
+        finally:
+            killer.cancel()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert jobs_state.get_job(job_id)['recovery_count'] >= 1
+        # The recovered run read the checkpoint from the "bucket".
+        assert (bucket_dir / 'done.ckpt').exists()
