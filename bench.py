@@ -55,7 +55,13 @@ def main() -> None:
         lora_rank=None if full_ft else lora_rank)
     step = build_train_step(config, mesh, shardings)
 
-    tokens = jax.random.randint(jax.random.PRNGKey(1),
+    # Seed from entropy: the serving tunnel caches executions keyed on
+    # (executable, inputs) across PROCESSES — a fully deterministic
+    # bench replays instantly on its second invocation and reports
+    # absurd throughput. Fresh tokens per run defeat the cache; the
+    # loss on random tokens is seed-insensitive (~ln vocab).
+    seed = int.from_bytes(os.urandom(4), 'little')
+    tokens = jax.random.randint(jax.random.PRNGKey(seed),
                                 (batch, seq + 1), 0, config.vocab_size,
                                 dtype=jnp.int32)
     batch_dict = {'tokens': tokens}

@@ -1,14 +1,34 @@
 """Attention ops: XLA reference implementation + Pallas TPU flash
-attention.
+attention (forward AND backward kernels, native GQA).
 
-``flash_attention`` dispatches to a Pallas kernel on TPU (block-tiled,
+``flash_attention`` dispatches to Pallas kernels on TPU (block-tiled,
 online-softmax, O(seq) memory) and to the XLA reference elsewhere
-(tests run on the CPU backend). Backward pass uses recompute-based
-custom VJP: the standard flash trick of saving only (out, logsumexp)
-and recomputing attention probabilities blockwise in the bwd kernel.
+(tests run the kernels in interpret mode on the CPU backend).
 
-GQA (grouped-query attention) is handled by folding KV-head groups:
-q: [B, T, H, D], k/v: [B, S, Hkv, D] with H % Hkv == 0.
+Design notes (TPU-first):
+- Kernels operate on a [B, H, T, D] layout so every block DMA is a
+  contiguous [rows, D] tile; the caller's transpose from the model's
+  [B, T, H, D] is absorbed into the preceding projection's output
+  layout by XLA.
+- GQA is native: K/V stay at [B, Hkv, S, D] and the kernel grid maps
+  query head h to KV head h // (H // Hkv) in the BlockSpec index_map —
+  no jnp.repeat, so K/V HBM traffic is 1/group of the naive version.
+- MXU dots run in bf16 x bf16 -> f32 (``preferred_element_type``);
+  softmax statistics and accumulators are f32. Scaling is applied to
+  the f32 logits after the dot so the operands stay bf16.
+- Backward is the FlashAttention-2 split: a dQ kernel gridded over
+  (B, H, q-blocks) and a dK/dV kernel gridded over (B, Hkv, k-blocks)
+  that accumulates over the KV-head's query group in-kernel. Both
+  recompute probabilities from the saved (q, k, v, lse) — only
+  O(B*H*T) statistics are saved, never the [T, S] matrix.
+- Causal masking is bottom-right aligned (q_pos + S - T >= k_pos),
+  matching ``dot_product_attention``'s ``tril(k=s-t)`` so cross-length
+  decode/prefill attention is consistent between the two paths.
+
+The reference framework has no TPU attention kernel at all (its
+compute path is user code / HF Trainer, see BASELINE.md); this module
+is the TPU-native replacement for the torch SDPA the reference's
+recipes rely on.
 """
 import functools
 from typing import Optional
@@ -19,6 +39,10 @@ import jax.numpy as jnp
 _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
+# f32 min sublane tile: statistics (lse/delta) are stored [B, H, 8, T]
+# with 8 broadcast sublanes so their (8, block) VMEM tiles satisfy
+# Mosaic's (8, 128) f32 minimum.
+_STAT_SUBLANES = 8
 
 
 def _on_tpu() -> bool:
@@ -58,181 +82,410 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 # ---------------------------------------------------------------------
-# Pallas TPU kernel: forward.
+# Pallas TPU kernels. Layout: q/o [B, H, T, D]; k/v [B, Hkv, S, D];
+# statistics [B, H, 8, T] (f32, sublane-broadcast).
 # ---------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                      causal, block_k, seq_k):
-    """One (batch*head, q-block) program: stream K/V blocks with
-    online softmax. Shapes in-refs: q [Bq, D], k/v [S, D]."""
+def _causal_bounds(q_idx, block_q, block_k, offset, num_kb):
+    """Shared causal block-bound math for the fwd and dQ kernels.
+
+    Returns (n_full, last_kb, relpos): K blocks [0, n_full) are fully
+    visible for this q block, [n_full, last_kb) straddle the diagonal
+    (mask with ``relpos >= kb * block_k``), and [last_kb, num_kb) are
+    fully hidden. ``relpos[r, c] = q_pos(r) + offset - c`` is hoisted
+    here so the diagonal loop only pays a scalar shift per block.
+    """
     from jax.experimental import pallas as pl
 
-    q = q_ref[...].astype(jnp.float32) * scale
+    n_full = jnp.clip(
+        (q_idx * block_q + offset + 1 - block_k) // block_k + 1,
+        0, num_kb)
+    last_kb = jnp.clip(
+        pl.cdiv((q_idx + 1) * block_q + offset, block_k), 0, num_kb)
+    relpos = (q_idx * block_q + offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0) -
+        jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
+    return n_full, last_kb, relpos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_q, seq_k):
+    """One (b, h, q-block) program: stream K/V blocks with online
+    softmax. Refs: q [Bq, D]; k/v [S, D]; o [Bq, D]; lse [8, Bq].
+
+    Causal masking is applied only to blocks straddling the diagonal;
+    fully-visible blocks run a mask-free body and fully-hidden blocks
+    are skipped by the loop bound. The iota for the diagonal mask is
+    hoisted out of the loop — the VPU (mask/exp/select) is the
+    bottleneck of this kernel at head_dim 64, not the MXU.
+    """
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...]  # bf16 — stays bf16 for the MXU
     block_q = q.shape[0]
-    q_idx = pl.program_id(1)
+    d = q.shape[-1]
+    q_idx = pl.program_id(2)
+    offset = seq_k - seq_q  # bottom-right causal alignment
 
     m = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    acc = jnp.zeros((block_q, d), jnp.float32)
 
     num_kb = seq_k // block_k
+    if causal:
+        n_full, last_kb, relpos = _causal_bounds(
+            q_idx, block_q, block_k, offset, num_kb)
 
-    def body(kb, carry):
+    def body(kb, carry, masked):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
-        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(
-            jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jnp.dot(q, k_blk.T,
-                    preferred_element_type=jnp.float32)  # [Bq, Bk]
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+                    preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(relpos >= kb * block_k, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     if causal:
-        # Only blocks at or before the diagonal contribute.
-        last_kb = jnp.minimum(
-            num_kb,
-            (q_idx + 1) * block_q // block_k +
-            (1 if block_q % block_k else 0) + 1)
-        last_kb = jnp.minimum(last_kb, num_kb)
-        m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m, l, acc))
+        carry = jax.lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False),
+            (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(
+            n_full, last_kb, functools.partial(body, masked=True),
+            carry)
     else:
-        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(
+            0, num_kb, functools.partial(body, masked=False),
+            (m, l, acc))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse block is (8, block_q): broadcast over the 8 padding sublanes
-    # (f32 min tile is (8, 128); a squeezed/1-sublane block is
-    # rejected by Mosaic).
-    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
-    lse_ref[...] = jnp.broadcast_to(lse[None, :], lse_ref.shape)
+    out = acc / l_safe[:, None]
+    lse = m + jnp.log(l_safe)
+    if causal and offset < 0:
+        # seq_q > seq_k: rows with q_pos + offset < 0 see NO keys. In
+        # a straddling block every logit is _NEG_INF, so m == _NEG_INF
+        # and p = exp(0) degenerates to a uniform average — fix up
+        # such rows to out = 0 and lse = +BIG (making the backward's
+        # exp(s - lse) exactly 0, hence zero gradients). Only compiled
+        # in for the t > s case.
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+        valid = (q_idx * block_q + row + offset) >= 0
+        out = jnp.where(valid, out, 0.0)
+        lse = jnp.where(valid[:, 0], lse, -_NEG_INF)
+    o_ref[...] = out.astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to(
+        lse.astype(jnp.float32)[None, :], lse_ref.shape)
 
 
-def _flash_fwd_pallas(q, k, v, *, scale, causal, block_q, block_k):
-    """q: [BH, T, D], k/v: [BH, S, D] -> (out [BH,T,D], lse [BH,T])."""
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, block_k, seq_q, seq_k):
+    """dQ for one (b, h, q-block): recompute P blockwise from lse.
+    Refs: q/do/dq [Bq, D]; k/v [S, D]; lse/delta [8, Bq]."""
     from jax.experimental import pallas as pl
 
-    bh, t, d = q.shape
-    s = k.shape[1]
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[0, :]      # [Bq]
+    delta = delta_ref[0, :]  # [Bq]
+    block_q, d = q.shape
+    q_idx = pl.program_id(2)
+    offset = seq_k - seq_q
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    num_kb = seq_k // block_k
+    if causal:
+        n_full, last_kb, relpos = _causal_bounds(
+            q_idx, block_q, block_k, offset, num_kb)
+
+    def body(kb, acc, masked):
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(relpos >= kb * block_k, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])           # masked -> exp(-inf)=0
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return acc + jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                             preferred_element_type=jnp.float32)
+
+    if causal:
+        acc = jax.lax.fori_loop(
+            0, n_full, functools.partial(body, masked=False), acc)
+        acc = jax.lax.fori_loop(
+            n_full, last_kb, functools.partial(body, masked=True), acc)
+    else:
+        acc = jax.lax.fori_loop(
+            0, num_kb, functools.partial(body, masked=False), acc)
+    dq_ref[...] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
+                    seq_k):
+    """dK/dV for one (b, kv-head, k-block, group-member) program.
+
+    Native GQA: the grid's innermost dimension iterates the KV head's
+    query-group members; the dk/dv output block index is independent
+    of it, so the f32 accumulators stay resident in VMEM across the
+    group and the contributions reduce in-place (zeroed at g == 0) —
+    no repeated K/V is ever materialized. Refs: q/do [T, D];
+    k/v [Bk, D]; lse/delta [8, T]; dk/dv [Bk, D] f32."""
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[...]
+    v_blk = v_ref[...]
+    block_k, d = k_blk.shape
+    k_idx = pl.program_id(2)
+    g = pl.program_id(3)
+    offset = seq_k - seq_q
+
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    dk_acc = jnp.zeros((block_k, d), jnp.float32)
+    dv_acc = jnp.zeros((block_k, d), jnp.float32)
+    num_qb = seq_q // block_q
+
+    if causal:
+        # q_pos + offset >= k_pos; smallest k_pos in this block is
+        # k_idx*block_k, so q blocks strictly before
+        # (k_idx*block_k - offset) // block_q contribute nothing, and
+        # q blocks whose min q_pos + offset >= max k_pos are fully
+        # visible (mask-free body).
+        start_qb = jnp.clip((k_idx * block_k - offset) // block_q, 0,
+                            num_qb)
+        first_full_qb = jnp.clip(
+            pl.cdiv((k_idx + 1) * block_k - 1 - offset, block_q), 0,
+            num_qb)
+        relpos = (offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0) -
+            (k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)))
+
+    def body(qb, carry, masked=False):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
+        do_blk = do_ref[pl.ds(qb * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        s = jnp.dot(q_blk, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(relpos + qb * block_q >= 0, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])
+        pt = p.astype(do_blk.dtype).T
+        dv_new = dv_acc + jnp.dot(
+            pt, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk_new = dk_acc + jnp.dot(
+            ds.astype(q_blk.dtype).T, q_blk,
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        carry = jax.lax.fori_loop(
+            start_qb, first_full_qb,
+            functools.partial(body, masked=True), (dk_acc, dv_acc))
+        dk_acc, dv_acc = jax.lax.fori_loop(
+            first_full_qb, num_qb,
+            functools.partial(body, masked=False), carry)
+    else:
+        dk_acc, dv_acc = jax.lax.fori_loop(0, num_qb, body,
+                                           (dk_acc, dv_acc))
+
+    dk_ref[...] += dk_acc
+    dv_ref[...] += dv_acc
+
+
+# ---------------------------------------------------------------------
+# pallas_call wrappers. All take q [B, H, T, D], k/v [B, Hkv, S, D].
+# ---------------------------------------------------------------------
+
+
+def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k,
+                interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    groups = h // hkv
     block_q = min(block_q, t)
     block_k = min(block_k, s)
-    assert t % block_q == 0 and s % block_k == 0, (t, s, block_q,
-                                                  block_k)
-    grid = (bh, t // block_q)
+    grid = (b, h, t // block_q)
 
-    kernel = functools.partial(_flash_fwd_kernel, scale=scale,
-                               causal=causal, block_k=block_k, seq_k=s)
-    # lse is stored [BH, 8, T]: 8 identical sublanes so the block
-    # (8, block_q) meets the f32 (8, 128) min-tile constraint.
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_q=t, seq_k=s)
+    kv_spec = pl.BlockSpec((None, None, s, d),
+                           lambda b, hh, i: (b, hh // groups, 0, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, hh, i: (b, hh, i, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, 8, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda b, hh, i: (b, hh, i, 0)),
+            pl.BlockSpec((None, None, _STAT_SUBLANES, block_q),
+                         lambda b, hh, i: (b, hh, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 8, t), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, _STAT_SUBLANES, t),
+                                 jnp.float32),
         ],
+        interpret=interpret,
     )(q, k, v)
-    return out, lse[:, 0, :]
+    return out, lse
+
+
+def _bwd_pallas(q, k, v, out, lse, do, *, scale, causal, block_q,
+                block_k, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    _, hkv, s, _ = k.shape
+    groups = h // hkv
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+
+    # delta[b,h,i] = sum_d dO * O — one fused XLA pass, then sublane-
+    # broadcast to the same [B, H, 8, T] layout as lse.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None, :],
+                             (b, h, _STAT_SUBLANES, t))
+    if lse.ndim == 3:
+        lse = jnp.broadcast_to(lse[:, :, None, :],
+                               (b, h, _STAT_SUBLANES, t))
+
+    q_spec = pl.BlockSpec((None, None, block_q, d),
+                          lambda b, hh, i: (b, hh, i, 0))
+    kv_full_spec = pl.BlockSpec((None, None, s, d),
+                                lambda b, hh, i: (b, hh // groups, 0,
+                                                  0))
+    stat_spec = pl.BlockSpec((None, None, _STAT_SUBLANES, block_q),
+                             lambda b, hh, i: (b, hh, 0, i))
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  causal=causal, block_k=block_k,
+                                  seq_q=t, seq_k=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, t // block_q),
+        in_specs=[q_spec, kv_full_spec, kv_full_spec, q_spec,
+                  stat_spec, stat_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, block_q=block_q,
+                                   seq_q=t, seq_k=s)
+    # Grid: group member g innermost so the dk/dv output block index
+    # (b, kv_head, j) is constant across g — Pallas keeps the block in
+    # VMEM and the kernel accumulates into it.
+    qg_spec = pl.BlockSpec((None, None, t, d),
+                           lambda b, kvh, j, g: (b, kvh * groups + g,
+                                                 0, 0))
+    kv_blk_spec = pl.BlockSpec((None, None, block_k, d),
+                               lambda b, kvh, j, g: (b, kvh, j, 0))
+    statg_spec = pl.BlockSpec((None, None, _STAT_SUBLANES, t),
+                              lambda b, kvh, j, g: (b,
+                                                    kvh * groups + g,
+                                                    0, 0))
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, hkv, s // block_k, groups),
+        in_specs=[qg_spec, kv_blk_spec, kv_blk_spec, qg_spec,
+                  statg_spec, statg_spec],
+        out_specs=[kv_blk_spec, kv_blk_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, s, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------
-# custom VJP wrapper with recompute-based backward.
+# custom VJP wrapper (on the [B, H, T, D] kernel layout).
 # ---------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, scale, block_q, block_k):
-    out, _ = _flash_fwd_pallas(q, k, v, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8,
+                                                    9))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k,
+                     block_q_bwd, block_k_bwd, interpret):
+    out, _ = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    out, lse = _flash_fwd_pallas(q, k, v, scale=scale, causal=causal,
-                                 block_q=block_q, block_k=block_k)
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
+                    block_q_bwd, block_k_bwd, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+    # Residuals are tagged so a surrounding jax.checkpoint with the
+    # ``remat_policy()`` policy saves them instead of re-running the
+    # forward kernel during backward (q/k/v stay rematerialized — they
+    # are cheap MXU projections). lse is saved de-duplicated [B,H,T];
+    # the bwd wrapper re-broadcasts the stat sublanes.
+    out = checkpoint_name(out, 'flash_attn_out')
+    lse = checkpoint_name(lse[:, :, 0, :], 'flash_attn_lse')
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_chunk(causal, scale, q, k, v, out, lse, do):
-    """Backward recompute for one BH-chunk. Materializes [bh, T, S]
-    probabilities for the chunk only."""
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    outf = out.astype(jnp.float32)
-
-    s = jnp.einsum('btd,bsd->bts', qf * scale, kf)
-    if causal:
-        t_, s_ = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((t_, s_), dtype=bool), k=s_ - t_)
-        s = jnp.where(mask[None], s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])  # [bh, T, S]
-    dv = jnp.einsum('bts,btd->bsd', p, dof)
-    dp = jnp.einsum('btd,bsd->bts', dof, vf)
-    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)  # [bh,T,1]
-    ds = p * (dp - delta)
-    dq = jnp.einsum('bts,bsd->btd', ds, kf) * scale
-    dk = jnp.einsum('bts,btd->bsd', ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
-# Cap the fp32 [chunk, T, S] recompute temp at ~1 GB.
-_BWD_TEMP_BYTES = 1 << 30
-
-
-def _flash_bwd_rule(causal, scale, block_q, block_k, residuals, do):
-    """Flash-attention backward: recompute probabilities from (q, k,
-    v, lse), scanned over chunks of the batch*heads dim so the O(T^2)
-    temp never exceeds ~1 GB (full materialization OOMed a v5e-1 at
-    batch 16 x 32 heads x 2048^2). A blockwise Pallas bwd kernel is
-    the planned upgrade for long-context."""
-    del block_q, block_k
+def _flash_bwd_rule(causal, scale, block_q, block_k, block_q_bwd,
+                    block_k_bwd, interpret, residuals, do):
     q, k, v, out, lse = residuals
-    bh, t, _ = q.shape
-    s_len = k.shape[1]
-    per_row = t * s_len * 4
-    chunk = max(1, min(bh, _BWD_TEMP_BYTES // per_row))
-    while bh % chunk != 0:
-        chunk -= 1
-    if chunk == bh:
-        return _flash_bwd_chunk(causal, scale, q, k, v, out, lse, do)
-
-    def body(args):
-        qc, kc, vc, oc, lc, dc = args
-        return _flash_bwd_chunk(causal, scale, qc, kc, vc, oc, lc, dc)
-
-    n = bh // chunk
-    reshape = lambda x: x.reshape((n, chunk) + x.shape[1:])
-    dq, dk, dv = jax.lax.map(
-        body, (reshape(q), reshape(k), reshape(v), reshape(out),
-               reshape(lse), reshape(do)))
-    unshape = lambda x: x.reshape((bh,) + x.shape[2:])
-    return unshape(dq), unshape(dk), unshape(dv)
+    return _bwd_pallas(q, k, v, out, lse, do, scale=scale,
+                       causal=causal, block_q=block_q_bwd,
+                       block_k=block_k_bwd, interpret=interpret)
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def remat_policy(base_policy=None):
+    """Checkpoint policy that saves the flash-attention kernel's
+    outputs (out + lse) so layer-level remat does not re-run the
+    forward kernel in backward. Compose with ``jax.checkpoint``:
+
+        jax.checkpoint(layer_fn, policy=attention.remat_policy())
+
+    ``base_policy``: optional policy to OR with (e.g.
+    ``jax.checkpoint_policies.save_only_these_names(...)``).
+    """
+    names_policy = jax.checkpoint_policies.save_only_these_names(
+        'flash_attn_out', 'flash_attn_lse')
+    if base_policy is None:
+        return names_policy
+    return jax.checkpoint_policies.save_from_both_policies(
+        names_policy, base_policy)
 
 
 # ---------------------------------------------------------------------
@@ -245,33 +498,45 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     scale: Optional[float] = None,
                     block_q: int = _DEFAULT_BLOCK_Q,
                     block_k: int = _DEFAULT_BLOCK_K,
-                    force_pallas: bool = False) -> jax.Array:
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
+                    force_pallas: bool = False,
+                    interpret: bool = False) -> jax.Array:
     """Flash attention. q: [B,T,H,D]; k,v: [B,S,Hkv,D] -> [B,T,H,D].
 
-    On TPU (or with force_pallas) uses the Pallas kernel; elsewhere
+    On TPU (or with force_pallas) uses the Pallas kernels; elsewhere
     falls back to the XLA reference so the same model code runs in
-    CPU tests.
+    CPU tests. ``interpret=True`` runs the kernels in the Pallas
+    interpreter (kernel unit tests on CPU).
     """
     b, t, h, d = q.shape
     _, s, hkv, _ = k.shape
+    assert h % hkv == 0, (h, hkv)
     if scale is None:
         scale = d ** -0.5
+    # Separate bwd block sizes are exposed for tuning; measured on
+    # v5e (1B shapes) the fwd sizes are within noise of best for bwd
+    # too, and 2048-wide bwd blocks exceed VMEM.
+    if block_q_bwd is None:
+        block_q_bwd = block_q
+    if block_k_bwd is None:
+        block_k_bwd = block_k
     use_pallas = force_pallas or _on_tpu()
-    # The kernel wants block-divisible sequence lengths.
+    # The kernels want block-divisible sequence lengths.
     if use_pallas and (t % min(block_q, t) == 0 and
                        s % min(block_k, s) == 0 and
-                       t >= 128 and s >= 128):
-        groups = h // hkv
-        if groups > 1:
-            # Expand KV heads for the kernel (cheap: broadcast, XLA
-            # fuses the gather into the kernel's operand layout).
-            k = jnp.repeat(k, groups, axis=2)
-            v = jnp.repeat(v, groups, axis=2)
-        # [B,T,H,D] -> [B*H, T, D]
-        qr = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        kr = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-        vr = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-        out = _flash_attention(qr, kr, vr, causal, scale, block_q,
-                               block_k)
-        return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+                       t % min(block_q_bwd, t) == 0 and
+                       s % min(block_k_bwd, s) == 0 and
+                       (interpret or (t >= 128 and s >= 128))):
+        # [B,T,H,D] -> [B,H,T,D]; XLA folds this into the producing
+        # projection's output layout. K/V keep their Hkv heads — GQA
+        # is handled inside the kernel grid.
+        qr = q.transpose(0, 2, 1, 3)
+        kr = k.transpose(0, 2, 1, 3)
+        vr = v.transpose(0, 2, 1, 3)
+        out = _flash_attention(qr, kr, vr, causal, scale,
+                               block_q, block_k,
+                               min(block_q_bwd, t),
+                               min(block_k_bwd, s), interpret)
+        return out.transpose(0, 2, 1, 3)
     return dot_product_attention(q, k, v, causal=causal, scale=scale)

@@ -139,19 +139,26 @@ class TestControllerInProcess:
         cluster_name = f'mjp-{job_id}-0'
 
         def preempt():
-            # Wait for the task cluster to be up and running.
+            # Wait until the managed job is actually RUNNING (not just
+            # the cluster record existing): a kill during provision/
+            # submit is absorbed by the launch retry path and never
+            # increments recovery_count — a timing flake, not the
+            # mid-run preemption this test is about.
             deadline = time.time() + 60
             while time.time() < deadline:
-                rec = state.get_cluster_from_name(cluster_name)
-                if rec is not None:
-                    handle = rec['handle']
-                    provision.terminate_instances(
-                        'local', handle.region,
-                        handle.cluster_name_on_cloud)
-                    return
+                rec = jobs_state.get_job(job_id)
+                if rec is not None and rec['status'] == \
+                        jobs_state.ManagedJobStatus.RUNNING:
+                    crec = state.get_cluster_from_name(cluster_name)
+                    if crec is not None:
+                        handle = crec['handle']
+                        provision.terminate_instances(
+                            'local', handle.region,
+                            handle.cluster_name_on_cloud)
+                        return
                 time.sleep(0.5)
 
-        killer = threading.Timer(4.0, preempt)
+        killer = threading.Timer(0.5, preempt)
         killer.start()
         try:
             final = ctrl.run()

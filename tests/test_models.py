@@ -48,39 +48,90 @@ class TestAttention:
         ref = attn.dot_product_attention(q, q, q, causal=True)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
-    def test_pallas_kernel_on_cpu_interpreter(self):
-        """The Pallas kernel itself (interpret mode unavailable here;
-        exercised via TPU bench) — verify the vjp wrapper's math by
-        running the custom backward against autodiff of the
-        reference."""
-        key = jax.random.PRNGKey(5)
-        bh, t, d = 4, 64, 16
-        q = jax.random.normal(key, (bh, t, d))
-        k = jax.random.normal(jax.random.PRNGKey(6), (bh, t, d))
-        v = jax.random.normal(jax.random.PRNGKey(7), (bh, t, d))
-        do = jax.random.normal(jax.random.PRNGKey(8), (bh, t, d))
-        scale = d ** -0.5
+    # The Pallas kernels run on CPU via the Pallas interpreter; the
+    # surrounding jax.default_matmul_precision('highest') matters
+    # because this build's default CPU matmul precision is reduced
+    # (bf16-class), which would swamp the comparison tolerances.
 
-        def ref_fn(q, k, v):
-            # reference attention on [BH, T, D] (single head folded)
-            out = attn.dot_product_attention(
-                q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
-                causal=True, scale=scale)
-            return out[:, :, 0, :]
+    def _rand_qkv(self, b, t, s, h, hkv, d, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        q = jax.random.normal(ks[0], (b, t, h, d), jnp.float32)
+        k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
+        v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.float32)
+        return q, k, v
 
-        out_ref, vjp_ref = jax.vjp(ref_fn, q, k, v)
-        dq_ref, dk_ref, dv_ref = vjp_ref(do)
+    @pytest.mark.parametrize('causal', [True, False])
+    @pytest.mark.parametrize('hkv', [4, 2])
+    def test_pallas_fwd_matches_reference(self, causal, hkv):
+        q, k, v = self._rand_qkv(2, 256, 256, 4, hkv, 64)
+        with jax.default_matmul_precision('highest'):
+            out = attn.flash_attention(q, k, v, causal=causal,
+                                       block_q=128, block_k=128,
+                                       force_pallas=True,
+                                       interpret=True)
+            ref = attn.dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
 
-        # Use the custom bwd rule directly with reference lse.
-        logits = jnp.einsum('btd,bsd->bts', q * scale, k)
-        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
-        logits = jnp.where(mask[None], logits, -1e30)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        dq, dk, dv = attn._flash_bwd_rule(
-            True, scale, 128, 128, (q, k, v, out_ref, lse), do)
-        np.testing.assert_allclose(dq, dq_ref, rtol=1e-4, atol=1e-4)
-        np.testing.assert_allclose(dk, dk_ref, rtol=1e-4, atol=1e-4)
-        np.testing.assert_allclose(dv, dv_ref, rtol=1e-4, atol=1e-4)
+    def test_pallas_grads_match_reference(self):
+        q, k, v = self._rand_qkv(2, 256, 256, 4, 2, 64, seed=5)
+        w = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+        def f_pallas(q, k, v):
+            out = attn.flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=128,
+                                       force_pallas=True,
+                                       interpret=True)
+            return (out * w).sum()
+
+        def f_ref(q, k, v):
+            return (attn.dot_product_attention(q, k, v,
+                                               causal=True) * w).sum()
+
+        with jax.default_matmul_precision('highest'):
+            gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(gp, gr):
+            np.testing.assert_allclose(a, r, rtol=1e-3, atol=1e-3)
+
+    def test_pallas_cross_length_causal_bottom_right(self):
+        """t != s causal attention: the kernel's mask must be bottom-
+        right aligned, matching the reference's tril(k=s-t)."""
+        q, k, v = self._rand_qkv(2, 128, 256, 4, 2, 64, seed=7)
+        with jax.default_matmul_precision('highest'):
+            out = attn.flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=128,
+                                       force_pallas=True,
+                                       interpret=True)
+            ref = attn.dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_pallas_tq_gt_skv_fully_masked_rows(self):
+        """seq_q > seq_k causal: rows that see no keys must produce
+        out == 0 and ZERO gradients (not a uniform V average)."""
+        q, k, v = self._rand_qkv(1, 256, 64, 2, 2, 64, seed=11)
+        hidden = 256 - 64  # rows 0..191 see no keys
+
+        def f(q, k, v):
+            out = attn.flash_attention(q, k, v, causal=True,
+                                       block_q=64, block_k=64,
+                                       force_pallas=True,
+                                       interpret=True)
+            return out
+
+        with jax.default_matmul_precision('highest'):
+            out, vjp = jax.vjp(f, q, k, v)
+            np.testing.assert_array_equal(
+                np.asarray(out[:, :hidden]), 0.0)
+            # Visible rows match the reference.
+            ref = attn.dot_product_attention(q, k, v, causal=True)
+            np.testing.assert_allclose(out[:, hidden:],
+                                       ref[:, hidden:],
+                                       rtol=1e-4, atol=1e-4)
+            do = jnp.ones_like(out)
+            dq, dk, dv = vjp(do)
+            np.testing.assert_array_equal(
+                np.asarray(dq[:, :hidden]), 0.0)
+            assert np.all(np.isfinite(dk)) and np.all(np.isfinite(dv))
 
 
 class TestLlama:
@@ -137,6 +188,33 @@ class TestLlama:
             {'tokens': tokens,
              'loss_mask': jnp.ones_like(tokens)}, self.config)
         np.testing.assert_allclose(full, masked, rtol=1e-5)
+
+    def test_loss_mask_alignment(self):
+        """A prompt-masked batch must average NLL over exactly the
+        positions whose TARGET token is unmasked — verified against a
+        hand-computed per-position NLL."""
+        b, t1 = 2, 16
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (b, t1), 0,
+                                    self.config.vocab_size)
+        # Mask out the first 5 tokens (prompt); aligned with tokens.
+        mask = jnp.concatenate(
+            [jnp.zeros((b, 5), jnp.int32),
+             jnp.ones((b, t1 - 5), jnp.int32)], axis=1)
+        got = llama.loss_fn(self.params,
+                            {'tokens': tokens, 'loss_mask': mask},
+                            self.config)
+        # Hand reference: per-position NLL of target tokens[:, 1:],
+        # weighted by mask[:, 1:] (position i predicts token i+1, and
+        # contributes iff that target is unmasked).
+        logits = llama.forward(self.params, tokens[:, :-1],
+                               self.config)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = tokens[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None],
+                                   axis=-1)[..., 0]
+        w = mask[:, 1:].astype(jnp.float32)
+        want = (nll * w).sum() / w.sum()
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
 
     def test_param_count_8b(self):
         cfg = llama.get_config('llama3-8b')

@@ -55,7 +55,9 @@ class TestShardedTraining:
             tiny_config, mesh, jax.random.PRNGKey(0),
             lora_rank=lora_rank)
         step = build_train_step(tiny_config, mesh, shardings)
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+        # Contract: tokens are [B, T+1]; the forward runs on the first
+        # T=32 positions (sp-divisible).
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
                                     tiny_config.vocab_size)
         losses = []
         for _ in range(n_steps):
@@ -91,7 +93,7 @@ class TestShardedTraining:
         state, shardings = init_train_state(
             tiny_config, mesh, jax.random.PRNGKey(0), lora_rank=4)
         step = build_train_step(tiny_config, mesh, shardings)
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
                                     tiny_config.vocab_size)
         # Copy to host BEFORE the step: donate_argnums invalidates the
         # input state's buffers.
