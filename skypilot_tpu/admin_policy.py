@@ -1,0 +1,88 @@
+"""Admin policy hook (analog of ``sky/admin_policy.py:101``).
+
+Organizations plug in a policy class that validates/mutates every
+user request before it reaches the optimizer — enforce labels, forbid
+regions, inject env vars, cap resources. Configure in
+``~/.skypilot_tpu/config.yaml``:
+
+    admin_policy: my_org.policies.SecurityPolicy
+
+The class must subclass :class:`AdminPolicy` (or duck-type
+``validate_and_mutate``). Raising :class:`UserRequestRejectedByPolicy`
+rejects the request.
+"""
+import dataclasses
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class UserRequestRejectedByPolicy(exceptions.SkyTpuError):
+    """The admin policy rejected this request."""
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the policy sees (reference ``sky/admin_policy.py:31``):
+    the task about to run, a mutable copy of the layered config, and
+    where the request came from ('launch' / 'jobs' / 'serve' /
+    'exec')."""
+    task: Any
+    config: Dict[str, Any]
+    at: str = 'launch'
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Any
+    config: Dict[str, Any]
+
+
+class AdminPolicy:
+    """Subclass and override (reference ``sky/admin_policy.py:101``)."""
+
+    @classmethod
+    def validate_and_mutate(cls, user_request: UserRequest
+                            ) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def _load_policy_class(path: str):
+    module_path, _, class_name = path.rpartition('.')
+    if not module_path:
+        raise exceptions.InvalidSpecError(
+            f'admin_policy must be a dotted path, got {path!r}')
+    try:
+        module = importlib.import_module(module_path)
+        return getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidSpecError(
+            f'Cannot import admin policy {path!r}: {e}') from e
+
+
+def apply(task, at: str = 'launch'):
+    """Run the configured admin policy (no-op when none configured).
+    Returns the (possibly mutated) task. If the policy mutates the
+    config, the mutation is installed process-wide via
+    ``config_lib.replace_config`` — downstream code (optimizer,
+    provisioner) reads config through config_lib and sees the policy's
+    constraints."""
+    policy_path: Optional[str] = config_lib.get_nested(
+        ('admin_policy',), None)
+    if not policy_path:
+        return task
+    policy_cls = _load_policy_class(policy_path)
+    original_config = config_lib.to_dict()
+    request = UserRequest(task=task,
+                          config=config_lib.to_dict(),
+                          at=at)
+    mutated = policy_cls.validate_and_mutate(request)
+    if mutated.config != original_config:
+        config_lib.replace_config(mutated.config)
+    logger.debug('admin policy %s applied at %s', policy_path, at)
+    return mutated.task

@@ -1,0 +1,72 @@
+"""SSH key management (analog of ``sky/authentication.py:38``
+``get_or_generate_keys``).
+
+Generates a per-user ed25519 keypair under the state dir on first use
+(under a filelock — concurrent launches race here) and exposes the
+GCP ``ssh-keys`` metadata line the provisioner injects at node
+creation. The reference writes keys to ``~/.sky/ssh`` and uploads
+them per-cloud (GCP project metadata / instance metadata); TPU VMs
+take the instance-metadata route, which needs no extra API call.
+"""
+import os
+import stat
+from typing import Tuple
+
+SSH_USER = 'skytpu'
+_KEY_NAME = 'sky-key'
+
+
+def _key_dir() -> str:
+    return os.path.join(
+        os.path.expanduser(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')),
+        'keys')
+
+
+def key_paths() -> Tuple[str, str]:
+    d = _key_dir()
+    return os.path.join(d, _KEY_NAME), os.path.join(d,
+                                                    f'{_KEY_NAME}.pub')
+
+
+def _generate_keypair(private_path: str, public_path: str) -> None:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+
+    key = ed25519.Ed25519PrivateKey.generate()
+    private_pem = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    public_ssh = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(private_path, 'wb') as f:
+        f.write(private_pem)
+    os.chmod(private_path, stat.S_IRUSR | stat.S_IWUSR)
+    with open(public_path, 'wb') as f:
+        f.write(public_ssh + b' skypilot-tpu\n')
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path), generating the
+    pair on first call. Safe under concurrent launches (filelock,
+    same protocol as the reference's ``_generate_rsa_key_pair``)."""
+    private_path, public_path = key_paths()
+    if os.path.exists(private_path) and os.path.exists(public_path):
+        return private_path, public_path
+    os.makedirs(_key_dir(), exist_ok=True)
+    from skypilot_tpu.utils import timeline
+    with timeline.FileLockEvent(private_path + '.lock'):
+        if not (os.path.exists(private_path) and
+                os.path.exists(public_path)):
+            _generate_keypair(private_path, public_path)
+    return private_path, public_path
+
+
+def gcp_ssh_key_metadata() -> str:
+    """The ``ssh-keys`` instance-metadata value GCP expects:
+    ``<user>:<openssh public key>``."""
+    _, public_path = get_or_generate_keys()
+    with open(public_path, encoding='utf-8') as f:
+        return f'{SSH_USER}:{f.read().strip()}'

@@ -34,7 +34,20 @@ class TpuBackend(Backend):
                   dryrun: bool, stream_logs: bool, cluster_name: str,
                   retry_until_up: bool = False
                   ) -> Optional[ClusterHandle]:
-        del stream_logs
+        """Provision (or reuse) under the per-cluster filelock: two
+        concurrent launches to the same name serialize — the loser of
+        the race sees the winner's UP record and reuses it (reference
+        holds the same lock, cloud_vm_ray_backend.py:2814)."""
+        with state.cluster_lock(cluster_name):
+            return self._provision_locked(
+                task, to_provision, dryrun=dryrun,
+                cluster_name=cluster_name,
+                retry_until_up=retry_until_up)
+
+    def _provision_locked(self, task: Task, to_provision: Resources, *,
+                          dryrun: bool, cluster_name: str,
+                          retry_until_up: bool = False
+                          ) -> Optional[ClusterHandle]:
         record = state.get_cluster_from_name(cluster_name)
         if record is not None and \
                 record['status'] == status_lib.ClusterStatus.UP:
@@ -51,6 +64,10 @@ class TpuBackend(Backend):
                     'tear this one down.')
             logger.info('Reusing existing cluster %s', cluster_name)
             state.update_last_use(cluster_name)
+            if not dryrun:
+                # Never under dryrun: the handshake does live agent
+                # calls and may restart the cluster runtime.
+                self._ensure_runtime_version(handle)
             return handle
         if dryrun:
             return None
@@ -112,6 +129,27 @@ class TpuBackend(Backend):
                                     is_launch=False)
         return handle
 
+    def _ensure_runtime_version(self, handle: ClusterHandle) -> None:
+        """Client/cluster version handshake on reuse (analog of the
+        reference's SKYLET_VERSION restart, sky/skylet/constants.py):
+        if any host agent speaks a different protocol version than
+        this client, re-ship the package and restart the runtime."""
+        from skypilot_tpu.runtime import agent
+        stale = []
+        for i in range(handle.num_hosts):
+            v = handle.agent_client(i).version()
+            if v is not None and v != agent.AGENT_VERSION:
+                stale.append((i, v))
+        if not stale:
+            return
+        logger.info('Cluster %s runtime version mismatch %s (client '
+                    'wants %s); restarting runtime.',
+                    handle.cluster_name, stale, agent.AGENT_VERSION)
+        if handle.provider != 'local':
+            from skypilot_tpu.provision import instance_setup
+            instance_setup.stop_runtime_on_cluster(handle)
+        self._post_provision_runtime_setup(handle)
+
     def _post_provision_runtime_setup(self,
                                       handle: ClusterHandle) -> None:
         """Agents healthy on every host + skylet running on head
@@ -133,6 +171,13 @@ class TpuBackend(Backend):
         # many "hosts" per machine; a global guard would let the first
         # cluster's skylet suppress every later cluster's).
         rdir = handle.head_runtime_dir
+        # Job-slot policy: TPU clusters run one job at a time (a slice
+        # is one atomic allocation); CPU-only clusters (managed-jobs
+        # controller) run many (ref sky/jobs/scheduler.py:257).
+        res = handle.launched_resources
+        is_tpu = res is not None and res.accelerator is not None
+        slots = 1 if is_tpu else 16
+        head.exec(f'echo {slots} > {rdir}/job_slots', timeout=15)
         skylet_cmd = (
             f'pgrep -f "skypilot_tpu.runtime.[s]kylet '
             f'--runtime-dir {rdir}" > /dev/null || '
@@ -230,9 +275,14 @@ class TpuBackend(Backend):
 
     def setup(self, handle: ClusterHandle, task: Task,
               detach_setup: bool = False) -> None:
-        """Setup runs at launch via the gang driver's setup phase; the
-        backend stores it in the next job spec instead of a separate
-        SSH pass. Kept as explicit stage for CLI parity."""
+        """Deliberately a no-op: setup executes as the gang driver's
+        first phase of the job itself (driver.py:_run_setup) — per-host
+        ``setup-N.log`` files, FAILED_SETUP status on failure, and
+        detached-by-default semantics (the reference needs a separate
+        SSH pass + ``--detach-setup`` because its setup runs outside
+        the Ray job, ``cloud_vm_ray_backend.py:3212``; folding it into
+        the job gives the detached behavior for free). ``exec_`` skips
+        setup by submitting with include_setup=False."""
         del handle, task, detach_setup
 
     # -- execute --------------------------------------------------------
@@ -376,6 +426,12 @@ class TpuBackend(Backend):
 
     def teardown(self, handle: ClusterHandle, *, terminate: bool,
                  purge: bool = False) -> None:
+        with state.cluster_lock(handle.cluster_name):
+            self._teardown_locked(handle, terminate=terminate,
+                                  purge=purge)
+
+    def _teardown_locked(self, handle: ClusterHandle, *,
+                         terminate: bool, purge: bool = False) -> None:
         try:
             if terminate:
                 provision.terminate_instances(

@@ -285,6 +285,211 @@ def cost_report():
     click.echo(table.get_string() if records else 'No usage recorded.')
 
 
+# ---------------------------------------------------------------------
+# Managed jobs group (analog of ``sky jobs``, sky/cli.py:3567).
+# ---------------------------------------------------------------------
+
+
+@cli.group(name='jobs')
+def jobs_group():
+    """Managed jobs with automatic recovery."""
+
+
+@jobs_group.command(name='launch')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+@click.option('--detach', '-d', is_flag=True,
+              help='Return after submission instead of waiting.')
+@click.option('--yes', '-y', is_flag=True)
+def jobs_launch(entrypoint, env, accelerator, num_nodes, use_spot,
+                workdir, name, detach, yes):
+    """Launch a managed job (controller relaunches on preemption)."""
+    from skypilot_tpu import jobs as jobs_lib
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Launch managed job {task.name or "<unnamed>"}?',
+                      default=True, abort=True)
+    job_id = jobs_lib.launch(task, detach=True)
+    click.echo(f'Managed job {job_id} submitted.')
+    if not detach:
+        from skypilot_tpu.jobs import core as jobs_core
+        final = jobs_core.wait(job_id)
+        click.echo(f'Managed job {job_id}: {final.value}')
+        if final != jobs_lib.ManagedJobStatus.SUCCEEDED:
+            raise SystemExit(1)
+
+
+@jobs_group.command(name='queue')
+def jobs_queue():
+    """List managed jobs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    records = jobs_core.queue()
+    table = ux_utils.Table(['ID', 'NAME', 'STATUS', 'RECOVERIES',
+                            'CLUSTER'])
+    for r in records:
+        table.add_row([r['job_id'], r['name'], r['status'].value,
+                       r['recovery_count'], r['task_cluster'] or '-'])
+    click.echo(table.get_string() if records else 'No managed jobs.')
+
+
+@jobs_group.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def jobs_cancel(job_ids, yes):
+    """Cancel managed job(s)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    for jid in job_ids:
+        if not yes and sys.stdin.isatty():
+            click.confirm(f'Cancel managed job {jid}?', default=True,
+                          abort=True)
+        jobs_core.cancel(jid)
+        click.echo(f'Cancellation requested for job {jid}.')
+
+
+@jobs_group.command(name='logs')
+@click.argument('job_id', type=int)
+def jobs_logs(job_id):
+    """Stream a managed job's current task-cluster logs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    jobs_core.tail_logs(job_id)
+
+
+# ---------------------------------------------------------------------
+# Serve group (analog of ``sky serve``, sky/cli.py:3984).
+# ---------------------------------------------------------------------
+
+
+@cli.group(name='serve')
+def serve_group():
+    """Serve a task behind a load-balanced, autoscaled endpoint."""
+
+
+@serve_group.command(name='up')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+@click.option('--service-name', default=None)
+@click.option('--yes', '-y', is_flag=True)
+def serve_up(entrypoint, env, accelerator, num_nodes, use_spot,
+             workdir, name, service_name, yes):
+    """Bring up a service from a task YAML (with a ``service:``
+    section) or inline command."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Bring up service '
+                      f'{service_name or task.name or "<unnamed>"}?',
+                      default=True, abort=True)
+    endpoint = serve_core.up(task, service_name)
+    click.echo(f'Service {service_name or task.name} at '
+               f'http://{endpoint}')
+
+
+@serve_group.command(name='down')
+@click.argument('service_name')
+@click.option('--yes', '-y', is_flag=True)
+def serve_down(service_name, yes):
+    """Tear a service down."""
+    from skypilot_tpu.serve import core as serve_core
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Tear down service {service_name}?',
+                      default=True, abort=True)
+    serve_core.down(service_name)
+    click.echo(f'Service {service_name} terminated.')
+
+
+@serve_group.command(name='status')
+@click.argument('service_name', required=False)
+def serve_status(service_name):
+    """Show service(s) and their replicas."""
+    from skypilot_tpu.serve import core as serve_core
+    records = serve_core.status(service_name)
+    table = ux_utils.Table(['NAME', 'STATUS', 'ENDPOINT', 'REPLICAS'])
+    for r in records:
+        ready = sum(1 for rep in r['replicas']
+                    if rep['status'].value == 'READY')
+        table.add_row([r['name'], r['status'].value,
+                       r['endpoint'] or '-',
+                       f'{ready}/{len(r["replicas"])}'])
+    click.echo(table.get_string() if records else 'No services.')
+
+
+# ---------------------------------------------------------------------
+# Storage group (analog of ``sky storage``, sky/cli.py:3473).
+# ---------------------------------------------------------------------
+
+
+@cli.group(name='storage')
+def storage_group():
+    """Object-store buckets managed by the framework."""
+
+
+@storage_group.command(name='ls')
+def storage_ls():
+    """List tracked storage buckets."""
+    from skypilot_tpu import state
+    records = state.get_storage()
+    table = ux_utils.Table(['NAME', 'CREATED', 'STATUS'])
+    import time as time_lib
+    for r in records:
+        age = time_lib.strftime('%Y-%m-%d %H:%M',
+                                time_lib.localtime(r['launched_at']))
+        table.add_row([r['name'], age, r['status']])
+    click.echo(table.get_string() if records else 'No storage.')
+
+
+@storage_group.command(name='delete')
+@click.argument('names', nargs=-1)
+@click.option('--all', 'delete_all', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def storage_delete(names, delete_all, yes):
+    """Delete bucket(s) and stop tracking them."""
+    from skypilot_tpu import state
+    from skypilot_tpu.data.storage import Storage
+    if delete_all:
+        names = [r['name'] for r in state.get_storage()]
+    if not names:
+        click.echo('No storage to delete.')
+        return
+    for name in names:
+        if not yes and sys.stdin.isatty():
+            click.confirm(f'Delete bucket {name}?', default=True,
+                          abort=True)
+        Storage(name=name).delete()
+        click.echo(f'Deleted storage {name}.')
+
+
+# ---------------------------------------------------------------------
+# Benchmark (analog of ``sky bench``, sky/cli.py:3560 — flattened to a
+# single command: launch candidates, wait, print the comparison).
+# ---------------------------------------------------------------------
+
+
+@cli.command(name='bench')
+@click.argument('entrypoint', nargs=-1)
+@_apply(_task_options)
+@click.option('--candidates', required=True,
+              help='Comma-separated accelerators, e.g. '
+                   '"tpu-v5e-8,tpu-v5p-8".')
+@click.option('--yes', '-y', is_flag=True)
+def bench_cmd(entrypoint, env, accelerator, num_nodes, use_spot,
+              workdir, name, candidates, yes):
+    """Run a task briefly on several TPU slice types and compare
+    sec/step and $/step."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    base = next(iter(task.resources))
+    cands = [base.copy(accelerators=c.strip())
+             for c in candidates.split(',') if c.strip()]
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Benchmark on {len(cands)} candidate(s)?',
+                      default=True, abort=True)
+    results = benchmark_utils.launch_benchmark(task, cands)
+    click.echo(benchmark_utils.format_results(results))
+
+
 def main():
     try:
         cli()

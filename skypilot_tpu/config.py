@@ -56,6 +56,20 @@ def loaded() -> bool:
     return bool(_ensure_loaded())
 
 
+def to_dict() -> Dict[str, Any]:
+    """Deep copy of the effective config (safe to hand to user code,
+    e.g. admin policies)."""
+    return copy.deepcopy(_ensure_loaded())
+
+
+def replace_config(new_config: Dict[str, Any]) -> None:
+    """Swap the loaded config for this process (admin-policy config
+    mutations; a later ``reload_config`` reverts to the file)."""
+    global _dict
+    with _lock:
+        _dict = copy.deepcopy(new_config)
+
+
 def loaded_config_path() -> Optional[str]:
     _ensure_loaded()
     return _loaded_path

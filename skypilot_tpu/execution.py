@@ -44,6 +44,12 @@ def _execute(task: Task, *, cluster_name: str,
     backend = TpuBackend()
     common_utils.check_cluster_name_is_valid(cluster_name)
 
+    # Org integration point: the configured admin policy may mutate or
+    # reject the request (reference sky/admin_policy.py:101, applied
+    # at sky/execution.py entry).
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, at='launch')
+
     # Default-cloud resolution: tasks that don't pin a cloud go to
     # gcp when credentials exist, else to the local fake provider
     # (reference: enabled-clouds gate the optimizer's candidates,

@@ -190,7 +190,17 @@ def main():
     parser.add_argument('--dag-yaml', required=True)
     args = parser.parse_args()
     controller = JobsController(args.job_id, args.dag_yaml)
-    final = controller.run()
+    try:
+        final = controller.run()
+    finally:
+        # A controller slot freed: admit the next PENDING managed job
+        # (reference: maybe_schedule_next_jobs on every transition,
+        # sky/jobs/scheduler.py:79).
+        from skypilot_tpu.jobs import core as jobs_core
+        try:
+            jobs_core.maybe_schedule_next_jobs()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('scheduling next pending jobs failed')
     logger.info('managed job %d finished: %s', args.job_id,
                 final.value)
     raise SystemExit(

@@ -44,32 +44,16 @@ def _controller_resources() -> Resources:
     return Resources()
 
 
-def launch(dag_or_task: Union[Dag, Task],
-           name: Optional[str] = None,
-           detach: bool = True) -> int:
-    """Submit a managed job; returns the managed job id."""
-    if isinstance(dag_or_task, Dag) and not dag_or_task.is_chain():
-        from skypilot_tpu import exceptions
-        raise exceptions.NotSupportedError(
-            'Managed jobs execute chain DAGs only (same restriction '
-            'as the reference).')
-    if name is None:
-        first = (dag_or_task.tasks[0] if isinstance(dag_or_task, Dag)
-                 else dag_or_task)
-        name = first.name or 'managed-job'
-
-    state_dir = os.path.expanduser(
+def _state_dir() -> str:
+    return os.path.expanduser(
         os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
-    dag_dir = os.path.join(state_dir, 'managed_dags')
-    os.makedirs(dag_dir, exist_ok=True)
-    controller_cluster = _controller_cluster_name()
-    job_id = jobs_state.add_job(name, '', controller_cluster)
-    dag_yaml_path = os.path.join(dag_dir, f'dag-{job_id}.yaml')
-    _dag_to_yaml(dag_or_task, dag_yaml_path)
-    jobs_state._db().execute_and_commit(  # pylint: disable=protected-access
-        'UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?',
-        (dag_yaml_path, job_id))
 
+
+def _spawn_controller(job_id: int, dag_yaml_path: str) -> int:
+    """Launch the per-job controller process on the controller
+    cluster; returns the controller's cluster-job id."""
+    state_dir = _state_dir()
+    controller_cluster = _controller_cluster_name()
     # The controller task: runs the per-job controller process. The
     # client state dir is forwarded so the controller (local provider:
     # same machine; gcp: the controller VM's own dir) sees the same
@@ -91,6 +75,97 @@ def launch(dag_or_task: Union[Dag, Task],
     logger.info('Managed job %d submitted (controller cluster %s, '
                 'controller job %s)', job_id, controller_cluster,
                 controller_job_id)
+    return controller_job_id
+
+
+def _admission_lock():
+    """Inter-process lock for the admission check-then-spawn (same
+    pattern as runtime job_lib.queue_lock: two controller exits
+    scheduling simultaneously must not double-spawn)."""
+    from skypilot_tpu.utils import timeline
+    os.makedirs(_state_dir(), exist_ok=True)
+    return timeline.FileLockEvent(
+        os.path.join(_state_dir(), '.jobs_admission.lock'))
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Admission control: spawn controllers for PENDING managed jobs
+    while ``scheduler.can_admit()`` allows (analog of
+    ``sky/jobs/scheduler.py:79`` maybe_schedule_next_jobs — called on
+    submission and on every controller exit)."""
+    from skypilot_tpu.jobs import scheduler
+    with _admission_lock():
+        while scheduler.can_admit():
+            pending = [
+                r for r in reversed(jobs_state.get_jobs())
+                if r['status'] == jobs_state.ManagedJobStatus.PENDING
+                and r['dag_yaml_path']
+            ]
+            if not pending:
+                return
+            job = pending[0]  # oldest
+            try:
+                _spawn_controller(job['job_id'], job['dag_yaml_path'])
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('Failed to spawn controller for '
+                                 'managed job %d', job['job_id'])
+                jobs_state.set_status(
+                    job['job_id'],
+                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER)
+
+
+def launch(dag_or_task: Union[Dag, Task],
+           name: Optional[str] = None,
+           detach: bool = True) -> int:
+    """Submit a managed job; returns the managed job id.
+
+    Controller-process spawn is gated on ``scheduler.can_admit()``:
+    above the limit the job stays PENDING and is picked up when a
+    running controller exits."""
+    if isinstance(dag_or_task, Dag) and not dag_or_task.is_chain():
+        from skypilot_tpu import exceptions
+        raise exceptions.NotSupportedError(
+            'Managed jobs execute chain DAGs only (same restriction '
+            'as the reference).')
+    from skypilot_tpu import admin_policy
+    if isinstance(dag_or_task, Task):
+        dag_or_task = admin_policy.apply(dag_or_task, at='jobs')
+    else:
+        dag_or_task.tasks = [admin_policy.apply(t, at='jobs')
+                             for t in dag_or_task.tasks]
+    if name is None:
+        first = (dag_or_task.tasks[0] if isinstance(dag_or_task, Dag)
+                 else dag_or_task)
+        name = first.name or 'managed-job'
+
+    state_dir = _state_dir()
+    dag_dir = os.path.join(state_dir, 'managed_dags')
+    os.makedirs(dag_dir, exist_ok=True)
+    controller_cluster = _controller_cluster_name()
+    job_id = jobs_state.add_job(name, '', controller_cluster)
+    dag_yaml_path = os.path.join(dag_dir, f'dag-{job_id}.yaml')
+    _dag_to_yaml(dag_or_task, dag_yaml_path)
+    jobs_state._db().execute_and_commit(  # pylint: disable=protected-access
+        'UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?',
+        (dag_yaml_path, job_id))
+
+    from skypilot_tpu.jobs import scheduler
+    with _admission_lock():
+        admit = scheduler.can_admit()
+        if admit:
+            try:
+                _spawn_controller(job_id, dag_yaml_path)
+            except Exception:
+                # Never leave a phantom SUBMITTED row: it would count
+                # against the admission limit forever.
+                jobs_state.set_status(
+                    job_id,
+                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER)
+                raise
+    if not admit:
+        logger.info('Managed job %d queued PENDING (admission limit '
+                    '%d reached)', job_id,
+                    scheduler.get_job_parallelism())
     if not detach:
         wait(job_id)
     return job_id
@@ -115,6 +190,16 @@ def queue() -> List[Dict[str, Any]]:
 
 
 def cancel(job_id: int) -> None:
+    with _admission_lock():
+        rec = jobs_state.get_job(job_id)
+        if rec is not None and \
+                rec['status'] == jobs_state.ManagedJobStatus.PENDING:
+            # No controller exists yet to act on a cancel signal — a
+            # CANCELLING row would sit non-terminal forever and eat an
+            # admission slot. Terminal-cancel it directly.
+            jobs_state.set_status(
+                job_id, jobs_state.ManagedJobStatus.CANCELLED)
+            return
     jobs_state.request_cancel(job_id)
 
 

@@ -31,6 +31,12 @@ def get_launch_parallelism() -> int:
 
 
 def get_job_parallelism() -> int:
+    override = os.environ.get('SKYTPU_JOBS_PARALLELISM')
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            pass
     return max(4, int(_memory_gb() * 1024 / 350))
 
 

@@ -15,6 +15,7 @@ from skypilot_tpu.parallel.train import (
     TrainState,
     build_train_step,
     init_train_state,
+    plan_train_state,
 )
 from skypilot_tpu.parallel import distributed
 from skypilot_tpu.parallel import lora
@@ -28,4 +29,5 @@ __all__ = [
     'init_train_state',
     'lora',
     'make_mesh',
+    'plan_train_state',
 ]

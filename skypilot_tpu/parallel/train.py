@@ -63,22 +63,24 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(('dp', 'fsdp'), None))
 
 
-def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
-                     key: jax.Array,
+def plan_train_state(config: llama.LlamaConfig, mesh,
                      optimizer: Optional[
                          optax.GradientTransformation] = None,
                      param_dtype=jnp.float32,
                      lora_rank: Optional[int] = None,
-                     lora_key: Optional[jax.Array] = None
-                     ) -> Tuple[TrainState, Any]:
-    """Initialize params DIRECTLY sharded on the mesh (out_shardings on
-    the init closure — no host-memory detour, required for 8B+).
+                     key: Optional[jax.Array] = None,
+                     lora_key: Optional[jax.Array] = None):
+    """Shape-and-sharding plan for the train state WITHOUT allocating
+    anything: returns (init_fn, state_shape, state_shardings).
 
-    Returns (state, state_shardings) — the latter feeds
-    ``build_train_step``.
+    Works with a concrete ``Mesh`` or an ``AbstractMesh`` (the latter
+    for compile-only validation of target-scale configs — e.g. does
+    the 8B config shard onto a 16-device v5p mesh — without hardware).
     """
     if optimizer is None:
         optimizer = default_optimizer()
+    if key is None:
+        key = jax.random.PRNGKey(0)
     rules = llama.param_sharding_rules(config)
     param_shardings = _sharding_tree(rules, mesh)
 
@@ -144,7 +146,27 @@ def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
         lora=(trainable_shardings if lora_rank is not None else None),
     )
 
-    init_fn = jax.jit(_init, out_shardings=state_shardings)
+    return _init, state_shape, state_shardings
+
+
+def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
+                     key: jax.Array,
+                     optimizer: Optional[
+                         optax.GradientTransformation] = None,
+                     param_dtype=jnp.float32,
+                     lora_rank: Optional[int] = None,
+                     lora_key: Optional[jax.Array] = None
+                     ) -> Tuple[TrainState, Any]:
+    """Initialize params DIRECTLY sharded on the mesh (out_shardings on
+    the init closure — no host-memory detour, required for 8B+).
+
+    Returns (state, state_shardings) — the latter feeds
+    ``build_train_step``.
+    """
+    init, _, state_shardings = plan_train_state(
+        config, mesh, optimizer=optimizer, param_dtype=param_dtype,
+        lora_rank=lora_rank, key=key, lora_key=lora_key)
+    init_fn = jax.jit(init, out_shardings=state_shardings)
     state = init_fn()
     return state, state_shardings
 

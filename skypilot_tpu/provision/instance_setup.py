@@ -18,19 +18,16 @@ from skypilot_tpu.utils.command_runner import SSHCommandRunner
 
 logger = tpu_logging.init_logger(__name__)
 
-_SSH_USER_DEFAULT = 'skytpu'
-_SSH_KEY_PATH = '~/.ssh/sky-key'
 _REMOTE_PKG_DIR = '~/.skypilot_tpu/wheels/skypilot_tpu'
 _AGENT_PORT = 8790
 
 
 def _runners(handle: ClusterHandle) -> List[SSHCommandRunner]:
-    key = os.path.expanduser(_SSH_KEY_PATH)
-    if not os.path.exists(key):
-        key = None
+    from skypilot_tpu import authentication
+    key, _ = authentication.get_or_generate_keys()
     return [
         SSHCommandRunner(h.get('external_ip') or h['ip'],
-                         _SSH_USER_DEFAULT, key)
+                         authentication.SSH_USER, key)
         for h in handle.hosts
     ]
 
@@ -53,6 +50,18 @@ def _read_remote_token(runner: SSHCommandRunner) -> str:
         f'cat {_REMOTE_TOKEN_FILE} 2>/dev/null || true',
         require_outputs=True)
     return out.strip() if rc == 0 else ''
+
+
+def stop_runtime_on_cluster(handle: ClusterHandle) -> None:
+    """Kill agents + skylet on every host (version-mismatch restart
+    path; the follow-up ``setup_runtime_on_cluster`` re-ships the
+    package and starts fresh processes)."""
+    def one(runner: SSHCommandRunner) -> None:
+        runner.run(f'pkill -f "{_AGENT_PATTERN}" || true; '
+                   f'pkill -f "skypilot_tpu.runtime.[s]kylet" || true')
+
+    with ThreadPoolExecutor(max_workers=32) as pool:
+        list(pool.map(one, _runners(handle)))
 
 
 def setup_runtime_on_cluster(handle: ClusterHandle) -> None:

@@ -295,8 +295,13 @@ class Resources:
         if spec is None:
             raise exceptions.InvalidSpecError(
                 'Cannot deploy a Resources without an accelerator.')
+        from skypilot_tpu import authentication
         return {
             'cluster_name_on_cloud': cluster_name_on_cloud,
+            # Key generated on first launch; injected as instance
+            # metadata so real-GCP bring-up can SSH (reference
+            # sky/authentication.py:38 get_or_generate_keys).
+            'ssh_public_key': authentication.gcp_ssh_key_metadata(),
             'tpu_type': spec.name,
             'tpu_generation': spec.generation,
             'accelerator_type': _gcp_accelerator_type(spec),

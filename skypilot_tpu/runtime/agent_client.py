@@ -85,6 +85,13 @@ class AgentClient:
     def health(self) -> Dict[str, Any]:
         return self._get('/health')
 
+    def version(self) -> Optional[str]:
+        """Agent protocol version, or None if unreachable."""
+        try:
+            return str(self.health().get('version'))
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
     def is_healthy(self) -> bool:
         try:
             return bool(self.health().get('ok'))

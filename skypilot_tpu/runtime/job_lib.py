@@ -89,6 +89,17 @@ def _db() -> db_utils.SQLiteConn:
     return conn
 
 
+def queue_lock():
+    """Inter-process lock for composite read-modify-write sequences on
+    the job queue (skylet's scheduler vs codegen submit both mutate
+    jobs.db — sqlite serializes single statements, not
+    check-then-act; analog of ``sky/skylet/job_lib.py:37``)."""
+    from skypilot_tpu.utils import timeline
+    os.makedirs(runtime_dir(), exist_ok=True)
+    return timeline.FileLockEvent(
+        os.path.join(runtime_dir(), '.jobs.lock'))
+
+
 # -- queue ops ---------------------------------------------------------
 
 
@@ -194,23 +205,24 @@ def get_latest_job_id() -> Optional[int]:
 def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
     """Cancel given jobs (default: all non-terminal). Kills driver
     process groups."""
-    if job_ids is None:
-        records = get_jobs(JobStatus.nonterminal_statuses())
-        job_ids = [r['job_id'] for r in records]
-    cancelled = []
-    for job_id in job_ids:
-        rec = get_job(job_id)
-        if rec is None or rec['status'].is_terminal():
-            continue
-        pid = rec['pid']
-        if pid:
-            try:
-                os.killpg(os.getpgid(pid), signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
-        set_status(job_id, JobStatus.CANCELLED)
-        cancelled.append(job_id)
-    return cancelled
+    with queue_lock():
+        if job_ids is None:
+            records = get_jobs(JobStatus.nonterminal_statuses())
+            job_ids = [r['job_id'] for r in records]
+        cancelled = []
+        for job_id in job_ids:
+            rec = get_job(job_id)
+            if rec is None or rec['status'].is_terminal():
+                continue
+            pid = rec['pid']
+            if pid:
+                try:
+                    os.killpg(os.getpgid(pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            set_status(job_id, JobStatus.CANCELLED)
+            cancelled.append(job_id)
+        return cancelled
 
 
 def is_cluster_idle(idle_minutes: int) -> bool:
@@ -247,23 +259,49 @@ def update_job_statuses() -> None:
             set_status(rec['job_id'], JobStatus.FAILED_DRIVER)
 
 
+def job_slots() -> int:
+    """Concurrent job slots on this cluster. 1 (default) for TPU
+    clusters — a slice is one atomic allocation, concurrent jobs would
+    fight over chips. CPU-only clusters (e.g. the managed-jobs
+    controller cluster) get more via SKYTPU_JOB_SLOTS, set by the
+    backend at skylet start (the reference sizes controller
+    concurrency the same way, ``sky/jobs/scheduler.py:257``)."""
+    val = os.environ.get('SKYTPU_JOB_SLOTS')
+    if val is None:
+        # Persisted at provision by the backend (survives skylet
+        # restarts and reaches every process using this runtime dir).
+        try:
+            with open(os.path.join(runtime_dir(), 'job_slots'),
+                      encoding='utf-8') as f:
+                val = f.read().strip()
+        except OSError:
+            return 1
+    try:
+        return max(1, int(val))
+    except ValueError:
+        return 1
+
+
 class FIFOScheduler:
-    """Single-slot FIFO: start the oldest PENDING job if no job is
-    active (a TPU slice is one atomic allocation — concurrent jobs
-    would fight over chips; the reference serializes via Ray resource
-    accounting, we serialize explicitly)."""
+    """FIFO with ``job_slots()`` concurrent slots (1 on TPU
+    clusters; the reference serializes via Ray resource accounting, we
+    serialize explicitly)."""
 
     def schedule_step(self) -> Optional[int]:
-        update_job_statuses()
-        active = get_jobs([JobStatus.SETTING_UP, JobStatus.RUNNING,
-                           JobStatus.INIT])
-        if active:
-            return None
-        pending = get_jobs([JobStatus.PENDING])
-        if not pending:
-            return None
-        job = pending[-1]  # oldest (list is DESC)
-        return self._start_driver(job)
+        # check-active-then-start must be atomic across processes: a
+        # codegen submit's eager schedule and skylet's periodic
+        # schedule racing here would double-start a driver.
+        with queue_lock():
+            update_job_statuses()
+            active = get_jobs([JobStatus.SETTING_UP, JobStatus.RUNNING,
+                               JobStatus.INIT])
+            if len(active) >= job_slots():
+                return None
+            pending = get_jobs([JobStatus.PENDING])
+            if not pending:
+                return None
+            job = pending[-1]  # oldest (list is DESC)
+            return self._start_driver(job)
 
     def _start_driver(self, job: Dict[str, Any]) -> int:
         job_id = job['job_id']

@@ -25,9 +25,6 @@ from skypilot_tpu import tpu_logging
 
 logger = tpu_logging.init_logger(__name__)
 
-SSH_USER = 'skytpu'
-SSH_KEY_PATH = '~/.ssh/sky-key'
-
 _lock = threading.Lock()
 # (cluster_name, host_index) -> (local_port, Popen)
 _tunnels: Dict[Tuple[str, int], Tuple[int, subprocess.Popen]] = {}
@@ -50,7 +47,11 @@ def _port_listening(port: int, timeout: float = 0.5) -> bool:
 
 def _tunnel_command(remote_addr: str, remote_port: int,
                     local_port: int) -> List[str]:
-    import os
+    # Same identity the provisioner installs on the instances
+    # (authentication.get_or_generate_keys) — a divergent hardcoded
+    # path here would leave tunnels unable to authenticate.
+    from skypilot_tpu import authentication
+    private_key, _ = authentication.get_or_generate_keys()
     return [
         'ssh',
         '-o', 'StrictHostKeyChecking=no',
@@ -58,10 +59,10 @@ def _tunnel_command(remote_addr: str, remote_port: int,
         '-o', 'IdentitiesOnly=yes',
         '-o', 'ExitOnForwardFailure=yes',
         '-o', 'ServerAliveInterval=30',
-        '-i', os.path.expanduser(SSH_KEY_PATH),
+        '-i', private_key,
         '-N',
         '-L', f'{local_port}:127.0.0.1:{remote_port}',
-        f'{SSH_USER}@{remote_addr}',
+        f'{authentication.SSH_USER}@{remote_addr}',
     ]
 
 

@@ -35,6 +35,8 @@ def _free_port() -> int:
 def up(task: Task, service_name: Optional[str] = None,
        wait_ready_timeout: float = 300.0) -> str:
     """Start a service; returns the endpoint URL."""
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, at='serve')
     if task.service is None:
         raise exceptions.InvalidSpecError(
             'Task has no service: section.')

@@ -99,6 +99,13 @@ class SkyServeLoadBalancer:
             def log_message(self, fmt, *args):
                 pass
 
+            # Hop-by-hop headers never forwarded (RFC 7230 §6.1).
+            _HOP_BY_HOP = {'connection', 'keep-alive',
+                           'proxy-authenticate',
+                           'proxy-authorization', 'te', 'trailers',
+                           'transfer-encoding', 'upgrade',
+                           'content-length', 'host'}
+
             def _proxy(self, method: str):
                 with lb._ts_lock:  # pylint: disable=protected-access
                     lb.request_timestamps.append(time.time())
@@ -117,30 +124,78 @@ class SkyServeLoadBalancer:
                 req = urllib.request.Request(url, data=data,
                                              method=method)
                 for k, v in self.headers.items():
-                    if k.lower() not in ('host', 'content-length'):
+                    if k.lower() not in self._HOP_BY_HOP:
                         req.add_header(k, v)
                 lb.policy.on_request_start(endpoint)
+                self._headers_sent = False
                 try:
                     with urllib.request.urlopen(req,
                                                 timeout=120) as resp:
-                        payload = resp.read()
-                        self.send_response(resp.status)
-                        for k, v in resp.headers.items():
-                            if k.lower() in ('content-type',):
-                                self.send_header(k, v)
-                        self.send_header('Content-Length',
-                                         str(len(payload)))
-                        self.end_headers()
-                        self.wfile.write(payload)
+                        self._stream_response(resp)
                 except (urllib.error.URLError, OSError) as e:
+                    if self._headers_sent:
+                        # Mid-stream failure: the status line is long
+                        # gone — writing a 502 now would inject a
+                        # second status line into the chunked body.
+                        # Abort the connection so the client sees a
+                        # truncated (invalid) stream, not garbage.
+                        logger.warning('replica stream aborted: %s', e)
+                        self.close_connection = True
+                        try:
+                            self.wfile.flush()
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
                     body = f'Replica error: {e}'.encode()
-                    self.send_response(502)
-                    self.send_header('Content-Length',
-                                     str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    try:
+                        self.send_response(502)
+                        self.send_header('Content-Length',
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except OSError:
+                        pass  # client already gone
                 finally:
                     lb.policy.on_request_end(endpoint)
+
+            def _stream_response(self, resp) -> None:
+                """Chunk-by-chunk pass-through so token streaming
+                (SSE / chunked LLM responses) reaches the client as
+                the replica produces it — never buffer the full body
+                (reference LB is an async streaming proxy,
+                sky/serve/load_balancer.py:90)."""
+                self.send_response(resp.status)
+                self._headers_sent = True
+                upstream_length = resp.headers.get('Content-Length')
+                for k, v in resp.headers.items():
+                    if k.lower() not in self._HOP_BY_HOP:
+                        self.send_header(k, v)
+                chunked = upstream_length is None
+                if chunked:
+                    self.send_header('Transfer-Encoding', 'chunked')
+                else:
+                    self.send_header('Content-Length',
+                                     upstream_length)
+                self.end_headers()
+                while True:
+                    # read1: return as soon as ANY bytes arrive (a
+                    # plain read(n) would wait to fill n, adding
+                    # latency between streamed tokens).
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    if chunked:
+                        self.wfile.write(
+                            f'{len(chunk):x}\r\n'.encode())
+                        self.wfile.write(chunk)
+                        self.wfile.write(b'\r\n')
+                    else:
+                        self.wfile.write(chunk)
+                    self.wfile.flush()
+                if chunked:
+                    self.wfile.write(b'0\r\n\r\n')
+                    self.wfile.flush()
 
             def do_GET(self):  # noqa: N802
                 self._proxy('GET')

@@ -25,6 +25,19 @@ def _db_path() -> str:
     return os.path.join(_db_dir(), 'state.db')
 
 
+def cluster_lock(cluster_name: str):
+    """Per-cluster inter-process filelock guarding provision/teardown/
+    status transitions (analog of the reference's per-cluster status
+    lock, ``sky/backends/cloud_vm_ray_backend.py:2814``). Use as a
+    context manager; reentrant within a process per filelock
+    semantics."""
+    from skypilot_tpu.utils import timeline
+    lock_dir = os.path.join(_db_dir(), '.locks')
+    os.makedirs(lock_dir, exist_ok=True)
+    return timeline.FileLockEvent(
+        os.path.join(lock_dir, f'cluster.{cluster_name}.lock'))
+
+
 def _create_tables(cursor, conn):
     cursor.execute("""\
         CREATE TABLE IF NOT EXISTS clusters (

@@ -1,0 +1,74 @@
+"""Admin policy hook (reference ``sky/admin_policy.py:101``)."""
+import sys
+import types
+
+import pytest
+
+from skypilot_tpu import admin_policy, config as config_lib, exceptions
+from skypilot_tpu.task import Task
+
+
+def _install_policy(monkeypatch, tmp_path, cls_src: str):
+    mod = types.ModuleType('org_policies')
+    exec(cls_src, mod.__dict__)  # pylint: disable=exec-used
+    monkeypatch.setitem(sys.modules, 'org_policies', mod)
+    cfg = tmp_path / 'config.yaml'
+    cfg.write_text('admin_policy: org_policies.Policy\n')
+    monkeypatch.setenv('SKYTPU_CONFIG', str(cfg))
+    config_lib.reload_config()
+
+
+class TestAdminPolicy:
+
+    def test_noop_without_config(self):
+        t = Task(run='echo hi')
+        assert admin_policy.apply(t) is t
+
+    def test_policy_mutates_task(self, monkeypatch, tmp_path):
+        _install_policy(monkeypatch, tmp_path, (
+            'from skypilot_tpu import admin_policy as ap\n'
+            'class Policy(ap.AdminPolicy):\n'
+            '    @classmethod\n'
+            '    def validate_and_mutate(cls, req):\n'
+            '        req.task.envs = dict(req.task.envs or {})\n'
+            '        req.task.envs["ORG_TAG"] = "enforced"\n'
+            '        return ap.MutatedUserRequest(req.task, '
+            'req.config)\n'))
+        t = Task(run='echo hi')
+        out = admin_policy.apply(t, at='launch')
+        assert out.envs['ORG_TAG'] == 'enforced'
+
+    def test_policy_rejects(self, monkeypatch, tmp_path):
+        _install_policy(monkeypatch, tmp_path, (
+            'from skypilot_tpu import admin_policy as ap\n'
+            'class Policy(ap.AdminPolicy):\n'
+            '    @classmethod\n'
+            '    def validate_and_mutate(cls, req):\n'
+            '        raise ap.UserRequestRejectedByPolicy('
+            '"spot only")\n'))
+        with pytest.raises(admin_policy.UserRequestRejectedByPolicy):
+            admin_policy.apply(Task(run='echo hi'))
+
+    def test_rejection_blocks_launch(self, monkeypatch, tmp_path):
+        _install_policy(monkeypatch, tmp_path, (
+            'from skypilot_tpu import admin_policy as ap\n'
+            'class Policy(ap.AdminPolicy):\n'
+            '    @classmethod\n'
+            '    def validate_and_mutate(cls, req):\n'
+            '        raise ap.UserRequestRejectedByPolicy("no")\n'))
+        from skypilot_tpu import execution
+        from skypilot_tpu.resources import Resources
+        t = Task(run='echo hi')
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        t.set_resources(res)
+        with pytest.raises(admin_policy.UserRequestRejectedByPolicy):
+            execution.launch(t, 'adminpol-test', dryrun=True)
+
+    def test_bad_policy_path(self, monkeypatch, tmp_path):
+        cfg = tmp_path / 'config.yaml'
+        cfg.write_text('admin_policy: nonexistent.module.Cls\n')
+        monkeypatch.setenv('SKYTPU_CONFIG', str(cfg))
+        config_lib.reload_config()
+        with pytest.raises(exceptions.InvalidSpecError):
+            admin_policy.apply(Task(run='echo hi'))

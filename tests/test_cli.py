@@ -90,3 +90,69 @@ class TestCli:
             runner.invoke(cli.cli, ['down', 'clitest', '-y'])
         st = runner.invoke(cli.cli, ['status'])
         assert 'clitest' not in st.output
+
+
+class TestCliGroups:
+    """jobs / serve / storage / bench groups (reference
+    ``sky/cli.py:3567,3984,3473,3560``) against the local cloud."""
+
+    def test_jobs_queue_empty(self, runner):
+        result = runner.invoke(cli.cli, ['jobs', 'queue'])
+        assert result.exit_code == 0, result.output
+
+    def test_serve_status_empty(self, runner):
+        result = runner.invoke(cli.cli, ['serve', 'status'])
+        assert result.exit_code == 0, result.output
+        assert 'No services' in result.output
+
+    def test_storage_ls_empty(self, runner):
+        result = runner.invoke(cli.cli, ['storage', 'ls'])
+        assert result.exit_code == 0, result.output
+
+    def test_bench_requires_candidates(self, runner):
+        result = runner.invoke(cli.cli, ['bench', 'echo hi'])
+        assert result.exit_code != 0
+
+    def test_jobs_launch_e2e_local(self, runner):
+        """xsky jobs launch runs a managed job to completion on the
+        local cloud (waits for the final state)."""
+        result = runner.invoke(
+            cli.cli, ['jobs', 'launch', 'echo managed-cli-ok', '-y',
+                      '--name', 'clijob'])
+        assert result.exit_code == 0, result.output
+        assert 'SUCCEEDED' in result.output
+        q = runner.invoke(cli.cli, ['jobs', 'queue'])
+        assert 'clijob' in q.output and 'SUCCEEDED' in q.output
+
+    def test_serve_up_status_down_e2e_local(self, runner, tmp_path,
+                                            monkeypatch):
+        """xsky serve up → status → down on the local cloud."""
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        yaml_path = tmp_path / 'svc.yaml'
+        yaml_path.write_text(
+            'name: clisvc\n'
+            'resources:\n'
+            '  cloud: local\n'
+            'run: python3 -m http.server $SKYTPU_REPLICA_PORT '
+            '--bind 127.0.0.1\n'
+            'service:\n'
+            '  readiness_probe:\n'
+            '    path: /\n'
+            '    initial_delay_seconds: 60\n'
+            '  replicas: 1\n'
+            '  port: 18300\n')
+        result = runner.invoke(cli.cli,
+                               ['serve', 'up', str(yaml_path), '-y'])
+        assert result.exit_code == 0, result.output
+        assert 'http://' in result.output
+        try:
+            st = runner.invoke(cli.cli, ['serve', 'status'])
+            assert 'clisvc' in st.output
+            st1 = runner.invoke(cli.cli, ['serve', 'status', 'clisvc'])
+            assert st1.exit_code == 0
+        finally:
+            dn = runner.invoke(cli.cli, ['serve', 'down', 'clisvc',
+                                         '-y'])
+            assert dn.exit_code == 0, dn.output
+        st = runner.invoke(cli.cli, ['serve', 'status'])
+        assert 'clisvc' not in st.output

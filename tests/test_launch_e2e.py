@@ -228,3 +228,72 @@ class TestSkyletOnCluster:
         assert head.exec(
             f'test -f {handle.head_runtime_dir}/skylet.log'
         )['returncode'] == 0
+
+
+class TestConcurrencySafety:
+    """Locking on shared state (reference: per-cluster status lock
+    ``cloud_vm_ray_backend.py:2812`` + job-queue lock
+    ``job_lib.py:37``)."""
+
+    def test_concurrent_launch_same_cluster_yields_one_cluster(
+            self, cluster):
+        """Two threads race `launch` with the SAME cluster name: the
+        per-cluster filelock serializes them — exactly one cluster
+        exists, both jobs run to success on it."""
+        import threading
+        results = [None, None]
+        errors = [None, None]
+
+        def do_launch(i):
+            try:
+                task = _local_task(f'echo concurrent-{i}',
+                                   name=f'ct{i}')
+                job_id, handle = execution.launch(
+                    task, cluster, quiet_optimizer=True,
+                    detach_run=True)
+                results[i] = (job_id, handle)
+            except Exception as e:  # pylint: disable=broad-except
+                errors[i] = e
+
+        threads = [threading.Thread(target=do_launch, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert errors == [None, None], errors
+        # One cluster record; both handles point at it.
+        rec = state.get_cluster_from_name(cluster)
+        assert rec is not None
+        assert results[0][1].cluster_name == \
+            results[1][1].cluster_name == cluster
+        # Both jobs eventually succeed (FIFO serializes them).
+        for job_id, _ in results:
+            final = core.wait_for_job(cluster, job_id, timeout=90)
+            assert final == job_lib.JobStatus.SUCCEEDED
+
+    def test_scheduler_never_double_starts(self, tmp_path,
+                                           monkeypatch):
+        """Concurrent schedule_step calls start ONE driver for one
+        pending job (atomic check-then-act under the queue lock)."""
+        import threading
+        monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path))
+        job_lib.add_job('j', 'ts-1')
+        starts = []
+        orig = job_lib.FIFOScheduler._start_driver
+
+        def fake_start(self, job):
+            starts.append(job['job_id'])
+            job_lib.set_status(job['job_id'], job_lib.JobStatus.INIT)
+            return job['job_id']
+
+        monkeypatch.setattr(job_lib.FIFOScheduler, '_start_driver',
+                            fake_start)
+        sched = job_lib.FIFOScheduler()
+        threads = [threading.Thread(target=sched.schedule_step)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert starts == [1], starts

@@ -177,6 +177,48 @@ class TestControllerInProcess:
         assert final == jobs_state.ManagedJobStatus.CANCELLED
 
 
+class TestAdmissionControl:
+    """Controller-spawn gating (reference sky/jobs/scheduler.py:79):
+    above the parallelism limit, managed jobs stay PENDING; controller
+    exits admit the next."""
+
+    def test_bounded_concurrency_then_drain(self, monkeypatch,
+                                            cleanup_clusters):
+        monkeypatch.setenv('SKYTPU_JOBS_PARALLELISM', '1')
+        ids = []
+        for i in range(3):
+            task = _local_task(f'echo adm-{i}', name=f'adm{i}')
+            ids.append(jobs.launch(task, detach=True))
+        # With limit 1 only the first job may go past PENDING now.
+        statuses = [jobs_state.get_job(j)['status'] for j in ids]
+        pending = [s for s in statuses
+                   if s == jobs_state.ManagedJobStatus.PENDING]
+        assert len(pending) >= 2, statuses
+        # Controller exits admit the rest; all drain to SUCCEEDED.
+        for j in ids:
+            final = jobs.core.wait(j, timeout=240)
+            assert final == jobs_state.ManagedJobStatus.SUCCEEDED, (
+                j, jobs_state.get_job(j))
+
+    def test_cancel_pending_job_is_terminal(self, monkeypatch,
+                                            cleanup_clusters):
+        """Cancelling a still-PENDING managed job (no controller yet)
+        must terminal-cancel it, not leave CANCELLING forever."""
+        monkeypatch.setenv('SKYTPU_JOBS_PARALLELISM', '1')
+        t1 = _local_task('sleep 30', name='admc1')
+        t2 = _local_task('echo never', name='admc2')
+        j1 = jobs.launch(t1, detach=True)
+        j2 = jobs.launch(t2, detach=True)
+        assert jobs_state.get_job(j2)['status'] == \
+            jobs_state.ManagedJobStatus.PENDING
+        jobs.cancel(j2)
+        assert jobs_state.get_job(j2)['status'] == \
+            jobs_state.ManagedJobStatus.CANCELLED
+        jobs.cancel(j1)
+        final = jobs.core.wait(j1, timeout=120)
+        assert final == jobs_state.ManagedJobStatus.CANCELLED
+
+
 class TestManagedJobsEndToEnd:
     """The full recursion: controller runs as a task on the
     controller cluster."""

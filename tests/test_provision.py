@@ -228,3 +228,48 @@ class TestGcpRunInstancesMocked:
                          'runtime_version': 'x'})
         record = provision.run_instances(config)
         assert record.resumed
+
+
+class TestAuthentication:
+    """SSH keygen/injection (reference sky/authentication.py:38)."""
+
+    def test_get_or_generate_keys_idempotent(self):
+        from skypilot_tpu import authentication
+        priv1, pub1 = authentication.get_or_generate_keys()
+        priv2, pub2 = authentication.get_or_generate_keys()
+        assert (priv1, pub1) == (priv2, pub2)
+        import os
+        import stat
+        assert os.path.exists(priv1) and os.path.exists(pub1)
+        mode = stat.S_IMODE(os.stat(priv1).st_mode)
+        assert mode == 0o600, oct(mode)
+        with open(pub1, encoding='utf-8') as f:
+            assert f.read().startswith('ssh-ed25519 ')
+
+    def test_deploy_variables_inject_public_key(self):
+        from skypilot_tpu.resources import Resources
+        res = Resources(accelerators='tpu-v5e-8', region='us-east1')
+        vars_ = res.make_deploy_variables('c-test')
+        assert vars_['ssh_public_key'].startswith(
+            'skytpu:ssh-ed25519 ')
+
+    def test_concurrent_generation_single_keypair(self):
+        import threading
+        from skypilot_tpu import authentication
+        outs = []
+        threads = [
+            threading.Thread(
+                target=lambda: outs.append(
+                    authentication.get_or_generate_keys()))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(set(outs)) == 1
+        # The key parses back (valid OpenSSH private key).
+        from cryptography.hazmat.primitives.serialization import \
+            load_ssh_private_key
+        with open(outs[0][0], 'rb') as f:
+            load_ssh_private_key(f.read(), password=None)

@@ -446,3 +446,66 @@ class TestEmptyTokenFailsClosed:
              '--port', str(_free_port())],
             capture_output=True, timeout=15, env=env, check=False)
         assert proc.returncode != 0
+
+
+class TestVersionHandshake:
+    """Client/cluster version handshake (reference SKYLET_VERSION
+    restart, sky/skylet/constants.py)."""
+
+    def test_agent_reports_version(self, agent):
+        client, _ = agent
+        from skypilot_tpu.runtime import agent as agent_mod
+        assert client.version() == agent_mod.AGENT_VERSION
+
+    def test_reuse_restarts_stale_runtime(self, monkeypatch):
+        """A handle whose agents report an old version triggers a
+        runtime restart on reuse."""
+        from skypilot_tpu.backends.tpu_backend import TpuBackend
+
+        calls = []
+
+        class FakeClient:
+            def version(self):
+                return '0'  # older than AGENT_VERSION
+
+        class FakeHandle:
+            cluster_name = 'vh-test'
+            provider = 'gcp'
+            num_hosts = 2
+
+            def agent_client(self, i):
+                return FakeClient()
+
+        from skypilot_tpu.provision import instance_setup
+        monkeypatch.setattr(
+            instance_setup, 'stop_runtime_on_cluster',
+            lambda handle: calls.append('stop'))
+        monkeypatch.setattr(
+            TpuBackend, '_post_provision_runtime_setup',
+            lambda self, handle: calls.append('setup'))
+        TpuBackend()._ensure_runtime_version(FakeHandle())
+        assert calls == ['stop', 'setup']
+
+    def test_reuse_no_restart_when_current(self, monkeypatch):
+        from skypilot_tpu.backends.tpu_backend import TpuBackend
+        from skypilot_tpu.runtime import agent as agent_mod
+
+        calls = []
+
+        class FakeClient:
+            def version(self):
+                return agent_mod.AGENT_VERSION
+
+        class FakeHandle:
+            cluster_name = 'vh-test2'
+            provider = 'gcp'
+            num_hosts = 1
+
+            def agent_client(self, i):
+                return FakeClient()
+
+        monkeypatch.setattr(
+            TpuBackend, '_post_provision_runtime_setup',
+            lambda self, handle: calls.append('setup'))
+        TpuBackend()._ensure_runtime_version(FakeHandle())
+        assert calls == []
