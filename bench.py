@@ -46,7 +46,14 @@ def main() -> None:
     full_ft = os.environ.get('BENCH_FULL_FT', '0') == '1'
 
     n_devices = len(jax.devices())
-    config = llama.get_config(model_name, max_seq_len=seq)
+    # attn+mlp_up: keep flash-attention outputs AND the MLP up-proj
+    # activations across the layer scan — measured best on a 16 GB
+    # v5e at these shapes (saving gate too OOMs with the fused-CE
+    # residuals; saving neither re-runs an avoidable [d, ffn] matmul
+    # per layer in backward).
+    remat_saves = os.environ.get('BENCH_REMAT_SAVES', 'attn+mlp_up')
+    config = llama.get_config(model_name, max_seq_len=seq,
+                              remat_saves=remat_saves)
 
     mesh = make_mesh(MeshConfig(fsdp=n_devices))
     state, shardings = init_train_state(
@@ -76,6 +83,17 @@ def main() -> None:
         state, metrics = step(state, batch_dict)
     jax.block_until_ready(metrics['loss'])
     dt = time.perf_counter() - t0
+
+    if os.environ.get('BENCH_PROFILE', '0') == '1':
+        # Per-op device-time table to stderr (the JSON line below
+        # stays the only stdout output).
+        from skypilot_tpu.utils import profiling
+        with profiling.capture_trace() as tdir:
+            for _ in range(2):
+                state, metrics = step(state, batch_dict)
+            jax.block_until_ready(metrics['loss'])
+        print(profiling.format_summary(
+            profiling.summarize_trace(tdir, top=30)), file=sys.stderr)
 
     tokens_per_step = batch * seq
     tokens_per_sec = steps * tokens_per_step / dt

@@ -13,11 +13,13 @@ Design (TPU-first, not a torch port):
   on 'tp', everything weight-sharded on 'fsdp' (ZeRO-3 style).
 """
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.ops import attention as attention_ops
@@ -41,6 +43,27 @@ class LlamaConfig:
     # Llama-3.1 RoPE frequency scaling (rope_scaling in HF config).
     rope_scaling: bool = False
     remat: bool = True
+    # What per-layer remat keeps besides the flash-attention kernel
+    # outputs ('+'-joined tokens, validated in forward_hidden):
+    #   'attn'        — rematerialize everything else (min memory);
+    #   '+mlp_up'     — also save the up-proj output (~268 MB/layer
+    #                   at B=8,T=2048 for 1B; skips one [d, ffn]
+    #                   matmul recompute — bench default on 16 GB v5e)
+    #   '+mlp'        — save gate AND up (~536 MB/layer, both matmul
+    #                   recomputes skipped);
+    #   '+qkv'        — save pre-rotation q/k/v (~100 MB/layer; RoPE
+    #                   is fused into the attention kernels).
+    # Frozen-base LoRA makes the saved activations pure speed: no
+    # weight grads need them.
+    remat_saves: str = 'attn'
+
+    def __post_init__(self):
+        unknown = set(self.remat_saves.split('+')) - {
+            'attn', 'mlp', 'mlp_up', 'qkv'}
+        if unknown:
+            raise ValueError(
+                f'unknown remat_saves token(s) {sorted(unknown)} in '
+                f'{self.remat_saves!r}; valid: attn, mlp, mlp_up, qkv')
 
     @property
     def head_dim(self) -> int:
@@ -191,16 +214,6 @@ def _rope_frequencies(config: LlamaConfig, positions: jax.Array
     return positions.astype(jnp.float32)[:, None] * freqs[None, :]
 
 
-def _apply_rope(x: jax.Array, angles: jax.Array) -> jax.Array:
-    """x: [B, T, H, D]; angles: [T, D/2]."""
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
-    return jnp.concatenate(
-        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
-        axis=-1).astype(x.dtype)
-
-
 def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
            angles: jax.Array, attn_impl,
            lora_params: Optional[Params] = None,
@@ -221,16 +234,26 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
             lora_scale
         q = q + dq.reshape(b, t, nh, hd).astype(q.dtype)
         v = v + dv.reshape(b, t, nkv, hd).astype(v.dtype)
-    q = _apply_rope(q, angles)
-    k = _apply_rope(k, angles)
-    attn = attn_impl(q, k, v)
+    # RoPE is delegated to the attention impl: the Pallas kernels
+    # rotate q/k blocks in VMEM (no separate f32 pass over HBM);
+    # non-kernel impls (ring shards, XLA fallback) apply it via
+    # ``attention_ops.apply_rope``.
+    q = checkpoint_name(q, 'qkv')
+    k = checkpoint_name(k, 'qkv')
+    v = checkpoint_name(v, 'qkv')
+    attn = attn_impl(q, k, v, angles)
     attn = attn.reshape(b, t, nh * hd)
     x = x + attn @ layer_params['wo']
 
     h = _rms_norm(x, layer_params['mlp_norm'], config.norm_eps)
-    gate = jax.nn.silu((h @ layer_params['w_gate'])
-                       .astype(jnp.float32)).astype(h.dtype)
-    up = h @ layer_params['w_up']
+    # Save the PRE-silu gate (silu-backward needs it anyway) and up:
+    # with these two named values kept, backward recomputes only
+    # elementwise ops here, not the two [d, ffn] matmuls. Separate
+    # names so remat_saves can keep just one of them when HBM is
+    # tight.
+    g_pre = checkpoint_name(h @ layer_params['w_gate'], 'mlp_gate')
+    up = checkpoint_name(h @ layer_params['w_up'], 'mlp_up')
+    gate = jax.nn.silu(g_pre.astype(jnp.float32)).astype(h.dtype)
     x = x + (gate * up) @ layer_params['w_down']
     return x
 
@@ -255,8 +278,8 @@ def forward_hidden(params: Params, tokens: jax.Array,
     communication).
     """
     if attn_impl is None:
-        attn_impl = lambda q, k, v: attention_ops.flash_attention(
-            q, k, v, causal=True)
+        attn_impl = lambda q, k, v, ang: attention_ops.flash_attention(
+            q, k, v, causal=True, rope_angles=ang)
     _, t = tokens.shape
     if positions is None:
         positions = jnp.arange(t)
@@ -278,13 +301,24 @@ def forward_hidden(params: Params, tokens: jax.Array,
 
     body = scan_body
     if config.remat:
-        # Per-layer remat, EXCEPT the flash-attention kernel outputs:
-        # re-running the attention kernel in backward costs ~3.4 ms/
-        # layer at (8, 2048) on v5e while saving out+lse costs only
-        # ~66 MB/layer — the projections feeding it are still
-        # rematerialized (cheap MXU matmuls).
-        body = jax.checkpoint(scan_body, prevent_cse=False,
-                              policy=attention_ops.remat_policy())
+        # Per-layer remat, EXCEPT the flash-attention kernel outputs
+        # (re-running the kernel costs ~3.4 ms/layer at (8, 2048) on
+        # v5e vs ~66 MB/layer to save out+lse) and, depending on
+        # ``config.remat_saves``, the big matmul outputs — see the
+        # field's docstring for the memory/recompute trade.
+        tokens_ = config.remat_saves.split('+')  # validated in config
+        extra = []
+        if 'mlp' in tokens_:
+            extra += ['mlp_gate', 'mlp_up']
+        if 'mlp_up' in tokens_:
+            extra.append('mlp_up')
+        if 'qkv' in tokens_:
+            extra.append('qkv')
+        base = (jax.checkpoint_policies.save_only_these_names(*extra)
+                if extra else None)
+        body = jax.checkpoint(
+            scan_body, prevent_cse=False,
+            policy=attention_ops.remat_policy(base_policy=base))
     clora = None
     if lora is not None:
         clora = jax.tree.map(lambda p: p.astype(config.dtype), lora)
@@ -313,6 +347,87 @@ def _ce_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
     tgt = jnp.take_along_axis(logits, targets[..., None],
                               axis=-1)[..., 0].astype(jnp.float32)
     return lse - tgt
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_ce(train_lm_head: bool):
+    """Chunked LM-head + cross-entropy with the hidden-state gradient
+    computed EAGERLY in the forward pass (custom_vjp).
+
+    dloss/dlogits = softmax - onehot is known in closed form, so each
+    chunk's dhidden = dlogits @ W^T can be produced while the logits
+    are still live — the backward then reads a tiny [B, T, D]
+    residual instead of re-running the [D, 128k-vocab] matmul under
+    remat. Per chunk: 2 vocab-size matmuls (3 with a trainable head)
+    vs 3 (4) for checkpoint-and-recompute. Cotangents scale linearly
+    in the upstream scalar, so deferring the g * (1/denom) factor to
+    the backward is exact.
+
+    Args (to the returned fn): hid [n, B, C, D]; lm_head [D, V];
+    tgt/msk [n, B, C]. Returns mean NLL over unmasked positions.
+    """
+
+    @jax.custom_vjp
+    def fused(hid, lm_head, tgt, msk):
+        def body(carry, xs):
+            ns, ms = carry
+            h, tg, mk = xs
+            nll = _ce_from_logits(h @ lm_head, tg)
+            return (ns + (nll * mk).sum(), ms + mk.sum()), None
+
+        (ns, ms), _ = jax.lax.scan(
+            jax.checkpoint(body, prevent_cse=False),
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (hid, tgt, msk))
+        return ns / jnp.maximum(ms, 1.0)
+
+    def fwd(hid, lm_head, tgt, msk):
+        d, v = lm_head.shape
+
+        def body(carry, xs):
+            ns, ms, dw = carry
+            h, tg, mk = xs
+            logits = (h @ lm_head).astype(jnp.float32)  # [B, C, V]
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt_logit = jnp.take_along_axis(
+                logits, tg[..., None], axis=-1)[..., 0]
+            nll = lse - tgt_logit
+            # XLA fuses softmax-minus-onehot into one pass over the
+            # bf16 logits; no fp32 [B, C, V] temp is materialized.
+            dlog = jnp.exp(logits - lse[..., None])
+            dlog = (dlog - jax.nn.one_hot(tg, v, dtype=jnp.float32))
+            dlog = (dlog * mk[..., None]).astype(h.dtype)
+            dh = dlog @ lm_head.T
+            if train_lm_head:
+                dw = dw + jnp.einsum(
+                    'bcd,bcv->dv', h, dlog,
+                    preferred_element_type=jnp.float32)
+            return (ns + (nll * mk).sum(), ms + mk.sum(), dw), dh
+
+        dw0 = (jnp.zeros((d, v), jnp.float32) if train_lm_head
+               else jnp.zeros((0, v), jnp.float32))
+        (ns, ms, dw), dh = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+             dw0),
+            (hid, tgt, msk))
+        denom = jnp.maximum(ms, 1.0)
+        return ns / denom, (dh, dw, denom)
+
+    def bwd(res, g):
+        dh, dw, denom = res
+        scale = g / denom
+        dhid = dh * scale.astype(dh.dtype)
+        if train_lm_head:
+            dlm = (dw * scale).astype(dh.dtype)
+        else:
+            # Shape carried by the 0-byte residual; the head is
+            # frozen so this cotangent is dead downstream.
+            dlm = jnp.zeros((dh.shape[-1], dw.shape[-1]), dh.dtype)
+        return dhid, dlm, None, None
+
+    fused.defvjp(fwd, bwd)
+    return fused
 
 
 # Sequence-chunk size for the fused head+CE scan. 512 keeps the fp32
@@ -363,15 +478,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
     msk = mask.reshape(b, n, chunk).transpose(1, 0, 2)
 
-    def chunk_body(carry, xs):
-        nll_sum, mask_sum = carry
-        h, tg, mk = xs
-        logits = h @ lm_head  # [B, chunk, V] compute dtype
-        nll = _ce_from_logits(logits, tg)
-        return (nll_sum + (nll * mk).sum(), mask_sum + mk.sum()), None
-
-    body = jax.checkpoint(chunk_body, prevent_cse=False)
-    (nll_sum, mask_sum), _ = jax.lax.scan(
-        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-        (hid, tgt, msk))
-    return nll_sum / jnp.maximum(mask_sum, 1.0)
+    # The head is frozen exactly when training LoRA adapters — skip
+    # the [D, V] grad matmul then (its cotangent would be dead).
+    return _fused_ce(train_lm_head=lora is None)(
+        hid, lm_head, tgt, msk)

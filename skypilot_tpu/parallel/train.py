@@ -183,7 +183,17 @@ def make_ring_attention_impl(mesh: Mesh, axis_name: str = 'sp'):
     fn = shard_map(
         functools.partial(ring.ring_attention, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn
+
+    def impl(q, k, v, angles):
+        # RoPE outside the ring (elementwise in T, shards cleanly);
+        # the single-chip path fuses it into the Pallas kernels
+        # instead.
+        from skypilot_tpu.ops import attention as attention_ops
+        q = attention_ops.apply_rope(q, angles)
+        k = attention_ops.apply_rope(k, angles)
+        return fn(q, k, v)
+
+    return impl
 
 
 def build_train_step(config: llama.LlamaConfig, mesh: Mesh,

@@ -1,0 +1,97 @@
+"""Step profiling: capture a ``jax.profiler`` trace and summarize
+device-side op time.
+
+The reference ships Chrome-trace profiling hooks around its benchmark
+harness (``sky bench`` timing callbacks; this module is the TPU-native
+equivalent wired into ``bench.py`` via ``BENCH_PROFILE=1``). The
+summary aggregates the XLA trace-event stream per op name so kernel
+regressions show up as a diffable table instead of a 100 MB pprof
+blob.
+
+Usage::
+
+    with capture_trace() as tmpdir:
+        run_steps()
+    for row in summarize_trace(tmpdir, top=20):
+        print(row)
+"""
+import collections
+import contextlib
+import glob
+import gzip
+import json
+import os
+import tempfile
+from typing import Iterator, List, NamedTuple, Optional
+
+
+class OpTime(NamedTuple):
+    name: str
+    total_ms: float
+    count: int
+    category: str
+
+
+@contextlib.contextmanager
+def capture_trace(trace_dir: Optional[str] = None) -> Iterator[str]:
+    """Context manager: profile the enclosed device work.
+
+    Yields the directory the trace is written into. The caller must
+    ``jax.block_until_ready`` its outputs inside the context or the
+    device timeline will be truncated.
+    """
+    import jax
+
+    out = trace_dir or tempfile.mkdtemp(prefix='xsky_trace_')
+    with jax.profiler.trace(out):
+        yield out
+
+
+def _trace_files(trace_dir: str) -> List[str]:
+    return sorted(glob.glob(
+        os.path.join(trace_dir, '**', '*.trace.json.gz'),
+        recursive=True))
+
+
+def summarize_trace(trace_dir: str, top: int = 25,
+                    device_only: bool = True) -> List[OpTime]:
+    """Aggregate complete ('X') trace events by op name, descending
+    total duration. ``device_only`` keeps TPU/GPU tracks and drops
+    host threads."""
+    files = _trace_files(trace_dir)
+    if not files:
+        raise FileNotFoundError(
+            f'no *.trace.json.gz under {trace_dir}')
+    agg = collections.defaultdict(lambda: [0.0, 0, ''])
+    for path in files:
+        with gzip.open(path, 'rt') as f:
+            trace = json.load(f)
+        events = trace.get('traceEvents', [])
+        pids = {}
+        for ev in events:
+            if ev.get('ph') == 'M' and ev.get('name') == 'process_name':
+                pids[ev['pid']] = ev.get('args', {}).get('name', '')
+        for ev in events:
+            if ev.get('ph') != 'X':
+                continue
+            pname = pids.get(ev.get('pid'), '')
+            if device_only and ('TPU' not in pname and
+                                'GPU' not in pname.upper()):
+                continue
+            a = agg[ev['name']]
+            a[0] += ev.get('dur', 0) / 1e3  # us -> ms
+            a[1] += 1
+            if not a[2]:
+                a[2] = ev.get('args', {}).get('hlo_category', '')
+    rows = [OpTime(name, ms, n, cat)
+            for name, (ms, n, cat) in agg.items()]
+    rows.sort(key=lambda r: -r.total_ms)
+    return rows[:top]
+
+
+def format_summary(rows: List[OpTime]) -> str:
+    lines = [f'{"total ms":>10}  {"count":>6}  {"category":<22} name']
+    for r in rows:
+        lines.append(f'{r.total_ms:10.1f}  {r.count:6d}  '
+                     f'{r.category:<22} {r.name}')
+    return '\n'.join(lines)

@@ -93,6 +93,51 @@ class TestAttention:
         for a, r in zip(gp, gr):
             np.testing.assert_allclose(a, r, rtol=1e-3, atol=1e-3)
 
+    def test_fused_rope_matches_external_rope(self):
+        """In-kernel RoPE (rope_angles=) == apply_rope outside, for
+        output and all three gradients. Model ref: _layer delegates
+        RoPE to the attention impl (models/llama.py)."""
+        q, k, v = self._rand_qkv(2, 256, 256, 4, 2, 64, seed=7)
+        t, d = 256, 64
+        angles = (jnp.arange(t, dtype=jnp.float32)[:, None] *
+                  (1.0 / 500000.0 ** (jnp.arange(d // 2) /
+                                      (d // 2)))[None, :])
+
+        def fused(q, k, v):
+            return attn.flash_attention(
+                q, k, v, causal=True, rope_angles=angles,
+                block_q=128, block_k=128, force_pallas=True,
+                interpret=True)
+
+        def external(q, k, v):
+            return attn.flash_attention(
+                attn.apply_rope(q, angles), attn.apply_rope(k, angles),
+                v, causal=True, block_q=128, block_k=128,
+                force_pallas=True, interpret=True)
+
+        with jax.default_matmul_precision('highest'):
+            np.testing.assert_allclose(fused(q, k, v),
+                                       external(q, k, v),
+                                       rtol=1e-4, atol=1e-4)
+            gf = jax.grad(lambda *a: fused(*a).sum(),
+                          argnums=(0, 1, 2))(q, k, v)
+            ge = jax.grad(lambda *a: external(*a).sum(),
+                          argnums=(0, 1, 2))(q, k, v)
+        for a, r in zip(gf, ge):
+            np.testing.assert_allclose(a, r, rtol=1e-3, atol=1e-3)
+
+    def test_fused_rope_fallback_path(self):
+        """The XLA fallback honors rope_angles too (same contract
+        off-TPU)."""
+        q, k, v = self._rand_qkv(1, 64, 64, 2, 2, 64, seed=11)
+        angles = jnp.linspace(0.0, 3.0, 64 * 32).reshape(64, 32)
+        out = attn.flash_attention(q, k, v, causal=True,
+                                   rope_angles=angles)
+        ref = attn.dot_product_attention(
+            attn.apply_rope(q, angles), attn.apply_rope(k, angles), v,
+            causal=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
     def test_pallas_cross_length_causal_bottom_right(self):
         """t != s causal attention: the kernel's mask must be bottom-
         right aligned, matching the reference's tril(k=s-t)."""
@@ -178,6 +223,82 @@ class TestLlama:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
 
+    def test_fused_ce_matches_autodiff_reference(self):
+        """loss_fn's eager-dhidden custom_vjp == plain autodiff
+        through explicit logits, for loss and every param grad (incl.
+        the trainable lm_head path)."""
+        tokens = jax.random.randint(jax.random.PRNGKey(13), (2, 17), 0,
+                                    self.config.vocab_size)
+        batch = {'tokens': tokens}
+        loss1, g1 = jax.value_and_grad(llama.loss_fn)(
+            self.params, batch, self.config)
+
+        def ref_loss(p):
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            hid = llama.forward_hidden(p, inputs, self.config)
+            logits = (hid @ p['lm_head'].astype(self.config.dtype)
+                      ).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, -1)
+            tl = jnp.take_along_axis(logits, targets[..., None],
+                                     -1)[..., 0]
+            return (lse - tl).mean()
+
+        loss2, g2 = jax.value_and_grad(ref_loss)(self.params)
+        assert abs(float(loss1) - float(loss2)) < 1e-3
+        for a, r in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(a, r, rtol=5e-2, atol=1e-3)
+
+    def test_fused_ce_lora_grads(self):
+        """Frozen-head (LoRA) mode: fused CE produces the same
+        adapter grads as autodiff with an explicit-logits loss."""
+        from skypilot_tpu.parallel import lora as lora_lib
+        lora = lora_lib.init_lora(self.config, jax.random.PRNGKey(4),
+                                  rank=4)
+        # Perturb so adapter grads are non-trivially nonzero.
+        lora = jax.tree.map(
+            lambda p: p + 0.01 * jax.random.normal(
+                jax.random.PRNGKey(5), p.shape, p.dtype), lora)
+        tokens = jax.random.randint(jax.random.PRNGKey(14), (2, 17), 0,
+                                    self.config.vocab_size)
+        batch = {'tokens': tokens}
+        loss1, g1 = jax.value_and_grad(
+            lambda lp: llama.loss_fn(self.params, batch, self.config,
+                                     lora=lp))(lora)
+
+        def ref_loss(lp):
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            hid = llama.forward_hidden(self.params, inputs,
+                                       self.config, lora=lp)
+            logits = (hid @ self.params['lm_head'].astype(
+                self.config.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, -1)
+            tl = jnp.take_along_axis(logits, targets[..., None],
+                                     -1)[..., 0]
+            return (lse - tl).mean()
+
+        loss2, g2 = jax.value_and_grad(ref_loss)(lora)
+        assert abs(float(loss1) - float(loss2)) < 1e-3
+        for a, r in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(a, r, rtol=5e-2, atol=1e-3)
+
+    def test_remat_saves_modes_agree(self):
+        """Every remat_saves mode computes the same loss/grads — the
+        policy only changes what backward recomputes."""
+        tokens = jax.random.randint(jax.random.PRNGKey(15), (2, 17), 0,
+                                    self.config.vocab_size)
+        batch = {'tokens': tokens}
+        results = {}
+        for mode in ('attn', 'attn+mlp_up', 'attn+mlp+qkv'):
+            cfg = llama.get_config('tiny', remat_saves=mode)
+            results[mode] = jax.value_and_grad(llama.loss_fn)(
+                self.params, batch, cfg)
+        base_loss, base_g = results['attn']
+        for mode, (loss, g) in results.items():
+            assert abs(float(loss) - float(base_loss)) < 1e-5, mode
+            for a, r in zip(jax.tree.leaves(g),
+                            jax.tree.leaves(base_g)):
+                np.testing.assert_allclose(a, r, rtol=1e-3, atol=1e-4)
+
     def test_loss_mask(self):
         tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
                                     self.config.vocab_size)
@@ -257,3 +378,8 @@ class TestLlama:
         folded = llama.forward(merged, tokens, self.config)
         np.testing.assert_allclose(runtime, folded, rtol=1e-3,
                                    atol=1e-3)
+
+
+def test_remat_saves_unknown_token_raises():
+    with pytest.raises(ValueError, match='remat_saves'):
+        llama.get_config('tiny', remat_saves='attn+mlpup')

@@ -1,0 +1,36 @@
+"""Profiling summary tests (CPU): capture_trace + summarize_trace.
+
+Model: the reference's benchmark timing callbacks
+(``sky/callbacks``/``sky bench``); this is the kernel-level analog
+wired into bench.py via BENCH_PROFILE=1.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.utils import profiling
+
+
+def test_capture_and_summarize(tmp_path):
+    x = jnp.ones((256, 256))
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    f(x).block_until_ready()  # compile outside the trace
+    with profiling.capture_trace(str(tmp_path)) as tdir:
+        f(x).block_until_ready()
+    rows = profiling.summarize_trace(tdir, top=10, device_only=False)
+    assert rows, 'expected at least one trace event'
+    assert all(r.total_ms >= 0 for r in rows)
+    # Descending by total time.
+    totals = [r.total_ms for r in rows]
+    assert totals == sorted(totals, reverse=True)
+    text = profiling.format_summary(rows)
+    assert 'total ms' in text and rows[0].name in text
+
+
+def test_summarize_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profiling.summarize_trace(str(tmp_path / 'nope'))
