@@ -355,6 +355,19 @@ def jobs_logs(job_id):
     jobs_core.tail_logs(job_id)
 
 
+@jobs_group.command(name='dashboard')
+@click.option('--port', '-p', default=8000, show_default=True)
+@click.option('--host', default='127.0.0.1', show_default=True)
+def jobs_dashboard(port, host):
+    """Serve the managed-jobs web dashboard (analog of
+    ``sky jobs dashboard``, sky/cli.py:3873)."""
+    from skypilot_tpu.jobs import dashboard
+    board = dashboard.Dashboard(host=host, port=port)
+    click.echo(f'Dashboard: http://{host}:{board.port}/ '
+               '(Ctrl-C to stop)')
+    board.serve_forever()
+
+
 # ---------------------------------------------------------------------
 # Serve group (analog of ``sky serve``, sky/cli.py:3984).
 # ---------------------------------------------------------------------

@@ -3,6 +3,7 @@ up the handle in the state DB and drives the backend."""
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import usage  # noqa: E501  (telemetry: one message per SDK entrypoint)
 from skypilot_tpu import exceptions, provision, state, status_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.backends import TpuBackend
@@ -25,6 +26,7 @@ def _get_handle(cluster_name: str,
     return record['handle']
 
 
+@usage.entrypoint('status')
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
     """Cluster records; with refresh=True, reconcile against the
@@ -62,16 +64,19 @@ def status(cluster_names: Optional[List[str]] = None,
     return records
 
 
+@usage.entrypoint('stop')
 def stop(cluster_name: str) -> None:
     handle = _get_handle(cluster_name, require_up=False)
     TpuBackend().teardown(handle, terminate=False)
 
 
+@usage.entrypoint('down')
 def down(cluster_name: str, purge: bool = False) -> None:
     handle = _get_handle(cluster_name, require_up=False)
     TpuBackend().teardown(handle, terminate=True, purge=purge)
 
 
+@usage.entrypoint('start')
 def start(cluster_name: str) -> None:
     """Restart a STOPPED single-host cluster."""
     record = state.get_cluster_from_name(cluster_name)
@@ -109,17 +114,20 @@ def start(cluster_name: str) -> None:
     state.add_or_update_cluster(cluster_name, handle, None, ready=True)
 
 
+@usage.entrypoint('autostop')
 def autostop(cluster_name: str, idle_minutes: int,
              down_after: bool = False) -> None:
     handle = _get_handle(cluster_name)
     TpuBackend().set_autostop(handle, idle_minutes, down_after)
 
 
+@usage.entrypoint('queue')
 def queue(cluster_name: str) -> List[Dict[str, Any]]:
     handle = _get_handle(cluster_name)
     return TpuBackend().job_queue(handle)
 
 
+@usage.entrypoint('cancel')
 def cancel(cluster_name: str,
            job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
@@ -142,6 +150,7 @@ def job_status(cluster_name: str,
     return backend.job_status(handle, job_id)
 
 
+@usage.entrypoint('tail_logs')
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
               out=None) -> None:
     handle = _get_handle(cluster_name)
@@ -154,6 +163,7 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
     backend.tail_logs(handle, job_id, out=out)
 
 
+@usage.entrypoint('cost_report')
 def cost_report() -> List[Dict[str, Any]]:
     """Accumulated cost per (historical) cluster from usage intervals
     (reference ``sky/core.py:213``)."""

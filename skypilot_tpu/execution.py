@@ -10,6 +10,7 @@ import enum
 from typing import List, Optional
 
 from skypilot_tpu import exceptions, optimizer, state, status_lib
+from skypilot_tpu import usage
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.backends import TpuBackend
 from skypilot_tpu.dag import Dag
@@ -126,6 +127,7 @@ def _execute(task: Task, *, cluster_name: str,
     return job_id, handle
 
 
+@usage.entrypoint('launch')
 def launch(task: Task, cluster_name: Optional[str] = None, *,
            dryrun: bool = False,
            stream_logs: bool = True,
@@ -145,6 +147,11 @@ def launch(task: Task, cluster_name: Optional[str] = None, *,
     if cluster_name is None:
         cluster_name = f'sky-{common_utils.get_user_hash()[:4]}-' \
                        f'{common_utils.get_usage_run_id()[:4]}'
+    usage.messages.usage.update_task(task)
+    usage.messages.usage.update_cluster_name(cluster_name)
+    if task.num_nodes and task.resources:
+        usage.messages.usage.update_cluster_resources(
+            task.num_nodes, next(iter(task.resources)))
     stages = None
     if fast:
         record = state.get_cluster_from_name(cluster_name)
@@ -161,6 +168,7 @@ def launch(task: Task, cluster_name: Optional[str] = None, *,
                     quiet_optimizer=quiet_optimizer)
 
 
+@usage.entrypoint('exec')
 def exec_(task: Task, cluster_name: str, *,
           dryrun: bool = False,
           detach_run: bool = False):

@@ -1,0 +1,185 @@
+"""Managed-jobs dashboard: a zero-dependency web view of the queue.
+
+Analog of ``/root/reference/sky/jobs/dashboard/dashboard.py`` (Flask
+app + templates serving a jobs table with refresh and cancel).
+TPU-native redesign: stdlib ``http.server`` (the framework has no
+Flask dependency — same choice as the on-cluster host agent), one
+self-contained HTML page polling a JSON API.
+
+Routes:
+  GET /            — HTML dashboard (auto-refreshes via fetch)
+  GET /api/jobs    — jobs queue as JSON
+  POST /api/cancel?job=<id> — request cancellation (signal file,
+      same mechanism as ``xsky jobs cancel``)
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from skypilot_tpu.jobs import state as jobs_state
+
+_PAGE = """<!doctype html>
+<html><head><title>xsky managed jobs</title>
+<style>
+ body { font-family: monospace; margin: 2em; background: #fafafa; }
+ table { border-collapse: collapse; width: 100%; }
+ th, td { border: 1px solid #ccc; padding: 6px 10px; text-align: left; }
+ th { background: #eee; }
+ .RUNNING { color: #0a7d00; } .FAILED, .FAILED_SETUP { color: #b00; }
+ .RECOVERING { color: #b8860b; } .SUCCEEDED { color: #06c; }
+ .CANCELLED { color: #777; }
+ button { font-family: inherit; }
+ #updated { color: #777; font-size: 0.9em; }
+</style></head>
+<body>
+<h2>Managed jobs</h2>
+<div id="updated"></div>
+<table id="jobs"><thead><tr>
+ <th>ID</th><th>Name</th><th>Status</th><th>Submitted</th>
+ <th>Duration</th><th>Recoveries</th><th>Cluster</th>
+ <th>Failure</th><th></th>
+</tr></thead><tbody></tbody></table>
+<script>
+function fmtTs(t) {
+  return t ? new Date(t * 1000).toISOString().replace('T', ' ')
+                 .slice(0, 19) : '-';
+}
+function fmtDur(job) {
+  const start = job.started_at, end = job.ended_at ||
+      (job.terminal ? job.started_at : Date.now() / 1000);
+  if (!start) return '-';
+  const s = Math.max(0, Math.round(end - start));
+  return Math.floor(s / 60) + 'm' + (s % 60) + 's';
+}
+async function refresh() {
+  const resp = await fetch('/api/jobs');
+  const jobs = await resp.json();
+  const tb = document.querySelector('#jobs tbody');
+  tb.innerHTML = '';
+  for (const j of jobs) {
+    const tr = document.createElement('tr');
+    // textContent only — job names / failure reasons are user-
+    // controlled strings; never interpolate them into HTML.
+    const cells = [j.job_id, j.name, j.status, fmtTs(j.submitted_at),
+                   fmtDur(j), j.recovery_count, j.task_cluster || '-',
+                   j.failure_reason || ''];
+    for (let i = 0; i < cells.length; i++) {
+      const td = document.createElement('td');
+      td.textContent = String(cells[i]);
+      if (i === 2) td.className = j.status;
+      tr.appendChild(td);
+    }
+    const act = document.createElement('td');
+    if (!j.terminal) {
+      const btn = document.createElement('button');
+      btn.textContent = 'cancel';
+      btn.addEventListener('click', () => cancelJob(j.job_id));
+      act.appendChild(btn);
+    }
+    tr.appendChild(act);
+    tb.appendChild(tr);
+  }
+  document.getElementById('updated').textContent =
+      'updated ' + new Date().toLocaleTimeString();
+}
+async function cancelJob(id) {
+  await fetch('/api/cancel?job=' + id, {method: 'POST'});
+  refresh();
+}
+refresh();
+setInterval(refresh, 5000);
+</script></body></html>
+"""
+
+
+def _jobs_json() -> bytes:
+    records = []
+    for r in jobs_state.get_jobs():
+        rec = dict(r)
+        status = rec.pop('status')
+        rec['status'] = status.value
+        rec['terminal'] = status.is_terminal()
+        records.append(rec)
+    return json.dumps(records).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = 'application/json') -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        path = urlparse(self.path).path
+        if path == '/':
+            self._send(200, _PAGE.encode(), 'text/html; charset=utf-8')
+        elif path == '/api/jobs':
+            self._send(200, _jobs_json())
+        else:
+            self._send(404, b'{"error": "not found"}')
+
+    def do_POST(self):  # noqa: N802
+        parsed = urlparse(self.path)
+        if parsed.path != '/api/cancel':
+            self._send(404, b'{"error": "not found"}')
+            return
+        # CSRF guard: browsers attach an Origin header to cross-site
+        # POSTs; reject any whose host does not match ours. Same-page
+        # fetches send a same-origin Origin (or none for non-browser
+        # clients like curl/tests).
+        origin = self.headers.get('Origin')
+        if origin:
+            host = self.headers.get('Host', '')
+            if urlparse(origin).netloc != host:
+                self._send(403, b'{"error": "cross-origin"}')
+                return
+        try:
+            job_id = int(parse_qs(parsed.query)['job'][0])
+        except (KeyError, ValueError, IndexError):
+            self._send(400, b'{"error": "missing job"}')
+            return
+        if jobs_state.get_job(job_id) is None:
+            self._send(404, b'{"error": "no such job"}')
+            return
+        jobs_state.request_cancel(job_id)
+        self._send(200, b'{"ok": true}')
+
+
+class Dashboard:
+    """Embeddable server (CLI: ``xsky jobs dashboard``)."""
+
+    def __init__(self, host: str = '127.0.0.1', port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            self.stop()

@@ -96,30 +96,57 @@ def get_project_id() -> str:
         '~/.skypilot_tpu/config.yaml or configure gcloud.')
 
 
+_RETRYABLE_HTTP = (500, 502, 503, 504)
+_MAX_RETRIES = 3
+_RETRY_BACKOFF_S = 0.5
+
+
 def request(method: str, url: str,
             body: Optional[Dict[str, Any]] = None,
-            timeout: float = 60.0) -> Dict[str, Any]:
+            timeout: float = 60.0,
+            max_retries: int = _MAX_RETRIES) -> Dict[str, Any]:
     """One authenticated JSON request; raises typed errors on 4xx/5xx
-    with TPU-aware stockout/quota classification."""
+    with TPU-aware stockout/quota classification.
+
+    Transient-failure policy (model: ``_retry_on_http_exception``,
+    ``sky/provision/gcp/instance_utils.py:103``): GETs retry on
+    network errors and retryable 5xx with exponential backoff;
+    mutating methods retry ONLY on network-layer errors (the request
+    may never have reached the API) — a 5xx on a POST is surfaced
+    immediately since TPU ``nodes.create`` is not idempotent and the
+    operation may have started server-side.
+    """
     data = json.dumps(body).encode() if body is not None else None
-    req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={
-            'Authorization': f'Bearer {get_access_token()}',
-            'Content-Type': 'application/json',
-        })
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            payload = resp.read()
-            return json.loads(payload) if payload else {}
-    except urllib.error.HTTPError as e:
-        raise classify_http_error(e) from e
-    except (urllib.error.URLError, OSError) as e:
-        # DNS failures / resets / timeouts must stay inside the
-        # SkyTpuError taxonomy so bulk_provision's cleanup and the
-        # failover sweep still run.
-        raise exceptions.ApiError(
-            f'network error talking to {url}: {e}') from e
+    backoff = _RETRY_BACKOFF_S
+    for attempt in range(max_retries + 1):
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={
+                'Authorization': f'Bearer {get_access_token()}',
+                'Content-Type': 'application/json',
+            })
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            if (method == 'GET' and e.code in _RETRYABLE_HTTP and
+                    attempt < max_retries):
+                time.sleep(backoff)
+                backoff *= 2
+                continue
+            raise classify_http_error(e) from e
+        except (urllib.error.URLError, OSError) as e:
+            if attempt < max_retries:
+                time.sleep(backoff)
+                backoff *= 2
+                continue
+            # DNS failures / resets / timeouts must stay inside the
+            # SkyTpuError taxonomy so bulk_provision's cleanup and the
+            # failover sweep still run.
+            raise exceptions.ApiError(
+                f'network error talking to {url}: {e}') from e
+    raise AssertionError('unreachable')
 
 
 def classify_http_error(e: 'urllib.error.HTTPError') -> Exception:

@@ -1,0 +1,7 @@
+"""Usage telemetry (analog of ``sky/usage/``)."""
+from skypilot_tpu.usage.usage_lib import (entrypoint,
+                                          entrypoint_context, messages,
+                                          prepare_json_from_config)
+
+__all__ = ['entrypoint', 'entrypoint_context', 'messages',
+           'prepare_json_from_config']

@@ -1,0 +1,260 @@
+"""Usage telemetry: record one message per client entrypoint run.
+
+Analog of ``/root/reference/sky/usage/usage_lib.py`` (Loki push of a
+schema-versioned usage message per CLI/SDK invocation, with user-code
+redaction and an env kill-switch). TPU-native redesign:
+
+- Messages SPOOL LOCALLY (``~/.skypilot_tpu/usage/spool.jsonl``) —
+  this framework targets zero-egress TPU environments, so network
+  push is opt-in via ``SKYTPU_USAGE_PUSH_URL`` instead of a hardcoded
+  collector (ref ``usage/constants.py:3`` LOG_URL). Push failures are
+  silent best-effort, like the reference's 2-thread timeout push.
+- Same privacy contract as the reference: ``setup``/``run``/``envs``
+  and file-mount contents are never recorded
+  (ref ``USAGE_MESSAGE_REDACT_KEYS``, ``usage/constants.py:16``);
+  ``SKYTPU_DISABLE_USAGE_COLLECTION=1`` disables collection entirely.
+- One message per process, stamped by the OUTERMOST entrypoint
+  (ref ``usage_lib.py:406`` entrypoint_context) — nested SDK calls
+  under a CLI command do not double-report.
+"""
+import contextlib
+import functools
+import json
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import env_options
+
+_SCHEMA_VERSION = 1
+_REDACT_KEYS = ('setup', 'run', 'envs', 'file_mounts')
+_REDACTED = '<redacted>'
+_SPOOL_MAX_BYTES = 4 * 1024 * 1024
+
+
+def _spool_path() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_USAGE_SPOOL',
+                       '~/.skypilot_tpu/usage/spool.jsonl'))
+
+
+def _sanitize_cmdline(cmdline: str) -> str:
+    """Redact values from the recorded command line. ``--env K=V``
+    carries user secrets and any bare ``K=V`` token may too — keep
+    flag/command words, drop values (same privacy contract as the
+    task-config redaction)."""
+    out: List[str] = []
+    skip_next = False
+    for tok in cmdline.split():
+        if skip_next:
+            skip_next = False
+            key = tok.split('=', 1)[0] if '=' in tok else ''
+            out.append(f'{key}={_REDACTED}' if key else _REDACTED)
+            continue
+        if tok in ('--env', '-e'):
+            out.append(tok)
+            skip_next = True
+        elif tok.startswith('--env='):
+            key = tok[len('--env='):].split('=', 1)[0]
+            out.append(f'--env={key}={_REDACTED}')
+        elif '=' in tok and not tok.startswith('-'):
+            out.append(f'{tok.split("=", 1)[0]}={_REDACTED}')
+        else:
+            out.append(tok)
+    return ' '.join(out)
+
+
+class UsageMessage:
+    """The per-run usage record (ref ``UsageMessageToReport:74``)."""
+
+    def __init__(self) -> None:
+        self.schema_version = _SCHEMA_VERSION
+        self.user: str = common_utils.get_user_hash()
+        self.run_id: str = common_utils.get_usage_run_id()
+        self.entrypoint: Optional[str] = None
+        self.internal: bool = False
+        self.client_time: float = time.time()
+        self.duration_s: Optional[float] = None
+        self.cmdline: Optional[str] = None
+        self.task: Optional[Dict[str, Any]] = None
+        self.cluster_names: List[str] = []
+        self.num_nodes: Optional[int] = None
+        self.accelerator: Optional[str] = None
+        self.region: Optional[str] = None
+        self.zone: Optional[str] = None
+        self.use_spot: Optional[bool] = None
+        self.final_status: Optional[str] = None
+        self.exception: Optional[str] = None
+        self.stacktrace: Optional[str] = None
+        self._sent = False
+
+    # -- update helpers (mirroring the reference's update_* API) ----
+
+    def update_entrypoint(self, name: str) -> None:
+        if self.entrypoint is None:
+            self.entrypoint = name
+            self.cmdline = _sanitize_cmdline(
+                common_utils.get_pretty_entrypoint())
+
+    def set_internal(self) -> None:
+        self.internal = True
+
+    def update_task(self, task) -> None:
+        self.task = prepare_json_from_config(task.to_yaml_config())
+
+    def update_cluster_name(self,
+                            name: Union[str, List[str], None]) -> None:
+        if name is None:
+            return
+        names = [name] if isinstance(name, str) else list(name)
+        for n in names:
+            if n not in self.cluster_names:
+                self.cluster_names.append(n)
+
+    def update_cluster_resources(self, num_nodes: int,
+                                 resources) -> None:
+        self.num_nodes = num_nodes
+        self.accelerator = getattr(resources, 'accelerator', None)
+        self.region = getattr(resources, 'region', None)
+        self.zone = getattr(resources, 'zone', None)
+        self.use_spot = getattr(resources, 'use_spot', None)
+
+    def update_final_status(self, status: Any) -> None:
+        self.final_status = getattr(status, 'value', None) or str(status)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith('_')}
+
+
+class MessageCollection:
+    """Holds the process's usage message (ref ``usage_lib.py:278``)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    @property
+    def usage(self) -> UsageMessage:
+        return self._usage
+
+    def reset(self) -> None:
+        self._usage = UsageMessage()
+
+
+messages = MessageCollection()
+
+
+def _disabled() -> bool:
+    return env_options.Options.DISABLE_LOGGING.get()
+
+
+def prepare_json_from_config(
+        config: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Redact user code/material from a task config before recording
+    (ref ``usage_lib.py:337`` _clean_yaml: setup/run/envs dropped)."""
+    if config is None:
+        return None
+    clean: Dict[str, Any] = {}
+    for key, value in config.items():
+        if key in _REDACT_KEYS and value is not None:
+            clean[key] = _REDACTED
+        else:
+            clean[key] = value
+    return clean
+
+
+def _rotate_if_needed(path: str) -> None:
+    try:
+        if os.path.getsize(path) > _SPOOL_MAX_BYTES:
+            os.replace(path, path + '.1')
+    except OSError:
+        pass
+
+
+def _push(line: str) -> None:
+    """Best-effort network push from a daemon thread — never blocks
+    the entrypoint's exit (the reference pushes the same way,
+    ``usage_lib.py:304`` via a 2-worker pool)."""
+    url = os.environ.get('SKYTPU_USAGE_PUSH_URL')
+    if not url:
+        return
+
+    def send():
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                url, data=line.encode(),
+                headers={'Content-Type': 'application/json'})
+            urllib.request.urlopen(req, timeout=2)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    import threading
+    threading.Thread(target=send, daemon=True).start()
+
+
+def _record() -> None:
+    msg = messages.usage
+    if _disabled() or msg._sent or msg.entrypoint is None:
+        return
+    msg._sent = True
+    line = json.dumps(msg.to_dict(), default=str)
+    path = _spool_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _rotate_if_needed(path)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(line + '\n')
+    except OSError:
+        return
+    _push(line)
+
+
+@contextlib.contextmanager
+def entrypoint_context(name: str):
+    """Stamp the message with the OUTERMOST entrypoint and record it
+    on exit (ref ``usage_lib.py:406``). Nested contexts no-op; a new
+    top-level call after a recorded one starts a fresh message (long-
+    lived SDK processes — jobs/serve controllers — get one message
+    per operation, not one per process)."""
+    if messages.usage._sent:
+        messages.reset()
+    msg = messages.usage
+    outermost = msg.entrypoint is None
+    msg.update_entrypoint(name)
+    if _disabled():
+        yield
+        return
+    start = time.time()
+    try:
+        yield
+    except Exception as e:  # pylint: disable=broad-except
+        if outermost:
+            msg.exception = type(e).__name__
+            msg.stacktrace = traceback.format_exc(limit=5)
+        raise
+    finally:
+        if outermost:
+            msg.duration_s = round(time.time() - start, 3)
+            _record()
+
+
+def entrypoint(name_or_fn: Union[str, Callable]):
+    """Decorator form (ref ``usage_lib.py:455``)."""
+    if isinstance(name_or_fn, str):
+        def decorator(fn):
+            @functools.wraps(fn)
+            def wrapper(*args, **kwargs):
+                with entrypoint_context(name_or_fn):
+                    return fn(*args, **kwargs)
+            return wrapper
+        return decorator
+
+    fn = name_or_fn
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with entrypoint_context(fn.__name__):
+            return fn(*args, **kwargs)
+    return wrapper

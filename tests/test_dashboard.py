@@ -1,0 +1,93 @@
+"""Jobs-dashboard tests (ref ``sky/jobs/dashboard/dashboard.py``:
+jobs table view + cancel action)."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.jobs import dashboard
+from skypilot_tpu.jobs import state as jobs_state
+
+
+@pytest.fixture
+def board():
+    b = dashboard.Dashboard(port=0)
+    b.start()
+    yield b
+    b.stop()
+
+
+def _get(board, path):
+    with urllib.request.urlopen(
+            f'http://127.0.0.1:{board.port}{path}') as resp:
+        return resp.status, resp.read()
+
+
+def _post(board, path):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{board.port}{path}', method='POST')
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, resp.read()
+
+
+def test_index_serves_html(board):
+    status, body = _get(board, '/')
+    assert status == 200
+    assert b'Managed jobs' in body
+
+
+def test_api_jobs_lists_queue(board):
+    job_id = jobs_state.add_job('dash-test', '/tmp/dag.yaml', 'ctl')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    status, body = _get(board, '/api/jobs')
+    assert status == 200
+    jobs = json.loads(body)
+    rec = next(j for j in jobs if j['job_id'] == job_id)
+    assert rec['name'] == 'dash-test'
+    assert rec['status'] == 'RUNNING'
+    assert rec['terminal'] is False
+
+
+def test_api_cancel_requests_cancellation(board):
+    job_id = jobs_state.add_job('dash-cancel', '/tmp/dag.yaml', 'ctl')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    status, body = _post(board, f'/api/cancel?job={job_id}')
+    assert status == 200
+    assert jobs_state.cancel_requested(job_id)
+
+
+def test_api_cancel_unknown_job_404(board):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(board, '/api/cancel?job=99999')
+    assert err.value.code == 404
+
+
+def test_unknown_route_404(board):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(board, '/nope')
+    assert err.value.code == 404
+
+
+def test_cancel_cross_origin_rejected(board):
+    job_id = jobs_state.add_job('csrf', '/tmp/dag.yaml', 'ctl')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{board.port}/api/cancel?job={job_id}',
+        method='POST', headers={'Origin': 'http://evil.example'})
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req)
+    assert err.value.code == 403
+    assert not jobs_state.cancel_requested(job_id)
+
+
+def test_cancel_same_origin_allowed(board):
+    job_id = jobs_state.add_job('sameorigin', '/tmp/dag.yaml', 'ctl')
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.RUNNING)
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{board.port}/api/cancel?job={job_id}',
+        method='POST',
+        headers={'Origin': f'http://127.0.0.1:{board.port}'})
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+    assert jobs_state.cancel_requested(job_id)

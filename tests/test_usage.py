@@ -1,0 +1,154 @@
+"""Usage telemetry tests (ref ``sky/usage/usage_lib.py`` behavior:
+one message per outermost entrypoint, redaction, kill-switch)."""
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import usage
+from skypilot_tpu.usage import usage_lib
+
+
+@pytest.fixture(autouse=True)
+def spool(tmp_path, monkeypatch):
+    path = tmp_path / 'spool.jsonl'
+    monkeypatch.setenv('SKYTPU_USAGE_SPOOL', str(path))
+    monkeypatch.delenv('SKYTPU_DISABLE_USAGE_COLLECTION',
+                       raising=False)
+    usage_lib.messages.reset()
+    yield path
+    usage_lib.messages.reset()
+
+
+def _read(path):
+    with open(path, encoding='utf-8') as f:
+        return [json.loads(line) for line in f]
+
+
+def test_entrypoint_records_message(spool):
+    @usage.entrypoint('status')
+    def status():
+        return 42
+
+    assert status() == 42
+    (msg,) = _read(spool)
+    assert msg['entrypoint'] == 'status'
+    assert msg['duration_s'] >= 0
+    assert msg['exception'] is None
+    assert msg['schema_version'] == 1
+
+
+def test_nested_entrypoints_report_outermost_once(spool):
+    @usage.entrypoint('inner')
+    def inner():
+        return 1
+
+    @usage.entrypoint('outer')
+    def outer():
+        return inner() + inner()
+
+    outer()
+    (msg,) = _read(spool)
+    assert msg['entrypoint'] == 'outer'
+
+
+def test_exception_recorded_and_reraised(spool):
+    @usage.entrypoint('launch')
+    def boom():
+        raise ValueError('nope')
+
+    with pytest.raises(ValueError):
+        boom()
+    (msg,) = _read(spool)
+    assert msg['exception'] == 'ValueError'
+    assert 'ValueError' in msg['stacktrace']
+
+
+def test_redaction_of_user_code():
+    cfg = {'name': 't', 'setup': 'echo secret', 'run': 'python x.py',
+           'envs': {'KEY': 'v'}, 'num_nodes': 2,
+           'file_mounts': {'/x': 'y'}}
+    clean = usage.prepare_json_from_config(cfg)
+    assert clean['setup'] == '<redacted>'
+    assert clean['run'] == '<redacted>'
+    assert clean['envs'] == '<redacted>'
+    assert clean['file_mounts'] == '<redacted>'
+    assert clean['num_nodes'] == 2
+
+
+def test_kill_switch(spool, monkeypatch):
+    monkeypatch.setenv('SKYTPU_DISABLE_USAGE_COLLECTION', '1')
+
+    @usage.entrypoint('status')
+    def status():
+        return 1
+
+    status()
+    assert not os.path.exists(spool)
+
+
+def test_cluster_updates_flow_into_message(spool):
+    with usage.entrypoint_context('launch'):
+        usage_lib.messages.usage.update_cluster_name('c1')
+        usage_lib.messages.usage.update_cluster_name(['c1', 'c2'])
+    (msg,) = _read(spool)
+    assert msg['cluster_names'] == ['c1', 'c2']
+
+
+def test_launch_records_task_and_cluster(spool):
+    """End-to-end: a real launch on the local fake cloud spools a
+    redacted message."""
+    from skypilot_tpu import core, exceptions, execution
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task(run='echo hi', name='usage-e2e')
+    res = Resources(cloud='local')
+    res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+    task.set_resources(res)
+    try:
+        job_id, handle = execution.launch(task, 'usg-test',
+                                          quiet_optimizer=True)
+        assert handle is not None
+    finally:
+        usage_lib.messages.reset()
+        try:
+            core.down('usg-test', purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+    msgs = _read(spool)
+    launch_msgs = [m for m in msgs if m['entrypoint'] == 'launch']
+    assert launch_msgs, msgs
+    msg = launch_msgs[-1]
+    assert msg['cluster_names'] == ['usg-test']
+    assert msg['task']['run'] == '<redacted>'
+
+
+def test_sequential_toplevel_calls_each_record(spool):
+    @usage.entrypoint('status')
+    def status():
+        return 1
+
+    status()
+    status()
+    msgs = _read(spool)
+    assert len(msgs) == 2
+    assert {m['entrypoint'] for m in msgs} == {'status'}
+    assert msgs[0]['run_id'] != msgs[1]['run_id']
+
+
+def test_cmdline_env_values_redacted(spool):
+    import sys
+    argv = ['xsky', 'launch', '--env', 'WANDB_API_KEY=sk-secret',
+            '--env=TOKEN=abc', 'task.yaml']
+    old = sys.argv
+    sys.argv = argv
+    try:
+        with usage.entrypoint_context('launch'):
+            pass
+    finally:
+        sys.argv = old
+    (msg,) = _read(spool)
+    assert 'sk-secret' not in msg['cmdline']
+    assert 'abc' not in msg['cmdline']
+    assert 'WANDB_API_KEY' in msg['cmdline']
+    assert 'task.yaml' in msg['cmdline']
