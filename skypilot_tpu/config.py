@@ -32,7 +32,14 @@ def _load() -> None:
     _loaded_path = path
     if os.path.exists(path):
         with open(path, encoding='utf-8') as f:
-            _dict = yaml.safe_load(f) or {}
+            loaded = yaml.safe_load(f) or {}
+        # Validate BEFORE assigning: on failure _dict stays None so
+        # every subsequent access re-raises instead of silently
+        # serving the invalid config.
+        from skypilot_tpu.utils import schemas
+        schemas.validate(loaded, schemas.CONFIG_SCHEMA,
+                         f'config file {path}')
+        _dict = loaded
     else:
         _dict = {}
 

@@ -11,7 +11,8 @@ portable fallback and the executable spec of the protocol.
 Protocol (JSON over HTTP):
     GET  /health                  -> {ok, version, agent}
     POST /run   {cmd, log_path, env?, cwd?}    -> {proc_id}
-    GET  /status?proc_id=N        -> {running, returncode}
+    GET  /status?proc_id=N[&wait=S] -> {running, returncode}
+         (wait: long-poll up to S seconds for process exit)
     POST /kill  {proc_id}         -> {ok}
     POST /exec  {cmd, timeout?}   -> {returncode, output}   (blocking)
     GET  /read?path=P&offset=N    -> raw bytes
@@ -36,9 +37,17 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
-AGENT_VERSION = '1'
+# '2': /status grew long-poll (wait=). The version handshake
+# (tpu_backend._ensure_runtime_version) restarts stale agents on
+# reused clusters — without the bump an old agent would ignore
+# `wait` and answer instantly, degrading the driver's long-poll loop
+# into a busy-loop.
+AGENT_VERSION = '2'
 DEFAULT_PORT = 8790
 TOKEN_HEADER = 'X-SkyTpu-Token'
+# Cap on /status?wait= long-polls (a handler thread is held for the
+# duration; the client re-issues on expiry).
+MAX_STATUS_WAIT = 30.0
 
 _token: Optional[str] = None
 
@@ -92,12 +101,23 @@ class _ProcTable:
             self._procs[proc_id] = proc
         return proc_id
 
-    def status(self, proc_id: int):
+    def status(self, proc_id: int, wait: float = 0.0):
+        """``wait`` > 0: long-poll — block until the process exits or
+        the deadline, then report. Turns the driver's fixed-rate
+        status polling into one outstanding request per host (the
+        0.5 s/host/poll rate was flagged as the scalability limit for
+        64-host pods; one connection-held request per host scales
+        linearly and returns the instant the process exits)."""
         with self._lock:
             proc = self._procs.get(proc_id)
         if proc is None:
             return {'running': False, 'returncode': None,
                     'error': 'unknown proc_id'}
+        if wait > 0:
+            try:
+                proc.wait(timeout=min(wait, MAX_STATUS_WAIT))
+            except subprocess.TimeoutExpired:
+                pass
         rc = proc.poll()
         return {'running': rc is None, 'returncode': rc}
 
@@ -154,7 +174,8 @@ class _Handler(BaseHTTPRequestHandler):
                         'agent': 'py'})
         elif parsed.path == '/status':
             proc_id = int(qs.get('proc_id', ['0'])[0])
-            self._json(_procs.status(proc_id))
+            wait = float(qs.get('wait', ['0'])[0])
+            self._json(_procs.status(proc_id, wait=wait))
         elif parsed.path == '/read':
             path = os.path.expanduser(qs.get('path', [''])[0])
             offset = int(qs.get('offset', ['0'])[0])

@@ -116,7 +116,15 @@ class AgentClient:
                                   'env': env or {}, 'cwd': cwd or ''})
         return int(out['proc_id'])
 
-    def status(self, proc_id: int) -> Dict[str, Any]:
+    def status(self, proc_id: int,
+               wait: Optional[float] = None) -> Dict[str, Any]:
+        """``wait``: long-poll up to that many seconds for process
+        exit (agent caps at 30 s). The HTTP timeout is stretched to
+        cover the hold."""
+        if wait:
+            return self._get('/status',
+                             {'proc_id': proc_id, 'wait': wait},
+                             timeout=wait + self.timeout)
         return self._get('/status', {'proc_id': proc_id})
 
     def kill(self, proc_id: int) -> bool:

@@ -25,6 +25,7 @@
 #include <unistd.h>
 
 #include <cctype>
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -36,7 +37,8 @@
 
 namespace {
 
-constexpr const char* kVersion = "1";
+// Keep in lockstep with agent.py AGENT_VERSION.
+constexpr const char* kVersion = "2";
 
 // ---------------------------------------------------------------------
 // Minimal JSON: value = object | string | number | bool | null.
@@ -489,9 +491,22 @@ void HandleConnection(int fd) {
                      "\", \"agent\": \"cpp\"}");
   } else if (req.method == "GET" && req.path == "/status") {
     int id = std::atoi(req.query["proc_id"].c_str());
+    // wait=S: long-poll (thread-per-connection makes blocking safe).
+    // Same contract as the Python agent; capped at 30 s.
+    double wait_s = std::atof(req.query["wait"].c_str());
+    if (wait_s > 30.0) wait_s = 30.0;
     bool known = false, running = false;
     int rc = -1;
     g_procs.Status(id, &known, &running, &rc);
+    if (known && running && wait_s > 0) {
+      auto deadline =
+          std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(static_cast<int>(wait_s * 1000));
+      while (running && std::chrono::steady_clock::now() < deadline) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        g_procs.Status(id, &known, &running, &rc);
+      }
+    }
     if (!known) {
       SendJson(fd, "{\"running\": false, \"returncode\": null, "
                    "\"error\": \"unknown proc_id\"}");
@@ -559,8 +574,6 @@ void HandleConnection(int fd) {
 }
 
 }  // namespace
-
-#include <chrono>
 
 int main(int argc, char** argv) {
   int port = 8790;

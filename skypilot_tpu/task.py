@@ -17,6 +17,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import schemas
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -157,9 +158,19 @@ class Task:
         the string fields using ``envs`` (+ CLI overrides), mirroring
         ``_fill_in_env_vars`` (``sky/task.py:73``)."""
         config = dict(config or {})
+        # Declarative first pass: typed, path-qualified errors for
+        # shape/type mistakes (ref sky/utils/schemas.py via
+        # validate_schema); the pop-and-raise parsing below remains
+        # the source of semantic errors.
+        schemas.validate(config, schemas.TASK_SCHEMA, 'task YAML')
         envs = dict(config.get('envs') or {})
         if env_overrides:
             envs.update(env_overrides)
+        # YAML scalars (8080, true) are valid env values; coerce to
+        # str here — process environments are string-only and the
+        # Python agent's Popen rejects non-str values at run time.
+        envs = {k: (v if isinstance(v, str) or v is None else str(v))
+                for k, v in envs.items()}
         config['envs'] = envs
         for key in ('setup', 'run', 'workdir'):
             val = config.get(key)

@@ -1,0 +1,171 @@
+"""JSON Schemas for user-facing YAML (task / resources / storage /
+service) and validation helpers.
+
+Analog of ``/root/reference/sky/utils/schemas.py`` (987 LoC of
+hand-written JSON Schema validated via jsonschema at every YAML
+ingestion point, ``sky/utils/common_utils.py:validate_schema``).
+TPU-native scope: only the fields this framework implements — the
+schemas are the single declarative statement of the YAML surface, and
+give typed, path-qualified errors BEFORE the pop-and-raise parsing in
+``task.py``/``resources.py`` (which stays as the second line of
+defense and the source of semantic errors).
+"""
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_FIELDS = {
+    'cloud': {'type': ['string', 'null']},
+    'accelerators': {
+        # 'tpu-v5p-8', list of candidates, or null.
+        'anyOf': [{'type': 'string'}, {'type': 'null'},
+                  {'type': 'array', 'items': {'type': 'string'}}],
+    },
+    'region': {'type': ['string', 'null']},
+    'zone': {'type': ['string', 'null']},
+    'use_spot': {'type': ['boolean', 'null']},
+    'spot_recovery': {'type': ['string', 'null']},
+    'disk_size': {'type': ['integer', 'null'], 'minimum': 1},
+    'runtime_version': {'type': ['string', 'null']},
+    'image_id': {'type': ['string', 'null']},
+    'ports': {
+        'anyOf': [{'type': 'null'}, {'type': 'integer'},
+                  {'type': 'string'},
+                  {'type': 'array',
+                   'items': {'type': ['integer', 'string']}}],
+    },
+    'labels': {'type': ['object', 'null'],
+               'additionalProperties': {'type': 'string'}},
+    'job_recovery': {'type': ['string', 'object', 'null']},
+    'accelerator_args': {'type': ['object', 'null']},
+}
+
+RESOURCES_SCHEMA = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': ['object', 'null'],
+    'additionalProperties': False,
+    'properties': {
+        **_RESOURCES_FIELDS,
+        'any_of': {
+            'type': 'array',
+            'items': {'type': 'object',
+                      'additionalProperties': False,
+                      'properties': _RESOURCES_FIELDS},
+        },
+    },
+}
+
+STORAGE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'source': {'type': ['string', 'null']},
+        'mode': {'type': 'string',
+                 'pattern': '(?i)^(MOUNT|COPY)$'},
+        'store': {'type': 'string', 'pattern': '(?i)^(GCS)$'},
+        'persistent': {'type': 'boolean'},
+    },
+}
+
+# Field names follow serve/service_spec.py's from_yaml_config /
+# to_yaml_config round-trip exactly (the controller re-parses the
+# emitted config, so the schema must accept everything it emits).
+SERVICE_SCHEMA = {
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'readiness_probe': {
+            'anyOf': [{'type': 'string'},
+                      {'type': 'object',
+                       'additionalProperties': False,
+                       'properties': {
+                           'path': {'type': 'string'},
+                           'initial_delay_seconds': {
+                               'type': 'number', 'minimum': 0},
+                           'timeout_seconds': {
+                               'type': 'number', 'minimum': 0},
+                       }}],
+        },
+        'replicas': {'type': 'integer', 'minimum': 1},
+        'port': {'type': 'integer', 'minimum': 1, 'maximum': 65535},
+        'replica_policy': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': ['integer', 'null'],
+                                 'minimum': 1},
+                'target_qps_per_replica': {'type': 'number',
+                                           'exclusiveMinimum': 0},
+                'upscale_delay_seconds': {'type': 'number',
+                                          'minimum': 0},
+                'downscale_delay_seconds': {'type': 'number',
+                                            'minimum': 0},
+                'base_ondemand_fallback_replicas': {
+                    'type': 'integer', 'minimum': 0},
+            },
+        },
+    },
+}
+
+TASK_SCHEMA = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': 'object',
+    'additionalProperties': False,
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'workdir': {'type': ['string', 'null']},
+        'setup': {'type': ['string', 'null']},
+        'run': {'type': ['string', 'null']},
+        'envs': {'type': ['object', 'null'],
+                 'additionalProperties': {
+                     'type': ['string', 'number', 'boolean', 'null']}},
+        'num_nodes': {'type': ['integer', 'null'], 'minimum': 1},
+        'file_mounts': {'type': ['object', 'null']},
+        'event_callback': {'type': ['string', 'null']},
+        'resources': RESOURCES_SCHEMA,
+        'storage_mounts': {
+            'type': ['object', 'null'],
+            'additionalProperties': STORAGE_SCHEMA,
+        },
+        'service': SERVICE_SCHEMA,
+        # Accepted-and-ignored reference fields (task.py:202).
+        'inputs': {},
+        'outputs': {},
+    },
+}
+
+# The layered config is open-ended by design (arbitrary sections may
+# be layered via override_config); known sections get type checks,
+# unknown sections pass through — unlike the strict task schema.
+CONFIG_SCHEMA = {
+    '$schema': 'https://json-schema.org/draft/2020-12/schema',
+    'type': ['object', 'null'],
+    'properties': {
+        'gcp': {
+            'type': 'object',
+            'properties': {
+                'project_id': {'type': 'string'},
+                'network': {'type': 'string'},
+                'labels': {'type': 'object'},
+            },
+        },
+        'admin_policy': {'type': 'string'},
+    },
+}
+
+
+def validate(config: Any, schema: Dict[str, Any],
+             what: str = 'spec') -> None:
+    """Validate ``config`` against ``schema``; raise
+    ``InvalidSpecError`` with a YAML-path-qualified message (model:
+    ``sky/utils/common_utils.py:validate_schema``)."""
+    import jsonschema
+
+    try:
+        jsonschema.validate(config, schema)
+    except jsonschema.exceptions.ValidationError as e:
+        path = '.'.join(str(p) for p in e.absolute_path) or '<root>'
+        raise exceptions.InvalidSpecError(
+            f'Invalid {what}: {e.message} (at {path!r})') from e

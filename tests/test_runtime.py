@@ -118,6 +118,42 @@ class TestAgentProtocol:
         st = client.status(99999)
         assert st['running'] is False
 
+    def test_status_long_poll_returns_on_exit(self, agent, tmp_path):
+        """/status?wait=S blocks while the proc runs and returns the
+        moment it exits — the driver's scalable liveness primitive
+        (one held request per host instead of 2 Hz polling)."""
+        client, _ = agent
+        log = str(tmp_path / 'lp.log')
+        proc_id = client.run('sleep 0.7', log)
+        t0 = time.time()
+        st = client.status(proc_id, wait=10.0)
+        elapsed = time.time() - t0
+        assert st['running'] is False
+        assert st['returncode'] == 0
+        # Returned via exit, not via the 10 s wait expiring.
+        assert elapsed < 8.0, elapsed
+        # And it actually blocked rather than returning immediately.
+        assert elapsed > 0.3, elapsed
+
+    def test_status_long_poll_expires_while_running(self, agent,
+                                                    tmp_path):
+        client, _ = agent
+        log = str(tmp_path / 'lp2.log')
+        proc_id = client.run('sleep 30', log)
+        t0 = time.time()
+        st = client.status(proc_id, wait=0.5)
+        elapsed = time.time() - t0
+        assert st['running'] is True
+        assert 0.4 <= elapsed < 5.0, elapsed
+        client.kill(proc_id)
+
+    def test_status_long_poll_unknown_proc_immediate(self, agent):
+        client, _ = agent
+        t0 = time.time()
+        st = client.status(424242, wait=5.0)
+        assert st['running'] is False
+        assert time.time() - t0 < 2.0
+
 
 @pytest.fixture
 def runtime_env(tmp_path, monkeypatch):

@@ -1,0 +1,122 @@
+"""YAML schema validation tests (ref ``sky/utils/schemas.py`` +
+``validate_schema``: typed, path-qualified errors at ingestion)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+from skypilot_tpu.utils import schemas
+
+
+class TestTaskSchema:
+
+    def test_valid_full_task(self):
+        task = Task.from_yaml_config({
+            'name': 't',
+            'num_nodes': 2,
+            'setup': 'pip install x',
+            'run': 'python train.py',
+            'envs': {'K': 'v'},
+            'resources': {
+                'cloud': 'gcp',
+                'accelerators': 'tpu-v5p-8',
+                'use_spot': True,
+                'ports': [8080, '9000-9010'],
+            },
+            'service': {'readiness_probe': '/health', 'port': 8080,
+                        'replicas': 2},
+        })
+        assert task.num_nodes == 2
+
+    def test_unknown_top_level_field_path_in_error(self):
+        with pytest.raises(exceptions.InvalidSpecError,
+                           match='nodes'):
+            Task.from_yaml_config({'run': 'x', 'nodes': 2})
+
+    def test_wrong_type_num_nodes(self):
+        with pytest.raises(exceptions.InvalidSpecError,
+                           match='num_nodes'):
+            Task.from_yaml_config({'run': 'x', 'num_nodes': 'two'})
+
+    def test_nested_resources_error_has_path(self):
+        with pytest.raises(exceptions.InvalidSpecError,
+                           match='resources'):
+            Task.from_yaml_config(
+                {'run': 'x', 'resources': {'disk_size': 'big'}})
+
+    def test_any_of_resources_validated(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Task.from_yaml_config({
+                'run': 'x',
+                'resources': {'any_of': [{'acclerators': 'v5e-8'}]}})
+
+    def test_negative_num_nodes_rejected(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            Task.from_yaml_config({'run': 'x', 'num_nodes': 0})
+
+    def test_service_bad_port_rejected(self):
+        with pytest.raises(exceptions.InvalidSpecError, match='port'):
+            Task.from_yaml_config({
+                'run': 'x', 'service': {'port': 99999}})
+
+    def test_storage_mount_mode_case_insensitive(self):
+        task = Task.from_yaml_config({
+            'run': 'x',
+            'storage_mounts': {
+                '/ckpt': {'name': 'bkt', 'mode': 'mount'}}})
+        assert task.storage_mounts
+
+
+class TestConfigSchema:
+
+    def test_known_section_type_checked(self):
+        with pytest.raises(exceptions.InvalidSpecError,
+                           match='project_id'):
+            schemas.validate({'gcp': {'project_id': 123}},
+                             schemas.CONFIG_SCHEMA, 'config')
+
+    def test_unknown_sections_pass(self):
+        schemas.validate({'myorg': {'anything': 1}},
+                         schemas.CONFIG_SCHEMA, 'config')
+
+    def test_config_file_validated_on_load(self, tmp_path,
+                                           monkeypatch):
+        bad = tmp_path / 'config.yaml'
+        bad.write_text('gcp:\n  project_id: 123\n')
+        monkeypatch.setenv('SKYTPU_CONFIG', str(bad))
+        from skypilot_tpu import config as config_lib
+        config_lib.reload_config()  # lazy: next access loads
+        try:
+            with pytest.raises(exceptions.InvalidSpecError):
+                config_lib.to_dict()
+        finally:
+            # Restore a clean state for other tests.
+            monkeypatch.delenv('SKYTPU_CONFIG')
+            config_lib.reload_config()
+
+
+def test_service_roundtrip_revalidates():
+    """to_yaml_config output must itself validate (the serve
+    controller re-parses it — regression: probe 'timeout_seconds')."""
+    task = Task.from_yaml_config({
+        'run': 'python serve.py',
+        'service': {
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 5,
+                                'timeout_seconds': 10},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                               'target_qps_per_replica': 2.5,
+                               'base_ondemand_fallback_replicas': 1},
+            'port': 9000,
+        },
+    })
+    rt = Task.from_yaml_config(task.to_yaml_config())
+    assert rt.service.port == 9000
+
+
+def test_numeric_env_values_coerced_to_str():
+    """YAML `envs: {PORT: 8080}` must reach the agent as strings —
+    Popen env is string-only (regression: agent 500 at run time)."""
+    task = Task.from_yaml_config(
+        {'run': 'echo $PORT', 'envs': {'PORT': 8080, 'FLAG': True}})
+    assert task.envs['PORT'] == '8080'
+    assert task.envs['FLAG'] == 'True'
