@@ -45,7 +45,11 @@ _LAZY_ATTRS = {
     'models': ('skypilot_tpu.models', None),
     'ops': ('skypilot_tpu.ops', None),
     'parallel': ('skypilot_tpu.parallel', None),
-    'check': ('skypilot_tpu.check', 'check'),
+    # The module, not the function — matching the reference, where
+    # ``sky.check`` is the module and ``sky.check.check()`` the API
+    # (binding the function here shadows the submodule and poisons
+    # later ``import skypilot_tpu.check`` holders).
+    'check': ('skypilot_tpu.check', None),
     'Storage': ('skypilot_tpu.data.storage', 'Storage'),
     'StoreType': ('skypilot_tpu.data.storage', 'StoreType'),
     'StorageMode': ('skypilot_tpu.data.storage', 'StorageMode'),

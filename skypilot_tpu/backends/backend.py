@@ -31,6 +31,15 @@ class ClusterHandle:
     agent_token: Optional[str] = None
 
     @property
+    def is_local(self) -> bool:
+        """Local-style runtime (hosts are processes on this machine)
+        — a cloud-registry property, not a name comparison, so plugin
+        clouds that reuse the local provision module behave
+        correctly."""
+        from skypilot_tpu import clouds
+        return clouds.from_name(self.provider).is_local
+
+    @property
     def num_hosts(self) -> int:
         return len(self.hosts)
 
@@ -50,7 +59,7 @@ class ClusterHandle:
         assert self.hosts, 'cluster has no hosts'
         host = self.hosts[host_index]
         token = getattr(self, 'agent_token', None)
-        if self.provider in ('local',):
+        if self.is_local:
             addr = host.get('external_ip') or host.get('ip')
             return AgentClient(addr, host['agent_port'], token=token)
         from skypilot_tpu.runtime import tunnels

@@ -22,6 +22,7 @@ from skypilot_tpu.utils import common_utils
 
 logger = tpu_logging.init_logger(__name__)
 
+
 _PROVISION_RETRY_GAP_SECONDS = 30
 
 
@@ -118,7 +119,7 @@ class TpuBackend(Backend):
             agent_token=agent_token,
         )
         handle.head_runtime_dir = handle.hosts[0]['runtime_dir']
-        if handle.provider == 'local':
+        if handle.is_local:
             base = os.path.dirname(handle.head_runtime_dir)
             handle.workdir = os.path.join(base, 'sky_workdir')
         state.add_or_update_cluster(cluster_name, handle,
@@ -145,7 +146,7 @@ class TpuBackend(Backend):
         logger.info('Cluster %s runtime version mismatch %s (client '
                     'wants %s); restarting runtime.',
                     handle.cluster_name, stale, agent.AGENT_VERSION)
-        if handle.provider != 'local':
+        if not handle.is_local:
             from skypilot_tpu.provision import instance_setup
             instance_setup.stop_runtime_on_cluster(handle)
         self._post_provision_runtime_setup(handle)
@@ -155,7 +156,7 @@ class TpuBackend(Backend):
         """Agents healthy on every host + skylet running on head
         (model: ``post_provision_runtime_setup``,
         ``sky/provision/provisioner.py:631``)."""
-        if handle.provider != 'local':
+        if not handle.is_local:
             from skypilot_tpu.provision import instance_setup
             instance_setup.setup_runtime_on_cluster(handle)
         for i in range(handle.num_hosts):
@@ -194,7 +195,7 @@ class TpuBackend(Backend):
 
     def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
         source = os.path.expanduser(workdir).rstrip('/') + '/'
-        if handle.provider == 'local':
+        if handle.is_local:
             from skypilot_tpu.utils.command_runner import \
                 LocalCommandRunner
             LocalCommandRunner().rsync(
@@ -233,7 +234,7 @@ class TpuBackend(Backend):
                 raise exceptions.StorageSourceError(
                     f'file_mount source {source!r} does not exist')
             is_dir = os.path.isdir(src)
-            if handle.provider == 'local':
+            if handle.is_local:
                 from skypilot_tpu.utils.command_runner import \
                     LocalCommandRunner
                 runner = LocalCommandRunner()
@@ -440,13 +441,9 @@ class TpuBackend(Backend):
                 provision.cleanup_ports(handle.provider, handle.region,
                                         handle.cluster_name_on_cloud)
             else:
-                res = handle.launched_resources
-                if res is not None and res.tpu_spec is not None and \
-                        res.tpu_spec.is_pod:
-                    raise exceptions.NotSupportedError(
-                        'TPU pods cannot be stopped (reference '
-                        'constraint sky/clouds/gcp.py:193-203); use '
-                        'down instead.')
+                from skypilot_tpu import clouds
+                clouds.from_name(handle.provider).check_stop_supported(
+                    handle.launched_resources)
                 provision.stop_instances(handle.provider,
                                          handle.region,
                                          handle.cluster_name_on_cloud)

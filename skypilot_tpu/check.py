@@ -1,35 +1,28 @@
-"""Credential checking (analog of ``sky/check.py:19``)."""
+"""Credential checking (analog of ``sky/check.py:19``): probe every
+registered cloud, persist the enabled set."""
 from typing import List
 
+from skypilot_tpu import clouds
 from skypilot_tpu import state
 from skypilot_tpu import tpu_logging
 
 logger = tpu_logging.init_logger(__name__)
 
 
-def _check_gcp() -> bool:
-    from skypilot_tpu import exceptions
-    from skypilot_tpu.provision.gcp import client as gcp_client
-    try:
-        gcp_client.get_access_token()
-        gcp_client.get_project_id()
-        return True
-    except exceptions.SkyTpuError:
-        return False
-
-
 def check(quiet: bool = False) -> List[str]:
-    """Probe each cloud's credentials; persist the enabled set."""
+    """Probe each registered cloud's credentials; persist the enabled
+    set (iterates the cloud registry — a newly registered provider is
+    probed with no change here, unlike the reference's per-cloud
+    if-ladder)."""
     enabled = []
-    if _check_gcp():
-        enabled.append('gcp')
-        if not quiet:
-            logger.info('GCP: enabled')
-    elif not quiet:
-        logger.info('GCP: no credentials found')
-    # The local fake provider is always available (used by tests and
-    # single-machine smoke runs).
-    enabled.append('local')
+    for cloud in clouds.registered():
+        ok, reason = cloud.check_credentials()
+        if ok:
+            enabled.append(cloud.name)
+            if not quiet:
+                logger.info('%s: enabled', cloud.name)
+        elif not quiet:
+            logger.info('%s: disabled (%s)', cloud.name, reason)
     state.set_enabled_clouds(enabled)
     return enabled
 

@@ -1,0 +1,37 @@
+"""Cloud registry (analog of ``sky/clouds/__init__.py`` +
+``sky/registry.py``): name -> Cloud singleton."""
+from typing import Dict, List
+
+from skypilot_tpu.clouds.cloud import Cloud
+from skypilot_tpu.clouds.gcp import GcpCloud
+from skypilot_tpu.clouds.local import LocalCloud
+
+CLOUD_REGISTRY: Dict[str, Cloud] = {}
+
+
+def register(cloud: Cloud) -> Cloud:
+    """Add a Cloud to the registry (call at import for built-ins;
+    callable by plugins/tests to add providers without patching)."""
+    assert cloud.name, 'Cloud.name must be set'
+    CLOUD_REGISTRY[cloud.name] = cloud
+    return cloud
+
+
+def from_name(name: str) -> Cloud:
+    try:
+        return CLOUD_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f'Unknown cloud {name!r}; registered: '
+            f'{sorted(CLOUD_REGISTRY)}') from None
+
+
+def registered() -> List[Cloud]:
+    return list(CLOUD_REGISTRY.values())
+
+
+register(GcpCloud())
+register(LocalCloud())
+
+__all__ = ['Cloud', 'CLOUD_REGISTRY', 'register', 'from_name',
+           'registered']

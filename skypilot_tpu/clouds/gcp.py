@@ -1,0 +1,44 @@
+"""GCP TPU cloud (analog of ``/root/reference/sky/clouds/gcp.py`` —
+the TPU-relevant slice: credential probe via the hand-rolled client,
+catalog-backed region/zone enumeration, the pod no-stop constraint
+``sky/clouds/gcp.py:193-203``)."""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu.clouds.cloud import Cloud
+
+
+class GcpCloud(Cloud):
+    name = 'gcp'
+    provision_module = 'gcp'
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.provision.gcp import client as gcp_client
+        try:
+            gcp_client.get_access_token()
+            gcp_client.get_project_id()
+            return True, None
+        except exceptions.SkyTpuError as e:
+            return False, str(e)
+
+    def regions_for(self, accelerator: Optional[str],
+                    use_spot: bool) -> List[str]:
+        if accelerator is None:
+            return [self.default_region()]
+        return catalog.get_regions(accelerator, use_spot)
+
+    def zones_for(self, accelerator: Optional[str],
+                  region: str) -> List[str]:
+        if accelerator is None:
+            return []
+        return catalog.get_zones(accelerator, region)
+
+    def supports_stop(self, resources) -> Tuple[bool, Optional[str]]:
+        if resources is not None and \
+                getattr(resources, 'tpu_spec', None) is not None and \
+                resources.tpu_spec.is_pod:
+            return False, ('TPU pods cannot be stopped (reference '
+                           'constraint sky/clouds/gcp.py:193-203); '
+                           'use down instead.')
+        return True, None

@@ -1,0 +1,30 @@
+"""The in-process fake cloud (tests + single-machine smoke runs).
+
+The reference has no equivalent — its closest analog is the
+kubernetes "existing cluster" path. Hosts are agent subprocesses on
+localhost ports (``provision/local/instance.py``)."""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds.cloud import Cloud
+
+
+class LocalCloud(Cloud):
+    name = 'local'
+    provision_module = 'local'
+    is_local = True
+    supports_spot = True        # failure injection emulates spot
+    supports_open_ports = False
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None       # always available
+
+    def regions_for(self, accelerator: Optional[str],
+                    use_spot: bool) -> List[str]:
+        return ['local']
+
+    def zones_for(self, accelerator: Optional[str],
+                  region: str) -> List[str]:
+        return []
+
+    def default_region(self) -> str:
+        return 'local'

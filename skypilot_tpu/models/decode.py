@@ -1,0 +1,185 @@
+"""KV-cache incremental decoding for the in-tree Llama.
+
+The reference serves LLMs through external engines (vLLM/TGI flags in
+``llm/vllm/service.yaml``); this module is the TPU-native in-tree
+equivalent for the serve recipe: prefill once, then O(1) work per
+generated token instead of re-running the full prefix
+(``recipes/serve_model.py`` previously recomputed the whole sequence
+per token — O(T^2) per reply).
+
+TPU-first design:
+- STATIC shapes throughout: the cache is [L, B, max_seq, Hkv, hd] and
+  decode attends over all max_seq positions with a position mask —
+  no dynamic shapes, so one compiled step serves every position.
+- The per-layer loop is a ``lax.scan`` over the stacked [L, ...]
+  params AND the cache, which is updated functionally
+  (``dynamic_update_slice``) and donated by the caller's jit.
+- Decode attention is a plain masked einsum: at q-length 1 the MXU
+  tile is tiny either way and flash's block machinery buys nothing.
+"""
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+_NEG_INF = -1e30
+
+
+@dataclasses.dataclass
+class KVCache:
+    """Functional KV cache. k/v: [L, B, max_seq, Hkv, hd] (compute
+    dtype); ``pos`` — number of positions already written (same for
+    every sequence in the batch; ragged batches left-pad)."""
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array  # scalar int32
+
+
+jax.tree_util.register_pytree_node(
+    KVCache,
+    lambda c: ((c.k, c.v, c.pos), None),
+    lambda _, leaves: KVCache(*leaves))
+
+
+def init_cache(config: llama.LlamaConfig, batch: int,
+               max_seq: Optional[int] = None) -> KVCache:
+    max_seq = max_seq or config.max_seq_len
+    shape = (config.n_layers, batch, max_seq, config.n_kv_heads,
+             config.head_dim)
+    return KVCache(k=jnp.zeros(shape, config.dtype),
+                   v=jnp.zeros(shape, config.dtype),
+                   pos=jnp.zeros((), jnp.int32))
+
+
+def _masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      q_pos: jax.Array, kv_len: jax.Array,
+                      scale: float) -> jax.Array:
+    """q: [B, T, H, hd]; k/v: [B, S, Hkv, hd] (S = max_seq, only
+    ``kv_len`` positions valid). Causal within the valid window:
+    query at absolute position ``q_pos + i`` sees keys [0, q_pos+i].
+    """
+    b, t, h, hd = q.shape
+    s = k.shape[1]
+    hkv = k.shape[2]
+    groups = h // hkv
+    qg = q.reshape(b, t, hkv, groups, hd)
+    logits = jnp.einsum('bthgd,bshd->bhgts', qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    key_idx = jnp.arange(s)[None, :]                       # [1, S]
+    query_abs = q_pos + jnp.arange(t)[:, None]             # [T, 1]
+    mask = (key_idx <= query_abs) & (key_idx < kv_len)     # [T, S]
+    logits = jnp.where(mask[None, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhgts,bshd->bthgd', probs.astype(v.dtype), v)
+    return out.reshape(b, t, h, hd)
+
+
+def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
+                  layer_params: Params, k_cache: jax.Array,
+                  v_cache: jax.Array, pos: jax.Array,
+                  angles: jax.Array
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One transformer layer over ``T`` new positions with cache
+    append. x: [B, T, D]; k_cache/v_cache: [B, S, Hkv, hd]. Returns
+    (y, new_k_cache, new_v_cache). Weight math mirrors ``_layer``
+    (models/llama.py) minus LoRA (serving uses merged weights —
+    ``parallel/lora.merge_lora``)."""
+    b, t, _ = x.shape
+    nh, nkv, hd = (config.n_heads, config.n_kv_heads, config.head_dim)
+
+    h = llama._rms_norm(x, layer_params['attn_norm'], config.norm_eps)
+    q = (h @ layer_params['wq']).reshape(b, t, nh, hd)
+    k = (h @ layer_params['wk']).reshape(b, t, nkv, hd)
+    v = (h @ layer_params['wv']).reshape(b, t, nkv, hd)
+    from skypilot_tpu.ops import attention as attention_ops
+    q = attention_ops.apply_rope(q, angles)
+    k = attention_ops.apply_rope(k, angles)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    attn = _masked_attention(q, k_cache, v_cache, q_pos=pos,
+                             kv_len=pos + t, scale=hd ** -0.5)
+    x = x + attn.reshape(b, t, nh * hd) @ layer_params['wo']
+
+    h = llama._rms_norm(x, layer_params['mlp_norm'], config.norm_eps)
+    gate = jax.nn.silu((h @ layer_params['w_gate'])
+                       .astype(jnp.float32)).astype(h.dtype)
+    up = h @ layer_params['w_up']
+    x = x + (gate * up) @ layer_params['w_down']
+    return x, k_cache, v_cache
+
+
+def forward_cached(params: Params, tokens: jax.Array,
+                   cache: KVCache, config: llama.LlamaConfig
+                   ) -> Tuple[jax.Array, KVCache]:
+    """Run ``tokens`` [B, T] at absolute positions
+    [cache.pos, cache.pos + T) and append to the cache. Returns
+    (logits [B, T, vocab] f32, new cache). Used both for prefill
+    (T = prompt length) and decode (T = 1) — same compiled step per
+    distinct T."""
+    cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
+    _, t = tokens.shape
+    positions = cache.pos + jnp.arange(t)
+    angles = llama._rope_frequencies(config, positions)
+
+    x = cparams['embed'][tokens]
+
+    def body(carry, scanned):
+        xc, pos = carry
+        layer_params, kc, vc = scanned
+        y, kc, vc = _layer_cached(config, xc, layer_params, kc, vc,
+                                  pos, angles)
+        return (y, pos), (kc, vc)
+
+    (x, _), (new_k, new_v) = jax.lax.scan(
+        body, (x, cache.pos), (cparams['layers'], cache.k, cache.v))
+    x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps)
+    logits = (x @ cparams['lm_head']).astype(jnp.float32)
+    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
+
+
+def greedy_generate(params: Params, prompt: jax.Array,
+                    config: llama.LlamaConfig, max_new_tokens: int,
+                    max_seq: Optional[int] = None,
+                    eos_id: Optional[int] = None) -> jax.Array:
+    """Greedy decode: prefill the prompt once, then one cached step
+    per token. prompt: [B, T0] -> [B, <=max_new_tokens] generated ids
+    (rows that hit ``eos_id`` are padded with it thereafter).
+
+    One jitted callable serves both phases — jit caches one
+    executable per distinct T (the T0-length prefill and the shared
+    T=1 decode step); the cache buffers are donated so generation
+    runs in-place in HBM.
+    """
+    max_seq = max_seq or config.max_seq_len
+    b, t0 = prompt.shape
+    assert t0 + max_new_tokens <= max_seq, (t0, max_new_tokens,
+                                            max_seq)
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    cache = init_cache(config, b, max_seq)
+
+    step = jax.jit(forward_cached, static_argnums=(3,),
+                   donate_argnums=(2,))
+
+    logits, cache = step(params, prompt, cache, config)
+    nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+    done = (jnp.zeros((b,), bool) if eos_id is None
+            else nxt == eos_id)
+    out = [nxt]
+    for _ in range(max_new_tokens - 1):
+        if eos_id is not None and bool(done.all()):
+            break
+        logits, cache = step(params, nxt[:, None], cache, config)
+        nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        if eos_id is not None:
+            # Per-row: once a row emitted EOS it keeps emitting EOS.
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        out.append(nxt)
+    return jnp.stack(out, axis=1)

@@ -119,9 +119,11 @@ def _enumerate_candidates(task: Task,
             # cluster; keep an explicitly chosen cloud.
             price = _CPU_VM_SPOT_PRICE_HOUR if res.use_spot \
                 else _CPU_VM_PRICE_HOUR
-            default_region = ('local' if res.cloud == 'local'
-                              else 'us-central1')
-            pinned = res.copy(cloud=res.cloud or 'gcp',
+            from skypilot_tpu import clouds
+            cloud_name = res.cloud or 'gcp'
+            default_region = clouds.from_name(
+                cloud_name).default_region()
+            pinned = res.copy(cloud=cloud_name,
                               region=res.region or default_region)
             if not _is_blocked(pinned, blocked):
                 out.append(_Candidate(pinned, price * task.num_nodes,

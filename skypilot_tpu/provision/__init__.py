@@ -15,15 +15,13 @@ from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
                                            ProvisionConfig,
                                            ProvisionRecord)
 
-_PROVIDERS = ('gcp', 'local')
-
-
 def _impl(provider: str):
-    if provider not in _PROVIDERS:
-        raise ValueError(f'Unknown provider {provider!r}; choose from '
-                         f'{_PROVIDERS}')
+    # The cloud registry owns the provider->module mapping, so a
+    # registered plugin cloud routes here without touching this file.
+    from skypilot_tpu import clouds
+    module = clouds.from_name(provider).provision_module
     return importlib.import_module(
-        f'skypilot_tpu.provision.{provider}.instance')
+        f'skypilot_tpu.provision.{module}.instance')
 
 
 def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:

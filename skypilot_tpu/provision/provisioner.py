@@ -18,7 +18,6 @@ has capacity.
 import dataclasses
 from typing import List, Optional, Set, Tuple
 
-from skypilot_tpu import catalog
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu import tpu_logging
@@ -35,6 +34,14 @@ def bulk_provision(config: ProvisionConfig) -> ProvisionRecord:
     config = provision.bootstrap_config(config)
     try:
         record = provision.run_instances(config)
+        # The record carries the CLOUD name, not the implementing
+        # provision module ('local' serves any registered cloud that
+        # reuses it — e.g. test/plugin clouds); all later dispatch
+        # (get_cluster_info, stop, terminate) goes through the cloud
+        # registry by this name.
+        if record.provider != config.provider:
+            record = dataclasses.replace(record,
+                                         provider=config.provider)
         provision.wait_instances(config.provider, config.region,
                                  config.cluster_name_on_cloud)
         # Only USER-requested ports are opened. The agent port is
@@ -79,26 +86,27 @@ class RetryingProvisioner:
     def _candidate_placements(
             self, to_provision: Resources
     ) -> List[Tuple[str, Optional[str]]]:
-        """(region, zone) pairs to try, cheapest region first."""
-        if to_provision.cloud == 'local' or \
-                to_provision.accelerator is None:
-            extra = getattr(to_provision, '_extra_config', None) or {}
-            if 'regions' in extra:  # test harness: fake region list
-                return [(r, None) for r in extra['regions']]
-            region = to_provision.region or 'local'
+        """(region, zone) pairs to try, cheapest region first —
+        enumeration delegated to the Cloud object (registry)."""
+        from skypilot_tpu import clouds
+        cloud = clouds.from_name(to_provision.cloud or 'gcp')
+        extra = getattr(to_provision, '_extra_config', None) or {}
+        if 'regions' in extra:  # test harness: fake region list
+            return [(r, None) for r in extra['regions']]
+        if cloud.is_local or to_provision.accelerator is None:
+            region = to_provision.region or cloud.default_region()
             return [(region, to_provision.zone)]
         accel = to_provision.accelerator
         if to_provision.region is not None:
             regions = [to_provision.region]
         else:
-            regions = catalog.get_regions(accel,
-                                          to_provision.use_spot)
+            regions = cloud.regions_for(accel, to_provision.use_spot)
         out: List[Tuple[str, Optional[str]]] = []
         for region in regions:
             if to_provision.zone is not None:
                 out.append((region, to_provision.zone))
                 continue
-            for zone in catalog.get_zones(accel, region):
+            for zone in cloud.zones_for(accel, region):
                 out.append((region, zone))
         return out
 

@@ -27,30 +27,28 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import decode, llama
 
     config = llama.get_config(args.model)
     params = llama.init_params(config, jax.random.PRNGKey(0))
 
-    @jax.jit
-    def next_token(params, tokens):
-        logits = llama.forward(params, tokens, config)
-        return logits[:, -1].argmax(-1)
-
     lock = threading.Lock()
 
     def generate(prompt_ids, max_new):
+        # KV-cache decode: prefill once, O(1) per token (was a full
+        # re-forward per token — O(T^2) per reply). jit caches one
+        # prefill executable per distinct prompt length plus one
+        # shared 1-token decode step; bucketing prompt lengths to
+        # bound compilations is the next optimization if needed.
         tokens = jnp.asarray([prompt_ids], jnp.int32)
-        out = []
+        max_new = min(max_new,
+                      config.max_seq_len - tokens.shape[1])
+        if max_new <= 0:
+            return []
         with lock:
-            for _ in range(max_new):
-                nxt = int(next_token(params, tokens)[0])
-                out.append(nxt)
-                tokens = jnp.concatenate(
-                    [tokens, jnp.asarray([[nxt]], jnp.int32)], axis=1)
-                if tokens.shape[1] >= config.max_seq_len:
-                    break
-        return out
+            out = decode.greedy_generate(params, tokens, config,
+                                         max_new_tokens=max_new)
+        return [int(t) for t in out[0]]
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = 'HTTP/1.1'

@@ -118,19 +118,20 @@ class Resources:
         self._accelerator = catalog.canonicalize(accelerators)
 
     def _validate(self) -> None:
-        if self._cloud is not None and self._cloud not in ('gcp',
-                                                           'local'):
-            raise exceptions.InvalidSpecError(
-                f'Unsupported cloud {self._cloud!r}; this framework is '
-                "TPU-native and currently supports 'gcp' (and 'local' "
-                'for the in-process fake provider).')
+        if self._cloud is not None:
+            from skypilot_tpu import clouds
+            if self._cloud not in clouds.CLOUD_REGISTRY:
+                raise exceptions.InvalidSpecError(
+                    f'Unsupported cloud {self._cloud!r}; registered '
+                    f'clouds: {sorted(clouds.CLOUD_REGISTRY)}')
         if self._spot_recovery not in SPOT_RECOVERY_STRATEGIES:
             raise exceptions.InvalidSpecError(
                 f'Invalid spot_recovery {self._spot_recovery!r}; choose '
                 f'from {SPOT_RECOVERY_STRATEGIES}')
         if self._accelerator is not None:
-            if self._cloud != 'local':
-                # Local fake provider accepts any region string.
+            from skypilot_tpu import clouds
+            if not clouds.from_name(self._cloud or 'gcp').is_local:
+                # Local-style providers accept any region string.
                 catalog.validate_region_zone(self._accelerator,
                                              self._region, self._zone)
             spec = self.tpu_spec

@@ -33,7 +33,9 @@ class ReplicaManager:
         self._launch_threads: Dict[int, threading.Thread] = {}
         # Local-provider port allocation: each replica gets its own
         # service port (one machine hosts all fake replicas).
-        self._is_local = any(r.cloud == 'local'
+        from skypilot_tpu import clouds
+        self._is_local = any(
+            clouds.from_name(r.cloud or 'gcp').is_local
                              for r in task.resources)
 
     # -- replica lifecycle ---------------------------------------------

@@ -1,0 +1,133 @@
+"""KV-cache decode tests: incremental forward == full forward.
+
+Ref: the reference serves via external engines (llm/vllm); this is
+the in-tree TPU-native decode path (models/decode.py) used by
+recipes/serve_model.py.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode, llama
+
+
+@pytest.fixture(scope='module')
+def setup():
+    config = llama.get_config('tiny')
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+class TestForwardCached:
+
+    def test_prefill_matches_full_forward(self, setup):
+        config, params = setup
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                    config.vocab_size)
+        full = llama.forward(params, tokens, config)
+        cache = decode.init_cache(config, 2, max_seq=32)
+        cached, cache = decode.forward_cached(params, tokens, cache,
+                                              config)
+        assert int(cache.pos) == 12
+        np.testing.assert_allclose(cached, full, rtol=2e-3, atol=2e-3)
+
+    def test_incremental_matches_full(self, setup):
+        """prefill(prompt) then 4 single-token steps == one full
+        forward over the whole sequence."""
+        config, params = setup
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0,
+                                    config.vocab_size)
+        full = llama.forward(params, tokens, config)
+
+        cache = decode.init_cache(config, 1, max_seq=32)
+        logits, cache = decode.forward_cached(params, tokens[:, :12],
+                                              cache, config)
+        step_logits = [logits[:, -1]]
+        for i in range(12, 16):
+            logits, cache = decode.forward_cached(
+                params, tokens[:, i:i + 1], cache, config)
+            step_logits.append(logits[:, -1])
+        # step_logits[k] is the prediction after consuming position
+        # 11+k, i.e. full[:, 11+k].
+        for k, sl in enumerate(step_logits):
+            np.testing.assert_allclose(sl, full[:, 11 + k], rtol=2e-3,
+                                       atol=2e-3)
+
+    def test_batch_decode(self, setup):
+        config, params = setup
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (3, 8), 0,
+                                    config.vocab_size)
+        full = llama.forward(params, tokens, config)
+        cache = decode.init_cache(config, 3, max_seq=16)
+        logits, cache = decode.forward_cached(params, tokens[:, :7],
+                                              cache, config)
+        logits2, _ = decode.forward_cached(params, tokens[:, 7:8],
+                                           cache, config)
+        np.testing.assert_allclose(logits2[:, -1], full[:, -1],
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestGreedyGenerate:
+
+    def test_deterministic_and_bounded(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out1 = decode.greedy_generate(params, prompt, config,
+                                      max_new_tokens=5, max_seq=16)
+        out2 = decode.greedy_generate(params, prompt, config,
+                                      max_new_tokens=5, max_seq=16)
+        assert out1.shape[1] <= 5
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_matches_naive_argmax_loop(self, setup):
+        """Greedy cached decode == greedy via full re-forward."""
+        config, params = setup
+        prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        out = decode.greedy_generate(params, prompt, config,
+                                     max_new_tokens=4, max_seq=16)
+        toks = prompt
+        naive = []
+        for _ in range(4):
+            logits = llama.forward(params, toks, config)
+            nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+            naive.append(int(nxt[0]))
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        assert list(np.asarray(out[0])) == naive
+
+
+class TestGenerateEdgeCases:
+
+    def test_zero_max_new_tokens(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[1, 2]], jnp.int32)
+        out = decode.greedy_generate(params, prompt, config,
+                                     max_new_tokens=0, max_seq=8)
+        assert out.shape == (1, 0)
+
+    def test_per_row_eos(self, setup):
+        """A row that hits EOS keeps emitting EOS while other rows
+        continue (no cross-row truncation)."""
+        config, params = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 4), 0,
+                                    config.vocab_size)
+        # Pick row 0's first greedy token as the 'EOS' so it stops
+        # immediately while row 1 (different prompt) continues.
+        first = decode.greedy_generate(params, prompt, config,
+                                       max_new_tokens=1, max_seq=16)
+        eos = int(first[0, 0])
+        out = decode.greedy_generate(params, prompt, config,
+                                     max_new_tokens=5, max_seq=16,
+                                     eos_id=eos)
+        assert all(int(t) == eos for t in out[0])
+        ref = decode.greedy_generate(params, prompt, config,
+                                     max_new_tokens=out.shape[1],
+                                     max_seq=16)
+        row1_ref = [int(t) for t in ref[1]]
+        row1_got = [int(t) for t in out[1]]
+        # Row 1 matches un-eos'd decoding until (if ever) IT emits
+        # the eos token.
+        for a, b in zip(row1_got, row1_ref):
+            assert a == b
+            if a == eos:
+                break
