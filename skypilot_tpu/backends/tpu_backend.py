@@ -312,6 +312,9 @@ class TpuBackend(Backend):
             'run_timestamp': run_timestamp,
             'task_name': task.name,
             'num_nodes': handle.num_hosts,
+            # Slice count for the multi-slice (DCN/megascale) env
+            # contract; hosts are rank-ordered slice-major.
+            'num_slices': getattr(handle, 'num_slices', 1) or 1,
             'hosts': [{'ip': h['ip'], 'agent_port': h['agent_port']}
                       for h in handle.hosts],
             # Head-side driver authenticates to worker agents with the

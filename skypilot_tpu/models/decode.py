@@ -91,10 +91,18 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
     b, t, _ = x.shape
     nh, nkv, hd = (config.n_heads, config.n_kv_heads, config.head_dim)
 
-    h = llama._rms_norm(x, layer_params['attn_norm'], config.norm_eps)
-    q = (h @ layer_params['wq']).reshape(b, t, nh, hd)
-    k = (h @ layer_params['wk']).reshape(b, t, nkv, hd)
-    v = (h @ layer_params['wv']).reshape(b, t, nkv, hd)
+    h = llama._rms_norm(x, layer_params['attn_norm'],
+                        config.norm_eps, config.norm_offset)
+    q = h @ layer_params['wq']
+    k = h @ layer_params['wk']
+    v = h @ layer_params['wv']
+    if config.qkv_bias:
+        q = q + layer_params['bq']
+        k = k + layer_params['bk']
+        v = v + layer_params['bv']
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nkv, hd)
+    v = v.reshape(b, t, nkv, hd)
     from skypilot_tpu.ops import attention as attention_ops
     q = attention_ops.apply_rope(q, angles)
     k = attention_ops.apply_rope(k, angles)
@@ -106,9 +114,11 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
                              kv_len=pos + t, scale=hd ** -0.5)
     x = x + attn.reshape(b, t, nh * hd) @ layer_params['wo']
 
-    h = llama._rms_norm(x, layer_params['mlp_norm'], config.norm_eps)
-    gate = jax.nn.silu((h @ layer_params['w_gate'])
-                       .astype(jnp.float32)).astype(h.dtype)
+    h = llama._rms_norm(x, layer_params['mlp_norm'],
+                        config.norm_eps, config.norm_offset)
+    gate = llama.mlp_act(config)(
+        (h @ layer_params['w_gate']).astype(jnp.float32)
+    ).astype(h.dtype)
     up = h @ layer_params['w_up']
     x = x + (gate * up) @ layer_params['w_down']
     return x, k_cache, v_cache
@@ -128,6 +138,9 @@ def forward_cached(params: Params, tokens: jax.Array,
     angles = llama._rope_frequencies(config, positions)
 
     x = cparams['embed'][tokens]
+    if config.scale_embeddings:
+        import math
+        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
 
     def body(carry, scanned):
         xc, pos = carry
@@ -138,8 +151,10 @@ def forward_cached(params: Params, tokens: jax.Array,
 
     (x, _), (new_k, new_v) = jax.lax.scan(
         body, (x, cache.pos), (cparams['layers'], cache.k, cache.v))
-    x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps)
-    logits = (x @ cparams['lm_head']).astype(jnp.float32)
+    x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
+                        config.norm_offset)
+    logits = (x @ llama.output_head(cparams, config)
+              ).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
 
 

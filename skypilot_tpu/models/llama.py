@@ -56,6 +56,22 @@ class LlamaConfig:
     # Frozen-base LoRA makes the saved activations pure speed: no
     # weight grads need them.
     remat_saves: str = 'attn'
+    # ---- family knobs (Gemma / Qwen / Mistral share the Llama block
+    # modulo these; same approach as MaxText's decoder config) ----
+    # Explicit head dim (Gemma: 256 with 8 heads at dim 2048);
+    # None -> dim // n_heads.
+    head_dim_override: Optional[int] = None
+    # MLP activation: 'silu' (Llama/Qwen/Mistral) or 'gelu_tanh'
+    # (Gemma's GeGLU).
+    mlp_activation: str = 'silu'
+    # Tie lm_head to embed^T (Gemma, Qwen2.5<=1.5B).
+    tie_embeddings: bool = False
+    # RMSNorm computes x * (1 + w) (Gemma's zero-centered weights).
+    norm_offset: bool = False
+    # Scale embeddings by sqrt(dim) after lookup (Gemma).
+    scale_embeddings: bool = False
+    # Bias on the q/k/v projections (Qwen2).
+    qkv_bias: bool = False
 
     def __post_init__(self):
         unknown = set(self.remat_saves.split('+')) - {
@@ -64,17 +80,26 @@ class LlamaConfig:
             raise ValueError(
                 f'unknown remat_saves token(s) {sorted(unknown)} in '
                 f'{self.remat_saves!r}; valid: attn, mlp, mlp_up, qkv')
+        if self.mlp_activation not in ('silu', 'gelu_tanh'):
+            raise ValueError(
+                f'unknown mlp_activation {self.mlp_activation!r}')
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.dim // self.n_heads
 
     def num_params(self) -> int:
         d, v, h = self.dim, self.vocab_size, self.ffn_hidden
+        nh, nkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
         per_layer = (
-            d * d + 2 * d * self.n_kv_heads * self.head_dim + d * d +
+            d * nh * hd + 2 * d * nkv * hd + nh * hd * d +
             3 * d * h + 2 * d)
-        return v * d * 2 + self.n_layers * per_layer + d
+        if self.qkv_bias:
+            per_layer += (nh + 2 * nkv) * hd
+        head = 0 if self.tie_embeddings else v * d
+        return v * d + head + self.n_layers * per_layer + d
 
 
 CONFIGS: Dict[str, LlamaConfig] = {
@@ -94,6 +119,32 @@ CONFIGS: Dict[str, LlamaConfig] = {
         name='llama2-7b', vocab_size=32000, dim=4096, n_layers=32,
         n_heads=32, n_kv_heads=32, ffn_hidden=11008,
         rope_theta=10000.0, max_seq_len=4096),
+    # Other families sharing the block (HF config.json values).
+    'gemma-2b': LlamaConfig(
+        name='gemma-2b', vocab_size=256000, dim=2048, n_layers=18,
+        n_heads=8, n_kv_heads=1, ffn_hidden=16384,
+        head_dim_override=256, rope_theta=10000.0, max_seq_len=8192,
+        mlp_activation='gelu_tanh', tie_embeddings=True,
+        norm_offset=True, scale_embeddings=True),
+    'gemma-7b': LlamaConfig(
+        name='gemma-7b', vocab_size=256000, dim=3072, n_layers=28,
+        n_heads=16, n_kv_heads=16, ffn_hidden=24576,
+        head_dim_override=256, rope_theta=10000.0, max_seq_len=8192,
+        mlp_activation='gelu_tanh', tie_embeddings=True,
+        norm_offset=True, scale_embeddings=True),
+    'qwen2.5-7b': LlamaConfig(
+        name='qwen2.5-7b', vocab_size=152064, dim=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, ffn_hidden=18944,
+        rope_theta=1000000.0, max_seq_len=32768, qkv_bias=True),
+    'qwen2.5-1.5b': LlamaConfig(
+        name='qwen2.5-1.5b', vocab_size=151936, dim=1536, n_layers=28,
+        n_heads=12, n_kv_heads=2, ffn_hidden=8960,
+        rope_theta=1000000.0, max_seq_len=32768, qkv_bias=True,
+        tie_embeddings=True),
+    'mistral-7b': LlamaConfig(
+        name='mistral-7b', vocab_size=32000, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_hidden=14336,
+        rope_theta=10000.0, max_seq_len=8192),
     # Small configs for tests / CPU dryruns.
     'debug-250m': LlamaConfig(
         name='debug-250m', vocab_size=32000, dim=1024, n_layers=8,
@@ -136,6 +187,12 @@ def init_params(config: LlamaConfig, key: jax.Array,
         return (jax.random.normal(key, shape, jnp.float32) *
                 scale).astype(dtype)
 
+    def norm_init(shape):
+        # norm_offset (Gemma): weights are zero-centered, applied as
+        # (1 + w) — init to zeros; plain RMSNorm inits to ones.
+        return (jnp.zeros(shape, dtype) if config.norm_offset
+                else jnp.ones(shape, dtype))
+
     ks = jax.random.split(k_layers, 7)
     params: Params = {
         'embed': dense(k_embed, (config.vocab_size, d), d),
@@ -147,12 +204,17 @@ def init_params(config: LlamaConfig, key: jax.Array,
             'w_gate': dense(ks[4], (L, d, ffn), d),
             'w_up': dense(ks[5], (L, d, ffn), d),
             'w_down': dense(ks[6], (L, ffn, d), ffn),
-            'attn_norm': jnp.ones((L, d), dtype),
-            'mlp_norm': jnp.ones((L, d), dtype),
+            'attn_norm': norm_init((L, d)),
+            'mlp_norm': norm_init((L, d)),
         },
-        'final_norm': jnp.ones((d,), dtype),
-        'lm_head': dense(k_out, (d, config.vocab_size), d),
+        'final_norm': norm_init((d,)),
     }
+    if config.qkv_bias:
+        params['layers']['bq'] = jnp.zeros((L, nh * hd), dtype)
+        params['layers']['bk'] = jnp.zeros((L, nkv * hd), dtype)
+        params['layers']['bv'] = jnp.zeros((L, nkv * hd), dtype)
+    if not config.tie_embeddings:
+        params['lm_head'] = dense(k_out, (d, config.vocab_size), d)
     return params
 
 
@@ -162,8 +224,7 @@ def param_sharding_rules(config: LlamaConfig) -> Params:
     TP shards heads / ffn-hidden / vocab; FSDP shards the other big
     axis (ZeRO-3). The scan-stacked layer axis stays replicated.
     """
-    del config
-    return {
+    rules = {
         'embed': P('tp', 'fsdp'),
         'layers': {
             'wq': P(None, 'fsdp', 'tp'),
@@ -177,8 +238,14 @@ def param_sharding_rules(config: LlamaConfig) -> Params:
             'mlp_norm': P(None, None),
         },
         'final_norm': P(None),
-        'lm_head': P('fsdp', 'tp'),
     }
+    if config.qkv_bias:
+        rules['layers']['bq'] = P(None, 'tp')
+        rules['layers']['bk'] = P(None, 'tp')
+        rules['layers']['bv'] = P(None, 'tp')
+    if not config.tie_embeddings:
+        rules['lm_head'] = P('fsdp', 'tp')
+    return rules
 
 
 # ---------------------------------------------------------------------
@@ -186,11 +253,15 @@ def param_sharding_rules(config: LlamaConfig) -> Params:
 # ---------------------------------------------------------------------
 
 
-def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+              offset: bool = False) -> jax.Array:
     xf = x.astype(jnp.float32)
     norm = xf * jax.lax.rsqrt(
         jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (norm * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w  # Gemma's zero-centered norm weights
+    return (norm * w).astype(x.dtype)
 
 
 def _rope_frequencies(config: LlamaConfig, positions: jax.Array
@@ -214,6 +285,15 @@ def _rope_frequencies(config: LlamaConfig, positions: jax.Array
     return positions.astype(jnp.float32)[:, None] * freqs[None, :]
 
 
+def mlp_act(config: LlamaConfig):
+    """The family's gated-MLP activation (single source of truth —
+    llama._layer and decode._layer_cached both use it; the valid set
+    is enforced in LlamaConfig.__post_init__)."""
+    if config.mlp_activation == 'silu':
+        return jax.nn.silu
+    return functools.partial(jax.nn.gelu, approximate=True)
+
+
 def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
            angles: jax.Array, attn_impl,
            lora_params: Optional[Params] = None,
@@ -221,10 +301,18 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     b, t, d = x.shape
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
-    h = _rms_norm(x, layer_params['attn_norm'], config.norm_eps)
-    q = (h @ layer_params['wq']).reshape(b, t, nh, hd)
-    k = (h @ layer_params['wk']).reshape(b, t, nkv, hd)
-    v = (h @ layer_params['wv']).reshape(b, t, nkv, hd)
+    h = _rms_norm(x, layer_params['attn_norm'], config.norm_eps,
+                  config.norm_offset)
+    q = h @ layer_params['wq']
+    k = h @ layer_params['wk']
+    v = h @ layer_params['wv']
+    if config.qkv_bias:
+        q = q + layer_params['bq']
+        k = k + layer_params['bk']
+        v = v + layer_params['bv']
+    q = q.reshape(b, t, nh, hd)
+    k = k.reshape(b, t, nkv, hd)
+    v = v.reshape(b, t, nkv, hd)
     if lora_params is not None:
         # LoRA on q/v projections (torchtune's default target set for
         # the reference recipe llm/llama-3_1-finetuning/lora.yaml).
@@ -245,15 +333,16 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     attn = attn.reshape(b, t, nh * hd)
     x = x + attn @ layer_params['wo']
 
-    h = _rms_norm(x, layer_params['mlp_norm'], config.norm_eps)
-    # Save the PRE-silu gate (silu-backward needs it anyway) and up:
+    h = _rms_norm(x, layer_params['mlp_norm'], config.norm_eps,
+                  config.norm_offset)
+    # Save the PRE-activation gate (its backward needs it anyway) and up:
     # with these two named values kept, backward recomputes only
     # elementwise ops here, not the two [d, ffn] matmuls. Separate
     # names so remat_saves can keep just one of them when HBM is
     # tight.
     g_pre = checkpoint_name(h @ layer_params['w_gate'], 'mlp_gate')
     up = checkpoint_name(h @ layer_params['w_up'], 'mlp_up')
-    gate = jax.nn.silu(g_pre.astype(jnp.float32)).astype(h.dtype)
+    gate = mlp_act(config)(g_pre.astype(jnp.float32)).astype(h.dtype)
     x = x + (gate * up) @ layer_params['w_down']
     return x
 
@@ -290,6 +379,8 @@ def forward_hidden(params: Params, tokens: jax.Array,
     cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
 
     x = cparams['embed'][tokens]  # [B, T, D] gather
+    if config.scale_embeddings:
+        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
     if activation_sharding is not None:
         x = jax.lax.with_sharding_constraint(x, activation_sharding)
 
@@ -324,7 +415,17 @@ def forward_hidden(params: Params, tokens: jax.Array,
         clora = jax.tree.map(lambda p: p.astype(config.dtype), lora)
     x, _ = jax.lax.scan(body, x, (cparams['layers'], clora))
 
-    return _rms_norm(x, cparams['final_norm'], config.norm_eps)
+    return _rms_norm(x, cparams['final_norm'], config.norm_eps,
+                     config.norm_offset)
+
+
+def output_head(params: Params, config: LlamaConfig) -> jax.Array:
+    """[D, V] output projection — the transposed embedding when the
+    config ties them (Gemma, small Qwen; gradients flow back to the
+    embedding through the transpose)."""
+    if config.tie_embeddings:
+        return params['embed'].astype(config.dtype).T
+    return params['lm_head'].astype(config.dtype)
 
 
 def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
@@ -335,8 +436,7 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
     """tokens [B, T] int32 -> logits [B, T, vocab] (fp32)."""
     x = forward_hidden(params, tokens, config, positions, attn_impl,
                        lora, lora_scale)
-    lm_head = params['lm_head'].astype(config.dtype)
-    return (x @ lm_head).astype(jnp.float32)
+    return (x @ output_head(params, config)).astype(jnp.float32)
 
 
 def _ce_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -468,7 +568,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     # *target* token i+1 is unmasked.
     mask = (jnp.ones_like(targets, jnp.float32) if mask is None
             else mask.astype(jnp.float32)[:, 1:])
-    lm_head = params['lm_head'].astype(config.dtype)
+    lm_head = output_head(params, config)
 
     b, t, d = hidden.shape
     chunk = LOSS_CHUNK if t % LOSS_CHUNK == 0 else t

@@ -36,14 +36,30 @@ class MeshConfig:
                 'sp': self.sp}
 
 
+def num_slices_from_env() -> int:
+    """Slice count from the runtime's env contract
+    (SKYTPU_NUM_SLICES, set by the gang driver for multi-slice jobs;
+    1 otherwise)."""
+    import os
+    return int(os.environ.get('SKYTPU_NUM_SLICES', '1'))
+
+
 def auto_mesh_config(n_devices: Optional[int] = None,
                      tp: int = 1, sp: int = 1,
-                     dp: int = 1) -> MeshConfig:
+                     dp: int = 1,
+                     num_slices: int = 1) -> MeshConfig:
     """Default strategy: everything not claimed by tp/sp/dp goes to
     fsdp (ZeRO-3 weight sharding is the memory-optimal default for
-    8B-class models on v5e/v6e)."""
+    8B-class models on v5e/v6e).
+
+    ``num_slices`` > 1: dp is raised to (a multiple of) the slice
+    count so the cross-DCN axis exists — only pure-DP gradient
+    all-reduces may cross slices.
+    """
     if n_devices is None:
         n_devices = len(jax.devices())
+    if num_slices > 1 and dp % num_slices != 0:
+        dp = dp * num_slices
     claimed = tp * sp * dp
     if n_devices % claimed != 0:
         raise ValueError(
@@ -53,10 +69,21 @@ def auto_mesh_config(n_devices: Optional[int] = None,
 
 
 def make_mesh(config: Optional[MeshConfig] = None,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+              devices: Optional[Sequence[jax.Device]] = None,
+              num_slices: int = 1) -> Mesh:
     """Build the Mesh. Device order: JAX's default device list already
     reflects ICI topology on TPU (hosts enumerate their local chips in
-    torus order), so a reshape keeps tp/sp on-slice."""
+    torus order), so a reshape keeps tp/sp on-slice.
+
+    ``num_slices`` > 1 (multi-slice / DCN): the ``dp`` axis must span
+    slices so only pure-data-parallel gradient all-reduces cross DCN
+    while fsdp/tp/sp collectives stay on ICI (the scaling-book
+    layout). Uses ``mesh_utils.create_hybrid_device_mesh`` (groups by
+    ``device.slice_index``) when the runtime exposes slice indices;
+    falls back to a slice-major reshape otherwise (CPU test meshes —
+    JAX enumerates devices process-major, which IS slice-major under
+    the runtime's slice-major host ranks).
+    """
     if devices is None:
         devices = jax.devices()
     if config is None:
@@ -65,6 +92,22 @@ def make_mesh(config: Optional[MeshConfig] = None,
         raise ValueError(
             f'Mesh needs {config.num_devices} devices, got '
             f'{len(devices)}')
+    if num_slices > 1:
+        if config.dp % num_slices != 0:
+            raise ValueError(
+                f'dp={config.dp} must be a multiple of num_slices='
+                f'{num_slices}: dp is the only axis whose collectives '
+                'may cross DCN')
+        if any(getattr(d, 'slice_index', None) is not None
+               for d in devices):
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                # per-slice (ICI) shape x cross-slice (DCN) shape.
+                (config.dp // num_slices, config.fsdp, config.tp,
+                 config.sp),
+                (num_slices, 1, 1, 1),
+                devices=devices)
+            return Mesh(arr, AXES)
     arr = np.asarray(devices).reshape(config.dp, config.fsdp,
                                       config.tp, config.sp)
     return Mesh(arr, AXES)

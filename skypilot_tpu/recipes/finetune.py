@@ -81,11 +81,17 @@ def main():
     from skypilot_tpu.parallel.train import default_optimizer
 
     config = llama.get_config(args.model, max_seq_len=args.seq)
-    mesh_cfg = auto_mesh_config(tp=args.tp, dp=args.dp)
-    mesh = make_mesh(mesh_cfg)
+    # Multi-slice jobs (SKYTPU_NUM_SLICES from the gang driver) get
+    # the hybrid mesh: dp spans slices so only its gradient
+    # all-reduce crosses DCN; fsdp/tp/sp collectives stay on ICI.
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    num_slices = mesh_lib.num_slices_from_env()
+    mesh_cfg = auto_mesh_config(tp=args.tp, dp=args.dp,
+                                num_slices=num_slices)
+    mesh = make_mesh(mesh_cfg, num_slices=num_slices)
     if jax.process_index() == 0:
         print(f'devices={jax.device_count()} mesh={mesh_cfg} '
-              f'model={args.model} '
+              f'slices={num_slices} model={args.model} '
               f'params={config.num_params() / 1e9:.2f}B')
 
     param_dtype = jnp.bfloat16 if args.param_dtype == 'bf16' \

@@ -101,7 +101,10 @@ def run_job(job_id: int) -> job_lib.JobStatus:
         env = env_contract.build_env(
             rank, ips,
             num_chips_per_node=spec.get('num_chips_per_node', 0),
-            task_id=task_id)
+            task_id=task_id,
+            # Multi-slice runs additionally get the megascale DCN
+            # contract (hosts are rank-ordered slice-major).
+            num_slices=spec.get('num_slices') or 1)
         env.update(spec.get('envs') or {})
         proc_id = client.run(spec['run_cmd'],
                              log_path=_remote_log_path(spec, rank),

@@ -20,14 +20,27 @@ ENV_COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'
 ENV_NUM_CHIPS_PER_NODE = 'SKYTPU_NUM_CHIPS_PER_NODE'
 ENV_TASK_ID = 'SKYTPU_TASK_ID'
 ENV_CLUSTER_INFO = 'SKYTPU_CLUSTER_INFO'
+ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
+ENV_SLICE_ID = 'SKYTPU_SLICE_ID'
+# libtpu's multi-slice (DCN) contract: with these set, intra-slice
+# collectives ride ICI and cross-slice ones ride DCN through the
+# megascale transport. jax.distributed still spans ALL hosts of ALL
+# slices (one global process group).
+MEGASCALE_PORT = 8477
 
 
 def build_env(node_rank: int, node_ips: List[str],
               num_chips_per_node: int = 0,
               task_id: Optional[str] = None,
-              coordinator_port: int = COORDINATOR_PORT
+              coordinator_port: int = COORDINATOR_PORT,
+              num_slices: int = 1
               ) -> Dict[str, str]:
-    """Env for one task process on host ``node_rank``."""
+    """Env for one task process on host ``node_rank``.
+
+    ``num_slices`` > 1: hosts are rank-ordered slice-major
+    (len(node_ips) % num_slices == 0), host 0 of slice 0 is both the
+    JAX coordinator and the megascale coordinator.
+    """
     ips_str = '\n'.join(node_ips)
     coordinator = f'{node_ips[0]}:{coordinator_port}'
     env = {
@@ -45,6 +58,20 @@ def build_env(node_rank: int, node_ips: List[str],
         'SKYPILOT_NODE_IPS': ips_str,
         'SKYPILOT_NUM_GPUS_PER_NODE': str(num_chips_per_node),
     }
+    if num_slices > 1:
+        if len(node_ips) % num_slices != 0:
+            raise ValueError(
+                f'{len(node_ips)} hosts not divisible by '
+                f'num_slices={num_slices}; slice ids would be wrong')
+        hosts_per_slice = len(node_ips) // num_slices
+        slice_id = node_rank // hosts_per_slice
+        env[ENV_NUM_SLICES] = str(num_slices)
+        env[ENV_SLICE_ID] = str(slice_id)
+        env['MEGASCALE_NUM_SLICES'] = str(num_slices)
+        env['MEGASCALE_SLICE_ID'] = str(slice_id)
+        env['MEGASCALE_COORDINATOR_ADDRESS'] = \
+            f'{node_ips[0]}:{MEGASCALE_PORT}'
+        env['MEGASCALE_PORT'] = str(MEGASCALE_PORT)
     if task_id is not None:
         env[ENV_TASK_ID] = env['SKYPILOT_TASK_ID'] = task_id
     return env

@@ -383,3 +383,86 @@ class TestLlama:
 def test_remat_saves_unknown_token_raises():
     with pytest.raises(ValueError, match='remat_saves'):
         llama.get_config('tiny', remat_saves='attn+mlpup')
+
+
+class TestModelFamilies:
+    """Family knobs generalizing the block (Gemma / Qwen / Mistral;
+    MaxText-style decoder config). Each knob is exercised on a tiny
+    config; real-size configs are shape-checked."""
+
+    def _tiny(self, **kw):
+        return llama.get_config('tiny', **kw)
+
+    @pytest.mark.parametrize('kw', [
+        dict(mlp_activation='gelu_tanh'),
+        dict(tie_embeddings=True),
+        dict(norm_offset=True),
+        dict(scale_embeddings=True),
+        dict(qkv_bias=True),
+        dict(head_dim_override=64),
+        # The full Gemma combination.
+        dict(mlp_activation='gelu_tanh', tie_embeddings=True,
+             norm_offset=True, scale_embeddings=True,
+             head_dim_override=64, n_kv_heads=1),
+    ])
+    def test_forward_loss_grads(self, kw):
+        cfg = self._tiny(**kw)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0,
+                                    cfg.vocab_size)
+        logits = llama.forward(params, tokens[:, :-1], cfg)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, {'tokens': tokens}, cfg)
+        assert float(loss) > 0
+        flat = jax.tree.leaves(
+            jax.tree.map(lambda g: float(jnp.abs(g).max()), grads))
+        assert any(v > 0 for v in flat)
+
+    def test_tied_embeddings_have_no_lm_head_and_get_head_grads(self):
+        cfg = self._tiny(tie_embeddings=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        assert 'lm_head' not in params
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 9), 0,
+                                    cfg.vocab_size)
+        _, grads = jax.value_and_grad(llama.loss_fn)(
+            params, {'tokens': tokens}, cfg)
+        # Head gradient flows into the embedding through the tie.
+        assert float(jnp.abs(grads['embed']).max()) > 0
+
+    def test_family_decode_matches_forward(self):
+        from skypilot_tpu.models import decode
+        cfg = self._tiny(mlp_activation='gelu_tanh',
+                         tie_embeddings=True, norm_offset=True,
+                         scale_embeddings=True, qkv_bias=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(3))
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (1, 12), 0,
+                                    cfg.vocab_size)
+        full = llama.forward(params, tokens, cfg)
+        cache = decode.init_cache(cfg, 1, max_seq=16)
+        logits, cache = decode.forward_cached(params, tokens[:, :8],
+                                              cache, cfg)
+        for i in range(8, 12):
+            logits, cache = decode.forward_cached(
+                params, tokens[:, i:i + 1], cache, cfg)
+        np.testing.assert_allclose(logits[:, -1], full[:, -1],
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_sharding_rules_cover_family_params(self):
+        for kw in (dict(qkv_bias=True), dict(tie_embeddings=True)):
+            cfg = self._tiny(**kw)
+            params = llama.init_params(cfg, jax.random.PRNGKey(0))
+            rules = llama.param_sharding_rules(cfg)
+            p_paths = {jax.tree_util.keystr(k) for k, _ in
+                       jax.tree_util.tree_flatten_with_path(params)[0]}
+            r_paths = {jax.tree_util.keystr(k) for k, _ in
+                       jax.tree_util.tree_flatten_with_path(rules)[0]}
+            assert p_paths == r_paths, (kw, p_paths ^ r_paths)
+
+    @pytest.mark.parametrize('name,expected_b', [
+        ('gemma-2b', 2.5e9), ('qwen2.5-7b', 7.6e9),
+        ('mistral-7b', 7.2e9), ('qwen2.5-1.5b', 1.5e9),
+    ])
+    def test_real_config_param_counts(self, name, expected_b):
+        n = llama.get_config(name).num_params()
+        assert 0.8 * expected_b < n < 1.25 * expected_b, (name, n)

@@ -140,3 +140,47 @@ class TestSequenceParallel:
                                       tiny_config, n_steps=4,
                                       lora_rank=4)
         assert losses[-1] < losses[0], losses
+
+
+class TestMultiSlice:
+    """Multi-slice (DCN) support: megascale env contract + hybrid
+    mesh (SURVEY 2.11-2.12: multi-slice = k slices x barrier at JAX
+    init; dp is the only axis whose collectives cross DCN)."""
+
+    def test_env_contract_single_slice_has_no_megascale(self):
+        from skypilot_tpu.runtime import env_contract
+        env = env_contract.build_env(0, ['10.0.0.1', '10.0.0.2'])
+        assert 'MEGASCALE_NUM_SLICES' not in env
+
+    def test_env_contract_multislice(self):
+        from skypilot_tpu.runtime import env_contract
+        ips = ['10.0.0.1', '10.0.0.2', '10.0.1.1', '10.0.1.2']
+        env = env_contract.build_env(2, ips, num_slices=2)
+        # Host rank 2 is host 0 of slice 1 (slice-major ranks).
+        assert env['SKYTPU_SLICE_ID'] == '1'
+        assert env['SKYTPU_NUM_SLICES'] == '2'
+        assert env['MEGASCALE_SLICE_ID'] == '1'
+        assert env['MEGASCALE_NUM_SLICES'] == '2'
+        assert env['MEGASCALE_COORDINATOR_ADDRESS'].startswith(
+            '10.0.0.1:')
+        # jax.distributed still spans ALL hosts.
+        assert env['SKYTPU_NUM_NODES'] == '4'
+        assert env['SKYTPU_COORDINATOR_ADDRESS'].startswith(
+            '10.0.0.1:')
+
+    def test_hybrid_mesh_builds_and_trains(self, tiny_config):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2),
+                         num_slices=2)
+        assert mesh.shape['dp'] == 2
+        state, shardings = init_train_state(tiny_config, mesh,
+                                            jax.random.PRNGKey(0))
+        step = build_train_step(tiny_config, mesh, shardings)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                    tiny_config.vocab_size,
+                                    dtype=jnp.int32)
+        _, metrics = step(state, {'tokens': tokens})
+        assert float(metrics['loss']) > 0
+
+    def test_dp_must_divide_by_slices(self):
+        with pytest.raises(ValueError, match='num_slices'):
+            make_mesh(MeshConfig(dp=1, fsdp=8), num_slices=2)
