@@ -91,3 +91,20 @@ class TestNorthStar8B:
         per_dev = _per_device_state_bytes(state_shape, shardings)
         # bf16 params (16G) + f32 mu+nu (64G) sharded 8 ways ≈ 10G.
         assert per_dev < 14 * 1024 ** 3, f'{per_dev / 1e9:.1f} GB'
+
+
+class TestFamilyNorthStar:
+    """The 7B-class family configs lower and fit too — same
+    validation as the 8B north star, once per family."""
+
+    @pytest.mark.parametrize('name', ['gemma-7b', 'qwen2.5-7b',
+                                      'mistral-7b'])
+    def test_7b_family_lowers_and_fits_v5p(self, name):
+        config = llama.get_config(name, max_seq_len=2048)
+        mesh = make_mesh(MeshConfig(fsdp=8))
+        lowered, state_shape, shardings = _lower_train_step(
+            config, mesh, lora_rank=16, batch=16, seq=2048)
+        assert lowered.as_text()
+        per_dev = _per_device_state_bytes(state_shape, shardings)
+        assert per_dev < V5P_HBM_BYTES, (
+            f'{name}: {per_dev / 1e9:.1f} GB per device')
