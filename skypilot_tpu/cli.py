@@ -395,8 +395,28 @@ def serve_up(entrypoint, env, accelerator, num_nodes, use_spot,
                       f'{service_name or task.name or "<unnamed>"}?',
                       default=True, abort=True)
     endpoint = serve_core.up(task, service_name)
-    click.echo(f'Service {service_name or task.name} at '
-               f'http://{endpoint}')
+    click.echo(f'Service {service_name or task.name} at {endpoint}')
+
+
+@serve_group.command(name='update')
+@click.argument('service_name')
+@click.argument('entrypoint', nargs=-1, required=True)
+@_apply(_task_options)
+@click.option('--yes', '-y', is_flag=True)
+def serve_update(service_name, entrypoint, env, accelerator,
+                 num_nodes, use_spot, workdir, name, yes):
+    """Rolling update to a new task version (analog of
+    ``sky serve update``, sky/cli.py:4302): new replicas come up,
+    old ones drain once the new version is READY — the endpoint
+    keeps serving throughout."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _task_from_entrypoint(entrypoint, env, accelerator,
+                                 num_nodes, use_spot, workdir, name)
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Update service {service_name}?', default=True,
+                      abort=True)
+    version = serve_core.update(service_name, task)
+    click.echo(f'Service {service_name} updating to v{version}.')
 
 
 @serve_group.command(name='down')

@@ -106,6 +106,9 @@ class JobsController:
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
 
+        max_restarts = next(
+            iter(task.resources)).max_restarts_on_errors
+        restarts_on_errors = 0
         recoveries = 0
         while True:
             if jobs_state.cancel_requested(self.job_id):
@@ -149,9 +152,27 @@ class JobsController:
                 return jobs_state.ManagedJobStatus.SUCCEEDED
             if status in (job_lib.JobStatus.FAILED,
                           job_lib.JobStatus.FAILED_SETUP):
-                # User-code failure: no recovery (reference
-                # distinguishes preemption vs user failure the same
-                # way).
+                # User-code failure (not preemption). With a
+                # max_restarts_on_errors budget, resubmit on the
+                # still-alive cluster (reference
+                # ``recovery_strategy.py:376``
+                # should_restart_on_failure); otherwise fail.
+                if (status == job_lib.JobStatus.FAILED and
+                        restarts_on_errors < max_restarts):
+                    restarts_on_errors += 1
+                    logger.warning(
+                        'Task %d failed (user code); restart %d/%d '
+                        'on %s', idx, restarts_on_errors,
+                        max_restarts, cluster_name)
+                    jobs_state.set_status(
+                        self.job_id,
+                        jobs_state.ManagedJobStatus.RECOVERING)
+                    job_id = strategy.launch(task, cluster_name)
+                    if job_id is not None:
+                        jobs_state.set_status(
+                            self.job_id,
+                            jobs_state.ManagedJobStatus.RUNNING)
+                        continue
                 strategy.terminate_cluster(cluster_name)
                 return (jobs_state.ManagedJobStatus.FAILED_SETUP
                         if status == job_lib.JobStatus.FAILED_SETUP

@@ -71,9 +71,18 @@ class Resources:
         image_id: Optional[str] = None,
         ports: Optional[List[Union[int, str]]] = None,
         labels: Optional[Dict[str, str]] = None,
-        job_recovery: Optional[str] = None,
+        job_recovery: Union[None, str, Dict[str, Any]] = None,
         _validate: bool = True,
     ):
+        # job_recovery accepts the reference's dict form
+        # ({strategy: ..., max_restarts_on_errors: N},
+        # sky/resources.py job_recovery) or a bare strategy name.
+        self._max_restarts_on_errors = 0
+        if isinstance(job_recovery, dict):
+            job_recovery = dict(job_recovery)
+            self._max_restarts_on_errors = int(
+                job_recovery.pop('max_restarts_on_errors', 0) or 0)
+            job_recovery = job_recovery.pop('strategy', None)
         self._cloud = cloud.lower() if cloud else None
         self._accelerator: Optional[str] = None
         self._set_accelerators(accelerators)
@@ -189,6 +198,13 @@ class Resources:
         return self._spot_recovery
 
     @property
+    def max_restarts_on_errors(self) -> int:
+        """User-code-failure restart budget for managed jobs
+        (reference ``recovery_strategy.py:376``
+        should_restart_on_failure; 0 = fail immediately)."""
+        return self._max_restarts_on_errors
+
+    @property
     def disk_size(self) -> int:
         return self._disk_size
 
@@ -276,6 +292,11 @@ class Resources:
             ports=self._ports,
             labels=self._labels,
         )
+        if self._max_restarts_on_errors:
+            fields['job_recovery'] = {
+                'strategy': self._spot_recovery,
+                'max_restarts_on_errors': self._max_restarts_on_errors,
+            }
         fields.update(override)
         new = Resources(**fields)
         # Provider-specific extras (e.g. the local fake provider's
@@ -396,6 +417,12 @@ class Resources:
             out['ports'] = self._ports
         if self._labels:
             out['labels'] = self._labels
+        if self._max_restarts_on_errors:
+            out['job_recovery'] = {
+                'strategy': self._spot_recovery,
+                'max_restarts_on_errors': self._max_restarts_on_errors,
+            }
+            out.pop('spot_recovery', None)
         return out
 
     def __repr__(self) -> str:

@@ -79,9 +79,13 @@ class _ProcTable:
         self._lock = threading.Lock()
         self._procs: Dict[int, subprocess.Popen] = {}
         self._next = 1
+        self._shutdown = False
 
     def start(self, cmd: str, log_path: str, env: Dict[str, str],
               cwd: str) -> int:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError('agent shutting down')
         log_path = os.path.expanduser(log_path)
         os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
         full_env = dict(os.environ)
@@ -99,6 +103,13 @@ class _ProcTable:
             proc_id = self._next
             self._next += 1
             self._procs[proc_id] = proc
+            if self._shutdown:
+                # SIGTERM landed while we were spawning: this
+                # process was invisible to the sweep — kill it here.
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
         return proc_id
 
     def status(self, proc_id: int, wait: float = 0.0):
@@ -132,6 +143,22 @@ class _ProcTable:
             except (ProcessLookupError, PermissionError):
                 pass
         return True
+
+    def kill_all(self) -> None:
+        """Kill every tracked process group. Task processes run in
+        their OWN sessions (start_new_session), so killing the agent
+        does not reach them — the agent's SIGTERM handler calls this
+        so teardown never leaks task processes (e.g. replica servers
+        still bound to their ports after ``down``)."""
+        with self._lock:
+            self._shutdown = True
+            procs = list(self._procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
 
 
 _procs = _ProcTable()
@@ -234,6 +261,21 @@ def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0',
     global _token
     if token is not None:
         _token = token
+
+    def _terminate(_signum, _frame):
+        # Two sweeps around a short grace: the first sets the
+        # shutdown flag (new /run requests are refused; mid-spawn
+        # ones self-kill on registration), the grace lets in-flight
+        # Popen calls reach registration, the second catches any
+        # stragglers. Without this, a process spawned between Popen
+        # and registration would survive os._exit.
+        import time as time_mod
+        _procs.kill_all()
+        time_mod.sleep(0.25)
+        _procs.kill_all()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _terminate)
     server = ThreadingHTTPServer((host, port), _Handler)
     server.serve_forever()
 

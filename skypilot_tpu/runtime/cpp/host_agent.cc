@@ -236,6 +236,7 @@ class ProcTable {
       _exit(127);
     }
     std::lock_guard<std::mutex> lock(mu_);
+    if (shutdown_) kill(-pid, SIGTERM);  // SIGTERM raced the spawn
     int id = next_id_++;
     procs_[id] = ProcEntry{pid, false, -1};
     return id;
@@ -259,6 +260,22 @@ class ProcTable {
     Reap(&it->second);
     if (!it->second.exited) kill(-it->second.pid, SIGTERM);
     return true;
+  }
+
+  // Task processes run in their own sessions (setsid in Start), so
+  // killing the agent's group does not reach them — the shutdown
+  // path sweeps them explicitly so teardown never leaks task
+  // processes. Called from the MAIN thread (not the signal handler
+  // — the handler only sets a flag and closes the listen fd), so
+  // taking the mutex is safe. Also flips shutdown_: a Start racing
+  // the sweep kills its own fresh process on registration.
+  void KillAll() {
+    std::lock_guard<std::mutex> lock(mu_);
+    shutdown_ = true;
+    for (auto& kv : procs_) {
+      Reap(&kv.second);
+      if (!kv.second.exited) kill(-kv.second.pid, SIGTERM);
+    }
   }
 
   static std::string Expand(const std::string& path) {
@@ -296,9 +313,12 @@ class ProcTable {
   std::mutex mu_;
   std::map<int, ProcEntry> procs_;
   int next_id_ = 1;
+  bool shutdown_ = false;
 };
 
 ProcTable g_procs;
+volatile sig_atomic_t g_stop = 0;
+int g_listen_fd = -1;
 
 // Blocking exec with timeout; captures combined output.
 int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output) {
@@ -619,6 +639,14 @@ int main(int argc, char** argv) {
 
   int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd < 0) { perror("socket"); return 1; }
+  // SIGTERM: the handler does only async-signal-safe work (set a
+  // flag, close the listen fd); the accept loop notices and runs the
+  // lock-guarded process sweep from the main thread.
+  g_listen_fd = listen_fd;
+  signal(SIGTERM, [](int) {
+    g_stop = 1;
+    close(g_listen_fd);
+  });
   int one = 1;
   setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
@@ -634,7 +662,17 @@ int main(int argc, char** argv) {
                port);
   while (true) {
     int fd = accept(listen_fd, nullptr, nullptr);
-    if (fd < 0) continue;
+    if (fd < 0) {
+      if (g_stop) break;
+      continue;
+    }
     std::thread(HandleConnection, fd).detach();
   }
+  // Two sweeps around a short grace so a fork in flight on a
+  // connection thread reaches registration (where Start self-kills
+  // under the shutdown flag) before the process exits.
+  g_procs.KillAll();
+  usleep(250000);
+  g_procs.KillAll();
+  return 0;
 }

@@ -1,7 +1,8 @@
 """Serving: autoscaled inference replicas behind a load balancer
 (analog of ``sky/serve/`` SkyServe)."""
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
-from skypilot_tpu.serve.core import down, status, terminate_replica, up
+from skypilot_tpu.serve.core import (down, status,
+                                     terminate_replica, up, update)
 
 __all__ = ['SkyServiceSpec', 'down', 'status', 'terminate_replica',
-           'up']
+           'up', 'update']

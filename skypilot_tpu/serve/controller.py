@@ -36,6 +36,7 @@ class SkyServeController:
         self.autoscaler = make_autoscaler(self.spec)
         self.load_balancer = SkyServeLoadBalancer(
             lb_port, self.replica_manager.ready_endpoints)
+        self.version = 1
         self._stop = threading.Event()
 
     def start(self) -> None:
@@ -51,11 +52,83 @@ class SkyServeController:
     def stop(self) -> None:
         self._stop.set()
 
+    def _check_for_update(self) -> None:
+        """Pick up a rolling-update request (serve.core.update bumps
+        target_version + writes the new task yaml). New replicas
+        launch at the new version; old ones drain in run_once."""
+        rec = serve_state.get_service(self.service_name)
+        if rec is None or rec['target_version'] <= self.version:
+            return
+        yaml_path = rec['target_task_yaml']
+        if not yaml_path or not os.path.exists(yaml_path):
+            logger.error('update to v%d requested but task yaml %r '
+                         'missing', rec['target_version'], yaml_path)
+            return
+        from skypilot_tpu.utils import common_utils
+        new_task = Task.from_yaml_config(
+            common_utils.read_yaml(yaml_path))
+        if new_task.service is None:
+            logger.error('update task yaml has no service section; '
+                         'ignoring')
+            return
+        logger.info('Rolling update %s: v%d -> v%d',
+                    self.service_name, self.version,
+                    rec['target_version'])
+        self.version = rec['target_version']
+        self.spec = new_task.service
+        self.replica_manager.set_task(new_task, self.version)
+        # Carry scaling state across the update: a service scaled to
+        # N under load must come up with N new-version replicas, not
+        # collapse to min_replicas.
+        old_target = self.autoscaler.target_num_replicas
+        self.autoscaler = make_autoscaler(self.spec)
+        self.autoscaler.target_num_replicas = max(
+            min(old_target, self.spec.max_replicas
+                or old_target), self.spec.min_replicas)
+
     def run_once(self) -> None:
         """One control tick: probe replicas, feed QPS to the
         autoscaler, apply scaling decisions, maintain service
-        status."""
+        status. During a rolling update, old-version replicas keep
+        serving until enough new-version replicas are READY, then
+        drain."""
+        self._check_for_update()
         records = self.replica_manager.probe_all()
+        old_alive = [r for r in records
+                     if r['version'] < self.version and
+                     not r['status'].is_terminal() and
+                     r['status'] != ReplicaStatus.SHUTTING_DOWN]
+        if old_alive:
+            # Keep feeding QPS to the autoscaler during the update
+            # (also bounds the LB's request-timestamp buffer).
+            self.autoscaler.collect_request_information(
+                self.load_balancer.drain_request_timestamps())
+            current = [r for r in records
+                       if r['version'] == self.version]
+            cur_nonterm = [r for r in current
+                           if not r['status'].is_terminal() and
+                           r['status'] != ReplicaStatus.SHUTTING_DOWN]
+            cur_ready = [r for r in current
+                         if r['status'] == ReplicaStatus.READY]
+            target = self.autoscaler.target_num_replicas
+            need = target - len(cur_nonterm)
+            if need > 0:
+                self.replica_manager.scale_up(need)
+            if len(cur_ready) >= target:
+                victims = [r['replica_id'] for r in old_alive]
+                logger.info('Rolling update: new version READY; '
+                            'draining old replicas %s', victims)
+                self.replica_manager.scale_down(victims)
+            # LB keeps serving the union of READY replicas (old +
+            # new) throughout; normal autoscaling resumes once the
+            # old version is drained.
+            ready = [r for r in records
+                     if r['status'] == ReplicaStatus.READY]
+            serve_state.set_service_status(
+                self.service_name,
+                ServiceStatus.READY if ready
+                else ServiceStatus.REPLICA_INIT)
+            return
         ready = [r for r in records
                  if r['status'] == ReplicaStatus.READY]
         self.autoscaler.collect_request_information(

@@ -88,6 +88,35 @@ def up(task: Task, service_name: Optional[str] = None,
         f'{wait_ready_timeout}s (see {log_path})')
 
 
+def update(service_name: str, task: Task) -> int:
+    """Rolling update to a new task version (analog of
+    ``sky/serve/core.py:362``): write the new task yaml, bump the
+    service's target_version; the controller launches new-version
+    replicas and drains old ones once the new version is READY —
+    the endpoint keeps serving throughout. Returns the new version.
+    """
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, at='serve')
+    if task.service is None:
+        raise exceptions.InvalidSpecError(
+            'Task has no service: section.')
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist; use up.')
+    new_version = rec['target_version'] + 1
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    task_yaml = os.path.join(
+        state_dir, 'services', f'{service_name}.v{new_version}.yaml')
+    common_utils.dump_yaml(task_yaml, task.to_yaml_config())
+    serve_state.set_target_version(service_name, new_version,
+                                   task_yaml)
+    logger.info('Service %s: rolling update to v%d requested',
+                service_name, new_version)
+    return new_version
+
+
 def down(service_name: str, timeout: float = 120.0) -> None:
     rec = serve_state.get_service(service_name)
     if rec is None:

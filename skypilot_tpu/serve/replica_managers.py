@@ -28,6 +28,11 @@ class ReplicaManager:
         self.service_name = service_name
         self.spec = spec
         self.task = task
+        self.version = 1
+        # Per-version specs: during a rolling update old replicas
+        # must keep being probed with THEIR version's readiness
+        # path/timeouts, not the new one's.
+        self._version_specs = {1: spec}
         self._next_replica_id = 1
         self._lock = threading.Lock()
         self._launch_threads: Dict[int, threading.Thread] = {}
@@ -37,6 +42,17 @@ class ReplicaManager:
         self._is_local = any(
             clouds.from_name(r.cloud or 'gcp').is_local
                              for r in task.resources)
+
+    def set_task(self, task: Task, version: int) -> None:
+        """Switch to a new task version: replicas launched from now
+        on run the new task (rolling update — the controller drains
+        old-version replicas once new ones are READY). Reference:
+        ``replica_managers.py:1172`` update_version."""
+        assert task.service is not None
+        self.task = task
+        self.spec = task.service
+        self.version = version
+        self._version_specs[version] = task.service
 
     # -- replica lifecycle ---------------------------------------------
 
@@ -55,31 +71,35 @@ class ReplicaManager:
                 replica_id = self._next_replica_id
                 self._next_replica_id += 1
                 ids.append(replica_id)
+        # Snapshot task/version NOW: an update arriving while a
+        # launch thread runs must not relabel an old-version replica.
+        version, task = self.version, self.task
         for replica_id in ids:
             serve_state.upsert_replica(
                 self.service_name, replica_id,
                 self._cluster_name(replica_id),
-                ReplicaStatus.PROVISIONING)
+                ReplicaStatus.PROVISIONING, version=version)
             thread = threading.Thread(
-                target=self._launch_replica, args=(replica_id,),
-                daemon=True)
+                target=self._launch_replica,
+                args=(replica_id, task, version), daemon=True)
             self._launch_threads[replica_id] = thread
             thread.start()
         return ids
 
-    def _launch_replica(self, replica_id: int) -> None:
+    def _launch_replica(self, replica_id: int, src_task: Task,
+                        version: int) -> None:
         cluster_name = self._cluster_name(replica_id)
         port = self._replica_port(replica_id)
         task = Task(
             name=f'{self.service_name}-r{replica_id}',
-            run=self.task.run,
-            setup=self.task.setup,
-            envs={**self.task.envs,
+            run=src_task.run,
+            setup=src_task.setup,
+            envs={**src_task.envs,
                   'SKYTPU_REPLICA_PORT': str(port),
                   'SKYTPU_REPLICA_ID': str(replica_id)},
-            workdir=self.task.workdir,
+            workdir=src_task.workdir,
         )
-        task.set_resources(set(self.task.resources))
+        task.set_resources(set(src_task.resources))
         try:
             execution.launch(task, cluster_name, detach_run=True,
                              quiet_optimizer=True)
@@ -99,7 +119,8 @@ class ReplicaManager:
         endpoint = f'http://{ip}:{port}'
         serve_state.upsert_replica(self.service_name, replica_id,
                                    cluster_name,
-                                   ReplicaStatus.STARTING, endpoint)
+                                   ReplicaStatus.STARTING, endpoint,
+                                   version=version)
 
     def scale_down(self, replica_ids: List[int]) -> None:
         for replica_id in replica_ids:
@@ -119,12 +140,14 @@ class ReplicaManager:
 
     # -- probing --------------------------------------------------------
 
-    def probe(self, endpoint: str) -> bool:
-        url = endpoint.rstrip('/') + self.spec.readiness_path
+    def probe(self, endpoint: str,
+              spec: Optional[SkyServiceSpec] = None) -> bool:
+        spec = spec or self.spec
+        url = endpoint.rstrip('/') + spec.readiness_path
         try:
             with urllib.request.urlopen(
                     url,
-                    timeout=self.spec.readiness_timeout_seconds) as r:
+                    timeout=spec.readiness_timeout_seconds) as r:
                 return 200 <= r.status < 300
         except (urllib.error.URLError, OSError, ValueError):
             return False
@@ -149,8 +172,10 @@ class ReplicaManager:
                 serve_state.remove_replica(self.service_name, rid)
                 self.scale_up(1)
                 continue
+            spec = self._version_specs.get(rec['version'],
+                                           self.spec)
             ready = rec['endpoint'] is not None and \
-                self.probe(rec['endpoint'])
+                self.probe(rec['endpoint'], spec)
             if ready:
                 if rec['status'] != ReplicaStatus.READY:
                     logger.info('Replica %d READY at %s', rid,
@@ -159,7 +184,7 @@ class ReplicaManager:
                                                ReplicaStatus.READY)
             else:
                 grace = time.time() - (rec['launched_at'] or 0) < \
-                    self.spec.initial_delay_seconds
+                    spec.initial_delay_seconds
                 if rec['status'] == ReplicaStatus.READY:
                     serve_state.set_replica_status(
                         self.service_name, rid, ReplicaStatus.NOT_READY)

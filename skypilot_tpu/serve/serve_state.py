@@ -55,7 +55,20 @@ def _create_tables(cursor, conn):
         status TEXT,
         endpoint TEXT,
         launched_at REAL,
+        version INTEGER DEFAULT 1,
         PRIMARY KEY (service_name, replica_id))""")
+    # Rolling-update columns (migrations for pre-update DBs).
+    import sqlite3
+    for stmt in (
+            'ALTER TABLE services ADD COLUMN '
+            'target_version INTEGER DEFAULT 1',
+            'ALTER TABLE services ADD COLUMN target_task_yaml TEXT',
+            'ALTER TABLE replicas ADD COLUMN version INTEGER '
+            'DEFAULT 1'):
+        try:
+            cursor.execute(stmt)
+        except sqlite3.OperationalError:
+            pass  # column already exists
     conn.commit()
 
 
@@ -100,8 +113,8 @@ def set_service_controller_pid(name: str, pid: int) -> None:
 def get_service(name: str) -> Optional[Dict[str, Any]]:
     row = _db().cursor.execute(
         'SELECT name, status, created_at, spec_json, endpoint, '
-        'controller_pid FROM services WHERE name=?',
-        (name,)).fetchone()
+        'controller_pid, target_version, target_task_yaml '
+        'FROM services WHERE name=?', (name,)).fetchone()
     if row is None:
         return None
     return {
@@ -111,6 +124,8 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
         'spec_json': row[3],
         'endpoint': row[4],
         'controller_pid': row[5],
+        'target_version': row[6] if row[6] is not None else 1,
+        'target_task_yaml': row[7],
     }
 
 
@@ -128,16 +143,18 @@ def remove_service(name: str) -> None:
 
 def upsert_replica(service_name: str, replica_id: int,
                    cluster_name: str, status: ReplicaStatus,
-                   endpoint: Optional[str] = None) -> None:
+                   endpoint: Optional[str] = None,
+                   version: int = 1) -> None:
     _db().execute_and_commit(
         'INSERT INTO replicas (service_name, replica_id, '
-        'cluster_name, status, endpoint, launched_at) '
-        'VALUES (?,?,?,?,?,?) '
+        'cluster_name, status, endpoint, launched_at, version) '
+        'VALUES (?,?,?,?,?,?,?) '
         'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
         'cluster_name=excluded.cluster_name, status=excluded.status, '
-        'endpoint=COALESCE(excluded.endpoint, replicas.endpoint)',
+        'endpoint=COALESCE(excluded.endpoint, replicas.endpoint), '
+        'version=excluded.version',
         (service_name, replica_id, cluster_name, status.value,
-         endpoint, time.time()))
+         endpoint, time.time(), version))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -150,7 +167,7 @@ def set_replica_status(service_name: str, replica_id: int,
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     rows = _db().cursor.execute(
         'SELECT replica_id, cluster_name, status, endpoint, '
-        'launched_at FROM replicas WHERE service_name=? '
+        'launched_at, version FROM replicas WHERE service_name=? '
         'ORDER BY replica_id', (service_name,)).fetchall()
     return [{
         'replica_id': r[0],
@@ -158,6 +175,7 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'status': ReplicaStatus(r[2]),
         'endpoint': r[3],
         'launched_at': r[4],
+        'version': r[5] if r[5] is not None else 1,
     } for r in rows]
 
 
@@ -165,3 +183,12 @@ def remove_replica(service_name: str, replica_id: int) -> None:
     _db().execute_and_commit(
         'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
         (service_name, replica_id))
+
+
+def set_target_version(name: str, version: int,
+                       task_yaml: str) -> None:
+    """Request a rolling update: the controller picks this up on its
+    next tick (reference ``sky/serve/core.py:362`` update)."""
+    _db().execute_and_commit(
+        'UPDATE services SET target_version=?, target_task_yaml=? '
+        'WHERE name=?', (version, task_yaml, name))

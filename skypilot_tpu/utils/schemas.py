@@ -36,7 +36,16 @@ _RESOURCES_FIELDS = {
     },
     'labels': {'type': ['object', 'null'],
                'additionalProperties': {'type': 'string'}},
-    'job_recovery': {'type': ['string', 'object', 'null']},
+    'job_recovery': {
+        'anyOf': [{'type': ['string', 'null']},
+                  {'type': 'object',
+                   'additionalProperties': False,
+                   'properties': {
+                       'strategy': {'type': ['string', 'null']},
+                       'max_restarts_on_errors': {
+                           'type': 'integer', 'minimum': 0},
+                   }}],
+    },
     'accelerator_args': {'type': ['object', 'null']},
 }
 

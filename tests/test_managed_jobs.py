@@ -302,3 +302,66 @@ class TestCheckpointRecoveryViaStorage:
         assert jobs_state.get_job(job_id)['recovery_count'] >= 1
         # The recovered run read the checkpoint from the "bucket".
         assert (bucket_dir / 'done.ckpt').exists()
+
+
+class TestMaxRestartsOnErrors:
+    """User-code-failure restart budget (reference
+    ``recovery_strategy.py:376`` should_restart_on_failure via
+    ``job_recovery: {max_restarts_on_errors: N}``)."""
+
+    def _write_dag(self, tmp_path, tasks):
+        import yaml
+        path = str(tmp_path / 'restart_dag.yaml')
+        with open(path, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([t.to_yaml_config() for t in tasks], f)
+        return path
+
+    def _flaky_task(self, tmp_path, fail_times, max_restarts,
+                    name='flaky'):
+        marker = tmp_path / 'attempts'
+        run = (f'n=$(cat {marker} 2>/dev/null || echo 0); '
+               f'echo $((n+1)) > {marker}; '
+               f'if [ "$n" -lt "{fail_times}" ]; then exit 1; fi; '
+               'echo finally-ok')
+        task = Task(name=name, run=run)
+        res = Resources(
+            cloud='local',
+            job_recovery={'strategy': 'NONE',
+                          'max_restarts_on_errors': max_restarts})
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        return task, marker
+
+    def test_restarts_then_succeeds(self, tmp_path, cleanup_clusters):
+        task, marker = self._flaky_task(tmp_path, fail_times=2,
+                                        max_restarts=3)
+        dag_yaml = self._write_dag(tmp_path, [task])
+        job_id = jobs_state.add_job('flaky', dag_yaml, 'inproc')
+        from skypilot_tpu.jobs.controller import JobsController
+        final = JobsController(job_id, dag_yaml).run()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert int(marker.read_text().strip()) == 3  # 2 fails + 1 ok
+
+    def test_budget_exhausted_fails(self, tmp_path, cleanup_clusters):
+        task, marker = self._flaky_task(tmp_path, fail_times=5,
+                                        max_restarts=1, name='flaky2')
+        dag_yaml = self._write_dag(tmp_path, [task])
+        job_id = jobs_state.add_job('flaky2', dag_yaml, 'inproc')
+        from skypilot_tpu.jobs.controller import JobsController
+        final = JobsController(job_id, dag_yaml).run()
+        assert final == jobs_state.ManagedJobStatus.FAILED
+        assert int(marker.read_text().strip()) == 2  # initial + 1
+
+    def test_yaml_round_trip(self):
+        res = Resources(
+            cloud='local',
+            job_recovery={'strategy': 'FAILOVER',
+                          'max_restarts_on_errors': 4})
+        assert res.max_restarts_on_errors == 4
+        assert res.spot_recovery == 'FAILOVER'
+        rt = Resources.from_yaml_config(res.to_yaml_config())
+        r2 = next(iter(rt))
+        assert r2.max_restarts_on_errors == 4
+        assert r2.spot_recovery == 'FAILOVER'
+        c = res.copy()
+        assert c.max_restarts_on_errors == 4

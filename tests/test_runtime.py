@@ -508,6 +508,7 @@ class TestVersionHandshake:
             cluster_name = 'vh-test'
             provider = 'gcp'
             num_hosts = 2
+            is_local = False
 
             def agent_client(self, i):
                 return FakeClient()
@@ -545,3 +546,60 @@ class TestVersionHandshake:
             lambda self, handle: calls.append('setup'))
         TpuBackend()._ensure_runtime_version(FakeHandle())
         assert calls == []
+
+
+class TestAgentTermination:
+
+    @pytest.fixture(params=['py', 'cpp'])
+    def raw_agent(self, request, tmp_path):
+        """Agent + its Popen handle (to SIGTERM it directly)."""
+        if request.param == 'cpp' and not _cpp_agent_available():
+            pytest.skip('C++ agent not built')
+        port = _free_port()
+        proc = agent_client.start_local_agent(
+            port, runtime_dir=str(tmp_path),
+            use_cpp=(request.param == 'cpp'))
+        client = AgentClient('127.0.0.1', port)
+        client.wait_healthy(timeout=15)
+        yield client, proc
+        if proc.poll() is None:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_sigterm_kills_tracked_processes(self, raw_agent,
+                                             tmp_path):
+        """Teardown must not leak task processes: task procs run in
+        their own sessions, so the agent sweeps them on SIGTERM
+        (regression: replica servers kept their ports after down)."""
+        import signal as signal_mod
+        client, agent_proc = raw_agent
+        import uuid
+        tag = uuid.uuid4().hex[:10]
+        marker = tmp_path / 'alive'
+        proc_id = client.run(
+            f'touch {marker}; SKYTPU_TEST_TAG={tag} sleep 300; '
+            f'rm -f {marker}',
+            str(tmp_path / 't.log'))
+        deadline = time.time() + 10
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.1)
+        assert marker.exists()
+        st = client.status(proc_id)
+        assert st['running']
+        # Find the task pid (child session) before killing the agent.
+        out = subprocess.run(
+            ['pgrep', '-f', tag], capture_output=True, text=True)
+        task_pids = [int(p) for p in out.stdout.split()]
+        assert task_pids
+        agent_proc.send_signal(signal_mod.SIGTERM)
+        agent_proc.wait(timeout=10)
+        deadline = time.time() + 10
+        gone = False
+        while time.time() < deadline:
+            alive = [p for p in task_pids
+                     if os.path.exists(f'/proc/{p}')]
+            if not alive:
+                gone = True
+                break
+            time.sleep(0.2)
+        assert gone, f'task processes leaked: {alive}'

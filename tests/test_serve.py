@@ -8,6 +8,7 @@ import pytest
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.serve import autoscalers, load_balancer, serve_state
+from skypilot_tpu.serve.serve_state import ServiceStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 
 
@@ -246,3 +247,66 @@ class TestServeEndToEnd:
         finally:
             serve_api.down('echosvc')
         assert serve_state.get_service('echosvc') is None
+
+
+@pytest.mark.slow
+class TestRollingUpdate:
+
+    def test_rolling_update_end_to_end(self, monkeypatch, tmp_path):
+        """v1 serves 'one'; update to v2 serving 'two'. The endpoint
+        must cut over to v2 and old replicas must drain, with the
+        service READY throughout (ref sky/serve/core.py:362)."""
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        def make_task(body, port):
+            d = tmp_path / body
+            d.mkdir(exist_ok=True)
+            (d / 'index.html').write_text(body)
+            task = Task(
+                name='upd-svc',
+                run=(f'cd {d} && python3 -m http.server '
+                     '$SKYTPU_REPLICA_PORT --bind 127.0.0.1'))
+            res = Resources(cloud='local')
+            res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+            task.set_resources(res)
+            task.service = SkyServiceSpec(
+                readiness_path='/', initial_delay_seconds=60,
+                readiness_timeout_seconds=3, min_replicas=1,
+                port=port)
+            return task
+
+        endpoint = serve_api.up(make_task('one', 18300), 'updsvc',
+                                wait_ready_timeout=120)
+        try:
+            with urllib.request.urlopen(endpoint, timeout=10) as r:
+                assert b'one' in r.read()
+            v1_replicas = {r['replica_id']
+                           for r in serve_state.get_replicas('updsvc')}
+
+            version = serve_api.update('updsvc',
+                                       make_task('two', 18300))
+            assert version == 2
+
+            deadline = time.time() + 150
+            cut_over = False
+            while time.time() < deadline:
+                reps = serve_state.get_replicas('updsvc')
+                v2_ready = [r for r in reps if r['version'] == 2 and
+                            r['status'] ==
+                            serve_state.ReplicaStatus.READY]
+                v1_left = [r for r in reps
+                           if r['replica_id'] in v1_replicas]
+                if v2_ready and not v1_left:
+                    cut_over = True
+                    break
+                time.sleep(1)
+            assert cut_over, serve_state.get_replicas('updsvc')
+            with urllib.request.urlopen(endpoint, timeout=10) as r:
+                assert b'two' in r.read()
+            rec = serve_state.get_service('updsvc')
+            assert rec['status'] == ServiceStatus.READY
+        finally:
+            serve_api.down('updsvc')
