@@ -116,11 +116,15 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
 
     h = llama._rms_norm(x, layer_params['mlp_norm'],
                         config.norm_eps, config.norm_offset)
-    gate = llama.mlp_act(config)(
-        (h @ layer_params['w_gate']).astype(jnp.float32)
-    ).astype(h.dtype)
-    up = h @ layer_params['w_up']
-    x = x + (gate * up) @ layer_params['w_down']
+    if config.n_experts:
+        moe_out, _ = llama._moe_mlp(config, h, layer_params)
+        x = x + moe_out
+    else:
+        gate = llama.mlp_act(config)(
+            (h @ layer_params['w_gate']).astype(jnp.float32)
+        ).astype(h.dtype)
+        up = h @ layer_params['w_up']
+        x = x + (gate * up) @ layer_params['w_down']
     return x, k_cache, v_cache
 
 

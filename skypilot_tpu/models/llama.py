@@ -72,6 +72,20 @@ class LlamaConfig:
     scale_embeddings: bool = False
     # Bias on the q/k/v projections (Qwen2).
     qkv_bias: bool = False
+    # ---- Mixture-of-Experts (Mixtral family). n_experts == 0 means
+    # a dense MLP; > 0 replaces every layer's MLP with a top-k-routed
+    # expert layer (GShard-style static capacity dispatch, experts
+    # sharded over the 'ep' mesh axis — the all-to-all is inserted by
+    # GSPMD from the expert-weight shardings). ----
+    n_experts: int = 0
+    moe_top_k: int = 2
+    # Per-expert buffer = ceil(top_k * T / E * capacity_factor)
+    # tokens; overflow drops (residual passes through). Static shapes
+    # keep the dispatch XLA/MXU-friendly.
+    moe_capacity_factor: float = 2.0
+    # Coefficient on the load-balance aux loss (≈1.0 at perfect
+    # balance; Switch Transformer's alpha).
+    moe_aux_coef: float = 0.02
 
     def __post_init__(self):
         unknown = set(self.remat_saves.split('+')) - {
@@ -93,13 +107,25 @@ class LlamaConfig:
     def num_params(self) -> int:
         d, v, h = self.dim, self.vocab_size, self.ffn_hidden
         nh, nkv, hd = self.n_heads, self.n_kv_heads, self.head_dim
+        mlp = 3 * d * h
+        if self.n_experts:
+            mlp = self.n_experts * mlp + d * self.n_experts
         per_layer = (
             d * nh * hd + 2 * d * nkv * hd + nh * hd * d +
-            3 * d * h + 2 * d)
+            mlp + 2 * d)
         if self.qkv_bias:
             per_layer += (nh + 2 * nkv) * hd
         head = 0 if self.tie_embeddings else v * d
         return v * d + head + self.n_layers * per_layer + d
+
+    def num_active_params(self) -> int:
+        """Params touched per token (== num_params for dense; for MoE
+        only top_k of the n_experts MLPs) — the FLOPs/token basis."""
+        if not self.n_experts:
+            return self.num_params()
+        unused = ((self.n_experts - self.moe_top_k) *
+                  3 * self.dim * self.ffn_hidden * self.n_layers)
+        return self.num_params() - unused
 
 
 CONFIGS: Dict[str, LlamaConfig] = {
@@ -145,6 +171,13 @@ CONFIGS: Dict[str, LlamaConfig] = {
         name='mistral-7b', vocab_size=32000, dim=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, ffn_hidden=14336,
         rope_theta=10000.0, max_seq_len=8192),
+    # MoE family: Mistral attention geometry + 8 routed experts, top-2
+    # (HF mistralai/Mixtral-8x7B config.json).
+    'mixtral-8x7b': LlamaConfig(
+        name='mixtral-8x7b', vocab_size=32000, dim=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, ffn_hidden=14336,
+        rope_theta=1000000.0, max_seq_len=32768,
+        n_experts=8, moe_top_k=2),
     # Small configs for tests / CPU dryruns.
     'debug-250m': LlamaConfig(
         name='debug-250m', vocab_size=32000, dim=1024, n_layers=8,
@@ -153,6 +186,10 @@ CONFIGS: Dict[str, LlamaConfig] = {
         name='tiny', vocab_size=512, dim=128, n_layers=2, n_heads=4,
         n_kv_heads=2, ffn_hidden=256, max_seq_len=512,
         dtype=jnp.float32, remat=False),
+    'tiny-moe': LlamaConfig(
+        name='tiny-moe', vocab_size=512, dim=128, n_layers=2,
+        n_heads=4, n_kv_heads=2, ffn_hidden=256, max_seq_len=512,
+        dtype=jnp.float32, remat=False, n_experts=4, moe_top_k=2),
 }
 
 
@@ -193,7 +230,23 @@ def init_params(config: LlamaConfig, key: jax.Array,
         return (jnp.zeros(shape, dtype) if config.norm_offset
                 else jnp.ones(shape, dtype))
 
-    ks = jax.random.split(k_layers, 7)
+    # Dense configs keep the historical 7-way split so a fixed seed
+    # reproduces pre-MoE initializations exactly.
+    E = config.n_experts
+    ks = jax.random.split(k_layers, 8 if E else 7)
+    if E:
+        mlp_params = {
+            'router': dense(ks[7], (L, d, E), d),
+            'w_gate': dense(ks[4], (L, E, d, ffn), d),
+            'w_up': dense(ks[5], (L, E, d, ffn), d),
+            'w_down': dense(ks[6], (L, E, ffn, d), ffn),
+        }
+    else:
+        mlp_params = {
+            'w_gate': dense(ks[4], (L, d, ffn), d),
+            'w_up': dense(ks[5], (L, d, ffn), d),
+            'w_down': dense(ks[6], (L, ffn, d), ffn),
+        }
     params: Params = {
         'embed': dense(k_embed, (config.vocab_size, d), d),
         'layers': {
@@ -201,9 +254,7 @@ def init_params(config: LlamaConfig, key: jax.Array,
             'wk': dense(ks[1], (L, d, nkv * hd), d),
             'wv': dense(ks[2], (L, d, nkv * hd), d),
             'wo': dense(ks[3], (L, nh * hd, d), nh * hd),
-            'w_gate': dense(ks[4], (L, d, ffn), d),
-            'w_up': dense(ks[5], (L, d, ffn), d),
-            'w_down': dense(ks[6], (L, ffn, d), ffn),
+            **mlp_params,
             'attn_norm': norm_init((L, d)),
             'mlp_norm': norm_init((L, d)),
         },
@@ -219,21 +270,36 @@ def init_params(config: LlamaConfig, key: jax.Array,
 
 
 def param_sharding_rules(config: LlamaConfig) -> Params:
-    """PartitionSpec per param over mesh axes (dp, fsdp, tp).
+    """PartitionSpec per param over mesh axes (dp, fsdp, ep, tp).
 
     TP shards heads / ffn-hidden / vocab; FSDP shards the other big
-    axis (ZeRO-3). The scan-stacked layer axis stays replicated.
+    axis (ZeRO-3). Non-expert params fold 'ep' into the fsdp group
+    (so an expert-parallel mesh still ZeRO-shards the dense weights);
+    expert-stacked weights shard their expert axis over 'ep'. The
+    scan-stacked layer axis stays replicated.
     """
+    fs = ('fsdp', 'ep')
+    if config.n_experts:
+        mlp_rules = {
+            'router': P(None, fs, None),
+            'w_gate': P(None, 'ep', 'fsdp', 'tp'),
+            'w_up': P(None, 'ep', 'fsdp', 'tp'),
+            'w_down': P(None, 'ep', 'tp', 'fsdp'),
+        }
+    else:
+        mlp_rules = {
+            'w_gate': P(None, fs, 'tp'),
+            'w_up': P(None, fs, 'tp'),
+            'w_down': P(None, 'tp', fs),
+        }
     rules = {
-        'embed': P('tp', 'fsdp'),
+        'embed': P('tp', fs),
         'layers': {
-            'wq': P(None, 'fsdp', 'tp'),
-            'wk': P(None, 'fsdp', 'tp'),
-            'wv': P(None, 'fsdp', 'tp'),
-            'wo': P(None, 'tp', 'fsdp'),
-            'w_gate': P(None, 'fsdp', 'tp'),
-            'w_up': P(None, 'fsdp', 'tp'),
-            'w_down': P(None, 'tp', 'fsdp'),
+            'wq': P(None, fs, 'tp'),
+            'wk': P(None, fs, 'tp'),
+            'wv': P(None, fs, 'tp'),
+            'wo': P(None, 'tp', fs),
+            **mlp_rules,
             'attn_norm': P(None, None),
             'mlp_norm': P(None, None),
         },
@@ -244,7 +310,7 @@ def param_sharding_rules(config: LlamaConfig) -> Params:
         rules['layers']['bk'] = P(None, 'tp')
         rules['layers']['bv'] = P(None, 'tp')
     if not config.tie_embeddings:
-        rules['lm_head'] = P('fsdp', 'tp')
+        rules['lm_head'] = P(fs, 'tp')
     return rules
 
 
@@ -294,10 +360,92 @@ def mlp_act(config: LlamaConfig):
     return functools.partial(jax.nn.gelu, approximate=True)
 
 
+def _moe_mlp(config: LlamaConfig, h: jax.Array, layer_params: Params,
+             mesh=None, out_spec=None):
+    """Top-k routed expert MLP (GShard-style static capacity
+    dispatch; reference has no MoE — new scope, cf. SURVEY §2.11).
+
+    h: [B, T, D] -> ([B, T, D], aux_loss scalar f32). Each batch row
+    is a routing group with per-expert capacity
+    ``ceil(top_k * T / E * capacity_factor)``; overflow tokens fall
+    back to the residual stream (standard token dropping). All shapes
+    are static so XLA tiles every einsum onto the MXU; the expert
+    dimension is sharded over 'ep' (propagated by GSPMD from the
+    expert-weight shardings), which lowers the dispatch/combine
+    einsums to an all-to-all over ICI.
+    """
+    b, t, d = h.shape
+    E, k = config.n_experts, config.moe_top_k
+    # Router in fp32 (selective precision, Switch Transformer §2.4):
+    # near-tie top-k flips on bf16 logits destabilize routing. The
+    # [D, E] matmul is negligible next to the expert FFNs.
+    logits = h.astype(jnp.float32) @ \
+        layer_params['router'].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)             # [B, T, E]
+    gate, idx = jax.lax.top_k(probs, k)                 # [B, T, k]
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    sel = jax.nn.one_hot(idx, E, dtype=jnp.float32)     # [B, T, k, E]
+    # Load-balance aux (Switch Transformer eq. 4, generalized to
+    # top-k): fraction of routed slots x mean router prob per expert,
+    # scaled so perfect balance gives exactly 1.0.
+    frac = sel.sum(2).mean((0, 1)) / k
+    aux = E * jnp.sum(frac * probs.mean((0, 1)))
+
+    cap = min(int(math.ceil(k * t * config.moe_capacity_factor / E)),
+              t)
+    # Slot order is token-major: earlier tokens win buffer space.
+    sel_flat = sel.reshape(b, t * k, E)
+    pos = (jnp.cumsum(sel_flat, axis=1) - sel_flat).astype(jnp.int32)
+    keep = sel_flat * (pos < cap)
+    disp = keep[..., None] * jax.nn.one_hot(pos, cap,
+                                            dtype=jnp.float32)
+    comb = disp * gate.reshape(b, t * k)[:, :, None, None]
+    disp = disp.reshape(b, t, k, E, cap).sum(2).astype(h.dtype)
+    comb = comb.reshape(b, t, k, E, cap).sum(2).astype(h.dtype)
+
+    def pin(arr, spec):
+        # Explicit expert-major shardings: without these GSPMD falls
+        # back to "involuntary full rematerialization" (replicate +
+        # repartition) on the dispatch transposes.
+        if mesh is None:
+            return arr
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+
+    # Remat save points mirror the dense MLP's: 'mlp'/'mlp_up' in
+    # ``remat_saves`` keep the [E, B, C, ffn] expert activations, and
+    # the dispatch/combine one-hots are always cheap-to-save names so
+    # backward need not rebuild the [B, T*k, E, C] cumsum tensors.
+    disp = checkpoint_name(disp, 'moe_dispatch')
+    comb = checkpoint_name(comb, 'moe_dispatch')
+    xin = jnp.einsum('btec,btd->ebcd', disp, h)      # a2a: tok→exp
+    xin = pin(xin, P('ep', ('dp', 'fsdp'), None, None))
+    g = checkpoint_name(
+        jnp.einsum('ebcd,edf->ebcf', xin, layer_params['w_gate']),
+        'mlp_gate')
+    up = checkpoint_name(
+        jnp.einsum('ebcd,edf->ebcf', xin, layer_params['w_up']),
+        'mlp_up')
+    act = mlp_act(config)(g.astype(jnp.float32)).astype(h.dtype)
+    xout = jnp.einsum('ebcf,efd->ebcd', act * up,
+                      layer_params['w_down'])
+    xout = pin(xout, P('ep', ('dp', 'fsdp'), None, None))
+    out = jnp.einsum('ebcd,btec->btd', xout, comb)   # a2a: exp→tok
+    out = pin(out, out_spec if out_spec is not None
+              else P(('dp', 'fsdp', 'ep'), None, None))
+    return out, aux
+
+
 def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
            angles: jax.Array, attn_impl,
            lora_params: Optional[Params] = None,
-           lora_scale: float = 1.0) -> jax.Array:
+           lora_scale: float = 1.0, mesh=None, act_spec=None):
+    """One transformer block. Returns (y, moe_aux_loss) — the aux is
+    0 for dense configs so the scan carry has one static shape.
+    ``act_spec``: the [B, T, D] activation PartitionSpec (so the MoE
+    combine restores e.g. the 'sp' sequence sharding)."""
     b, t, d = x.shape
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
 
@@ -335,6 +483,10 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
 
     h = _rms_norm(x, layer_params['mlp_norm'], config.norm_eps,
                   config.norm_offset)
+    if config.n_experts:
+        moe_out, aux = _moe_mlp(config, h, layer_params, mesh=mesh,
+                                out_spec=act_spec)
+        return x + moe_out, aux
     # Save the PRE-activation gate (its backward needs it anyway) and up:
     # with these two named values kept, backward recomputes only
     # elementwise ops here, not the two [d, ffn] matmuls. Separate
@@ -344,7 +496,7 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     up = checkpoint_name(h @ layer_params['w_up'], 'mlp_up')
     gate = mlp_act(config)(g_pre.astype(jnp.float32)).astype(h.dtype)
     x = x + (gate * up) @ layer_params['w_down']
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward_hidden(params: Params, tokens: jax.Array,
@@ -353,9 +505,12 @@ def forward_hidden(params: Params, tokens: jax.Array,
                    attn_impl=None,
                    lora: Optional[Params] = None,
                    lora_scale: float = 1.0,
-                   activation_sharding=None) -> jax.Array:
+                   activation_sharding=None,
+                   with_aux: bool = False, mesh=None):
     """tokens [B, T] int32 -> final hidden states [B, T, D]
-    (post-final-norm, compute dtype).
+    (post-final-norm, compute dtype). With ``with_aux`` returns
+    (hidden, moe_aux_loss) — the layer-mean load-balance loss
+    (always 0 for dense configs).
 
     Master params may be fp32; compute happens in ``config.dtype``
     (bf16 on the MXU). ``lora`` is an optional pytree of stacked
@@ -385,10 +540,15 @@ def forward_hidden(params: Params, tokens: jax.Array,
         x = jax.lax.with_sharding_constraint(x, activation_sharding)
 
     def scan_body(carry, scanned):
+        x_c, aux_c = carry
         layer_params, layer_lora = scanned
-        y = _layer(config, carry, layer_params, angles, attn_impl,
-                   lora_params=layer_lora, lora_scale=lora_scale)
-        return y, None
+        y, aux = _layer(config, x_c, layer_params, angles, attn_impl,
+                        lora_params=layer_lora, lora_scale=lora_scale,
+                        mesh=mesh,
+                        act_spec=(activation_sharding.spec
+                                  if activation_sharding is not None
+                                  else None))
+        return (y, aux_c + aux), None
 
     body = scan_body
     if config.remat:
@@ -405,6 +565,10 @@ def forward_hidden(params: Params, tokens: jax.Array,
             extra.append('mlp_up')
         if 'qkv' in tokens_:
             extra.append('qkv')
+        if config.n_experts:
+            # Dispatch/combine one-hots are cheap to keep and costly
+            # to rebuild (cumsum over [B, T*k, E]) — always save.
+            extra.append('moe_dispatch')
         base = (jax.checkpoint_policies.save_only_these_names(*extra)
                 if extra else None)
         body = jax.checkpoint(
@@ -413,10 +577,14 @@ def forward_hidden(params: Params, tokens: jax.Array,
     clora = None
     if lora is not None:
         clora = jax.tree.map(lambda p: p.astype(config.dtype), lora)
-    x, _ = jax.lax.scan(body, x, (cparams['layers'], clora))
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               (cparams['layers'], clora))
 
-    return _rms_norm(x, cparams['final_norm'], config.norm_eps,
-                     config.norm_offset)
+    hidden = _rms_norm(x, cparams['final_norm'], config.norm_eps,
+                       config.norm_offset)
+    if with_aux:
+        return hidden, aux / config.n_layers
+    return hidden
 
 
 def output_head(params: Params, config: LlamaConfig) -> jax.Array:
@@ -540,7 +708,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
             lora: Optional[Params] = None,
             lora_scale: float = 1.0,
             attn_impl=None,
-            activation_sharding=None) -> jax.Array:
+            activation_sharding=None, mesh=None) -> jax.Array:
     """Causal LM cross-entropy over positions predicting
     ``tokens[:, 1:]`` (mask-aware if batch has 'loss_mask').
 
@@ -559,10 +727,10 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     # ~30% step-time regression at seq 2048).
     inputs = tokens[:, :-1]
     targets = tokens[:, 1:]
-    hidden = forward_hidden(params, inputs, config, lora=lora,
-                            lora_scale=lora_scale,
-                            attn_impl=attn_impl,
-                            activation_sharding=activation_sharding)
+    hidden, moe_aux = forward_hidden(
+        params, inputs, config, lora=lora, lora_scale=lora_scale,
+        attn_impl=attn_impl, activation_sharding=activation_sharding,
+        with_aux=True, mesh=mesh)
     mask = batch.get('loss_mask')
     # loss_mask aligns with ``tokens``: position i contributes iff its
     # *target* token i+1 is unmasked.
@@ -580,5 +748,7 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
 
     # The head is frozen exactly when training LoRA adapters — skip
     # the [D, V] grad matmul then (its cotangent would be dead).
-    return _fused_ce(train_lm_head=lora is None)(
-        hid, lm_head, tgt, msk)
+    ce = _fused_ce(train_lm_head=lora is None)(hid, lm_head, tgt, msk)
+    if config.n_experts:
+        ce = ce + config.moe_aux_coef * moe_aux
+    return ce

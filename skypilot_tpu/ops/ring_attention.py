@@ -148,7 +148,7 @@ def ring_attention_sharded(mesh: Mesh, q: jax.Array, k: jax.Array,
     seq=sp, heads=tp) and run ring attention under shard_map."""
     from jax import shard_map
 
-    spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
+    spec = P(('dp', 'fsdp', 'ep'), axis_name, 'tp', None)
 
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name),

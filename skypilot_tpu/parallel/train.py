@@ -60,7 +60,7 @@ def _sharding_tree(rules: Params, mesh: Mesh):
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(('dp', 'fsdp'), None))
+    return NamedSharding(mesh, P(('dp', 'fsdp', 'ep'), None))
 
 
 def plan_train_state(config: llama.LlamaConfig, mesh,
@@ -179,7 +179,7 @@ def make_ring_attention_impl(mesh: Mesh, axis_name: str = 'sp'):
 
     from skypilot_tpu.ops import ring_attention as ring
 
-    spec = P(('dp', 'fsdp'), axis_name, 'tp', None)
+    spec = P(('dp', 'fsdp', 'ep'), axis_name, 'tp', None)
     fn = shard_map(
         functools.partial(ring.ring_attention, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -217,7 +217,7 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
     use_sp = mesh.shape.get('sp', 1) > 1
     attn_impl = make_ring_attention_impl(mesh) if use_sp else None
     act_sharding = NamedSharding(
-        mesh, P(('dp', 'fsdp'), 'sp', None)) if use_sp else None
+        mesh, P(('dp', 'fsdp', 'ep'), 'sp', None)) if use_sp else None
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         if is_lora:
@@ -226,7 +226,7 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                     jax.lax.stop_gradient(state.params), batch, config,
                     lora=lora_p, lora_scale=lora_scale,
                     attn_impl=attn_impl,
-                    activation_sharding=act_sharding)
+                    activation_sharding=act_sharding, mesh=mesh)
 
             loss, grads = jax.value_and_grad(loss_of)(state.lora)
             updates, new_opt = optimizer.update(grads, state.opt_state,
@@ -239,7 +239,7 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
             def loss_of(params):
                 return llama.loss_fn(
                     params, batch, config, attn_impl=attn_impl,
-                    activation_sharding=act_sharding)
+                    activation_sharding=act_sharding, mesh=mesh)
 
             loss, grads = jax.value_and_grad(loss_of)(state.params)
             updates, new_opt = optimizer.update(grads, state.opt_state,

@@ -36,6 +36,10 @@ def parse_args():
                    help='full finetune instead of LoRA')
     p.add_argument('--tp', type=int, default=1)
     p.add_argument('--dp', type=int, default=1)
+    p.add_argument('--ep', type=int, default=1,
+                   help='expert-parallel degree (MoE models)')
+    p.add_argument('--sp', type=int, default=1,
+                   help='sequence-parallel degree (ring attention)')
     p.add_argument('--data', default=None,
                    help='tokenized dataset (.npy of token ids)')
     p.add_argument('--synthetic', action='store_true', default=None)
@@ -86,8 +90,8 @@ def main():
     # all-reduce crosses DCN; fsdp/tp/sp collectives stay on ICI.
     from skypilot_tpu.parallel import mesh as mesh_lib
     num_slices = mesh_lib.num_slices_from_env()
-    mesh_cfg = auto_mesh_config(tp=args.tp, dp=args.dp,
-                                num_slices=num_slices)
+    mesh_cfg = auto_mesh_config(tp=args.tp, dp=args.dp, ep=args.ep,
+                                sp=args.sp, num_slices=num_slices)
     mesh = make_mesh(mesh_cfg, num_slices=num_slices)
     if jax.process_index() == 0:
         print(f'devices={jax.device_count()} mesh={mesh_cfg} '

@@ -61,7 +61,8 @@ class TestNorthStar8B:
     def test_8b_lowers_and_fits_v5p(self, mesh_axes, lora_rank):
         config = llama.get_config('llama3.1-8b', max_seq_len=2048)
         n_dev = int(np.prod(list(mesh_axes.values())))
-        axes = {'dp': 1, 'fsdp': 1, 'tp': 1, 'sp': 1, **mesh_axes}
+        axes = {'dp': 1, 'fsdp': 1, 'ep': 1, 'tp': 1, 'sp': 1,
+                **mesh_axes}
         if n_dev <= 8:
             mesh = make_mesh(MeshConfig(**{k: v for k, v in
                                            axes.items()}))
@@ -108,3 +109,18 @@ class TestFamilyNorthStar:
         per_dev = _per_device_state_bytes(state_shape, shardings)
         assert per_dev < V5P_HBM_BYTES, (
             f'{name}: {per_dev / 1e9:.1f} GB per device')
+
+    def test_mixtral_lowers_and_fits_v5p_32dev(self):
+        """Mixtral-8x7B (46.7B total params) full-FT on a 32-chip
+        v5p mesh with expert parallelism: experts shard over ep=8,
+        dense weights ZeRO-shard over (fsdp, ep)."""
+        config = llama.get_config('mixtral-8x7b', max_seq_len=2048)
+        mesh = AbstractMesh((1, 2, 8, 2, 1),
+                            ('dp', 'fsdp', 'ep', 'tp', 'sp'))
+        lowered, state_shape, shardings = _lower_train_step(
+            config, mesh, lora_rank=None, batch=32, seq=2048)
+        assert lowered.as_text()
+        per_dev = _per_device_state_bytes(state_shape, shardings)
+        # 46.7B: bf16 params 93G + f32 moments 374G over 32 chips
+        # ≈ 15G/chip.
+        assert per_dev < 20 * 1024 ** 3, f'{per_dev / 1e9:.1f} GB'
