@@ -179,13 +179,18 @@ class TpuBackend(Backend):
         is_tpu = res is not None and res.accelerator is not None
         slots = 1 if is_tpu else 16
         head.exec(f'echo {slots} > {rdir}/job_slots', timeout=15)
+        # The ( ... & ) grouping is load-bearing: without it, bash
+        # backgrounds the whole `pgrep || nohup ...` list and the
+        # forked subshell waits on skylet forever while holding the
+        # agent's output pipe open — every exec then hits the full
+        # timeout (observed as 30 s of dead air per launch).
         skylet_cmd = (
             f'pgrep -f "skypilot_tpu.runtime.[s]kylet '
-            f'--runtime-dir {rdir}" > /dev/null || '
+            f'--runtime-dir {rdir}" > /dev/null || ('
             f'SKYTPU_RUNTIME_DIR={rdir} '
             f"nohup python3 -m skypilot_tpu.runtime.'s'kylet "
             f'--runtime-dir {rdir} '
-            f'>> {rdir}/skylet.log 2>&1 &')
+            f'< /dev/null >> {rdir}/skylet.log 2>&1 &)')
         out = head.exec(skylet_cmd, timeout=30)
         if out.get('returncode') != 0:
             logger.warning('skylet start returned %s: %s',

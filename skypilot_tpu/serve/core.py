@@ -79,13 +79,30 @@ def up(task: Task, service_name: Optional[str] = None,
             logger.info('Service %s READY at %s', service_name,
                         endpoint)
             return endpoint
+        # Never leave a half-up service behind on failure: a live
+        # controller would keep relaunching failing replicas (and
+        # leaking their processes) with nothing left to ever tear it
+        # down, and a dead controller leaves the service row + any
+        # launched replica clusters orphaned.
         if proc.poll() is not None:
+            _cleanup_failed_up(service_name)
             raise exceptions.SkyTpuError(
                 f'Serve controller died (see {log_path})')
         time.sleep(1.0)
+    logger.error('Service %s not READY in %ss; tearing it down',
+                 service_name, wait_ready_timeout)
+    _cleanup_failed_up(service_name)
     raise TimeoutError(
         f'Service {service_name} not READY after '
         f'{wait_ready_timeout}s (see {log_path})')
+
+
+def _cleanup_failed_up(service_name: str) -> None:
+    try:
+        down(service_name)
+    except exceptions.SkyTpuError as e:
+        logger.warning('Cleanup of failed service %s: %s',
+                       service_name, e)
 
 
 def update(service_name: str, task: Task) -> int:

@@ -196,6 +196,20 @@ class ReplicaManager:
                         'delay', rid)
                     serve_state.set_replica_status(
                         self.service_name, rid, ReplicaStatus.FAILED)
+                    # Tear the cluster down NOW: a failed replica's
+                    # task processes otherwise keep running (and keep
+                    # its port bound, so the replacement replica can
+                    # collide). The FAILED record stays for status
+                    # reporting (ref replica_managers.py:225
+                    # ReplicaStatusProperty — failed replicas are
+                    # terminated, their status preserved).
+                    try:
+                        core_lib.down(self._cluster_name(rid),
+                                      purge=True)
+                    except exceptions.SkyTpuError as e:
+                        logger.warning(
+                            'Teardown of failed replica %d: %s',
+                            rid, e)
         return serve_state.get_replicas(self.service_name)
 
     def ready_endpoints(self) -> List[str]:
