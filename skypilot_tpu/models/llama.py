@@ -269,46 +269,50 @@ def init_params(config: LlamaConfig, key: jax.Array,
     return params
 
 
-def param_sharding_rules(config: LlamaConfig) -> Params:
-    """PartitionSpec per param over mesh axes (dp, fsdp, ep, tp).
+def param_sharding_rules(config: LlamaConfig,
+                         pipeline: bool = False) -> Params:
+    """PartitionSpec per param over mesh axes (pp, fsdp, ep, tp).
 
     TP shards heads / ffn-hidden / vocab; FSDP shards the other big
     axis (ZeRO-3). Non-expert params fold 'ep' into the fsdp group
     (so an expert-parallel mesh still ZeRO-shards the dense weights);
     expert-stacked weights shard their expert axis over 'ep'. The
-    scan-stacked layer axis stays replicated.
+    scan-stacked layer axis is replicated, EXCEPT under pipeline
+    parallelism (``pipeline=True``) where it shards over 'pp' so each
+    stage holds only its own layers.
     """
+    pl = 'pp' if pipeline else None
     fs = ('fsdp', 'ep')
     if config.n_experts:
         mlp_rules = {
-            'router': P(None, fs, None),
-            'w_gate': P(None, 'ep', 'fsdp', 'tp'),
-            'w_up': P(None, 'ep', 'fsdp', 'tp'),
-            'w_down': P(None, 'ep', 'tp', 'fsdp'),
+            'router': P(pl, fs, None),
+            'w_gate': P(pl, 'ep', 'fsdp', 'tp'),
+            'w_up': P(pl, 'ep', 'fsdp', 'tp'),
+            'w_down': P(pl, 'ep', 'tp', 'fsdp'),
         }
     else:
         mlp_rules = {
-            'w_gate': P(None, fs, 'tp'),
-            'w_up': P(None, fs, 'tp'),
-            'w_down': P(None, 'tp', fs),
+            'w_gate': P(pl, fs, 'tp'),
+            'w_up': P(pl, fs, 'tp'),
+            'w_down': P(pl, 'tp', fs),
         }
     rules = {
         'embed': P('tp', fs),
         'layers': {
-            'wq': P(None, fs, 'tp'),
-            'wk': P(None, fs, 'tp'),
-            'wv': P(None, fs, 'tp'),
-            'wo': P(None, 'tp', fs),
+            'wq': P(pl, fs, 'tp'),
+            'wk': P(pl, fs, 'tp'),
+            'wv': P(pl, fs, 'tp'),
+            'wo': P(pl, 'tp', fs),
             **mlp_rules,
-            'attn_norm': P(None, None),
-            'mlp_norm': P(None, None),
+            'attn_norm': P(pl, None),
+            'mlp_norm': P(pl, None),
         },
         'final_norm': P(None),
     }
     if config.qkv_bias:
-        rules['layers']['bq'] = P(None, 'tp')
-        rules['layers']['bk'] = P(None, 'tp')
-        rules['layers']['bv'] = P(None, 'tp')
+        rules['layers']['bq'] = P(pl, 'tp')
+        rules['layers']['bk'] = P(pl, 'tp')
+        rules['layers']['bv'] = P(pl, 'tp')
     if not config.tie_embeddings:
         rules['lm_head'] = P(fs, 'tp')
     return rules
@@ -499,6 +503,56 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     return x, jnp.zeros((), jnp.float32)
 
 
+def default_attn_impl():
+    """Single-device/auto-sharded attention: the Pallas flash kernel
+    with RoPE fused in (shared default of ``forward_hidden`` and the
+    pipeline-parallel path)."""
+    return lambda q, k, v, ang: attention_ops.flash_attention(
+        q, k, v, causal=True, rope_angles=ang)
+
+
+def embed_tokens(cparams: Params, tokens: jax.Array,
+                 config: LlamaConfig) -> jax.Array:
+    """Token embedding lookup (+ Gemma's sqrt(dim) scaling) on
+    compute-dtype params."""
+    x = cparams['embed'][tokens]
+    if config.scale_embeddings:
+        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
+    return x
+
+
+def layer_remat_policy(config: LlamaConfig):
+    """The per-layer remat save policy implied by
+    ``config.remat_saves`` (+ flash-attention outputs, + MoE dispatch
+    one-hots) — shared by ``forward_hidden`` and
+    ``parallel/pipeline.py`` so pipelined stages save exactly what the
+    plain scan does."""
+    tokens_ = config.remat_saves.split('+')  # validated in config
+    extra = []
+    if 'mlp' in tokens_:
+        extra += ['mlp_gate', 'mlp_up']
+    if 'mlp_up' in tokens_:
+        extra.append('mlp_up')
+    if 'qkv' in tokens_:
+        extra.append('qkv')
+    if config.n_experts:
+        # Dispatch/combine one-hots are cheap to keep and costly to
+        # rebuild (cumsum over [B, T*k, E]) — always save.
+        extra.append('moe_dispatch')
+    base = (jax.checkpoint_policies.save_only_these_names(*extra)
+            if extra else None)
+    return attention_ops.remat_policy(base_policy=base)
+
+
+def shifted_loss_mask(batch: Dict[str, jax.Array],
+                      targets: jax.Array) -> jax.Array:
+    """loss_mask aligns with ``tokens``: position i contributes iff
+    its *target* token i+1 is unmasked."""
+    mask = batch.get('loss_mask')
+    return (jnp.ones_like(targets, jnp.float32) if mask is None
+            else mask.astype(jnp.float32)[:, 1:])
+
+
 def forward_hidden(params: Params, tokens: jax.Array,
                    config: LlamaConfig,
                    positions: Optional[jax.Array] = None,
@@ -522,8 +576,7 @@ def forward_hidden(params: Params, tokens: jax.Array,
     communication).
     """
     if attn_impl is None:
-        attn_impl = lambda q, k, v, ang: attention_ops.flash_attention(
-            q, k, v, causal=True, rope_angles=ang)
+        attn_impl = default_attn_impl()
     _, t = tokens.shape
     if positions is None:
         positions = jnp.arange(t)
@@ -533,9 +586,7 @@ def forward_hidden(params: Params, tokens: jax.Array,
     # gradients flow back to the (possibly fp32) master params.
     cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
 
-    x = cparams['embed'][tokens]  # [B, T, D] gather
-    if config.scale_embeddings:
-        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
+    x = embed_tokens(cparams, tokens, config)  # [B, T, D] gather
     if activation_sharding is not None:
         x = jax.lax.with_sharding_constraint(x, activation_sharding)
 
@@ -557,23 +608,8 @@ def forward_hidden(params: Params, tokens: jax.Array,
         # v5e vs ~66 MB/layer to save out+lse) and, depending on
         # ``config.remat_saves``, the big matmul outputs — see the
         # field's docstring for the memory/recompute trade.
-        tokens_ = config.remat_saves.split('+')  # validated in config
-        extra = []
-        if 'mlp' in tokens_:
-            extra += ['mlp_gate', 'mlp_up']
-        if 'mlp_up' in tokens_:
-            extra.append('mlp_up')
-        if 'qkv' in tokens_:
-            extra.append('qkv')
-        if config.n_experts:
-            # Dispatch/combine one-hots are cheap to keep and costly
-            # to rebuild (cumsum over [B, T*k, E]) — always save.
-            extra.append('moe_dispatch')
-        base = (jax.checkpoint_policies.save_only_these_names(*extra)
-                if extra else None)
-        body = jax.checkpoint(
-            scan_body, prevent_cse=False,
-            policy=attention_ops.remat_policy(base_policy=base))
+        body = jax.checkpoint(scan_body, prevent_cse=False,
+                              policy=layer_remat_policy(config))
     clora = None
     if lora is not None:
         clora = jax.tree.map(lambda p: p.astype(config.dtype), lora)
@@ -731,13 +767,25 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
         params, inputs, config, lora=lora, lora_scale=lora_scale,
         attn_impl=attn_impl, activation_sharding=activation_sharding,
         with_aux=True, mesh=mesh)
-    mask = batch.get('loss_mask')
-    # loss_mask aligns with ``tokens``: position i contributes iff its
-    # *target* token i+1 is unmasked.
-    mask = (jnp.ones_like(targets, jnp.float32) if mask is None
-            else mask.astype(jnp.float32)[:, 1:])
-    lm_head = output_head(params, config)
+    mask = shifted_loss_mask(batch, targets)
 
+    # The head is frozen exactly when training LoRA adapters — skip
+    # the [D, V] grad matmul then (its cotangent would be dead).
+    ce = loss_from_hidden(params, hidden, targets, mask, config,
+                          train_lm_head=lora is None)
+    if config.n_experts:
+        ce = ce + config.moe_aux_coef * moe_aux
+    return ce
+
+
+def loss_from_hidden(params: Params, hidden: jax.Array,
+                     targets: jax.Array, mask: jax.Array,
+                     config: LlamaConfig,
+                     train_lm_head: bool = True) -> jax.Array:
+    """Chunked fused LM-head + CE over final hidden states (shared by
+    ``loss_fn`` and the pipeline-parallel loss in
+    ``parallel/pipeline.py``)."""
+    lm_head = output_head(params, config)
     b, t, d = hidden.shape
     chunk = LOSS_CHUNK if t % LOSS_CHUNK == 0 else t
     n = t // chunk
@@ -745,10 +793,5 @@ def loss_fn(params: Params, batch: Dict[str, jax.Array],
     hid = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
     tgt = targets.reshape(b, n, chunk).transpose(1, 0, 2)
     msk = mask.reshape(b, n, chunk).transpose(1, 0, 2)
-
-    # The head is frozen exactly when training LoRA adapters — skip
-    # the [D, V] grad matmul then (its cotangent would be dead).
-    ce = _fused_ce(train_lm_head=lora is None)(hid, lm_head, tgt, msk)
-    if config.n_experts:
-        ce = ce + config.moe_aux_coef * moe_aux
-    return ce
+    return _fused_ce(train_lm_head=train_lm_head)(hid, lm_head, tgt,
+                                                  msk)

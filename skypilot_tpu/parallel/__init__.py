@@ -3,7 +3,7 @@ distributed bootstrap.
 
 Replaces the reference's orchestration-only parallelism contract
 (SURVEY.md §2.11: env vars feeding torchrun/NCCL) with in-tree JAX
-SPMD: mesh axes (dp, fsdp, tp, sp), NamedSharding rules, XLA
+SPMD: mesh axes (pp, dp, fsdp, ep, tp, sp), NamedSharding rules, XLA
 collectives over ICI/DCN.
 """
 from skypilot_tpu.parallel.mesh import (
@@ -19,6 +19,7 @@ from skypilot_tpu.parallel.train import (
 )
 from skypilot_tpu.parallel import distributed
 from skypilot_tpu.parallel import lora
+from skypilot_tpu.parallel import pipeline
 
 __all__ = [
     'MeshConfig',
@@ -29,5 +30,6 @@ __all__ = [
     'init_train_state',
     'lora',
     'make_mesh',
+    'pipeline',
     'plan_train_state',
 ]

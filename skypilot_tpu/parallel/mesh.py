@@ -1,12 +1,14 @@
 """Device mesh construction.
 
-Axes (fixed order, outer→inner): ``dp`` (pure data parallel, gradients
-all-reduced over DCN across slices), ``fsdp`` (data parallel with
-weight sharding, ICI), ``ep`` (expert parallel for MoE — experts live
-sharded, token dispatch is an all-to-all; acts as an extra
-data/weight-shard axis for non-expert params), ``tp`` (tensor
-parallel, innermost so its collectives ride the fastest ICI links),
-``sp`` (sequence/context parallel for ring attention).
+Axes (fixed order, outer→inner): ``pp`` (pipeline parallel — stage
+boundaries are point-to-point activation sends, the cheapest traffic,
+so the axis sits outermost where links are slowest), ``dp`` (pure data
+parallel, gradients all-reduced over DCN across slices), ``fsdp``
+(data parallel with weight sharding, ICI), ``ep`` (expert parallel
+for MoE — experts live sharded, token dispatch is an all-to-all; acts
+as an extra data/weight-shard axis for non-expert params), ``tp``
+(tensor parallel, innermost so its collectives ride the fastest ICI
+links), ``sp`` (sequence/context parallel for ring attention).
 
 The scaling-book recipe: pick the mesh, annotate shardings, let XLA
 insert collectives.
@@ -19,11 +21,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ('dp', 'fsdp', 'ep', 'tp', 'sp')
+AXES = ('pp', 'dp', 'fsdp', 'ep', 'tp', 'sp')
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     ep: int = 1
@@ -32,11 +35,12 @@ class MeshConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.ep * self.tp * self.sp
+        return (self.pp * self.dp * self.fsdp * self.ep * self.tp *
+                self.sp)
 
     def shape(self):
-        return {'dp': self.dp, 'fsdp': self.fsdp, 'ep': self.ep,
-                'tp': self.tp, 'sp': self.sp}
+        return {'pp': self.pp, 'dp': self.dp, 'fsdp': self.fsdp,
+                'ep': self.ep, 'tp': self.tp, 'sp': self.sp}
 
 
 def num_slices_from_env() -> int:
@@ -49,10 +53,10 @@ def num_slices_from_env() -> int:
 
 def auto_mesh_config(n_devices: Optional[int] = None,
                      tp: int = 1, sp: int = 1,
-                     dp: int = 1, ep: int = 1,
+                     dp: int = 1, ep: int = 1, pp: int = 1,
                      num_slices: int = 1) -> MeshConfig:
-    """Default strategy: everything not claimed by tp/sp/dp/ep goes to
-    fsdp (ZeRO-3 weight sharding is the memory-optimal default for
+    """Default strategy: everything not claimed by pp/tp/sp/dp/ep goes
+    to fsdp (ZeRO-3 weight sharding is the memory-optimal default for
     8B-class models on v5e/v6e).
 
     ``num_slices`` > 1: dp is raised to (a multiple of) the slice
@@ -63,13 +67,13 @@ def auto_mesh_config(n_devices: Optional[int] = None,
         n_devices = len(jax.devices())
     if num_slices > 1 and dp % num_slices != 0:
         dp = dp * num_slices
-    claimed = tp * sp * dp * ep
+    claimed = tp * sp * dp * ep * pp
     if n_devices % claimed != 0:
         raise ValueError(
-            f'n_devices={n_devices} not divisible by tp*sp*dp*ep='
+            f'n_devices={n_devices} not divisible by tp*sp*dp*ep*pp='
             f'{claimed}')
-    return MeshConfig(dp=dp, fsdp=n_devices // claimed, ep=ep, tp=tp,
-                      sp=sp)
+    return MeshConfig(pp=pp, dp=dp, fsdp=n_devices // claimed, ep=ep,
+                      tp=tp, sp=sp)
 
 
 def make_mesh(config: Optional[MeshConfig] = None,
@@ -107,13 +111,14 @@ def make_mesh(config: Optional[MeshConfig] = None,
             from jax.experimental import mesh_utils
             arr = mesh_utils.create_hybrid_device_mesh(
                 # per-slice (ICI) shape x cross-slice (DCN) shape.
-                (config.dp // num_slices, config.fsdp, config.ep,
-                 config.tp, config.sp),
-                (num_slices, 1, 1, 1, 1),
+                (config.pp, config.dp // num_slices, config.fsdp,
+                 config.ep, config.tp, config.sp),
+                (1, num_slices, 1, 1, 1, 1),
                 devices=devices)
             return Mesh(arr, AXES)
-    arr = np.asarray(devices).reshape(config.dp, config.fsdp,
-                                      config.ep, config.tp, config.sp)
+    arr = np.asarray(devices).reshape(config.pp, config.dp,
+                                      config.fsdp, config.ep,
+                                      config.tp, config.sp)
     return Mesh(arr, AXES)
 
 

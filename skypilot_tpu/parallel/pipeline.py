@@ -1,0 +1,190 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe schedule).
+
+The reference has no pipeline parallelism anywhere (SURVEY §2.11 —
+TP/PP/EP/SP absent); this is new TPU-native scope. Design:
+
+- The stacked [L, ...] layer params shard their leading axis over
+  'pp', so each stage holds L/pp layers (``param_sharding_rules``
+  with ``pipeline=True``).
+- The layer stack runs under ``shard_map(axis_names={'pp'})`` —
+  manual over 'pp' only; dp/fsdp/ep/tp stay AUTO, so GSPMD keeps
+  sharding the per-stage matmuls exactly as in the non-pipelined
+  path. Stage boundaries are ``lax.ppermute`` point-to-point sends
+  (the cheapest collective — 'pp' sits on the outermost/slowest mesh
+  dim for this reason).
+- GPipe schedule: the batch splits into ``num_micro`` microbatches;
+  step s has stage i computing microbatch s-i. The pipeline runs
+  num_micro + pp - 1 steps; the pp-1 bubble steps compute on junk
+  that is masked out at collection, which also zeroes its gradients.
+  Bubble fraction = (pp-1)/(num_micro+pp-1): raise num_micro to
+  amortize.
+
+Embedding and the fused LM-head/CE loss run OUTSIDE the shard_map,
+replicated over 'pp' (auto-sharded over the data/tp axes as usual) —
+redundant compute on pp-1 stages, but both are O(1 matmul) next to
+the L-layer stack and it keeps the pipeline body free of
+stage-conditional parameter access.
+"""
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+
+Params = llama.Params
+
+
+def validate_pipeline_config(config: llama.LlamaConfig, mesh: Mesh,
+                             lora_rank: Optional[int] = None) -> None:
+    """Structural checks for a pp>1 mesh (called once, from
+    ``plan_train_state``; batch/num_micro divisibility is enforced at
+    trace time in ``pipelined_layers``)."""
+    pp = mesh.shape['pp']
+    if config.n_layers % pp != 0:
+        raise ValueError(
+            f'n_layers={config.n_layers} not divisible by pp={pp}')
+    if config.n_experts:
+        raise NotImplementedError(
+            'MoE + pipeline parallelism is not supported yet '
+            '(shard experts over ep instead)')
+    if lora_rank is not None:
+        raise NotImplementedError(
+            'LoRA + pipeline parallelism is not supported yet')
+    if mesh.shape.get('sp', 1) > 1:
+        raise NotImplementedError(
+            'sequence parallelism inside a pipeline stage is not '
+            'supported yet')
+
+
+def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
+                     x: jax.Array, stacked_params: Params,
+                     mesh: Mesh, num_micro: int,
+                     remat=None) -> jax.Array:
+    """Run ``x`` [B, T, D] through the pp-sharded layer stack.
+
+    ``layer_fn(x_mb, layer_params) -> y_mb`` applies ONE layer;
+    ``stacked_params`` leaves are [L, ...] with L sharded over 'pp'.
+    B must be divisible by num_micro. ``remat``: a checkpoint policy
+    to remat each layer with (None = no remat).
+    """
+    pp = mesh.shape['pp']
+    b = x.shape[0]
+    if b % num_micro != 0:
+        raise ValueError(
+            f'batch {b} not divisible by num_micro={num_micro}')
+
+    one_layer = layer_fn
+    if remat is not None:
+        one_layer = jax.checkpoint(layer_fn, prevent_cse=False,
+                                   policy=remat)
+
+    def stage_fn(x_mb, params_local):
+        y, _ = jax.lax.scan(
+            lambda c, lp: (one_layer(c, lp), None), x_mb, params_local)
+        return y
+
+    def body(x_full, params_local):
+        # x_full: [B, T, D] (replicated over pp, auto over the rest);
+        # params_local: [L/pp, ...].
+        idx = jax.lax.axis_index('pp')
+        mb = b // num_micro
+        micro = x_full.reshape(num_micro, mb, *x_full.shape[1:])
+        # pcast: the carries start as pp-invariant zeros but become
+        # pp-varying inside the scan (ppermute/axis_index), so their
+        # varying-axes type must be declared up front.
+        buf = jax.lax.pcast(jnp.zeros(micro.shape[1:], x_full.dtype),
+                            ('pp',), to='varying')
+        outs = jax.lax.pcast(jnp.zeros(micro.shape, x_full.dtype),
+                             ('pp',), to='varying')
+
+        def step(carry, s):
+            buf, outs = carry
+            # Stage 0 ingests microbatch s; later stages consume the
+            # rotated-in activation from the previous stage.
+            inp = jax.lax.dynamic_index_in_dim(
+                micro, jnp.clip(s, 0, num_micro - 1), axis=0,
+                keepdims=False)
+            xin = jnp.where(idx == 0, inp, buf)
+            y = stage_fn(xin, params_local)
+            # The LAST stage finished microbatch s-(pp-1) — record it
+            # (masked off during the pp-1 warmup bubble).
+            out_idx = s - (pp - 1)
+            valid = (out_idx >= 0) & (idx == pp - 1)
+            oi = jnp.clip(out_idx, 0, num_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, oi, axis=0,
+                                               keepdims=False)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, cur), oi, axis=0)
+            # Rotate activations one stage forward (ring: the wrap
+            # edge pp-1 -> 0 carries junk that stage 0 ignores).
+            buf = jax.lax.ppermute(
+                y, 'pp', [(i, (i + 1) % pp) for i in range(pp)])
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            step, (buf, outs), jnp.arange(num_micro + pp - 1))
+        # Only the last stage holds real outputs; zero-and-psum
+        # replicates them to every stage.
+        outs = jnp.where(idx == pp - 1, outs, 0)
+        outs = jax.lax.psum(outs, 'pp')
+        return outs.reshape(x_full.shape)
+
+    fn = jax.shard_map(
+        body, mesh=mesh, axis_names={'pp'},
+        in_specs=(P(), jax.tree.map(lambda _: P('pp'),
+                                    stacked_params)),
+        out_specs=P())
+    return fn(x, stacked_params)
+
+
+def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
+                        num_micro: Optional[int] = None
+                        ) -> Callable[[Params, Dict[str, jax.Array]],
+                                      jax.Array]:
+    """A drop-in replacement for ``llama.loss_fn`` whose layer stack
+    runs pipelined over 'pp'. Same batch contract: tokens [B, T+1]."""
+    pp = mesh.shape['pp']
+    if num_micro is None:
+        # 2x stages halves the bubble vs num_micro=pp; keep it a
+        # divisor-friendly default.
+        num_micro = 2 * pp
+    if num_micro < 1:
+        raise ValueError(f'num_micro={num_micro} must be >= 1')
+
+    attn_impl = llama.default_attn_impl()
+    remat = llama.layer_remat_policy(config) if config.remat else None
+
+    def loss(params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+        tokens = batch['tokens']
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        _, t = inputs.shape
+        angles = llama._rope_frequencies(config, jnp.arange(t))
+
+        cparams = jax.tree.map(lambda p: p.astype(config.dtype),
+                               params)
+        x = llama.embed_tokens(cparams, inputs, config)
+
+        def layer_fn(x_mb, layer_params):
+            y, _ = llama._layer(config, x_mb, layer_params, angles,
+                                attn_impl)
+            return y
+
+        hidden = pipelined_layers(layer_fn, x, cparams['layers'],
+                                  mesh, num_micro, remat=remat)
+        hidden = llama._rms_norm(hidden, cparams['final_norm'],
+                                 config.norm_eps, config.norm_offset)
+
+        # Gradients flow to cparams (the bf16 cast) and back to the
+        # master params through jax.tree.map's cast — same mixed-
+        # precision path as llama.forward_hidden.
+        return llama.loss_from_hidden(
+            cparams, hidden, targets,
+            llama.shifted_loss_mask(batch, targets), config,
+            train_lm_head=True)
+
+    return loss

@@ -81,7 +81,12 @@ def plan_train_state(config: llama.LlamaConfig, mesh,
         optimizer = default_optimizer()
     if key is None:
         key = jax.random.PRNGKey(0)
-    rules = llama.param_sharding_rules(config)
+    use_pp = mesh.shape.get('pp', 1) > 1
+    if use_pp:
+        from skypilot_tpu.parallel import pipeline as pipeline_lib
+        pipeline_lib.validate_pipeline_config(config, mesh,
+                                              lora_rank=lora_rank)
+    rules = llama.param_sharding_rules(config, pipeline=use_pp)
     param_shardings = _sharding_tree(rules, mesh)
 
     def _init() -> TrainState:
@@ -201,7 +206,8 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                      optimizer: Optional[
                          optax.GradientTransformation] = None,
                      lora_scale: float = 2.0,
-                     donate: bool = True
+                     donate: bool = True,
+                     pipeline_microbatches: Optional[int] = None
                      ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                    Tuple[TrainState, Dict[str, jax.Array]]]:
     """The full training step: loss → grad → optimizer update, jitted
@@ -209,15 +215,27 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
 
     When the mesh has an ``sp`` axis > 1, activations shard their
     sequence dim over it and attention runs as ring attention
-    (long-context: per-device memory stays O(T / sp))."""
+    (long-context: per-device memory stays O(T / sp)). A ``pp`` axis
+    > 1 runs the layer stack as a GPipe pipeline
+    (``parallel/pipeline.py``) with ``pipeline_microbatches``
+    microbatches (default 2*pp)."""
     if optimizer is None:
         optimizer = default_optimizer()
     is_lora = state_shardings.lora is not None
 
     use_sp = mesh.shape.get('sp', 1) > 1
+    use_pp = mesh.shape.get('pp', 1) > 1
     attn_impl = make_ring_attention_impl(mesh) if use_sp else None
     act_sharding = NamedSharding(
         mesh, P(('dp', 'fsdp', 'ep'), 'sp', None)) if use_sp else None
+
+    pp_loss = None
+    if use_pp:
+        from skypilot_tpu.parallel import pipeline as pipeline_lib
+        pipeline_lib.validate_pipeline_config(
+            config, mesh, lora_rank=1 if is_lora else None)
+        pp_loss = pipeline_lib.build_pipeline_loss(
+            config, mesh, num_micro=pipeline_microbatches)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         if is_lora:
@@ -237,6 +255,8 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                                    opt_state=new_opt, lora=new_lora)
         else:
             def loss_of(params):
+                if pp_loss is not None:
+                    return pp_loss(params, batch)
                 return llama.loss_fn(
                     params, batch, config, attn_impl=attn_impl,
                     activation_sharding=act_sharding, mesh=mesh)

@@ -40,6 +40,10 @@ def parse_args():
                    help='expert-parallel degree (MoE models)')
     p.add_argument('--sp', type=int, default=1,
                    help='sequence-parallel degree (ring attention)')
+    p.add_argument('--pp', type=int, default=1,
+                   help='pipeline-parallel degree (GPipe schedule)')
+    p.add_argument('--microbatches', type=int, default=None,
+                   help='pipeline microbatches (default 2*pp)')
     p.add_argument('--data', default=None,
                    help='tokenized dataset (.npy of token ids)')
     p.add_argument('--synthetic', action='store_true', default=None)
@@ -91,7 +95,8 @@ def main():
     from skypilot_tpu.parallel import mesh as mesh_lib
     num_slices = mesh_lib.num_slices_from_env()
     mesh_cfg = auto_mesh_config(tp=args.tp, dp=args.dp, ep=args.ep,
-                                sp=args.sp, num_slices=num_slices)
+                                sp=args.sp, pp=args.pp,
+                                num_slices=num_slices)
     mesh = make_mesh(mesh_cfg, num_slices=num_slices)
     if jax.process_index() == 0:
         print(f'devices={jax.device_count()} mesh={mesh_cfg} '
@@ -106,7 +111,8 @@ def main():
         param_dtype=param_dtype,
         lora_rank=None if args.full_ft else args.lora_rank)
     step_fn = build_train_step(config, mesh, shardings,
-                               optimizer=optimizer)
+                               optimizer=optimizer,
+                               pipeline_microbatches=args.microbatches)
 
     ckpt = None
     start_step = 0

@@ -37,8 +37,8 @@ class TestMesh:
 
     def test_make_mesh(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
-        assert mesh.shape == {'dp': 2, 'fsdp': 2, 'ep': 1, 'tp': 2,
-                              'sp': 1}
+        assert mesh.shape == {'pp': 1, 'dp': 2, 'fsdp': 2, 'ep': 1,
+                              'tp': 2, 'sp': 1}
 
     def test_batch_size_per_device(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
