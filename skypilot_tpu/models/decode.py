@@ -129,13 +129,19 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
 
 
 def forward_cached(params: Params, tokens: jax.Array,
-                   cache: KVCache, config: llama.LlamaConfig
+                   cache: KVCache, config: llama.LlamaConfig,
+                   last_only: bool = False
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, T] at absolute positions
     [cache.pos, cache.pos + T) and append to the cache. Returns
     (logits [B, T, vocab] f32, new cache). Used both for prefill
     (T = prompt length) and decode (T = 1) — same compiled step per
-    distinct T."""
+    distinct T.
+
+    ``last_only`` (static): project only the final position through
+    the LM head — prefill feeding greedy decode needs just
+    logits[:, -1], and skipping the rest avoids materializing a
+    [B, T, 128k-vocab] f32 tensor (4.2 GB at B=8, T=1024)."""
     cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
     _, t = tokens.shape
     positions = cache.pos + jnp.arange(t)
@@ -155,11 +161,38 @@ def forward_cached(params: Params, tokens: jax.Array,
 
     (x, _), (new_k, new_v) = jax.lax.scan(
         body, (x, cache.pos), (cparams['layers'], cache.k, cache.v))
+    if last_only:
+        x = x[:, -1:]
     x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
                         config.norm_offset)
     logits = (x @ llama.output_head(cparams, config)
               ).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
+
+
+def decode_tokens_scan(params: Params, first: jax.Array,
+                       cache: KVCache, config: llama.LlamaConfig,
+                       num_tokens: int) -> Tuple[jax.Array, KVCache]:
+    """Greedy-decode ``num_tokens`` further tokens ENTIRELY on device:
+    a single ``lax.scan`` carries (token, cache), so one dispatch
+    serves the whole generation. This is the serving hot loop — the
+    Python-loop ``greedy_generate`` pays a host round-trip per token
+    (~tens of ms each through a tunneled device), which dwarfs the
+    ~4 ms weight-read time of a 1B-class decode step.
+
+    first: [B] the most recent token per row. Returns
+    ([B, num_tokens] generated ids, final cache).
+    """
+
+    def body(carry, _):
+        tok, kv = carry
+        logits, kv = forward_cached(params, tok[:, None], kv, config)
+        nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        return (nxt, kv), nxt
+
+    (_, cache), toks = jax.lax.scan(body, (first, cache), None,
+                                    length=num_tokens)
+    return toks.swapaxes(0, 1), cache
 
 
 def greedy_generate(params: Params, prompt: jax.Array,
@@ -183,18 +216,26 @@ def greedy_generate(params: Params, prompt: jax.Array,
         return jnp.zeros((b, 0), jnp.int32)
     cache = init_cache(config, b, max_seq)
 
-    step = jax.jit(forward_cached, static_argnums=(3,),
+    step = jax.jit(forward_cached, static_argnums=(3, 4),
                    donate_argnums=(2,))
 
-    logits, cache = step(params, prompt, cache, config)
+    logits, cache = step(params, prompt, cache, config, True)
     nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
-    done = (jnp.zeros((b,), bool) if eos_id is None
-            else nxt == eos_id)
+    if eos_id is None:
+        # No early exit wanted: run the whole generation as one
+        # device-side scan (one dispatch instead of one per token).
+        scan_fn = jax.jit(decode_tokens_scan, static_argnums=(3, 4),
+                          donate_argnums=(2,))
+        toks, _ = scan_fn(params, nxt, cache, config,
+                          max_new_tokens - 1)
+        return jnp.concatenate([nxt[:, None], toks], axis=1)
+    done = nxt == eos_id
     out = [nxt]
     for _ in range(max_new_tokens - 1):
         if eos_id is not None and bool(done.all()):
             break
-        logits, cache = step(params, nxt[:, None], cache, config)
+        logits, cache = step(params, nxt[:, None], cache, config,
+                             True)
         nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
         if eos_id is not None:
             # Per-row: once a row emitted EOS it keeps emitting EOS.

@@ -35,20 +35,26 @@ def main():
     lock = threading.Lock()
 
     def generate(prompt_ids, max_new):
-        # KV-cache decode: prefill once, O(1) per token (was a full
-        # re-forward per token — O(T^2) per reply). jit caches one
-        # prefill executable per distinct prompt length plus one
-        # shared 1-token decode step; bucketing prompt lengths to
-        # bound compilations is the next optimization if needed.
+        # KV-cache decode: prefill once, then ONE device-side scan for
+        # the whole generation (decode.decode_tokens_scan). The scan
+        # length is a static compile parameter, so requested lengths
+        # are bucketed to powers of two and truncated — otherwise
+        # every distinct client max_new_tokens would pay a full-model
+        # recompile while holding the serve lock. (Bucketing prompt
+        # lengths the same way is the next optimization if needed.)
         tokens = jnp.asarray([prompt_ids], jnp.int32)
         max_new = min(max_new,
                       config.max_seq_len - tokens.shape[1])
         if max_new <= 0:
             return []
+        bucket = 1
+        while bucket < max_new:
+            bucket *= 2
+        bucket = min(bucket, config.max_seq_len - tokens.shape[1])
         with lock:
             out = decode.greedy_generate(params, tokens, config,
-                                         max_new_tokens=max_new)
-        return [int(t) for t in out[0]]
+                                         max_new_tokens=bucket)
+        return [int(t) for t in out[0][:max_new]]
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = 'HTTP/1.1'

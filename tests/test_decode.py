@@ -96,6 +96,23 @@ class TestGreedyGenerate:
         assert list(np.asarray(out[0])) == naive
 
 
+    def test_scan_decoder_matches_token_loop(self, setup):
+        """The device-side scan decode (eos_id=None path) must equal
+        the per-token Python loop (eos path, with an EOS id that
+        never fires)."""
+        config, params = setup
+        prompt = jnp.asarray([[5, 9, 2, 7], [1, 2, 3, 4]], jnp.int32)
+        scan_out = decode.greedy_generate(params, prompt, config,
+                                          max_new_tokens=5,
+                                          max_seq=16)
+        loop_out = decode.greedy_generate(params, prompt, config,
+                                          max_new_tokens=5,
+                                          max_seq=16,
+                                          eos_id=config.vocab_size)
+        np.testing.assert_array_equal(np.asarray(scan_out),
+                                      np.asarray(loop_out))
+
+
 class TestGenerateEdgeCases:
 
     def test_zero_max_new_tokens(self, setup):
