@@ -170,6 +170,32 @@ def forward_cached(params: Params, tokens: jax.Array,
     return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
 
 
+def decode_shardings(config: llama.LlamaConfig, mesh,
+                     shard_batch: bool = True):
+    """(param_shardings, cache_shardings) for sharded serving on a
+    mesh — models too big for one chip decode tensor-parallel: params
+    follow ``llama.param_sharding_rules`` (heads/ffn over 'tp',
+    ZeRO-style over the fsdp group), the KV cache shards its KV-head
+    axis over 'tp' and — with ``shard_batch`` — batch over the data
+    axes (pass False when the serving batch is smaller than the
+    data-parallel degree, e.g. single-request replicas). GSPMD
+    propagates the activation shardings; the per-layer all-reduces
+    ride ICI exactly as in training."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from skypilot_tpu.parallel.train import sharding_tree
+
+    rules = llama.param_sharding_rules(config)
+    param_sh = sharding_tree(rules, mesh)
+    batch_axes = ('dp', 'fsdp', 'ep') if shard_batch else None
+    kv_spec = NamedSharding(mesh, P(None, batch_axes, None, 'tp',
+                                    None))
+    cache_sh = KVCache(k=kv_spec, v=kv_spec,
+                       pos=NamedSharding(mesh, P()))
+    return param_sh, cache_sh
+
+
 def decode_tokens_scan(params: Params, first: jax.Array,
                        cache: KVCache, config: llama.LlamaConfig,
                        num_tokens: int) -> Tuple[jax.Array, KVCache]:
@@ -198,7 +224,9 @@ def decode_tokens_scan(params: Params, first: jax.Array,
 def greedy_generate(params: Params, prompt: jax.Array,
                     config: llama.LlamaConfig, max_new_tokens: int,
                     max_seq: Optional[int] = None,
-                    eos_id: Optional[int] = None) -> jax.Array:
+                    eos_id: Optional[int] = None,
+                    cache_sharding: Optional[KVCache] = None
+                    ) -> jax.Array:
     """Greedy decode: prefill the prompt once, then one cached step
     per token. prompt: [B, T0] -> [B, <=max_new_tokens] generated ids
     (rows that hit ``eos_id`` are padded with it thereafter).
@@ -206,7 +234,9 @@ def greedy_generate(params: Params, prompt: jax.Array,
     One jitted callable serves both phases — jit caches one
     executable per distinct T (the T0-length prefill and the shared
     T=1 decode step); the cache buffers are donated so generation
-    runs in-place in HBM.
+    runs in-place in HBM. ``cache_sharding``: a KVCache of
+    NamedShardings (``decode_shardings``) pinning the cache layout
+    for tensor-parallel serving.
     """
     max_seq = max_seq or config.max_seq_len
     b, t0 = prompt.shape
@@ -215,6 +245,8 @@ def greedy_generate(params: Params, prompt: jax.Array,
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), jnp.int32)
     cache = init_cache(config, b, max_seq)
+    if cache_sharding is not None:
+        cache = jax.device_put(cache, cache_sharding)
 
     step = jax.jit(forward_cached, static_argnums=(3, 4),
                    donate_argnums=(2,))

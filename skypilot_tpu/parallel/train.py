@@ -53,10 +53,15 @@ def default_optimizer(learning_rate: float = 3e-4,
     )
 
 
-def _sharding_tree(rules: Params, mesh: Mesh):
+def sharding_tree(rules: Params, mesh: Mesh):
+    """PartitionSpec tree -> NamedSharding tree (shared helper; also
+    used by models/decode.decode_shardings)."""
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), rules,
         is_leaf=lambda x: isinstance(x, P))
+
+
+_sharding_tree = sharding_tree
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

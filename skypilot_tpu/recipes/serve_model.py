@@ -22,6 +22,10 @@ def main():
                         default=int(os.environ.get(
                             'SKYTPU_REPLICA_PORT', '8080')))
     parser.add_argument('--max-new-tokens', type=int, default=32)
+    parser.add_argument('--tp', type=int, default=1,
+                        help='tensor-parallel degree for models too '
+                             'big for one chip (shards params + KV '
+                             'cache over the tp mesh axis)')
     args = parser.parse_args()
 
     import jax
@@ -30,7 +34,21 @@ def main():
     from skypilot_tpu.models import decode, llama
 
     config = llama.get_config(args.model)
-    params = llama.init_params(config, jax.random.PRNGKey(0))
+    cache_sh = None
+    if args.tp > 1:
+        from skypilot_tpu.parallel import auto_mesh_config, make_mesh
+        mesh = make_mesh(auto_mesh_config(tp=args.tp))
+        # Single-request replica: cache batch stays replicated.
+        param_sh, cache_sh = decode.decode_shardings(
+            config, mesh, shard_batch=False)
+        # Init DIRECTLY sharded (out_shardings on the jitted init) —
+        # materializing the full pytree on one device first would OOM
+        # for exactly the models --tp exists for.
+        params = jax.jit(
+            lambda: llama.init_params(config, jax.random.PRNGKey(0)),
+            out_shardings=param_sh)()
+    else:
+        params = llama.init_params(config, jax.random.PRNGKey(0))
 
     lock = threading.Lock()
 
@@ -53,7 +71,8 @@ def main():
         bucket = min(bucket, config.max_seq_len - tokens.shape[1])
         with lock:
             out = decode.greedy_generate(params, tokens, config,
-                                         max_new_tokens=bucket)
+                                         max_new_tokens=bucket,
+                                         cache_sharding=cache_sh)
         return [int(t) for t in out[0][:max_new]]
 
     class Handler(BaseHTTPRequestHandler):
