@@ -55,6 +55,11 @@ def serve_main() -> None:
     config = llama.get_config(model_name)
     params = llama.init_params(config, jax.random.PRNGKey(0),
                                dtype=jnp.bfloat16)
+    quantized = os.environ.get('BENCH_QUANT', '0') == '1'
+    if quantized:
+        from skypilot_tpu.models import quant
+        params = jax.jit(quant.quantize_params,
+                         static_argnums=(1,))(params, config)
     max_seq = prompt_len + gen
 
     step = jax.jit(decode.forward_cached, static_argnums=(3, 4),
@@ -116,6 +121,7 @@ def serve_main() -> None:
         'detail': {
             'devices': len(jax.devices()),
             'platform': jax.devices()[0].platform,
+            'weights': 'int8' if quantized else 'bf16',
             'batch': batch,
             'prompt_len': prompt_len,
             'generated': gen,

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.models.quant import matmul as _mm
 
 Params = Dict[str, Any]
 _NEG_INF = -1e30
@@ -93,9 +94,9 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
 
     h = llama._rms_norm(x, layer_params['attn_norm'],
                         config.norm_eps, config.norm_offset)
-    q = h @ layer_params['wq']
-    k = h @ layer_params['wk']
-    v = h @ layer_params['wv']
+    q = _mm(h, layer_params['wq'])
+    k = _mm(h, layer_params['wk'])
+    v = _mm(h, layer_params['wv'])
     if config.qkv_bias:
         q = q + layer_params['bq']
         k = k + layer_params['bk']
@@ -112,7 +113,7 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
 
     attn = _masked_attention(q, k_cache, v_cache, q_pos=pos,
                              kv_len=pos + t, scale=hd ** -0.5)
-    x = x + attn.reshape(b, t, nh * hd) @ layer_params['wo']
+    x = x + _mm(attn.reshape(b, t, nh * hd), layer_params['wo'])
 
     h = llama._rms_norm(x, layer_params['mlp_norm'],
                         config.norm_eps, config.norm_offset)
@@ -121,10 +122,10 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
         x = x + moe_out
     else:
         gate = llama.mlp_act(config)(
-            (h @ layer_params['w_gate']).astype(jnp.float32)
+            _mm(h, layer_params['w_gate']).astype(jnp.float32)
         ).astype(h.dtype)
-        up = h @ layer_params['w_up']
-        x = x + (gate * up) @ layer_params['w_down']
+        up = _mm(h, layer_params['w_up'])
+        x = x + _mm(gate * up, layer_params['w_down'])
     return x, k_cache, v_cache
 
 
@@ -142,7 +143,12 @@ def forward_cached(params: Params, tokens: jax.Array,
     the LM head — prefill feeding greedy decode needs just
     logits[:, -1], and skipping the rest avoids materializing a
     [B, T, 128k-vocab] f32 tensor (4.2 GB at B=8, T=1024)."""
-    cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
+    # int8 leaves (weight-only quantization, models/quant.py) must NOT
+    # be upcast here — they cross HBM as int8 and convert in-register
+    # inside the matmuls.
+    cparams = jax.tree.map(
+        lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
+        params)
     _, t = tokens.shape
     positions = cache.pos + jnp.arange(t)
     angles = llama._rope_frequencies(config, positions)
@@ -165,8 +171,12 @@ def forward_cached(params: Params, tokens: jax.Array,
         x = x[:, -1:]
     x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
                         config.norm_offset)
-    logits = (x @ llama.output_head(cparams, config)
-              ).astype(jnp.float32)
+    if config.tie_embeddings:
+        logits = (x @ llama.output_head(cparams, config)
+                  ).astype(jnp.float32)
+    else:
+        # _mm absorbs the quantized-vs-plain distinction.
+        logits = _mm(x, cparams['lm_head']).astype(jnp.float32)
     return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
 
 

@@ -1,0 +1,75 @@
+"""Weight-only int8 quantization for serving.
+
+Decode throughput on a single chip is weight-bandwidth-bound: every
+generated token re-reads all matmul weights from HBM. Symmetric
+per-output-channel int8 halves that traffic vs bf16; the int8->bf16
+convert is fused by XLA into the dot-general's operand read (the
+weights cross HBM as int8), and the per-channel scale applies AFTER
+the matmul, which is exact for per-output-channel scaling.
+
+Scope (v1): the stacked layer projections (wq/wk/wv/wo, gate/up/down)
+and the LM head. Embedding stays bf16 (decode gathers one row per
+token — negligible traffic); norms/biases stay bf16 (tiny); MoE
+expert weights and the KV cache are not quantized yet.
+
+The reference has no quantization anywhere (serving is delegated to
+external engines, ``llm/vllm/service.yaml``); this is TPU-native new
+scope.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+
+Params = Dict[str, Any]
+
+# Leaves under params['layers'] that are [L, in, out] matmul weights.
+_LAYER_MATMULS = ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down')
+
+
+def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel int8: w ~= q * s with q int8 and
+    s = amax/127 reduced over the contraction axis (-2) only — any
+    leading axes (the stacked layer dim) keep their own scales so the
+    pair scans layer-by-layer alongside the weights."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return {'q': q, 's': s.astype(jnp.bfloat16)}
+
+
+def matmul(x: jax.Array, w) -> jax.Array:
+    """x @ w for plain or quantized ({'q','s'}) weights. The int8
+    operand converts in-register (XLA fuses it into the dot); the
+    scale is applied to the f32/bf16 product per output channel."""
+    if isinstance(w, dict) and 'q' in w:
+        out = x @ w['q'].astype(x.dtype)
+        return out * w['s'].astype(out.dtype)
+    return x @ w
+
+
+def quantize_params(params: Params, config: llama.LlamaConfig
+                    ) -> Params:
+    """Return a params pytree with the big matmul weights replaced by
+    {'q': int8, 's': bf16} pairs (shape-compatible with the decode
+    path via ``matmul``)."""
+    if config.n_experts:
+        raise NotImplementedError(
+            'int8 quantization of MoE expert weights is not '
+            'supported yet')
+    out = dict(params)
+    layers = dict(params['layers'])
+    for name in _LAYER_MATMULS:
+        layers[name] = quantize_weight(layers[name])
+    out['layers'] = layers
+    if 'lm_head' in params:
+        out['lm_head'] = quantize_weight(params['lm_head'])
+    return out
+
+
+def is_quantized(params: Params) -> bool:
+    wq = params.get('layers', {}).get('wq')
+    return isinstance(wq, dict) and 'q' in wq

@@ -26,7 +26,14 @@ def main():
                         help='tensor-parallel degree for models too '
                              'big for one chip (shards params + KV '
                              'cache over the tp mesh axis)')
+    parser.add_argument('--quant', choices=['none', 'int8'],
+                        default='none',
+                        help='weight-only quantization (halves '
+                             'decode weight bandwidth)')
     args = parser.parse_args()
+    if args.quant == 'int8' and args.tp > 1:
+        # Reject before the (expensive) sharded init, not after.
+        parser.error('--quant int8 with --tp > 1 is not supported yet')
 
     import jax
     import jax.numpy as jnp
@@ -49,6 +56,10 @@ def main():
             out_shardings=param_sh)()
     else:
         params = llama.init_params(config, jax.random.PRNGKey(0))
+    if args.quant == 'int8':
+        from skypilot_tpu.models import quant
+        params = jax.jit(quant.quantize_params,
+                         static_argnums=(1,))(params, config)
 
     lock = threading.Lock()
 

@@ -1,0 +1,103 @@
+"""Weight-only int8 quantization for serving (models/quant.py).
+
+No reference analog (the reference delegates serving to external
+engines); TPU-native new scope: halve decode's weight-bandwidth.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode, llama, quant
+
+
+@pytest.fixture(scope='module')
+def setup():
+    config = llama.get_config('tiny')
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+class TestQuantizeWeight:
+
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32),
+                              jnp.float32)
+        qw = quant.quantize_weight(w)
+        assert qw['q'].dtype == jnp.int8
+        deq = qw['q'].astype(jnp.float32) * qw['s'].astype(jnp.float32)
+        # Per-output-channel symmetric int8: error <= scale/2 per
+        # element, plus the bf16 scale's own ~0.4% relative rounding.
+        err = np.abs(np.asarray(deq - w))
+        bound = (np.asarray(qw['s'], np.float32) * 0.51 +
+                 0.005 * np.abs(np.asarray(w)))
+        assert (err <= bound).all()
+
+    def test_stacked_layer_shape(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (4, 16, 8))
+        qw = quant.quantize_weight(w)
+        assert qw['q'].shape == (4, 16, 8)
+        # Per-layer AND per-output-channel scales: the leading layer
+        # axis must survive so the pair scans alongside the weights.
+        assert qw['s'].shape == (4, 1, 8)
+
+    def test_matmul_plain_passthrough(self):
+        x = jnp.ones((2, 4))
+        w = jnp.ones((4, 3))
+        np.testing.assert_allclose(np.asarray(quant.matmul(x, w)),
+                                   np.asarray(x @ w))
+
+
+class TestQuantizedDecode:
+
+    def test_params_tree_structure(self, setup):
+        config, params = setup
+        qp = quant.quantize_params(params, config)
+        assert quant.is_quantized(qp)
+        assert not quant.is_quantized(params)
+        # Non-matmul leaves untouched.
+        assert qp['layers']['attn_norm'] is params['layers']['attn_norm']
+        assert qp['embed'] is params['embed']
+
+    def test_logits_close_to_fp(self, setup):
+        config, params = setup
+        qp = quant.quantize_params(params, config)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 0,
+                                  config.vocab_size, dtype=jnp.int32)
+        cache = decode.init_cache(config, 2, max_seq=16)
+        want, _ = decode.forward_cached(params, toks, cache, config)
+        cache2 = decode.init_cache(config, 2, max_seq=16)
+        got, _ = decode.forward_cached(qp, toks, cache2, config)
+        w = np.asarray(want)
+        g = np.asarray(got)
+        # int8 weight-only keeps logits close; argmax should agree on
+        # the vast majority of positions for a random-init model.
+        agree = (w.argmax(-1) == g.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+        assert np.abs(g - w).mean() < 0.15 * np.abs(w).mean() + 0.1
+
+    def test_greedy_generate_quantized(self, setup):
+        config, params = setup
+        qp = quant.quantize_params(params, config)
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0,
+                                    config.vocab_size, dtype=jnp.int32)
+        out = decode.greedy_generate(qp, prompt, config,
+                                     max_new_tokens=4, max_seq=16)
+        assert out.shape == (2, 4)
+        ids = np.asarray(out)
+        assert ((0 <= ids) & (ids < config.vocab_size)).all()
+
+    def test_moe_rejected(self):
+        config = llama.get_config('tiny-moe')
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError):
+            quant.quantize_params(params, config)
+
+    def test_tied_embeddings_head_stays_fp(self):
+        config = llama.get_config('tiny', tie_embeddings=True)
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        qp = quant.quantize_params(params, config)
+        toks = jnp.asarray([[1, 2, 3]], jnp.int32)
+        cache = decode.init_cache(config, 1, max_seq=8)
+        logits, _ = decode.forward_cached(qp, toks, cache, config)
+        assert np.isfinite(np.asarray(logits)).all()

@@ -57,7 +57,7 @@ class TestAgentProtocol:
         proc_id = client.run('echo hello-$MARKER; sleep 0.2', log,
                              env={'MARKER': 'x42'})
         # Initially running (or already finished — poll).
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline:
             st = client.status(proc_id)
             if not st['running']:
@@ -70,7 +70,7 @@ class TestAgentProtocol:
     def test_nonzero_exit(self, agent, tmp_path):
         client, _ = agent
         proc_id = client.run('exit 3', str(tmp_path / 'l.log'))
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline:
             st = client.status(proc_id)
             if not st['running']:
@@ -84,7 +84,7 @@ class TestAgentProtocol:
         st = client.status(proc_id)
         assert st['running']
         assert client.kill(proc_id)
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline:
             st = client.status(proc_id)
             if not st['running']:
@@ -305,7 +305,7 @@ class TestAutostop:
         # Idle (no jobs) and idle_minutes=0 -> triggers immediately.
         from skypilot_tpu.runtime import skylet
         skylet.run_once(job_lib.FIFOScheduler())
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while time.time() < deadline and not marker.exists():
             time.sleep(0.1)
         assert marker.exists()
@@ -580,7 +580,7 @@ class TestAgentTermination:
             f'touch {marker}; SKYTPU_TEST_TAG={tag} sleep 300; '
             f'rm -f {marker}',
             str(tmp_path / 't.log'))
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         while not marker.exists() and time.time() < deadline:
             time.sleep(0.1)
         assert marker.exists()
@@ -593,7 +593,7 @@ class TestAgentTermination:
         assert task_pids
         agent_proc.send_signal(signal_mod.SIGTERM)
         agent_proc.wait(timeout=10)
-        deadline = time.time() + 10
+        deadline = time.time() + 30
         gone = False
         while time.time() < deadline:
             alive = [p for p in task_pids
