@@ -38,16 +38,20 @@ def init_lora(config: llama.LlamaConfig, key: jax.Array, rank: int = 16,
     }
 
 
-def lora_sharding_rules(config: llama.LlamaConfig) -> Dict[str, Any]:
+def lora_sharding_rules(config: llama.LlamaConfig,
+                        pipeline: bool = False) -> Dict[str, Any]:
     """LoRA factors: A shards its input dim on fsdp; B shards its
     output (head) dim on tp — matching the base wq/wv shardings so no
-    extra collectives appear in the adapter path."""
+    extra collectives appear in the adapter path. Under pipeline
+    parallelism the stacked layer axis shards over 'pp' like the base
+    weights."""
     del config
+    pl = 'pp' if pipeline else None
     return {
-        'wq_a': P(None, 'fsdp', None),
-        'wq_b': P(None, None, 'tp'),
-        'wv_a': P(None, 'fsdp', None),
-        'wv_b': P(None, None, 'tp'),
+        'wq_a': P(pl, 'fsdp', None),
+        'wq_b': P(pl, None, 'tp'),
+        'wv_a': P(pl, 'fsdp', None),
+        'wv_b': P(pl, None, 'tp'),
     }
 
 

@@ -47,13 +47,11 @@ def validate_pipeline_config(config: llama.LlamaConfig, mesh: Mesh,
     if config.n_layers % pp != 0:
         raise ValueError(
             f'n_layers={config.n_layers} not divisible by pp={pp}')
+    del lora_rank  # LoRA stacks [L, ...] like the base — pp-shardable
     if config.n_experts:
         raise NotImplementedError(
             'MoE + pipeline parallelism is not supported yet '
             '(shard experts over ep instead)')
-    if lora_rank is not None:
-        raise NotImplementedError(
-            'LoRA + pipeline parallelism is not supported yet')
     if mesh.shape.get('sp', 1) > 1:
         raise NotImplementedError(
             'sequence parallelism inside a pipeline stage is not '
@@ -142,11 +140,16 @@ def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
 
 
 def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
-                        num_micro: Optional[int] = None
-                        ) -> Callable[[Params, Dict[str, jax.Array]],
-                                      jax.Array]:
+                        num_micro: Optional[int] = None,
+                        lora: bool = False, lora_scale: float = 2.0
+                        ) -> Callable[..., jax.Array]:
     """A drop-in replacement for ``llama.loss_fn`` whose layer stack
-    runs pipelined over 'pp'. Same batch contract: tokens [B, T+1]."""
+    runs pipelined over 'pp'. Same batch contract: tokens [B, T+1].
+
+    With ``lora=True`` the returned callable is
+    ``loss(params, lora_params, batch)`` — the base is frozen
+    (stop_gradient) and the stacked adapters shard over 'pp' and scan
+    alongside their stage's layers."""
     pp = mesh.shape['pp']
     if num_micro is None:
         # 2x stages halves the bubble vs num_micro=pp; keep it a
@@ -158,7 +161,13 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
     attn_impl = llama.default_attn_impl()
     remat = llama.layer_remat_policy(config) if config.remat else None
 
-    def loss(params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+    def loss(params: Params, *rest) -> jax.Array:
+        if lora:
+            lora_params, batch = rest
+            params = jax.lax.stop_gradient(params)
+        else:
+            (batch,) = rest
+            lora_params = None
         tokens = batch['tokens']
         inputs = tokens[:, :-1]
         targets = tokens[:, 1:]
@@ -169,13 +178,28 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
                                params)
         x = llama.embed_tokens(cparams, inputs, config)
 
-        def layer_fn(x_mb, layer_params):
-            y, _ = llama._layer(config, x_mb, layer_params, angles,
-                                attn_impl)
-            return y
+        if lora_params is None:
+            stacked = cparams['layers']
 
-        hidden = pipelined_layers(layer_fn, x, cparams['layers'],
-                                  mesh, num_micro, remat=remat)
+            def layer_fn(x_mb, layer_params):
+                y, _ = llama._layer(config, x_mb, layer_params,
+                                    angles, attn_impl)
+                return y
+        else:
+            clora = jax.tree.map(lambda p: p.astype(config.dtype),
+                                 lora_params)
+            stacked = (cparams['layers'], clora)
+
+            def layer_fn(x_mb, scanned):
+                layer_params, layer_lora = scanned
+                y, _ = llama._layer(config, x_mb, layer_params,
+                                    angles, attn_impl,
+                                    lora_params=layer_lora,
+                                    lora_scale=lora_scale)
+                return y
+
+        hidden = pipelined_layers(layer_fn, x, stacked, mesh,
+                                  num_micro, remat=remat)
         hidden = llama._rms_norm(hidden, cparams['final_norm'],
                                  config.norm_eps, config.norm_offset)
 
@@ -185,6 +209,6 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
         return llama.loss_from_hidden(
             cparams, hidden, targets,
             llama.shifted_loss_mask(batch, targets), config,
-            train_lm_head=True)
+            train_lm_head=not lora)
 
     return loss

@@ -117,7 +117,8 @@ def plan_train_state(config: llama.LlamaConfig, mesh,
     if lora_rank is not None:
         from skypilot_tpu.parallel import lora as lora_lib
         lora_shardings = _sharding_tree(
-            lora_lib.lora_sharding_rules(config), mesh)
+            lora_lib.lora_sharding_rules(config, pipeline=use_pp),
+            mesh)
         trainable_shardings = lora_shardings
 
     # Match opt-state leaves (Adam mu/nu mirror the trainable tree) to
@@ -237,14 +238,16 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
     pp_loss = None
     if use_pp:
         from skypilot_tpu.parallel import pipeline as pipeline_lib
-        pipeline_lib.validate_pipeline_config(
-            config, mesh, lora_rank=1 if is_lora else None)
+        pipeline_lib.validate_pipeline_config(config, mesh)
         pp_loss = pipeline_lib.build_pipeline_loss(
-            config, mesh, num_micro=pipeline_microbatches)
+            config, mesh, num_micro=pipeline_microbatches,
+            lora=is_lora, lora_scale=lora_scale)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         if is_lora:
             def loss_of(lora_p):
+                if pp_loss is not None:
+                    return pp_loss(state.params, lora_p, batch)
                 return llama.loss_fn(
                     jax.lax.stop_gradient(state.params), batch, config,
                     lora=lora_p, lora_scale=lora_scale,

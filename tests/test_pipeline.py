@@ -51,10 +51,12 @@ class TestPipelinedLayers:
 
 class TestPipelineTraining:
 
-    def _losses(self, mesh_cfg, config, num_micro=None, steps=3):
+    def _losses(self, mesh_cfg, config, num_micro=None, steps=3,
+                lora_rank=None):
         mesh = make_mesh(mesh_cfg)
         state, shardings = init_train_state(config, mesh,
-                                            jax.random.PRNGKey(0))
+                                            jax.random.PRNGKey(0),
+                                            lora_rank=lora_rank)
         step = build_train_step(config, mesh, shardings,
                                 pipeline_microbatches=num_micro)
         toks = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
@@ -87,6 +89,14 @@ class TestPipelineTraining:
         ref = self._losses(MeshConfig(fsdp=8), config)
         np.testing.assert_allclose(pp, ref, rtol=1e-4)
 
+    def test_pp_with_lora_matches_reference(self, cfg):
+        # Frozen base + stacked adapters sharded over 'pp', scanned
+        # alongside their stage's layers.
+        pp = self._losses(MeshConfig(pp=2, fsdp=4), cfg,
+                          num_micro=4, lora_rank=4)
+        ref = self._losses(MeshConfig(fsdp=8), cfg, lora_rank=4)
+        np.testing.assert_allclose(pp, ref, rtol=1e-4)
+
     def test_stage_params_are_sharded_over_pp(self, cfg):
         mesh = make_mesh(MeshConfig(pp=2, fsdp=4))
         state, _ = init_train_state(cfg, mesh, jax.random.PRNGKey(0))
@@ -101,12 +111,6 @@ class TestPipelineValidation:
         mesh = make_mesh(MeshConfig(pp=4, dp=2))
         with pytest.raises(ValueError, match='divisible'):
             init_train_state(config, mesh, jax.random.PRNGKey(0))
-
-    def test_lora_unsupported(self, cfg):
-        mesh = make_mesh(MeshConfig(pp=2, fsdp=4))
-        with pytest.raises(NotImplementedError, match='LoRA'):
-            init_train_state(cfg, mesh, jax.random.PRNGKey(0),
-                             lora_rank=4)
 
     def test_moe_unsupported(self):
         config = llama.get_config('tiny-moe')
