@@ -231,6 +231,112 @@ def decode_tokens_scan(params: Params, first: jax.Array,
     return toks.swapaxes(0, 1), cache
 
 
+def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row (static k), -inf the rest."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _filter_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering with a DYNAMIC top_p (no recompile per
+    request): keep the smallest prefix of the descending-prob order
+    whose cumulative probability reaches top_p. The top-1 token is
+    always kept (top_p is clamped above 0, so the first token's
+    zero preceding mass never reaches it)."""
+    top_p = jnp.maximum(jnp.asarray(top_p, jnp.float32), 1e-6)
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # A token is OUTSIDE the nucleus if the cumulative mass before it
+    # already reached top_p.
+    outside = (cum - probs) >= top_p
+    kth = jnp.where(outside, jnp.inf, sorted_desc).min(-1,
+                                                      keepdims=True)
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def sample_token(logits: jax.Array, key: jax.Array,
+                 temperature: jax.Array, top_k: int = 0,
+                 top_p: Optional[jax.Array] = None) -> jax.Array:
+    """Sample next ids from [B, V] logits. ``temperature``/``top_p``
+    are dynamic (traced) so one executable serves every request;
+    ``top_k`` is static (0 = off). temperature == 0 -> greedy."""
+    filtered = logits.astype(jnp.float32)
+    if top_k:
+        filtered = _filter_top_k(filtered, top_k)
+    if top_p is not None:
+        filtered = _filter_top_p(filtered, top_p)
+    t_safe = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(key, filtered / t_safe, axis=-1)
+    greedy = logits.argmax(-1)
+    return jnp.where(temperature <= 0.0, greedy,
+                     sampled).astype(jnp.int32)
+
+
+def sample_tokens_scan(params: Params, first: jax.Array,
+                       cache: KVCache, config: llama.LlamaConfig,
+                       num_tokens: int, key: jax.Array,
+                       temperature: jax.Array, top_k: int = 0,
+                       top_p: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, KVCache]:
+    """Sampling analog of ``decode_tokens_scan`` — the whole
+    generation is one device-side dispatch; the PRNG key splits per
+    step inside the scan."""
+
+    def body(carry, _):
+        tok, kv, k_ = carry
+        k_, sub = jax.random.split(k_)
+        logits, kv = forward_cached(params, tok[:, None], kv, config)
+        nxt = sample_token(logits[:, -1], sub, temperature,
+                           top_k=top_k, top_p=top_p)
+        return (nxt, kv, k_), nxt
+
+    (_, cache, _), toks = jax.lax.scan(body, (first, cache, key),
+                                       None, length=num_tokens)
+    return toks.swapaxes(0, 1), cache
+
+
+def sample_generate(params: Params, prompt: jax.Array,
+                    config: llama.LlamaConfig, max_new_tokens: int,
+                    key: jax.Array, temperature: float = 1.0,
+                    top_k: int = 0,
+                    top_p: Optional[float] = None,
+                    max_seq: Optional[int] = None,
+                    cache_sharding: Optional[KVCache] = None
+                    ) -> jax.Array:
+    """Sampled generation: prefill once, then one scan dispatch.
+    temperature/top_p are passed as arrays so distinct request values
+    reuse one compiled executable. prompt [B, T0] ->
+    [B, max_new_tokens]."""
+    max_seq = max_seq or config.max_seq_len
+    b, t0 = prompt.shape
+    assert t0 + max_new_tokens <= max_seq, (t0, max_new_tokens,
+                                            max_seq)
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    cache = init_cache(config, b, max_seq)
+    if cache_sharding is not None:
+        cache = jax.device_put(cache, cache_sharding)
+    temp = jnp.asarray(temperature, jnp.float32)
+    # top_p=None skips the nucleus filter entirely — a full-vocab
+    # sort per generated token is not free, so don't run it as a
+    # mathematical no-op.
+    p = None if top_p is None else jnp.asarray(top_p, jnp.float32)
+
+    step = jax.jit(forward_cached, static_argnums=(3, 4),
+                   donate_argnums=(2,))
+    logits, cache = step(params, prompt, cache, config, True)
+    key, sub = jax.random.split(key)
+    nxt = sample_token(logits[:, -1], sub, temp, top_k=top_k, top_p=p)
+    if max_new_tokens == 1:
+        return nxt[:, None]
+    scan_fn = jax.jit(sample_tokens_scan, static_argnums=(3, 4, 7),
+                      donate_argnums=(2,))
+    toks, _ = scan_fn(params, nxt, cache, config, max_new_tokens - 1,
+                      key, temp, top_k, p)
+    return jnp.concatenate([nxt[:, None], toks], axis=1)
+
+
 def greedy_generate(params: Params, prompt: jax.Array,
                     config: llama.LlamaConfig, max_new_tokens: int,
                     max_seq: Optional[int] = None,

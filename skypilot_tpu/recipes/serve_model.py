@@ -63,7 +63,8 @@ def main():
 
     lock = threading.Lock()
 
-    def generate(prompt_ids, max_new):
+    def generate(prompt_ids, max_new, temperature=None, top_p=None,
+                 seed=None):
         # KV-cache decode: prefill once, then ONE device-side scan for
         # the whole generation (decode.decode_tokens_scan). The scan
         # length is a static compile parameter, so requested lengths
@@ -81,9 +82,23 @@ def main():
             bucket *= 2
         bucket = min(bucket, config.max_seq_len - tokens.shape[1])
         with lock:
-            out = decode.greedy_generate(params, tokens, config,
-                                         max_new_tokens=bucket,
-                                         cache_sharding=cache_sh)
+            if temperature is not None or top_p is not None:
+                # temperature/top_p enter as ARRAYS, so every request
+                # value reuses one compiled executable. Unseeded
+                # requests draw a fresh key — identical requests must
+                # not return identical "samples".
+                if seed is None:
+                    seed = int.from_bytes(os.urandom(4), 'little')
+                out = decode.sample_generate(
+                    params, tokens, config, max_new_tokens=bucket,
+                    key=jax.random.PRNGKey(seed),
+                    temperature=(1.0 if temperature is None
+                                 else temperature),
+                    top_p=top_p, cache_sharding=cache_sh)
+            else:
+                out = decode.greedy_generate(params, tokens, config,
+                                             max_new_tokens=bucket,
+                                             cache_sharding=cache_sh)
         return [int(t) for t in out[0][:max_new]]
 
     class Handler(BaseHTTPRequestHandler):
@@ -117,14 +132,28 @@ def main():
                               for t in body['prompt_ids']]
                 max_new = min(int(body.get('max_new_tokens',
                                            args.max_new_tokens)), 512)
-            except (ValueError, KeyError) as e:
+                temperature = body.get('temperature')
+                if temperature is not None:
+                    temperature = float(temperature)
+                top_p = body.get('top_p')
+                if top_p is not None:
+                    top_p = float(top_p)
+                seed = body.get('seed')
+                if seed is not None:
+                    seed = int(seed)
+            except (ValueError, KeyError, TypeError) as e:
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
-            out = generate(prompt_ids, max_new)
+            out = generate(prompt_ids, max_new, temperature=temperature,
+                           top_p=top_p, seed=seed)
             self._json({'output_ids': out})
 
-    # Warm the compile before declaring readiness.
+    # Warm every decode variant's compile before declaring readiness
+    # (greedy, sampled, sampled+nucleus) — the first request would
+    # otherwise pay it while holding the serve lock.
     generate([1, 2, 3], 1)
+    generate([1, 2, 3], 2, temperature=1.0, seed=0)
+    generate([1, 2, 3], 2, temperature=1.0, top_p=0.9, seed=0)
     server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
     print(f'serve_model ready on :{args.port} (model {args.model})')
     server.serve_forever()

@@ -163,6 +163,89 @@ class TestShardedDecode:
         assert ((0 <= ids) & (ids < config.vocab_size)).all()
 
 
+class TestSampling:
+
+    def test_temperature_zero_is_greedy(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        want = decode.greedy_generate(params, prompt, config,
+                                      max_new_tokens=4, max_seq=16)
+        got = decode.sample_generate(params, prompt, config,
+                                     max_new_tokens=4,
+                                     key=jax.random.PRNGKey(0),
+                                     temperature=0.0, max_seq=16)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+
+    def test_top_k_one_is_greedy(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[7, 8, 9]], jnp.int32)
+        want = decode.greedy_generate(params, prompt, config,
+                                      max_new_tokens=3, max_seq=16)
+        got = decode.sample_generate(params, prompt, config,
+                                     max_new_tokens=3,
+                                     key=jax.random.PRNGKey(1),
+                                     temperature=1.0, top_k=1,
+                                     max_seq=16)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want))
+
+    def test_sampling_varies_with_key_and_is_reproducible(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        outs = [np.asarray(decode.sample_generate(
+            params, prompt, config, max_new_tokens=8,
+            key=jax.random.PRNGKey(s), temperature=5.0, max_seq=16))
+            for s in (0, 0, 1)]
+        np.testing.assert_array_equal(outs[0], outs[1])  # same key
+        assert not np.array_equal(outs[0], outs[2])      # diff key
+
+    def test_top_p_filter_keeps_nucleus(self):
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        # top_p=0.6: cumulative before token1 is 0.5 < 0.6 so token1
+        # stays; before token2 is 0.8 >= 0.6 so tokens 2,3 drop.
+        filtered = decode._filter_top_p(logits,
+                                        jnp.asarray(0.6, jnp.float32))
+        f = np.asarray(filtered[0])
+        assert np.isfinite(f[0]) and np.isfinite(f[1])
+        assert f[2] <= -1e29 and f[3] <= -1e29
+
+    def test_top_p_zero_keeps_top1(self):
+        # Degenerate top_p (some clients send 0 meaning "greedy"):
+        # the top-1 token must survive, never an all-masked row.
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        f = np.asarray(decode._filter_top_p(
+            logits, jnp.asarray(0.0, jnp.float32))[0])
+        assert np.isfinite(f[0])
+        assert (f[1:] <= -1e29).all()
+
+    def test_dynamic_temperature_no_recompile(self, setup,
+                                              monkeypatch):
+        # temperature/top_p are traced arrays: different request
+        # values must reuse one executable. The counter body runs on
+        # TRACE only (cached executions skip the Python wrapper), so
+        # a regression to per-value recompiles shows up as extra
+        # traces.
+        config, params = setup
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        traces = []
+        orig = decode.sample_tokens_scan
+
+        def counting(*a, **k):
+            traces.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(decode, 'sample_tokens_scan', counting)
+        for temp, p in ((0.7, 0.9), (1.3, 0.8), (2.0, 0.95)):
+            out = decode.sample_generate(params, prompt, config,
+                                         max_new_tokens=4,
+                                         key=jax.random.PRNGKey(2),
+                                         temperature=temp, top_p=p,
+                                         max_seq=16)
+            assert out.shape == (1, 4)
+        assert len(traces) == 1, traces
+
+
 class TestGenerateEdgeCases:
 
     def test_zero_max_new_tokens(self, setup):
