@@ -364,6 +364,13 @@ def mlp_act(config: LlamaConfig):
     return functools.partial(jax.nn.gelu, approximate=True)
 
 
+# Sentinel for the `mesh` argument of _moe_mlp/_layer/forward_hidden:
+# bind sharding constraints to the AMBIENT mesh via bare PartitionSpecs
+# (required inside a partial-manual shard_map, where a concrete
+# NamedSharding would clash with the manual axis types).
+AMBIENT_MESH = 'context'
+
+
 def _moe_mlp(config: LlamaConfig, h: jax.Array, layer_params: Params,
              mesh=None, out_spec=None):
     """Top-k routed expert MLP (GShard-style static capacity
@@ -414,6 +421,8 @@ def _moe_mlp(config: LlamaConfig, h: jax.Array, layer_params: Params,
         # repartition) on the dispatch transposes.
         if mesh is None:
             return arr
+        if mesh is AMBIENT_MESH:
+            return jax.lax.with_sharding_constraint(arr, spec)
         from jax.sharding import NamedSharding
         return jax.lax.with_sharding_constraint(
             arr, NamedSharding(mesh, spec))
@@ -448,6 +457,9 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
            lora_scale: float = 1.0, mesh=None, act_spec=None):
     """One transformer block. Returns (y, moe_aux_loss) — the aux is
     0 for dense configs so the scan carry has one static shape.
+    ``mesh``: a concrete Mesh for the MoE sharding pins, or
+    ``AMBIENT_MESH`` to bind them to the ambient mesh (inside a
+    partial-manual shard_map), or None to skip them.
     ``act_spec``: the [B, T, D] activation PartitionSpec (so the MoE
     combine restores e.g. the 'sp' sequence sharding)."""
     b, t, d = x.shape

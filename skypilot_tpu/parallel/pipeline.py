@@ -47,27 +47,31 @@ def validate_pipeline_config(config: llama.LlamaConfig, mesh: Mesh,
     if config.n_layers % pp != 0:
         raise ValueError(
             f'n_layers={config.n_layers} not divisible by pp={pp}')
-    del lora_rank  # LoRA stacks [L, ...] like the base — pp-shardable
-    if config.n_experts:
-        raise NotImplementedError(
-            'MoE + pipeline parallelism is not supported yet '
-            '(shard experts over ep instead)')
+    # LoRA and MoE both stack [L, ...] like the base weights, so they
+    # shard over 'pp' and scan per-stage; MoE's aux loss accumulates
+    # through the pipeline (bubble steps masked). pp x ep composes:
+    # the expert all-to-alls stay GSPMD-auto inside each stage.
+    del lora_rank
     if mesh.shape.get('sp', 1) > 1:
         raise NotImplementedError(
             'sequence parallelism inside a pipeline stage is not '
             'supported yet')
 
 
-def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
-                     x: jax.Array, stacked_params: Params,
-                     mesh: Mesh, num_micro: int,
-                     remat=None) -> jax.Array:
+def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
+                     mesh: Mesh, num_micro: int, remat=None):
     """Run ``x`` [B, T, D] through the pp-sharded layer stack.
 
-    ``layer_fn(x_mb, layer_params) -> y_mb`` applies ONE layer;
+    ``layer_fn(x_mb, layer_params) -> (y_mb, aux)`` applies ONE layer
+    (aux: scalar f32, e.g. the MoE load-balance loss — 0 for dense);
     ``stacked_params`` leaves are [L, ...] with L sharded over 'pp'.
     B must be divisible by num_micro. ``remat``: a checkpoint policy
     to remat each layer with (None = no remat).
+
+    Returns (y [B, T, D], aux_sum) where aux_sum totals every
+    (layer, microbatch) contribution — divide by
+    ``n_layers * num_micro`` for the layer-mean; bubble-step junk is
+    masked out of both.
     """
     pp = mesh.shape['pp']
     b = x.shape[0]
@@ -81,9 +85,16 @@ def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
                                    policy=remat)
 
     def stage_fn(x_mb, params_local):
-        y, _ = jax.lax.scan(
-            lambda c, lp: (one_layer(c, lp), None), x_mb, params_local)
-        return y
+        def scan_body(carry, lp):
+            x_c, aux_c = carry
+            y, aux = one_layer(x_c, lp)
+            return (y, aux_c + aux), None
+
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ('pp',),
+                             to='varying')
+        (y, aux), _ = jax.lax.scan(scan_body, (x_mb, aux0),
+                                   params_local)
+        return y, aux
 
     def body(x_full, params_local):
         # x_full: [B, T, D] (replicated over pp, auto over the rest);
@@ -98,16 +109,22 @@ def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
                             ('pp',), to='varying')
         outs = jax.lax.pcast(jnp.zeros(micro.shape, x_full.dtype),
                              ('pp',), to='varying')
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                             ('pp',), to='varying')
 
         def step(carry, s):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             # Stage 0 ingests microbatch s; later stages consume the
             # rotated-in activation from the previous stage.
             inp = jax.lax.dynamic_index_in_dim(
                 micro, jnp.clip(s, 0, num_micro - 1), axis=0,
                 keepdims=False)
             xin = jnp.where(idx == 0, inp, buf)
-            y = stage_fn(xin, params_local)
+            y, aux = stage_fn(xin, params_local)
+            # Stage idx is processing microbatch s-idx; bubble steps
+            # compute on junk — exclude them from the aux total.
+            stage_valid = ((s - idx >= 0) & (s - idx < num_micro))
+            aux_acc = aux_acc + jnp.where(stage_valid, aux, 0.0)
             # The LAST stage finished microbatch s-(pp-1) — record it
             # (masked off during the pp-1 warmup bubble).
             out_idx = s - (pp - 1)
@@ -121,21 +138,23 @@ def pipelined_layers(layer_fn: Callable[[jax.Array, Params], jax.Array],
             # edge pp-1 -> 0 carries junk that stage 0 ignores).
             buf = jax.lax.ppermute(
                 y, 'pp', [(i, (i + 1) % pp) for i in range(pp)])
-            return (buf, outs), None
+            return (buf, outs, aux_acc), None
 
-        (_, outs), _ = jax.lax.scan(
-            step, (buf, outs), jnp.arange(num_micro + pp - 1))
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            step, (buf, outs, aux0), jnp.arange(num_micro + pp - 1))
         # Only the last stage holds real outputs; zero-and-psum
-        # replicates them to every stage.
+        # replicates them to every stage. The aux psum totals each
+        # stage's (already masked) contributions.
         outs = jnp.where(idx == pp - 1, outs, 0)
         outs = jax.lax.psum(outs, 'pp')
-        return outs.reshape(x_full.shape)
+        aux_total = jax.lax.psum(aux_acc, 'pp')
+        return outs.reshape(x_full.shape), aux_total
 
     fn = jax.shard_map(
         body, mesh=mesh, axis_names={'pp'},
         in_specs=(P(), jax.tree.map(lambda _: P('pp'),
                                     stacked_params)),
-        out_specs=P())
+        out_specs=(P(), P()))
     return fn(x, stacked_params)
 
 
@@ -178,13 +197,18 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
                                params)
         x = llama.embed_tokens(cparams, inputs, config)
 
+        # AMBIENT_MESH keeps the MoE dispatch einsums' explicit 'ep'
+        # shardings INSIDE the pp-manual shard_map: bare-P constraints
+        # bind to the ambient mesh's auto axes (a concrete
+        # NamedSharding would clash with the manual 'pp' axis type);
+        # without them GSPMD falls back to replicate-and-repartition.
+        pin_mode = llama.AMBIENT_MESH if config.n_experts else None
         if lora_params is None:
             stacked = cparams['layers']
 
             def layer_fn(x_mb, layer_params):
-                y, _ = llama._layer(config, x_mb, layer_params,
-                                    angles, attn_impl)
-                return y
+                return llama._layer(config, x_mb, layer_params,
+                                    angles, attn_impl, mesh=pin_mode)
         else:
             clora = jax.tree.map(lambda p: p.astype(config.dtype),
                                  lora_params)
@@ -192,23 +216,35 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
 
             def layer_fn(x_mb, scanned):
                 layer_params, layer_lora = scanned
-                y, _ = llama._layer(config, x_mb, layer_params,
+                return llama._layer(config, x_mb, layer_params,
                                     angles, attn_impl,
                                     lora_params=layer_lora,
-                                    lora_scale=lora_scale)
-                return y
+                                    lora_scale=lora_scale,
+                                    mesh=pin_mode)
 
-        hidden = pipelined_layers(layer_fn, x, stacked, mesh,
-                                  num_micro, remat=remat)
+        hidden, aux_sum = pipelined_layers(layer_fn, x, stacked, mesh,
+                                           num_micro, remat=remat)
         hidden = llama._rms_norm(hidden, cparams['final_norm'],
                                  config.norm_eps, config.norm_offset)
 
         # Gradients flow to cparams (the bf16 cast) and back to the
         # master params through jax.tree.map's cast — same mixed-
         # precision path as llama.forward_hidden.
-        return llama.loss_from_hidden(
+        ce = llama.loss_from_hidden(
             cparams, hidden, targets,
             llama.shifted_loss_mask(batch, targets), config,
             train_lm_head=not lora)
+        if config.n_experts:
+            # Divide the (layer x microbatch) total down to the mean.
+            # NOTE: aux is MICROBATCH-LOCAL — E*sum(f_e * P_e) is
+            # quadratic in the batch statistics, so the mean over
+            # microbatches differs from the full-batch value by
+            # O(routing variance across microbatches) (~1e-4 relative
+            # at tiny scale). This matches how gradient-accumulated
+            # MoE training computes aux; routing itself is per-row
+            # and therefore exactly unchanged.
+            ce = ce + config.moe_aux_coef * aux_sum / (
+                config.n_layers * num_micro)
+        return ce
 
     return loss
