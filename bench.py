@@ -53,13 +53,15 @@ def serve_main() -> None:
     import numpy as np
 
     config = llama.get_config(model_name)
-    params = llama.init_params(config, jax.random.PRNGKey(0),
-                               dtype=jnp.bfloat16)
     quantized = os.environ.get('BENCH_QUANT', '0') == '1'
     if quantized:
+        # Leaf-streamed init+quantize: the bf16 tree never fully
+        # materializes, so 8B-class models fit a 16 GB chip as int8.
         from skypilot_tpu.models import quant
-        params = jax.jit(quant.quantize_params,
-                         static_argnums=(1,))(params, config)
+        params = quant.init_quantized(config, jax.random.PRNGKey(0))
+    else:
+        params = llama.init_params(config, jax.random.PRNGKey(0),
+                                   dtype=jnp.bfloat16)
     max_seq = prompt_len + gen
 
     step = jax.jit(decode.forward_cached, static_argnums=(3, 4),

@@ -70,6 +70,55 @@ def quantize_params(params: Params, config: llama.LlamaConfig
     return out
 
 
+def init_quantized(config: llama.LlamaConfig, key: jax.Array,
+                   dtype=jnp.bfloat16) -> Params:
+    """Random-init a params tree LEAF-STREAMED with the matmul weights
+    quantized as they materialize — the full bf16 tree never exists on
+    device (an 8B bf16 tree alone exceeds a v5e chip's 16 GB HBM; the
+    int8 tree is ~8 GB and serves fine).
+
+    Weight VALUES are random benchmark/demo weights (norms at their
+    init, biases zero, dense ~N(0, 1/dim)) — real serving loads a
+    checkpoint leaf-by-leaf through ``quantize_weight`` the same way.
+    """
+    if config.n_experts:
+        raise NotImplementedError(
+            'int8 quantization of MoE expert weights is not '
+            'supported yet')
+    shapes = jax.eval_shape(
+        lambda: llama.init_params(config, key, dtype=dtype))
+    quantize = jax.jit(quantize_weight)
+
+    def init_leaf(name, sd, k):
+        if 'norm' in name:
+            return (jnp.zeros(sd.shape, dtype) if config.norm_offset
+                    else jnp.ones(sd.shape, dtype))
+        if name in ('bq', 'bk', 'bv'):
+            return jnp.zeros(sd.shape, dtype)
+        # Same per-leaf fan-in rule as init_params' dense(): matmul
+        # weights are [..., in, out] (fan_in = shape[-2]); the
+        # embedding's fan-in is its model dim (shape[-1]).
+        fan_in = sd.shape[-1] if name == 'embed' else sd.shape[-2]
+        scale = 1.0 / (fan_in ** 0.5)
+        normal = jax.jit(
+            lambda k_: (jax.random.normal(k_, sd.shape, jnp.float32) *
+                        scale).astype(dtype))
+        return normal(k)
+
+    out: Params = {'layers': {}}
+    flat, _ = jax.tree_util.tree_flatten_with_path(shapes)
+    for i, (path, sd) in enumerate(flat):
+        name = path[-1].key
+        leaf = init_leaf(name, sd, jax.random.fold_in(key, i))
+        if name in _LAYER_MATMULS or name == 'lm_head':
+            leaf = quantize(leaf)  # frees the wide original
+        if len(path) == 2:
+            out['layers'][name] = leaf
+        else:
+            out[name] = leaf
+    return out
+
+
 def is_quantized(params: Params) -> bool:
     wq = params.get('layers', {}).get('wq')
     return isinstance(wq, dict) and 'q' in wq

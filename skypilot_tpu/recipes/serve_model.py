@@ -54,12 +54,13 @@ def main():
         params = jax.jit(
             lambda: llama.init_params(config, jax.random.PRNGKey(0)),
             out_shardings=param_sh)()
+    elif args.quant == 'int8':
+        # Leaf-streamed init+quantize — the bf16 tree never fully
+        # materializes (8B bf16 alone would exceed a v5e's HBM).
+        from skypilot_tpu.models import quant
+        params = quant.init_quantized(config, jax.random.PRNGKey(0))
     else:
         params = llama.init_params(config, jax.random.PRNGKey(0))
-    if args.quant == 'int8':
-        from skypilot_tpu.models import quant
-        params = jax.jit(quant.quantize_params,
-                         static_argnums=(1,))(params, config)
 
     lock = threading.Lock()
 

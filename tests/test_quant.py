@@ -93,6 +93,26 @@ class TestQuantizedDecode:
         with pytest.raises(NotImplementedError):
             quant.quantize_params(params, config)
 
+    def test_init_quantized_serves(self, setup):
+        # Leaf-streamed init (the 8B-on-one-chip path): produces the
+        # same tree structure as quantize_params(init_params(...)) and
+        # decodes end-to-end.
+        config, params = setup
+        qp = quant.init_quantized(config, jax.random.PRNGKey(0),
+                                  dtype=jnp.float32)
+        ref = quant.quantize_params(params, config)
+        assert (jax.tree_util.tree_structure(qp) ==
+                jax.tree_util.tree_structure(ref))
+        assert quant.is_quantized(qp)
+        for name in ('wq', 'w_down'):
+            assert qp['layers'][name]['q'].shape == \
+                ref['layers'][name]['q'].shape
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out = decode.greedy_generate(qp, prompt, config,
+                                     max_new_tokens=3, max_seq=8)
+        assert out.shape == (1, 3)
+        assert np.isfinite(np.asarray(out)).all()
+
     def test_tied_embeddings_head_stays_fp(self):
         config = llama.get_config('tiny', tie_embeddings=True)
         params = llama.init_params(config, jax.random.PRNGKey(0))
