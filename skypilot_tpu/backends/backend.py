@@ -50,16 +50,25 @@ class ClusterHandle:
         return self.hosts[0].get('external_ip') or \
             self.hosts[0].get('ip')
 
+    @property
+    def direct_agent(self) -> bool:
+        """Agents reached at their reported IP:port directly — local
+        processes, or runtime_via_agent clouds (kubernetes pod IPs,
+        reachable in-cluster; no SSH exists to tunnel through)."""
+        from skypilot_tpu import clouds
+        cloud = clouds.from_name(self.provider)
+        return cloud.is_local or cloud.runtime_via_agent
+
     def agent_client(self, host_index: int):
         """Client for host ``host_index``'s agent, from the CLIENT
-        side. On remote clouds the agent port is never opened publicly
+        side. On SSH clouds the agent port is never opened publicly
         — traffic rides an SSH local port-forward (reference model:
         SSH-only control plane, ``sky/utils/command_runner.py:426``)."""
         from skypilot_tpu.runtime.agent_client import AgentClient
         assert self.hosts, 'cluster has no hosts'
         host = self.hosts[host_index]
         token = getattr(self, 'agent_token', None)
-        if self.is_local:
+        if self.direct_agent:
             addr = host.get('external_ip') or host.get('ip')
             return AgentClient(addr, host['agent_port'], token=token)
         from skypilot_tpu.runtime import tunnels

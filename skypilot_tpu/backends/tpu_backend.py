@@ -143,6 +143,18 @@ class TpuBackend(Backend):
                 stale.append((i, v))
         if not stale:
             return
+        from skypilot_tpu import clouds
+        if clouds.from_name(handle.provider).runtime_via_agent:
+            # The agent IS the host's main process, baked in at
+            # provision (pod Secret) — it cannot be restarted in
+            # place, and re-shipping the package would not touch it.
+            # Be honest instead of looping on a mismatch the client
+            # would then talk a newer protocol across.
+            raise exceptions.NotSupportedError(
+                f'Cluster {handle.cluster_name} runs agent protocol '
+                f'{stale} but this client needs '
+                f'{agent.AGENT_VERSION}; relaunch it '
+                f'(`xsky down {handle.cluster_name}` then launch).')
         logger.info('Cluster %s runtime version mismatch %s (client '
                     'wants %s); restarting runtime.',
                     handle.cluster_name, stale, agent.AGENT_VERSION)
@@ -156,8 +168,16 @@ class TpuBackend(Backend):
         """Agents healthy on every host + skylet running on head
         (model: ``post_provision_runtime_setup``,
         ``sky/provision/provisioner.py:631``)."""
-        if not handle.is_local:
-            from skypilot_tpu.provision import instance_setup
+        from skypilot_tpu import clouds
+        cloud = clouds.from_name(handle.provider)
+        from skypilot_tpu.provision import instance_setup
+        if cloud.runtime_via_agent:
+            # Agents come up with the hosts (e.g. pod bootstrap from
+            # a Secret); once healthy, the package ships THROUGH them.
+            for i in range(handle.num_hosts):
+                handle.agent_client(i).wait_healthy(timeout=300)
+            instance_setup.setup_runtime_via_agent(handle)
+        elif not handle.is_local:
             instance_setup.setup_runtime_on_cluster(handle)
         for i in range(handle.num_hosts):
             handle.agent_client(i).wait_healthy(timeout=120)

@@ -4,6 +4,7 @@ from typing import Dict, List
 
 from skypilot_tpu.clouds.cloud import Cloud
 from skypilot_tpu.clouds.gcp import GcpCloud
+from skypilot_tpu.clouds.kubernetes import KubernetesCloud
 from skypilot_tpu.clouds.local import LocalCloud
 
 CLOUD_REGISTRY: Dict[str, Cloud] = {}
@@ -32,6 +33,7 @@ def registered() -> List[Cloud]:
 
 register(GcpCloud())
 register(LocalCloud())
+register(KubernetesCloud())
 
 __all__ = ['Cloud', 'CLOUD_REGISTRY', 'register', 'from_name',
            'registered']

@@ -35,6 +35,12 @@ class Cloud(abc.ABC):
     #: fake cloud (hosts are local processes, rsync is a local copy).
     is_local: bool = False
 
+    #: Hosts come up with the agent already running (provider-side
+    #: bootstrap) and are reached at their reported IP:port directly —
+    #: no SSH anywhere: runtime setup pushes the package THROUGH the
+    #: agent (/put) instead of rsync (kubernetes pods).
+    runtime_via_agent: bool = False
+
     supports_spot: bool = True
     supports_open_ports: bool = True
 

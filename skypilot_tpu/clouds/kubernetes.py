@@ -1,0 +1,51 @@
+"""Kubernetes (GKE) cloud: TPU slices as pods on TPU node pools.
+
+Analog of the reference's ``sky/clouds/kubernetes.py`` (713 LoC +
+5 kLoC provisioner) redesigned TPU-first — see
+``provision/kubernetes/``. The control plane is the host agent over
+pod IPs (no SSH), so this cloud sets ``runtime_via_agent``.
+"""
+from typing import List, Optional, Tuple
+
+from skypilot_tpu.clouds.cloud import Cloud
+
+
+class KubernetesCloud(Cloud):
+    name = 'kubernetes'
+    provision_module = 'kubernetes'
+    is_local = False
+    #: Pods bootstrap their agent from a Secret at creation; runtime
+    #: setup pushes the package THROUGH the agent (no SSH/rsync), and
+    #: clients connect to pod IPs directly (in-cluster controller) —
+    #: see backends.tpu_backend + provision.instance_setup branches.
+    runtime_via_agent = True
+    supports_spot = False        # spot node pools are a pool property
+    supports_open_ports = False  # pod IPs are cluster-internal
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        try:
+            from skypilot_tpu.provision.kubernetes import client
+            c = client.KubeClient()
+            c.request('GET', '/api/v1/namespaces/'
+                             f'{c.namespace}/pods',
+                      params={'limit': '1'}, timeout=5)
+            return True, None
+        except Exception as e:  # pylint: disable=broad-except
+            return False, f'cannot reach kubernetes API: {e}'
+
+    def regions_for(self, accelerator: Optional[str],
+                    use_spot: bool) -> List[str]:
+        del accelerator, use_spot
+        return ['kubernetes']
+
+    def zones_for(self, accelerator: Optional[str],
+                  region: str) -> List[str]:
+        return []
+
+    def default_region(self) -> str:
+        return 'kubernetes'
+
+    def supports_stop(self, resources) -> Tuple[bool, Optional[str]]:
+        del resources
+        return False, ('kubernetes pods cannot be stopped-and-'
+                       'resumed; use down instead.')

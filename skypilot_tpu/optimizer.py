@@ -129,6 +129,32 @@ def _enumerate_candidates(task: Task,
                 out.append(_Candidate(pinned, price * task.num_nodes,
                                       runtime))
             continue
+        cloud_name = res.cloud or 'gcp'
+        if cloud_name != 'gcp':
+            # Non-GCP provider offering TPU slices (kubernetes /
+            # local / plugin clouds): one candidate in the provider's
+            # own "region", priced at the cheapest GCP rate for the
+            # slice (GKE TPU node pools bill as GCP TPUs; the local
+            # fake has no bill at all).
+            from skypilot_tpu import clouds as clouds_lib
+            cloud_obj = clouds_lib.from_name(cloud_name)
+            if res.use_spot and not cloud_obj.supports_spot:
+                continue
+            try:
+                regions = catalog.get_regions(res.accelerator,
+                                              res.use_spot)
+                price = catalog.get_hourly_cost(
+                    res.accelerator, res.use_spot, regions[0], None)
+            except (exceptions.ResourcesUnavailableError,
+                    exceptions.InvalidSpecError):
+                continue
+            pinned = res.copy(
+                cloud=cloud_name,
+                region=res.region or cloud_obj.default_region())
+            if not _is_blocked(pinned, blocked):
+                out.append(_Candidate(pinned, price * task.num_nodes,
+                                      runtime))
+            continue
         # A zone pin implies its region even when region is omitted
         # (zone 'us-east5-b' -> region 'us-east5').
         region_pin = res.region

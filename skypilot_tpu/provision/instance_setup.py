@@ -126,8 +126,74 @@ def setup_runtime_on_cluster(handle: ClusterHandle) -> None:
         list(pool.map(one, runners))
 
 
+def _via_agent(handle: ClusterHandle) -> bool:
+    from skypilot_tpu import clouds
+    return clouds.from_name(handle.provider).runtime_via_agent
+
+
+def _tar_dir(source: str, arcname: str = '.') -> bytes:
+    """gzip tarball of a directory (pycache/build junk excluded)."""
+    import io
+    import tarfile
+
+    def keep(info: 'tarfile.TarInfo'):
+        name = os.path.basename(info.name)
+        if name == '__pycache__' or name.endswith('.pyc'):
+            return None
+        return info
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode='w:gz') as tar:
+        tar.add(source, arcname=arcname, filter=keep)
+    return buf.getvalue()
+
+
+def setup_runtime_via_agent(handle: ClusterHandle) -> None:
+    """Runtime bring-up for SSH-less clouds (``runtime_via_agent``,
+    e.g. kubernetes): the agent is already running (provider
+    bootstrap, e.g. from the pod Secret); ship the package tree
+    THROUGH it so agent-exec'd codegen can import skypilot_tpu (the
+    pod's PYTHONPATH points at the push target)."""
+    data = _tar_dir(_package_source_dir(), arcname='skypilot_tpu')
+    tar_path = '~/.skypilot_tpu/wheels/pkg.tar.gz'
+
+    def one(i: int) -> None:
+        cl = handle.agent_client(i)
+        cl.put_file(tar_path, data)
+        out = cl.exec(
+            f'cd ~/.skypilot_tpu/wheels && tar -xzf pkg.tar.gz && '
+            f'rm -f pkg.tar.gz', timeout=120)
+        if out.get('returncode') != 0:
+            from skypilot_tpu import exceptions
+            raise exceptions.FetchClusterInfoError(
+                f'package unpack failed on host {i}: {out}')
+
+    with ThreadPoolExecutor(
+            max_workers=min(32, handle.num_hosts)) as pool:
+        list(pool.map(one, range(handle.num_hosts)))
+
+
 def sync_to_all_hosts(handle: ClusterHandle, source: str,
                       target: str) -> None:
+    if _via_agent(handle):
+        data = _tar_dir(source.rstrip('/'))
+        tar_path = f'{target.rstrip("/")}.sync.tar.gz'
+
+        def one_agent(i: int) -> None:
+            cl = handle.agent_client(i)
+            cl.put_file(tar_path, data)
+            out = cl.exec(f'mkdir -p {target} && '
+                          f'tar -xzf {tar_path} -C {target} && '
+                          f'rm -f {tar_path}', timeout=300)
+            if out.get('returncode') != 0:
+                from skypilot_tpu import exceptions
+                raise exceptions.SkyTpuError(
+                    f'workdir sync failed on host {i}: {out}')
+
+        with ThreadPoolExecutor(
+                max_workers=min(32, handle.num_hosts)) as pool:
+            list(pool.map(one_agent, range(handle.num_hosts)))
+        return
     runners = _runners(handle)
 
     def one(runner: SSHCommandRunner) -> None:
@@ -142,6 +208,21 @@ def sync_to_all_hosts(handle: ClusterHandle, source: str,
 def sync_file_to_all_hosts(handle: ClusterHandle, source: str,
                            target: str) -> None:
     """Single-file variant (file_mounts with a file source)."""
+    if _via_agent(handle):
+        src = os.path.expanduser(source)
+        with open(src, 'rb') as f:
+            data = f.read()
+        # Preserve permission bits (the rsync path does): a mounted
+        # executable script must stay executable on the hosts.
+        mode = os.stat(src).st_mode & 0o777
+
+        def one_agent(i: int) -> None:
+            handle.agent_client(i).put_file(target, data, mode=mode)
+
+        with ThreadPoolExecutor(
+                max_workers=min(32, handle.num_hosts)) as pool:
+            list(pool.map(one_agent, range(handle.num_hosts)))
+        return
     runners = _runners(handle)
 
     def one(runner: SSHCommandRunner) -> None:

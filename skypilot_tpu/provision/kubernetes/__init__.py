@@ -1,0 +1,11 @@
+"""Kubernetes (GKE) provisioner: TPU slice hosts as pods.
+
+Analog of the reference's ``sky/provision/kubernetes/`` (5 kLoC,
+pods-as-nodes via the kubernetes SDK) redesigned TPU-first: pods
+request ``google.com/tpu`` chips on GKE TPU node pools
+(``gke-tpu-accelerator``/``gke-tpu-topology`` selectors), bootstrap
+the stdlib-only host agent from a Secret (no SSH, no kubectl-exec),
+and the control plane rides the same agent HTTP protocol as every
+other cloud. The API client is hand-rolled REST (like
+``provision/gcp/client.py``) — no kubernetes SDK dependency.
+"""

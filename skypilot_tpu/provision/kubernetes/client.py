@@ -1,0 +1,215 @@
+"""Minimal Kubernetes REST client (no kubernetes SDK).
+
+Auth resolution order (model: the reference's kubeconfig handling in
+``sky/provision/kubernetes/utils.py``, minus the SDK):
+1. ``SKYTPU_KUBE_API`` env — explicit API server URL (+ optional
+   ``SKYTPU_KUBE_TOKEN``). This is also the test hook: tests point it
+   at an in-process fake API server.
+2. In-cluster service account (``KUBERNETES_SERVICE_HOST`` env +
+   ``/var/run/secrets/kubernetes.io/serviceaccount/``) — the normal
+   path for controllers running inside GKE.
+3. ``$KUBECONFIG`` / ``~/.kube/config`` — bearer-token or client-cert
+   users of the current context.
+"""
+import base64
+import json
+import os
+import ssl
+import tempfile
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_SA_DIR = '/var/run/secrets/kubernetes.io/serviceaccount'
+_RETRYABLE_HTTP = (500, 502, 503, 504)
+_MAX_RETRIES = 3
+_RETRY_BACKOFF_S = 0.5
+
+
+def _load_kubeconfig() -> Tuple[str, Dict[str, str], Optional[ssl.SSLContext]]:
+    """(server, headers, ssl_context) from the current context of
+    $KUBECONFIG / ~/.kube/config."""
+    import yaml
+    path = os.environ.get('KUBECONFIG',
+                          os.path.expanduser('~/.kube/config'))
+    with open(path, encoding='utf-8') as f:
+        cfg = yaml.safe_load(f)
+    ctx_name = cfg.get('current-context')
+    ctx = next(c['context'] for c in cfg['contexts']
+               if c['name'] == ctx_name)
+    cluster = next(c['cluster'] for c in cfg['clusters']
+                   if c['name'] == ctx['cluster'])
+    user = next(u['user'] for u in cfg['users']
+                if u['name'] == ctx['user'])
+
+    server = cluster['server']
+    headers: Dict[str, str] = {}
+    ssl_ctx: Optional[ssl.SSLContext] = None
+    if server.startswith('https'):
+        ssl_ctx = ssl.create_default_context()
+        if cluster.get('insecure-skip-tls-verify'):
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
+        elif 'certificate-authority-data' in cluster:
+            ssl_ctx = ssl.create_default_context(cadata=base64.b64decode(
+                cluster['certificate-authority-data']).decode())
+        elif 'certificate-authority' in cluster:
+            ssl_ctx = ssl.create_default_context(
+                cafile=cluster['certificate-authority'])
+        if 'client-certificate-data' in user:
+            # load_cert_chain needs files; write 0600 temps.
+            cert = tempfile.NamedTemporaryFile(delete=False)
+            cert.write(base64.b64decode(user['client-certificate-data']))
+            cert.close()
+            keyf = tempfile.NamedTemporaryFile(delete=False)
+            keyf.write(base64.b64decode(user['client-key-data']))
+            keyf.close()
+            os.chmod(keyf.name, 0o600)
+            ssl_ctx.load_cert_chain(cert.name, keyf.name)
+    if 'token' in user:
+        headers['Authorization'] = f'Bearer {user["token"]}'
+    return server, headers, ssl_ctx
+
+
+class KubeClient:
+    """Talks to one API server; namespace-scoped helpers."""
+
+    def __init__(self):
+        self._ssl: Optional[ssl.SSLContext] = None
+        self._headers: Dict[str, str] = {}
+        api = os.environ.get('SKYTPU_KUBE_API')
+        if api:
+            self.server = api.rstrip('/')
+            token = os.environ.get('SKYTPU_KUBE_TOKEN')
+            if token:
+                self._headers['Authorization'] = f'Bearer {token}'
+            self.namespace = os.environ.get('SKYTPU_KUBE_NAMESPACE',
+                                            'default')
+            return
+        if os.environ.get('KUBERNETES_SERVICE_HOST'):
+            host = os.environ['KUBERNETES_SERVICE_HOST']
+            port = os.environ.get('KUBERNETES_SERVICE_PORT', '443')
+            self.server = f'https://{host}:{port}'
+            with open(os.path.join(_SA_DIR, 'token'),
+                      encoding='utf-8') as f:
+                self._headers['Authorization'] = f'Bearer {f.read()}'
+            self._ssl = ssl.create_default_context(
+                cafile=os.path.join(_SA_DIR, 'ca.crt'))
+            try:
+                with open(os.path.join(_SA_DIR, 'namespace'),
+                          encoding='utf-8') as f:
+                    self.namespace = f.read().strip()
+            except OSError:
+                self.namespace = 'default'
+            self.namespace = os.environ.get('SKYTPU_KUBE_NAMESPACE',
+                                            self.namespace)
+            return
+        self.server, self._headers, self._ssl = _load_kubeconfig()
+        self.namespace = os.environ.get('SKYTPU_KUBE_NAMESPACE',
+                                        'default')
+
+    # -- raw ------------------------------------------------------------
+
+    def request(self, method: str, path: str,
+                body: Optional[Dict[str, Any]] = None,
+                params: Optional[Dict[str, str]] = None,
+                timeout: float = 30.0) -> Dict[str, Any]:
+        url = self.server + path
+        if params:
+            url += '?' + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        headers = dict(self._headers)
+        headers['Content-Type'] = 'application/json'
+        headers['Accept'] = 'application/json'
+        backoff = _RETRY_BACKOFF_S
+        for attempt in range(_MAX_RETRIES + 1):
+            req = urllib.request.Request(url, data=data, method=method,
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout,
+                        context=self._ssl) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                # Same transient policy as the GCP client: only GETs
+                # retry retryable 5xx (mutations may have landed).
+                if (method == 'GET' and e.code in _RETRYABLE_HTTP
+                        and attempt < _MAX_RETRIES):
+                    time.sleep(backoff)
+                    backoff *= 2
+                    continue
+                raise classify_http_error(e) from e
+            except (urllib.error.URLError, OSError) as e:
+                if attempt < _MAX_RETRIES:
+                    time.sleep(backoff)
+                    backoff *= 2
+                    continue
+                raise exceptions.ApiError(
+                    f'network error talking to {url}: {e}') from e
+        raise AssertionError('unreachable')
+
+    # -- namespaced resources -------------------------------------------
+
+    def _ns_path(self, kind: str, name: str = '') -> str:
+        path = f'/api/v1/namespaces/{self.namespace}/{kind}'
+        return f'{path}/{name}' if name else path
+
+    def create_pod(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        return self.request('POST', self._ns_path('pods'), manifest)
+
+    def get_pod(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.request('GET', self._ns_path('pods', name))
+        except exceptions.ClusterDoesNotExist:
+            return None
+
+    def list_pods(self, label_selector: str) -> Dict[str, Any]:
+        return self.request('GET', self._ns_path('pods'),
+                            params={'labelSelector': label_selector})
+
+    def delete_pod(self, name: str) -> None:
+        try:
+            self.request('DELETE', self._ns_path('pods', name),
+                         params={'gracePeriodSeconds': '5'})
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+    def create_secret(self, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        return self.request('POST', self._ns_path('secrets'), manifest)
+
+    def get_secret(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self.request('GET', self._ns_path('secrets', name))
+        except exceptions.ClusterDoesNotExist:
+            return None
+
+    def delete_secret(self, name: str) -> None:
+        try:
+            self.request('DELETE', self._ns_path('secrets', name))
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+
+def classify_http_error(e: 'urllib.error.HTTPError') -> Exception:
+    """Map k8s API errors into the framework's failover taxonomy."""
+    try:
+        detail = e.read().decode()
+    except OSError:
+        detail = ''
+    msg = f'k8s API {e.code}: {detail[:500]}'
+    if e.code == 404:
+        return exceptions.ClusterDoesNotExist(msg)
+    if e.code == 403:
+        # Resource quota exhaustion surfaces as 403 Forbidden with
+        # 'exceeded quota' — region-level blocklist material.
+        if 'quota' in detail.lower():
+            return exceptions.QuotaExceededError(msg)
+        return exceptions.ApiError(msg)
+    if e.code == 422 and 'insufficient' in detail.lower():
+        return exceptions.StockoutError(msg)
+    return exceptions.ApiError(msg)

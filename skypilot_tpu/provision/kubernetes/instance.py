@@ -1,0 +1,308 @@
+"""Kubernetes provider: TPU slice hosts as pods (GKE TPU node pools).
+
+Design (vs the reference's ``sky/provision/kubernetes/instance.py``
+pods-as-VMs + SSH-jump-pod):
+- One pod per TPU HOST; a slice of H hosts x S slices is S*H pods,
+  rank-labeled. GKE gang-schedules a TPU podslice natively when pods
+  carry ``google.com/tpu`` limits + the accelerator/topology node
+  selectors.
+- Bootstrap WITHOUT SSH: a per-cluster Secret carries the stdlib-only
+  host agent (``runtime/agent.py``) and the control-plane token; the
+  pod command starts the agent directly. The rest of the framework
+  then reaches the pod exactly like any other host (agent HTTP:
+  exec/run/put/read).
+- The package tree itself ships AFTER bring-up via the agent's /put
+  (``instance_setup.setup_runtime_via_agent``) — same effect as the
+  reference's wheel upload, no image bake required.
+"""
+import base64
+import os
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions, tpu_logging
+from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
+                                           ProvisionConfig,
+                                           ProvisionRecord)
+from skypilot_tpu.provision.kubernetes import client as kube
+
+logger = tpu_logging.init_logger(__name__)
+
+_CLUSTER_LABEL = 'skypilot-tpu/cluster'
+_RANK_LABEL = 'skypilot-tpu/rank'
+_PORT_ANNOTATION = 'skypilot-tpu/agent-port'
+_AGENT_PORT = 8790
+
+# GKE TPU node-pool accelerator label values per generation
+# (cloud.google.com/gke-tpu-accelerator).
+_GKE_ACCELERATOR = {
+    'v2': 'tpu-v2-podslice',
+    'v3': 'tpu-v3-podslice',
+    'v4': 'tpu-v4-podslice',
+    # The catalog canonicalizes 'v5litepod' -> 'v5e'
+    # (tpu_catalog._GEN_ALIASES); accept both spellings.
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5litepod': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+
+def _agent_source() -> str:
+    from skypilot_tpu.runtime import agent
+    with open(agent.__file__, encoding='utf-8') as f:
+        return f.read()
+
+
+def _secret_name(cluster_name_on_cloud: str) -> str:
+    return f'{cluster_name_on_cloud}-boot'
+
+
+def _pod_name(cluster_name_on_cloud: str, rank: int) -> str:
+    return f'{cluster_name_on_cloud}-{rank}'
+
+
+def _pod_manifest(config: ProvisionConfig, rank: int,
+                  slice_index: int) -> Dict[str, Any]:
+    nc = config.node_config
+    image = nc.get('image_id') or 'python:3.11-slim'
+    chips = int(nc.get('chips_per_host', nc.get('chips', 0)) or 0)
+    resources: Dict[str, Any] = {}
+    node_selector: Dict[str, str] = {}
+    if nc.get('tpu_type'):
+        gen = nc.get('tpu_generation', '')
+        accel = _GKE_ACCELERATOR.get(gen)
+        if accel is None:
+            raise exceptions.InvalidSpecError(
+                f'no GKE accelerator label for TPU generation {gen!r}')
+        node_selector['cloud.google.com/gke-tpu-accelerator'] = accel
+        if nc.get('topology'):
+            node_selector['cloud.google.com/gke-tpu-topology'] = \
+                nc['topology']
+        per_host = max(1, chips // max(1, int(nc.get('num_hosts', 1))))
+        resources = {'limits': {'google.com/tpu': str(per_host)}}
+    env = [{'name': 'SKYTPU_K8S_RANK', 'value': str(rank)}]
+    # PYTHONPATH points at the (post-bring-up) package push target so
+    # agent-exec'd codegen snippets can import skypilot_tpu.
+    command = [
+        '/bin/sh', '-c',
+        'export PYTHONPATH=/root/.skypilot_tpu/wheels:$PYTHONPATH; '
+        f'exec python3 /skytpu-boot/agent.py --port {_AGENT_PORT} '
+        '--token-file /skytpu-boot/token',
+    ]
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': _pod_name(config.cluster_name_on_cloud, rank),
+            'labels': {
+                _CLUSTER_LABEL: config.cluster_name_on_cloud,
+                _RANK_LABEL: str(rank),
+                'skypilot-tpu/slice': str(slice_index),
+                **(nc.get('labels') or {}),
+            },
+        },
+        'spec': {
+            'restartPolicy': 'Never',
+            'containers': [{
+                'name': 'host',
+                'image': image,
+                'command': command,
+                'env': env,
+                'resources': resources,
+                'volumeMounts': [{'name': 'skytpu-boot',
+                                  'mountPath': '/skytpu-boot'}],
+            }],
+            'nodeSelector': node_selector,
+            'volumes': [{
+                'name': 'skytpu-boot',
+                'secret': {
+                    'secretName': _secret_name(
+                        config.cluster_name_on_cloud),
+                    'defaultMode': 0o444,
+                },
+            }],
+        },
+    }
+
+
+def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:
+    return config
+
+
+def run_instances(config: ProvisionConfig) -> ProvisionRecord:
+    c = kube.KubeClient()
+    name = config.cluster_name_on_cloud
+    num_hosts = int(config.node_config.get('num_hosts', 1) or 1)
+    total = num_hosts * max(1, config.count)
+
+    existing = c.list_pods(f'{_CLUSTER_LABEL}={name}').get('items', [])
+    live = [p for p in existing
+            if p.get('metadata', {}).get('deletionTimestamp') is None]
+    if len(live) == total:
+        logger.info('Reusing %d existing pods for %s', total, name)
+        return ProvisionRecord(provider='kubernetes',
+                               region=config.region, zone=config.zone,
+                               cluster_name_on_cloud=name,
+                               resumed=True)
+    if live:
+        # Partial remains of a previous attempt — recreate cleanly.
+        # Pod deletion is ASYNC: wait until the names are actually
+        # gone or the same-name create below 409s (the in-process
+        # fake deletes synchronously; real clusters do not).
+        terminate_instances(config.region, name)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            left = c.list_pods(
+                f'{_CLUSTER_LABEL}={name}').get('items', [])
+            if not left:
+                break
+            time.sleep(2)
+        else:
+            raise exceptions.ApiError(
+                f'old pods of {name} still terminating after 120s')
+
+    token = secrets.token_hex(16)
+    c.delete_secret(_secret_name(name))
+    c.create_secret({
+        'apiVersion': 'v1',
+        'kind': 'Secret',
+        'metadata': {'name': _secret_name(name),
+                     'labels': {_CLUSTER_LABEL: name}},
+        'type': 'Opaque',
+        'data': {
+            'agent.py': base64.b64encode(
+                _agent_source().encode()).decode(),
+            'token': base64.b64encode(token.encode()).decode(),
+        },
+    })
+    created: List[str] = []
+    try:
+        for rank in range(total):
+            manifest = _pod_manifest(config, rank, rank // num_hosts)
+            c.create_pod(manifest)
+            created.append(manifest['metadata']['name'])
+    except exceptions.SkyTpuError:
+        # All-or-nothing (a TPU slice is one atomic allocation):
+        # roll back partial pods so failover retries from clean state.
+        for pod in created:
+            c.delete_pod(pod)
+        c.delete_secret(_secret_name(name))
+        raise
+    return ProvisionRecord(provider='kubernetes', region=config.region,
+                           zone=config.zone,
+                           cluster_name_on_cloud=name,
+                           created_instance_ids=created)
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, state
+    c = kube.KubeClient()
+    timeout = float(os.environ.get('SKYTPU_KUBE_WAIT_TIMEOUT', '600'))
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = c.list_pods(
+            f'{_CLUSTER_LABEL}={cluster_name_on_cloud}'
+        ).get('items', [])
+        if pods and all(
+                p.get('status', {}).get('phase') == 'Running' and
+                p.get('status', {}).get('podIP')
+                for p in pods):
+            return
+        bad = [p for p in pods
+               if p.get('status', {}).get('phase') == 'Failed']
+        if bad:
+            raise exceptions.StockoutError(
+                f'{len(bad)} pod(s) of {cluster_name_on_cloud} '
+                'failed during bring-up')
+        time.sleep(2)
+    # Unschedulable past the deadline == no TPU capacity in this
+    # cluster — stockout granularity so the failover engine moves on.
+    raise exceptions.StockoutError(
+        f'pods of {cluster_name_on_cloud} not Running after '
+        f'{timeout}s (likely no free TPU node-pool capacity)')
+
+
+def get_cluster_info(region: str,
+                     cluster_name_on_cloud: str) -> ClusterInfo:
+    del region
+    c = kube.KubeClient()
+    pods = c.list_pods(
+        f'{_CLUSTER_LABEL}={cluster_name_on_cloud}').get('items', [])
+    if not pods:
+        raise exceptions.FetchClusterInfoError(
+            f'no pods found for {cluster_name_on_cloud}')
+    pods.sort(key=lambda p: int(
+        p['metadata']['labels'].get(_RANK_LABEL, '0')))
+    instances = []
+    for p in pods:
+        annotations = p['metadata'].get('annotations') or {}
+        instances.append(InstanceInfo(
+            instance_id=p['metadata']['name'],
+            internal_ip=p.get('status', {}).get('podIP', ''),
+            external_ip=None,
+            agent_port=int(annotations.get(_PORT_ANNOTATION,
+                                           _AGENT_PORT)),
+            tags={'runtime_dir': '~/.skypilot_tpu'},
+        ))
+    token = None
+    secret = c.get_secret(_secret_name(cluster_name_on_cloud))
+    if secret:
+        token = base64.b64decode(
+            secret.get('data', {}).get('token', '')).decode() or None
+    return ClusterInfo(provider='kubernetes', instances=instances,
+                       head_instance_id=instances[0].instance_id,
+                       custom_metadata={'agent_token': token})
+
+
+def query_instances(region: str,
+                    cluster_name_on_cloud: str) -> Dict[str, Any]:
+    del region
+    c = kube.KubeClient()
+    pods = c.list_pods(
+        f'{_CLUSTER_LABEL}={cluster_name_on_cloud}').get('items', [])
+    phase_map = {
+        'Running': 'running',
+        'Pending': 'pending',
+        'Succeeded': 'terminated',
+        'Failed': 'terminated',
+        'Unknown': 'unknown',
+    }
+    return {
+        p['metadata']['name']: phase_map.get(
+            p.get('status', {}).get('phase', ''), 'unknown')
+        for p in pods
+    }
+
+
+def stop_instances(region: str, cluster_name_on_cloud: str) -> None:
+    del region, cluster_name_on_cloud
+    raise exceptions.NotSupportedError(
+        'kubernetes pods cannot be stopped-and-resumed; terminate '
+        'instead (same constraint as TPU pods on GCP).')
+
+
+def terminate_instances(region: str,
+                        cluster_name_on_cloud: str) -> None:
+    del region
+    c = kube.KubeClient()
+    pods = c.list_pods(
+        f'{_CLUSTER_LABEL}={cluster_name_on_cloud}').get('items', [])
+    for p in pods:
+        c.delete_pod(p['metadata']['name'])
+    c.delete_secret(_secret_name(cluster_name_on_cloud))
+
+
+def open_ports(region: str, cluster_name_on_cloud: str,
+               ports) -> None:
+    # Pod IPs are cluster-internal; user ports are reachable
+    # in-cluster directly. (A LoadBalancer/Ingress Service per
+    # user-requested port is the external-exposure path — not needed
+    # by the control plane, which never opens the agent port.)
+    del region, cluster_name_on_cloud, ports
+
+
+def cleanup_ports(region: str, cluster_name_on_cloud: str) -> None:
+    del region, cluster_name_on_cloud

@@ -226,6 +226,30 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'error': 'unauthorized'}, 401)
             return
         parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == '/put':
+            # Raw octet-stream upload: ?path=...&mode=oct&append=0|1.
+            # The file-transfer primitive for clusters reached only
+            # through the agent (kubernetes pods — no SSH/rsync).
+            qs = urllib.parse.parse_qs(parsed.query)
+            path = os.path.expanduser(qs.get('path', [''])[0])
+            if not path:
+                self._json({'error': 'path required'}, 400)
+                return
+            length = int(self.headers.get('Content-Length', '0'))
+            data = self.rfile.read(length)
+            try:
+                os.makedirs(os.path.dirname(path) or '.',
+                            exist_ok=True)
+                mode = 'ab' if qs.get('append', ['0'])[0] == '1' \
+                    else 'wb'
+                with open(path, mode) as f:
+                    f.write(data)
+                if 'mode' in qs:
+                    os.chmod(path, int(qs['mode'][0], 8))
+                self._json({'ok': True, 'bytes': len(data)})
+            except OSError as e:
+                self._json({'error': str(e)}, 500)
+            return
         try:
             body = self._read_body()
         except json.JSONDecodeError:

@@ -143,6 +143,38 @@ class AgentClient:
         return self._get('/read', {'path': path, 'offset': offset},
                          raw=True)
 
+    def put_file(self, path: str, data: bytes,
+                 mode: Optional[int] = None,
+                 chunk: int = 4 << 20) -> None:
+        """Upload ``data`` to ``path`` on the host (chunked; the
+        file-transfer primitive for clusters with no SSH — e.g.
+        kubernetes pods). ``mode``: chmod octal int (e.g. 0o755)."""
+        params: Dict[str, Any] = {'path': path}
+        if mode is not None:
+            params['mode'] = oct(mode)[2:]
+        for i in range(0, max(len(data), 1), chunk):
+            q = dict(params, append=int(i > 0))
+            url = (self._base + '/put?' +
+                   urllib.parse.urlencode(q))
+            headers = dict(self._headers())
+            headers['Content-Type'] = 'application/octet-stream'
+            req = urllib.request.Request(url, data=data[i:i + chunk],
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as resp:
+                    out = json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                # Agents report failures (short write, bad path) as
+                # 4xx/5xx — map into the framework's taxonomy so
+                # provision/failover handle them.
+                raise exceptions.SkyTpuError(
+                    f'put_file {path} on {self.host}: HTTP {e.code} '
+                    f'{e.read()[:200]!r}') from e
+            if not out.get('ok'):
+                raise exceptions.SkyTpuError(
+                    f'put_file {path}: {out}')
+
 
 def start_local_agent(port: int,
                       runtime_dir: Optional[str] = None,

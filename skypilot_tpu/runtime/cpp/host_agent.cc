@@ -551,6 +551,45 @@ void HandleConnection(int fd) {
       fclose(f);
     }
     SendResponse(fd, 200, "application/octet-stream", data);
+  } else if (req.method == "POST" && req.path == "/put") {
+    // Raw octet-stream upload (?path=...&mode=oct&append=0|1): the
+    // file-transfer primitive for clusters reached only through the
+    // agent (kubernetes pods — no SSH/rsync). Body is NOT json.
+    std::string path = ProcTable::Expand(req.query["path"]);
+    if (path.empty()) {
+      SendJson(fd, "{\"error\": \"path required\"}", 400);
+      close(fd);
+      return;
+    }
+    // mkdir -p the parent.
+    for (size_t i = 1; i < path.size(); ++i) {
+      if (path[i] == '/') {
+        mkdir(path.substr(0, i).c_str(), 0755);
+      }
+    }
+    bool append = req.query["append"] == "1";
+    FILE* f = fopen(path.c_str(), append ? "ab" : "wb");
+    if (f == nullptr) {
+      SendJson(fd, "{\"error\": \"cannot open\"}", 500);
+      close(fd);
+      return;
+    }
+    size_t written = fwrite(req.body.data(), 1, req.body.size(), f);
+    int close_rc = fclose(f);  // flush failures (ENOSPC) land here
+    if (written != req.body.size() || close_rc != 0) {
+      SendJson(fd, "{\"error\": \"short write\"}", 500);
+      close(fd);
+      return;
+    }
+    if (!req.query["mode"].empty()) {
+      chmod(path.c_str(),
+            static_cast<mode_t>(strtol(req.query["mode"].c_str(),
+                                       nullptr, 8)));
+    }
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "{\"ok\": true, \"bytes\": %zu}",
+                  written);
+    SendJson(fd, buf);
   } else if (req.method == "POST") {
     JsonValue body;
     JsonParser parser(req.body);

@@ -1,0 +1,349 @@
+"""Kubernetes provider: REST client, pod manifests, and the full
+launch -> gang-run -> down path against an in-process fake
+kube-apiserver whose "pods" are real local agent processes (the same
+fake-cloud philosophy as provision/local, applied to the k8s API).
+"""
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu import core, exceptions, execution
+from skypilot_tpu.provision.common import ProvisionConfig
+from skypilot_tpu.provision.kubernetes import client as kube_client
+from skypilot_tpu.provision.kubernetes import instance as kube_instance
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class FakeKubeApi:
+    """Enough of the kube API for the provider: namespaced pods +
+    secrets. Creating a pod 'schedules' it by spawning the agent the
+    pod's Secret carries — faithfully exercising the no-SSH bootstrap
+    (HOME is a per-pod dir, PYTHONPATH emulates the container env,
+    the agent-port annotation stands in for distinct pod IPs)."""
+
+    def __init__(self, root_dir):
+        self.root = root_dir
+        self.pods = {}
+        self.secrets = {}
+        self.procs = {}
+        self.fail_create = None  # 'stockout' | 'quota'
+        self.lock = threading.Lock()
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                qs = urllib.parse.parse_qs(parsed.query)
+                parts = parsed.path.strip('/').split('/')
+                # /api/v1/namespaces/<ns>/<kind>[/<name>]
+                kind = parts[4] if len(parts) > 4 else ''
+                name = parts[5] if len(parts) > 5 else ''
+                store = (api.pods if kind == 'pods' else api.secrets)
+                with api.lock:
+                    if name:
+                        if name not in store:
+                            self._json({'kind': 'Status',
+                                        'code': 404}, 404)
+                            return
+                        self._json(store[name])
+                        return
+                    items = list(store.values())
+                    sel = qs.get('labelSelector', [''])[0]
+                    if sel and '=' in sel:
+                        k, v = sel.split('=', 1)
+                        items = [
+                            p for p in items
+                            if p['metadata'].get('labels',
+                                                 {}).get(k) == v
+                        ]
+                    self._json({'kind': 'List', 'items': items})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get('Content-Length', '0'))
+                manifest = json.loads(self.rfile.read(length))
+                parts = urllib.parse.urlparse(
+                    self.path).path.strip('/').split('/')
+                kind = parts[4] if len(parts) > 4 else ''
+                if kind == 'secrets':
+                    with api.lock:
+                        api.secrets[
+                            manifest['metadata']['name']] = manifest
+                    self._json(manifest, 201)
+                    return
+                if kind == 'pods':
+                    if api.fail_create == 'stockout':
+                        self._json({'message':
+                                    'Insufficient google.com/tpu'},
+                                   422)
+                        return
+                    if api.fail_create == 'quota':
+                        self._json({'message': 'exceeded quota: tpu'},
+                                   403)
+                        return
+                    api.schedule_pod(manifest)
+                    self._json(manifest, 201)
+                    return
+                self._json({'code': 404}, 404)
+
+            def do_DELETE(self):  # noqa: N802
+                parts = urllib.parse.urlparse(
+                    self.path).path.strip('/').split('/')
+                kind = parts[4] if len(parts) > 4 else ''
+                name = parts[5] if len(parts) > 5 else ''
+                with api.lock:
+                    if kind == 'pods' and name in api.pods:
+                        api.kill_pod(name)
+                        del api.pods[name]
+                        self._json({'status': 'Success'})
+                        return
+                    if kind == 'secrets' and name in api.secrets:
+                        del api.secrets[name]
+                        self._json({'status': 'Success'})
+                        return
+                self._json({'code': 404}, 404)
+
+        self.server = ThreadingHTTPServer(('127.0.0.1', 0), Handler)
+        self.url = f'http://127.0.0.1:{self.server.server_port}'
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def schedule_pod(self, manifest):
+        name = manifest['metadata']['name']
+        secret_name = manifest['spec']['volumes'][0]['secret'][
+            'secretName']
+        secret = self.secrets[secret_name]
+        pod_home = os.path.join(self.root, name)
+        boot = os.path.join(pod_home, 'skytpu-boot')
+        os.makedirs(boot, exist_ok=True)
+        for fname, b64 in secret['data'].items():
+            with open(os.path.join(boot, fname), 'wb') as f:
+                f.write(base64.b64decode(b64))
+        port = _free_port()
+        env = dict(os.environ)
+        env['HOME'] = pod_home
+        env['PYTHONPATH'] = os.path.join(pod_home, '.skypilot_tpu',
+                                         'wheels')
+        env.pop('SKYTPU_STATE_DIR', None)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(boot, 'agent.py'),
+             '--port', str(port), '--host', '127.0.0.1',
+             '--token-file', os.path.join(boot, 'token')],
+            env=env, start_new_session=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.procs[name] = proc
+        manifest.setdefault('metadata', {}).setdefault(
+            'annotations', {})['skypilot-tpu/agent-port'] = str(port)
+        manifest['status'] = {'phase': 'Running',
+                              'podIP': '127.0.0.1'}
+        self.pods[name] = manifest
+
+    def kill_pod(self, name):
+        proc = self.procs.pop(name, None)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                proc.terminate()
+
+    def shutdown(self):
+        for name in list(self.procs):
+            self.kill_pod(name)
+        self.server.shutdown()
+
+
+@pytest.fixture
+def fake_api(tmp_path, monkeypatch):
+    api = FakeKubeApi(str(tmp_path / 'pods'))
+    monkeypatch.setenv('SKYTPU_KUBE_API', api.url)
+    monkeypatch.setenv('SKYTPU_KUBE_NAMESPACE', 'default')
+    monkeypatch.setenv('SKYTPU_KUBE_WAIT_TIMEOUT', '60')
+    yield api
+    api.shutdown()
+
+
+def _k8s_task(run, num_hosts=2, name='k8s-e2e'):
+    task = Task(name=name, run=run)
+    res = Resources(cloud='kubernetes')
+    res._extra_config = {'num_hosts': num_hosts}  # pylint: disable=protected-access
+    task.set_resources(res)
+    return task
+
+
+class TestKubeClient:
+
+    def test_env_override(self, fake_api):
+        c = kube_client.KubeClient()
+        assert c.server == fake_api.url
+        assert c.namespace == 'default'
+        assert c.list_pods('a=b')['items'] == []
+
+    def test_kubeconfig_token_auth(self, tmp_path, monkeypatch):
+        import yaml
+        cfg = {
+            'current-context': 'ctx',
+            'contexts': [{'name': 'ctx',
+                          'context': {'cluster': 'cl',
+                                      'user': 'me'}}],
+            'clusters': [{'name': 'cl',
+                          'cluster': {
+                              'server': 'https://1.2.3.4:6443',
+                              'insecure-skip-tls-verify': True}}],
+            'users': [{'name': 'me', 'user': {'token': 'sekret'}}],
+        }
+        path = tmp_path / 'kubeconfig'
+        path.write_text(yaml.safe_dump(cfg))
+        monkeypatch.delenv('SKYTPU_KUBE_API', raising=False)
+        monkeypatch.delenv('KUBERNETES_SERVICE_HOST', raising=False)
+        monkeypatch.setenv('KUBECONFIG', str(path))
+        c = kube_client.KubeClient()
+        assert c.server == 'https://1.2.3.4:6443'
+        assert c._headers['Authorization'] == 'Bearer sekret'
+
+    def test_error_classification(self):
+        import io
+        import urllib.error
+
+        def err(code, body):
+            return urllib.error.HTTPError(
+                'http://x', code, 'oops', {},
+                io.BytesIO(body.encode()))
+
+        assert isinstance(
+            kube_client.classify_http_error(err(404, '')),
+            exceptions.ClusterDoesNotExist)
+        assert isinstance(
+            kube_client.classify_http_error(
+                err(403, 'exceeded quota: tpu')),
+            exceptions.QuotaExceededError)
+        assert isinstance(
+            kube_client.classify_http_error(
+                err(422, 'Insufficient google.com/tpu')),
+            exceptions.StockoutError)
+
+
+class TestPodManifest:
+
+    def test_tpu_pod_shape(self):
+        config = ProvisionConfig(
+            provider='kubernetes', region='kubernetes', zone=None,
+            cluster_name='c', cluster_name_on_cloud='c-abcd',
+            node_config={
+                'tpu_type': 'tpu-v5p-16',
+                'tpu_generation': 'v5p',
+                'topology': '2x2x2',
+                'num_hosts': 2,
+                'chips': 8,
+            }, count=1)
+        m = kube_instance._pod_manifest(config, rank=1, slice_index=0)
+        assert m['metadata']['name'] == 'c-abcd-1'
+        sel = m['spec']['nodeSelector']
+        assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+            'tpu-v5p-slice'
+        assert sel['cloud.google.com/gke-tpu-topology'] == '2x2x2'
+        limits = m['spec']['containers'][0]['resources']['limits']
+        assert limits['google.com/tpu'] == '4'  # 8 chips / 2 hosts
+        vol = m['spec']['volumes'][0]
+        assert vol['secret']['secretName'] == 'c-abcd-boot'
+
+    def test_v5e_generation_maps(self):
+        # The catalog canonicalizes 'v5litepod' -> 'v5e'; the GKE
+        # accelerator map must accept the canonical spelling (it once
+        # keyed only 'v5litepod', making every v5e launch fail).
+        config = ProvisionConfig(
+            provider='kubernetes', region='kubernetes', zone=None,
+            cluster_name='c', cluster_name_on_cloud='c-ffff',
+            node_config={'tpu_type': 'tpu-v5e-8',
+                         'tpu_generation': 'v5e', 'topology': '2x4',
+                         'num_hosts': 2, 'chips': 8}, count=1)
+        m = kube_instance._pod_manifest(config, rank=0, slice_index=0)
+        assert m['spec']['nodeSelector'][
+            'cloud.google.com/gke-tpu-accelerator'] == \
+            'tpu-v5-lite-podslice'
+
+    def test_cpu_pod_has_no_tpu_bits(self):
+        config = ProvisionConfig(
+            provider='kubernetes', region='kubernetes', zone=None,
+            cluster_name='c', cluster_name_on_cloud='c-eeee',
+            node_config={'num_hosts': 1}, count=1)
+        m = kube_instance._pod_manifest(config, rank=0, slice_index=0)
+        assert m['spec']['nodeSelector'] == {}
+        assert m['spec']['containers'][0]['resources'] == {}
+
+
+class TestKubernetesEndToEnd:
+
+    def test_launch_gang_run_down(self, fake_api):
+        from skypilot_tpu import state, status_lib
+        from skypilot_tpu.runtime import job_lib
+        import io
+        task = _k8s_task(
+            'echo krank=$SKYTPU_NODE_RANK/$SKYTPU_NUM_NODES')
+        job_id, handle = execution.launch(task, 'k8sc',
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        try:
+            assert handle.provider == 'kubernetes'
+            assert handle.num_hosts == 2
+            final = core.wait_for_job('k8sc', job_id, timeout=120)
+            assert final == job_lib.JobStatus.SUCCEEDED
+            buf = io.StringIO()
+            core.tail_logs('k8sc', job_id, out=buf)
+            log = buf.getvalue()
+            assert 'krank=0/2' in log
+            assert 'krank=1/2' in log
+            rec = state.get_cluster_from_name('k8sc')
+            assert rec['status'] == status_lib.ClusterStatus.UP
+        finally:
+            core.down('k8sc', purge=True)
+        # Pods AND their agent processes are gone.
+        assert fake_api.pods == {}
+        assert all(p.poll() is not None
+                   for p in fake_api.procs.values())
+
+    def test_stockout_failover_raises_cleanly(self, fake_api):
+        fake_api.fail_create = 'stockout'
+        task = _k8s_task('echo hi', num_hosts=1)
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            execution.launch(task, 'k8sfail', quiet_optimizer=True,
+                             detach_run=True)
+        # No pods or secrets leaked behind the failed attempt.
+        assert fake_api.pods == {}
+
+    def test_stop_unsupported(self, fake_api):
+        task = _k8s_task('sleep 1', num_hosts=1)
+        _, _ = execution.launch(task, 'k8stop', quiet_optimizer=True,
+                                detach_run=True)
+        try:
+            with pytest.raises(exceptions.NotSupportedError):
+                core.stop('k8stop')
+        finally:
+            core.down('k8stop', purge=True)

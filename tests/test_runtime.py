@@ -105,6 +105,20 @@ class TestAgentProtocol:
         out = client.exec('sleep 30', timeout=1)
         assert out['returncode'] == 124
 
+    def test_put_file_roundtrip(self, agent, tmp_path):
+        """/put writes raw bytes (chunked append, parent dirs created,
+        mode applied) — the file-transfer primitive for SSH-less
+        clusters (kubernetes pods)."""
+        client, _ = agent
+        path = str(tmp_path / 'sub' / 'dir' / 'blob.bin')
+        data = bytes(range(256)) * 64
+        client.put_file(path, data, mode=0o755, chunk=4096)
+        assert client.read_file(path) == data
+        assert os.stat(path).st_mode & 0o777 == 0o755
+        # Overwrite (not append) on a fresh put.
+        client.put_file(path, b'short')
+        assert client.read_file(path) == b'short'
+
     def test_read_file_with_offset(self, agent, tmp_path):
         client, _ = agent
         p = tmp_path / 'data.txt'
