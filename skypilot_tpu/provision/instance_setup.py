@@ -131,6 +131,13 @@ def _via_agent(handle: ClusterHandle) -> bool:
     return clouds.from_name(handle.provider).runtime_via_agent
 
 
+def _fan_out_agents(handle: ClusterHandle, fn) -> None:
+    """Run ``fn(host_index)`` in parallel over every host's agent."""
+    with ThreadPoolExecutor(
+            max_workers=min(32, handle.num_hosts)) as pool:
+        list(pool.map(fn, range(handle.num_hosts)))
+
+
 def _tar_dir(source: str, arcname: str = '.') -> bytes:
     """gzip tarball of a directory (pycache/build junk excluded)."""
     import io
@@ -168,9 +175,7 @@ def setup_runtime_via_agent(handle: ClusterHandle) -> None:
             raise exceptions.FetchClusterInfoError(
                 f'package unpack failed on host {i}: {out}')
 
-    with ThreadPoolExecutor(
-            max_workers=min(32, handle.num_hosts)) as pool:
-        list(pool.map(one, range(handle.num_hosts)))
+    _fan_out_agents(handle, one)
 
 
 def sync_to_all_hosts(handle: ClusterHandle, source: str,
@@ -190,9 +195,7 @@ def sync_to_all_hosts(handle: ClusterHandle, source: str,
                 raise exceptions.SkyTpuError(
                     f'workdir sync failed on host {i}: {out}')
 
-        with ThreadPoolExecutor(
-                max_workers=min(32, handle.num_hosts)) as pool:
-            list(pool.map(one_agent, range(handle.num_hosts)))
+        _fan_out_agents(handle, one_agent)
         return
     runners = _runners(handle)
 
@@ -219,9 +222,7 @@ def sync_file_to_all_hosts(handle: ClusterHandle, source: str,
         def one_agent(i: int) -> None:
             handle.agent_client(i).put_file(target, data, mode=mode)
 
-        with ThreadPoolExecutor(
-                max_workers=min(32, handle.num_hosts)) as pool:
-            list(pool.map(one_agent, range(handle.num_hosts)))
+        _fan_out_agents(handle, one_agent)
         return
     runners = _runners(handle)
 

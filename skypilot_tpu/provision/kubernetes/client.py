@@ -60,8 +60,14 @@ def _load_kubeconfig() -> Tuple[str, Dict[str, str], Optional[ssl.SSLContext]]:
         elif 'certificate-authority' in cluster:
             ssl_ctx = ssl.create_default_context(
                 cafile=cluster['certificate-authority'])
-        if 'client-certificate-data' in user:
-            # load_cert_chain needs files; write 0600 temps.
+        if 'client-certificate' in user:
+            # File-path variant (minikube, legacy GKE).
+            ssl_ctx.load_cert_chain(user['client-certificate'],
+                                    user.get('client-key'))
+        elif 'client-certificate-data' in user:
+            # load_cert_chain needs files; write 0600 temps and
+            # remove them immediately after the (eager) load — the
+            # key must not linger in /tmp.
             cert = tempfile.NamedTemporaryFile(delete=False)
             cert.write(base64.b64decode(user['client-certificate-data']))
             cert.close()
@@ -69,7 +75,11 @@ def _load_kubeconfig() -> Tuple[str, Dict[str, str], Optional[ssl.SSLContext]]:
             keyf.write(base64.b64decode(user['client-key-data']))
             keyf.close()
             os.chmod(keyf.name, 0o600)
-            ssl_ctx.load_cert_chain(cert.name, keyf.name)
+            try:
+                ssl_ctx.load_cert_chain(cert.name, keyf.name)
+            finally:
+                os.unlink(cert.name)
+                os.unlink(keyf.name)
     if 'token' in user:
         headers['Authorization'] = f'Bearer {user["token"]}'
     return server, headers, ssl_ctx
@@ -145,7 +155,10 @@ class KubeClient:
                     continue
                 raise classify_http_error(e) from e
             except (urllib.error.URLError, OSError) as e:
-                if attempt < _MAX_RETRIES:
+                # Network errors retry GETs only, same as 5xx: a
+                # timed-out POST may have landed server-side, and
+                # re-POSTing a pod create 409s confusingly.
+                if method == 'GET' and attempt < _MAX_RETRIES:
                     time.sleep(backoff)
                     backoff *= 2
                     continue

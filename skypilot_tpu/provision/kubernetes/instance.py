@@ -97,10 +97,12 @@ def _pod_manifest(config: ProvisionConfig, rank: int,
         'metadata': {
             'name': _pod_name(config.cluster_name_on_cloud, rank),
             'labels': {
+                # User labels first: the control labels below must
+                # win a collision or teardown/listing lose the pods.
+                **(nc.get('labels') or {}),
                 _CLUSTER_LABEL: config.cluster_name_on_cloud,
                 _RANK_LABEL: str(rank),
                 'skypilot-tpu/slice': str(slice_index),
-                **(nc.get('labels') or {}),
             },
         },
         'spec': {
@@ -138,8 +140,15 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
     total = num_hosts * max(1, config.count)
 
     existing = c.list_pods(f'{_CLUSTER_LABEL}={name}').get('items', [])
-    live = [p for p in existing
-            if p.get('metadata', {}).get('deletionTimestamp') is None]
+    live = [
+        p for p in existing
+        if p.get('metadata', {}).get('deletionTimestamp') is None
+        # A crashed/finished pod (restartPolicy Never) is NOT
+        # reusable — counting it as live would "resume" a dead
+        # cluster and then fail wait_instances.
+        and p.get('status', {}).get('phase') not in ('Failed',
+                                                     'Succeeded')
+    ]
     if len(live) == total:
         logger.info('Reusing %d existing pods for %s', total, name)
         return ProvisionRecord(provider='kubernetes',

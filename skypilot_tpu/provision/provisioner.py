@@ -106,7 +106,14 @@ class RetryingProvisioner:
             if to_provision.zone is not None:
                 out.append((region, to_provision.zone))
                 continue
-            for zone in cloud.zones_for(accel, region):
+            zones = cloud.zones_for(accel, region)
+            if not zones:
+                # Zone-less provider (kubernetes: a region IS the
+                # whole placement) — the region itself is the
+                # candidate, not nothing.
+                out.append((region, None))
+                continue
+            for zone in zones:
                 out.append((region, zone))
         return out
 

@@ -139,8 +139,10 @@ class Resources:
                 f'from {SPOT_RECOVERY_STRATEGIES}')
         if self._accelerator is not None:
             from skypilot_tpu import clouds
-            if not clouds.from_name(self._cloud or 'gcp').is_local:
-                # Local-style providers accept any region string.
+            if (self._cloud or 'gcp') == 'gcp':
+                # The catalog's regions/zones are GCP's; other
+                # providers (local, kubernetes, plugins) use their
+                # own region strings ('kubernetes', a context name).
                 catalog.validate_region_zone(self._accelerator,
                                              self._region, self._zone)
             spec = self.tpu_spec

@@ -329,6 +329,34 @@ class TestKubernetesEndToEnd:
         assert all(p.poll() is not None
                    for p in fake_api.procs.values())
 
+    def test_tpu_slice_launch(self, fake_api):
+        """TPU-accelerator launch on kubernetes: optimizer candidate,
+        zone-less placement, TPU-labeled pods, gang run (the pure-CPU
+        e2e misses the accelerator-specific paths, which once had two
+        independent launch-blocking bugs)."""
+        from skypilot_tpu import state
+        from skypilot_tpu.runtime import job_lib
+        task = Task(name='k8stpu',
+                    run='echo tpu-rank=$SKYTPU_NODE_RANK')
+        res = Resources(cloud='kubernetes', accelerators='tpu-v5e-8')
+        task.set_resources(res)
+        job_id, handle = execution.launch(task, 'k8stpu',
+                                          quiet_optimizer=True,
+                                          detach_run=True)
+        try:
+            assert handle.region == 'kubernetes'
+            final = core.wait_for_job('k8stpu', job_id, timeout=120)
+            assert final == job_lib.JobStatus.SUCCEEDED
+            pod = next(iter(fake_api.pods.values()))
+            sel = pod['spec']['nodeSelector']
+            assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+                'tpu-v5-lite-podslice'
+            limits = pod['spec']['containers'][0]['resources'][
+                'limits']
+            assert 'google.com/tpu' in limits
+        finally:
+            core.down('k8stpu', purge=True)
+
     def test_stockout_failover_raises_cleanly(self, fake_api):
         fake_api.fail_create = 'stockout'
         task = _k8s_task('echo hi', num_hosts=1)
@@ -337,6 +365,54 @@ class TestKubernetesEndToEnd:
                              detach_run=True)
         # No pods or secrets leaked behind the failed attempt.
         assert fake_api.pods == {}
+
+    def test_managed_job_recovers_from_pod_kill(self, fake_api,
+                                                tmp_path,
+                                                monkeypatch):
+        """Spot-preemption analog on kubernetes: delete the task
+        pods mid-run; the managed-jobs controller must detect the
+        dead cluster, relaunch fresh pods, and the job must still
+        SUCCEED — the full recovery loop on the new provider."""
+        import threading
+        import time
+        import yaml
+        from skypilot_tpu import provision, state
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.jobs.controller import JobsController
+        from skypilot_tpu.jobs import controller as controller_mod
+        monkeypatch.setattr(controller_mod,
+                            'JOB_STATUS_CHECK_GAP_SECONDS', 1.0)
+
+        task = _k8s_task('sleep 6 && echo k8s-survived',
+                         num_hosts=1, name='k8smj')
+        dag_yaml = tmp_path / 'dag.yaml'
+        dag_yaml.write_text(yaml.safe_dump_all(
+            [task.to_yaml_config()]))
+        job_id = jobs_state.add_job('k8smj', str(dag_yaml), 'k8s')
+        ctrl = JobsController(job_id, str(dag_yaml))
+        cluster_name = f'k8smj-{job_id}-0'
+
+        def preempt():
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                rec = jobs_state.get_job(job_id)
+                if rec is not None and rec['status'] == \
+                        jobs_state.ManagedJobStatus.RUNNING:
+                    crec = state.get_cluster_from_name(cluster_name)
+                    if crec is not None:
+                        handle = crec['handle']
+                        provision.terminate_instances(
+                            'kubernetes', handle.region,
+                            handle.cluster_name_on_cloud)
+                        return
+                time.sleep(0.5)
+
+        killer = threading.Thread(target=preempt, daemon=True)
+        killer.start()
+        final = ctrl.run()
+        killer.join(timeout=5)
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert jobs_state.get_job(job_id)['recovery_count'] >= 1
 
     def test_stop_unsupported(self, fake_api):
         task = _k8s_task('sleep 1', num_hosts=1)
