@@ -51,15 +51,21 @@ def validate_pipeline_config(config: llama.LlamaConfig, mesh: Mesh,
     # shard over 'pp' and scan per-stage; MoE's aux loss accumulates
     # through the pipeline (bubble steps masked). pp x ep composes:
     # the expert all-to-alls stay GSPMD-auto inside each stage.
+    # pp x sp composes by making the pipeline shard_map manual over
+    # BOTH axes and running ring attention directly (Shardy rejects
+    # nested manual computations, so an inner sp shard_map is not an
+    # option).
     del lora_rank
-    if mesh.shape.get('sp', 1) > 1:
+    if mesh.shape.get('sp', 1) > 1 and config.n_experts:
         raise NotImplementedError(
-            'sequence parallelism inside a pipeline stage is not '
-            'supported yet')
+            'MoE + sequence parallelism inside a pipeline is not '
+            'supported: the manual-sp stage would route on local '
+            'sequence shards, changing capacity semantics')
 
 
 def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
-                     mesh: Mesh, num_micro: int, remat=None):
+                     mesh: Mesh, num_micro: int, remat=None,
+                     seq_axis: Optional[str] = None):
     """Run ``x`` [B, T, D] through the pp-sharded layer stack.
 
     ``layer_fn(x_mb, layer_params) -> (y_mb, aux)`` applies ONE layer
@@ -67,6 +73,11 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
     ``stacked_params`` leaves are [L, ...] with L sharded over 'pp'.
     B must be divisible by num_micro. ``remat``: a checkpoint policy
     to remat each layer with (None = no remat).
+
+    ``seq_axis``: also run MANUAL over this mesh axis with the
+    activations' T dim sharded across it (sequence parallelism inside
+    the pipeline — layer_fn sees local T shards and must do ring
+    attention over the axis itself).
 
     Returns (y [B, T, D], aux_sum) where aux_sum totals every
     (layer, microbatch) contribution — divide by
@@ -78,6 +89,9 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
     if b % num_micro != 0:
         raise ValueError(
             f'batch {b} not divisible by num_micro={num_micro}')
+    manual_axes = {'pp'} | ({seq_axis} if seq_axis else set())
+    vma_axes = tuple(sorted(manual_axes))
+    x_spec = P(None, seq_axis, None) if seq_axis else P()
 
     one_layer = layer_fn
     if remat is not None:
@@ -90,7 +104,7 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
             y, aux = one_layer(x_c, lp)
             return (y, aux_c + aux), None
 
-        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ('pp',),
+        aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32), vma_axes,
                              to='varying')
         (y, aux), _ = jax.lax.scan(scan_body, (x_mb, aux0),
                                    params_local)
@@ -102,15 +116,16 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
         idx = jax.lax.axis_index('pp')
         mb = b // num_micro
         micro = x_full.reshape(num_micro, mb, *x_full.shape[1:])
-        # pcast: the carries start as pp-invariant zeros but become
-        # pp-varying inside the scan (ppermute/axis_index), so their
-        # varying-axes type must be declared up front.
+        # pcast: the carries start as invariant zeros but become
+        # varying over the manual axes inside the scan
+        # (ppermute/axis_index), so their varying-axes type must be
+        # declared up front.
         buf = jax.lax.pcast(jnp.zeros(micro.shape[1:], x_full.dtype),
-                            ('pp',), to='varying')
+                            vma_axes, to='varying')
         outs = jax.lax.pcast(jnp.zeros(micro.shape, x_full.dtype),
-                             ('pp',), to='varying')
+                             vma_axes, to='varying')
         aux0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
-                             ('pp',), to='varying')
+                             vma_axes, to='varying')
 
         def step(carry, s):
             buf, outs, aux_acc = carry
@@ -144,17 +159,19 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
             step, (buf, outs, aux0), jnp.arange(num_micro + pp - 1))
         # Only the last stage holds real outputs; zero-and-psum
         # replicates them to every stage. The aux psum totals each
-        # stage's (already masked) contributions.
+        # stage's (already masked) contributions (summing over ALL
+        # manual axes so the scalar comes out invariant; under sp the
+        # only aux producer, MoE, is rejected, so aux is 0 there).
         outs = jnp.where(idx == pp - 1, outs, 0)
         outs = jax.lax.psum(outs, 'pp')
-        aux_total = jax.lax.psum(aux_acc, 'pp')
+        aux_total = jax.lax.psum(aux_acc, vma_axes)
         return outs.reshape(x_full.shape), aux_total
 
     fn = jax.shard_map(
-        body, mesh=mesh, axis_names={'pp'},
-        in_specs=(P(), jax.tree.map(lambda _: P('pp'),
-                                    stacked_params)),
-        out_specs=(P(), P()))
+        body, mesh=mesh, axis_names=manual_axes,
+        in_specs=(x_spec, jax.tree.map(lambda _: P('pp'),
+                                       stacked_params)),
+        out_specs=(x_spec, P()))
     return fn(x, stacked_params)
 
 
@@ -177,7 +194,20 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
     if num_micro < 1:
         raise ValueError(f'num_micro={num_micro} must be >= 1')
 
+    use_sp = mesh.shape.get('sp', 1) > 1
     attn_impl = llama.default_attn_impl()
+    if use_sp:
+        from skypilot_tpu.ops import attention as attention_ops
+        from skypilot_tpu.ops import ring_attention as ring
+
+        def attn_impl(q, k, v, angles):  # noqa: F811
+            # Inside the manual-(pp, sp) shard_map: q/k/v hold local
+            # sequence shards; ring attention supplies the cross-
+            # shard communication directly (no nested shard_map —
+            # Shardy rejects re-binding manual axes).
+            q = attention_ops.apply_rope(q, angles)
+            k = attention_ops.apply_rope(k, angles)
+            return ring.ring_attention(q, k, v, axis_name='sp')
     remat = llama.layer_remat_policy(config) if config.remat else None
 
     def loss(params: Params, *rest) -> jax.Array:
@@ -203,12 +233,24 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
         # NamedSharding would clash with the manual 'pp' axis type);
         # without them GSPMD falls back to replicate-and-repartition.
         pin_mode = llama.AMBIENT_MESH if config.n_experts else None
+
+        def local_angles(t_local):
+            # Under manual sp the stage sees a T/sp sequence shard;
+            # its RoPE angles are the matching rows of the full table
+            # (closure-captured, replicated).
+            if not use_sp:
+                return angles
+            start = jax.lax.axis_index('sp') * t_local
+            return jax.lax.dynamic_slice_in_dim(angles, start,
+                                                t_local, 0)
+
         if lora_params is None:
             stacked = cparams['layers']
 
             def layer_fn(x_mb, layer_params):
                 return llama._layer(config, x_mb, layer_params,
-                                    angles, attn_impl, mesh=pin_mode)
+                                    local_angles(x_mb.shape[1]),
+                                    attn_impl, mesh=pin_mode)
         else:
             clora = jax.tree.map(lambda p: p.astype(config.dtype),
                                  lora_params)
@@ -217,13 +259,15 @@ def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
             def layer_fn(x_mb, scanned):
                 layer_params, layer_lora = scanned
                 return llama._layer(config, x_mb, layer_params,
-                                    angles, attn_impl,
+                                    local_angles(x_mb.shape[1]),
+                                    attn_impl,
                                     lora_params=layer_lora,
                                     lora_scale=lora_scale,
                                     mesh=pin_mode)
 
-        hidden, aux_sum = pipelined_layers(layer_fn, x, stacked, mesh,
-                                           num_micro, remat=remat)
+        hidden, aux_sum = pipelined_layers(
+            layer_fn, x, stacked, mesh, num_micro, remat=remat,
+            seq_axis='sp' if use_sp else None)
         hidden = llama._rms_norm(hidden, cparams['final_norm'],
                                  config.norm_eps, config.norm_offset)
 
