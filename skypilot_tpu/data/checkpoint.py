@@ -78,6 +78,38 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(state))
         return restored, step + 1
 
+    def restore_latest_raw(self, keys=None) -> Optional[Any]:
+        """Restore the latest checkpoint WITHOUT a template — raw
+        (host) arrays in the saved tree structure. ``keys`` selects
+        top-level subtrees (e.g. ``('params', 'lora')``) via orbax
+        partial restore, so serving does NOT download/materialize the
+        optimizer moments — for an 8B fp32 TrainState that is ~64 GB
+        of Adam state skipped."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        logger.info('Restoring checkpoint step %d from %s', step,
+                    self.path)
+        if keys is None:
+            return self._manager.restore(step)
+        import orbax.checkpoint as ocp
+        # A read-only manager with an explicit PyTree handler: the
+        # main manager's registry is tied to StandardSave and cannot
+        # serve item_metadata before a save/restore happens in this
+        # process.
+        mgr = ocp.CheckpointManager(
+            self.path, item_handlers=ocp.PyTreeCheckpointHandler())
+        try:
+            meta = mgr.item_metadata(step)
+            tree = meta.tree if hasattr(meta, 'tree') else meta
+            item = {k: tree[k] for k in keys
+                    if k in tree and tree[k] is not None}
+            return mgr.restore(
+                step, args=ocp.args.PyTreeRestore(
+                    item=item, partial_restore=True))
+        finally:
+            mgr.close()
+
     def wait(self) -> None:
         self._manager.wait_until_finished()
 

@@ -119,6 +119,33 @@ def init_quantized(config: llama.LlamaConfig, key: jax.Array,
     return out
 
 
+def quantize_params_streamed(params: Params,
+                             config: llama.LlamaConfig) -> Params:
+    """``quantize_params`` for HOST-resident trees (checkpoint
+    restores): transfers and quantizes ONE leaf at a time so the
+    bf16 tree never fully materializes on device (8B bf16 alone
+    exceeds a v5e chip's HBM)."""
+    if config.n_experts:
+        raise NotImplementedError(
+            'int8 quantization of MoE expert weights is not '
+            'supported yet')
+    quantize = jax.jit(quantize_weight)
+    cast = jax.jit(lambda x: x.astype(config.dtype))
+
+    out = dict(params)
+    out['layers'] = dict(params['layers'])
+    for name, leaf in params['layers'].items():
+        if name in _LAYER_MATMULS:
+            out['layers'][name] = quantize(leaf)
+        else:
+            out['layers'][name] = cast(jnp.asarray(leaf))
+    for name in ('embed', 'final_norm'):
+        out[name] = cast(jnp.asarray(params[name]))
+    if 'lm_head' in params:
+        out['lm_head'] = quantize(params['lm_head'])
+    return out
+
+
 def is_quantized(params: Params) -> bool:
     wq = params.get('layers', {}).get('wq')
     return isinstance(wq, dict) and 'q' in wq

@@ -55,6 +55,26 @@ def lora_sharding_rules(config: llama.LlamaConfig,
     }
 
 
+def merge_lora_host(params: llama.Params, lora: Dict[str, Any],
+                    scale: float = 2.0) -> llama.Params:
+    """``merge_lora`` on HOST (numpy) arrays, leaf-by-leaf — for
+    checkpoint-restored trees headed to sharded/quantized serving,
+    where putting the full unsharded tree on one device first would
+    OOM for exactly the models those paths exist for."""
+    import numpy as np
+    merged = dict(params)
+    layers = dict(params['layers'])
+    for w, a, b in (('wq', 'wq_a', 'wq_b'), ('wv', 'wv_a', 'wv_b')):
+        base = np.asarray(layers[w])
+        delta = scale * np.einsum(
+            'ldr,lro->ldo', np.asarray(lora[a], np.float32),
+            np.asarray(lora[b], np.float32))
+        layers[w] = (base.astype(np.float32) +
+                     delta).astype(base.dtype)
+    merged['layers'] = layers
+    return merged
+
+
 def merge_lora(params: llama.Params, lora: Dict[str, Any],
                scale: float = 2.0) -> llama.Params:
     """Fold adapters into the base weights (for export/serving)."""

@@ -30,6 +30,13 @@ def main():
                         default='none',
                         help='weight-only quantization (halves '
                              'decode weight bandwidth)')
+    parser.add_argument('--checkpoint-dir', default=None,
+                        help='restore the latest finetune checkpoint '
+                             'from this dir (a TrainState as saved by '
+                             'recipes/finetune; LoRA adapters are '
+                             'merged into the base). Point at the '
+                             'task-id subdir, e.g. a mounted bucket '
+                             'path.')
     args = parser.parse_args()
     if args.quant == 'int8' and args.tp > 1:
         # Reject before the (expensive) sharded init, not after.
@@ -41,6 +48,29 @@ def main():
     from skypilot_tpu.models import decode, llama
 
     config = llama.get_config(args.model)
+    ckpt_params = None
+    if args.checkpoint_dir:
+        from skypilot_tpu.data.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(args.checkpoint_dir,
+                                 use_task_namespace=False)
+        raw = ckpt.restore_latest_raw(keys=('params', 'lora'))
+        if raw is None:
+            raise SystemExit(
+                f'no checkpoint found under {args.checkpoint_dir}')
+        ckpt_params = raw['params']
+        if raw.get('lora') is not None:
+            # Serve merged weights — no adapter math in the hot
+            # loop. Merged ON HOST: the tp/int8 paths below exist
+            # precisely because the full tree must not land on one
+            # device.
+            from skypilot_tpu.parallel import lora as lora_lib
+            ckpt_params = lora_lib.merge_lora_host(ckpt_params,
+                                                   raw['lora'])
+        # Serve at the compute dtype: a training checkpoint is
+        # usually fp32 masters — serving those doubles weight HBM.
+        import numpy as np
+        ckpt_params = jax.tree.map(
+            lambda x: np.asarray(x).astype(config.dtype), ckpt_params)
     cache_sh = None
     if args.tp > 1:
         from skypilot_tpu.parallel import auto_mesh_config, make_mesh
@@ -48,17 +78,29 @@ def main():
         # Single-request replica: cache batch stays replicated.
         param_sh, cache_sh = decode.decode_shardings(
             config, mesh, shard_batch=False)
-        # Init DIRECTLY sharded (out_shardings on the jitted init) —
-        # materializing the full pytree on one device first would OOM
-        # for exactly the models --tp exists for.
-        params = jax.jit(
-            lambda: llama.init_params(config, jax.random.PRNGKey(0)),
-            out_shardings=param_sh)()
+        if ckpt_params is not None:
+            # Host->device transfer lands directly sharded.
+            params = jax.device_put(ckpt_params, param_sh)
+        else:
+            # Init DIRECTLY sharded (out_shardings on the jitted
+            # init) — materializing the full pytree on one device
+            # first would OOM for exactly the models --tp exists for.
+            params = jax.jit(
+                lambda: llama.init_params(config,
+                                          jax.random.PRNGKey(0)),
+                out_shardings=param_sh)()
     elif args.quant == 'int8':
-        # Leaf-streamed init+quantize — the bf16 tree never fully
-        # materializes (8B bf16 alone would exceed a v5e's HBM).
         from skypilot_tpu.models import quant
-        params = quant.init_quantized(config, jax.random.PRNGKey(0))
+        if ckpt_params is not None:
+            # Leaf-streamed: each (host) leaf transfers + quantizes
+            # alone, so the bf16 tree never fully sits in HBM.
+            params = quant.quantize_params_streamed(ckpt_params,
+                                                    config)
+        else:
+            params = quant.init_quantized(config,
+                                          jax.random.PRNGKey(0))
+    elif ckpt_params is not None:
+        params = jax.tree.map(jnp.asarray, ckpt_params)
     else:
         params = llama.init_params(config, jax.random.PRNGKey(0))
 

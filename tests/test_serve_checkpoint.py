@@ -1,0 +1,85 @@
+"""Train -> checkpoint -> serve handoff: the serve replica restores
+the latest finetune TrainState (raw, no optimizer template) and
+serves the LoRA-merged weights (models/decode + data/checkpoint +
+parallel/lora glue; reference has no analog — serving is delegated
+to external engines there).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.data.checkpoint import CheckpointManager
+from skypilot_tpu.models import decode, llama, quant
+from skypilot_tpu.parallel import (MeshConfig, build_train_step,
+                                   init_train_state, lora as lora_lib,
+                                   make_mesh)
+
+
+def _train_and_save(tmp_path, steps=2):
+    config = llama.get_config('tiny')
+    mesh = make_mesh(MeshConfig(fsdp=8))
+    state, shardings = init_train_state(config, mesh,
+                                        jax.random.PRNGKey(0),
+                                        lora_rank=4)
+    step = build_train_step(config, mesh, shardings)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
+                              config.vocab_size, dtype=jnp.int32)
+    for _ in range(steps):
+        state, _ = step(state, {'tokens': toks})
+    ckpt = CheckpointManager(str(tmp_path / 'ck'),
+                             save_interval_steps=1,
+                             use_task_namespace=False)
+    assert ckpt.maybe_save(int(state.step), state)
+    ckpt.wait()
+    ckpt.close()
+    return config, state
+
+
+class TestServeCheckpointHandoff:
+
+    def test_raw_restore_and_lora_merge(self, tmp_path):
+        config, state = _train_and_save(tmp_path)
+        ckpt = CheckpointManager(str(tmp_path / 'ck'),
+                                 use_task_namespace=False)
+        raw = ckpt.restore_latest_raw(keys=('params', 'lora'))
+        ckpt.close()
+        assert raw is not None and 'params' in raw and 'lora' in raw
+        # Partial restore: the Adam moments (2/3 of the checkpoint
+        # bytes at 8B scale) must NOT be downloaded for serving.
+        assert 'opt_state' not in raw
+
+        # Host-side merge (numpy): the sharded/quantized serve paths
+        # must never put the full unsharded tree on one device.
+        merged = lora_lib.merge_lora_host(raw['params'], raw['lora'])
+        merged = jax.tree.map(jnp.asarray, merged)
+        want = lora_lib.merge_lora(state.params, state.lora)
+        np.testing.assert_allclose(
+            np.asarray(merged['layers']['wq'], np.float32),
+            np.asarray(want['layers']['wq'], np.float32), rtol=1e-6)
+
+        # The restored+merged weights decode (the serve path).
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out = decode.greedy_generate(merged, prompt, config,
+                                     max_new_tokens=3, max_seq=8)
+        want_out = decode.greedy_generate(want, prompt, config,
+                                          max_new_tokens=3, max_seq=8)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.asarray(want_out))
+
+    def test_streamed_quantize_from_host_checkpoint(self, tmp_path):
+        config, state = _train_and_save(tmp_path)
+        ckpt = CheckpointManager(str(tmp_path / 'ck'),
+                                 use_task_namespace=False)
+        raw = ckpt.restore_latest_raw()
+        ckpt.close()
+        qp = quant.quantize_params_streamed(raw['params'], config)
+        assert quant.is_quantized(qp)
+        # Same structure as the on-device quantizer.
+        ref = quant.quantize_params(
+            jax.tree.map(jnp.asarray, raw['params']), config)
+        assert (jax.tree_util.tree_structure(qp) ==
+                jax.tree_util.tree_structure(ref))
+        prompt = jnp.asarray([[4, 5]], jnp.int32)
+        out = decode.greedy_generate(qp, prompt, config,
+                                     max_new_tokens=2, max_seq=8)
+        assert out.shape == (1, 2)
