@@ -30,6 +30,11 @@ def main():
                         default='none',
                         help='weight-only quantization (halves '
                              'decode weight bandwidth)')
+    parser.add_argument('--slots', type=int, default=0,
+                        help='enable continuous batching with this '
+                             'many concurrent decode slots (greedy '
+                             'requests share one batch; sampling '
+                             'requests fall back to the serial path)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -41,6 +46,10 @@ def main():
     if args.quant == 'int8' and args.tp > 1:
         # Reject before the (expensive) sharded init, not after.
         parser.error('--quant int8 with --tp > 1 is not supported yet')
+    if args.slots > 0 and args.tp > 1:
+        parser.error('--slots (continuous batching) with --tp > 1 is '
+                     'not supported yet: the engine cache is '
+                     'unsharded and would replicate per device')
 
     import jax
     import jax.numpy as jnp
@@ -105,9 +114,25 @@ def main():
         params = llama.init_params(config, jax.random.PRNGKey(0))
 
     lock = threading.Lock()
+    engine = None
+    if args.slots > 0:
+        from skypilot_tpu.serve.batching import BatchingEngine
+        engine = BatchingEngine(params, config, slots=args.slots)
 
     def generate(prompt_ids, max_new, temperature=None, top_p=None,
                  seed=None):
+        if (engine is not None and temperature is None
+                and top_p is None):
+            # Continuous batching: no lock — concurrent greedy
+            # requests share the decode batch (the engine clamps
+            # max_new itself).
+            return engine.generate(prompt_ids, max_new)
+        return _generate_serial(prompt_ids, max_new,
+                                temperature=temperature, top_p=top_p,
+                                seed=seed)
+
+    def _generate_serial(prompt_ids, max_new, temperature=None,
+                         top_p=None, seed=None):
         # KV-cache decode: prefill once, then ONE device-side scan for
         # the whole generation (decode.decode_tokens_scan). The scan
         # length is a static compile parameter, so requested lengths
@@ -193,8 +218,10 @@ def main():
 
     # Warm every decode variant's compile before declaring readiness
     # (greedy, sampled, sampled+nucleus) — the first request would
-    # otherwise pay it while holding the serve lock.
-    generate([1, 2, 3], 1)
+    # otherwise pay it while holding the serve lock. max_new=2 so the
+    # batching engine's decode step compiles too (a 1-token request
+    # retires at admission without ever dispatching it).
+    generate([1, 2, 3], 2)
     generate([1, 2, 3], 2, temperature=1.0, seed=0)
     generate([1, 2, 3], 2, temperature=1.0, top_p=0.9, seed=0)
     server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
