@@ -120,19 +120,20 @@ def main():
         engine = BatchingEngine(params, config, slots=args.slots)
 
     def generate(prompt_ids, max_new, temperature=None, top_p=None,
-                 seed=None):
+                 seed=None, eos_id=None):
         if (engine is not None and temperature is None
                 and top_p is None):
             # Continuous batching: no lock — concurrent greedy
             # requests share the decode batch (the engine clamps
-            # max_new itself).
-            return engine.generate(prompt_ids, max_new)
+            # max_new itself and retires rows at eos_id).
+            return engine.generate(prompt_ids, max_new,
+                                   eos_id=eos_id)
         return _generate_serial(prompt_ids, max_new,
                                 temperature=temperature, top_p=top_p,
-                                seed=seed)
+                                seed=seed, eos_id=eos_id)
 
     def _generate_serial(prompt_ids, max_new, temperature=None,
-                         top_p=None, seed=None):
+                         top_p=None, seed=None, eos_id=None):
         # KV-cache decode: prefill once, then ONE device-side scan for
         # the whole generation (decode.decode_tokens_scan). The scan
         # length is a static compile parameter, so requested lengths
@@ -164,10 +165,18 @@ def main():
                                  else temperature),
                     top_p=top_p, cache_sharding=cache_sh)
             else:
+                # Deliberately NOT passing eos_id down: it would
+                # switch greedy_generate to its per-token loop (one
+                # host round-trip per token, lock held); the scan
+                # decodes the full bucket and the host-side
+                # truncation below yields identical output.
                 out = decode.greedy_generate(params, tokens, config,
                                              max_new_tokens=bucket,
                                              cache_sharding=cache_sh)
-        return [int(t) for t in out[0][:max_new]]
+        out = [int(t) for t in out[0][:max_new]]
+        if eos_id is not None and eos_id in out:
+            out = out[:out.index(eos_id) + 1]
+        return out
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = 'HTTP/1.1'
@@ -209,11 +218,14 @@ def main():
                 seed = body.get('seed')
                 if seed is not None:
                     seed = int(seed)
+                eos_id = body.get('eos_id')
+                if eos_id is not None:
+                    eos_id = int(eos_id)
             except (ValueError, KeyError, TypeError) as e:
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
             out = generate(prompt_ids, max_new, temperature=temperature,
-                           top_p=top_p, seed=seed)
+                           top_p=top_p, seed=seed, eos_id=eos_id)
             self._json({'output_ids': out})
 
     # Warm every decode variant's compile before declaring readiness

@@ -179,9 +179,11 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
 
 
 class _Request:
-    def __init__(self, prompt_ids: List[int], max_new: int):
+    def __init__(self, prompt_ids: List[int], max_new: int,
+                 eos_id: Optional[int] = None):
         self.prompt_ids = prompt_ids
         self.max_new = max_new
+        self.eos_id = eos_id
         self.out: 'queue.Queue' = queue.Queue()
 
 
@@ -238,11 +240,15 @@ class BatchingEngine:
 
     # -- client API -----------------------------------------------------
 
-    def submit(self, prompt_ids: List[int],
-               max_new: int) -> 'queue.Queue':
+    def submit(self, prompt_ids: List[int], max_new: int,
+               eos_id: Optional[int] = None) -> 'queue.Queue':
+        """Returns a Queue yielding generated ids then None. With
+        ``eos_id``, the row retires the moment it emits that id
+        (the EOS itself is emitted, matching greedy_generate)."""
         max_new = min(max_new,
                       self.max_seq - len(prompt_ids) - 1)
-        req = _Request(list(prompt_ids), max(0, max_new))
+        req = _Request(list(prompt_ids), max(0, max_new),
+                       eos_id=eos_id)
         if req.max_new == 0 or self._stop:
             req.out.put(None)
             return req.out
@@ -250,10 +256,10 @@ class BatchingEngine:
         self.wake.set()
         return req.out
 
-    def generate(self, prompt_ids: List[int],
-                 max_new: int) -> List[int]:
+    def generate(self, prompt_ids: List[int], max_new: int,
+                 eos_id: Optional[int] = None) -> List[int]:
         """Blocking convenience: collect the full generation."""
-        q = self.submit(prompt_ids, max_new)
+        q = self.submit(prompt_ids, max_new, eos_id=eos_id)
         out: List[int] = []
         while True:
             tok = q.get()
@@ -296,7 +302,7 @@ class BatchingEngine:
         self.slot_req[row] = req
         self.slot_left[row] = req.max_new - 1
         req.out.put(first)
-        if self.slot_left[row] <= 0:
+        if self.slot_left[row] <= 0 or first == req.eos_id:
             req.out.put(None)
             self.slot_req[row] = None
 
@@ -356,9 +362,17 @@ class BatchingEngine:
             for i in active_rows:
                 req = self.slot_req[i]
                 emit = min(self.slot_left[i], n)
+                done = False
                 for t in host_toks[i][:emit]:
                     req.out.put(int(t))
-                self.slot_left[i] -= emit
-                if self.slot_left[i] <= 0:
+                    self.slot_left[i] -= 1
+                    if int(t) == req.eos_id:
+                        # EOS retires the row NOW; anything the
+                        # device computed past it in this dispatch is
+                        # discarded (the slot is fully rewritten at
+                        # reuse).
+                        done = True
+                        break
+                if done or self.slot_left[i] <= 0:
                     req.out.put(None)
                     self.slot_req[i] = None

@@ -93,6 +93,23 @@ class TestBatchingEngine:
         finally:
             engine.close()
 
+    def test_eos_early_retirement(self, setup):
+        config, params = setup
+        base = _reference(params, config, [1, 2, 3], 8)
+        eos = base[3]
+        k = base.index(eos) + 1  # through the FIRST occurrence
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=3)
+        try:
+            out = engine.generate([1, 2, 3], 8, eos_id=eos)
+            assert out == base[:k]
+            # The retired slot is immediately reusable and clean.
+            out2 = engine.generate([5, 6], 4)
+            assert out2 == _reference(params, config, [5, 6], 4)
+        finally:
+            engine.close()
+
     def test_quantized_params(self, setup):
         config, params = setup
         qp = quant.quantize_params(params, config)
