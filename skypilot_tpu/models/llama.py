@@ -433,17 +433,22 @@ def _moe_mlp(config: LlamaConfig, h: jax.Array, layer_params: Params,
     # backward need not rebuild the [B, T*k, E, C] cumsum tensors.
     disp = checkpoint_name(disp, 'moe_dispatch')
     comb = checkpoint_name(comb, 'moe_dispatch')
+    # expert_einsum: plain einsum for bf16 weights, int8-aware
+    # (per-expert-channel scales applied post-contraction) for
+    # weight-only-quantized serving.
+    from skypilot_tpu.models.quant import expert_einsum
+
     xin = jnp.einsum('btec,btd->ebcd', disp, h)      # a2a: tok→exp
     xin = pin(xin, P('ep', ('dp', 'fsdp'), None, None))
     g = checkpoint_name(
-        jnp.einsum('ebcd,edf->ebcf', xin, layer_params['w_gate']),
+        expert_einsum('ebcd,edf->ebcf', xin, layer_params['w_gate']),
         'mlp_gate')
     up = checkpoint_name(
-        jnp.einsum('ebcd,edf->ebcf', xin, layer_params['w_up']),
+        expert_einsum('ebcd,edf->ebcf', xin, layer_params['w_up']),
         'mlp_up')
     act = mlp_act(config)(g.astype(jnp.float32)).astype(h.dtype)
-    xout = jnp.einsum('ebcf,efd->ebcd', act * up,
-                      layer_params['w_down'])
+    xout = expert_einsum('ebcf,efd->ebcd', act * up,
+                         layer_params['w_down'])
     xout = pin(xout, P('ep', ('dp', 'fsdp'), None, None))
     out = jnp.einsum('ebcd,btec->btd', xout, comb)   # a2a: exp→tok
     out = pin(out, out_spec if out_spec is not None

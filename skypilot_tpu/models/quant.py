@@ -7,10 +7,12 @@ convert is fused by XLA into the dot-general's operand read (the
 weights cross HBM as int8), and the per-channel scale applies AFTER
 the matmul, which is exact for per-output-channel scaling.
 
-Scope (v1): the stacked layer projections (wq/wk/wv/wo, gate/up/down)
-and the LM head. Embedding stays bf16 (decode gathers one row per
-token — negligible traffic); norms/biases stay bf16 (tiny); MoE
-expert weights and the KV cache are not quantized yet.
+Scope: the stacked layer projections (wq/wk/wv/wo, gate/up/down —
+including MoE expert stacks, per (layer, expert, out-channel)) and
+the LM head. Embedding stays bf16 (decode gathers one row per token —
+negligible traffic); norms/biases/MoE router stay bf16 (tiny; the
+router also drives top-k selection — selective precision); the KV
+cache is not quantized yet.
 
 The reference has no quantization anywhere (serving is delegated to
 external engines, ``llm/vllm/service.yaml``); this is TPU-native new
@@ -51,19 +53,35 @@ def matmul(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
+def expert_einsum(subscript: str, x: jax.Array, w) -> jax.Array:
+    """``jnp.einsum(subscript, x, w)`` for plain or quantized expert
+    weights. Quantized w is [E, in, out] int8 with per-(expert,
+    out-channel) scales [E, 1, out]; the scale applies after the
+    contraction (exact for per-output-channel scaling). Used by the
+    MoE dispatch path (llama._moe_mlp)."""
+    if isinstance(w, dict) and 'q' in w:
+        out = jnp.einsum(subscript, x, w['q'].astype(x.dtype))
+        # [E, 1, out] -> broadcast over the token/capacity dims of
+        # the [E, ..., out] result.
+        s = w['s'].astype(out.dtype)
+        return out * s.reshape(s.shape[0],
+                               *([1] * (out.ndim - 2)), s.shape[-1])
+    return jnp.einsum(subscript, x, w)
+
+
 def quantize_params(params: Params, config: llama.LlamaConfig
                     ) -> Params:
     """Return a params pytree with the big matmul weights replaced by
     {'q': int8, 's': bf16} pairs (shape-compatible with the decode
-    path via ``matmul``)."""
-    if config.n_experts:
-        raise NotImplementedError(
-            'int8 quantization of MoE expert weights is not '
-            'supported yet')
+    path via ``matmul``/``expert_einsum``). MoE expert weights
+    [L, E, in, out] quantize per (layer, expert, out-channel) — the
+    router stays full precision (selective precision, it is tiny and
+    drives top-k selection)."""
     out = dict(params)
     layers = dict(params['layers'])
     for name in _LAYER_MATMULS:
-        layers[name] = quantize_weight(layers[name])
+        if name in layers:
+            layers[name] = quantize_weight(layers[name])
     out['layers'] = layers
     if 'lm_head' in params:
         out['lm_head'] = quantize_weight(params['lm_head'])
@@ -81,10 +99,6 @@ def init_quantized(config: llama.LlamaConfig, key: jax.Array,
     init, biases zero, dense ~N(0, 1/dim)) — real serving loads a
     checkpoint leaf-by-leaf through ``quantize_weight`` the same way.
     """
-    if config.n_experts:
-        raise NotImplementedError(
-            'int8 quantization of MoE expert weights is not '
-            'supported yet')
     shapes = jax.eval_shape(
         lambda: llama.init_params(config, key, dtype=dtype))
     quantize = jax.jit(quantize_weight)
@@ -125,10 +139,6 @@ def quantize_params_streamed(params: Params,
     restores): transfers and quantizes ONE leaf at a time so the
     bf16 tree never fully materializes on device (8B bf16 alone
     exceeds a v5e chip's HBM)."""
-    if config.n_experts:
-        raise NotImplementedError(
-            'int8 quantization of MoE expert weights is not '
-            'supported yet')
     quantize = jax.jit(quantize_weight)
     cast = jax.jit(lambda x: x.astype(config.dtype))
 

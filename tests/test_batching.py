@@ -107,6 +107,12 @@ class TestBatchingEngine:
             # The retired slot is immediately reusable and clean.
             out2 = engine.generate([5, 6], 4)
             assert out2 == _reference(params, config, [5, 6], 4)
+            # EOS as the VERY FIRST token retires at admission (a
+            # distinct code path) without leaking the slot.
+            out3 = engine.generate([1, 2, 3], 8, eos_id=base[0])
+            assert out3 == [base[0]]
+            out4 = engine.generate([5, 6], 4)
+            assert out4 == _reference(params, config, [5, 6], 4)
         finally:
             engine.close()
 
