@@ -69,6 +69,19 @@ class TpuBackend(Backend):
                 # Never under dryrun: the handshake does live agent
                 # calls and may restart the cluster runtime.
                 self._ensure_runtime_version(handle)
+                # A reused cluster may be asked for ports the original
+                # launch did not open (serve: one LB port per service
+                # on the shared controller cluster) — open the union.
+                ports = sorted({p for r in task.resources
+                                for p in (r.ports or [])})
+                if ports:
+                    try:
+                        provision.open_ports(handle.provider,
+                                             handle.region,
+                                             handle.cluster_name_on_cloud,
+                                             ports)
+                    except exceptions.SkyTpuError as e:
+                        logger.warning('open_ports on reuse: %s', e)
             return handle
         if dryrun:
             return None

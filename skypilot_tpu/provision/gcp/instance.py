@@ -7,6 +7,7 @@ atomic gang — no per-VM orchestration), poll the operation, read the
 per-host ``networkEndpoints`` for rank-ordered IPs, map
 stockout/quota errors for the failover engine.
 """
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -232,8 +233,23 @@ def terminate_instances(region: str,
 
 def open_ports(region: str, cluster_name_on_cloud: str,
                ports: List[str]) -> None:
-    """Create a firewall rule for the requested ports on the 'skytpu'
-    network tag."""
+    """Create (or merge ports into) the firewall rule for the
+    'skytpu' network tag. The 409-merge below is a read-modify-write
+    of a shared rule — serialize it client-side so two concurrent
+    ``serve up`` calls cannot drop each other's ports."""
+    import filelock
+    lock_dir = os.path.expanduser(
+        os.path.join(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'),
+            '.locks'))
+    os.makedirs(lock_dir, exist_ok=True)
+    with filelock.FileLock(
+            os.path.join(lock_dir, f'fw-{cluster_name_on_cloud}.lock')):
+        _open_ports_locked(cluster_name_on_cloud, ports)
+
+
+def _open_ports_locked(cluster_name_on_cloud: str,
+                       ports: List[str]) -> None:
     project = gcp_client.get_project_id()
     rule_name = f'skytpu-{cluster_name_on_cloud}-ports'
     body = {
@@ -253,8 +269,26 @@ def open_ports(region: str, cluster_name_on_cloud: str,
             f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
             'firewalls', body)
     except exceptions.ApiError as e:
-        if e.http_code != 409:  # already exists
+        if e.http_code != 409:
             raise
+        # Rule exists (an earlier service/launch on this cluster):
+        # merge the new ports in rather than dropping them — serve
+        # adds one LB port per service to a shared controller
+        # cluster.
+        url = (f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
+               f'firewalls/{rule_name}')
+        existing = gcp_client.request('GET', url)
+        have = set()
+        for allowed in existing.get('allowed', []):
+            have.update(str(p) for p in allowed.get('ports', []))
+        want = have | {str(p) for p in ports}
+        if want != have:
+            gcp_client.request('PATCH', url, {
+                'allowed': [{
+                    'IPProtocol': 'tcp',
+                    'ports': sorted(want),
+                }],
+            })
 
 
 def cleanup_ports(region: str, cluster_name_on_cloud: str) -> None:

@@ -5,12 +5,27 @@ target_qps_per_replica), bounded to [min, max], applied with
 hysteresis — consecutive upscale/downscale observations must persist
 for the configured delays before acting (``:348-545`` in the
 reference).
+
+``FallbackRequestRateAutoscaler`` / ``FallbackFixedAutoscaler``
+(model: ``sky/serve/autoscalers.py:546-640``): keep
+``base_ondemand_fallback_replicas`` on-demand replicas as an
+availability floor, fill the rest of the target with spot, replace
+preempted spot replicas, and — with ``dynamic_ondemand_fallback`` —
+temporarily cover spot shortfall with extra on-demand replicas that
+drain once spot recovers. On TPU, spot serving is the cost story:
+v5e spot is ~3x cheaper than on-demand (catalog), so the fleet wants
+to be spot with an on-demand floor.
+
+All autoscalers emit a list of ``ScalingOp`` from ``generate_ops``;
+each op optionally pins ``use_spot`` for new replicas (the
+reference's per-decision resource override, ``:28``
+AutoscalerDecision).
 """
 import dataclasses
 import enum
 import math
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
@@ -33,6 +48,28 @@ class AutoscalerDecision:
     target_num_replicas: int
 
 
+@dataclasses.dataclass
+class ScalingOp:
+    """One concrete action for the replica manager."""
+    operator: AutoscalerDecisionOperator
+    count: int = 0                        # SCALE_UP: how many
+    use_spot: Optional[bool] = None       # SCALE_UP: resources pin
+    replica_ids: List[int] = dataclasses.field(default_factory=list)
+
+
+def _nonterminal(records: List[Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    return [r for r in records
+            if not r['status'].is_terminal() and
+            r['status'] != ReplicaStatus.SHUTTING_DOWN]
+
+
+def _ready(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    return [r for r in records if r['status'] == ReplicaStatus.READY]
+
+
 class Autoscaler:
 
     def __init__(self, spec: SkyServiceSpec):
@@ -47,6 +84,26 @@ class Autoscaler:
                          now: Optional[float] = None
                          ) -> AutoscalerDecision:
         raise NotImplementedError
+
+    def generate_ops(self, records: List[Dict[str, Any]],
+                     now: Optional[float] = None) -> List[ScalingOp]:
+        """Reconcile the fleet against the target: evaluate_scaling
+        applies the policy (hysteresis etc.) to
+        ``target_num_replicas``; the delta vs the live fleet covers
+        both autoscaling and replacement of failed/preempted
+        replicas in one step."""
+        nonterm = _nonterminal(records)
+        self.evaluate_scaling(len(_ready(records)), now)
+        delta = self.target_num_replicas - len(nonterm)
+        if delta > 0:
+            return [ScalingOp(AutoscalerDecisionOperator.SCALE_UP,
+                              count=delta)]
+        if delta < 0:
+            victims = [r['replica_id']
+                       for r in reversed(nonterm)][:-delta]
+            return [ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
+                              replica_ids=victims)]
+        return []
 
 
 class FixedReplicaAutoscaler(Autoscaler):
@@ -117,8 +174,85 @@ class RequestRateAutoscaler(Autoscaler):
                                   self.target_num_replicas)
 
 
+class _SpotMixOps:
+    """Shared spot/on-demand mix planner for the fallback
+    autoscalers (model: ``sky/serve/autoscalers.py:546-640``).
+
+    Given a total target T from the scaling policy:
+      - ``base = min(base_ondemand_fallback_replicas, T)`` replicas
+        are pinned on-demand (the availability floor);
+      - ``T - base`` replicas are spot;
+      - with ``dynamic_ondemand_fallback``, any spot shortfall
+        (want_spot - ready_spot) is covered by extra on-demand
+        replicas that are scaled back down as spot becomes READY.
+    """
+
+    def _mix_ops(self, records: List[Dict[str, Any]]
+                 ) -> List[ScalingOp]:
+        spec = self.spec  # type: ignore[attr-defined]
+        target = self.target_num_replicas  # type: ignore[attr-defined]
+        base = min(spec.base_ondemand_fallback_replicas, target)
+        want_spot = target - base
+        nonterm = _nonterminal(records)
+        spot = [r for r in nonterm if r.get('use_spot')]
+        ondemand = [r for r in nonterm if not r.get('use_spot')]
+        ready_spot = [r for r in _ready(records) if r.get('use_spot')]
+
+        want_od = base
+        if spec.dynamic_ondemand_fallback:
+            want_od += max(0, want_spot - len(ready_spot))
+
+        ops: List[ScalingOp] = []
+        if len(spot) < want_spot:
+            ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_UP,
+                                 count=want_spot - len(spot),
+                                 use_spot=True))
+        elif len(spot) > want_spot:
+            victims = [r['replica_id'] for r in
+                       reversed(spot)][:len(spot) - want_spot]
+            ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
+                                 replica_ids=victims))
+        if len(ondemand) < want_od:
+            ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_UP,
+                                 count=want_od - len(ondemand),
+                                 use_spot=False))
+        elif len(ondemand) > want_od:
+            # Newest first: dynamic-fallback extras drain before the
+            # long-lived base replicas.
+            victims = [r['replica_id'] for r in
+                       reversed(ondemand)][:len(ondemand) - want_od]
+            ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
+                                 replica_ids=victims))
+        return ops
+
+
+class FallbackRequestRateAutoscaler(_SpotMixOps,
+                                    RequestRateAutoscaler):
+    """QPS-driven total target + spot/on-demand mix."""
+
+    def generate_ops(self, records, now=None):
+        # evaluate_scaling updates target_num_replicas with the
+        # request-rate hysteresis; the mix planner then reconciles
+        # the fleet composition against it.
+        self.evaluate_scaling(len(_ready(records)), now)
+        return self._mix_ops(records)
+
+
+class FallbackFixedAutoscaler(_SpotMixOps, FixedReplicaAutoscaler):
+    """Fixed total target (min_replicas) + spot/on-demand mix."""
+
+    def generate_ops(self, records, now=None):
+        return self._mix_ops(records)
+
+
 def make_autoscaler(spec: SkyServiceSpec) -> Autoscaler:
+    wants_fallback = spec.base_ondemand_fallback_replicas > 0 or \
+        spec.dynamic_ondemand_fallback
     if spec.target_qps_per_replica is not None and \
             spec.max_replicas > spec.min_replicas:
+        if wants_fallback:
+            return FallbackRequestRateAutoscaler(spec)
         return RequestRateAutoscaler(spec)
+    if wants_fallback:
+        return FallbackFixedAutoscaler(spec)
     return FixedReplicaAutoscaler(spec)

@@ -35,7 +35,9 @@ class SkyServeController:
                                               task)
         self.autoscaler = make_autoscaler(self.spec)
         self.load_balancer = SkyServeLoadBalancer(
-            lb_port, self.replica_manager.ready_endpoints)
+            lb_port, self.replica_manager.ready_endpoints,
+            tls_keyfile=self.spec.tls_keyfile,
+            tls_certfile=self.spec.tls_certfile)
         self.version = 1
         self._stop = threading.Event()
 
@@ -43,10 +45,19 @@ class SkyServeController:
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.REPLICA_INIT)
         self.load_balancer.start()
-        serve_state.set_service_endpoint(
-            self.service_name,
-            f'http://127.0.0.1:{self.load_balancer.port}')
-        self.replica_manager.scale_up(self.spec.min_replicas)
+        # The client computes the authoritative endpoint from the
+        # controller cluster's head IP (serve/core.py up); only fill
+        # one in when the controller is run standalone (tests).
+        rec = serve_state.get_service(self.service_name)
+        if rec is not None and not rec['endpoint']:
+            scheme = 'https' if self.spec.tls_certfile else 'http'
+            serve_state.set_service_endpoint(
+                self.service_name,
+                f'{scheme}://127.0.0.1:{self.load_balancer.port}')
+        # Initial provisioning is the first tick's generate_ops
+        # (shortfall from zero replicas) — an eager scale_up here
+        # would bypass the fallback autoscalers' spot/on-demand mix
+        # and get partially torn down one tick later.
         self._loop()
 
     def stop(self) -> None:
@@ -92,6 +103,15 @@ class SkyServeController:
         status. During a rolling update, old-version replicas keep
         serving until enough new-version replicas are READY, then
         drain."""
+        rec = serve_state.get_service(self.service_name)
+        if rec is None or rec['down_requested']:
+            # ``serve down`` flags the row (or force-removed it): the
+            # controller owns teardown — terminate replicas + LB and
+            # exit; the job on the controller cluster then completes.
+            logger.info('Down requested for %s; shutting down.',
+                        self.service_name)
+            self._stop.set()
+            return
         self._check_for_update()
         records = self.replica_manager.probe_all()
         old_alive = [r for r in records
@@ -133,30 +153,21 @@ class SkyServeController:
                  if r['status'] == ReplicaStatus.READY]
         self.autoscaler.collect_request_information(
             self.load_balancer.drain_request_timestamps())
-        decision = self.autoscaler.evaluate_scaling(len(ready))
-        if decision.operator == AutoscalerDecisionOperator.SCALE_UP:
-            need = decision.target_num_replicas - \
-                self.replica_manager.num_nonterminal()
-            if need > 0:
-                logger.info('Autoscaler: scale UP to %d (+%d)',
-                            decision.target_num_replicas, need)
-                self.replica_manager.scale_up(need)
-        elif decision.operator == \
-                AutoscalerDecisionOperator.SCALE_DOWN:
-            extra = self.replica_manager.num_nonterminal() - \
-                decision.target_num_replicas
-            if extra > 0:
-                victims = [r['replica_id'] for r in reversed(records)
-                           if not r['status'].is_terminal()][:extra]
-                logger.info('Autoscaler: scale DOWN to %d (-%s)',
-                            decision.target_num_replicas, victims)
-                self.replica_manager.scale_down(victims)
-        # Replica shortfall from failures (not autoscaling): keep at
-        # least target replicas provisioning.
-        shortfall = self.autoscaler.target_num_replicas - \
-            self.replica_manager.num_nonterminal()
-        if shortfall > 0:
-            self.replica_manager.scale_up(shortfall)
+        # The autoscaler plans the whole fleet delta — scaling,
+        # failure/preemption replacement, and (fallback autoscalers)
+        # the spot/on-demand mix — as concrete ops.
+        for op in self.autoscaler.generate_ops(records):
+            if op.operator == AutoscalerDecisionOperator.SCALE_UP:
+                logger.info('Autoscaler: +%d replica(s)%s', op.count,
+                            '' if op.use_spot is None else
+                            f' ({"spot" if op.use_spot else "on-demand"})')
+                self.replica_manager.scale_up(op.count,
+                                              use_spot=op.use_spot)
+            elif op.operator == \
+                    AutoscalerDecisionOperator.SCALE_DOWN:
+                logger.info('Autoscaler: scale DOWN (-%s)',
+                            op.replica_ids)
+                self.replica_manager.scale_down(op.replica_ids)
         status = ServiceStatus.READY if ready else \
             ServiceStatus.REPLICA_INIT
         serve_state.set_service_status(self.service_name, status)

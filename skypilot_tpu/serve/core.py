@@ -1,18 +1,19 @@
 """Serve client API (analog of ``sky/serve/core.py``: up/down/status).
 
-``up`` starts one controller process per service (hosting the replica
-manager, autoscaler and load balancer) and waits for the endpoint.
-The controller runs as a local daemon process of the client machine
-rather than on a controller cluster in this round — replicas are full
-clusters either way; moving the controller itself onto a cluster
-reuses the managed-jobs recursion (see jobs/core.py) and is the
-planned next step.
+``up`` launches the serve controller (replica manager + autoscaler +
+load balancer, one process per service) **as a task on a controller
+cluster** via the ordinary launch path — the same "controller is just
+a task" recursion managed jobs use (reference ``sky/serve/core.py:136``
+→ ``sky/serve/service.py:133``; repo analog ``jobs/core.py``). The
+service therefore outlives the client process: the controller runs
+under the cluster's agent, not as a child of whoever typed
+``xsky serve up``. The load balancer port is allocated from a fixed
+range and opened on the controller cluster via ``resources.ports`` so
+real clouds firewall it open (``provision/provisioner.py:51``).
 """
 import json
 import os
-import signal
-import socket
-import subprocess
+import shlex
 import time
 from typing import Any, Dict, List, Optional
 
@@ -25,11 +26,49 @@ from skypilot_tpu.utils import common_utils
 
 logger = tpu_logging.init_logger(__name__)
 
+CONTROLLER_CLUSTER_PREFIX = 'sky-serve-controller-'
+# One LB port per service, allocated from this range (reference:
+# load-balancer ports 30001-30100, sky/serve/constants.py).
+LB_PORT_START = 30001
+LB_PORT_END = 30100
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
+
+def _controller_cluster_name() -> str:
+    return CONTROLLER_CLUSTER_PREFIX + common_utils.get_user_hash()
+
+
+def _state_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+
+
+def _lb_port_lock():
+    """Serializes read-allocate-insert of LB ports across concurrent
+    ``serve up`` processes (same filelock pattern as
+    ``jobs/core.py`` _admission_lock)."""
+    from skypilot_tpu.utils import timeline
+    os.makedirs(_state_dir(), exist_ok=True)
+    return timeline.FileLockEvent(
+        os.path.join(_state_dir(), '.serve_lb_ports.lock'))
+
+
+def _allocate_lb_port() -> int:
+    used = set(serve_state.used_lb_ports())
+    for port in range(LB_PORT_START, LB_PORT_END + 1):
+        if port not in used:
+            return port
+    raise exceptions.SkyTpuError(
+        f'No free load-balancer port in [{LB_PORT_START}, '
+        f'{LB_PORT_END}] — too many services on this controller.')
+
+
+def _controller_resources():
+    """CPU-only controller with the service's LB port opened; cloud
+    resolved by the default-cloud logic in execution (gcp VM when
+    credentials exist, local otherwise) — same policy as the jobs
+    controller (jobs/core.py)."""
+    from skypilot_tpu.resources import Resources
+    return Resources()
 
 
 def up(task: Task, service_name: Optional[str] = None,
@@ -48,30 +87,78 @@ def up(task: Task, service_name: Optional[str] = None,
             f'Service {service_name!r} already exists; use update or '
             'down first.')
 
-    state_dir = os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    state_dir = _state_dir()
     os.makedirs(os.path.join(state_dir, 'services'), exist_ok=True)
     task_yaml = os.path.join(state_dir, 'services',
                              f'{service_name}.yaml')
-    common_utils.dump_yaml(task_yaml, task.to_yaml_config())
-    serve_state.add_service(service_name,
-                            json.dumps(task.service.to_yaml_config()))
+    task_config = task.to_yaml_config()
+    # TLS credentials are shipped to the controller cluster as file
+    # mounts and the controller-side spec points at the shipped
+    # copies (reference: tls files live with the controller,
+    # sky/serve/service_spec.py:31).
+    tls_mounts: Dict[str, str] = {}
+    if task.service.tls_certfile:
+        remote_dir = f'~/.skytpu_tls/{service_name}'
+        tls_mounts = {
+            f'{remote_dir}/cert.pem':
+                os.path.expanduser(task.service.tls_certfile),
+            f'{remote_dir}/key.pem':
+                os.path.expanduser(task.service.tls_keyfile),
+        }
+        task_config['service']['tls'] = {
+            'certfile': f'{remote_dir}/cert.pem',
+            'keyfile': f'{remote_dir}/key.pem',
+        }
+    common_utils.dump_yaml(task_yaml, task_config)
+    with _lb_port_lock():
+        lb_port = _allocate_lb_port()
+        serve_state.add_service(
+            service_name, json.dumps(task.service.to_yaml_config()),
+            lb_port=lb_port)
 
-    lb_port = _free_port()
-    log_path = os.path.join(state_dir, 'services',
-                            f'{service_name}.controller.log')
-    env = dict(os.environ)
-    env['SKYTPU_STATE_DIR'] = state_dir
-    with open(log_path, 'a', encoding='utf-8') as logf:
-        proc = subprocess.Popen(
-            ['python3', '-m', 'skypilot_tpu.serve.controller',
-             '--service-name', service_name, '--task-yaml', task_yaml,
-             '--lb-port', str(lb_port)],
-            stdout=logf, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
-    serve_state.set_service_controller_pid(service_name, proc.pid)
+    # Controller task: runs the per-service controller process on the
+    # controller cluster. The state dir is forwarded so the controller
+    # (local provider: same machine; gcp: the controller VM's own
+    # dir) sees the same serve DB (same contract as jobs/core.py).
+    controller_cluster = _controller_cluster_name()
+    controller_task = Task(
+        name=f'serve-controller-{service_name}',
+        run=(f'SKYTPU_STATE_DIR={shlex.quote(state_dir)} '
+             f'python3 -m skypilot_tpu.serve.controller '
+             f'--service-name {shlex.quote(service_name)} '
+             f'--task-yaml {shlex.quote(task_yaml)} '
+             f'--lb-port {lb_port}'),
+        file_mounts=tls_mounts or None,
+    )
+    res = _controller_resources()
+    controller_task.set_resources(
+        res.copy(ports=sorted(set(res.ports or []) | {str(lb_port)})))
 
-    endpoint = f'http://127.0.0.1:{lb_port}'
+    from skypilot_tpu import execution, state
+    try:
+        # fast=True skips SYNC_FILE_MOUNTS on a reused controller
+        # cluster, so it is only safe without mounts to ship.
+        controller_job_id, _ = execution.launch(
+            controller_task, controller_cluster,
+            fast=not tls_mounts,
+            detach_run=True, quiet_optimizer=True,
+            retry_until_up=True)
+    except exceptions.SkyTpuError:
+        serve_state.remove_service(service_name)
+        raise
+    serve_state.set_controller_job(service_name, controller_cluster,
+                                   controller_job_id)
+
+    record = state.get_cluster_from_name(controller_cluster)
+    assert record is not None, controller_cluster
+    scheme = 'https' if task.service.tls_certfile else 'http'
+    endpoint = f'{scheme}://{record["handle"].head_ip}:{lb_port}'
+    serve_state.set_service_endpoint(service_name, endpoint)
+    logger.info('Service %s: controller on cluster %s (job %s), '
+                'endpoint %s', service_name, controller_cluster,
+                controller_job_id, endpoint)
+
+    from skypilot_tpu import core as core_lib
     deadline = time.time() + wait_ready_timeout
     while time.time() < deadline:
         rec = serve_state.get_service(service_name)
@@ -79,22 +166,32 @@ def up(task: Task, service_name: Optional[str] = None,
             logger.info('Service %s READY at %s', service_name,
                         endpoint)
             return endpoint
-        # Never leave a half-up service behind on failure: a live
-        # controller would keep relaunching failing replicas (and
-        # leaking their processes) with nothing left to ever tear it
-        # down, and a dead controller leaves the service row + any
-        # launched replica clusters orphaned.
-        if proc.poll() is not None:
+        # Never leave a half-up service behind on failure: a dead
+        # controller leaves the service row + any launched replica
+        # clusters orphaned, with nothing left to tear them down.
+        try:
+            job_status = core_lib.job_status(controller_cluster,
+                                             controller_job_id)
+        except exceptions.SkyTpuError:
+            job_status = None  # transient; keep polling
+        # ANY terminal state before READY is a failure — including
+        # SUCCEEDED (a controller that exited cleanly without the
+        # service coming up is still a dead service).
+        if job_status is not None and job_status.is_terminal():
             _cleanup_failed_up(service_name)
             raise exceptions.SkyTpuError(
-                f'Serve controller died (see {log_path})')
+                f'Serve controller job {controller_job_id} on '
+                f'{controller_cluster} ended {job_status.value} '
+                f'before the service was READY; see '
+                f'`xsky logs {controller_cluster} '
+                f'{controller_job_id}`.')
         time.sleep(1.0)
     logger.error('Service %s not READY in %ss; tearing it down',
                  service_name, wait_ready_timeout)
     _cleanup_failed_up(service_name)
     raise TimeoutError(
         f'Service {service_name} not READY after '
-        f'{wait_ready_timeout}s (see {log_path})')
+        f'{wait_ready_timeout}s')
 
 
 def _cleanup_failed_up(service_name: str) -> None:
@@ -122,10 +219,8 @@ def update(service_name: str, task: Task) -> int:
         raise exceptions.ClusterDoesNotExist(
             f'Service {service_name!r} does not exist; use up.')
     new_version = rec['target_version'] + 1
-    state_dir = os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
     task_yaml = os.path.join(
-        state_dir, 'services', f'{service_name}.v{new_version}.yaml')
+        _state_dir(), 'services', f'{service_name}.v{new_version}.yaml')
     common_utils.dump_yaml(task_yaml, task.to_yaml_config())
     serve_state.set_target_version(service_name, new_version,
                                    task_yaml)
@@ -135,28 +230,47 @@ def update(service_name: str, task: Task) -> int:
 
 
 def down(service_name: str, timeout: float = 120.0) -> None:
+    """Tear a service down: flag the controller (it terminates its
+    replicas + LB and exits), wait, then force-clean anything left.
+    The controller is a job on the controller cluster — the last
+    resort is cancelling that job through the agent channel, never a
+    client-side process kill."""
     rec = serve_state.get_service(service_name)
     if rec is None:
         raise exceptions.ClusterDoesNotExist(
             f'Service {service_name!r} does not exist.')
-    pid = rec['controller_pid']
-    if pid:
-        try:
-            os.kill(pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            pid = None
-    deadline = time.time() + timeout
-    while pid and time.time() < deadline:
-        rec = serve_state.get_service(service_name)
-        if rec is None or rec['status'] in (ServiceStatus.DOWN,):
-            break
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
-            break
-        time.sleep(0.5)
-    # Force-clean any replicas the controller did not get to.
+    serve_state.request_down(service_name)
     from skypilot_tpu import core as core_lib
+    deadline = time.time() + timeout
+    controller_cluster = rec['controller_cluster']
+    controller_job_id = rec['controller_job_id']
+    while time.time() < deadline:
+        cur = serve_state.get_service(service_name)
+        if cur is None or cur['status'] == ServiceStatus.DOWN:
+            break
+        if controller_cluster and controller_job_id:
+            try:
+                js = core_lib.job_status(controller_cluster,
+                                         controller_job_id)
+            except exceptions.SkyTpuError:
+                # Transient (agent restart, tunnel blip): unknown is
+                # NOT "gone" — force-cleaning now would race a live
+                # controller's launch threads. Keep waiting.
+                time.sleep(0.5)
+                continue
+            if js is None or js.is_terminal():
+                break  # controller gone; fall through to force-clean
+        time.sleep(0.5)
+    else:
+        # Controller did not act on the flag in time: cancel its job.
+        if controller_cluster and controller_job_id:
+            try:
+                core_lib.cancel(controller_cluster,
+                                [controller_job_id])
+            except exceptions.SkyTpuError as e:
+                logger.warning('Cancelling serve controller job: %s',
+                               e)
+    # Force-clean any replicas the controller did not get to.
     for replica in serve_state.get_replicas(service_name):
         try:
             core_lib.down(replica['cluster_name'], purge=True)

@@ -75,10 +75,16 @@ class SkyServeLoadBalancer:
 
     def __init__(self, port: int,
                  get_ready_endpoints: Callable[[], List[str]],
-                 policy: Optional[LoadBalancingPolicy] = None):
+                 policy: Optional[LoadBalancingPolicy] = None,
+                 tls_keyfile: Optional[str] = None,
+                 tls_certfile: Optional[str] = None):
         self.port = port
         self.get_ready_endpoints = get_ready_endpoints
         self.policy = policy or LeastLoadPolicy()
+        # TLS terminates here; replica traffic behind the LB stays
+        # plain HTTP (reference sky/serve/service_spec.py:31 tls).
+        self.tls_keyfile = tls_keyfile
+        self.tls_certfile = tls_certfile
         self.request_timestamps: List[float] = []
         self._ts_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
@@ -205,10 +211,20 @@ class SkyServeLoadBalancer:
 
         self._server = ThreadingHTTPServer(('0.0.0.0', self.port),
                                            Handler)
+        if self.tls_certfile:
+            import os
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                certfile=os.path.expanduser(self.tls_certfile),
+                keyfile=os.path.expanduser(self.tls_keyfile))
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)
         self._thread.start()
-        logger.info('Load balancer listening on :%d', self.port)
+        logger.info('Load balancer listening on :%d%s', self.port,
+                    ' (TLS)' if self.tls_certfile else '')
 
     def stop(self) -> None:
         if self._server is not None:

@@ -64,7 +64,12 @@ class ReplicaManager:
             return self.spec.port + replica_id
         return self.spec.port
 
-    def scale_up(self, n: int = 1) -> List[int]:
+    def scale_up(self, n: int = 1,
+                 use_spot: Optional[bool] = None) -> List[int]:
+        """Launch n replicas. ``use_spot`` pins the new replicas'
+        spot-ness (the fallback autoscalers' per-op resource
+        override, ref ``sky/serve/autoscalers.py:28``); None keeps
+        the task's own resources."""
         ids = []
         with self._lock:
             for _ in range(n):
@@ -74,20 +79,25 @@ class ReplicaManager:
         # Snapshot task/version NOW: an update arriving while a
         # launch thread runs must not relabel an old-version replica.
         version, task = self.version, self.task
+        spot_flag = use_spot if use_spot is not None else \
+            any(r.use_spot for r in task.resources)
         for replica_id in ids:
             serve_state.upsert_replica(
                 self.service_name, replica_id,
                 self._cluster_name(replica_id),
-                ReplicaStatus.PROVISIONING, version=version)
+                ReplicaStatus.PROVISIONING, version=version,
+                use_spot=spot_flag)
             thread = threading.Thread(
                 target=self._launch_replica,
-                args=(replica_id, task, version), daemon=True)
+                args=(replica_id, task, version, use_spot),
+                daemon=True)
             self._launch_threads[replica_id] = thread
             thread.start()
         return ids
 
     def _launch_replica(self, replica_id: int, src_task: Task,
-                        version: int) -> None:
+                        version: int,
+                        use_spot: Optional[bool] = None) -> None:
         cluster_name = self._cluster_name(replica_id)
         port = self._replica_port(replica_id)
         task = Task(
@@ -98,8 +108,26 @@ class ReplicaManager:
                   'SKYTPU_REPLICA_PORT': str(port),
                   'SKYTPU_REPLICA_ID': str(replica_id)},
             workdir=src_task.workdir,
+            # A service YAML's mounts (e.g. a checkpoint bucket) must
+            # reach every replica (reference: the replica task IS the
+            # user task, mounts included,
+            # ``sky/serve/replica_managers.py:58``).
+            file_mounts=(dict(src_task.file_mounts)
+                         if src_task.file_mounts else None),
         )
-        task.set_resources(set(src_task.resources))
+        task.set_storage_mounts(dict(src_task.storage_mounts))
+        # The serving port must be reachable from the load balancer:
+        # thread it into resources.ports so the provisioner opens it
+        # on real clouds (``provision/provisioner.py:51`` only opens
+        # user-requested ports; reference port flow
+        # ``sky/serve/replica_managers.py:58`` →
+        # ``sky/provision/__init__.py:33`` open_ports).
+        overrides = {} if use_spot is None else {'use_spot': use_spot}
+        task.set_resources({
+            r.copy(ports=sorted(set(r.ports or []) | {str(port)}),
+                   **overrides)
+            for r in src_task.resources
+        })
         try:
             execution.launch(task, cluster_name, detach_run=True,
                              quiet_optimizer=True)
@@ -117,10 +145,11 @@ class ReplicaManager:
             return
         ip = record['handle'].head_ip
         endpoint = f'http://{ip}:{port}'
-        serve_state.upsert_replica(self.service_name, replica_id,
-                                   cluster_name,
-                                   ReplicaStatus.STARTING, endpoint,
-                                   version=version)
+        serve_state.upsert_replica(
+            self.service_name, replica_id, cluster_name,
+            ReplicaStatus.STARTING, endpoint, version=version,
+            use_spot=(use_spot if use_spot is not None else
+                      any(r.use_spot for r in src_task.resources)))
 
     def scale_down(self, replica_ids: List[int]) -> None:
         for replica_id in replica_ids:
@@ -165,12 +194,16 @@ class ReplicaManager:
                 continue
             cluster = state.get_cluster_from_name(rec['cluster_name'])
             if cluster is None:
-                logger.warning('Replica %d cluster gone (preempted); '
-                               'relaunching', rid)
+                # Preempted (cluster gone). Replacement is the
+                # autoscaler's call — the same tick's generate_ops
+                # sees the shortfall and relaunches with the right
+                # spot/on-demand mix (fallback autoscalers may cover
+                # with on-demand instead of like-for-like).
+                logger.warning('Replica %d cluster gone (preempted)',
+                               rid)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.PREEMPTED)
                 serve_state.remove_replica(self.service_name, rid)
-                self.scale_up(1)
                 continue
             spec = self._version_specs.get(rec['version'],
                                            self.spec)

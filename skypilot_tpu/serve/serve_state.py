@@ -57,14 +57,23 @@ def _create_tables(cursor, conn):
         launched_at REAL,
         version INTEGER DEFAULT 1,
         PRIMARY KEY (service_name, replica_id))""")
-    # Rolling-update columns (migrations for pre-update DBs).
+    # Rolling-update + controller-cluster columns (migrations for
+    # older DBs).
     import sqlite3
     for stmt in (
             'ALTER TABLE services ADD COLUMN '
             'target_version INTEGER DEFAULT 1',
             'ALTER TABLE services ADD COLUMN target_task_yaml TEXT',
             'ALTER TABLE replicas ADD COLUMN version INTEGER '
-            'DEFAULT 1'):
+            'DEFAULT 1',
+            'ALTER TABLE services ADD COLUMN lb_port INTEGER',
+            'ALTER TABLE services ADD COLUMN down_requested INTEGER '
+            'DEFAULT 0',
+            'ALTER TABLE services ADD COLUMN controller_cluster TEXT',
+            'ALTER TABLE services ADD COLUMN '
+            'controller_job_id INTEGER',
+            'ALTER TABLE replicas ADD COLUMN use_spot INTEGER '
+            'DEFAULT 0'):
         try:
             cursor.execute(stmt)
         except sqlite3.OperationalError:
@@ -84,12 +93,13 @@ def _db() -> db_utils.SQLiteConn:
     return conn
 
 
-def add_service(name: str, spec_json: str) -> None:
+def add_service(name: str, spec_json: str,
+                lb_port: Optional[int] = None) -> None:
     _db().execute_and_commit(
         'INSERT OR REPLACE INTO services (name, status, created_at, '
-        'spec_json) VALUES (?,?,?,?)',
+        'spec_json, lb_port, down_requested) VALUES (?,?,?,?,?,0)',
         (name, ServiceStatus.CONTROLLER_INIT.value, time.time(),
-         spec_json))
+         spec_json, lb_port))
 
 
 def set_service_status(name: str, status: ServiceStatus) -> None:
@@ -113,7 +123,8 @@ def set_service_controller_pid(name: str, pid: int) -> None:
 def get_service(name: str) -> Optional[Dict[str, Any]]:
     row = _db().cursor.execute(
         'SELECT name, status, created_at, spec_json, endpoint, '
-        'controller_pid, target_version, target_task_yaml '
+        'controller_pid, target_version, target_task_yaml, lb_port, '
+        'down_requested, controller_cluster, controller_job_id '
         'FROM services WHERE name=?', (name,)).fetchone()
     if row is None:
         return None
@@ -126,6 +137,10 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
         'controller_pid': row[5],
         'target_version': row[6] if row[6] is not None else 1,
         'target_task_yaml': row[7],
+        'lb_port': row[8],
+        'down_requested': bool(row[9]),
+        'controller_cluster': row[10],
+        'controller_job_id': row[11],
     }
 
 
@@ -144,17 +159,18 @@ def remove_service(name: str) -> None:
 def upsert_replica(service_name: str, replica_id: int,
                    cluster_name: str, status: ReplicaStatus,
                    endpoint: Optional[str] = None,
-                   version: int = 1) -> None:
+                   version: int = 1,
+                   use_spot: bool = False) -> None:
     _db().execute_and_commit(
         'INSERT INTO replicas (service_name, replica_id, '
-        'cluster_name, status, endpoint, launched_at, version) '
-        'VALUES (?,?,?,?,?,?,?) '
+        'cluster_name, status, endpoint, launched_at, version, '
+        'use_spot) VALUES (?,?,?,?,?,?,?,?) '
         'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
         'cluster_name=excluded.cluster_name, status=excluded.status, '
         'endpoint=COALESCE(excluded.endpoint, replicas.endpoint), '
-        'version=excluded.version',
+        'version=excluded.version, use_spot=excluded.use_spot',
         (service_name, replica_id, cluster_name, status.value,
-         endpoint, time.time(), version))
+         endpoint, time.time(), version, int(use_spot)))
 
 
 def set_replica_status(service_name: str, replica_id: int,
@@ -167,8 +183,9 @@ def set_replica_status(service_name: str, replica_id: int,
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     rows = _db().cursor.execute(
         'SELECT replica_id, cluster_name, status, endpoint, '
-        'launched_at, version FROM replicas WHERE service_name=? '
-        'ORDER BY replica_id', (service_name,)).fetchall()
+        'launched_at, version, use_spot FROM replicas '
+        'WHERE service_name=? ORDER BY replica_id',
+        (service_name,)).fetchall()
     return [{
         'replica_id': r[0],
         'cluster_name': r[1],
@@ -176,6 +193,7 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
         'endpoint': r[3],
         'launched_at': r[4],
         'version': r[5] if r[5] is not None else 1,
+        'use_spot': bool(r[6]),
     } for r in rows]
 
 
@@ -192,3 +210,27 @@ def set_target_version(name: str, version: int,
     _db().execute_and_commit(
         'UPDATE services SET target_version=?, target_task_yaml=? '
         'WHERE name=?', (version, task_yaml, name))
+
+
+def request_down(name: str) -> None:
+    """Ask the (possibly remote) controller to tear the service down;
+    it acts on the flag on its next tick. Replaces client-side
+    process kills — the controller is a cluster job, not a child of
+    the client (reference: serve teardown is a controller-side
+    operation, ``sky/serve/serve_utils.py`` terminate_services)."""
+    _db().execute_and_commit(
+        'UPDATE services SET down_requested=1 WHERE name=?', (name,))
+
+
+def set_controller_job(name: str, controller_cluster: str,
+                       controller_job_id: Optional[int]) -> None:
+    _db().execute_and_commit(
+        'UPDATE services SET controller_cluster=?, controller_job_id=? '
+        'WHERE name=?', (controller_cluster, controller_job_id, name))
+
+
+def used_lb_ports() -> List[int]:
+    rows = _db().cursor.execute(
+        'SELECT lb_port FROM services WHERE lb_port IS NOT NULL'
+    ).fetchall()
+    return [r[0] for r in rows]

@@ -24,6 +24,9 @@ class SkyServiceSpec:
         downscale_delay_seconds: int = DEFAULT_DOWNSCALE_DELAY_SECONDS,
         port: int = 8080,
         base_ondemand_fallback_replicas: int = 0,
+        dynamic_ondemand_fallback: bool = False,
+        tls_keyfile: Optional[str] = None,
+        tls_certfile: Optional[str] = None,
     ):
         if min_replicas < 0:
             raise exceptions.InvalidSpecError('min_replicas must be '
@@ -50,8 +53,20 @@ class SkyServiceSpec:
         self.upscale_delay_seconds = upscale_delay_seconds
         self.downscale_delay_seconds = downscale_delay_seconds
         self.port = port
+        if base_ondemand_fallback_replicas < 0:
+            raise exceptions.InvalidSpecError(
+                'base_ondemand_fallback_replicas must be >= 0')
         self.base_ondemand_fallback_replicas = \
             base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
+        # TLS terminates at the load balancer (reference
+        # ``sky/serve/service_spec.py:31,181`` tls section); replica
+        # traffic stays plain HTTP behind it.
+        if bool(tls_keyfile) != bool(tls_certfile):
+            raise exceptions.InvalidSpecError(
+                'tls requires both keyfile and certfile.')
+        self.tls_keyfile = tls_keyfile
+        self.tls_certfile = tls_certfile
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]
@@ -67,6 +82,7 @@ class SkyServiceSpec:
         if replicas is not None:
             policy.setdefault('min_replicas', replicas)
         port = config.pop('port', 8080)
+        tls = dict(config.pop('tls', {}) or {})
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown service fields: {sorted(config)}')
@@ -88,6 +104,10 @@ class SkyServiceSpec:
             port=int(port),
             base_ondemand_fallback_replicas=policy.get(
                 'base_ondemand_fallback_replicas', 0),
+            dynamic_ondemand_fallback=policy.get(
+                'dynamic_ondemand_fallback', False),
+            tls_keyfile=tls.get('keyfile'),
+            tls_certfile=tls.get('certfile'),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -112,4 +132,9 @@ class SkyServiceSpec:
         if self.base_ondemand_fallback_replicas:
             rp['base_ondemand_fallback_replicas'] = \
                 self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            rp['dynamic_ondemand_fallback'] = True
+        if self.tls_keyfile:
+            out['tls'] = {'keyfile': self.tls_keyfile,
+                          'certfile': self.tls_certfile}
         return out

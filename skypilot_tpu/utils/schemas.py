@@ -113,6 +113,15 @@ SERVICE_SCHEMA = {
                                             'minimum': 0},
                 'base_ondemand_fallback_replicas': {
                     'type': 'integer', 'minimum': 0},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+        },
+        'tls': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'keyfile': {'type': 'string'},
+                'certfile': {'type': 'string'},
             },
         },
     },

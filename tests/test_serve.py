@@ -43,6 +43,30 @@ class TestServiceSpec:
         with pytest.raises(exceptions.InvalidSpecError):
             SkyServiceSpec(min_replicas=3, max_replicas=1)
 
+    def test_tls_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'tls': {'keyfile': '/tmp/k.pem',
+                    'certfile': '/tmp/c.pem'},
+        })
+        assert spec.tls_keyfile == '/tmp/k.pem'
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.tls_certfile == '/tmp/c.pem'
+
+    def test_tls_requires_both_files(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(tls_keyfile='/tmp/k.pem')
+
+    def test_fallback_round_trip(self):
+        spec = SkyServiceSpec.from_yaml_config({
+            'replica_policy': {'min_replicas': 2,
+                               'base_ondemand_fallback_replicas': 1,
+                               'dynamic_ondemand_fallback': True},
+        })
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.base_ondemand_fallback_replicas == 1
+        assert spec2.dynamic_ondemand_fallback is True
+
 
 class TestAutoscaler:
 
@@ -107,6 +131,112 @@ class TestAutoscaler:
         assert isinstance(a, autoscalers.FixedReplicaAutoscaler)
         d = a.evaluate_scaling(0)
         assert d.target_num_replicas == 2
+
+
+class TestFallbackAutoscaler:
+    """Spot/on-demand mix planning (model:
+    ``sky/serve/autoscalers.py:546-640`` FallbackRequestRateAutoscaler
+    + tests/test_serve_autoscaler.py)."""
+
+    def _rec(self, rid, status, use_spot):
+        return {'replica_id': rid, 'status': status,
+                'use_spot': use_spot, 'endpoint': None,
+                'cluster_name': f'c-{rid}', 'launched_at': 0.0,
+                'version': 1}
+
+    def _ops_by_kind(self, ops):
+        up = {(op.use_spot): op.count for op in ops
+              if op.operator ==
+              autoscalers.AutoscalerDecisionOperator.SCALE_UP}
+        down = [rid for op in ops
+                if op.operator ==
+                autoscalers.AutoscalerDecisionOperator.SCALE_DOWN
+                for rid in op.replica_ids]
+        return up, down
+
+    def test_make_autoscaler_selects_fallback(self):
+        spec = SkyServiceSpec(min_replicas=2,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.make_autoscaler(spec)
+        assert isinstance(a, autoscalers.FallbackFixedAutoscaler)
+        spec = SkyServiceSpec(min_replicas=1, max_replicas=4,
+                              target_qps_per_replica=1.0,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.make_autoscaler(spec)
+        assert isinstance(a,
+                          autoscalers.FallbackRequestRateAutoscaler)
+
+    def test_initial_mix(self):
+        spec = SkyServiceSpec(min_replicas=3,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.FallbackFixedAutoscaler(spec)
+        up, down = self._ops_by_kind(a.generate_ops([]))
+        assert up == {True: 2, False: 1}
+        assert not down
+
+    def test_spot_preemption_replaced_by_spot(self):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        spec = SkyServiceSpec(min_replicas=3,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.FallbackFixedAutoscaler(spec)
+        # One spot replica was preempted (its record removed by
+        # probe_all); one spot + the on-demand base remain.
+        records = [self._rec(1, ReplicaStatus.READY, False),
+                   self._rec(2, ReplicaStatus.READY, True)]
+        up, down = self._ops_by_kind(a.generate_ops(records))
+        assert up == {True: 1}
+        assert not down
+
+    def test_dynamic_fallback_covers_then_drains(self):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        spec = SkyServiceSpec(min_replicas=3,
+                              base_ondemand_fallback_replicas=1,
+                              dynamic_ondemand_fallback=True)
+        a = autoscalers.FallbackFixedAutoscaler(spec)
+        # Spot fleet is up but not READY yet: dynamic fallback covers
+        # the shortfall with extra on-demand.
+        records = [self._rec(1, ReplicaStatus.READY, False),
+                   self._rec(2, ReplicaStatus.PROVISIONING, True),
+                   self._rec(3, ReplicaStatus.PROVISIONING, True)]
+        up, down = self._ops_by_kind(a.generate_ops(records))
+        assert up == {False: 2}
+        assert not down
+        # Spot recovered: the dynamic extras (newest on-demand) drain;
+        # the base on-demand replica stays.
+        records = [self._rec(1, ReplicaStatus.READY, False),
+                   self._rec(2, ReplicaStatus.READY, True),
+                   self._rec(3, ReplicaStatus.READY, True),
+                   self._rec(4, ReplicaStatus.READY, False),
+                   self._rec(5, ReplicaStatus.READY, False)]
+        up, down = self._ops_by_kind(a.generate_ops(records))
+        assert not up
+        assert down == [5, 4]
+
+    def test_qps_driven_mix_scales_spot(self):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        spec = SkyServiceSpec(min_replicas=1, max_replicas=4,
+                              target_qps_per_replica=1.0,
+                              upscale_delay_seconds=10,
+                              downscale_delay_seconds=20,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.FallbackRequestRateAutoscaler(spec)
+        t0 = 5000.0
+        a.collect_request_information(
+            [t0 + i / 3.0 for i in range(180)])  # 3 qps
+        records = [self._rec(1, ReplicaStatus.READY, False)]
+        a.generate_ops(records, now=t0 + 60)  # hysteresis window
+        up, _ = self._ops_by_kind(
+            a.generate_ops(records, now=t0 + 71))
+        # target=3 → 1 on-demand base (already up) + 2 spot.
+        assert up == {True: 2}
+
+    def test_base_capped_at_target(self):
+        spec = SkyServiceSpec(min_replicas=1,
+                              base_ondemand_fallback_replicas=5)
+        a = autoscalers.FallbackFixedAutoscaler(spec)
+        up, down = self._ops_by_kind(a.generate_ops([]))
+        assert up == {False: 1}
+        assert not down
 
 
 class TestLoadBalancerPolicies:
@@ -199,6 +329,66 @@ class TestStreamingProxy:
             replica.shutdown()
 
 
+class TestReplicaLaunchPlumbing:
+    """The replica task must carry the serving port in
+    resources.ports (so ``open_ports`` fires on real clouds,
+    provision/provisioner.py:51) and the service YAML's mounts
+    (ref sky/serve/replica_managers.py:58)."""
+
+    def _manager_and_captured(self, monkeypatch, task):
+        from skypilot_tpu.serve import replica_managers
+        captured = {}
+
+        def fake_launch(t, cluster_name, **kwargs):
+            captured['task'] = t
+            captured['cluster_name'] = cluster_name
+            return 1, None
+
+        monkeypatch.setattr(replica_managers.execution, 'launch',
+                            fake_launch)
+        monkeypatch.setattr(
+            replica_managers.state, 'get_cluster_from_name',
+            lambda name: None)
+        mgr = replica_managers.ReplicaManager(
+            'portsvc', task.service, task)
+        return mgr, captured
+
+    def test_replica_resources_carry_port_and_mounts(
+            self, monkeypatch, tmp_path):
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+        mount_src = tmp_path / 'cfg'
+        mount_src.mkdir()
+        task = Task(name='portsvc', run='serve',
+                    file_mounts={'/remote/cfg': str(mount_src)})
+        res = Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                        ports=[8443])
+        task.set_resources(res)
+        task.service = SkyServiceSpec(readiness_path='/', port=9009,
+                                      min_replicas=1)
+        mgr, captured = self._manager_and_captured(monkeypatch, task)
+        mgr._launch_replica(1, task, 1)  # pylint: disable=protected-access
+        launched = captured['task']
+        ports = {p for r in launched.resources for p in r.ports}
+        assert '9009' in ports, ports  # the serving port
+        assert '8443' in ports, ports  # user ports preserved
+        assert launched.file_mounts == {'/remote/cfg': str(mount_src)}
+
+    def test_replica_storage_mounts_propagate(self, monkeypatch):
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+        task = Task(name='portsvc', run='serve')
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(port=9010, min_replicas=1)
+        marker = object()  # Storage objects pass through untouched
+        task.set_storage_mounts({'/ckpt': marker})
+        mgr, captured = self._manager_and_captured(monkeypatch, task)
+        mgr._launch_replica(1, task, 1)  # pylint: disable=protected-access
+        assert captured['task'].storage_mounts == {'/ckpt': marker}
+
+
 @pytest.mark.slow
 class TestServeEndToEnd:
 
@@ -229,6 +419,26 @@ class TestServeEndToEnd:
             assert replicas[0]['status'] == \
                 serve_state.ReplicaStatus.READY
 
+            # The control plane lives on a controller CLUSTER, not in
+            # the client process: the controller must be a RUNNING job
+            # on the sky-serve-controller cluster, so the service
+            # survives the client exiting (ref sky/serve/core.py:136
+            # → service.py:133).
+            from skypilot_tpu import core as core_lib
+            from skypilot_tpu import state as state_lib
+            from skypilot_tpu.runtime.job_lib import JobStatus
+            from skypilot_tpu.serve import core as serve_core
+            rec = serve_state.get_service('echosvc')
+            cc = rec['controller_cluster']
+            assert cc and cc.startswith(
+                serve_core.CONTROLLER_CLUSTER_PREFIX), rec
+            assert state_lib.get_cluster_from_name(cc) is not None
+            assert rec['lb_port'] is not None and \
+                serve_core.LB_PORT_START <= rec['lb_port'] <= \
+                serve_core.LB_PORT_END
+            assert core_lib.job_status(
+                cc, rec['controller_job_id']) == JobStatus.RUNNING
+
             # Kill the replica; controller must relaunch a new one.
             serve_api.terminate_replica('echosvc', 1)
             deadline = time.time() + 120
@@ -247,6 +457,123 @@ class TestServeEndToEnd:
         finally:
             serve_api.down('echosvc')
         assert serve_state.get_service('echosvc') is None
+
+
+@pytest.mark.slow
+class TestTlsServeEndToEnd:
+
+    def test_https_endpoint(self, monkeypatch, tmp_path):
+        """TLS terminates at the LB: the endpoint is https and serves
+        the replica's plain-HTTP content (ref
+        sky/serve/service_spec.py:31 tls section)."""
+        import ssl
+        import subprocess
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        key = tmp_path / 'key.pem'
+        cert = tmp_path / 'cert.pem'
+        subprocess.run(
+            ['openssl', 'req', '-x509', '-newkey', 'rsa:2048',
+             '-keyout', str(key), '-out', str(cert), '-days', '1',
+             '-nodes', '-subj', '/CN=localhost'],
+            check=True, capture_output=True)
+
+        task = Task(
+            name='tls-svc',
+            run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
+                 '--bind 127.0.0.1'))
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=60,
+            readiness_timeout_seconds=3, min_replicas=1, port=18500,
+            tls_keyfile=str(key), tls_certfile=str(cert))
+
+        endpoint = serve_api.up(task, 'tlssvc',
+                                wait_ready_timeout=150)
+        try:
+            assert endpoint.startswith('https://'), endpoint
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(endpoint, timeout=10,
+                                        context=ctx) as r:
+                assert r.status == 200
+        finally:
+            serve_api.down('tlssvc')
+
+
+@pytest.mark.slow
+class TestFallbackServeEndToEnd:
+
+    def test_spot_mix_and_preemption_recovery(self, monkeypatch):
+        """A service with an on-demand base under a spot fleet: the
+        fleet comes up mixed; preempting the spot replica (cluster
+        torn down out-of-band) gets a spot replacement launched while
+        the on-demand base keeps serving (ref
+        sky/serve/autoscalers.py:546)."""
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        task = Task(
+            name='fb-svc',
+            run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
+                 '--bind 127.0.0.1'))
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=60,
+            readiness_timeout_seconds=3, min_replicas=2, port=18400,
+            base_ondemand_fallback_replicas=1)
+
+        endpoint = serve_api.up(task, 'fbsvc',
+                                wait_ready_timeout=150)
+        try:
+            def mix(replicas):
+                spot = [r for r in replicas if r['use_spot']]
+                od = [r for r in replicas if not r['use_spot']]
+                return spot, od
+
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                replicas = serve_state.get_replicas('fbsvc')
+                spot, od = mix([
+                    r for r in replicas if r['status'] ==
+                    serve_state.ReplicaStatus.READY])
+                if len(spot) == 1 and len(od) == 1:
+                    break
+                time.sleep(1)
+            assert (len(spot), len(od)) == (1, 1), replicas
+
+            # Preempt the spot replica out-of-band.
+            victim = spot[0]
+            core_lib.down(victim['cluster_name'], purge=True)
+
+            deadline = time.time() + 120
+            recovered = False
+            while time.time() < deadline:
+                replicas = serve_state.get_replicas('fbsvc')
+                spot, od = mix([
+                    r for r in replicas if r['status'] ==
+                    serve_state.ReplicaStatus.READY])
+                if len(spot) == 1 and len(od) == 1 and \
+                        spot[0]['replica_id'] != victim['replica_id']:
+                    recovered = True
+                    break
+                time.sleep(1)
+            assert recovered, serve_state.get_replicas('fbsvc')
+            with urllib.request.urlopen(endpoint, timeout=10) as r:
+                assert r.status == 200
+        finally:
+            serve_api.down('fbsvc')
 
 
 @pytest.mark.slow
