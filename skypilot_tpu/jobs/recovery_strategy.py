@@ -58,12 +58,18 @@ class StrategyExecutor:
                retry_until_up: bool = False) -> Optional[int]:
         """Provision + submit; returns the cluster job id, or None if
         provisioning kept failing."""
+        from skypilot_tpu.jobs import scheduler
         for attempt in range(max_retries):
             try:
-                job_id, _ = execution.launch(
-                    task, cluster_name, detach_run=True,
-                    quiet_optimizer=True,
-                    retry_until_up=retry_until_up)
+                # Bounded by the controller-wide launch budget: a
+                # zone-wide preemption wakes every controller at
+                # once; their relaunches must queue, not stampede
+                # (reference sky/jobs/scheduler.py:257-270).
+                with scheduler.launch_slot():
+                    job_id, _ = execution.launch(
+                        task, cluster_name, detach_run=True,
+                        quiet_optimizer=True,
+                        retry_until_up=retry_until_up)
                 return job_id
             except exceptions.ResourcesUnavailableError as e:
                 if e.no_failover:
@@ -71,7 +77,10 @@ class StrategyExecutor:
                 logger.warning(
                     'Launch attempt %d/%d failed: %s', attempt + 1,
                     max_retries, e)
-                time.sleep(RETRY_GAP_SECONDS)
+                # Exponential backoff: repeated failures usually mean
+                # capacity is gone everywhere; hammering faster does
+                # not bring it back.
+                time.sleep(RETRY_GAP_SECONDS * (2 ** attempt))
             except (exceptions.CommandError, OSError) as e:
                 # Cluster died mid-launch (e.g. spot preemption while
                 # the job submit was in flight): reconcile the state
@@ -85,7 +94,7 @@ class StrategyExecutor:
                     core_lib.status([cluster_name], refresh=True)
                 except exceptions.SkyTpuError:
                     pass
-                time.sleep(RETRY_GAP_SECONDS)
+                time.sleep(RETRY_GAP_SECONDS * (2 ** attempt))
         return None
 
     def terminate_cluster(self, cluster_name: str) -> None:

@@ -4,9 +4,16 @@
 Limits concurrent controller processes by machine size, the same
 heuristics as the reference: launches ≈ 4×CPU
 (``_get_launch_parallelism:265``), running jobs ≈ memory/350MB
-(``_get_job_parallelism:257``).
+(``_get_job_parallelism:257``). ``launch_slot`` bounds concurrent
+cluster launches/recoveries across all controller processes
+(reference throttles launches the same way, ``:257-270`` — an
+unbounded recovery storm after a zone-wide preemption would hammer
+the cloud API and the controller VM).
 """
+import contextlib
 import os
+import time
+
 
 from skypilot_tpu.jobs import state as jobs_state
 
@@ -27,7 +34,41 @@ def _memory_gb() -> float:
 
 
 def get_launch_parallelism() -> int:
+    override = os.environ.get('SKYTPU_LAUNCH_PARALLELISM')
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            pass
     return max(4, 4 * _cpu_count())
+
+
+@contextlib.contextmanager
+def launch_slot(poll_seconds: float = 0.2):
+    """Hold one of ``get_launch_parallelism()`` cross-process launch
+    slots for the duration of a cluster launch/recovery attempt.
+    Slots are OS filelocks in the state dir, so every controller
+    process on the machine shares the same budget."""
+    import filelock
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    slot_dir = os.path.join(state_dir, '.launch_slots')
+    os.makedirs(slot_dir, exist_ok=True)
+    n = get_launch_parallelism()
+    while True:
+        for i in range(n):
+            lock = filelock.FileLock(
+                os.path.join(slot_dir, f'slot-{i}.lock'))
+            try:
+                lock.acquire(timeout=0)
+            except filelock.Timeout:
+                continue
+            try:
+                yield
+                return
+            finally:
+                lock.release()
+        time.sleep(poll_seconds)
 
 
 def get_job_parallelism() -> int:

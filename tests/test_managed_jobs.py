@@ -200,6 +200,38 @@ class TestAdmissionControl:
             assert final == jobs_state.ManagedJobStatus.SUCCEEDED, (
                 j, jobs_state.get_job(j))
 
+    def test_launch_slots_bound_concurrency(self, monkeypatch,
+                                            tmp_path):
+        """Simultaneous launches/recoveries must serialize to the
+        launch-parallelism limit (reference throttles launches,
+        sky/jobs/scheduler.py:257-270)."""
+        import threading
+        from skypilot_tpu.jobs import scheduler
+        monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+        monkeypatch.setenv('SKYTPU_LAUNCH_PARALLELISM', '2')
+        assert scheduler.get_launch_parallelism() == 2
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        def worker():
+            with scheduler.launch_slot(poll_seconds=0.01):
+                with lock:
+                    active.append(1)
+                    peak.append(len(active))
+                time.sleep(0.2)
+                with lock:
+                    active.pop()
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(peak) == 6          # every launch eventually ran
+        assert max(peak) <= 2, peak    # never more than the limit
+
     def test_cancel_pending_job_is_terminal(self, monkeypatch,
                                             cleanup_clusters):
         """Cancelling a still-PENDING managed job (no controller yet)
