@@ -310,6 +310,35 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def launch_main() -> None:
+    """BENCH_MODE=launch: `launch` time-to-first-step on the local
+    fake cloud (the un-measured half of BASELINE.json's north star —
+    the reference publishes no number, BASELINE.md:32; this records
+    the framework-overhead floor: optimize + provision + runtime
+    bring-up + submit + schedule, everything but the cloud API's
+    VM-creation latency)."""
+    import tempfile
+    state_dir = tempfile.mkdtemp(prefix='skytpu-ttfs-')
+    os.environ['SKYTPU_STATE_DIR'] = state_dir
+    from skypilot_tpu.benchmark import benchmark_utils
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task(name='ttfs', run='echo first-step')
+    res = Resources(cloud='local')
+    res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+    task.set_resources(res)
+    breakdown = benchmark_utils.measure_time_to_first_step(task)
+    print(json.dumps({
+        'metric': 'launch_time_to_first_step_seconds',
+        'value': round(breakdown['time_to_first_step'], 3),
+        'unit': 's',
+        # No published reference number exists (BASELINE.md:32);
+        # this run seeds the baseline.
+        'vs_baseline': 1.0,
+        'detail': {k: round(v, 3) for k, v in breakdown.items()},
+    }))
+
+
 if __name__ == '__main__':
     try:
         mode = os.environ.get('BENCH_MODE', 'train')
@@ -317,6 +346,8 @@ if __name__ == '__main__':
             serve_main()
         elif mode == 'serve_batch':
             serve_batch_main()
+        elif mode == 'launch':
+            launch_main()
         else:
             main()
     except Exception as e:  # pylint: disable=broad-except

@@ -15,14 +15,27 @@ import importlib
 from typing import TYPE_CHECKING
 
 from skypilot_tpu import exceptions
-from skypilot_tpu.dag import Dag
-from skypilot_tpu.optimizer import Optimizer, OptimizeTarget, optimize
-from skypilot_tpu.resources import Resources
-from skypilot_tpu.task import Task
+
+if TYPE_CHECKING:
+    from skypilot_tpu.dag import Dag
+    from skypilot_tpu.optimizer import (Optimizer, OptimizeTarget,
+                                        optimize)
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
 
 __version__ = '0.1.0'
 
 _LAZY_ATTRS = {
+    # Spec surface — lazy too: the on-cluster control snippets
+    # (runtime/codegen.py) import skypilot_tpu.runtime.job_lib on
+    # every RPC, and an eager Task/Resources here would make each of
+    # them pay the catalog/pandas import (~0.5 s per agent /exec).
+    'Dag': ('skypilot_tpu.dag', 'Dag'),
+    'Optimizer': ('skypilot_tpu.optimizer', 'Optimizer'),
+    'OptimizeTarget': ('skypilot_tpu.optimizer', 'OptimizeTarget'),
+    'optimize': ('skypilot_tpu.optimizer', 'optimize'),
+    'Resources': ('skypilot_tpu.resources', 'Resources'),
+    'Task': ('skypilot_tpu.task', 'Task'),
     # execution pipeline
     'launch': ('skypilot_tpu.execution', 'launch'),
     'exec': ('skypilot_tpu.execution', 'exec_'),
@@ -68,12 +81,4 @@ def __getattr__(name: str):
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 
 
-__all__ = [
-    'Dag',
-    'Optimizer',
-    'OptimizeTarget',
-    'Resources',
-    'Task',
-    'exceptions',
-    'optimize',
-] + list(_LAZY_ATTRS)
+__all__ = ['exceptions'] + list(_LAZY_ATTRS)

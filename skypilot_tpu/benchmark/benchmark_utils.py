@@ -113,6 +113,60 @@ def launch_benchmark(task: Task, candidates: List[Resources],
     return results
 
 
+def measure_time_to_first_step(task: Task,
+                               cluster_name: str = 'ttfs-bench',
+                               timeout: float = 300.0,
+                               teardown: bool = True
+                               ) -> Dict[str, float]:
+    """Measure `launch` time-to-first-step: wall clock from calling
+    ``execution.launch`` until the submitted job is RUNNING (user
+    code executing on the cluster), with the per-stage breakdown
+    (optimize / provision / sync / submit) from
+    ``execution.get_last_launch_timing``.
+
+    This is the second half of BASELINE.json's north-star metric;
+    the reference never aggregates it — its stages are only
+    bracketed by timeline spans
+    (``sky/provision/provisioner.py:394-631``).
+    """
+    import time as time_lib
+    t0 = time_lib.monotonic()
+    job_id, _ = execution.launch(task, cluster_name,
+                                 detach_run=True,
+                                 quiet_optimizer=True)
+    breakdown = execution.get_last_launch_timing()
+    deadline = time_lib.monotonic() + timeout
+    try:
+        while time_lib.monotonic() < deadline:
+            status = core_lib.job_status(cluster_name, job_id)
+            # RUNNING (or already SUCCEEDED, for a job faster than
+            # our poll) means user code ran. Any other terminal
+            # state means it never did — a timing that "measured"
+            # a setup/driver failure must not seed the baseline.
+            if status in (job_lib.JobStatus.RUNNING,
+                          job_lib.JobStatus.SUCCEEDED):
+                break
+            if status is not None and status.is_terminal():
+                raise exceptions.SkyTpuError(
+                    f'bench job ended {status.value} before user '
+                    'code ran; no time-to-first-step measured.')
+            time_lib.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f'job {job_id} not RUNNING after {timeout}s')
+        breakdown['time_to_first_step'] = \
+            time_lib.monotonic() - t0
+        breakdown['to_running'] = \
+            breakdown['time_to_first_step'] - breakdown['total']
+        return breakdown
+    finally:
+        if teardown:
+            try:
+                core_lib.down(cluster_name, purge=True)
+            except exceptions.SkyTpuError:
+                pass
+
+
 def format_results(results: List[BenchmarkResult]) -> str:
     from skypilot_tpu.utils import ux_utils
     table = ux_utils.Table(['CANDIDATE', 'STATUS', 'STEPS',

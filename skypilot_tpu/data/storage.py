@@ -24,8 +24,25 @@ class StoreType(enum.Enum):
 
     @classmethod
     def from_url(cls, url: str) -> 'StoreType':
+        """GCS only — a deliberate support-matrix choice, not an
+        omission: TPUs are GCP-only hardware, so the data plane is
+        GCS-native (reference supports 6 stores,
+        ``sky/data/storage.py:114``; see README data-layer matrix).
+        Unsupported schemes get an actionable error."""
         if url.startswith('gs://'):
             return cls.GCS
+        other = {'s3://': 'Amazon S3', 'r2://': 'Cloudflare R2',
+                 'cos://': 'IBM COS', 'oci://': 'Oracle OCI',
+                 'azure://': 'Azure Blob', 'https://': 'Azure Blob'}
+        for prefix, label in other.items():
+            if url.startswith(prefix):
+                raise exceptions.StorageSourceError(
+                    f'{label} URLs are not supported: this framework '
+                    'is TPU-native and its data layer is GCS-only '
+                    f'(TPUs only exist on GCP). Transfer {url!r} to '
+                    'a GCS bucket first — `gsutil -m rsync -r '
+                    f'{url} gs://<bucket>` or GCP Storage Transfer '
+                    'Service — then mount gs://<bucket>.')
         raise exceptions.StorageSourceError(
             f'Unsupported store URL {url!r} (gs:// only — this '
             'framework is GCS-first).')

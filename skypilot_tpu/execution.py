@@ -31,6 +31,23 @@ class Stage(enum.Enum):
     DOWN = enum.auto()
 
 
+# Breakdown of this THREAD's most recent completed launch, seconds
+# per stage (optimize/provision/sync_workdir/file_mounts/submit/
+# total). Thread-local: launch_benchmark runs launches concurrently
+# from worker threads, and a process-global would interleave their
+# breakdowns. This is the instrumented half of the BASELINE.json
+# north star — "`sky launch` time-to-first-step" (the reference only
+# brackets the stages with timeline spans,
+# sky/provision/provisioner.py:394-631).
+import threading as _threading
+
+_launch_timing_tls = _threading.local()
+
+
+def get_last_launch_timing() -> dict:
+    return dict(getattr(_launch_timing_tls, 'timing', {}))
+
+
 def _execute(task: Task, *, cluster_name: str,
              stages: Optional[List[Stage]] = None,
              dryrun: bool = False,
@@ -44,6 +61,32 @@ def _execute(task: Task, *, cluster_name: str,
     stages = stages or list(Stage)
     backend = TpuBackend()
     common_utils.check_cluster_name_is_valid(cluster_name)
+    import time as time_lib
+    from skypilot_tpu.utils import timeline
+    timing: dict = {}
+    # A failed launch must not leave the previous launch's numbers
+    # readable as if they were this one's.
+    _launch_timing_tls.timing = timing
+    t_start = time_lib.monotonic()
+
+    class _Timed:
+        """Wall-clock one launch stage into the breakdown (and the
+        Chrome trace when SKYTPU_DEBUG=1)."""
+
+        def __init__(self, key: str):
+            self.key = key
+            self._span = timeline.Event(f'launch.{key}')
+
+        def __enter__(self):
+            self._t0 = time_lib.monotonic()
+            self._span.__enter__()
+            return self
+
+        def __exit__(self, *exc):
+            self._span.__exit__(*exc)
+            timing[self.key] = timing.get(self.key, 0.0) + \
+                time_lib.monotonic() - self._t0
+            return False
 
     # Org integration point: the configured admin policy may mutate or
     # reject the request (reference sky/admin_policy.py:101, applied
@@ -73,20 +116,23 @@ def _execute(task: Task, *, cluster_name: str,
             # optimize for existing clusters).
             to_provision = existing['handle'].launched_resources
         else:
-            with Dag() as dag:
-                dag.add(task)
-            optimizer.optimize(dag, optimize_target,
-                               quiet=quiet_optimizer)
-            to_provision = task.best_resources  # type: ignore[attr-defined]
+            with _Timed('optimize'):
+                with Dag() as dag:
+                    dag.add(task)
+                optimizer.optimize(dag, optimize_target,
+                                   quiet=quiet_optimizer)
+                to_provision = task.best_resources  # type: ignore[attr-defined]
     if to_provision is None:
         to_provision = next(iter(task.resources))
 
     handle = None
     if Stage.PROVISION in stages:
-        handle = backend.provision(task, to_provision, dryrun=dryrun,
-                                   stream_logs=stream_logs,
-                                   cluster_name=cluster_name,
-                                   retry_until_up=retry_until_up)
+        with _Timed('provision'):
+            handle = backend.provision(task, to_provision,
+                                       dryrun=dryrun,
+                                       stream_logs=stream_logs,
+                                       cluster_name=cluster_name,
+                                       retry_until_up=retry_until_up)
     else:
         record = state.get_cluster_from_name(cluster_name)
         assert record is not None, cluster_name
@@ -97,24 +143,28 @@ def _execute(task: Task, *, cluster_name: str,
     assert handle is not None
 
     if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-        backend.sync_workdir(handle, task.workdir)
+        with _Timed('sync_workdir'):
+            backend.sync_workdir(handle, task.workdir)
 
     if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
                                              task.storage_mounts):
-        if task.storage_mounts:
-            # Client side: ensure buckets exist, upload sources.
-            task.sync_storage_mounts()
-        # Cluster side: rsync file mounts, run mount scripts on every
-        # host (reference: cloud_vm_ray_backend.py:3138 sync stage +
-        # mounting_utils.py:265 mount script).
-        backend.sync_file_mounts(handle, task.file_mounts,
-                                 task.storage_mounts)
+        with _Timed('file_mounts'):
+            if task.storage_mounts:
+                # Client side: ensure buckets exist, upload sources.
+                task.sync_storage_mounts()
+            # Cluster side: rsync file mounts, run mount scripts on
+            # every host (reference: cloud_vm_ray_backend.py:3138
+            # sync stage + mounting_utils.py:265 mount script).
+            backend.sync_file_mounts(handle, task.file_mounts,
+                                     task.storage_mounts)
 
     job_id = None
     if Stage.EXEC in stages:
         include_setup = Stage.SETUP in stages
-        job_id = backend.execute(handle, task, detach_run=detach_run,
-                                 include_setup=include_setup)
+        with _Timed('submit'):
+            job_id = backend.execute(handle, task,
+                                     detach_run=detach_run,
+                                     include_setup=include_setup)
 
     # `--down` without an idle threshold means "tear down once the
     # job is done": expressed as autostop(idle=0, down=True) so it is
@@ -124,6 +174,11 @@ def _execute(task: Task, *, cluster_name: str,
         idle_minutes_to_autostop = 0
     if idle_minutes_to_autostop is not None:
         backend.set_autostop(handle, idle_minutes_to_autostop, down)
+    timing['total'] = time_lib.monotonic() - t_start
+    if job_id is not None:
+        logger.info(
+            'Launch timing (s): %s',
+            ', '.join(f'{k}={v:.2f}' for k, v in timing.items()))
     return job_id, handle
 
 

@@ -12,10 +12,28 @@ import shlex
 from typing import Any, Dict, List, Optional
 
 
+# Control snippets never touch the TPU, but the container's
+# sitecustomize imports jax (~1.7s) into EVERY python process when
+# PALLAS_AXON_POOL_IPS is set. Stash the var across interpreter
+# startup and restore it first thing, so child processes the snippet
+# spawns (the job driver -> user code) still see the TPU env while
+# the snippet itself skips the jax import.
+_ENV_PRELUDE = '''\
+import os
+_stash = os.environ.pop('SKYTPU_AXON_STASH', '')
+if _stash:
+    os.environ['PALLAS_AXON_POOL_IPS'] = _stash
+else:
+    os.environ.pop('PALLAS_AXON_POOL_IPS', None)
+'''
+
+
 def _wrap(runtime_dir: str, body: str) -> str:
     """Run a python snippet with the head's runtime dir exported."""
-    return (f'SKYTPU_RUNTIME_DIR={shlex.quote(runtime_dir)} '
-            f'python3 -c {shlex.quote(body)}')
+    return (f'SKYTPU_AXON_STASH="${{PALLAS_AXON_POOL_IPS:-}}" '
+            f'PALLAS_AXON_POOL_IPS= '
+            f'SKYTPU_RUNTIME_DIR={shlex.quote(runtime_dir)} '
+            f'python3 -c {shlex.quote(_ENV_PRELUDE + body)}')
 
 
 def add_and_schedule_job(runtime_dir: str, job_name: str,
