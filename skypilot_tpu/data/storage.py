@@ -33,7 +33,10 @@ class StoreType(enum.Enum):
             return cls.GCS
         other = {'s3://': 'Amazon S3', 'r2://': 'Cloudflare R2',
                  'cos://': 'IBM COS', 'oci://': 'Oracle OCI',
-                 'azure://': 'Azure Blob', 'https://': 'Azure Blob'}
+                 'azure://': 'Azure Blob'}
+        if url.startswith('https://') and \
+                '.blob.core.windows.net' in url:
+            other['https://'] = 'Azure Blob'
         for prefix, label in other.items():
             if url.startswith(prefix):
                 raise exceptions.StorageSourceError(

@@ -39,6 +39,10 @@ def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
     wf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     s = jnp.maximum(amax, 1e-8) / 127.0
+    # Quantize against the bf16-rounded scale that will actually be
+    # stored, so q*s reconstructs exactly (codes computed against the
+    # f32 scale carry a ~0.2% systematic per-channel mismatch).
+    s = s.astype(jnp.bfloat16).astype(jnp.float32)
     q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
     return {'q': q, 's': s.astype(jnp.bfloat16)}
 

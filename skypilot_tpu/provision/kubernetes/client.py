@@ -82,7 +82,58 @@ def _load_kubeconfig() -> Tuple[str, Dict[str, str], Optional[ssl.SSLContext]]:
                 os.unlink(keyf.name)
     if 'token' in user:
         headers['Authorization'] = f'Bearer {user["token"]}'
+    elif 'exec' in user:
+        # Exec credential plugin (client.authentication.k8s.io) —
+        # GKE's default auth since 1.26 (gke-gcloud-auth-plugin): run
+        # the plugin and read status.token from its ExecCredential
+        # JSON output.
+        headers['Authorization'] = \
+            f'Bearer {_exec_credential_token(user["exec"])}'
+    elif 'client-certificate' not in user and \
+            'client-certificate-data' not in user:
+        # No token, no cert, no plugin: requests would go out
+        # unauthenticated and surface as confusing 401s — fail with
+        # the fix instead.
+        raise exceptions.InvalidCloudConfigError(
+            f'Kubeconfig user {ctx["user"]!r} has no bearer token, '
+            'client certificate, or exec credential plugin. '
+            'Provide one (e.g. `gcloud container clusters '
+            'get-credentials` for GKE), or set SKYTPU_KUBE_API + '
+            'SKYTPU_KUBE_TOKEN.')
     return server, headers, ssl_ctx
+
+
+def _exec_credential_token(exec_cfg: Dict[str, Any]) -> str:
+    """Run a kubeconfig ``user.exec`` plugin and return
+    ``status.token`` (client.authentication.k8s.io ExecCredential
+    contract)."""
+    import json
+    import subprocess
+    cmd = [exec_cfg['command']] + list(exec_cfg.get('args') or [])
+    env = dict(os.environ)
+    for item in exec_cfg.get('env') or []:
+        env[item['name']] = item['value']
+    env.setdefault('KUBERNETES_EXEC_INFO', json.dumps({
+        'apiVersion': exec_cfg.get(
+            'apiVersion', 'client.authentication.k8s.io/v1beta1'),
+        'kind': 'ExecCredential',
+        'spec': {'interactive': False},
+    }))
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=60, check=True)
+    except (OSError, subprocess.SubprocessError) as e:
+        raise exceptions.InvalidCloudConfigError(
+            f'Kubeconfig exec credential plugin {cmd[0]!r} failed: '
+            f'{e}. Install it (GKE: gke-gcloud-auth-plugin) or use '
+            'a static token.') from e
+    try:
+        cred = json.loads(out.stdout)
+        return cred['status']['token']
+    except (ValueError, KeyError) as e:
+        raise exceptions.InvalidCloudConfigError(
+            f'Exec credential plugin {cmd[0]!r} returned no '
+            f'status.token: {out.stdout[:200]!r}') from e
 
 
 class KubeClient:

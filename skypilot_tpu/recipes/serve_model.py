@@ -128,6 +128,12 @@ def main():
             # max_new itself and retires rows at eos_id).
             return engine.generate(prompt_ids, max_new,
                                    eos_id=eos_id)
+        # HBM headroom requirement: this serial path allocates a
+        # fresh [L, 1, S]-per-KV cache ON TOP of the engine's
+        # resident [L, slots, S] cache, so with --slots the chip must
+        # be sized to hold (slots + 1) cache rows — size --slots to
+        # leave one row's worth of HBM free, or a single temperature
+        # request can OOM a chip that exactly fits the engine.
         return _generate_serial(prompt_ids, max_new,
                                 temperature=temperature, top_p=top_p,
                                 seed=seed, eos_id=eos_id)

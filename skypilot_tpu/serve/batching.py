@@ -254,6 +254,12 @@ class BatchingEngine:
             return req.out
         self.pending.put(req)
         self.wake.set()
+        # close() may have stopped the loop between the _stop check
+        # above and the put — the exited loop will never drain this
+        # request, so sentinel it here (a double None from racing
+        # _drain_all is harmless: consumers stop at the first).
+        if self._stop:
+            req.out.put(None)
         return req.out
 
     def generate(self, prompt_ids: List[int], max_new: int,
@@ -310,6 +316,11 @@ class BatchingEngine:
         """Fail-stop: unblock every waiter — a silently dead loop
         thread would hang all current AND future requests forever."""
         logger.error('Batching engine died: %r', exc)
+        self._drain_all()
+
+    def _drain_all(self) -> None:
+        """Put the None sentinel on every active slot queue and every
+        still-pending request so no waiter blocks past loop exit."""
         self._stop = True
         for i, req in enumerate(self.slot_req):
             if req is not None:
@@ -324,6 +335,11 @@ class BatchingEngine:
     def _loop(self) -> None:
         try:
             self._loop_inner()
+            # Normal exit (close() while requests are in flight):
+            # drain exactly like the failure path, or blocked
+            # generate()/submit() waiters hang forever on queues that
+            # will never see their None sentinel.
+            self._drain_all()
         except BaseException as e:  # pylint: disable=broad-except
             self._fail_all(e)
 
