@@ -33,27 +33,71 @@ _NEG_INF = -1e30
 @dataclasses.dataclass
 class KVCache:
     """Functional KV cache. k/v: [L, B, max_seq, Hkv, hd] (compute
-    dtype); ``pos`` — number of positions already written (same for
-    every sequence in the batch; ragged batches left-pad)."""
+    dtype, or int8 with per-(position, head) ``k_scale``/``v_scale``
+    [L, B, max_seq, Hkv] when quantized); ``pos`` — number of
+    positions already written (same for every sequence in the batch;
+    ragged batches left-pad).
+
+    int8 KV (``init_cache(kv_int8=True)``) halves the cache's HBM
+    traffic — decode TPOT is cache-bandwidth-bound at long context,
+    so this is the serving bandwidth lever (JetStream ships the same
+    int8-KV option)."""
     k: jax.Array
     v: jax.Array
     pos: jax.Array  # scalar int32
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 jax.tree_util.register_pytree_node(
     KVCache,
-    lambda c: ((c.k, c.v, c.pos), None),
+    lambda c: ((c.k, c.v, c.pos, c.k_scale, c.v_scale), None),
     lambda _, leaves: KVCache(*leaves))
 
 
 def init_cache(config: llama.LlamaConfig, batch: int,
-               max_seq: Optional[int] = None) -> KVCache:
+               max_seq: Optional[int] = None,
+               kv_int8: bool = False) -> KVCache:
     max_seq = max_seq or config.max_seq_len
     shape = (config.n_layers, batch, max_seq, config.n_kv_heads,
              config.head_dim)
+    if kv_int8:
+        return KVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            pos=jnp.zeros((), jnp.int32),
+            k_scale=jnp.zeros(shape[:-1], jnp.bfloat16),
+            v_scale=jnp.zeros(shape[:-1], jnp.bfloat16))
     return KVCache(k=jnp.zeros(shape, config.dtype),
                    v=jnp.zeros(shape, config.dtype),
                    pos=jnp.zeros((), jnp.int32))
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-(batch, position, head) symmetric int8: x [B, T, Hkv, hd]
+    -> (codes int8, scales bf16 [B, T, Hkv]). The scale is
+    bf16-rounded BEFORE encoding so codes reconstruct against the
+    stored scale (same rule as models/quant.py)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    s = s.astype(jnp.bfloat16).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127,
+                 127).astype(jnp.int8)
+    return q, s.astype(jnp.bfloat16)
+
+
+def _dequant_kv(q: jax.Array, scale: Optional[jax.Array],
+                dtype) -> jax.Array:
+    """Lazy dequant right before attention — XLA fuses the multiply
+    into the consumer, so HBM reads stay int8-sized."""
+    if scale is None:
+        return q
+    return q.astype(dtype) * scale[..., None].astype(dtype)
 
 
 def _masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -82,12 +126,15 @@ def _masked_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
                   layer_params: Params, k_cache: jax.Array,
                   v_cache: jax.Array, pos: jax.Array,
-                  angles: jax.Array
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                  angles: jax.Array, prefill: bool = False,
+                  k_scale: Optional[jax.Array] = None,
+                  v_scale: Optional[jax.Array] = None):
     """One transformer layer over ``T`` new positions with cache
-    append. x: [B, T, D]; k_cache/v_cache: [B, S, Hkv, hd]. Returns
-    (y, new_k_cache, new_v_cache). Weight math mirrors ``_layer``
-    (models/llama.py) minus LoRA (serving uses merged weights —
+    append. x: [B, T, D]; k_cache/v_cache: [B, S, Hkv, hd] (int8 with
+    ``k_scale``/``v_scale`` [B, S, Hkv] when the cache is
+    quantized). Returns (y, new_k_cache, new_v_cache, new_k_scale,
+    new_v_scale). Weight math mirrors ``_layer`` (models/llama.py)
+    minus LoRA (serving uses merged weights —
     ``parallel/lora.merge_lora``)."""
     b, t, _ = x.shape
     nh, nkv, hd = (config.n_heads, config.n_kv_heads, config.head_dim)
@@ -108,11 +155,46 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
     q = attention_ops.apply_rope(q, angles)
     k = attention_ops.apply_rope(k, angles)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    if k_scale is not None:
+        k8, ks = _quantize_kv(k)
+        v8, vs = _quantize_kv(v)
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k8,
+                                               (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v8,
+                                               (0, pos, 0, 0))
+        k_scale = jax.lax.dynamic_update_slice(k_scale, ks,
+                                               (0, pos, 0))
+        v_scale = jax.lax.dynamic_update_slice(v_scale, vs,
+                                               (0, pos, 0))
+    else:
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k,
+                                               (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v,
+                                               (0, pos, 0, 0))
 
-    attn = _masked_attention(q, k_cache, v_cache, q_pos=pos,
-                             kv_len=pos + t, scale=hd ** -0.5)
+    if t == 1 or not prefill:
+        kd = _dequant_kv(k_cache, k_scale, k.dtype)
+        vd = _dequant_kv(v_cache, v_scale, v.dtype)
+    if t == 1:
+        # Decode step: length-aware attention over the valid cache
+        # prefix (Pallas when opted in, dense masked otherwise).
+        from skypilot_tpu.ops import decode_attention as da
+        lengths = jnp.full((b,), 0, jnp.int32) + (pos + 1)
+        attn = da.decode_attention(q[:, 0], kd, vd,
+                                   lengths, hd ** -0.5)[:, None]
+    elif prefill:
+        # Prefill at pos=0: the cache holds exactly this chunk, so
+        # causal flash over the LOCAL q/k/v is the whole attention —
+        # O(T) memory vs the dense mask's [B, H, T, max_seq] f32
+        # logits (38 GB at T=4k, B=16, S=4.6k). The cache write
+        # above may quantize; attention here reads the exact bf16
+        # chunk (quantization error only enters later decode steps).
+        from skypilot_tpu.ops import attention as attention_ops
+        attn = attention_ops.flash_attention(q, k, v, causal=True,
+                                             scale=hd ** -0.5)
+    else:
+        attn = _masked_attention(q, kd, vd, q_pos=pos,
+                                 kv_len=pos + t, scale=hd ** -0.5)
     x = x + _mm(attn.reshape(b, t, nh * hd), layer_params['wo'])
 
     h = llama._rms_norm(x, layer_params['mlp_norm'],
@@ -126,12 +208,13 @@ def _layer_cached(config: llama.LlamaConfig, x: jax.Array,
         ).astype(h.dtype)
         up = _mm(h, layer_params['w_up'])
         x = x + _mm(gate * up, layer_params['w_down'])
-    return x, k_cache, v_cache
+    return x, k_cache, v_cache, k_scale, v_scale
 
 
 def forward_cached(params: Params, tokens: jax.Array,
                    cache: KVCache, config: llama.LlamaConfig,
-                   last_only: bool = False
+                   last_only: bool = False,
+                   prefill: bool = False
                    ) -> Tuple[jax.Array, KVCache]:
     """Run ``tokens`` [B, T] at absolute positions
     [cache.pos, cache.pos + T) and append to the cache. Returns
@@ -142,7 +225,12 @@ def forward_cached(params: Params, tokens: jax.Array,
     ``last_only`` (static): project only the final position through
     the LM head — prefill feeding greedy decode needs just
     logits[:, -1], and skipping the rest avoids materializing a
-    [B, T, 128k-vocab] f32 tensor (4.2 GB at B=8, T=1024)."""
+    [B, T, 128k-vocab] f32 tensor (4.2 GB at B=8, T=1024).
+
+    ``prefill`` (static): promise that ``cache.pos == 0`` — long
+    chunks then run causal FLASH attention over the local q/k/v
+    instead of the dense mask over the whole cache (O(T) memory).
+    Callers feeding a prompt into a fresh cache should set it."""
     # int8 leaves (weight-only quantization, models/quant.py) must NOT
     # be upcast here — they cross HBM as int8 and convert in-register
     # inside the matmuls.
@@ -160,13 +248,16 @@ def forward_cached(params: Params, tokens: jax.Array,
 
     def body(carry, scanned):
         xc, pos = carry
-        layer_params, kc, vc = scanned
-        y, kc, vc = _layer_cached(config, xc, layer_params, kc, vc,
-                                  pos, angles)
-        return (y, pos), (kc, vc)
+        layer_params, kc, vc, ks, vs = scanned
+        y, kc, vc, ks, vs = _layer_cached(
+            config, xc, layer_params, kc, vc, pos, angles,
+            prefill=prefill, k_scale=ks, v_scale=vs)
+        return (y, pos), (kc, vc, ks, vs)
 
-    (x, _), (new_k, new_v) = jax.lax.scan(
-        body, (x, cache.pos), (cparams['layers'], cache.k, cache.v))
+    (x, _), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        body, (x, cache.pos),
+        (cparams['layers'], cache.k, cache.v, cache.k_scale,
+         cache.v_scale))
     if last_only:
         x = x[:, -1:]
     x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
@@ -177,11 +268,13 @@ def forward_cached(params: Params, tokens: jax.Array,
     else:
         # _mm absorbs the quantized-vs-plain distinction.
         logits = _mm(x, cparams['lm_head']).astype(jnp.float32)
-    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t)
+    return logits, KVCache(k=new_k, v=new_v, pos=cache.pos + t,
+                           k_scale=new_ks, v_scale=new_vs)
 
 
 def decode_shardings(config: llama.LlamaConfig, mesh,
-                     shard_batch: bool = True):
+                     shard_batch: bool = True,
+                     kv_int8: bool = False):
     """(param_shardings, cache_shardings) for sharded serving on a
     mesh — models too big for one chip decode tensor-parallel: params
     follow ``llama.param_sharding_rules`` (heads/ffn over 'tp',
@@ -201,8 +294,11 @@ def decode_shardings(config: llama.LlamaConfig, mesh,
     batch_axes = ('dp', 'fsdp', 'ep') if shard_batch else None
     kv_spec = NamedSharding(mesh, P(None, batch_axes, None, 'tp',
                                     None))
+    scale_spec = NamedSharding(mesh, P(None, batch_axes, None,
+                                       'tp')) if kv_int8 else None
     cache_sh = KVCache(k=kv_spec, v=kv_spec,
-                       pos=NamedSharding(mesh, P()))
+                       pos=NamedSharding(mesh, P()),
+                       k_scale=scale_spec, v_scale=scale_spec)
     return param_sh, cache_sh
 
 
@@ -302,7 +398,8 @@ def sample_generate(params: Params, prompt: jax.Array,
                     top_k: int = 0,
                     top_p: Optional[float] = None,
                     max_seq: Optional[int] = None,
-                    cache_sharding: Optional[KVCache] = None
+                    cache_sharding: Optional[KVCache] = None,
+                    kv_int8: bool = False
                     ) -> jax.Array:
     """Sampled generation: prefill once, then one scan dispatch.
     temperature/top_p are passed as arrays so distinct request values
@@ -314,7 +411,7 @@ def sample_generate(params: Params, prompt: jax.Array,
                                             max_seq)
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), jnp.int32)
-    cache = init_cache(config, b, max_seq)
+    cache = init_cache(config, b, max_seq, kv_int8=kv_int8)
     if cache_sharding is not None:
         cache = jax.device_put(cache, cache_sharding)
     temp = jnp.asarray(temperature, jnp.float32)
@@ -323,9 +420,9 @@ def sample_generate(params: Params, prompt: jax.Array,
     # mathematical no-op.
     p = None if top_p is None else jnp.asarray(top_p, jnp.float32)
 
-    step = jax.jit(forward_cached, static_argnums=(3, 4),
+    step = jax.jit(forward_cached, static_argnums=(3, 4, 5),
                    donate_argnums=(2,))
-    logits, cache = step(params, prompt, cache, config, True)
+    logits, cache = step(params, prompt, cache, config, True, True)
     key, sub = jax.random.split(key)
     nxt = sample_token(logits[:, -1], sub, temp, top_k=top_k, top_p=p)
     if max_new_tokens == 1:
@@ -341,7 +438,8 @@ def greedy_generate(params: Params, prompt: jax.Array,
                     config: llama.LlamaConfig, max_new_tokens: int,
                     max_seq: Optional[int] = None,
                     eos_id: Optional[int] = None,
-                    cache_sharding: Optional[KVCache] = None
+                    cache_sharding: Optional[KVCache] = None,
+                    kv_int8: bool = False
                     ) -> jax.Array:
     """Greedy decode: prefill the prompt once, then one cached step
     per token. prompt: [B, T0] -> [B, <=max_new_tokens] generated ids
@@ -360,14 +458,14 @@ def greedy_generate(params: Params, prompt: jax.Array,
                                             max_seq)
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), jnp.int32)
-    cache = init_cache(config, b, max_seq)
+    cache = init_cache(config, b, max_seq, kv_int8=kv_int8)
     if cache_sharding is not None:
         cache = jax.device_put(cache, cache_sharding)
 
-    step = jax.jit(forward_cached, static_argnums=(3, 4),
+    step = jax.jit(forward_cached, static_argnums=(3, 4, 5),
                    donate_argnums=(2,))
 
-    logits, cache = step(params, prompt, cache, config, True)
+    logits, cache = step(params, prompt, cache, config, True, True)
     nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
     if eos_id is None:
         # No early exit wanted: run the whole generation as one

@@ -30,6 +30,11 @@ def main():
                         default='none',
                         help='weight-only quantization (halves '
                              'decode weight bandwidth)')
+    parser.add_argument('--kv-int8', action='store_true',
+                        help='int8 KV cache for the batching engine '
+                             '(halves decode HBM traffic; measured '
+                             'TPOT 24.8->16.6 ms at S=4.6k b=16 on '
+                             'v5e)')
     parser.add_argument('--slots', type=int, default=0,
                         help='enable continuous batching with this '
                              'many concurrent decode slots (greedy '
@@ -117,7 +122,8 @@ def main():
     engine = None
     if args.slots > 0:
         from skypilot_tpu.serve.batching import BatchingEngine
-        engine = BatchingEngine(params, config, slots=args.slots)
+        engine = BatchingEngine(params, config, slots=args.slots,
+                                kv_int8=args.kv_int8)
 
     def generate(prompt_ids, max_new, temperature=None, top_p=None,
                  seed=None, eos_id=None):
@@ -230,8 +236,62 @@ def main():
             except (ValueError, KeyError, TypeError) as e:
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
+            stream = bool(body.get('stream'))
+            if stream and engine is not None and temperature is None \
+                    and top_p is None:
+                # SSE: tokens leave as the engine produces them (per
+                # decode dispatch), so client TTFT is prefill-bound,
+                # not completion-bound. The serve LB passes chunked
+                # bodies through unbuffered (load_balancer.py
+                # _stream_response), end to end.
+                q = engine.submit(prompt_ids, max_new, eos_id=eos_id)
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                self.send_header('Cache-Control', 'no-cache')
+                self.send_header('Transfer-Encoding', 'chunked')
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f'{len(data):x}\r\n'.encode())
+                    self.wfile.write(data + b'\r\n')
+                    self.wfile.flush()
+
+                try:
+                    while True:
+                        tok = q.get()
+                        if tok is None:
+                            chunk(b'data: [DONE]\n\n')
+                            break
+                        chunk(f'data: {tok}\n\n'.encode())
+                    self.wfile.write(b'0\r\n\r\n')
+                    self.wfile.flush()
+                except OSError:
+                    # Client went away mid-stream: drain the queue so
+                    # the engine's row retires normally. Bounded
+                    # get()s — the sentinel may already have been
+                    # consumed above, and a bare get() would then
+                    # block this handler thread forever.
+                    import queue as queue_mod
+                    try:
+                        while q.get(timeout=120) is not None:
+                            pass
+                    except queue_mod.Empty:
+                        pass
+                return
             out = generate(prompt_ids, max_new, temperature=temperature,
                            top_p=top_p, seed=seed, eos_id=eos_id)
+            if stream:
+                # No engine (or sampling): stream-compatible response
+                # with the whole generation as one event burst.
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                payload = b''.join(f'data: {t}\n\n'.encode()
+                                   for t in out) + b'data: [DONE]\n\n'
+                self.send_header('Content-Length',
+                                 str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
             self._json({'output_ids': out})
 
     # Warm every decode variant's compile before declaring readiness

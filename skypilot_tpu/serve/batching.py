@@ -64,39 +64,33 @@ def _attend_rows(q: jax.Array, k: jax.Array, v: jax.Array,
                  pos: jax.Array, scale: float) -> jax.Array:
     """q [B, 1, H, hd]; k/v [B, S, Hkv, hd]; pos [B] = the index the
     current token was just written at. Row b attends keys [0, pos_b].
-    """
-    b, _, h, hd = q.shape
-    s = k.shape[1]
-    hkv = k.shape[2]
-    groups = h // hkv
-    qg = q.reshape(b, 1, hkv, groups, hd)
-    logits = jnp.einsum('bthgd,bshd->bhgts', qg, k,
-                        preferred_element_type=jnp.float32) * scale
-    key_idx = jnp.arange(s)[None, :]
-    mask = key_idx <= pos[:, None]                     # [B, S]
-    logits = jnp.where(mask[:, None, None, None, :], logits,
-                       _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum('bhgts,bshd->bthgd', probs.astype(v.dtype), v)
-    return out.reshape(b, 1, h, hd)
+    On TPU this is the length-aware Pallas kernel
+    (ops/decode_attention.py): HBM reads scale with each row's
+    actual context, not the cache allocation."""
+    from skypilot_tpu.ops import decode_attention as da
+    out = da.decode_attention(q[:, 0], k, v, pos + 1, scale)
+    return out[:, None]
 
 
 def decode_steps_rows(params: Params, tokens: jax.Array,
-                      k_cache: jax.Array, v_cache: jax.Array,
-                      pos: jax.Array, active: jax.Array,
+                      caches, pos: jax.Array, active: jax.Array,
                       config: llama.LlamaConfig,
                       num_steps: int):
     """Greedy-decode ``num_steps`` tokens for every row at PER-ROW
     positions, as one dispatch (inner ``lax.scan``).
 
-    tokens [B] (each row's most recent token); k/v_cache
-    [L, B, S, Hkv, hd]; pos [B] = next write index per row; active
+    tokens [B] (each row's most recent token); ``caches`` =
+    (k_cache, v_cache, k_scale, v_scale) with k/v [L, B, S, Hkv, hd]
+    (int8 + bf16 scales [L, B, S, Hkv] when quantized — int8 KV
+    halves the decode loop's dominant HBM stream; scales are None
+    for a bf16 cache); pos [B] = next write index per row; active
     [B] bool — inactive rows still compute (static shapes) but their
     pos does not advance and their writes keep landing on the same
     parked cell, so they cannot corrupt anything.
 
-    Returns (out_tokens [B, num_steps], k_cache, v_cache, new_pos).
+    Returns (out_tokens [B, num_steps], caches, new_pos).
     """
+    k_cache, v_cache, k_scale, v_scale = caches
     if config.n_experts:
         raise NotImplementedError('MoE continuous batching not '
                                   'supported yet')
@@ -107,7 +101,7 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
     b = tokens.shape[0]
 
     def one_token(carry, _):
-        tok, kc_all, vc_all, cur = carry
+        tok, kc_all, vc_all, ks_all, vs_all, cur = carry
         angles = llama._rope_frequencies(config, cur)   # [B, hd/2]
         x = cparams['embed'][tok][:, None]              # [B, 1, D]
         if config.scale_embeddings:
@@ -116,7 +110,7 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
 
         def layer(carry_x, scanned):
             xc, cur_ = carry_x
-            lp, kc, vc = scanned
+            lp, kc, vc, ks, vs = scanned
             h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
                                 config.norm_offset)
             q = _mm(h, lp['wq'])
@@ -131,17 +125,29 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             v = v.reshape(b, 1, nkv, hd)
             q = _rope_rows(q, angles)
             k = _rope_rows(k, angles)
-            # One-hot masked write, NOT a scatter: per-row dynamic
-            # indices make XLA emit an (unvectorized, slow) TPU
-            # scatter, while a full-cache where() is a single
-            # bandwidth-bound elementwise pass (the JetStream trick).
-            hit = (jnp.arange(kc.shape[1])[None, :] ==
-                   cur_[:, None])                      # [B, S]
-            kc = jnp.where(hit[:, :, None, None], k[:, 0][:, None],
-                           kc)
-            vc = jnp.where(hit[:, :, None, None], v[:, 0][:, None],
-                           vc)
-            attn = _attend_rows(q, kc, vc, cur_, hd ** -0.5)
+            if ks is not None:
+                # int8 KV: quantize the new row, one-hot write codes
+                # AND scales, dequant lazily at the attention read
+                # (XLA fuses; HBM reads stay int8-sized).
+                k8, ksc = decode._quantize_kv(k)
+                v8, vsc = decode._quantize_kv(v)
+                hit = (jnp.arange(kc.shape[1])[None, :] ==
+                       cur_[:, None])                    # [B, S]
+                kc = jnp.where(hit[:, :, None, None], k8[:, 0][:, None], kc)
+                vc = jnp.where(hit[:, :, None, None], v8[:, 0][:, None], vc)
+                ks = jnp.where(hit[:, :, None], ksc[:, 0][:, None], ks)
+                vs = jnp.where(hit[:, :, None], vsc[:, 0][:, None], vs)
+            else:
+                # Per-row cache write: Pallas windowed write when
+                # opted in; otherwise the one-hot full-cache where()
+                # (the JetStream trick to avoid XLA's unvectorized
+                # scatter).
+                from skypilot_tpu.ops import decode_attention as da
+                kc, vc = da.cache_write(kc, vc, k[:, 0], v[:, 0],
+                                        cur_)
+            kd = decode._dequant_kv(kc, ks, k.dtype)
+            vd = decode._dequant_kv(vc, vs, v.dtype)
+            attn = _attend_rows(q, kd, vd, cur_, hd ** -0.5)
             xc = xc + _mm(attn.reshape(b, 1, nh * hd), lp['wo'])
             h = llama._rms_norm(xc, lp['mlp_norm'], config.norm_eps,
                                 config.norm_offset)
@@ -150,10 +156,11 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             ).astype(h.dtype)
             up = _mm(h, lp['w_up'])
             xc = xc + _mm(gate * up, lp['w_down'])
-            return (xc, cur_), (kc, vc)
+            return (xc, cur_), (kc, vc, ks, vs)
 
-        (x, _), (kc_all, vc_all) = jax.lax.scan(
-            layer, (x, cur), (cparams['layers'], kc_all, vc_all))
+        (x, _), (kc_all, vc_all, ks_all, vs_all) = jax.lax.scan(
+            layer, (x, cur),
+            (cparams['layers'], kc_all, vc_all, ks_all, vs_all))
         x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
                             config.norm_offset)
         if config.tie_embeddings:
@@ -165,12 +172,15 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
         # their next write overwrites the same parked cell.
         nxt = jnp.where(active, nxt, tok)
         new_cur = jnp.where(active, cur + 1, cur)
-        return (nxt, kc_all, vc_all, new_cur), nxt
+        return (nxt, kc_all, vc_all, ks_all, vs_all, new_cur), nxt
 
-    (tok, k_cache, v_cache, pos), toks = jax.lax.scan(
-        one_token, (tokens, k_cache, v_cache, pos), None,
-        length=num_steps)
-    return toks.swapaxes(0, 1), k_cache, v_cache, pos
+    (tok, k_cache, v_cache, k_scale, v_scale, pos), toks = \
+        jax.lax.scan(
+            one_token,
+            (tokens, k_cache, v_cache, k_scale, v_scale, pos), None,
+            length=num_steps)
+    return (toks.swapaxes(0, 1),
+            (k_cache, v_cache, k_scale, v_scale), pos)
 
 
 # ---------------------------------------------------------------------
@@ -199,7 +209,8 @@ class BatchingEngine:
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
                  slots: int = 8, max_seq: Optional[int] = None,
-                 steps_per_dispatch: int = 8):
+                 steps_per_dispatch: int = 8,
+                 kv_int8: bool = False):
         if config.n_experts:
             # Reject at construction, not at first dispatch inside
             # the loop thread.
@@ -209,11 +220,27 @@ class BatchingEngine:
         self.config = config
         self.slots = slots
         self.max_seq = max_seq or config.max_seq_len
+        from skypilot_tpu.ops import decode_attention as da
+        if da._use_pallas():  # pylint: disable=protected-access
+            # Round the cache up to the decode kernel's chunk size so
+            # the length-aware attention path engages (the padding is
+            # never read: reads scale with row lengths).
+            blk = da._BLOCK_S  # pylint: disable=protected-access
+            self.max_seq = max(2 * blk,
+                               -(-self.max_seq // blk) * blk)
         self.steps = steps_per_dispatch
+        self.kv_int8 = kv_int8
         shape = (config.n_layers, slots, self.max_seq,
                  config.n_kv_heads, config.head_dim)
-        self.k_cache = jnp.zeros(shape, config.dtype)
-        self.v_cache = jnp.zeros(shape, config.dtype)
+        if kv_int8:
+            self.caches = (jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape, jnp.int8),
+                           jnp.zeros(shape[:-1], jnp.bfloat16),
+                           jnp.zeros(shape[:-1], jnp.bfloat16))
+        else:
+            self.caches = (jnp.zeros(shape, config.dtype),
+                           jnp.zeros(shape, config.dtype), None,
+                           None)
         self.pos = jnp.zeros((slots,), jnp.int32)
         self.tokens = jnp.zeros((slots,), jnp.int32)
         # Host-side slot bookkeeping.
@@ -223,20 +250,27 @@ class BatchingEngine:
         self.wake = threading.Event()
         self._stop = False
         self._step_fn = jax.jit(decode_steps_rows,
-                                static_argnums=(6, 7),
-                                donate_argnums=(2, 3))
+                                static_argnums=(5, 6),
+                                donate_argnums=(2,))
         self._prefill = jax.jit(decode.forward_cached,
-                                static_argnums=(3, 4),
+                                static_argnums=(3, 4, 5),
                                 donate_argnums=(2,))
         self._insert = jax.jit(self._insert_impl,
-                               donate_argnums=(0, 1))
+                               donate_argnums=(0,))
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
     @staticmethod
-    def _insert_impl(k_cache, v_cache, row, k_row, v_row):
-        return (k_cache.at[:, row].set(k_row),
-                v_cache.at[:, row].set(v_row))
+    def _insert_impl(caches, row, new):
+        """Copy a freshly prefilled request's cache (decode.KVCache,
+        batch 1) into slot ``row`` — codes AND scales when int8."""
+        kc, vc, ks, vs = caches
+        kc = kc.at[:, row].set(new.k[:, 0])
+        vc = vc.at[:, row].set(new.v[:, 0])
+        if ks is not None:
+            ks = ks.at[:, row].set(new.k_scale[:, 0])
+            vs = vs.at[:, row].set(new.v_scale[:, 0])
+        return kc, vc, ks, vs
 
     # -- client API -----------------------------------------------------
 
@@ -289,7 +323,8 @@ class BatchingEngine:
         padded = req.prompt_ids + [0] * (bucket - t0)
         prompt = jnp.asarray([padded], jnp.int32)
         cache = decode.init_cache(self.config, 1,
-                                  max_seq=self.max_seq)
+                                  max_seq=self.max_seq,
+                                  kv_int8=self.kv_int8)
         # Exact-bucket prompts project only the last position through
         # the LM head; padded ones need the full logits because the
         # real last token sits at t0-1, not at the padded end (a
@@ -298,11 +333,9 @@ class BatchingEngine:
         # safe — see module docstring.
         last_only = (bucket == t0)
         logits, cache = self._prefill(self.params, prompt, cache,
-                                      self.config, last_only)
+                                      self.config, last_only, True)
         first = int(logits[0, -1 if last_only else t0 - 1].argmax(-1))
-        self.k_cache, self.v_cache = self._insert(
-            self.k_cache, self.v_cache, row, cache.k[:, 0],
-            cache.v[:, 0])
+        self.caches = self._insert(self.caches, row, cache)
         self.pos = self.pos.at[row].set(t0)
         self.tokens = self.tokens.at[row].set(first)
         self.slot_req[row] = req
@@ -369,9 +402,9 @@ class BatchingEngine:
             active = jnp.asarray(
                 [r is not None and self.slot_left[i] > 0
                  for i, r in enumerate(self.slot_req)], bool)
-            toks, self.k_cache, self.v_cache, self.pos = \
-                self._step_fn(self.params, self.tokens, self.k_cache,
-                              self.v_cache, self.pos, active,
+            toks, self.caches, self.pos = \
+                self._step_fn(self.params, self.tokens, self.caches,
+                              self.pos, active,
                               self.config, n)
             self.tokens = toks[:, -1]
             host_toks = jax.device_get(toks)
