@@ -175,6 +175,322 @@ def pipelined_layers(layer_fn, x: jax.Array, stacked_params: Params,
     return fn(x, stacked_params)
 
 
+def build_pipeline_value_and_grad(config: llama.LlamaConfig,
+                                  mesh: Mesh,
+                                  num_micro: Optional[int] = None,
+                                  lora: bool = False,
+                                  lora_scale: float = 2.0):
+    """1F1B schedule (one-forward-one-backward): returns
+    ``vg(params[, lora_params], batch) -> (loss, grads)``.
+
+    GPipe (``build_pipeline_loss`` + ``jax.grad``) runs ALL forwards
+    then ALL backwards: autodiff through the schedule scan saves one
+    residual set per step, so live activation memory grows with
+    ``num_micro``. 1F1B interleaves: at step s, stage i forwards
+    microbatch ``s - i`` and backwards microbatch
+    ``s - 2(pp-1) + i`` — each stage holds at most ``2(pp - i) - 1``
+    stage inputs, so peak activation memory is O(pp), INDEPENDENT of
+    num_micro (the property that lets microbatch count — and with it
+    the bubble fraction — grow freely). Backward recomputes the
+    stage forward from the stored input (same total FLOPs as
+    rematted GPipe). Cotangents rotate backward one stage per step
+    (the mirror of the forward's ppermute ring); the last stage
+    seeds them from the per-microbatch CE-SUM (grads are scaled by
+    the global mask count at the end, so the masked-mean loss
+    matches GPipe exactly).
+
+    Scope: dense (+ LoRA) stacks. MoE (microbatch-local aux) and sp
+    (sequence-sharded stages) stay on the GPipe path.
+
+    No reference analog (SURVEY §2.11 — the reference has no
+    pipeline parallelism at all); schedule follows PipeDream-Flush
+    (Narayanan et al.) / Megatron-LM's non-interleaved 1F1B.
+    """
+    pp = mesh.shape['pp']
+    if num_micro is None:
+        num_micro = 2 * pp
+    if config.n_experts:
+        raise NotImplementedError(
+            '1F1B with MoE is not supported; use the GPipe schedule')
+    if mesh.shape.get('sp', 1) > 1:
+        raise NotImplementedError(
+            '1F1B with sequence parallelism is not supported; use '
+            'the GPipe schedule')
+    attn_impl = llama.default_attn_impl()
+    remat = llama.layer_remat_policy(config) if config.remat else None
+    m = num_micro
+    n_steps = m + 2 * (pp - 1)
+    slots = 2 * pp
+
+    def vg(params: Params, *rest):
+        if lora:
+            lora_params, batch = rest
+        else:
+            (batch,) = rest
+            lora_params = None
+        tokens = batch['tokens']
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        b, t = inputs.shape
+        if b % m != 0:
+            raise ValueError(f'batch {b} not divisible by '
+                             f'num_micro={m}')
+        mb = b // m
+        angles = llama._rope_frequencies(config, jnp.arange(t))
+        mask = llama.shifted_loss_mask(batch, targets)
+
+        cparams = jax.tree.map(lambda p: p.astype(config.dtype),
+                               params)
+        x = llama.embed_tokens(cparams, inputs, config)
+
+        train_head = not lora
+        head_vars = {'final_norm': cparams['final_norm'],
+                     'head': llama.output_head(cparams, config)}
+
+        if lora:
+            clora = jax.tree.map(lambda p: p.astype(config.dtype),
+                                 lora_params)
+            stacked = (cparams['layers'], clora)
+
+            def one_layer(x_mb, scanned):
+                lp, ll = scanned
+                y, _ = llama._layer(config, x_mb, lp, angles,
+                                    attn_impl, lora_params=ll,
+                                    lora_scale=lora_scale)
+                return y
+
+            def grad_select(dstacked):
+                return dstacked[1]       # lora cotangents only
+        else:
+            stacked = cparams['layers']
+
+            def one_layer(x_mb, lp):
+                y, _ = llama._layer(config, x_mb, lp, angles,
+                                    attn_impl)
+                return y
+
+            def grad_select(dstacked):
+                return dstacked
+
+        layer_step = one_layer
+        if remat is not None:
+            layer_step = jax.checkpoint(one_layer, prevent_cse=False,
+                                        policy=remat)
+
+        def stage_fn(x_mb, params_local):
+            def scan_body(x_c, lp):
+                return layer_step(x_c, lp), None
+
+            y, _ = jax.lax.scan(scan_body, x_mb, params_local)
+            return y
+
+        def head_fn(hvars, hidden, tgt, msk):
+            """Per-microbatch CE SUM (unnormalized) + mask count."""
+            h = llama._rms_norm(hidden, hvars['final_norm'],
+                                config.norm_eps, config.norm_offset)
+            logits = (h @ hvars['head']).astype(jnp.float32)
+            nll = llama._ce_from_logits(logits, tgt)
+            return (nll * msk).sum(), msk.sum()
+
+        def body(x_full, tgt_full, msk_full, hvars, params_local):
+            idx = jax.lax.axis_index('pp')
+            micro = x_full.reshape(m, mb, t, x_full.shape[-1])
+            tgt_m = tgt_full.reshape(m, mb, t)
+            msk_m = msk_full.reshape(m, mb, t)
+
+            def vary(z):
+                return jax.lax.pcast(z, ('pp',), to='varying')
+
+            act = vary(jnp.zeros(micro.shape[1:], x_full.dtype))
+            cot = vary(jnp.zeros(micro.shape[1:], x_full.dtype))
+            in_buf = vary(jnp.zeros((slots,) + micro.shape[1:],
+                                    x_full.dtype))
+            pgrads = jax.tree.map(
+                lambda p: vary(jnp.zeros(p.shape, jnp.float32)),
+                grad_select(params_local))
+            hgrads = jax.tree.map(
+                lambda p: vary(jnp.zeros(p.shape, jnp.float32)),
+                hvars)
+            dembed = vary(jnp.zeros(micro.shape, x_full.dtype))
+            ce0 = vary(jnp.zeros((), jnp.float32))
+            ms0 = vary(jnp.zeros((), jnp.float32))
+
+            def masked_update(buf, slot, new, valid):
+                cur = jax.lax.dynamic_index_in_dim(buf, slot, axis=0,
+                                                   keepdims=False)
+                return jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(valid, new, cur), slot, axis=0)
+
+            def step(carry, s):
+                (act, cot, in_buf, pgrads, hgrads, dembed, ce,
+                 ms) = carry
+                # ---- forward half: stage idx runs microbatch fm.
+                fm = s - idx
+                fwd_valid = (fm >= 0) & (fm < m)
+                fmc = jnp.clip(fm, 0, m - 1)
+                inp = jax.lax.dynamic_index_in_dim(micro, fmc, axis=0,
+                                                   keepdims=False)
+                xin = jnp.where(idx == 0, inp, act)
+                in_buf = masked_update(in_buf, fmc % slots, xin,
+                                       fwd_valid)
+                y = stage_fn(xin, params_local)
+                act_next = jax.lax.ppermute(
+                    y, 'pp', [(i, (i + 1) % pp) for i in range(pp)])
+
+                # ---- backward half: stage idx backprops microbatch
+                # bm (for the LAST stage bm == fm: its fresh forward
+                # output seeds the cotangent chain via the CE head).
+                bm = s - 2 * (pp - 1) + idx
+                bwd_valid = (bm >= 0) & (bm < m)
+                bmc = jnp.clip(bm, 0, m - 1)
+                x_b = jax.lax.dynamic_index_in_dim(
+                    in_buf, bmc % slots, axis=0, keepdims=False)
+                y_b, stage_vjp = jax.vjp(stage_fn, x_b, params_local)
+
+                tg = jax.lax.dynamic_index_in_dim(tgt_m, bmc, axis=0,
+                                                  keepdims=False)
+                mk = jax.lax.dynamic_index_in_dim(msk_m, bmc, axis=0,
+                                                  keepdims=False)
+                last = idx == pp - 1
+
+                # The CE head + its vjp run on EVERY stage (the
+                # non-last stages' results are masked off below) —
+                # SPMD requires a uniform program; a lax.cond on a
+                # pp-varying predicate with GSPMD-auto collectives in
+                # the branch aborts the runtime. Cost: pp-1 redundant
+                # head matmuls per step; acceptable until a
+                # stage-uniform head-skip lands.
+                #
+                # hvars must be pcast VARYING first: differentiating
+                # a pp-invariant input of a pp-varying computation
+                # makes jax insert an implicit psum('pp') in the
+                # backward, which would fold the other stages' junk
+                # head grads into every device's cotangent. Varying
+                # inputs keep per-device cotangents; the masked psum
+                # below does the one correct reduction.
+                hvars_v = jax.tree.map(
+                    lambda p: jax.lax.pcast(p, ('pp',),
+                                            to='varying'), hvars)
+                (ce_mb, ms_mb), head_vjp = jax.vjp(
+                    head_fn, hvars_v, y_b, tg, mk)
+                # Cotangents must carry the outputs' varying-over-
+                # 'pp' type (manual shard_map typing).
+                dh_vars, g_hidden, _, _ = head_vjp(
+                    (jax.lax.pcast(jnp.ones((), jnp.float32),
+                                   ('pp',), to='varying'),
+                     jax.lax.pcast(jnp.zeros((), jnp.float32),
+                                   ('pp',), to='varying')))
+                del hvars_v
+                g_y = jnp.where(last, g_hidden.astype(cot.dtype),
+                                cot)
+                dx, dstacked = stage_vjp(g_y)
+
+                acc = jnp.logical_and(bwd_valid, True)
+                pgrads = jax.tree.map(
+                    lambda g, d: g + jnp.where(
+                        acc, d.astype(jnp.float32), 0.0),
+                    pgrads, grad_select(dstacked))
+                if train_head:
+                    hgrads = jax.tree.map(
+                        lambda g, d: g + jnp.where(
+                            jnp.logical_and(acc, last),
+                            d.astype(jnp.float32), 0.0),
+                        hgrads, dh_vars)
+                ce = ce + jnp.where(jnp.logical_and(acc, last),
+                                    ce_mb, 0.0)
+                ms = ms + jnp.where(jnp.logical_and(acc, last),
+                                    ms_mb, 0.0)
+                dembed = masked_update(
+                    dembed, bmc, jnp.where(idx == 0, dx, 0.0),
+                    jnp.logical_and(acc, idx == 0))
+                cot_next = jax.lax.ppermute(
+                    dx, 'pp', [(i, (i - 1) % pp) for i in range(pp)])
+                return (act_next, cot_next, in_buf, pgrads, hgrads,
+                        dembed, ce, ms), None
+
+            carry0 = (act, cot, in_buf, pgrads, hgrads, dembed, ce0,
+                      ms0)
+            (act, cot, in_buf, pgrads, hgrads, dembed, ce, ms), _ = \
+                jax.lax.scan(step, carry0, jnp.arange(n_steps))
+
+            # Every quantity below lives on one stage (grads on each
+            # stage's own shard stay put; head/embed/scalars psum to
+            # replicated).
+            hgrads = jax.tree.map(lambda g: jax.lax.psum(g, 'pp'),
+                                  hgrads)
+            dembed = jax.lax.psum(dembed, 'pp')
+            ce = jax.lax.psum(ce, 'pp')
+            ms = jax.lax.psum(ms, 'pp')
+            return (pgrads, hgrads,
+                    dembed.reshape(x_full.shape), ce, ms)
+
+        fn = jax.shard_map(
+            body, mesh=mesh, axis_names={'pp'},
+            in_specs=(P(), P(), P(), P(),
+                      jax.tree.map(lambda _: P('pp'), stacked)),
+            out_specs=(jax.tree.map(lambda _: P('pp'),
+                                    grad_select(stacked)),
+                       jax.tree.map(lambda _: P(), head_vars),
+                       P(), P(), P()))
+        pgrads, hgrads, dembed_in, ce, ms = fn(x, targets, mask,
+                                               head_vars, stacked)
+
+        denom = jnp.maximum(ms, 1.0)
+        loss = ce / denom
+
+        # Everything was differentiated against the CE SUM; the
+        # masked-mean's 1/denom scales every cotangent linearly.
+        scale = 1.0 / denom
+        pgrads = jax.tree.map(lambda g: g * scale, pgrads)
+
+        if lora:
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), pgrads, lora_params)
+            return loss, grads
+
+        # Full FT: fold the head grads + the embedding-input grads
+        # back into the master-param tree.
+        def embed_fwd(embed_w):
+            ep = dict(cparams)
+            ep['embed'] = embed_w
+            return llama.embed_tokens(ep, inputs, config)
+
+        _, embed_vjp = jax.vjp(embed_fwd, cparams['embed'])
+        (d_embed,) = embed_vjp(dembed_in.astype(config.dtype))
+        d_embed = d_embed.astype(jnp.float32) * scale
+
+        hgrads = jax.tree.map(lambda g: g * scale, hgrads)
+        grads = {'layers': pgrads, 'final_norm': hgrads['final_norm'],
+                 'embed': d_embed}
+        if config.tie_embeddings:
+            # output_head ties to the embedding table.
+            grads['embed'] = grads['embed'] + \
+                _head_grad_to_embed(hgrads['head'], cparams, config)
+        else:
+            grads['lm_head'] = hgrads['head']
+        for key in params:
+            if key not in grads:
+                grads[key] = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32),
+                    params[key])
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads,
+                             params)
+        return loss, grads
+
+    return vg
+
+
+def _head_grad_to_embed(d_head: jax.Array, cparams: Params,
+                        config: llama.LlamaConfig) -> jax.Array:
+    """Map a [D, V] lm-head cotangent back onto the tied embedding
+    table via the same transform ``output_head`` applies."""
+    _, head_vjp = jax.vjp(
+        lambda e: llama.output_head({**cparams, 'embed': e}, config),
+        cparams['embed'])
+    (d_embed,) = head_vjp(d_head.astype(cparams['embed'].dtype))
+    return d_embed.astype(jnp.float32)
+
+
 def build_pipeline_loss(config: llama.LlamaConfig, mesh: Mesh,
                         num_micro: Optional[int] = None,
                         lora: bool = False, lora_scale: float = 2.0

@@ -213,7 +213,8 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                          optax.GradientTransformation] = None,
                      lora_scale: float = 2.0,
                      donate: bool = True,
-                     pipeline_microbatches: Optional[int] = None
+                     pipeline_microbatches: Optional[int] = None,
+                     pipeline_schedule: str = 'gpipe'
                      ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                    Tuple[TrainState, Dict[str, jax.Array]]]:
     """The full training step: loss → grad → optimizer update, jitted
@@ -236,12 +237,26 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
         mesh, P(('dp', 'fsdp', 'ep'), 'sp', None)) if use_sp else None
 
     pp_loss = None
+    pp_vg = None
     if use_pp:
         from skypilot_tpu.parallel import pipeline as pipeline_lib
         pipeline_lib.validate_pipeline_config(config, mesh)
-        pp_loss = pipeline_lib.build_pipeline_loss(
-            config, mesh, num_micro=pipeline_microbatches,
-            lora=is_lora, lora_scale=lora_scale)
+        if pipeline_schedule == '1f1b':
+            # 1F1B interleaves fwd/bwd so activation memory is O(pp)
+            # rather than O(num_micro); it computes (loss, grads)
+            # itself (the schedule IS the backward pass — see
+            # pipeline.build_pipeline_value_and_grad).
+            pp_vg = pipeline_lib.build_pipeline_value_and_grad(
+                config, mesh, num_micro=pipeline_microbatches,
+                lora=is_lora, lora_scale=lora_scale)
+        elif pipeline_schedule == 'gpipe':
+            pp_loss = pipeline_lib.build_pipeline_loss(
+                config, mesh, num_micro=pipeline_microbatches,
+                lora=is_lora, lora_scale=lora_scale)
+        else:
+            raise ValueError(
+                f'unknown pipeline_schedule {pipeline_schedule!r} '
+                "(choose 'gpipe' or '1f1b')")
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         if is_lora:
@@ -254,7 +269,10 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                     attn_impl=attn_impl,
                     activation_sharding=act_sharding, mesh=mesh)
 
-            loss, grads = jax.value_and_grad(loss_of)(state.lora)
+            if pp_vg is not None:
+                loss, grads = pp_vg(state.params, state.lora, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(state.lora)
             updates, new_opt = optimizer.update(grads, state.opt_state,
                                                 state.lora)
             new_lora = optax.apply_updates(state.lora, updates)
@@ -269,7 +287,11 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
                     params, batch, config, attn_impl=attn_impl,
                     activation_sharding=act_sharding, mesh=mesh)
 
-            loss, grads = jax.value_and_grad(loss_of)(state.params)
+            if pp_vg is not None:
+                loss, grads = pp_vg(state.params, batch)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(
+                    state.params)
             updates, new_opt = optimizer.update(grads, state.opt_state,
                                                 state.params)
             new_params = optax.apply_updates(state.params, updates)

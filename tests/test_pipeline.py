@@ -57,13 +57,14 @@ class TestPipelinedLayers:
 class TestPipelineTraining:
 
     def _losses(self, mesh_cfg, config, num_micro=None, steps=3,
-                lora_rank=None):
+                lora_rank=None, schedule='gpipe'):
         mesh = make_mesh(mesh_cfg)
         state, shardings = init_train_state(config, mesh,
                                             jax.random.PRNGKey(0),
                                             lora_rank=lora_rank)
         step = build_train_step(config, mesh, shardings,
-                                pipeline_microbatches=num_micro)
+                                pipeline_microbatches=num_micro,
+                                pipeline_schedule=schedule)
         toks = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
                                   config.vocab_size, dtype=jnp.int32)
         out = []
@@ -93,6 +94,42 @@ class TestPipelineTraining:
                           num_micro=2)
         ref = self._losses(MeshConfig(fsdp=8), config)
         np.testing.assert_allclose(pp, ref, rtol=1e-4)
+
+    def test_1f1b_matches_gpipe(self, cfg):
+        """The 1F1B schedule is a reordering, not a numerics change:
+        losses across optimizer updates must track GPipe (and so the
+        non-pipelined reference) — full-FT path, grads via the
+        manual interleaved backward
+        (pipeline.build_pipeline_value_and_grad)."""
+        f1b = self._losses(MeshConfig(pp=2, fsdp=4), cfg,
+                           num_micro=4, schedule='1f1b')
+        ref = self._losses(MeshConfig(pp=2, fsdp=4), cfg,
+                           num_micro=4, schedule='gpipe')
+        np.testing.assert_allclose(f1b, ref, rtol=2e-4)
+        assert f1b[-1] < f1b[0]
+
+    def test_1f1b_pp4_odd_microbatches(self, cfg):
+        # Bubble/warmup masking must hold when num_micro != 2*pp and
+        # doesn't divide evenly into the schedule.
+        f1b = self._losses(MeshConfig(pp=4, fsdp=2), cfg,
+                           num_micro=8, schedule='1f1b')
+        ref = self._losses(MeshConfig(fsdp=8), cfg)
+        np.testing.assert_allclose(f1b, ref, rtol=2e-4)
+
+    def test_1f1b_with_lora(self, cfg):
+        f1b = self._losses(MeshConfig(pp=2, fsdp=4), cfg,
+                           num_micro=4, lora_rank=4,
+                           schedule='1f1b')
+        ref = self._losses(MeshConfig(pp=2, fsdp=4), cfg,
+                           num_micro=4, lora_rank=4,
+                           schedule='gpipe')
+        np.testing.assert_allclose(f1b, ref, rtol=2e-4)
+
+    def test_1f1b_rejects_moe(self):
+        config = llama.get_config('tiny-moe')
+        mesh = make_mesh(MeshConfig(pp=2, fsdp=4))
+        with pytest.raises(NotImplementedError, match='MoE'):
+            pipeline.build_pipeline_value_and_grad(config, mesh)
 
     def test_pp_with_moe_matches_reference(self):
         # MoE layers pipeline like dense ones (experts stack [L, ...]);
