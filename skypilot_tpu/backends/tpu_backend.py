@@ -171,7 +171,14 @@ class TpuBackend(Backend):
         logger.info('Cluster %s runtime version mismatch %s (client '
                     'wants %s); restarting runtime.',
                     handle.cluster_name, stale, agent.AGENT_VERSION)
-        if not handle.is_local:
+        if handle.is_local:
+            # Local "hosts" are agent processes: respawn them in
+            # place (the no-op setup path below would leave the old
+            # processes — and their protocol — running).
+            from skypilot_tpu.provision.local import instance as local_inst
+            local_inst.restart_agents(handle.region,
+                                      handle.cluster_name_on_cloud)
+        else:
             from skypilot_tpu.provision import instance_setup
             instance_setup.stop_runtime_on_cluster(handle)
         self._post_provision_runtime_setup(handle)

@@ -210,6 +210,55 @@ def terminate_instances(region: str,
                   ignore_errors=True)
 
 
+def restart_agents(region: str, cluster_name_on_cloud: str) -> None:
+    """Kill and respawn every host's agent IN PLACE (same port,
+    runtime dir, token) — the local analog of re-shipping the package
+    and restarting the runtime on a version-skewed cluster
+    (tpu_backend._ensure_runtime_version)."""
+    del region
+    meta = _load(cluster_name_on_cloud)
+    if meta is None:
+        raise exceptions.FetchClusterInfoError(
+            f'no such local cluster {cluster_name_on_cloud}')
+    token = meta.get('agent_token')
+    _kill_agents(cluster_name_on_cloud)
+    # Wait for the PORT to stop answering, not the pid: agents
+    # spawned by this very process become zombies after SIGTERM
+    # (nothing reaps them) and a pid check would burn the whole
+    # deadline (see _host_alive's note). Escalate to SIGKILL on
+    # expiry; an old agent surviving both would make the respawn
+    # fail to bind and the handshake falsely "succeed" against the
+    # stale process — raise instead.
+    for h in meta['hosts']:
+        deadline = time.time() + 5
+        while _host_alive(h, token) and time.time() < deadline:
+            time.sleep(0.05)
+        if _host_alive(h, token):
+            try:
+                os.killpg(os.getpgid(h['pid']), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(h['pid'], signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            deadline = time.time() + 5
+            while _host_alive(h, token) and time.time() < deadline:
+                time.sleep(0.05)
+        if _host_alive(h, token):
+            raise exceptions.SkyTpuError(
+                f'agent on port {h["port"]} survived SIGKILL; '
+                'cannot restart the runtime in place')
+    for h in meta['hosts']:
+        proc = agent_client.start_local_agent(
+            h['port'], runtime_dir=h['runtime_dir'], token=token)
+        h['pid'] = proc.pid
+    _save(cluster_name_on_cloud, meta)
+    for h in meta['hosts']:
+        agent_client.AgentClient(
+            '127.0.0.1', h['port'],
+            token=token).wait_healthy(timeout=30)
+
+
 def _kill_agents(cluster_name_on_cloud: str) -> None:
     meta = _load(cluster_name_on_cloud)
     if meta is None:

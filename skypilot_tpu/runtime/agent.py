@@ -43,6 +43,18 @@ from typing import Dict, Optional
 # `wait` and answer instantly, degrading the driver's long-poll loop
 # into a busy-loop.
 AGENT_VERSION = '2'
+
+
+def served_version() -> str:
+    """The protocol version THIS agent process reports.
+    SKYTPU_AGENT_VERSION_OVERRIDE is the backward-compat test seam
+    (model: tests/backward_compatibility_tests.sh runs old wheels
+    against new clusters; here the Python agent emulates an old
+    protocol id). Read per-request and only on the serving side —
+    an import-time override would also change the CLIENT's expected
+    version and mask genuinely stale clusters."""
+    return os.environ.get('SKYTPU_AGENT_VERSION_OVERRIDE',
+                          AGENT_VERSION)
 DEFAULT_PORT = 8790
 TOKEN_HEADER = 'X-SkyTpu-Token'
 # Cap on /status?wait= long-polls (a handler thread is held for the
@@ -197,7 +209,7 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(parsed.query)
         if parsed.path == '/health':
-            self._json({'ok': True, 'version': AGENT_VERSION,
+            self._json({'ok': True, 'version': served_version(),
                         'agent': 'py'})
         elif parsed.path == '/status':
             proc_id = int(qs.get('proc_id', ['0'])[0])

@@ -18,7 +18,11 @@ _CPP_AGENT_REL = 'runtime/cpp/host_agent'
 
 def resolve_agent_binary() -> Optional[str]:
     """Path to the native C++ agent if built, else None (Python agent
-    is used)."""
+    is used). SKYTPU_FORCE_PYTHON_AGENT=1 forces the Python agent —
+    a debugging/compat knob (the Python agent can emulate other
+    protocol versions for skew testing; the binary's is baked in)."""
+    if os.environ.get('SKYTPU_FORCE_PYTHON_AGENT') == '1':
+        return None
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cand = os.path.join(here, _CPP_AGENT_REL)
     if os.path.exists(cand) and os.access(cand, os.X_OK):

@@ -61,6 +61,40 @@ class TestTimeToFirstStep:
         assert state.get_cluster_from_name('ttfstest') is None
 
 
+class TestVersionSkewEndToEnd:
+    """Old cluster vs new client (ref
+    tests/backward_compatibility_tests.sh): a cluster whose agents
+    speak an older protocol must be transparently restarted on reuse
+    and then run jobs for the newer client (tpu_backend
+    _ensure_runtime_version)."""
+
+    def test_reuse_restarts_stale_runtime(self, cluster,
+                                          monkeypatch):
+        from skypilot_tpu.runtime import agent as agent_mod
+        # "Old cluster": its (Python) agents report protocol '1'.
+        monkeypatch.setenv('SKYTPU_FORCE_PYTHON_AGENT', '1')
+        monkeypatch.setenv('SKYTPU_AGENT_VERSION_OVERRIDE', '1')
+        task = _local_task('echo v1-job', num_hosts=2, name='skew')
+        job_id, handle = execution.launch(task, cluster,
+                                          detach_run=True,
+                                          quiet_optimizer=True)
+        assert core.wait_for_job(cluster, job_id, timeout=120) == \
+            job_lib.JobStatus.SUCCEEDED
+        assert handle.agent_client(0).version() == '1'
+
+        # "New client": expects the current protocol; on reuse the
+        # handshake must restart the stale runtime in place.
+        monkeypatch.delenv('SKYTPU_AGENT_VERSION_OVERRIDE')
+        task2 = _local_task('echo v2-job', num_hosts=2, name='skew2')
+        job2, handle2 = execution.launch(task2, cluster,
+                                         detach_run=True,
+                                         quiet_optimizer=True)
+        assert handle2.agent_client(0).version() == \
+            agent_mod.AGENT_VERSION
+        assert core.wait_for_job(cluster, job2, timeout=120) == \
+            job_lib.JobStatus.SUCCEEDED
+
+
 class TestLaunchEndToEnd:
 
     def test_launch_two_host_gang(self, cluster):
