@@ -337,7 +337,29 @@ def main() -> None:
             result['detail']['serve'] = _serve_probe()
         except Exception as e:  # pylint: disable=broad-except
             result['detail']['serve'] = {'error': repr(e)[:200]}
+    if os.environ.get('BENCH_INLINE_LAUNCH', '1') == '1':
+        # Launch time-to-first-step on the local fake (the second
+        # half of BASELINE.json's north star) rides along too.
+        try:
+            result['detail']['launch'] = _launch_probe()
+        except Exception as e:  # pylint: disable=broad-except
+            result['detail']['launch'] = {'error': repr(e)[:200]}
     print(json.dumps(result))
+
+
+def _launch_probe() -> dict:
+    import tempfile
+    state_dir = tempfile.mkdtemp(prefix='skytpu-ttfs-')
+    os.environ['SKYTPU_STATE_DIR'] = state_dir
+    from skypilot_tpu.benchmark import benchmark_utils
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task(name='ttfs', run='echo first-step')
+    res = Resources(cloud='local')
+    res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+    task.set_resources(res)
+    breakdown = benchmark_utils.measure_time_to_first_step(task)
+    return {k: round(v, 3) for k, v in breakdown.items()}
 
 
 def _serve_probe() -> dict:
@@ -399,17 +421,7 @@ def launch_main() -> None:
     the framework-overhead floor: optimize + provision + runtime
     bring-up + submit + schedule, everything but the cloud API's
     VM-creation latency)."""
-    import tempfile
-    state_dir = tempfile.mkdtemp(prefix='skytpu-ttfs-')
-    os.environ['SKYTPU_STATE_DIR'] = state_dir
-    from skypilot_tpu.benchmark import benchmark_utils
-    from skypilot_tpu.resources import Resources
-    from skypilot_tpu.task import Task
-    task = Task(name='ttfs', run='echo first-step')
-    res = Resources(cloud='local')
-    res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
-    task.set_resources(res)
-    breakdown = benchmark_utils.measure_time_to_first_step(task)
+    breakdown = _launch_probe()
     print(json.dumps({
         'metric': 'launch_time_to_first_step_seconds',
         'value': round(breakdown['time_to_first_step'], 3),
@@ -417,7 +429,7 @@ def launch_main() -> None:
         # No published reference number exists (BASELINE.md:32);
         # this run seeds the baseline.
         'vs_baseline': 1.0,
-        'detail': {k: round(v, 3) for k, v in breakdown.items()},
+        'detail': breakdown,
     }))
 
 

@@ -25,7 +25,11 @@ TPU-first design:
   admit them, and causality keeps them out of the real positions'
   K/V entirely.
 - Numerics contract: batched outputs EQUAL single-request greedy
-  decoding (tested token-for-token).
+  decoding (tested token-for-token). MoE caveat: equality holds
+  while expert capacity does not bind — the engine's power-of-two
+  prompt padding enters the capacity denominator
+  (cap = ceil(k*T*cf/E)), so a low ``moe_capacity_factor`` can drop
+  different tokens than an unpadded prefill would.
 """
 import queue
 import threading
@@ -91,9 +95,6 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
     Returns (out_tokens [B, num_steps], caches, new_pos).
     """
     k_cache, v_cache, k_scale, v_scale = caches
-    if config.n_experts:
-        raise NotImplementedError('MoE continuous batching not '
-                                  'supported yet')
     cparams = jax.tree.map(
         lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
         params)
@@ -151,11 +152,19 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             xc = xc + _mm(attn.reshape(b, 1, nh * hd), lp['wo'])
             h = llama._rms_norm(xc, lp['mlp_norm'], config.norm_eps,
                                 config.norm_offset)
-            gate = llama.mlp_act(config)(
-                _mm(h, lp['w_gate']).astype(jnp.float32)
-            ).astype(h.dtype)
-            up = _mm(h, lp['w_up'])
-            xc = xc + _mm(gate * up, lp['w_down'])
+            if config.n_experts:
+                # MoE routes per token — per-row positions are
+                # irrelevant to the dispatch, so the training-path
+                # expert MLP drops straight in (aux loss unused at
+                # inference).
+                moe_out, _ = llama._moe_mlp(config, h, lp)
+                xc = xc + moe_out
+            else:
+                gate = llama.mlp_act(config)(
+                    _mm(h, lp['w_gate']).astype(jnp.float32)
+                ).astype(h.dtype)
+                up = _mm(h, lp['w_up'])
+                xc = xc + _mm(gate * up, lp['w_down'])
             return (xc, cur_), (kc, vc, ks, vs)
 
         (x, _), (kc_all, vc_all, ks_all, vs_all) = jax.lax.scan(
@@ -211,11 +220,6 @@ class BatchingEngine:
                  slots: int = 8, max_seq: Optional[int] = None,
                  steps_per_dispatch: int = 8,
                  kv_int8: bool = False):
-        if config.n_experts:
-            # Reject at construction, not at first dispatch inside
-            # the loop thread.
-            raise NotImplementedError('MoE continuous batching not '
-                                      'supported yet')
         self.params = params
         self.config = config
         self.slots = slots
