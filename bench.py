@@ -349,6 +349,8 @@ def main() -> None:
 
 def _launch_probe() -> dict:
     import tempfile
+
+    from skypilot_tpu import tpu_logging
     state_dir = tempfile.mkdtemp(prefix='skytpu-ttfs-')
     os.environ['SKYTPU_STATE_DIR'] = state_dir
     from skypilot_tpu.benchmark import benchmark_utils
@@ -358,7 +360,12 @@ def _launch_probe() -> dict:
     res = Resources(cloud='local')
     res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
     task.set_resources(res)
-    breakdown = benchmark_utils.measure_time_to_first_step(task)
+    # The launch path logs INFO to stdout; the bench contract is ONE
+    # JSON line there. Trigger handler setup BEFORE silencing — the
+    # lazy _setup inside the launch would reset levels otherwise.
+    tpu_logging.init_logger('skypilot_tpu.bench')
+    with tpu_logging.silent():
+        breakdown = benchmark_utils.measure_time_to_first_step(task)
     return {k: round(v, 3) for k, v in breakdown.items()}
 
 

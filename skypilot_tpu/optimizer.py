@@ -272,20 +272,18 @@ def _optimize_exhaustive(dag: Dag,
                          candidates: Dict[Task, List[_Candidate]],
                          minimize: OptimizeTarget
                          ) -> Dict[Task, _Candidate]:
-    """Exhaustive search over the candidate product for general DAGs
-    (replaces the reference's pulp ILP ``sky/optimizer.py:472``).
-    Falls back to per-task greedy when the product is too large."""
+    """Exact search over the candidate product for general DAGs —
+    the native replacement for the reference's pulp/CBC ILP
+    (``sky/optimizer.py:472``). Small products enumerate directly;
+    larger ones run branch-and-bound (same optimum, pruned search),
+    with an expansion cap that degrades to best-found-so-far (which
+    is never worse than greedy, its seed)."""
     tasks = list(dag.tasks)
     product = 1
     for t in tasks:
         product *= max(1, len(candidates[t]))
     if product > _MAX_EXHAUSTIVE_PRODUCT:
-        logger.warning(
-            'DAG candidate space too large (%d combos); using per-task '
-            'greedy placement.', product)
-        return {t: min(candidates[t],
-                       key=lambda c: c.objective(minimize))
-                for t in tasks}
+        return _optimize_branch_and_bound(dag, candidates, minimize)
     edges = list(dag.graph.edges)
     best_total = None
     best_combo: Optional[Tuple[_Candidate, ...]] = None
@@ -299,6 +297,116 @@ def _optimize_exhaustive(dag: Dag,
             best_combo = combo
     assert best_combo is not None
     return dict(zip(tasks, best_combo))
+
+
+# Branch-and-bound expansion budget: beyond this the search returns
+# the best assignment found so far (anytime behavior).
+_MAX_BNB_EXPANSIONS = 500_000
+
+
+def _optimize_branch_and_bound(dag: Dag,
+                               candidates: Dict[Task,
+                                                List[_Candidate]],
+                               minimize: OptimizeTarget
+                               ) -> Dict[Task, _Candidate]:
+    """Exact DAG placement by depth-first branch-and-bound.
+
+    Equivalent to the reference's pairwise ILP: minimize
+    sum(node objective) + sum(edge egress) over one candidate per
+    task. The lower bound for an incomplete assignment is the sum of
+    each unassigned task's cheapest candidate (edge costs are >= 0,
+    so dropping them keeps the bound admissible); candidates are
+    tried cheapest-first so good incumbents arrive early and prune
+    hard. Within the expansion budget the result is OPTIMAL; past it
+    (astronomical candidate spaces) the incumbent — seeded by
+    edge-aware sequential greedy, so never worse than greedy — is
+    returned with a warning.
+    """
+    tasks = list(dag.tasks)
+    n = len(tasks)
+    order = sorted(range(n), key=lambda i: len(candidates[tasks[i]]))
+    cands = [sorted(candidates[tasks[i]],
+                    key=lambda c: c.objective(minimize))
+             for i in order]
+    # Edges as (position-in-order, position-in-order) so edge costs
+    # are charged as soon as both endpoints are assigned.
+    pos_of_task = {id(tasks[i]): p for p, i in enumerate(order)}
+    edges_at: List[List[Tuple[int, bool]]] = [[] for _ in range(n)]
+    for (u, v) in dag.graph.edges:
+        pu, pv = pos_of_task[id(u)], pos_of_task[id(v)]
+        late, early, u_is_late = ((pu, pv, True) if pu > pv
+                                  else (pv, pu, False))
+        edges_at[late].append((early, u_is_late))
+
+    def edge_cost_at(p: int, cand: _Candidate,
+                     chosen: List[Optional[_Candidate]]) -> float:
+        total = 0.0
+        for (early, late_is_src) in edges_at[p]:
+            other = chosen[early]
+            assert other is not None
+            src, dst = ((cand, other) if late_is_src
+                        else (other, cand))
+            # _edge_cost signature: (u_task, u_cand, v_cand).
+            u_task = tasks[order[p]] if late_is_src else \
+                tasks[order[early]]
+            total += _edge_cost(u_task, src, dst, minimize)
+        return total
+
+    min_tail = [0.0] * (n + 1)
+    for p in range(n - 1, -1, -1):
+        min_tail[p] = min_tail[p + 1] + \
+            cands[p][0].objective(minimize)
+
+    # Incumbent: edge-aware sequential greedy.
+    chosen: List[Optional[_Candidate]] = [None] * n
+    greedy_total = 0.0
+    for p in range(n):
+        best_c, best_v = None, None
+        for c in cands[p]:
+            v = c.objective(minimize) + edge_cost_at(p, c, chosen)
+            if best_v is None or v < best_v:
+                best_c, best_v = c, v
+        chosen[p] = best_c
+        greedy_total += best_v
+    best_assign = list(chosen)
+    best_total = greedy_total
+
+    expansions = 0
+    truncated = False
+
+    def dfs(p: int, partial: float,
+            chosen: List[Optional[_Candidate]]) -> None:
+        nonlocal best_assign, best_total, expansions, truncated
+        if p == n:
+            if partial < best_total:
+                best_total = partial
+                best_assign = list(chosen)
+            return
+        for c in cands[p]:
+            expansions += 1
+            if expansions > _MAX_BNB_EXPANSIONS:
+                truncated = True
+                return
+            step = c.objective(minimize) + edge_cost_at(p, c, chosen)
+            lower = partial + step + min_tail[p + 1]
+            if lower >= best_total:
+                # cands[p] is objective-sorted, but `step` includes
+                # edge costs, so LATER candidates can still beat this
+                # one — prune the branch, not the whole level.
+                continue
+            chosen[p] = c
+            dfs(p + 1, partial + step, chosen)
+            chosen[p] = None
+            if truncated:
+                return
+
+    dfs(0, 0.0, [None] * n)
+    if truncated:
+        logger.warning(
+            'DAG placement search hit the %d-node-expansion budget; '
+            'returning the best assignment found so far (never worse '
+            'than greedy).', _MAX_BNB_EXPANSIONS)
+    return {tasks[order[p]]: best_assign[p] for p in range(n)}
 
 
 def format_plan(dag: Dag, plan: Dict[Task, _Candidate],
