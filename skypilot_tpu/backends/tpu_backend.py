@@ -426,8 +426,11 @@ class TpuBackend(Backend):
         return json.loads(payload) if payload else []
 
     def tail_logs(self, handle: ClusterHandle, job_id: int,
-                  out=None, poll_interval: float = 0.5) -> None:
-        """Stream run.log from the head until the job is terminal."""
+                  out=None, poll_interval: float = 0.5,
+                  follow: bool = True) -> None:
+        """Stream run.log from the head until the job is terminal
+        (``follow=False``: dump what exists and return — needed for
+        logs of long-lived jobs like serve controllers)."""
         import sys
         out = out or sys.stdout
         head = handle.head_agent()
@@ -438,6 +441,14 @@ class TpuBackend(Backend):
             logger.warning('No log path for job %d', job_id)
             return
         offset = 0
+        if not follow:
+            # One dump, no status poll (that remote exec only serves
+            # the follow loop's terminal-race catch-up read).
+            data = head.read_file(log_path, 0)
+            if data:
+                out.write(data.decode('utf-8', errors='replace'))
+                out.flush()
+            return
         while True:
             status = self.job_status(handle, job_id)
             data = head.read_file(log_path, offset)

@@ -448,6 +448,55 @@ def serve_status(service_name):
     click.echo(table.get_string() if records else 'No services.')
 
 
+@serve_group.command(name='logs')
+@click.argument('service_name')
+@click.option('--replica-id', type=int, default=None,
+              help='Stream this replica cluster\'s job logs instead '
+                   'of the controller\'s.')
+@click.option('--follow/--no-follow', default=True,
+              help='Keep streaming (controller jobs run until the '
+                   'service goes down) or dump what exists and exit.')
+def serve_logs(service_name, replica_id, follow):
+    """Stream a service's controller (default) or replica logs
+    (analog of ``sky serve logs``, sky/cli.py serve group)."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu.serve import serve_state
+    rec = serve_state.get_service(service_name)
+    if rec is None:
+        raise click.ClickException(
+            f'Service {service_name!r} does not exist.')
+    if replica_id is None:
+        if not rec['controller_cluster'] or \
+                not rec['controller_job_id']:
+            raise click.ClickException(
+                f'Service {service_name!r} has no controller job '
+                'recorded.')
+        core_lib.tail_logs(rec['controller_cluster'],
+                           rec['controller_job_id'], follow=follow)
+        return
+    target = serve_state.get_replica(service_name, replica_id)
+    if target is None:
+        raise click.ClickException(
+            f'No replica {replica_id} in service {service_name!r}.')
+    core_lib.tail_logs(target['cluster_name'], follow=follow)
+
+
+@serve_group.command(name='terminate-replica')
+@click.argument('service_name')
+@click.argument('replica_id', type=int)
+@click.option('--yes', '-y', is_flag=True)
+def serve_terminate_replica(service_name, replica_id, yes):
+    """Manually kill one replica; the controller replaces it (analog
+    of ``sky serve down --replica-id``, sky/serve/core.py:588)."""
+    from skypilot_tpu.serve import core as serve_core
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Terminate replica {replica_id} of '
+                      f'{service_name}?', default=True, abort=True)
+    serve_core.terminate_replica(service_name, replica_id)
+    click.echo(f'Replica {replica_id} of {service_name} terminated; '
+               'the controller will replace it.')
+
+
 # ---------------------------------------------------------------------
 # Storage group (analog of ``sky storage``, sky/cli.py:3473).
 # ---------------------------------------------------------------------

@@ -152,7 +152,7 @@ def job_status(cluster_name: str,
 
 @usage.entrypoint('tail_logs')
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
-              out=None) -> None:
+              out=None, follow: bool = True) -> None:
     handle = _get_handle(cluster_name)
     backend = TpuBackend()
     if job_id is None:
@@ -160,7 +160,7 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
         if not records:
             raise exceptions.JobError('No jobs on cluster.')
         job_id = records[0]['job_id']
-    backend.tail_logs(handle, job_id, out=out)
+    backend.tail_logs(handle, job_id, out=out, follow=follow)
 
 
 @usage.entrypoint('cost_report')

@@ -295,9 +295,10 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
 def terminate_replica(service_name: str, replica_id: int) -> None:
     """Manually kill one replica (the controller will replace it)."""
     from skypilot_tpu import core as core_lib
-    replicas = serve_state.get_replicas(service_name)
-    target = next((r for r in replicas
-                   if r['replica_id'] == replica_id), None)
+    if serve_state.get_service(service_name) is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    target = serve_state.get_replica(service_name, replica_id)
     if target is None:
         raise exceptions.InvalidSpecError(
             f'No replica {replica_id} in service {service_name!r}')

@@ -197,6 +197,12 @@ def get_replicas(service_name: str) -> List[Dict[str, Any]]:
     } for r in rows]
 
 
+def get_replica(service_name: str,
+                replica_id: int) -> Optional[Dict[str, Any]]:
+    return next((r for r in get_replicas(service_name)
+                 if r['replica_id'] == replica_id), None)
+
+
 def remove_replica(service_name: str, replica_id: int) -> None:
     _db().execute_and_commit(
         'DELETE FROM replicas WHERE service_name=? AND replica_id=?',

@@ -150,6 +150,17 @@ class TestCliGroups:
             assert 'clisvc' in st.output
             st1 = runner.invoke(cli.cli, ['serve', 'status', 'clisvc'])
             assert st1.exit_code == 0
+            # Controller logs stream through the controller-cluster
+            # job channel (--no-follow: the controller job runs
+            # until the service goes down).
+            lg = runner.invoke(cli.cli, ['serve', 'logs', 'clisvc',
+                                         '--no-follow'])
+            assert lg.exit_code == 0, lg.output
+            bad = runner.invoke(cli.cli,
+                                ['serve', 'logs', 'clisvc',
+                                 '--replica-id', '99',
+                                 '--no-follow'])
+            assert bad.exit_code != 0
         finally:
             dn = runner.invoke(cli.cli, ['serve', 'down', 'clisvc',
                                          '-y'])
