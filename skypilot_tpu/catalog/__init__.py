@@ -11,6 +11,14 @@ from skypilot_tpu.catalog.tpu_catalog import (
     list_accelerators,
     validate_region_zone,
 )
+from skypilot_tpu.catalog.vm_catalog import (
+    DEFAULT_CONTROLLER_CPUS,
+    get_vm_hourly_cost,
+    get_vm_regions,
+    instance_type_for,
+    validate_instance_type,
+    vcpus_of,
+)
 
 __all__ = [
     'TpuSpec',
@@ -23,4 +31,10 @@ __all__ = [
     'is_tpu',
     'list_accelerators',
     'validate_region_zone',
+    'DEFAULT_CONTROLLER_CPUS',
+    'get_vm_hourly_cost',
+    'get_vm_regions',
+    'instance_type_for',
+    'validate_instance_type',
+    'vcpus_of',
 ]

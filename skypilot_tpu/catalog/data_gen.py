@@ -173,19 +173,72 @@ def generate_rows() -> List[Dict]:
     return rows
 
 
-def main(out_path: str = None) -> str:
-    if out_path is None:
-        out_path = os.path.join(os.path.dirname(__file__), 'data',
-                                'tpu_catalog.csv')
-    rows = generate_rows()
+# -- CPU VMs (controller-class machines) --------------------------------
+#
+# GCE machine types for accelerator-less tasks (managed-jobs/serve
+# controllers). Prices are public us-central1 list prices; other
+# regions apply the same REGION_FACTOR spread as the TPU rows.
+# Reference analog: the GCP SKU fetcher's instance-type CSV
+# (``fetch_gcp.py:791`` -> ``gcp/vms.csv``).
+VM_TYPES: Dict[str, Dict] = {
+    'e2-standard-2': dict(vcpus=2, mem_gb=8, price=0.067),
+    'e2-standard-4': dict(vcpus=4, mem_gb=16, price=0.134),
+    'e2-standard-8': dict(vcpus=8, mem_gb=32, price=0.268),
+    'n2-standard-2': dict(vcpus=2, mem_gb=8, price=0.0971),
+    'n2-standard-4': dict(vcpus=4, mem_gb=16, price=0.1942),
+    'n2-standard-8': dict(vcpus=8, mem_gb=32, price=0.3885),
+    'n2-standard-16': dict(vcpus=16, mem_gb=64, price=0.777),
+    'n2-standard-32': dict(vcpus=32, mem_gb=128, price=1.554),
+}
+
+# Spot discount for GCE VMs (larger than TPU spot: e2/n2 spot lists
+# around 0.3x on-demand).
+VM_SPOT_FACTOR = 0.30
+
+# Every region any TPU row lives in must have VM rows: controllers are
+# placed next to the slices they manage.
+VM_REGIONS = sorted({
+    region
+    for info in GENERATIONS.values()
+    for region in info['regions']
+})
+
+
+def generate_vm_rows() -> List[Dict]:
+    rows = []
+    for vm_type, info in VM_TYPES.items():
+        for region in VM_REGIONS:
+            factor = REGION_FACTOR.get(region, 1.0)
+            price = round(info['price'] * factor, 4)
+            rows.append({
+                'InstanceType': vm_type,
+                'vCPUs': info['vcpus'],
+                'MemoryGB': info['mem_gb'],
+                'Region': region,
+                'Price': price,
+                'SpotPrice': round(price * VM_SPOT_FACTOR, 4),
+            })
+    return rows
+
+
+def _write_csv(out_path: str, rows: List[Dict]) -> None:
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, 'w', newline='', encoding='utf-8') as f:
         writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
         writer.writeheader()
         writer.writerows(rows)
+
+
+def main(out_path: str = None) -> str:
+    data_dir = os.path.join(os.path.dirname(__file__), 'data')
+    if out_path is None:
+        out_path = os.path.join(data_dir, 'tpu_catalog.csv')
+    _write_csv(out_path, generate_rows())
+    vm_path = os.path.join(os.path.dirname(out_path), 'vm_catalog.csv')
+    _write_csv(vm_path, generate_vm_rows())
     return out_path
 
 
 if __name__ == '__main__':
     path = main()
-    print(f'Wrote {path}')
+    print(f'Wrote {path} (+ vm_catalog.csv)')

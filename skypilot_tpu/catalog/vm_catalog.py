@@ -1,0 +1,125 @@
+"""CPU-VM (GCE machine type) catalog queries.
+
+Controller-class VMs for accelerator-less tasks (managed-jobs / serve
+controllers). Analog of the reference's instance-type catalog lookups
+(``sky/clouds/service_catalog/gcp_catalog.py:get_instance_type_for_cpus``
+family) — scoped to the machine shapes controllers actually use.
+"""
+import functools
+import os
+import re
+from typing import List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu import exceptions
+
+_VM_CATALOG_PATH = os.path.join(os.path.dirname(__file__), 'data',
+                                'vm_catalog.csv')
+
+# Controller default: 8 vCPU / 32 GB (reference CONTROLLER_RESOURCES
+# asks cpus=4+ mem=8x, sky/utils/controller_utils.py; we default one
+# size up so one VM comfortably runs 16 controller processes).
+DEFAULT_CONTROLLER_CPUS = 8
+
+_PLUS_RE = re.compile(r'^(\d+)\+?$')
+
+
+@functools.lru_cache(maxsize=1)
+def _read_catalog() -> pd.DataFrame:
+    if not os.path.exists(_VM_CATALOG_PATH):
+        # Self-heal: regenerate from the in-tree seed tables (same
+        # pattern as tpu_catalog._read_catalog).
+        from skypilot_tpu.catalog import data_gen
+        data_gen.main()
+    return pd.read_csv(_VM_CATALOG_PATH)
+
+
+def parse_cpus(value: object, field: str = 'cpus') -> Tuple[int, bool]:
+    """'4' -> (4, exact); '4+' -> (4, at-least); int passes through.
+    ``field`` names the YAML key in error messages (also used for
+    ``memory``)."""
+    if isinstance(value, (int, float)):
+        return int(value), False
+    m = _PLUS_RE.match(str(value).strip())
+    if m is None:
+        raise exceptions.InvalidSpecError(
+            f'Invalid {field} value {value!r}; use N or N+ '
+            '(e.g. 4, 8+).')
+    return int(m.group(1)), str(value).strip().endswith('+')
+
+
+def instance_type_for(cpus: Optional[object] = None,
+                      memory_gb: Optional[object] = None,
+                      region: Optional[str] = None) -> str:
+    """Cheapest machine type with >= the requested cpus/memory
+    (N or 'N+' both mean at-least here, matching the reference's
+    cheapest-fit behavior)."""
+    df = _read_catalog()
+    if region is not None:
+        df = df[df['Region'] == region]
+    want_cpus, _ = parse_cpus(cpus if cpus is not None
+                              else DEFAULT_CONTROLLER_CPUS)
+    df = df[df['vCPUs'] >= want_cpus]
+    if memory_gb is not None:
+        want_mem, _ = parse_cpus(memory_gb, field='memory')
+        df = df[df['MemoryGB'] >= want_mem]
+    if df.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'No machine type with cpus>={cpus} memory>={memory_gb}'
+            + (f' in {region}' if region else ''), no_failover=True)
+    best = df.sort_values('Price').iloc[0]
+    return str(best['InstanceType'])
+
+
+def validate_instance_type(instance_type: str) -> None:
+    df = _read_catalog()
+    if instance_type not in set(df['InstanceType']):
+        raise exceptions.InvalidSpecError(
+            f'Unknown machine type {instance_type!r}. Known: '
+            f'{sorted(set(df["InstanceType"]))}')
+
+
+def get_vm_hourly_cost(instance_type: str, use_spot: bool,
+                       region: Optional[str] = None) -> float:
+    df = _read_catalog()
+    df = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        sub = df[df['Region'] == region]
+        # A region outside the catalog (e.g. the local fake provider's
+        # 'local' region, or a plugin cloud) prices at the cheapest
+        # real region rather than erroring: plan tables must never
+        # crash on a controller row.
+        if not sub.empty:
+            df = sub
+    if df.empty:
+        raise exceptions.ResourcesUnavailableError(
+            f'Machine type {instance_type!r} not in the VM catalog.',
+            no_failover=True)
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(df[col].min())
+
+
+def get_vm_regions(instance_type: str) -> List[str]:
+    df = _read_catalog()
+    df = df[df['InstanceType'] == instance_type]
+    by_region = df.groupby('Region')['Price'].min().sort_values()
+    return list(by_region.index)
+
+
+def vcpus_of(instance_type: str) -> int:
+    df = _read_catalog()
+    df = df[df['InstanceType'] == instance_type]
+    if df.empty:
+        raise exceptions.InvalidSpecError(
+            f'Unknown machine type {instance_type!r}')
+    return int(df.iloc[0]['vCPUs'])
+
+
+def memory_gb_of(instance_type: str) -> int:
+    df = _read_catalog()
+    df = df[df['InstanceType'] == instance_type]
+    if df.empty:
+        raise exceptions.InvalidSpecError(
+            f'Unknown machine type {instance_type!r}')
+    return int(df.iloc[0]['MemoryGB'])

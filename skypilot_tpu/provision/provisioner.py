@@ -93,9 +93,31 @@ class RetryingProvisioner:
         extra = getattr(to_provision, '_extra_config', None) or {}
         if 'regions' in extra:  # test harness: fake region list
             return [(r, None) for r in extra['regions']]
-        if cloud.is_local or to_provision.accelerator is None:
+        if cloud.is_local:
             region = to_provision.region or cloud.default_region()
             return [(region, to_provision.zone)]
+        if to_provision.accelerator is None:
+            if cloud.name != 'gcp':
+                region = to_provision.region or cloud.default_region()
+                return [(region, to_provision.zone)]
+            # Controller-class GCE VM: fail over across zones a-c,
+            # then across the VM catalog's regions cheapest-first
+            # (before round 4 the only candidate was {region}-a; one
+            # zonal stockout killed the whole launch).
+            from skypilot_tpu.catalog import vm_catalog
+            if to_provision.region is not None:
+                regions = [to_provision.region]
+            else:
+                regions = vm_catalog.get_vm_regions(
+                    to_provision.instance_type)
+            out = []
+            for region in regions:
+                if to_provision.zone is not None:
+                    out.append((region, to_provision.zone))
+                    continue
+                out.extend((region, f'{region}-{s}')
+                           for s in ('a', 'b', 'c'))
+            return out
         accel = to_provision.accelerator
         if to_provision.region is not None:
             regions = [to_provision.region]
@@ -137,12 +159,17 @@ class RetryingProvisioner:
             attempt = to_provision.copy(region=region, zone=zone)
             if self._is_blocked(attempt):
                 continue
-            node_config = {}
-            if to_provision.accelerator is not None:
+            from skypilot_tpu import clouds as clouds_lib
+            if clouds_lib.from_name(provider).is_local:
+                # The local fake provider needs no deploy variables
+                # (its "hosts" are agent processes; num_hosts comes
+                # from _extra_config below).
+                node_config = {'num_hosts': 1}
+            else:
+                # TPU slice deploy vars, or the machine-type vars of
+                # an accelerator-less controller VM.
                 node_config = attempt.make_deploy_variables(
                     cluster_name_on_cloud)
-            else:
-                node_config = {'num_hosts': 1}
             # Thread through provider-specific extras (e.g. the local
             # provider's failure injection set by tests).
             node_config.update(getattr(to_provision, '_extra_config',

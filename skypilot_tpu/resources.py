@@ -62,6 +62,8 @@ class Resources:
         self,
         cloud: Optional[str] = None,
         accelerators: Union[None, str, Dict[str, int]] = None,
+        cpus: Union[None, int, str] = None,
+        memory: Union[None, int, str] = None,
         region: Optional[str] = None,
         zone: Optional[str] = None,
         use_spot: Optional[bool] = None,
@@ -86,6 +88,12 @@ class Resources:
         self._cloud = cloud.lower() if cloud else None
         self._accelerator: Optional[str] = None
         self._set_accelerators(accelerators)
+        # CPU/memory requests shape the machine type of
+        # accelerator-less (controller-class) VMs; ignored for TPU
+        # slices, whose host shape is fixed by the slice type
+        # (catalog vCPUsPerHost).
+        self._cpus = str(cpus) if cpus is not None else None
+        self._memory = str(memory) if memory is not None else None
         self._region = region
         self._zone = zone
         self._use_spot_specified = use_spot is not None
@@ -137,6 +145,12 @@ class Resources:
             raise exceptions.InvalidSpecError(
                 f'Invalid spot_recovery {self._spot_recovery!r}; choose '
                 f'from {SPOT_RECOVERY_STRATEGIES}')
+        if self._cpus is not None:
+            from skypilot_tpu.catalog import vm_catalog
+            vm_catalog.parse_cpus(self._cpus)  # syntax check
+        if self._memory is not None:
+            from skypilot_tpu.catalog import vm_catalog
+            vm_catalog.parse_cpus(self._memory, field='memory')
         if self._accelerator is not None:
             from skypilot_tpu import clouds
             if (self._cloud or 'gcp') == 'gcp':
@@ -178,6 +192,24 @@ class Resources:
         if self._accelerator is None:
             return None
         return catalog.get_tpu_spec(self._accelerator)
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        """GCE machine type for accelerator-less tasks (cheapest type
+        satisfying cpus/memory; controller default otherwise). None
+        for TPU slices — their host shape is the slice's."""
+        if self._accelerator is not None:
+            return None
+        from skypilot_tpu.catalog import vm_catalog
+        return vm_catalog.instance_type_for(self._cpus, self._memory)
 
     @property
     def region(self) -> Optional[str]:
@@ -246,7 +278,14 @@ class Resources:
 
     def get_hourly_price(self) -> float:
         if self._accelerator is None:
-            return 0.0
+            # Controller-class VM: price the resolved machine type
+            # from the VM catalog (the local fake provider costs
+            # nothing).
+            if self._cloud == 'local':
+                return 0.0
+            from skypilot_tpu.catalog import vm_catalog
+            return vm_catalog.get_vm_hourly_cost(
+                self.instance_type, self._use_spot, self._region)
         return catalog.get_hourly_cost(self._accelerator, self._use_spot,
                                        self._region, self._zone)
 
@@ -278,12 +317,26 @@ class Resources:
                 return False
             if mine.chips > theirs.chips:
                 return False
+        elif other.accelerator is None and \
+                other.cloud not in (None, 'local'):
+            from skypilot_tpu.catalog import vm_catalog
+            if self._cpus is not None:
+                want, _ = vm_catalog.parse_cpus(self._cpus)
+                if want > vm_catalog.vcpus_of(other.instance_type):
+                    return False
+            if self._memory is not None:
+                want, _ = vm_catalog.parse_cpus(self._memory,
+                                                field='memory')
+                if want > vm_catalog.memory_gb_of(other.instance_type):
+                    return False
         return True
 
     def copy(self, **override) -> 'Resources':
         fields: Dict[str, Any] = dict(
             cloud=self._cloud,
             accelerators=self._accelerator,
+            cpus=self._cpus,
+            memory=self._memory,
             region=self._region,
             zone=self._zone,
             use_spot=self._use_spot if self._use_spot_specified else None,
@@ -312,13 +365,27 @@ class Resources:
 
     def make_deploy_variables(self, cluster_name_on_cloud: str)\
             -> Dict[str, Any]:
-        """Variables the provisioner needs to create this slice (analog
-        of ``sky/resources.py:1041`` + ``sky/clouds/gcp.py:460-485``
-        TPU deploy vars)."""
+        """Variables the provisioner needs to create this slice — or,
+        for accelerator-less (controller-class) tasks, this GCE VM
+        (analog of ``sky/resources.py:1041`` + ``sky/clouds/gcp.py:
+        460-485`` TPU deploy vars; VM analog ``GCPComputeInstance``
+        inputs, ``sky/provision/gcp/instance_utils.py:311``)."""
         spec = self.tpu_spec
         if spec is None:
-            raise exceptions.InvalidSpecError(
-                'Cannot deploy a Resources without an accelerator.')
+            from skypilot_tpu import authentication
+            return {
+                'cluster_name_on_cloud': cluster_name_on_cloud,
+                'ssh_public_key': authentication.gcp_ssh_key_metadata(),
+                'machine_type': self.instance_type,
+                'num_hosts': 1,
+                'use_spot': self._use_spot,
+                'region': self._region,
+                'zone': self._zone,
+                'disk_size': self._disk_size,
+                'image_id': self._image_id,
+                'ports': self._ports or [],
+                'labels': self._labels or {},
+            }
         from skypilot_tpu import authentication
         return {
             'cluster_name_on_cloud': cluster_name_on_cloud,
@@ -375,6 +442,8 @@ class Resources:
         known = dict(
             cloud=config.pop('cloud', None),
             accelerators=config.pop('accelerators', None),
+            cpus=config.pop('cpus', None),
+            memory=config.pop('memory', None),
             region=config.pop('region', None),
             zone=config.pop('zone', None),
             use_spot=config.pop('use_spot', None),
@@ -401,6 +470,10 @@ class Resources:
             out['cloud'] = self._cloud
         if self._accelerator:
             out['accelerators'] = self._accelerator
+        if self._cpus:
+            out['cpus'] = self._cpus
+        if self._memory:
+            out['memory'] = self._memory
         if self._region:
             out['region'] = self._region
         if self._zone:

@@ -21,6 +21,12 @@ _RESOURCES_FIELDS = {
         'anyOf': [{'type': 'string'}, {'type': 'null'},
                   {'type': 'array', 'items': {'type': 'string'}}],
     },
+    # CPU/memory requests for accelerator-less (controller-class) VMs:
+    # N or 'N+' (at least N).
+    'cpus': {'anyOf': [{'type': 'integer'}, {'type': 'string'},
+                       {'type': 'null'}]},
+    'memory': {'anyOf': [{'type': 'integer'}, {'type': 'string'},
+                         {'type': 'null'}]},
     'region': {'type': ['string', 'null']},
     'zone': {'type': ['string', 'null']},
     'use_spot': {'type': ['boolean', 'null']},

