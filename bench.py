@@ -251,8 +251,11 @@ def main() -> None:
     # residuals; saving neither re-runs an avoidable [d, ffn] matmul
     # per layer in backward).
     remat_saves = os.environ.get('BENCH_REMAT_SAVES', 'attn+mlp_up')
-    config = llama.get_config(model_name, max_seq_len=seq,
-                              remat_saves=remat_saves)
+    config = llama.get_config(
+        model_name, max_seq_len=seq, remat_saves=remat_saves,
+        # BENCH_REMAT=0: no per-layer remat at all — XLA saves every
+        # residual (fits for small models; trades HBM for FLOPs).
+        remat=os.environ.get('BENCH_REMAT', '1') == '1')
 
     mesh = make_mesh(MeshConfig(fsdp=n_devices))
     state, shardings = init_train_state(

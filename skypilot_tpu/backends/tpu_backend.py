@@ -69,6 +69,10 @@ class TpuBackend(Backend):
                 # Never under dryrun: the handshake does live agent
                 # calls and may restart the cluster runtime.
                 self._ensure_runtime_version(handle)
+                # Re-assert the job-slot policy: a CPU controller
+                # cluster's parallelism may have been reconfigured
+                # (env override) since it was provisioned.
+                self._write_job_slots(handle)
                 # A reused cluster may be asked for ports the original
                 # launch did not open (serve: one LB port per service
                 # on the shared controller cluster) — open the union.
@@ -183,6 +187,35 @@ class TpuBackend(Backend):
             instance_setup.stop_runtime_on_cluster(handle)
         self._post_provision_runtime_setup(handle)
 
+    def _write_job_slots(self, handle: ClusterHandle) -> None:
+        """Job-slot policy: TPU clusters run one job at a time (a
+        slice is one atomic allocation); CPU-only clusters (managed-
+        jobs / serve controllers) run as many controller processes as
+        the machine-size heuristic allows — the cluster's FIFO job
+        queue IS the admission control (ref sky/jobs/scheduler.py:
+        257). The heuristic is evaluated ON THE CONTROLLER HOST
+        (its memory / its env), not the client machine — a laptop
+        must not size an e2-standard-2's parallelism."""
+        res = handle.launched_resources
+        is_tpu = res is not None and res.accelerator is not None
+        rdir = handle.head_runtime_dir
+        if is_tpu:
+            cmd = f'echo 1 > {rdir}/job_slots'
+        else:
+            body = (
+                "from skypilot_tpu.jobs import scheduler\n"
+                "import os\n"
+                "path = os.path.join(os.path.expanduser("
+                "os.environ['SKYTPU_RUNTIME_DIR']), 'job_slots')\n"
+                "with open(path, 'w') as f:\n"
+                "    f.write(str(scheduler."
+                "get_job_parallelism()))\n")
+            cmd = codegen._wrap(rdir, body)  # pylint: disable=protected-access
+        out = handle.head_agent().exec(cmd, timeout=30)
+        if out.get('returncode') != 0:
+            logger.warning('writing job_slots returned %s: %s',
+                           out.get('returncode'), out.get('output'))
+
     def _post_provision_runtime_setup(self,
                                       handle: ClusterHandle) -> None:
         """Agents healthy on every host + skylet running on head
@@ -212,13 +245,7 @@ class TpuBackend(Backend):
         # many "hosts" per machine; a global guard would let the first
         # cluster's skylet suppress every later cluster's).
         rdir = handle.head_runtime_dir
-        # Job-slot policy: TPU clusters run one job at a time (a slice
-        # is one atomic allocation); CPU-only clusters (managed-jobs
-        # controller) run many (ref sky/jobs/scheduler.py:257).
-        res = handle.launched_resources
-        is_tpu = res is not None and res.accelerator is not None
-        slots = 1 if is_tpu else 16
-        head.exec(f'echo {slots} > {rdir}/job_slots', timeout=15)
+        self._write_job_slots(handle)
         # The ( ... & ) grouping is load-bearing: without it, bash
         # backgrounds the whole `pgrep || nohup ...` list and the
         # forked subshell waits on skylet forever while holding the

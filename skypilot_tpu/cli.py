@@ -460,11 +460,12 @@ def serve_logs(service_name, replica_id, follow):
     """Stream a service's controller (default) or replica logs
     (analog of ``sky serve logs``, sky/cli.py serve group)."""
     from skypilot_tpu import core as core_lib
-    from skypilot_tpu.serve import serve_state
-    rec = serve_state.get_service(service_name)
-    if rec is None:
+    from skypilot_tpu.serve import core as serve_core
+    records = serve_core.status(service_name)
+    if not records:
         raise click.ClickException(
             f'Service {service_name!r} does not exist.')
+    rec = records[0]
     if replica_id is None:
         if not rec['controller_cluster'] or \
                 not rec['controller_job_id']:
@@ -474,11 +475,9 @@ def serve_logs(service_name, replica_id, follow):
         core_lib.tail_logs(rec['controller_cluster'],
                            rec['controller_job_id'], follow=follow)
         return
-    target = serve_state.get_replica(service_name, replica_id)
-    if target is None:
-        raise click.ClickException(
-            f'No replica {replica_id} in service {service_name!r}.')
-    core_lib.tail_logs(target['cluster_name'], follow=follow)
+    # Replica clusters live in the controller's state DB; the dump
+    # rides the controller hop (one shot — --follow does not apply).
+    serve_core.tail_replica_logs(service_name, replica_id)
 
 
 @serve_group.command(name='terminate-replica')

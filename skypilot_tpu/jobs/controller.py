@@ -29,6 +29,13 @@ MAX_RECOVERIES = int(os.environ.get('SKYTPU_JOBS_MAX_RECOVERIES',
                                     '10'))
 
 
+def archived_log_path(job_id: int) -> str:
+    """Controller-local archive of the managed job's task logs."""
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(base, 'job_logs', f'job-{job_id}.log')
+
+
 class JobsController:
 
     def __init__(self, managed_job_id: int, dag_yaml_path: str):
@@ -58,6 +65,20 @@ class JobsController:
         if record is None:
             return None
         return record['handle'].region
+
+    def _archive_logs(self, cluster_name: str) -> None:
+        """Pull the task cluster's run.log into a controller-local
+        file BEFORE teardown, so `jobs logs` works after the cluster
+        is gone (the reference keeps managed-job logs with the
+        controller, sky/jobs/utils.py stream_logs)."""
+        path = archived_log_path(self.job_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            with open(path, 'a', encoding='utf-8') as f:
+                core_lib.tail_logs(cluster_name, out=f, follow=False)
+        except (exceptions.SkyTpuError, OSError) as e:
+            logger.warning('archiving logs of %s: %s', cluster_name,
+                           e)
 
     def _cluster_is_alive(self, cluster_name: str) -> bool:
         """Preemption check: query the provider for actual instance
@@ -114,6 +135,7 @@ class JobsController:
             if jobs_state.cancel_requested(self.job_id):
                 logger.info('Cancel requested; tearing down %s',
                             cluster_name)
+                self._archive_logs(cluster_name)
                 strategy.terminate_cluster(cluster_name)
                 jobs_state.clear_cancel(self.job_id)
                 return jobs_state.ManagedJobStatus.CANCELLED
@@ -148,6 +170,7 @@ class JobsController:
             if status == job_lib.JobStatus.SUCCEEDED:
                 logger.info('Task %d succeeded; tearing down %s', idx,
                             cluster_name)
+                self._archive_logs(cluster_name)
                 strategy.terminate_cluster(cluster_name)
                 return jobs_state.ManagedJobStatus.SUCCEEDED
             if status in (job_lib.JobStatus.FAILED,
@@ -173,6 +196,7 @@ class JobsController:
                             self.job_id,
                             jobs_state.ManagedJobStatus.RUNNING)
                         continue
+                self._archive_logs(cluster_name)
                 strategy.terminate_cluster(cluster_name)
                 return (jobs_state.ManagedJobStatus.FAILED_SETUP
                         if status == job_lib.JobStatus.FAILED_SETUP
@@ -207,23 +231,28 @@ class JobsController:
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument('--job-id', type=int, required=True)
+    # The managed job id IS this process's cluster job id (exported
+    # by the gang driver); an explicit --job-id is for tests.
+    parser.add_argument('--job-id', type=int, default=None)
     parser.add_argument('--dag-yaml', required=True)
+    parser.add_argument('--name', default='managed-job')
+    parser.add_argument('--controller-cluster', default='')
     args = parser.parse_args()
-    controller = JobsController(args.job_id, args.dag_yaml)
-    try:
-        final = controller.run()
-    finally:
-        # A controller slot freed: admit the next PENDING managed job
-        # (reference: maybe_schedule_next_jobs on every transition,
-        # sky/jobs/scheduler.py:79).
-        from skypilot_tpu.jobs import core as jobs_core
-        try:
-            jobs_core.maybe_schedule_next_jobs()
-        except Exception:  # pylint: disable=broad-except
-            logger.exception('scheduling next pending jobs failed')
-    logger.info('managed job %d finished: %s', args.job_id,
-                final.value)
+    job_id = args.job_id
+    if job_id is None:
+        job_id = int(os.environ['SKYTPU_CLUSTER_JOB_ID'])
+    # Self-register (idempotent vs the client's post-submit RPC):
+    # a controller that got a job slot before the client's ensure_job
+    # landed must still have a row to drive.
+    jobs_state.ensure_job(job_id, args.name, args.dag_yaml,
+                          args.controller_cluster)
+    if jobs_state.get_job(job_id)['status'] == \
+            jobs_state.ManagedJobStatus.CANCELLED:
+        # Cancelled while still queued; nothing to do.
+        raise SystemExit(1)
+    controller = JobsController(job_id, args.dag_yaml)
+    final = controller.run()
+    logger.info('managed job %d finished: %s', job_id, final.value)
     raise SystemExit(
         0 if final == jobs_state.ManagedJobStatus.SUCCEEDED else 1)
 

@@ -1,18 +1,29 @@
 """Managed jobs client API (analog of ``sky/jobs/core.py``).
 
-``launch`` embeds the user DAG yaml into a controller task and runs
-it on the jobs-controller cluster via the ordinary launch path — the
+``launch`` ships the user DAG to the jobs-controller cluster and
+submits a controller task through the ordinary exec path — the
 reference's "controller is just a task" recursion
-(``sky/jobs/core.py:39-146``). On the controller the task runs
-``skypilot_tpu.jobs.controller`` for the job.
+(``sky/jobs/core.py:39-146``). The managed job id IS the controller
+cluster's job id (same contract as the reference), and ALL managed-job
+state lives controller-side: the client's ``queue`` / ``cancel`` /
+``logs`` are codegen-RPC calls to the controller cluster's head
+(``jobs/codegen.py``; reference ``ManagedJobCodeGen``,
+``sky/jobs/utils.py``). Admission control is the controller cluster's
+own FIFO job queue: its job-slot count (``scheduler.
+get_job_parallelism``) bounds concurrent controller processes, and
+queued controllers sit PENDING until a slot frees.
 """
+import base64
 import os
 import shlex
+import time
 from typing import Any, Dict, List, Optional, Union
 
+from skypilot_tpu import exceptions
 from skypilot_tpu import execution
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.dag import Dag
+from skypilot_tpu.jobs import codegen as jobs_codegen
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
@@ -22,20 +33,11 @@ logger = tpu_logging.init_logger(__name__)
 
 CONTROLLER_CLUSTER_PREFIX = 'sky-jobs-controller-'
 
+ManagedJobStatus = jobs_state.ManagedJobStatus
+
 
 def _controller_cluster_name() -> str:
     return CONTROLLER_CLUSTER_PREFIX + common_utils.get_user_hash()
-
-
-def _dag_to_yaml(dag_or_task: Union[Dag, Task], path: str) -> None:
-    import yaml
-    if isinstance(dag_or_task, Task):
-        tasks = [dag_or_task]
-    else:
-        tasks = list(dag_or_task.tasks)
-    docs = [t.to_yaml_config() for t in tasks]
-    with open(path, 'w', encoding='utf-8') as f:
-        yaml.safe_dump_all(docs, f, sort_keys=False)
 
 
 def _controller_resources() -> Resources:
@@ -44,86 +46,69 @@ def _controller_resources() -> Resources:
     return Resources()
 
 
-def _state_dir() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+def _dag_to_yaml_bytes(dag_or_task: Union[Dag, Task]) -> bytes:
+    import yaml
+    if isinstance(dag_or_task, Task):
+        tasks = [dag_or_task]
+    else:
+        tasks = list(dag_or_task.tasks)
+    docs = [t.to_yaml_config() for t in tasks]
+    return yaml.safe_dump_all(docs, sort_keys=False).encode()
 
 
-def _spawn_controller(job_id: int, dag_yaml_path: str) -> int:
-    """Launch the per-job controller process on the controller
-    cluster; returns the controller's cluster-job id."""
-    state_dir = _state_dir()
-    controller_cluster = _controller_cluster_name()
-    # The controller task: runs the per-job controller process. The
-    # client state dir is forwarded so the controller (local provider:
-    # same machine; gcp: the controller VM's own dir) sees the same
-    # managed-jobs DB.
-    controller_task = Task(
-        name=f'jobs-controller-{job_id}',
-        run=(f'SKYTPU_STATE_DIR={shlex.quote(state_dir)} '
-             f'python3 -m skypilot_tpu.jobs.controller '
-             f'--job-id {job_id} --dag-yaml '
-             f'{shlex.quote(dag_yaml_path)}'),
-    )
-    controller_task.set_resources(_controller_resources())
-    jobs_state.set_status(job_id,
-                          jobs_state.ManagedJobStatus.SUBMITTED)
-    controller_job_id, _ = execution.launch(
-        controller_task, controller_cluster, fast=True,
-        detach_run=True, quiet_optimizer=True, retry_until_up=True)
-    jobs_state.set_controller_job(job_id, controller_job_id)
-    logger.info('Managed job %d submitted (controller cluster %s, '
-                'controller job %s)', job_id, controller_cluster,
-                controller_job_id)
-    return controller_job_id
+def _get_controller_handle(must_exist: bool = True):
+    from skypilot_tpu import state
+    record = state.get_cluster_from_name(_controller_cluster_name())
+    if record is None:
+        if must_exist:
+            raise exceptions.ClusterDoesNotExist(
+                'No jobs-controller cluster — no managed jobs have '
+                'been launched from this machine.')
+        return None
+    return record['handle']
 
 
-def _admission_lock():
-    """Inter-process lock for the admission check-then-spawn (same
-    pattern as runtime job_lib.queue_lock: two controller exits
-    scheduling simultaneously must not double-spawn)."""
-    from skypilot_tpu.utils import timeline
-    os.makedirs(_state_dir(), exist_ok=True)
-    return timeline.FileLockEvent(
-        os.path.join(_state_dir(), '.jobs_admission.lock'))
+def _ensure_controller_cluster():
+    """Provision (or reuse) the controller cluster; returns its
+    handle. A run-less task goes through the ordinary launch path
+    (provision + runtime bring-up, no job submitted)."""
+    up_task = Task(name='jobs-controller-up')
+    up_task.set_resources(_controller_resources())
+    execution.launch(up_task, _controller_cluster_name(), fast=True,
+                     detach_run=True, quiet_optimizer=True,
+                     retry_until_up=True)
+    return _get_controller_handle()
 
 
-def maybe_schedule_next_jobs() -> None:
-    """Admission control: spawn controllers for PENDING managed jobs
-    while ``scheduler.can_admit()`` allows (analog of
-    ``sky/jobs/scheduler.py:79`` maybe_schedule_next_jobs — called on
-    submission and on every controller exit)."""
-    from skypilot_tpu.jobs import scheduler
-    with _admission_lock():
-        while scheduler.can_admit():
-            pending = [
-                r for r in reversed(jobs_state.get_jobs())
-                if r['status'] == jobs_state.ManagedJobStatus.PENDING
-                and r['dag_yaml_path']
-            ]
-            if not pending:
-                return
-            job = pending[0]  # oldest
-            try:
-                _spawn_controller(job['job_id'], job['dag_yaml_path'])
-            except Exception:  # pylint: disable=broad-except
-                logger.exception('Failed to spawn controller for '
-                                 'managed job %d', job['job_id'])
-                jobs_state.set_status(
-                    job['job_id'],
-                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER)
+def _controller_rpc(handle, cmd: str, timeout: float = 60.0) -> str:
+    out = handle.head_agent().exec(cmd, timeout=timeout)
+    if out.get('returncode') != 0:
+        raise exceptions.CommandError(
+            out.get('returncode', 1), 'jobs controller RPC',
+            out.get('output', ''))
+    return out.get('output', '')
+
+
+def _parse(output: str, tag: str) -> str:
+    from skypilot_tpu.runtime import codegen
+    value = codegen.parse_tagged(output, tag)
+    if value is None:
+        raise exceptions.CommandError(1, f'jobs RPC ({tag})', output)
+    return value
+
+
+def _to_record(r: Dict[str, Any]) -> Dict[str, Any]:
+    r = dict(r)
+    r['status'] = ManagedJobStatus(r['status'])
+    return r
 
 
 def launch(dag_or_task: Union[Dag, Task],
            name: Optional[str] = None,
            detach: bool = True) -> int:
-    """Submit a managed job; returns the managed job id.
-
-    Controller-process spawn is gated on ``scheduler.can_admit()``:
-    above the limit the job stays PENDING and is picked up when a
-    running controller exits."""
+    """Submit a managed job; returns the managed job id (== the
+    controller cluster's job id for this job's controller)."""
     if isinstance(dag_or_task, Dag) and not dag_or_task.is_chain():
-        from skypilot_tpu import exceptions
         raise exceptions.NotSupportedError(
             'Managed jobs execute chain DAGs only (same restriction '
             'as the reference).')
@@ -138,46 +123,78 @@ def launch(dag_or_task: Union[Dag, Task],
                  else dag_or_task)
         name = first.name or 'managed-job'
 
-    state_dir = _state_dir()
-    dag_dir = os.path.join(state_dir, 'managed_dags')
-    os.makedirs(dag_dir, exist_ok=True)
+    handle = _ensure_controller_cluster()
     controller_cluster = _controller_cluster_name()
-    job_id = jobs_state.add_job(name, '', controller_cluster)
-    dag_yaml_path = os.path.join(dag_dir, f'dag-{job_id}.yaml')
-    _dag_to_yaml(dag_or_task, dag_yaml_path)
-    jobs_state._db().execute_and_commit(  # pylint: disable=protected-access
-        'UPDATE managed_jobs SET dag_yaml_path=? WHERE job_id=?',
-        (dag_yaml_path, job_id))
 
-    from skypilot_tpu.jobs import scheduler
-    with _admission_lock():
-        admit = scheduler.can_admit()
-        if admit:
-            try:
-                _spawn_controller(job_id, dag_yaml_path)
-            except Exception:
-                # Never leave a phantom SUBMITTED row: it would count
-                # against the admission limit forever.
-                jobs_state.set_status(
-                    job_id,
-                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER)
-                raise
-    if not admit:
-        logger.info('Managed job %d queued PENDING (admission limit '
-                    '%d reached)', job_id,
-                    scheduler.get_job_parallelism())
+    # Ship the DAG to the controller's state dir over the agent
+    # channel (head-only is enough: the controller process runs on
+    # the head).
+    import uuid
+    rdir = handle.head_runtime_dir
+    dag_name = f'dag-{uuid.uuid4().hex[:12]}.yaml'
+    remote_dag = os.path.join(rdir, jobs_codegen.STATE_SUBDIR,
+                              'managed_dags', dag_name)
+    handle.head_agent().put_file(remote_dag,
+                                 _dag_to_yaml_bytes(dag_or_task))
+
+    # Controller task: registers itself under its cluster job id
+    # (exported by the gang driver as SKYTPU_CLUSTER_JOB_ID).
+    controller_task = Task(
+        name=f'jobs-controller-{name}',
+        run=(f'{jobs_codegen.state_dir_cmd(rdir)} '
+             f'python3 -m skypilot_tpu.jobs.controller '
+             f'--dag-yaml {shlex.quote(remote_dag)} '
+             f'--name {shlex.quote(name)} '
+             f'--controller-cluster '
+             f'{shlex.quote(controller_cluster)}'),
+    )
+    controller_task.set_resources(_controller_resources())
+    job_id, _ = execution.exec_(controller_task, controller_cluster,
+                                detach_run=True)
+    assert job_id is not None
+    # Register the row now so `jobs queue` shows PENDING even before
+    # the controller process gets a job slot (idempotent vs the
+    # controller's own ensure_job).
+    _controller_rpc(handle, jobs_codegen.ensure_job(
+        rdir, job_id, name, remote_dag, controller_cluster))
+    logger.info('Managed job %d submitted (controller cluster %s)',
+                job_id, controller_cluster)
     if not detach:
         wait(job_id)
     return job_id
 
 
+def get(job_id: int) -> Optional[Dict[str, Any]]:
+    """One managed-job record from the controller, or None."""
+    handle = _get_controller_handle()
+    out = _controller_rpc(handle, jobs_codegen.get_job(
+        handle.head_runtime_dir, job_id))
+    payload = _parse(out, 'JOB')
+    if payload == 'null':
+        return None
+    import json
+    return _to_record(json.loads(payload))
+
+
+def queue() -> List[Dict[str, Any]]:
+    """All managed jobs, newest first (controller-side truth)."""
+    handle = _get_controller_handle(must_exist=False)
+    if handle is None:
+        return []
+    out = _controller_rpc(handle, jobs_codegen.get_jobs(
+        handle.head_runtime_dir))
+    import json
+    return [_to_record(r) for r in json.loads(_parse(out, 'JOBS'))]
+
+
 def wait(job_id: int, timeout: float = 3600.0,
          poll: float = 2.0) -> jobs_state.ManagedJobStatus:
-    import time
     deadline = time.time() + timeout
     while time.time() < deadline:
-        rec = jobs_state.get_job(job_id)
-        assert rec is not None, job_id
+        rec = get(job_id)
+        if rec is None:
+            raise exceptions.JobError(
+                f'managed job {job_id} unknown to the controller')
         if rec['status'].is_terminal():
             return rec['status']
         time.sleep(poll)
@@ -185,29 +202,45 @@ def wait(job_id: int, timeout: float = 3600.0,
                        f'{timeout}s')
 
 
-def queue() -> List[Dict[str, Any]]:
-    return jobs_state.get_jobs()
-
-
 def cancel(job_id: int) -> None:
-    with _admission_lock():
-        rec = jobs_state.get_job(job_id)
-        if rec is not None and \
-                rec['status'] == jobs_state.ManagedJobStatus.PENDING:
-            # No controller exists yet to act on a cancel signal — a
-            # CANCELLING row would sit non-terminal forever and eat an
-            # admission slot. Terminal-cancel it directly.
-            jobs_state.set_status(
-                job_id, jobs_state.ManagedJobStatus.CANCELLED)
+    handle = _get_controller_handle()
+    out = _controller_rpc(handle, jobs_codegen.cancel_job(
+        handle.head_runtime_dir, job_id))
+    result = _parse(out, 'CANCEL')
+    if result == 'no-such-job':
+        raise exceptions.JobError(
+            f'managed job {job_id} unknown to the controller')
+
+
+def tail_logs(job_id: int, out=None, follow: bool = True,
+              poll: float = 2.0) -> None:
+    """Stream the managed job's logs via the controller (archived
+    finished-task logs + the live task cluster's run.log; the task
+    clusters live in the controller's state DB and the client cannot
+    reach them directly). Follow mode polls with a moving byte
+    offset — only the unseen suffix crosses the wire; a recovery's
+    fresh (shorter) log resets the offset."""
+    import sys
+    out = out or sys.stdout
+    handle = _get_controller_handle()
+    offset = 0
+    while True:
+        resp = _controller_rpc(handle, jobs_codegen.dump_task_log(
+            handle.head_runtime_dir, job_id, offset), timeout=120.0)
+        status = _parse(resp, 'STATUS')
+        if status == 'UNKNOWN':
+            raise exceptions.JobError(
+                f'managed job {job_id} unknown to the controller')
+        total = int(_parse(resp, 'TOTAL'))
+        if total < offset:
+            offset = 0  # log shrank (recovery): restart from scratch
+            continue
+        chunk = base64.b64decode(_parse(resp, 'LOGB64')).decode(
+            'utf-8', errors='replace')
+        if chunk:
+            out.write(chunk)
+            out.flush()
+        offset = total
+        if not follow or ManagedJobStatus(status).is_terminal():
             return
-    jobs_state.request_cancel(job_id)
-
-
-def tail_logs(job_id: int, out=None) -> None:
-    """Stream the current task cluster's logs for a managed job."""
-    from skypilot_tpu import core as core_lib
-    rec = jobs_state.get_job(job_id)
-    if rec is None or not rec['task_cluster']:
-        raise ValueError(f'managed job {job_id} has no task cluster '
-                         'yet')
-    core_lib.tail_logs(rec['task_cluster'], out=out)
+        time.sleep(poll)

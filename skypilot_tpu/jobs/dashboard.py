@@ -95,9 +95,27 @@ setInterval(refresh, 5000);
 """
 
 
+def _get_records():
+    """Managed-job rows: controller-side truth via RPC when a
+    controller cluster exists (client-side dashboard), else the local
+    DB (dashboard running on the controller itself, or no managed
+    jobs launched from this machine yet)."""
+    from skypilot_tpu.jobs import core as jobs_core
+
+    def _local_cancel(job_id: int) -> None:
+        jobs_state.request_cancel(job_id)
+
+    handle = jobs_core._get_controller_handle(  # pylint: disable=protected-access
+        must_exist=False)
+    if handle is None:
+        return jobs_state.get_jobs(), _local_cancel
+    return jobs_core.queue(), jobs_core.cancel
+
+
 def _jobs_json() -> bytes:
     records = []
-    for r in jobs_state.get_jobs():
+    rows, _ = _get_records()
+    for r in rows:
         rec = dict(r)
         status = rec.pop('status')
         rec['status'] = status.value
@@ -149,10 +167,16 @@ class _Handler(BaseHTTPRequestHandler):
         except (KeyError, ValueError, IndexError):
             self._send(400, b'{"error": "missing job"}')
             return
-        if jobs_state.get_job(job_id) is None:
+        from skypilot_tpu import exceptions
+        rows, cancel_fn = _get_records()
+        if not any(r['job_id'] == job_id for r in rows):
             self._send(404, b'{"error": "no such job"}')
             return
-        jobs_state.request_cancel(job_id)
+        try:
+            cancel_fn(job_id)
+        except exceptions.SkyTpuError as e:
+            self._send(500, json.dumps({'error': str(e)}).encode())
+            return
         self._send(200, b'{"ok": true}')
 
 

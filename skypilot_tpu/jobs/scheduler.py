@@ -1,21 +1,20 @@
-"""Controller-wide admission control (analog of
+"""Controller-machine parallelism limits (analog of
 ``sky/jobs/scheduler.py``).
 
-Limits concurrent controller processes by machine size, the same
-heuristics as the reference: launches ≈ 4×CPU
+Sizing heuristics match the reference: launches ≈ 4×CPU
 (``_get_launch_parallelism:265``), running jobs ≈ memory/350MB
-(``_get_job_parallelism:257``). ``launch_slot`` bounds concurrent
-cluster launches/recoveries across all controller processes
-(reference throttles launches the same way, ``:257-270`` — an
-unbounded recovery storm after a zone-wide preemption would hammer
-the cloud API and the controller VM).
+(``_get_job_parallelism:257``). ``get_job_parallelism`` becomes the
+controller CLUSTER's job-slot count (written by the backend at
+provision), so admission control is the cluster's own FIFO job queue:
+excess controller jobs sit PENDING until a slot frees. ``launch_slot``
+bounds concurrent cluster launches/recoveries across all controller
+processes on the machine (reference throttles launches the same way,
+``:257-270`` — an unbounded recovery storm after a zone-wide
+preemption would hammer the cloud API and the controller VM).
 """
 import contextlib
 import os
 import time
-
-
-from skypilot_tpu.jobs import state as jobs_state
 
 
 def _cpu_count() -> int:
@@ -79,12 +78,3 @@ def get_job_parallelism() -> int:
         except ValueError:
             pass
     return max(4, int(_memory_gb() * 1024 / 350))
-
-
-def can_admit() -> bool:
-    """May a new managed job's controller start now?"""
-    active = [
-        r for r in jobs_state.get_nonterminal_jobs()
-        if r['status'] != jobs_state.ManagedJobStatus.PENDING
-    ]
-    return len(active) < get_job_parallelism()

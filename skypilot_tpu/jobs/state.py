@@ -97,6 +97,21 @@ def add_job(name: str, dag_yaml_path: str,
     return int(job_id)
 
 
+def ensure_job(job_id: int, name: str, dag_yaml_path: str,
+               controller_cluster: str) -> None:
+    """Idempotently register a managed-job row with an EXPLICIT id —
+    the controller-cluster job id (managed job id == cluster job id,
+    same contract as the reference). Called both by the client right
+    after submission (for PENDING visibility) and by the controller
+    process at startup (whichever wins, the other is a no-op)."""
+    _db().execute_and_commit(
+        'INSERT OR IGNORE INTO managed_jobs (job_id, name, status, '
+        'submitted_at, dag_yaml_path, controller_cluster) '
+        'VALUES (?,?,?,?,?,?)',
+        (job_id, name, ManagedJobStatus.PENDING.value, time.time(),
+         dag_yaml_path, controller_cluster))
+
+
 def set_status(job_id: int, status: ManagedJobStatus,
                failure_reason: Optional[str] = None) -> None:
     db = _db()

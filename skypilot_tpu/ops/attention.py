@@ -31,13 +31,18 @@ is the TPU-native replacement for the torch SDPA the reference's
 recipes rely on.
 """
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-_DEFAULT_BLOCK_Q = 512
-_DEFAULT_BLOCK_K = 512
+# Default flash tile sizes; env-overridable for block-size sweeps on
+# new chips/shapes without touching call sites (read at import).
+_DEFAULT_BLOCK_Q = int(os.environ.get('SKYTPU_FLASH_BLOCK_Q', '512'))
+_DEFAULT_BLOCK_K = int(os.environ.get('SKYTPU_FLASH_BLOCK_K', '512'))
+_ENV_BLOCK_Q_BWD = os.environ.get('SKYTPU_FLASH_BLOCK_Q_BWD')
+_ENV_BLOCK_K_BWD = os.environ.get('SKYTPU_FLASH_BLOCK_K_BWD')
 _NEG_INF = -1e30
 # f32 min sublane tile: statistics (lse/delta) are stored [B, H, 8, T]
 # with 8 broadcast sublanes so their (8, block) VMEM tiles satisfy
@@ -674,11 +679,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # owns the surrounding layouts) reusing the fwd (512, 512) tile
     # measured ~6% faster end-to-end on v5e at the 1B shapes — trust
     # the end-to-end number.
+    if block_q_bwd is None and _ENV_BLOCK_Q_BWD:
+        block_q_bwd = int(_ENV_BLOCK_Q_BWD)
+    if block_k_bwd is None and _ENV_BLOCK_K_BWD:
+        block_k_bwd = int(_ENV_BLOCK_K_BWD)
     if block_q_bwd is None:
         block_q_bwd = block_q
     if block_k_bwd is None:
         block_k_bwd = block_k
-    use_pallas = force_pallas or _on_tpu()
+    # SKYTPU_NO_FLASH=1: route through the XLA reference attention
+    # even on TPU (A/B lever — on some chip/shape points XLA's fused
+    # attention beats the Pallas kernels, cf. the decode path where
+    # dense XLA won on v5e).
+    use_pallas = (force_pallas or _on_tpu()) and \
+        os.environ.get('SKYTPU_NO_FLASH', '0') != '1'
     # The kernels want block-divisible sequence lengths.
     if use_pallas and (t % min(block_q, t) == 0 and
                        s % min(block_k, s) == 0 and
@@ -706,4 +720,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if rope_angles is not None:
         q = apply_rope(q, rope_angles)
         k = apply_rope(k, rope_angles)
-    return dot_product_attention(q, k, v, causal=causal, scale=scale)
+    out = dot_product_attention(q, k, v, causal=causal, scale=scale)
+    # Same residual tag as the Pallas path so layer-level remat
+    # policies (save_only_these_names('flash_attn_out', ...)) keep
+    # the attention output either way; backward recomputes
+    # scores/softmax from (recomputed) qkv — the standard memory-
+    # efficient trade.
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(out, 'flash_attn_out')

@@ -32,11 +32,23 @@ logger = tpu_logging.init_logger(__name__)
 
 _LABEL_CLUSTER = 'skytpu-cluster'
 
-# cluster_name_on_cloud -> (kind, zone); kind in {'tpu', 'vm'}.
-# Process-local hint only — every lookup that misses (or whose hint
-# has gone stale) falls back to the full API sweep, so a cache from a
-# previous failover attempt can never hide a live resource.
-_placement_cache: Dict[str, Tuple[str, str]] = {}
+# cluster_name_on_cloud -> (kind, zone, slice_count); kind in
+# {'tpu', 'vm'}. Process-local hint only — every lookup that misses
+# (or whose hint has gone stale) falls back to the full API sweep, so
+# a cache from a previous failover attempt can never hide a live
+# resource.
+_placement_cache: Dict[str, Tuple[str, str, int]] = {}
+
+
+def _slice_names(node_id: str, count: int) -> List[str]:
+    """On-cloud node names for an N-slice cluster. A single slice
+    keeps the bare name (backward compatible); multi-slice clusters
+    are ``<name>-s0..s{N-1}``, rank-ordered slice-major (reference
+    fan-out contract: ``sky/backends/cloud_vm_ray_backend.py:
+    5062-5076``)."""
+    if count <= 1:
+        return [node_id]
+    return [f'{node_id}-s{i}' for i in range(count)]
 
 
 def _node_url(project: str, zone: str, node_id: str = '') -> str:
@@ -75,58 +87,107 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
                 'node config; Resources.make_deploy_variables should '
                 'have resolved one from the VM catalog.')
         record = compute_instance.create_instance(config, zone)
-        _placement_cache[node_id] = ('vm', zone)
+        _placement_cache[node_id] = ('vm', zone, 1)
         return record
 
     project = gcp_client.get_project_id()
-    existing = _get_node(project, zone, node_id)
-    if existing is not None:
-        state = existing.get('state')
-        if state == 'READY':
-            logger.info('TPU node %s already READY; reusing.', node_id)
+    count = max(1, config.count)
+    names = _slice_names(node_id, count)
+
+    existing = [_get_node(project, zone, n) for n in names]
+    if all(n is not None for n in existing):
+        states = {n.get('state') for n in existing}
+        if states == {'READY'}:
+            logger.info('TPU slice set %s already READY; reusing.',
+                        node_id)
+            _placement_cache[node_id] = ('tpu', zone, count)
             return ProvisionRecord(
                 provider='gcp', region=config.region, zone=zone,
                 cluster_name_on_cloud=node_id, resumed=True,
-                created_instance_ids=[node_id])
-        if state in ('STOPPED',):
+                created_instance_ids=list(names))
+        if states == {'STOPPED'} and count == 1:
             logger.info('Starting stopped TPU node %s', node_id)
             op = gcp_client.request(
                 'POST', _node_url(project, zone, node_id) + ':start')
             gcp_client.wait_operation(
                 f'{gcp_client.TPU_API}/{op["name"]}')
+            _placement_cache[node_id] = ('tpu', zone, 1)
             return ProvisionRecord(
                 provider='gcp', region=config.region, zone=zone,
                 cluster_name_on_cloud=node_id, resumed=True,
                 created_instance_ids=[node_id])
+    elif any(n is not None for n in existing):
+        # Partial slice set left by an earlier failed create: clear
+        # it so the gang comes up atomically or not at all.
+        logger.warning('Partial slice set for %s; cleaning up before '
+                       'recreate.', node_id)
+        for name, node in zip(names, existing):
+            if node is not None:
+                _delete_node(project, zone, name)
 
-    body: Dict[str, Any] = {
-        'acceleratorType': node_cfg['accelerator_type'],
-        'runtimeVersion': node_cfg['runtime_version'],
-        'networkConfig': {
-            'network': node_cfg.get('network', 'default'),
-            'enableExternalIps': True,
-        },
-        'labels': {_LABEL_CLUSTER: node_id,
-                   **(node_cfg.get('labels') or {})},
-        'metadata': {
-            'ssh-keys': node_cfg.get('ssh_public_key', ''),
-        },
-        'schedulingConfig': {
-            'preemptible': bool(node_cfg.get('use_spot', False)),
-        },
-        'tags': ['skytpu'],
-    }
-    if node_cfg.get('disk_size'):
-        body['dataDisks'] = []  # boot disk size fixed for TPU VMs
-    logger.info('Creating TPU %s (%s) in %s',
+    def _body(slice_index: int) -> Dict[str, Any]:
+        return {
+            'acceleratorType': node_cfg['accelerator_type'],
+            'runtimeVersion': node_cfg['runtime_version'],
+            'networkConfig': {
+                'network': node_cfg.get('network', 'default'),
+                'enableExternalIps': True,
+            },
+            'labels': {_LABEL_CLUSTER: node_id,
+                       'skytpu-slice': str(slice_index),
+                       **(node_cfg.get('labels') or {})},
+            'metadata': {
+                'ssh-keys': node_cfg.get('ssh_public_key', ''),
+            },
+            'schedulingConfig': {
+                'preemptible': bool(node_cfg.get('use_spot', False)),
+            },
+            'tags': ['skytpu'],
+        }
+
+    logger.info('Creating %d TPU slice(s) %s (%s) in %s', count,
                 node_id, node_cfg['accelerator_type'], zone)
-    op = gcp_client.request(
-        'POST', _node_url(project, zone) + f'?nodeId={node_id}', body)
-    gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
-    _placement_cache[node_id] = ('tpu', zone)
+    created: List[str] = []
+    ops: List[Dict[str, Any]] = []
+    try:
+        # Issue every create before waiting (the API provisions the
+        # slices concurrently), then wait all — the gang is atomic:
+        # ANY failure (stockout of one slice) deletes every slice and
+        # surfaces one typed error for the failover engine to act on
+        # as a unit.
+        for i, name in enumerate(names):
+            op = gcp_client.request(
+                'POST', _node_url(project, zone) + f'?nodeId={name}',
+                _body(i))
+            created.append(name)
+            ops.append(op)
+        for op in ops:
+            gcp_client.wait_operation(f'{gcp_client.TPU_API}/'
+                                      f'{op["name"]}')
+    except exceptions.SkyTpuError:
+        for name in created:
+            try:
+                _delete_node(project, zone, name)
+            except exceptions.SkyTpuError:
+                logger.warning('cleanup of slice %s failed', name)
+        raise
+    _placement_cache[node_id] = ('tpu', zone, count)
     return ProvisionRecord(provider='gcp', region=config.region,
                            zone=zone, cluster_name_on_cloud=node_id,
-                           created_instance_ids=[node_id])
+                           created_instance_ids=list(names))
+
+
+def _delete_node(project: str, zone: str, name: str) -> None:
+    try:
+        op = gcp_client.request('DELETE',
+                                _node_url(project, zone, name))
+    except exceptions.ApiError as e:
+        if e.http_code == 404:
+            return
+        raise
+    if op.get('name'):
+        gcp_client.wait_operation(
+            f'{gcp_client.TPU_API}/{op["name"]}')
 
 
 def _get_node(project: str, zone: str,
@@ -166,31 +227,89 @@ def _find_node(region: str,
 
 
 def _locate(region: str, name: str
-            ) -> Optional[Tuple[str, Dict[str, Any]]]:
-    """(kind, resource) for a cluster name — TPU node or compute VM.
+            ) -> Optional[Tuple[str, List[Dict[str, Any]]]]:
+    """(kind, resources) for a cluster name — TPU slice set (one node
+    per slice, slice-ordered) or a single compute VM.
 
-    Tries the placement cache's exact (kind, zone) first so steady-
-    state polling costs one GET instead of a zone sweep; a cache miss
-    or stale hint falls back to the TPU sweep then the VM sweep."""
+    Tries the placement cache's exact (kind, zone, count) first so
+    steady-state polling costs one GET per slice instead of a zone
+    sweep; a cache miss or stale hint falls back to the TPU sweep
+    (bare name, then ``-s0..``) then the VM sweep."""
     cached = _placement_cache.get(name)
     if cached is not None:
-        kind, zone = cached
+        kind, zone, count = cached
         project = gcp_client.get_project_id()
-        found = (_get_node(project, zone, name) if kind == 'tpu'
-                 else compute_instance.get_instance(project, zone,
-                                                    name))
-        if found is not None:
-            found['_zone'] = zone
-            return kind, found
+        if kind == 'vm':
+            inst = compute_instance.get_instance(project, zone, name)
+            if inst is not None:
+                inst['_zone'] = zone
+                return 'vm', [inst]
+        else:
+            # Collect whatever slices still exist — a hole anywhere
+            # (including slice 0) must NOT hide the survivors, or
+            # terminate would leak live, billing slices.
+            nodes = []
+            for slice_name in _slice_names(name, count):
+                node = _get_node(project, zone, slice_name)
+                if node is None:
+                    continue
+                node['_zone'] = zone
+                node['_name'] = slice_name
+                nodes.append(node)
+            if len(nodes) == count:
+                return 'tpu', nodes
+            if nodes:
+                # Partial set (a slice was preempted/deleted): report
+                # what exists — query maps "fewer slices than
+                # expected" to a dead cluster; terminate deletes the
+                # survivors by their recorded names.
+                return 'tpu', nodes
         _placement_cache.pop(name, None)  # stale
     node = _find_node(region, name)
     if node is not None:
-        _placement_cache[name] = ('tpu', node['_zone'])
-        return 'tpu', node
+        node['_name'] = name
+        _placement_cache[name] = ('tpu', node['_zone'], 1)
+        return 'tpu', [node]
+    # Multi-slice set created by another process: probe the first two
+    # slice names (s0 may itself be the preempted one), then walk.
+    first = None
+    first_idx = 0
+    for i in (0, 1):
+        first = _find_node(region, f'{name}-s{i}')
+        if first is not None:
+            first_idx = i
+            break
+    if first is not None:
+        zone = first['_zone']
+        first['_name'] = f'{name}-s{first_idx}'
+        project = gcp_client.get_project_id()
+        nodes = [first]
+        i = first_idx + 1
+        misses = 0
+        saw_hole = first_idx > 0
+        while misses < 2:  # tolerate one interior hole
+            slice_name = f'{name}-s{i}'
+            node = _get_node(project, zone, slice_name)
+            if node is None:
+                misses += 1
+            else:
+                if misses > 0:
+                    saw_hole = True
+                    misses = 0
+                node['_zone'] = zone
+                node['_name'] = slice_name
+                nodes.append(node)
+            i += 1
+        # A hole means the set is PARTIAL: cache one more than found
+        # so the cached path keeps reporting it dead (terminated)
+        # rather than a healthy smaller gang.
+        _placement_cache[name] = ('tpu', zone,
+                                  len(nodes) + (1 if saw_hole else 0))
+        return 'tpu', nodes
     inst = compute_instance.find_instance(region, name)
     if inst is not None:
-        _placement_cache[name] = ('vm', inst['_zone'])
-        return 'vm', inst
+        _placement_cache[name] = ('vm', inst['_zone'], 1)
+        return 'vm', [inst]
     return None
 
 
@@ -202,14 +321,14 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
         if located is None:
             raise exceptions.FetchClusterInfoError(
                 f'{cluster_name_on_cloud} not found in {region}')
-        kind, node = located
+        kind, nodes = located
         if kind == 'vm':
             target = state or 'RUNNING'
-            if node.get('status') == target:
+            if nodes[0].get('status') == target:
                 return
         else:
             target = state or 'READY'
-            if node.get('state') == target:
+            if all(n.get('state') == target for n in nodes):
                 return
         time.sleep(10)
     raise exceptions.ApiError(
@@ -222,33 +341,39 @@ def get_cluster_info(region: str,
     if located is None:
         raise exceptions.FetchClusterInfoError(
             f'{cluster_name_on_cloud} not found in {region}')
-    kind, node = located
+    kind, nodes = located
     if kind == 'vm':
         return compute_instance.instance_to_cluster_info(
-            cluster_name_on_cloud, node)
-    endpoints = node.get('networkEndpoints', [])
+            cluster_name_on_cloud, nodes[0])
+    # Hosts are rank-ordered SLICE-MAJOR: all of slice 0's hosts,
+    # then slice 1's, ... — the order the gang driver's megascale/
+    # rank env contract assumes (runtime/env_contract.py).
     instances: List[InstanceInfo] = []
-    for i, ep in enumerate(endpoints):
-        external = None
-        access = ep.get('accessConfig') or {}
-        if access.get('externalIp'):
-            external = access['externalIp']
-        instances.append(InstanceInfo(
-            instance_id=f'{cluster_name_on_cloud}-w{i}',
-            internal_ip=ep.get('ipAddress', ''),
-            external_ip=external,
-            tags={'zone': node.get('_zone', '')},
-        ))
+    for s, node in enumerate(nodes):
+        prefix = node.get('_name', cluster_name_on_cloud)
+        for i, ep in enumerate(node.get('networkEndpoints', [])):
+            external = None
+            access = ep.get('accessConfig') or {}
+            if access.get('externalIp'):
+                external = access['externalIp']
+            instances.append(InstanceInfo(
+                instance_id=f'{prefix}-w{i}',
+                internal_ip=ep.get('ipAddress', ''),
+                external_ip=external,
+                tags={'zone': node.get('_zone', ''),
+                      'slice': str(s)},
+            ))
     if not instances:
         raise exceptions.FetchClusterInfoError(
             f'TPU {cluster_name_on_cloud} has no network endpoints')
     return ClusterInfo(
         provider='gcp', instances=instances,
         head_instance_id=instances[0].instance_id,
-        custom_metadata={'zone': node.get('_zone'),
-                         'state': node.get('state'),
+        custom_metadata={'zone': nodes[0].get('_zone'),
+                         'state': nodes[0].get('state'),
+                         'num_slices': len(nodes),
                          'accelerator_type':
-                             node.get('acceleratorType')})
+                             nodes[0].get('acceleratorType')})
 
 
 def query_instances(region: str,
@@ -256,12 +381,12 @@ def query_instances(region: str,
     located = _locate(region, cluster_name_on_cloud)
     if located is None:
         return {}
-    kind, node = located
+    kind, nodes = located
     if kind == 'vm':
         return {cluster_name_on_cloud:
                 compute_instance.STATUS_MAP.get(
-                    node.get('status', ''), 'unknown')}
-    # One atomic slice: a single logical 'instance'.
+                    nodes[0].get('status', ''), 'unknown')}
+    # The slice SET is one atomic gang: a single logical 'instance'.
     state_map = {
         'READY': 'running',
         'CREATING': 'pending',
@@ -273,27 +398,41 @@ def query_instances(region: str,
         'PREEMPTED': 'terminated',
         'TERMINATED': 'terminated',
     }
-    return {cluster_name_on_cloud:
-            state_map.get(node.get('state', ''), 'unknown')}
+    cached = _placement_cache.get(cluster_name_on_cloud)
+    if cached is not None and cached[0] == 'tpu' and \
+            len(nodes) < cached[2]:
+        # A slice vanished out from under the set: the gang is dead.
+        return {cluster_name_on_cloud: 'terminated'}
+    statuses = [state_map.get(n.get('state', ''), 'unknown')
+                for n in nodes]
+    if any(s == 'terminated' for s in statuses):
+        agg = 'terminated'
+    elif any(s != 'running' for s in statuses):
+        agg = next(s for s in statuses if s != 'running')
+    else:
+        agg = 'running'
+    return {cluster_name_on_cloud: agg}
 
 
 def stop_instances(region: str, cluster_name_on_cloud: str) -> None:
     located = _locate(region, cluster_name_on_cloud)
     if located is None:
         return
-    kind, node = located
+    kind, nodes = located
     if kind == 'vm':
         compute_instance.stop_instance(region, cluster_name_on_cloud,
-                                       zone=node['_zone'])
+                                       zone=nodes[0]['_zone'])
         return
-    if len(node.get('networkEndpoints', [])) > 1:
+    if len(nodes) > 1 or \
+            len(nodes[0].get('networkEndpoints', [])) > 1:
         raise exceptions.NotSupportedError(
-            'TPU pods cannot be stopped, only terminated (reference '
-            'constraint: sky/clouds/gcp.py:193-203).')
+            'TPU pods/multi-slice sets cannot be stopped, only '
+            'terminated (reference constraint: '
+            'sky/clouds/gcp.py:193-203).')
     project = gcp_client.get_project_id()
     op = gcp_client.request(
         'POST',
-        _node_url(project, node['_zone'], cluster_name_on_cloud) +
+        _node_url(project, nodes[0]['_zone'], cluster_name_on_cloud) +
         ':stop')
     gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
 
@@ -303,17 +442,23 @@ def terminate_instances(region: str,
     located = _locate(region, cluster_name_on_cloud)
     if located is None:
         return
-    kind, node = located
+    kind, nodes = located
     _placement_cache.pop(cluster_name_on_cloud, None)
     if kind == 'vm':
         compute_instance.terminate_instance(
-            region, cluster_name_on_cloud, zone=node['_zone'])
+            region, cluster_name_on_cloud, zone=nodes[0]['_zone'])
         return
     project = gcp_client.get_project_id()
-    op = gcp_client.request(
-        'DELETE',
-        _node_url(project, node['_zone'], cluster_name_on_cloud))
-    gcp_client.wait_operation(f'{gcp_client.TPU_API}/{op["name"]}')
+    errors = []
+    for node in nodes:
+        name = node.get('_name', cluster_name_on_cloud)
+        try:
+            _delete_node(project, node['_zone'], name)
+        except exceptions.SkyTpuError as e:
+            errors.append((name, e))
+    if errors:
+        raise exceptions.ApiError(
+            f'Failed to delete slice(s) {errors}')
 
 
 def open_ports(region: str, cluster_name_on_cloud: str,
@@ -359,21 +504,41 @@ def _open_ports_locked(cluster_name_on_cloud: str,
         # Rule exists (an earlier service/launch on this cluster):
         # merge the new ports in rather than dropping them — serve
         # adds one LB port per service to a shared controller
-        # cluster.
+        # cluster. The client-side filelock serializes THIS machine;
+        # writers on other machines (client vs controller VM) are
+        # handled by the fingerprint-conditional PATCH: GCP rejects a
+        # write whose fingerprint no longer matches, and we re-read
+        # and retry until our ports are confirmed present.
         url = (f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
                f'firewalls/{rule_name}')
-        existing = gcp_client.request('GET', url)
-        have = set()
-        for allowed in existing.get('allowed', []):
-            have.update(str(p) for p in allowed.get('ports', []))
-        want = have | {str(p) for p in ports}
-        if want != have:
-            gcp_client.request('PATCH', url, {
+        want_ports = {str(p) for p in ports}
+        for _ in range(5):
+            existing = gcp_client.request('GET', url)
+            have = set()
+            for allowed in existing.get('allowed', []):
+                have.update(str(p) for p in allowed.get('ports', []))
+            if want_ports <= have:
+                return
+            body = {
                 'allowed': [{
                     'IPProtocol': 'tcp',
-                    'ports': sorted(want),
+                    'ports': sorted(have | want_ports),
                 }],
-            })
+            }
+            if existing.get('fingerprint'):
+                body['fingerprint'] = existing['fingerprint']
+            try:
+                gcp_client.request('PATCH', url, body)
+            except exceptions.ApiError as patch_err:
+                if patch_err.http_code == 412:  # fingerprint raced
+                    continue
+                raise
+            # Verify after write: PATCH + a concurrent writer without
+            # fingerprint support must not silently drop our ports.
+        raise exceptions.ApiError(
+            f'Could not merge ports {sorted(want_ports)} into '
+            f'firewall rule {rule_name} after 5 attempts '
+            '(concurrent writers).')
 
 
 def cleanup_ports(region: str, cluster_name_on_cloud: str) -> None:

@@ -36,6 +36,34 @@ def _wrap(runtime_dir: str, body: str) -> str:
             f'python3 -c {shlex.quote(_ENV_PRELUDE + body)}')
 
 
+# Controller-side state (managed-jobs DB, serve DB, shipped DAGs/task
+# yamls, archived logs) lives in this subdir of the controller
+# cluster's runtime dir; jobs/serve codegen snippets and controller
+# task run commands all derive SKYTPU_STATE_DIR from it.
+CONTROLLER_STATE_SUBDIR = 'managed'
+
+_CONTROLLER_PRELUDE = f'''\
+import json, os
+_rdir = os.path.expanduser(os.environ['SKYTPU_RUNTIME_DIR'])
+os.environ['SKYTPU_STATE_DIR'] = os.path.join(
+    _rdir, {CONTROLLER_STATE_SUBDIR!r})
+os.makedirs(os.environ['SKYTPU_STATE_DIR'], exist_ok=True)
+'''
+
+
+def controller_wrap(runtime_dir: str, body: str) -> str:
+    """Like _wrap, but the snippet sees the CONTROLLER state dir —
+    the transport for ManagedJobCodeGen/ServeCodeGen analogs."""
+    return _wrap(runtime_dir, _CONTROLLER_PRELUDE + body)
+
+
+def controller_state_dir_cmd(runtime_dir: str) -> str:
+    """Shell fragment exporting the controller-side state dir (used
+    in controller task run commands)."""
+    return (f'SKYTPU_STATE_DIR={shlex.quote(runtime_dir)}/'
+            f'{CONTROLLER_STATE_SUBDIR}')
+
+
 def add_and_schedule_job(runtime_dir: str, job_name: str,
                          run_timestamp: str, resources_str: str,
                          spec: Dict[str, Any]) -> str:

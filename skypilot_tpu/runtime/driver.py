@@ -106,6 +106,11 @@ def run_job(job_id: int) -> job_lib.JobStatus:
             # contract (hosts are rank-ordered slice-major).
             num_slices=spec.get('num_slices') or 1)
         env.update(spec.get('envs') or {})
+        # The cluster-local job id, so jobs that ARE controllers
+        # (managed jobs / serve) can self-identify: managed job id ==
+        # controller-cluster job id (reference contract,
+        # sky/jobs/core.py launch returning the controller job id).
+        env['SKYTPU_CLUSTER_JOB_ID'] = str(job_id)
         proc_id = client.run(spec['run_cmd'],
                              log_path=_remote_log_path(spec, rank),
                              env=env, cwd=spec.get('workdir'))

@@ -70,6 +70,19 @@ def _ready(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [r for r in records if r['status'] == ReplicaStatus.READY]
 
 
+def _scale_down_victims(candidates: List[Dict[str, Any]],
+                        n: int) -> List[int]:
+    """Pick ``n`` scale-down victims: prefer replicas that are NOT
+    yet READY (PROVISIONING/STARTING — killing one never drops live
+    serving capacity), then newest-first (dynamic-fallback extras
+    drain before long-lived base replicas)."""
+    from skypilot_tpu.serve.serve_state import ReplicaStatus
+    ordered = sorted(
+        reversed(candidates),  # newest-first within each group
+        key=lambda r: r['status'] == ReplicaStatus.READY)
+    return [r['replica_id'] for r in ordered][:n]
+
+
 class Autoscaler:
 
     def __init__(self, spec: SkyServiceSpec):
@@ -99,8 +112,7 @@ class Autoscaler:
             return [ScalingOp(AutoscalerDecisionOperator.SCALE_UP,
                               count=delta)]
         if delta < 0:
-            victims = [r['replica_id']
-                       for r in reversed(nonterm)][:-delta]
+            victims = _scale_down_victims(nonterm, -delta)
             return [ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
                               replica_ids=victims)]
         return []
@@ -208,8 +220,8 @@ class _SpotMixOps:
                                  count=want_spot - len(spot),
                                  use_spot=True))
         elif len(spot) > want_spot:
-            victims = [r['replica_id'] for r in
-                       reversed(spot)][:len(spot) - want_spot]
+            victims = _scale_down_victims(spot,
+                                          len(spot) - want_spot)
             ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
                                  replica_ids=victims))
         if len(ondemand) < want_od:
@@ -217,10 +229,8 @@ class _SpotMixOps:
                                  count=want_od - len(ondemand),
                                  use_spot=False))
         elif len(ondemand) > want_od:
-            # Newest first: dynamic-fallback extras drain before the
-            # long-lived base replicas.
-            victims = [r['replica_id'] for r in
-                       reversed(ondemand)][:len(ondemand) - want_od]
+            victims = _scale_down_victims(ondemand,
+                                          len(ondemand) - want_od)
             ops.append(ScalingOp(AutoscalerDecisionOperator.SCALE_DOWN,
                                  replica_ids=victims))
         return ops

@@ -230,8 +230,21 @@ class BatchingEngine:
             # the length-aware attention path engages (the padding is
             # never read: reads scale with row lengths).
             blk = da._BLOCK_S  # pylint: disable=protected-access
+            requested = self.max_seq
             self.max_seq = max(2 * blk,
                                -(-self.max_seq // blk) * blk)
+            if self.max_seq != requested:
+                # The rounding multiplies every slot's resident KV
+                # HBM (L*slots*S rows); an engine sized to exactly
+                # fit at the requested max_seq can OOM purely from
+                # flipping SKYTPU_PALLAS_DECODE — make the change
+                # visible to operators sizing --slots against HBM.
+                logger.warning(
+                    'SKYTPU_PALLAS_DECODE: max_seq %d rounded up to '
+                    '%d (decode-kernel chunk %d); KV cache grows '
+                    '%.0f%% — resize --slots if HBM is tight.',
+                    requested, self.max_seq, blk,
+                    100.0 * (self.max_seq / requested - 1.0))
         self.steps = steps_per_dispatch
         self.kv_int8 = kv_int8
         shape = (config.n_layers, slots, self.max_seq,

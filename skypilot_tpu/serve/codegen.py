@@ -1,0 +1,176 @@
+"""Serve codegen-over-RPC: python snippets executed on the CONTROLLER
+CLUSTER's head through the agent channel.
+
+The serve DB (services, replicas, LB ports) lives with the controller
+— a service must outlive and be visible beyond the client machine
+that typed ``serve up``. Every client-side read/write — status, down,
+update, terminate-replica — is a snippet shipped to the head, the
+reference's ``ServeCodeGen`` transport (``sky/serve/serve_utils.py``).
+Before round 4 the client polled its own local sqlite, which aliased
+the controller's DB only on the local fake provider (round-3 advisor
+finding, serve/core.py:162).
+"""
+from skypilot_tpu.runtime import codegen as runtime_codegen
+
+STATE_SUBDIR = runtime_codegen.CONTROLLER_STATE_SUBDIR
+
+_PRELUDE = 'from skypilot_tpu.serve import serve_state\n'
+
+
+def _wrap(runtime_dir: str, body: str) -> str:
+    return runtime_codegen.controller_wrap(runtime_dir,
+                                           _PRELUDE + body)
+
+
+def state_dir_cmd(runtime_dir: str) -> str:
+    return runtime_codegen.controller_state_dir_cmd(runtime_dir)
+
+
+def register_service(runtime_dir: str, name: str, spec_json: str,
+                     port_start: int, port_end: int) -> str:
+    """Atomically (controller-side lock) check-allocate-insert: the
+    service row + its LB port. Prints REGISTER:<port> or
+    REGISTER:exists."""
+    body = f'''
+import filelock
+lock = filelock.FileLock(os.path.join(
+    os.environ['SKYTPU_STATE_DIR'], '.serve_lb_ports.lock'))
+with lock:
+    if serve_state.get_service({name!r}) is not None:
+        print('REGISTER:exists')
+    else:
+        used = set(serve_state.used_lb_ports())
+        port = None
+        for p in range({port_start}, {port_end} + 1):
+            if p not in used:
+                port = p
+                break
+        if port is None:
+            print('REGISTER:no-free-port')
+        else:
+            serve_state.add_service({name!r}, {spec_json!r},
+                                    lb_port=port)
+            print('REGISTER:' + str(port))
+'''
+    return _wrap(runtime_dir, body)
+
+
+def set_controller_job(runtime_dir: str, name: str,
+                       controller_cluster: str, job_id: int,
+                       endpoint: str) -> str:
+    body = f'''
+serve_state.set_controller_job({name!r}, {controller_cluster!r},
+                               {job_id})
+serve_state.set_service_endpoint({name!r}, {endpoint!r})
+print('SET:ok')
+'''
+    return _wrap(runtime_dir, body)
+
+
+def get_service(runtime_dir: str, name: str) -> str:
+    body = f'''
+svc = serve_state.get_service({name!r})
+if svc is None:
+    print('SERVICE:null')
+else:
+    svc = dict(svc)
+    svc['status'] = svc['status'].value
+    svc['replicas'] = [
+        {{k: (v.value if hasattr(v, 'value') else v)
+          for k, v in r.items()}}
+        for r in serve_state.get_replicas({name!r})]
+    print('SERVICE:' + json.dumps(svc))
+'''
+    return _wrap(runtime_dir, body)
+
+
+def get_services(runtime_dir: str) -> str:
+    body = '''
+out = []
+for svc in serve_state.get_services():
+    svc = dict(svc)
+    svc['status'] = svc['status'].value
+    svc['replicas'] = [
+        {k: (v.value if hasattr(v, 'value') else v)
+         for k, v in r.items()}
+        for r in serve_state.get_replicas(svc['name'])]
+    out.append(svc)
+print('SERVICES:' + json.dumps(out))
+'''
+    return _wrap(runtime_dir, body)
+
+
+def request_down(runtime_dir: str, name: str) -> str:
+    body = f'''
+if serve_state.get_service({name!r}) is None:
+    print('DOWN:no-such-service')
+else:
+    serve_state.request_down({name!r})
+    print('DOWN:ok')
+'''
+    return _wrap(runtime_dir, body)
+
+
+def force_cleanup(runtime_dir: str, name: str) -> str:
+    """Tear down any replicas the controller did not get to, then
+    drop the service row — runs controller-side because the replica
+    clusters live in the CONTROLLER's cluster DB."""
+    body = f'''
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions
+for replica in serve_state.get_replicas({name!r}):
+    try:
+        core_lib.down(replica['cluster_name'], purge=True)
+    except exceptions.SkyTpuError:
+        pass
+serve_state.remove_service({name!r})
+print('CLEANUP:ok')
+'''
+    return _wrap(runtime_dir, body)
+
+
+def set_target_version(runtime_dir: str, name: str, version: int,
+                       task_yaml: str) -> str:
+    body = f'''
+serve_state.set_target_version({name!r}, {version}, {task_yaml!r})
+print('UPDATE:' + str({version}))
+'''
+    return _wrap(runtime_dir, body)
+
+
+def terminate_replica(runtime_dir: str, name: str,
+                      replica_id: int) -> str:
+    body = f'''
+from skypilot_tpu import core as core_lib
+target = serve_state.get_replica({name!r}, {replica_id})
+if target is None:
+    print('TERMINATE:no-such-replica')
+else:
+    core_lib.down(target['cluster_name'], purge=True)
+    print('TERMINATE:ok')
+'''
+    return _wrap(runtime_dir, body)
+
+
+def dump_replica_log(runtime_dir: str, name: str,
+                     replica_id: int) -> str:
+    """One-shot dump of a replica cluster's latest job log (base64) —
+    replica clusters are reachable only from the controller."""
+    body = f'''
+import base64, io
+from skypilot_tpu import core as core_lib
+from skypilot_tpu import exceptions
+target = serve_state.get_replica({name!r}, {replica_id})
+if target is None:
+    print('NOREPLICA:1')
+else:
+    buf = io.StringIO()
+    try:
+        core_lib.tail_logs(target['cluster_name'], out=buf,
+                           follow=False)
+    except (exceptions.SkyTpuError, OSError) as e:
+        buf.write('(logs unavailable: %s)' % e)
+    print('LOGB64:' + base64.b64encode(
+        buf.getvalue().encode()).decode())
+'''
+    return _wrap(runtime_dir, body)

@@ -125,15 +125,23 @@ class SkyServeController:
                 self.load_balancer.drain_request_timestamps())
             current = [r for r in records
                        if r['version'] == self.version]
-            cur_nonterm = [r for r in current
-                           if not r['status'].is_terminal() and
-                           r['status'] != ReplicaStatus.SHUTTING_DOWN]
             cur_ready = [r for r in current
                          if r['status'] == ReplicaStatus.READY]
             target = self.autoscaler.target_num_replicas
-            need = target - len(cur_nonterm)
-            if need > 0:
-                self.replica_manager.scale_up(need)
+            # New-version provisioning goes through the autoscaler's
+            # op planner so the fallback autoscalers' spot/on-demand
+            # mix survives the update (a bare scale_up(need) would
+            # bring the new version up all-default and churn once
+            # normal ticks resume — round-3 advisor finding).
+            for op in self.autoscaler.generate_ops(current):
+                if op.operator == AutoscalerDecisionOperator.SCALE_UP:
+                    self.replica_manager.scale_up(
+                        op.count, use_spot=op.use_spot)
+                elif op.operator == \
+                        AutoscalerDecisionOperator.SCALE_DOWN:
+                    # Mix rebalancing among NEW-version replicas only
+                    # (old-version drain is handled below).
+                    self.replica_manager.scale_down(op.replica_ids)
             if len(cur_ready) >= target:
                 victims = [r['replica_id'] for r in old_alive]
                 logger.info('Rolling update: new version READY; '

