@@ -101,6 +101,72 @@ class _Candidate:
         return self.runtime_seconds
 
 
+def _declared_tps(task: Task, accelerator: str) -> Optional[float]:
+    """Declared tokens/s/chip for this accelerator, if any (scalar =
+    same everywhere; dict = per-accelerator table, canonical names —
+    malformed keys are warned about and skipped, never fatal: the
+    field is an estimate hint)."""
+    declared = task.estimated_tokens_per_second_per_chip
+    if declared is None:
+        return None
+    if isinstance(declared, dict):
+        for name, tps in declared.items():
+            try:
+                canonical = catalog.canonicalize(name)
+            except exceptions.InvalidSpecError:
+                logger.warning(
+                    'Ignoring malformed accelerator name %r in '
+                    'estimated_tokens_per_second_per_chip.', name)
+                continue
+            if canonical == accelerator:
+                return float(tps)
+        return None
+    return float(declared)
+
+
+def _apply_token_ranking(task: Task, cands: List['_Candidate'],
+                         default_runtime: float) -> None:
+    """$/token ranking (BASELINE.json north star): with a declared
+    throughput table, candidate runtimes become
+    tokens / (tok_s_chip * chips) — cost minimization then ranks by
+    cost-per-token (a v5p can beat a cheaper v5e when its per-chip
+    throughput advantage exceeds the price ratio).
+
+    Applies ONLY when every accelerator candidate is covered by the
+    table — mixing normalized and default runtimes would make the
+    comparison meaningless. Without a total token budget, the budget
+    is what the FASTEST candidate processes in ``default_runtime``,
+    so the winning plan's displayed ETA/cost stays on the familiar
+    default-runtime scale."""
+    if task.estimated_tokens_per_second_per_chip is None:
+        return
+    rates: List[Optional[float]] = []
+    for cand in cands:
+        res = cand.resources
+        if res.accelerator is None:
+            rates.append(None)  # controller VMs keep the default
+            continue
+        tps = _declared_tps(task, res.accelerator)
+        if tps is None or tps <= 0:
+            logger.warning(
+                'estimated_tokens_per_second_per_chip does not cover '
+                '%s; $/token ranking disabled for task %r.',
+                res.accelerator, task.name)
+            return
+        spec = res.tpu_spec
+        chips = spec.chips if spec is not None else 1
+        rates.append(tps * chips * task.num_nodes)
+    accel_rates = [r for r in rates if r is not None]
+    if not accel_rates:
+        return
+    total = task.estimated_total_tokens
+    if total is None:
+        total = default_runtime * max(accel_rates)
+    for cand, rate in zip(cands, rates):
+        if rate is not None:
+            cand.runtime_seconds = total / rate
+
+
 def _enumerate_candidates(task: Task,
                           blocked: Set[Resources]) -> List[_Candidate]:
     """Expand a task's resource set into pinned candidates — one per
@@ -175,6 +241,7 @@ def _enumerate_candidates(task: Task,
                 continue
             out.append(_Candidate(pinned, price * task.num_nodes,
                                   runtime))
+    _apply_token_ranking(task, out, runtime)
     out.sort(key=lambda c: c.cost_per_hour)
     return out
 

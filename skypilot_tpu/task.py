@@ -56,6 +56,16 @@ class Task:
         self.service: Optional[Any] = None  # SkyServiceSpec analog
         self.resources: Set[Resources] = {Resources()}
         self.estimated_runtime_seconds: Optional[float] = None
+        # $/token ranking inputs (BASELINE.json north star): declared
+        # throughput per chip — a scalar (same on every slice type) or
+        # a {accelerator: tok/s/chip} table — plus optionally the
+        # total token budget. The optimizer turns these into per-
+        # candidate runtimes, so cost minimization ranks by $/token
+        # (reference analog: the time_estimator_fn hook,
+        # sky/optimizer.py:241).
+        self.estimated_tokens_per_second_per_chip: \
+            Union[None, float, Dict[str, float]] = None
+        self.estimated_total_tokens: Optional[float] = None
         # Inputs/outputs for DAG egress-cost estimation (reference
         # ``sky/task.py`` set_inputs/set_outputs).
         self.inputs: Optional[str] = None
@@ -210,6 +220,12 @@ class Task:
             task.service = service_spec.SkyServiceSpec.from_yaml_config(
                 service_config)
 
+        tps = config.pop('estimated_tokens_per_second_per_chip', None)
+        if tps is not None:
+            task.estimated_tokens_per_second_per_chip = tps
+        total = config.pop('estimated_total_tokens', None)
+        if total is not None:
+            task.estimated_total_tokens = float(total)
         config.pop('inputs', None)
         config.pop('outputs', None)
         if config:
@@ -248,6 +264,12 @@ class Task:
             }
         if self.service is not None:
             out['service'] = self.service.to_yaml_config()
+        if self.estimated_tokens_per_second_per_chip is not None:
+            out['estimated_tokens_per_second_per_chip'] = \
+                self.estimated_tokens_per_second_per_chip
+        if self.estimated_total_tokens is not None:
+            out['estimated_total_tokens'] = \
+                self.estimated_total_tokens
         return out
 
     # -- misc -----------------------------------------------------------

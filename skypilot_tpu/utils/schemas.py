@@ -154,6 +154,15 @@ TASK_SCHEMA = {
             'additionalProperties': STORAGE_SCHEMA,
         },
         'service': SERVICE_SCHEMA,
+        # $/token ranking inputs (optimizer.py): scalar or
+        # per-accelerator table of declared throughput, plus the
+        # total token budget.
+        'estimated_tokens_per_second_per_chip': {
+            'anyOf': [{'type': 'number'}, {'type': 'null'},
+                      {'type': 'object',
+                       'additionalProperties': {'type': 'number'}}],
+        },
+        'estimated_total_tokens': {'type': ['number', 'null']},
         # Accepted-and-ignored reference fields (task.py:202).
         'inputs': {},
         'outputs': {},

@@ -263,3 +263,79 @@ class TestReviewRegressions:
         c1 = opt._enumerate_candidates(t1, set())[0]
         c4 = opt._enumerate_candidates(t4, set())[0]
         assert c4.cost_per_hour == pytest.approx(4 * c1.cost_per_hour)
+
+
+class TestDollarPerTokenRanking:
+    """$/token ranking (BASELINE.json north star): a declared per-
+    accelerator throughput table makes cost minimization rank by
+    cost-per-token, flipping picks the hourly price alone would
+    make (optimizer.py _candidate_runtime; reference analog
+    sky/optimizer.py:241 time_estimator)."""
+
+    def _task(self, tps=None, total=None):
+        task = Task(name='rank', run='train')
+        task.set_resources(Resources.from_yaml_config(
+            {'accelerators': ['tpu-v5e-8', 'tpu-v5p-8'],
+             'cloud': 'gcp'}))
+        task.estimated_tokens_per_second_per_chip = tps
+        task.estimated_total_tokens = total
+        dag = Dag()
+        dag.add(task)
+        return dag, task
+
+    def _pick(self, dag):
+        optimize(dag, quiet=True)
+        return dag.tasks[0].best_resources.accelerator
+
+    def test_without_throughput_cheapest_per_hour_wins(self):
+        dag, _ = self._task()
+        assert self._pick(dag) == 'tpu-v5e-8'  # $9.6/h vs $16.8/h
+
+    def test_throughput_table_flips_to_dollars_per_token(self):
+        # v5p-8 (4 chips, $16.8/h) at 17k tok/s/chip beats v5e-8
+        # (8 chips, $9.6/h) at 4k tok/s/chip on $/token:
+        # 16.8/(17000*4) < 9.6/(4000*8).
+        dag, _ = self._task(tps={'tpu-v5e-8': 4000.0,
+                                 'tpu-v5p-8': 17000.0},
+                            total=1e9)
+        assert self._pick(dag) == 'tpu-v5p-8'
+
+    def test_scalar_throughput_keeps_cheapest(self):
+        # Same tok/s/chip everywhere: more chips finish sooner at the
+        # same $/chip-second ratio — v5e-8's cheaper chip-hour wins.
+        dag, _ = self._task(tps=5000.0, total=1e9)
+        assert self._pick(dag) == 'tpu-v5e-8'
+
+    def test_yaml_round_trip(self):
+        task = Task.from_yaml_config({
+            'name': 'y', 'run': 'x',
+            'estimated_tokens_per_second_per_chip': {
+                'tpu-v5e-8': 4000},
+            'estimated_total_tokens': 5e8,
+        })
+        rt = Task.from_yaml_config(task.to_yaml_config())
+        assert rt.estimated_tokens_per_second_per_chip == {
+            'tpu-v5e-8': 4000}
+        assert rt.estimated_total_tokens == 5e8
+
+    def test_partial_table_disables_ranking(self):
+        # Covering only one of two candidates would compare
+        # incommensurable runtimes — ranking must fall back to
+        # cheapest-per-hour for the whole task.
+        dag, _ = self._task(tps={'tpu-v5p-8': 17000.0}, total=1e9)
+        assert self._pick(dag) == 'tpu-v5e-8'
+
+    def test_malformed_table_key_is_ignored(self):
+        dag, _ = self._task(tps={'v5p-8!!': 17000.0}, total=1e9)
+        assert self._pick(dag) == 'tpu-v5e-8'  # no crash, no rank
+
+    def test_no_budget_keeps_eta_scale(self):
+        # Without a token budget the FASTEST candidate's runtime is
+        # the declared default (1h), so plan ETAs stay meaningful.
+        dag, task = self._task(tps={'tpu-v5e-8': 4000.0,
+                                    'tpu-v5p-8': 17000.0})
+        from skypilot_tpu import optimizer as opt
+        cands = opt._enumerate_candidates(task, set())
+        fastest = min(c.runtime_seconds for c in cands
+                      if c.resources.accelerator is not None)
+        assert abs(fastest - 3600.0) < 1e-6
