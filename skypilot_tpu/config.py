@@ -22,7 +22,9 @@ ENV_VAR_CONFIG = 'SKYTPU_CONFIG'
 
 _dict: Optional[Dict[str, Any]] = None
 _loaded_path: Optional[str] = None
-_lock = threading.Lock()
+# Reentrant: override_config holds the lock across _ensure_loaded
+# (a plain Lock deadlocks there).
+_lock = threading.RLock()
 
 
 def _load() -> None:

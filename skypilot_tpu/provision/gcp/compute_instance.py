@@ -36,6 +36,8 @@ def _wait_zone_op(project: str, zone: str,
                   op: Dict[str, Any]) -> None:
     """Compute operations are zonal resources with a selfLink; TPU ops
     carry a full resource name instead — hence the separate helper."""
+    if not op.get('selfLink') and not op.get('name'):
+        return  # synchronous/empty response: nothing to wait on
     url = op.get('selfLink') or (
         f'{gcp_client.COMPUTE_API}/projects/{project}/zones/{zone}/'
         f'operations/{op["name"]}')

@@ -125,8 +125,11 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
             if node is not None:
                 _delete_node(project, zone, name)
 
+    from skypilot_tpu import config as config_lib
+    reservation = config_lib.get_nested(('gcp', 'reservation'), None)
+
     def _body(slice_index: int) -> Dict[str, Any]:
-        return {
+        body: Dict[str, Any] = {
             'acceleratorType': node_cfg['accelerator_type'],
             'runtimeVersion': node_cfg['runtime_version'],
             'networkConfig': {
@@ -144,6 +147,20 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
             },
             'tags': ['skytpu'],
         }
+        if reservation:
+            # Reservation pass-through (direct nodes.create path).
+            body['schedulingConfig']['reserved'] = True
+        return body
+    if config_lib.get_nested(('gcp', 'use_queued_resources'), False):
+        # Queued-resources acquisition (DWS-style): for v5p/v6e
+        # capacity a queued request is often the ONLY way to get a
+        # slice — the failover engine treats a queue timeout as a
+        # stockout and moves on (reference DWS analog:
+        # sky/provision/gcp/instance_utils.py:978
+        # GCPManagedInstanceGroup).
+        return _run_via_queued_resources(config, zone, names,
+                                         node_cfg, _body,
+                                         reservation)
 
     logger.info('Creating %d TPU slice(s) %s (%s) in %s', count,
                 node_id, node_cfg['accelerator_type'], zone)
@@ -175,6 +192,141 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
     return ProvisionRecord(provider='gcp', region=config.region,
                            zone=zone, cluster_name_on_cloud=node_id,
                            created_instance_ids=list(names))
+
+
+# queuedResources terminal/waiting state classification.
+_QR_ACTIVE = 'ACTIVE'
+_QR_WAITING = ('ACCEPTED', 'PROVISIONING', 'CREATING',
+               'WAITING_FOR_RESOURCES')
+_QR_FAILED = ('FAILED', 'SUSPENDED', 'SUSPENDING')
+
+
+def _qr_url(project: str, zone: str, qr_id: str = '') -> str:
+    base = (f'{gcp_client.TPU_API}/projects/{project}/locations/'
+            f'{zone}/queuedResources')
+    return f'{base}/{qr_id}' if qr_id else base
+
+
+def _run_via_queued_resources(config: ProvisionConfig, zone: str,
+                              names: List[str],
+                              node_cfg: Dict[str, Any],
+                              body_fn, reservation: Optional[str]
+                              ) -> ProvisionRecord:
+    """Acquire the slice set through the queuedResources API: one
+    queued request covering EVERY slice (all-or-nothing server-side),
+    polled until ACTIVE or the configured wait budget runs out —
+    timeouts and failed requests are cleaned up and surfaced as
+    StockoutError so the failover engine tries the next placement."""
+    from skypilot_tpu import config as config_lib
+    project = gcp_client.get_project_id()
+    node_id = config.cluster_name_on_cloud
+    qr_id = f'{node_id}-qr'
+    timeout = float(config_lib.get_nested(
+        ('gcp', 'queued_resource_timeout_seconds'), 900.0))
+    if timeout <= 0:
+        # 0 would mean "no server-side expiry" but the provisioner
+        # still needs a bounded wait to fail over; use the default.
+        timeout = 900.0
+
+    # A leftover request from a crashed earlier attempt would 409 the
+    # create below and wedge this cluster name in this zone.
+    _delete_queued_resource(project, zone, qr_id, missing_ok=True)
+
+    def _node_spec(i: int, name: str) -> Dict[str, Any]:
+        node = body_fn(i)
+        # The scheduling tier is expressed at the QR level
+        # (spot/guaranteed below); the API rejects requests that ALSO
+        # carry per-node schedulingConfig tiers.
+        node.pop('schedulingConfig', None)
+        return {'parent': parent, 'nodeId': name, 'node': node}
+
+    parent = f'projects/{project}/locations/{zone}'
+    body: Dict[str, Any] = {
+        'tpu': {
+            'nodeSpec': [_node_spec(i, name)
+                         for i, name in enumerate(names)],
+        },
+    }
+    if reservation:
+        res_name = reservation
+        if '/' not in res_name:
+            res_name = (f'projects/{project}/zones/{zone}/'
+                        f'reservations/{res_name}')
+        body['guaranteed'] = {'reserved': True}
+        body['reservationName'] = res_name
+    elif node_cfg.get('use_spot'):
+        body['spot'] = {}
+    # Server-side expiry rounds UP (sub-second test timeouts must not
+    # become an already-expired '0s').
+    body['queueingPolicy'] = {
+        'validUntilDuration': f'{max(1, int(-(-timeout // 1)))}s'}
+
+    logger.info('Queued-resource request %s: %d slice(s) (%s) in %s%s',
+                qr_id, len(names), node_cfg['accelerator_type'], zone,
+                f' [reservation {reservation}]' if reservation else '')
+    gcp_client.request('POST',
+                       _qr_url(project, zone) +
+                       f'?queuedResourceId={qr_id}', body)
+    deadline = time.time() + max(timeout, 1.0)
+    state = 'ACCEPTED'
+    try:
+        while time.time() < deadline:
+            qr = gcp_client.request('GET',
+                                    _qr_url(project, zone, qr_id))
+            state = (qr.get('state') or {}).get('state', 'ACCEPTED')
+            if state == _QR_ACTIVE:
+                _placement_cache[node_id] = ('tpu', zone, len(names))
+                return ProvisionRecord(
+                    provider='gcp', region=config.region, zone=zone,
+                    cluster_name_on_cloud=node_id,
+                    created_instance_ids=list(names))
+            if state in _QR_FAILED:
+                break
+            if state not in _QR_WAITING:
+                logger.warning('Unexpected queuedResource state %s',
+                               state)
+            time.sleep(min(15.0, max(0.1, timeout / 60.0)))
+    except exceptions.SkyTpuError:
+        # A failed poll (transient 5xx, network) must not leak the
+        # queued request — it could later grant an untracked,
+        # billing slice while the failover engine moves on.
+        _cleanup_qr(project, zone, qr_id, names)
+        raise
+    # Not granted (failed or still queued at the deadline): delete
+    # the request AND any half-created nodes, then report stockout.
+    _cleanup_qr(project, zone, qr_id, names)
+    raise exceptions.StockoutError(
+        f'Queued resource {qr_id} not granted in {zone} '
+        f'(last state {state}).')
+
+
+def _cleanup_qr(project: str, zone: str, qr_id: str,
+                names: List[str]) -> None:
+    _delete_queued_resource(project, zone, qr_id)
+    for name in names:
+        try:
+            _delete_node(project, zone, name)
+        except exceptions.SkyTpuError:
+            pass
+
+
+def _delete_queued_resource(project: str, zone: str, qr_id: str,
+                            missing_ok: bool = True) -> None:
+    del missing_ok  # 404 is always fine
+    try:
+        op = gcp_client.request(
+            'DELETE', _qr_url(project, zone, qr_id) + '?force=true')
+    except exceptions.ApiError as e:
+        if e.http_code == 404:
+            return
+        logger.warning('Deleting queued resource %s: %s', qr_id, e)
+        return
+    if op.get('name'):
+        try:
+            gcp_client.wait_operation(
+                f'{gcp_client.TPU_API}/{op["name"]}', timeout=300)
+        except exceptions.SkyTpuError as e:
+            logger.warning('Waiting for QR delete %s: %s', qr_id, e)
 
 
 def _delete_node(project: str, zone: str, name: str) -> None:
@@ -441,6 +593,17 @@ def terminate_instances(region: str,
                         cluster_name_on_cloud: str) -> None:
     located = _locate(region, cluster_name_on_cloud)
     if located is None:
+        # No nodes — but a STILL-QUEUED queuedResource may exist (a
+        # provisioner killed mid-poll): sweep the region's zones for
+        # it, or it could later grant untracked, billing slices.
+        from skypilot_tpu import config as config_lib
+        if config_lib.get_nested(('gcp', 'use_queued_resources'),
+                                 False):
+            project = gcp_client.get_project_id()
+            for suffix in ('a', 'b', 'c', 'd', 'f'):
+                _delete_queued_resource(
+                    project, f'{region}-{suffix}',
+                    f'{cluster_name_on_cloud}-qr')
         return
     kind, nodes = located
     _placement_cache.pop(cluster_name_on_cloud, None)
@@ -449,6 +612,10 @@ def terminate_instances(region: str,
             region, cluster_name_on_cloud, zone=nodes[0]['_zone'])
         return
     project = gcp_client.get_project_id()
+    # A queued-resource request may still own these nodes; force-
+    # deleting it first releases them (no-op when none exists).
+    _delete_queued_resource(project, nodes[0]['_zone'],
+                            f'{cluster_name_on_cloud}-qr')
     errors = []
     for node in nodes:
         name = node.get('_name', cluster_name_on_cloud)

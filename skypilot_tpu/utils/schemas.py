@@ -182,6 +182,16 @@ CONFIG_SCHEMA = {
                 'project_id': {'type': 'string'},
                 'network': {'type': 'string'},
                 'labels': {'type': 'object'},
+                # Slice acquisition via the queuedResources API
+                # (DWS-style queued capacity; provision/gcp).
+                'use_queued_resources': {'type': 'boolean'},
+                # How long a queued request may wait before the
+                # provisioner gives up and fails over.
+                'queued_resource_timeout_seconds':
+                    {'type': 'number', 'minimum': 0},
+                # Reservation to target (short name or full
+                # projects/.../reservations/... path).
+                'reservation': {'type': 'string'},
             },
         },
         'admin_policy': {'type': 'string'},

