@@ -162,11 +162,20 @@ class TpuBackend(Backend):
             return
         from skypilot_tpu import clouds
         if clouds.from_name(handle.provider).runtime_via_agent:
-            # The agent IS the host's main process, baked in at
-            # provision (pod Secret) — it cannot be restarted in
-            # place, and re-shipping the package would not touch it.
-            # Be honest instead of looping on a mismatch the client
-            # would then talk a newer protocol across.
+            # The baked (pod-Secret) agent copy cannot be replaced,
+            # but the pod's supervisor loop respawns the agent from
+            # an operator-shipped override — upgrade in place through
+            # the agent's own /put + /exec (the pod survives).
+            from skypilot_tpu.provision import instance_setup
+            logger.info('Cluster %s agent protocol %s (client wants '
+                        '%s); upgrading agents in place.',
+                        handle.cluster_name, stale,
+                        agent.AGENT_VERSION)
+            if instance_setup.upgrade_agents_in_place(handle):
+                self._post_provision_runtime_setup(handle)
+                return
+            # Pre-supervisor pod (no respawn loop): be honest
+            # instead of looping on a mismatch.
             raise exceptions.NotSupportedError(
                 f'Cluster {handle.cluster_name} runs agent protocol '
                 f'{stale} but this client needs '

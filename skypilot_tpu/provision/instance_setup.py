@@ -11,6 +11,7 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List
 
+from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.backends.backend import ClusterHandle
 from skypilot_tpu.runtime import agent_client
@@ -176,6 +177,64 @@ def setup_runtime_via_agent(handle: ClusterHandle) -> None:
                 f'package unpack failed on host {i}: {out}')
 
     _fan_out_agents(handle, one)
+
+
+def upgrade_agents_in_place(handle: ClusterHandle) -> bool:
+    """Re-ship and respawn the host agents THROUGH the agent channel
+    (for ``runtime_via_agent`` clouds, where the agent came up with
+    the pod and there is no SSH): put the current agent source as
+    ``~/.skypilot_tpu/agent_override.py``, kill the running agent,
+    and let the pod's supervisor loop respawn it from the override.
+    Returns True when every host answers with the current protocol
+    version afterwards (False = pre-supervisor pod: caller falls back
+    to the honest relaunch error)."""
+    import time
+
+    from skypilot_tpu.runtime import agent as agent_mod
+
+    with open(agent_mod.__file__, encoding='utf-8') as f:
+        src = f.read().encode()
+
+    def one(i: int) -> None:
+        cl = handle.agent_client(i)
+        port = handle.hosts[i]['agent_port']
+        # Only supervised pods may be upgraded this way: killing a
+        # pre-supervisor pod's PID-1 agent would take the whole pod
+        # down permanently (restartPolicy: Never).
+        probe = cl.exec(
+            'test -f "$HOME/.skypilot_tpu/supervised"', timeout=15)
+        if probe.get('returncode') != 0:
+            raise exceptions.NotSupportedError(
+                f'host {i}: pre-supervisor pod')
+        cl.put_file('~/.skypilot_tpu/agent_override.py', src)
+        # Detached, port-scoped kill (several agents can share a test
+        # machine); the supervisor respawns from the override. The
+        # bracket keeps pkill from matching this very shell.
+        cl.exec('(sleep 0.3; '
+                f'pkill -f "[a]gent.py --port {port}"; '
+                f'pkill -f "[a]gent_override.py --port {port}"'
+                ') >/dev/null 2>&1 &', timeout=15)
+
+    try:
+        _fan_out_agents(handle, one)
+    except Exception as e:  # pylint: disable=broad-except
+        # Any failure (pre-supervisor pod, dropped connection) falls
+        # back to the caller's honest relaunch error rather than an
+        # opaque traceback mid-reuse.
+        logger.warning('in-place agent upgrade not possible: %s', e)
+        return False
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        versions = []
+        for i in range(handle.num_hosts):
+            try:
+                versions.append(handle.agent_client(i).version())
+            except Exception:  # pylint: disable=broad-except
+                versions.append(None)
+        if all(v == agent_mod.AGENT_VERSION for v in versions):
+            return True
+        time.sleep(1.0)
+    return False
 
 
 def sync_to_all_hosts(handle: ClusterHandle, source: str,

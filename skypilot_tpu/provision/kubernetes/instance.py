@@ -85,11 +85,31 @@ def _pod_manifest(config: ProvisionConfig, rank: int,
     env = [{'name': 'SKYTPU_K8S_RANK', 'value': str(rank)}]
     # PYTHONPATH points at the (post-bring-up) package push target so
     # agent-exec'd codegen snippets can import skypilot_tpu.
+    # Supervisor loop (NOT exec): the shell stays PID 1 and respawns
+    # the agent if it exits, preferring an operator-shipped override
+    # — this is what makes IN-PLACE agent upgrades possible on a
+    # version handshake mismatch (the baked Secret copy cannot be
+    # replaced, but ~/.skypilot_tpu/agent_override.py can; see
+    # instance_setup.upgrade_agents_in_place).
     command = [
         '/bin/sh', '-c',
         'export PYTHONPATH=/root/.skypilot_tpu/wheels:$PYTHONPATH; '
-        f'exec python3 /skytpu-boot/agent.py --port {_AGENT_PORT} '
-        '--token-file /skytpu-boot/token',
+        # The marker tells upgrade_agents_in_place this pod CAN be
+        # upgraded in place (pre-supervisor pods must not have their
+        # PID-1 agent killed).
+        'mkdir -p "$HOME/.skypilot_tpu"; '
+        'touch "$HOME/.skypilot_tpu/supervised"; '
+        # sh is PID 1: forward termination to the agent child or pod
+        # deletion would hang for the full grace period.
+        'trap \'kill "$CHILD" 2>/dev/null; exit 0\' TERM INT; '
+        'while true; do '
+        'AGENT=/skytpu-boot/agent.py; '
+        '[ -f "$HOME/.skypilot_tpu/agent_override.py" ] && '
+        'AGENT="$HOME/.skypilot_tpu/agent_override.py"; '
+        f'python3 "$AGENT" --port {_AGENT_PORT} '
+        '--token-file /skytpu-boot/token & '
+        'CHILD=$!; wait "$CHILD"; '
+        'sleep 1; done',
     ]
     return {
         'apiVersion': 'v1',

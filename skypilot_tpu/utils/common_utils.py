@@ -27,11 +27,18 @@ def get_user_hash() -> str:
     ``~/.sky/user_hash``; here ``~/.skypilot_tpu/user_hash``.
     """
     global _user_hash
-    if _user_hash is not None:
-        return _user_hash
+    # The env override wins over the process-local cache: a test (or
+    # controller process) that sets SKYTPU_USER_HASH after something
+    # already hashed must not keep namespacing resources under the
+    # stale value — client and controller would compute DIFFERENT
+    # on-cloud names for the same cluster.
     env = os.environ.get('SKYTPU_USER_HASH')
     if env and re.fullmatch(r'[0-9a-f]+', env):
-        _user_hash = env
+        # Deliberately NOT cached: when the override disappears the
+        # next call must fall back to the persisted identity, not
+        # keep the env value alive.
+        return env
+    if _user_hash is not None:
         return _user_hash
     path = os.path.expanduser('~/.skypilot_tpu/user_hash')
     if os.path.exists(path):
