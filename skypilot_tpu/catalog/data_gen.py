@@ -131,9 +131,9 @@ def _num_hosts(gen: str, chips: int, chips_per_host: int) -> int:
     return max(1, chips // chips_per_host)
 
 
-def generate_rows() -> List[Dict]:
+def generate_rows(generations: Dict[str, Dict] = None) -> List[Dict]:
     rows = []
-    for gen, info in GENERATIONS.items():
+    for gen, info in (generations or GENERATIONS).items():
         for size in info['sizes']:
             if info['cores_naming']:
                 # v2/v3/v4/v5p chips carry 2 TensorCores and are named
@@ -152,8 +152,17 @@ def generate_rows() -> List[Dict]:
                 topo = _topo_3d(chips)
             for region, zones in info['regions'].items():
                 factor = REGION_FACTOR.get(region, 1.0)
-                price = round(info['price_chip_hour'] * factor * chips, 4)
-                spot = round(price * SPOT_FACTOR, 4)
+                # Live-fetched per-region rates (catalog/fetch_gcp.py)
+                # override the seed-price x region-factor estimate;
+                # same for spot vs the SPOT_FACTOR approximation.
+                chip_hour = info.get('region_prices', {}).get(
+                    region, info['price_chip_hour'] * factor)
+                price = round(chip_hour * chips, 4)
+                spot_chip_hour = info.get('region_spot_prices',
+                                          {}).get(region)
+                spot = (round(spot_chip_hour * chips, 4)
+                        if spot_chip_hour is not None
+                        else round(price * SPOT_FACTOR, 4))
                 for z in zones:
                     rows.append({
                         'AcceleratorName': f'tpu-{gen}-{size}',
@@ -204,12 +213,16 @@ VM_REGIONS = sorted({
 })
 
 
-def generate_vm_rows() -> List[Dict]:
+def generate_vm_rows(vm_types: Dict[str, Dict] = None) -> List[Dict]:
     rows = []
-    for vm_type, info in VM_TYPES.items():
+    for vm_type, info in (vm_types or VM_TYPES).items():
         for region in VM_REGIONS:
             factor = REGION_FACTOR.get(region, 1.0)
-            price = round(info['price'] * factor, 4)
+            # Live-fetched per-region $/hr (catalog/fetch_gcp.py)
+            # overrides the seed x region-factor estimate.
+            price = info.get('region_prices', {}).get(
+                region, info['price'] * factor)
+            price = round(price, 4)
             rows.append({
                 'InstanceType': vm_type,
                 'vCPUs': info['vcpus'],
@@ -229,13 +242,18 @@ def _write_csv(out_path: str, rows: List[Dict]) -> None:
         writer.writerows(rows)
 
 
-def main(out_path: str = None) -> str:
+def main(out_path: str = None,
+         generations: Dict[str, Dict] = None,
+         vm_types: Dict[str, Dict] = None) -> str:
+    """Write both CSVs. ``generations``/``vm_types``: optional seed-
+    table overrides (the live fetcher passes merged tables here
+    instead of mutating this module's globals)."""
     data_dir = os.path.join(os.path.dirname(__file__), 'data')
     if out_path is None:
         out_path = os.path.join(data_dir, 'tpu_catalog.csv')
-    _write_csv(out_path, generate_rows())
+    _write_csv(out_path, generate_rows(generations))
     vm_path = os.path.join(os.path.dirname(out_path), 'vm_catalog.csv')
-    _write_csv(vm_path, generate_vm_rows())
+    _write_csv(vm_path, generate_vm_rows(vm_types))
     return out_path
 
 

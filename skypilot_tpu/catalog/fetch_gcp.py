@@ -1,0 +1,241 @@
+"""Live pricing fetcher: regenerate the catalogs from the Cloud
+Billing Catalog API.
+
+Analog of the reference's ``sky/clouds/service_catalog/data_fetchers/
+fetch_gcp.py:791`` (it drives googleapiclient; this speaks REST
+through the same hand-rolled auth as ``provision/gcp/client.py`` — no
+cloud SDK). The SKU feed updates the *seed tables* in ``data_gen.py``
+(per-chip-hour TPU rates, per-region multipliers, VM core/ram rates)
+and regenerates the CSVs, so everything downstream — the optimizer,
+$/token ranking, cost report — prices from live data while offline
+images keep working from the seeds.
+
+Run:  python -m skypilot_tpu.catalog.fetch_gcp [--dry-run]
+"""
+import argparse
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+_BILLING_API = 'https://cloudbilling.googleapis.com/v1'
+# Public, stable service ids in the Cloud Billing catalog.
+_TPU_SERVICE = 'services/E505-1604-58F8'      # Cloud TPU
+_COMPUTE_SERVICE = 'services/6F81-5844-456A'  # Compute Engine
+
+# "Cloud TPU v5e" / "TPU v5p pod" etc -> catalog generation key.
+_TPU_DESC_RE = re.compile(
+    r'tpu\s*v(\d+[a-z]*)', re.IGNORECASE)
+
+
+def _list_skus(service: str) -> Iterable[Dict]:
+    """Page through a service's SKUs (the billing catalog API is
+    unauthenticated-readable with any valid token)."""
+    import urllib.parse
+
+    from skypilot_tpu.provision.gcp import client as gcp_client
+    token = ''
+    while True:
+        url = f'{_BILLING_API}/{service}/skus?pageSize=5000'
+        if token:
+            url += ('&pageToken=' +
+                    urllib.parse.quote(token, safe=''))
+        page = gcp_client.request('GET', url)
+        yield from page.get('skus', [])
+        token = page.get('nextPageToken', '')
+        if not token:
+            return
+
+
+def _unit_price_usd(sku: Dict) -> Optional[float]:
+    """$ per usage unit from the first pricing tier."""
+    infos = sku.get('pricingInfo') or []
+    if not infos:
+        return None
+    expr = infos[0].get('pricingExpression') or {}
+    rates = expr.get('tieredRates') or []
+    if not rates:
+        return None
+    price = rates[0].get('unitPrice') or {}
+    units = int(price.get('units') or 0)
+    nanos = int(price.get('nanos') or 0)
+    return units + nanos / 1e9
+
+
+def parse_tpu_skus(skus: Iterable[Dict]
+                   ) -> Dict[Tuple[str, str, bool], float]:
+    """(generation, region, is_spot) -> $/chip-hour.
+
+    TPU SKUs describe per-chip-hour usage, one SKU per
+    (generation, region, on-demand/preemptible)."""
+    out: Dict[Tuple[str, str, bool], float] = {}
+    for sku in skus:
+        desc = sku.get('description', '')
+        m = _TPU_DESC_RE.search(desc)
+        if not m:
+            continue
+        gen = f'v{m.group(1).lower()}'
+        if gen == 'v5litepod':
+            gen = 'v5e'
+        spot = ('preemptible' in desc.lower() or
+                'spot' in desc.lower())
+        price = _unit_price_usd(sku)
+        if price is None or price <= 0:
+            continue
+        for region in sku.get('serviceRegions', []):
+            key = (gen, region, spot)
+            # Keep the cheapest matching SKU (some descriptions
+            # cover pod vs single-host variants at the same rate).
+            if key not in out or price < out[key]:
+                out[key] = price
+    return out
+
+
+def parse_vm_skus(skus: Iterable[Dict]
+                  ) -> Dict[Tuple[str, str, str], float]:
+    """(family, region, 'core'|'ram') -> unit price ($/vCPU-hr or
+    $/GB-hr, on-demand)."""
+    out: Dict[Tuple[str, str, str], float] = {}
+    fam_re = re.compile(r'^(N2|E2) Instance (Core|Ram)',
+                        re.IGNORECASE)
+    for sku in skus:
+        desc = sku.get('description', '')
+        m = fam_re.match(desc)
+        if not m or 'preemptible' in desc.lower() or \
+                'spot' in desc.lower() or 'commitment' in desc.lower():
+            continue
+        family = m.group(1).lower()
+        kind = m.group(2).lower()  # 'core' | 'ram'
+        price = _unit_price_usd(sku)
+        if price is None or price <= 0:
+            continue
+        for region in sku.get('serviceRegions', []):
+            out[(family, region, kind)] = price
+    return out
+
+
+def merged_tpu_seed(tpu_prices: Dict[Tuple[str, str, bool], float]
+                    ) -> Dict[str, Dict]:
+    """data_gen.GENERATIONS with live per-chip-hour prices folded in
+    (per-generation base = cheapest fetched region; region spread is
+    handled by data_gen's REGION_FACTOR, which we bypass by writing
+    explicit per-region overrides)."""
+    from skypilot_tpu.catalog import data_gen
+    seed = {g: dict(info) for g, info in data_gen.GENERATIONS.items()}
+    for gen, info in seed.items():
+        fetched = {r: p for (g, r, spot), p in tpu_prices.items()
+                   if g == gen and not spot and r in info['regions']}
+        fetched_spot = {
+            r: p for (g, r, spot), p in tpu_prices.items()
+            if g == gen and spot and r in info['regions']}
+        if fetched:
+            info['price_chip_hour'] = min(fetched.values())
+            info['region_prices'] = fetched
+        if fetched_spot:
+            info['region_spot_prices'] = fetched_spot
+    return seed
+
+
+def vm_price_table(vm_prices: Dict[Tuple[str, str, str], float]
+                   ) -> Dict[str, Dict[str, float]]:
+    """instance_type -> region -> $/hr from core+ram unit prices."""
+    from skypilot_tpu.catalog import data_gen
+    table: Dict[str, Dict[str, float]] = {}
+    for vm_type, info in data_gen.VM_TYPES.items():
+        family = vm_type.split('-', 1)[0]
+        per_region: Dict[str, float] = {}
+        for region in data_gen.VM_REGIONS:
+            core = vm_prices.get((family, region, 'core'))
+            ram = vm_prices.get((family, region, 'ram'))
+            if core is None or ram is None:
+                continue
+            per_region[region] = round(
+                core * info['vcpus'] + ram * info['mem_gb'], 4)
+        if per_region:
+            table[vm_type] = per_region
+    return table
+
+
+def fetch(dry_run: bool = False) -> List[str]:
+    """Fetch live prices and regenerate the CSVs. Returns a list of
+    human-readable change lines. Raises InvalidCloudConfigError when
+    no credentials exist (offline images keep the seeded CSVs)."""
+    tpu = parse_tpu_skus(_list_skus(_TPU_SERVICE))
+    vm = parse_vm_skus(_list_skus(_COMPUTE_SERVICE))
+    if not tpu and not vm:
+        raise exceptions.ApiError(
+            'Billing catalog returned no TPU/VM SKUs — API change? '
+            'Keeping the seeded catalog.')
+    from skypilot_tpu.catalog import data_gen
+    changes: List[str] = []
+    # A half-empty feed means a description-format change: say so
+    # loudly rather than letting that half silently stay on seeds.
+    if not tpu:
+        logger.warning('No TPU SKUs parsed (description format '
+                       'change?) — TPU prices stay on the seeds.')
+        changes.append('WARNING: TPU feed empty; TPU prices NOT '
+                       'refreshed')
+    if not vm:
+        logger.warning('No VM SKUs parsed (description format '
+                       'change?) — VM prices stay on the seeds.')
+        changes.append('WARNING: VM feed empty; VM prices NOT '
+                       'refreshed')
+    seed = merged_tpu_seed(tpu)
+    for gen, info in seed.items():
+        old = data_gen.GENERATIONS[gen]['price_chip_hour']
+        new = info['price_chip_hour']
+        if abs(old - new) > 1e-9:
+            changes.append(
+                f'tpu {gen}: {old} -> {new} $/chip-hr')
+    vms = vm_price_table(vm)
+    for vm_type, regions in vms.items():
+        old = data_gen.VM_TYPES[vm_type]['price']
+        new = min(regions.values())
+        if abs(old - new) > 1e-4:
+            changes.append(f'vm {vm_type}: {old} -> {new} $/hr')
+    if dry_run:
+        return changes
+    # Rewrite the CSVs from the merged tables (module seed globals
+    # stay untouched — they are the offline fallback). Per-region
+    # (and spot) overrides ride along so CSV rows get the ACTUAL
+    # fetched rates, not base x region-factor estimates.
+    merged_vm = {t: dict(info)
+                 for t, info in data_gen.VM_TYPES.items()}
+    for vm_type, regions in vms.items():
+        merged_vm[vm_type]['price'] = min(regions.values())
+        merged_vm[vm_type]['region_prices'] = regions
+    data_gen.main(generations=seed, vm_types=merged_vm)
+    # Invalidate the in-process catalog caches.
+    from skypilot_tpu.catalog import tpu_catalog, vm_catalog
+    tpu_catalog._read_catalog.cache_clear()  # pylint: disable=protected-access
+    vm_catalog._read_catalog.cache_clear()  # pylint: disable=protected-access
+    return changes
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description='Regenerate the priced catalogs from the Cloud '
+                    'Billing Catalog API.')
+    parser.add_argument('--dry-run', action='store_true',
+                        help='print price changes without rewriting '
+                             'the CSVs')
+    args = parser.parse_args()
+    try:
+        changes = fetch(dry_run=args.dry_run)
+    except exceptions.InvalidCloudConfigError as e:
+        raise SystemExit(
+            f'No GCP credentials ({e}); the seeded catalog stays in '
+            'place — run from a machine with gcloud auth to refresh '
+            'prices.')
+    if not changes:
+        print('Catalog prices already current.')
+    for line in changes:
+        print(('would update: ' if args.dry_run else 'updated: ') +
+              line)
+
+
+if __name__ == '__main__':
+    main()

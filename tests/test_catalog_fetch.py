@@ -1,0 +1,159 @@
+"""Live pricing fetcher (catalog/fetch_gcp.py) against a fake Cloud
+Billing Catalog API — parity with the reference's offline data
+fetchers (sky/.../fetch_gcp.py:791), minus the SDK."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import fetch_gcp
+
+
+def _sku(desc, price, regions):
+    return {
+        'description': desc,
+        'serviceRegions': regions,
+        'pricingInfo': [{
+            'pricingExpression': {
+                'tieredRates': [{
+                    'unitPrice': {'units': str(int(price)),
+                                  'nanos': int((price % 1) * 1e9)},
+                }],
+            },
+        }],
+    }
+
+
+class TestSkuParsing:
+
+    def test_tpu_skus(self):
+        skus = [
+            _sku('Cloud TPU v5e chip hour', 1.10,
+                 ['us-west4', 'us-east5']),
+            _sku('Preemptible Cloud TPU v5e chip hour', 0.47,
+                 ['us-west4']),
+            _sku('Cloud TPU v5p chip hour', 4.10, ['us-east5']),
+            _sku('Something unrelated', 9.99, ['us-east5']),
+        ]
+        out = fetch_gcp.parse_tpu_skus(skus)
+        assert out[('v5e', 'us-west4', False)] == pytest.approx(1.10)
+        assert out[('v5e', 'us-west4', True)] == pytest.approx(0.47)
+        assert out[('v5p', 'us-east5', False)] == pytest.approx(4.10)
+        assert ('v5p', 'us-east5', True) not in out
+
+    def test_vm_skus(self):
+        skus = [
+            _sku('N2 Instance Core running in Americas', 0.031,
+                 ['us-central1']),
+            _sku('N2 Instance Ram running in Americas', 0.0042,
+                 ['us-central1']),
+            _sku('Spot Preemptible N2 Instance Core', 0.007,
+                 ['us-central1']),
+            _sku('E2 Instance Core running in Americas', 0.022,
+                 ['us-central1']),
+            _sku('E2 Instance Ram running in Americas', 0.003,
+                 ['us-central1']),
+        ]
+        out = fetch_gcp.parse_vm_skus(skus)
+        assert out[('n2', 'us-central1', 'core')] == \
+            pytest.approx(0.031)
+        assert out[('e2', 'us-central1', 'ram')] == \
+            pytest.approx(0.003)
+        # Spot excluded from the on-demand unit table.
+        assert all(p > 0.01 for (f, r, k), p in out.items()
+                   if k == 'core')
+
+    def test_vm_price_table_composes_core_and_ram(self):
+        prices = {
+            ('n2', 'us-central1', 'core'): 0.031611,
+            ('n2', 'us-central1', 'ram'): 0.004237,
+        }
+        table = fetch_gcp.vm_price_table(prices)
+        # n2-standard-8 = 8 cores + 32 GB.
+        assert table['n2-standard-8']['us-central1'] == \
+            pytest.approx(8 * 0.031611 + 32 * 0.004237, abs=1e-4)
+
+    def test_merged_tpu_seed_prefers_cheapest_region(self):
+        seed = fetch_gcp.merged_tpu_seed({
+            ('v5e', 'us-west4', False): 1.05,
+            ('v5e', 'us-east5', False): 1.15,
+            ('v5e', 'nowhere-region', False): 0.1,  # not in seed
+        })
+        assert seed['v5e']['price_chip_hour'] == pytest.approx(1.05)
+        # Untouched generations keep their seed price.
+        from skypilot_tpu.catalog import data_gen
+        assert seed['v4']['price_chip_hour'] == \
+            data_gen.GENERATIONS['v4']['price_chip_hour']
+
+
+class TestFetchEndToEnd:
+
+    def test_fetch_dry_run_reports_changes(self, monkeypatch):
+        def fake_list(service):
+            if service == fetch_gcp._TPU_SERVICE:
+                return [_sku('Cloud TPU v5e chip hour', 1.11,
+                             ['us-west4'])]
+            return [
+                _sku('N2 Instance Core', 0.04, ['us-central1']),
+                _sku('N2 Instance Ram', 0.005, ['us-central1']),
+            ]
+        monkeypatch.setattr(fetch_gcp, '_list_skus', fake_list)
+        changes = fetch_gcp.fetch(dry_run=True)
+        assert any('v5e' in c for c in changes)
+        # Dry run must not rewrite the CSVs.
+        from skypilot_tpu import catalog
+        assert catalog.get_hourly_cost('tpu-v5e-8', False,
+                                       'us-west4') != 1.11 * 8
+
+    def test_fetch_empty_feed_keeps_seeded_catalog(self, monkeypatch):
+        monkeypatch.setattr(fetch_gcp, '_list_skus',
+                            lambda service: [])
+        with pytest.raises(exceptions.ApiError):
+            fetch_gcp.fetch(dry_run=True)
+
+
+    def test_fetch_writes_live_region_and_spot_rates(
+            self, monkeypatch, tmp_path):
+        """Non-dry-run: fetched per-region (and spot) rates land in
+        the CSVs verbatim — no region-factor estimates on top — and
+        the module seed tables stay untouched."""
+        import copy
+
+        from skypilot_tpu.catalog import data_gen
+        seeds_before = copy.deepcopy(data_gen.GENERATIONS)
+
+        def fake_list(service):
+            if service == fetch_gcp._TPU_SERVICE:
+                return [
+                    _sku('Cloud TPU v5e chip hour', 1.05,
+                         ['us-west4']),
+                    _sku('Cloud TPU v5e chip hour', 1.15,
+                         ['us-east5']),
+                    _sku('Preemptible Cloud TPU v5e chip hour', 0.63,
+                         ['us-west4']),
+                ]
+            return [
+                _sku('N2 Instance Core', 0.04, ['us-central1']),
+                _sku('N2 Instance Ram', 0.005, ['us-central1']),
+            ]
+
+        monkeypatch.setattr(fetch_gcp, '_list_skus', fake_list)
+        out = str(tmp_path / 'tpu_catalog.csv')
+        monkeypatch.setattr(
+            data_gen, 'main',
+            lambda generations=None, vm_types=None, _m=data_gen.main:
+                _m(out_path=out, generations=generations,
+                   vm_types=vm_types))
+        fetch_gcp.fetch(dry_run=False)
+        import pandas as pd
+        df = pd.read_csv(out)
+        v5e8 = df[(df.AcceleratorName == 'tpu-v5e-8')]
+        west = v5e8[v5e8.Region == 'us-west4'].iloc[0]
+        east = v5e8[v5e8.Region == 'us-east5'].iloc[0]
+        assert west.Price == pytest.approx(1.05 * 8)
+        assert east.Price == pytest.approx(1.15 * 8)  # not min*factor
+        assert west.SpotPrice == pytest.approx(0.63 * 8)  # live spot
+        vm = pd.read_csv(str(tmp_path / 'vm_catalog.csv'))
+        n2 = vm[(vm.InstanceType == 'n2-standard-8') &
+                (vm.Region == 'us-central1')].iloc[0]
+        assert n2.Price == pytest.approx(8 * 0.04 + 32 * 0.005,
+                                         abs=1e-4)
+        assert data_gen.GENERATIONS == seeds_before  # no mutation
