@@ -77,6 +77,10 @@ def parse_tpu_skus(skus: Iterable[Dict]
         m = _TPU_DESC_RE.search(desc)
         if not m:
             continue
+        if 'commitment' in desc.lower():
+            # CUD rates are ~half of list; the keep-the-cheapest rule
+            # below would silently replace on-demand prices with them.
+            continue
         gen = f'v{m.group(1).lower()}'
         if gen == 'v5litepod':
             gen = 'v5e'
@@ -233,6 +237,9 @@ def main() -> None:
     if not changes:
         print('Catalog prices already current.')
     for line in changes:
+        if line.startswith('WARNING'):
+            print(line)
+            continue
         print(('would update: ' if args.dry_run else 'updated: ') +
               line)
 

@@ -100,6 +100,7 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
         params)
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     b = tokens.shape[0]
+    quantized = k_scale is not None  # static at trace
 
     def one_token(carry, _):
         tok, kc_all, vc_all, ks_all, vs_all, cur = carry
@@ -111,6 +112,8 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
 
         def layer(carry_x, scanned):
             xc, cur_ = carry_x
+            # None scale leaves pass through lax.scan as empty
+            # pytrees — one unpack serves both cache dtypes.
             lp, kc, vc, ks, vs = scanned
             h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
                                 config.norm_offset)
@@ -126,24 +129,36 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             v = v.reshape(b, 1, nkv, hd)
             q = _rope_rows(q, angles)
             k = _rope_rows(k, angles)
+            # The in-layer cache update exists ONLY so this step's
+            # attention sees the new row; the caller persists the
+            # rows with one merged write per token (emitting full
+            # updated slices as scan outputs rewrote the entire
+            # cache per token — measured ~1.6 ms/token at 1B b16,
+            # the same pathology fixed in models/decode.py).
             if ks is not None:
                 # int8 KV: quantize the new row, one-hot write codes
                 # AND scales, dequant lazily at the attention read
                 # (XLA fuses; HBM reads stay int8-sized).
-                k8, ksc = decode._quantize_kv(k)
-                v8, vsc = decode._quantize_kv(v)
+                k_rows, ks_rows = decode._quantize_kv(k)
+                v_rows, vs_rows = decode._quantize_kv(v)
                 hit = (jnp.arange(kc.shape[1])[None, :] ==
                        cur_[:, None])                    # [B, S]
-                kc = jnp.where(hit[:, :, None, None], k8[:, 0][:, None], kc)
-                vc = jnp.where(hit[:, :, None, None], v8[:, 0][:, None], vc)
-                ks = jnp.where(hit[:, :, None], ksc[:, 0][:, None], ks)
-                vs = jnp.where(hit[:, :, None], vsc[:, 0][:, None], vs)
+                kc = jnp.where(hit[:, :, None, None],
+                               k_rows[:, 0][:, None], kc)
+                vc = jnp.where(hit[:, :, None, None],
+                               v_rows[:, 0][:, None], vc)
+                ks = jnp.where(hit[:, :, None],
+                               ks_rows[:, 0][:, None], ks)
+                vs = jnp.where(hit[:, :, None],
+                               vs_rows[:, 0][:, None], vs)
             else:
                 # Per-row cache write: Pallas windowed write when
                 # opted in; otherwise the one-hot full-cache where()
                 # (the JetStream trick to avoid XLA's unvectorized
                 # scatter).
                 from skypilot_tpu.ops import decode_attention as da
+                k_rows, v_rows = k, v
+                ks_rows = vs_rows = None
                 kc, vc = da.cache_write(kc, vc, k[:, 0], v[:, 0],
                                         cur_)
             kd = decode._dequant_kv(kc, ks, k.dtype)
@@ -165,11 +180,28 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
                 ).astype(h.dtype)
                 up = _mm(h, lp['w_up'])
                 xc = xc + _mm(gate * up, lp['w_down'])
-            return (xc, cur_), (kc, vc, ks, vs)
+            return (xc, cur_), (
+                k_rows[:, 0], v_rows[:, 0],
+                None if ks_rows is None else ks_rows[:, 0],
+                None if vs_rows is None else vs_rows[:, 0])
 
-        (x, _), (kc_all, vc_all, ks_all, vs_all) = jax.lax.scan(
+        (x, _), rows = jax.lax.scan(
             layer, (x, cur),
             (cparams['layers'], kc_all, vc_all, ks_all, vs_all))
+        # Persist the new rows with ONE merged elementwise select per
+        # token — XLA updates the carried cache buffers in place (no
+        # fresh ys allocation, no carry-aliasing copies).
+        hit = (jnp.arange(kc_all.shape[2])[None, :] ==
+               cur[:, None])                             # [B, S]
+        kc_all = jnp.where(hit[None, :, :, None, None],
+                           rows[0][:, :, None], kc_all)
+        vc_all = jnp.where(hit[None, :, :, None, None],
+                           rows[1][:, :, None], vc_all)
+        if quantized:
+            ks_all = jnp.where(hit[None, :, :, None],
+                               rows[2][:, :, None], ks_all)
+            vs_all = jnp.where(hit[None, :, :, None],
+                               rows[3][:, :, None], vs_all)
         x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
                             config.norm_offset)
         if config.tie_embeddings:
