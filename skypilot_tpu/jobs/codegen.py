@@ -17,6 +17,13 @@ STATE_SUBDIR = runtime_codegen.CONTROLLER_STATE_SUBDIR
 
 _PRELUDE = 'from skypilot_tpu.jobs import state as jobs_state\n'
 
+# Reconcile managed-job rows against the controller cluster's own
+# job table before any read/write: a dead controller PROCESS must not
+# leave its managed job RUNNING (or its task cluster billing)
+# forever. The logic lives in jobs_state (importable, unit-testable);
+# the snippet is one call.
+_RECONCILE = 'jobs_state.reconcile_dead_controllers()\n'
+
 
 def _wrap(runtime_dir: str, body: str) -> str:
     return runtime_codegen.controller_wrap(runtime_dir,
@@ -40,7 +47,7 @@ print('ENSURED:' + str({job_id}))
 
 
 def get_jobs(runtime_dir: str) -> str:
-    body = '''
+    body = _RECONCILE + '''
 records = jobs_state.get_jobs()
 out = [{k: (v.value if hasattr(v, 'value') else v)
         for k, v in r.items()} for r in records]
@@ -50,7 +57,7 @@ print('JOBS:' + json.dumps(out))
 
 
 def get_job(runtime_dir: str, job_id: int) -> str:
-    body = f'''
+    body = _RECONCILE + f'''
 r = jobs_state.get_job({job_id})
 if r is None:
     print('JOB:null')
@@ -66,7 +73,7 @@ def cancel_job(runtime_dir: str, job_id: int) -> str:
     cluster job is PENDING) is cancelled outright and the row made
     terminal; a running controller gets the signal file and acts on
     it (tears its task cluster down) within a poll interval."""
-    body = f'''
+    body = _RECONCILE + f'''
 from skypilot_tpu.runtime import job_lib
 rec = jobs_state.get_job({job_id})
 if rec is None:
@@ -95,7 +102,7 @@ def dump_task_log(runtime_dir: str, job_id: int,
     the job status, total length, and the base64 chunk past the
     offset — follow mode polls with a moving offset instead of
     re-transferring the whole log each round."""
-    body = f'''
+    body = _RECONCILE + f'''
 import base64, io
 from skypilot_tpu.jobs import controller as controller_mod
 rec = jobs_state.get_job({job_id})

@@ -116,6 +116,14 @@ def set_status(job_id: int, status: ManagedJobStatus,
                failure_reason: Optional[str] = None) -> None:
     db = _db()
     now = time.time()
+    current = get_job(job_id)
+    if current is not None and current['status'].is_terminal() and \
+            status != current['status']:
+        # Terminal is final: a late writer (e.g. an orphaned
+        # controller child whose job was already reconciled to
+        # FAILED_CONTROLLER) must not resurrect the row under
+        # callers that acted on the terminal state.
+        return
     sets = ['status=?']
     params: List[Any] = [status.value]
     if status == ManagedJobStatus.RUNNING:
@@ -198,6 +206,42 @@ def get_jobs() -> List[Dict[str, Any]]:
 
 def get_nonterminal_jobs() -> List[Dict[str, Any]]:
     return [r for r in get_jobs() if not r['status'].is_terminal()]
+
+
+def reconcile_dead_controllers() -> List[int]:
+    """Controller-side: managed jobs whose CONTROLLER PROCESS died
+    (their controller-cluster job — same id — is terminal while the
+    row is not; the controller always writes its terminal row BEFORE
+    exiting) are marked FAILED_CONTROLLER and their task clusters
+    torn down (nothing else will ever reclaim them). Runs on the
+    controller host in front of every jobs RPC read/write (reference
+    analog: skylet-driven managed-job reconciliation,
+    sky/skylet/events.py). Returns the reconciled job ids."""
+    from skypilot_tpu.runtime import job_lib
+    job_lib.update_job_statuses()
+    reconciled = []
+    for rec in get_nonterminal_jobs():
+        cluster_status = job_lib.get_status(rec['job_id'])
+        if cluster_status is None or \
+                not cluster_status.is_terminal():
+            continue
+        set_status(
+            rec['job_id'], ManagedJobStatus.FAILED_CONTROLLER,
+            failure_reason='controller process ended '
+            f'({cluster_status.value}) before the job reached a '
+            'terminal state')
+        reconciled.append(rec['job_id'])
+        if rec['task_cluster']:
+            # Best-effort: the task cluster is reachable only from
+            # this (controller) host; a dead controller leaves it
+            # billing with no other owner.
+            from skypilot_tpu import core as core_lib
+            from skypilot_tpu import exceptions
+            try:
+                core_lib.down(rec['task_cluster'], purge=True)
+            except (exceptions.SkyTpuError, OSError, ValueError):
+                pass
+    return reconciled
 
 
 def request_cancel(job_id: int) -> None:

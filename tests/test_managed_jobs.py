@@ -557,3 +557,67 @@ class TestGcpFakeControllerEndToEnd:
         buf = io.StringIO()
         jobs.core.tail_logs(job_id, out=buf, follow=False)
         assert 'via-gcp-controller' in buf.getvalue()
+
+
+class TestControllerDeathReconciliation:
+    """A managed job whose CONTROLLER PROCESS dies must not stay
+    RUNNING forever: the queue RPC reconciles rows against the
+    controller cluster's job table (jobs/codegen._RECONCILE)."""
+
+    def test_dead_controller_marks_failed_controller(
+            self, cleanup_clusters):
+        task = _local_task('sleep 300', name='mj-dead')
+        job_id = jobs.launch(task, detach=True)
+        # Wait for the controller to actually start driving.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            rec = jobs.core.get(job_id)
+            if rec['status'] in (
+                    jobs_state.ManagedJobStatus.STARTING,
+                    jobs_state.ManagedJobStatus.RUNNING):
+                break
+            time.sleep(1)
+        assert rec['status'] in (
+            jobs_state.ManagedJobStatus.STARTING,
+            jobs_state.ManagedJobStatus.RUNNING), rec
+        # Kill the CONTROLLER job out-of-band (process death).
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu.jobs import core as jobs_core
+        core_lib.cancel(jobs_core._controller_cluster_name(),
+                        [job_id])
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rec = jobs.core.get(job_id)
+            if rec['status'].is_terminal():
+                break
+            time.sleep(1)
+        assert rec['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER, rec
+        assert 'controller process ended' in rec['failure_reason']
+
+    def test_reconcile_unit(self, monkeypatch, tmp_path):
+        """reconcile_dead_controllers: terminal cluster job +
+        nonterminal row -> FAILED_CONTROLLER; terminal rows are
+        final (late writers cannot resurrect them)."""
+        monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path / 'rt'))
+        from skypilot_tpu.runtime import job_lib
+        cluster_job = job_lib.add_job('ctl', 'ts-1', 'cpu',
+                                      str(tmp_path / 'spec.json'))
+        # Align ids: managed job id == cluster job id.
+        row_id = jobs_state.add_job('r', '/tmp/d.yaml', 'ctrl')
+        assert row_id == cluster_job
+        jobs_state.set_status(row_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        job_lib.set_status(cluster_job,
+                           job_lib.JobStatus.FAILED_DRIVER)
+        reconciled = jobs_state.reconcile_dead_controllers()
+        assert reconciled == [row_id]
+        rec = jobs_state.get_job(row_id)
+        assert rec['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        assert 'FAILED_DRIVER' in rec['failure_reason']
+        # Terminal is final: a late SUCCEEDED write is ignored.
+        jobs_state.set_status(row_id,
+                              jobs_state.ManagedJobStatus.SUCCEEDED)
+        assert jobs_state.get_job(row_id)['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
