@@ -1,0 +1,48 @@
+"""Detached task-cluster reaper for dead managed-job controllers.
+
+``jobs_state.reconcile_dead_controllers`` runs inside every jobs RPC;
+tearing a real TPU slice down there would block (and time out) the
+very status query that discovered the dead controller. Instead it
+spawns this module DETACHED on the controller host; teardown retries
+here with backoff, logging to the controller state dir.
+
+Run: python3 -m skypilot_tpu.jobs.reap <cluster_name>
+(with SKYTPU_STATE_DIR pointing at the controller state dir).
+"""
+import os
+import sys
+import time
+
+
+def main() -> int:
+    cluster_name = sys.argv[1]
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import exceptions, state
+
+    last_err = None
+    for attempt in range(5):
+        if state.get_cluster_from_name(cluster_name) is None:
+            return 0  # already gone
+        try:
+            core_lib.down(cluster_name, purge=True)
+            return 0
+        except (exceptions.SkyTpuError, OSError) as e:
+            last_err = e
+            time.sleep(min(60.0, 5.0 * 2 ** attempt))
+    print(f'reap {cluster_name}: giving up after 5 attempts: '
+          f'{last_err}', file=sys.stderr)
+    return 1
+
+
+if __name__ == '__main__':
+    base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    os.makedirs(base, exist_ok=True)
+    # Detached process: keep a breadcrumb of what we reaped/failed.
+    log_path = os.path.join(base, 'reap.log')
+    with open(log_path, 'a', encoding='utf-8') as log:
+        sys.stderr = log
+        rc = main()
+        log.write(f'{time.strftime("%F %T")} reap {sys.argv[1:]} '
+                  f'rc={rc}\n')
+    raise SystemExit(rc)

@@ -116,14 +116,6 @@ def set_status(job_id: int, status: ManagedJobStatus,
                failure_reason: Optional[str] = None) -> None:
     db = _db()
     now = time.time()
-    current = get_job(job_id)
-    if current is not None and current['status'].is_terminal() and \
-            status != current['status']:
-        # Terminal is final: a late writer (e.g. an orphaned
-        # controller child whose job was already reconciled to
-        # FAILED_CONTROLLER) must not resurrect the row under
-        # callers that acted on the terminal state.
-        return
     sets = ['status=?']
     params: List[Any] = [status.value]
     if status == ManagedJobStatus.RUNNING:
@@ -136,9 +128,17 @@ def set_status(job_id: int, status: ManagedJobStatus,
         sets.append('failure_reason=?')
         params.append(failure_reason)
     params.append(job_id)
+    # Terminal is final, enforced IN the UPDATE predicate (atomic —
+    # a read-then-write guard would race the very late-writer it
+    # exists to block): a job already terminal (e.g. reconciled to
+    # FAILED_CONTROLLER) cannot be resurrected by an orphaned
+    # controller child.
+    terminal_values = tuple(s.value for s in _TERMINAL)
+    placeholders = ','.join('?' for _ in terminal_values)
     db.execute_and_commit(
-        f'UPDATE managed_jobs SET {", ".join(sets)} WHERE job_id=?',
-        tuple(params))
+        f'UPDATE managed_jobs SET {", ".join(sets)} '
+        f'WHERE job_id=? AND status NOT IN ({placeholders})',
+        tuple(params) + terminal_values)
 
 
 def set_task_cluster(job_id: int, cluster: str) -> None:
@@ -232,15 +232,19 @@ def reconcile_dead_controllers() -> List[int]:
             'terminal state')
         reconciled.append(rec['job_id'])
         if rec['task_cluster']:
-            # Best-effort: the task cluster is reachable only from
-            # this (controller) host; a dead controller leaves it
-            # billing with no other owner.
-            from skypilot_tpu import core as core_lib
-            from skypilot_tpu import exceptions
-            try:
-                core_lib.down(rec['task_cluster'], purge=True)
-            except (exceptions.SkyTpuError, OSError, ValueError):
-                pass
+            # The task cluster is reachable only from this
+            # (controller) host and now has no owner. Teardown can
+            # take minutes on a real provider, so it runs DETACHED
+            # (jobs/reap.py retries with backoff) — blocking here
+            # would time out the status RPC that found the body.
+            import subprocess
+            import sys as sys_mod
+            subprocess.Popen(
+                [sys_mod.executable, '-m', 'skypilot_tpu.jobs.reap',
+                 rec['task_cluster']],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True)
     return reconciled
 
 

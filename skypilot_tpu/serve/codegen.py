@@ -14,7 +14,11 @@ from skypilot_tpu.runtime import codegen as runtime_codegen
 
 STATE_SUBDIR = runtime_codegen.CONTROLLER_STATE_SUBDIR
 
-_PRELUDE = 'from skypilot_tpu.serve import serve_state\n'
+_PRELUDE = ('from skypilot_tpu.serve import serve_state\n'
+            # Dead serve controllers must not leave a stale READY:
+            # reconcile against the controller cluster's job table
+            # before every RPC (mirrors jobs/codegen._RECONCILE).
+            'serve_state.reconcile_dead_controllers()\n')
 
 
 def _wrap(runtime_dir: str, body: str) -> str:

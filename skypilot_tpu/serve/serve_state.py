@@ -103,9 +103,18 @@ def add_service(name: str, spec_json: str,
 
 
 def set_service_status(name: str, status: ServiceStatus) -> None:
+    # FAILED is sticky except toward DOWN (atomic, in the UPDATE
+    # predicate): once reconciliation declared the controller dead, a
+    # surviving orphan's READY ticks must not flap the status back
+    # (mirror of jobs/state.set_status finality).
+    if status == ServiceStatus.DOWN:
+        _db().execute_and_commit(
+            'UPDATE services SET status=? WHERE name=?',
+            (status.value, name))
+        return
     _db().execute_and_commit(
-        'UPDATE services SET status=? WHERE name=?',
-        (status.value, name))
+        'UPDATE services SET status=? WHERE name=? AND status != ?',
+        (status.value, name, ServiceStatus.FAILED.value))
 
 
 def set_service_endpoint(name: str, endpoint: str) -> None:
@@ -142,6 +151,37 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
         'controller_cluster': row[10],
         'controller_job_id': row[11],
     }
+
+
+def reconcile_dead_controllers() -> List[str]:
+    """Controller-side: services whose CONTROLLER PROCESS died (the
+    controller-cluster job they recorded is terminal while the
+    service is not DOWN/FAILED) are marked FAILED — a dead controller
+    cannot probe replicas or act on down flags, so a stale READY
+    would be a lie to ``serve status`` (same pattern as
+    jobs/state.reconcile_dead_controllers). Replica clusters are
+    left for ``serve down``'s force-clean (they may still be
+    serving). Returns the reconciled service names."""
+    from skypilot_tpu.runtime import job_lib
+    job_lib.update_job_statuses()
+    reconciled = []
+    for svc in get_services():
+        if svc['status'] in (ServiceStatus.DOWN, ServiceStatus.FAILED,
+                             ServiceStatus.SHUTTING_DOWN):
+            # SHUTTING_DOWN: down() may have cancelled the controller
+            # job while its graceful teardown still runs — that is an
+            # ordered shutdown, not a death to report as FAILED.
+            continue
+        job_id = svc['controller_job_id']
+        if not job_id:
+            continue
+        cluster_status = job_lib.get_status(int(job_id))
+        if cluster_status is None or \
+                not cluster_status.is_terminal():
+            continue
+        set_service_status(svc['name'], ServiceStatus.FAILED)
+        reconciled.append(svc['name'])
+    return reconciled
 
 
 def get_services() -> List[Dict[str, Any]]:

@@ -594,6 +594,23 @@ class TestControllerDeathReconciliation:
         assert rec['status'] == \
             jobs_state.ManagedJobStatus.FAILED_CONTROLLER, rec
         assert 'controller process ended' in rec['failure_reason']
+        # The detached reaper must reclaim the orphaned task cluster
+        # (it lives in the CONTROLLER's provider registry).
+        import os as os_lib
+
+        from skypilot_tpu.utils import common_utils
+        ctrl_rec = state.get_cluster_from_name(
+            jobs_core._controller_cluster_name())
+        ctrl_state = os_lib.path.join(
+            ctrl_rec['handle'].head_runtime_dir, 'managed')
+        mangled = common_utils.make_cluster_name_on_cloud(
+            rec['task_cluster'])
+        meta = os_lib.path.join(ctrl_state, 'local_clusters',
+                                f'{mangled}.json')
+        deadline = time.time() + 60
+        while time.time() < deadline and os_lib.path.exists(meta):
+            time.sleep(1)
+        assert not os_lib.path.exists(meta), 'task cluster leaked'
 
     def test_reconcile_unit(self, monkeypatch, tmp_path):
         """reconcile_dead_controllers: terminal cluster job +

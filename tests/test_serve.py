@@ -700,3 +700,45 @@ class TestRollingUpdate:
             assert rec['status'] == ServiceStatus.READY
         finally:
             serve_api.down('updsvc')
+
+
+@pytest.mark.slow
+class TestServeControllerDeath:
+    """A dead serve-controller process must surface as FAILED in
+    `serve status`, not a stale READY
+    (serve_state.reconcile_dead_controllers)."""
+
+    def test_dead_controller_reconciles_to_failed(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        task = Task(
+            name='dead-svc',
+            run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
+                 '--bind 127.0.0.1'))
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=60,
+            readiness_timeout_seconds=3, min_replicas=1, port=18600)
+        serve_api.up(task, 'deadsvc', wait_ready_timeout=120)
+        try:
+            rec = _svc('deadsvc')
+            assert rec['status'] == ServiceStatus.READY
+            # Kill the controller PROCESS out-of-band.
+            core_lib.cancel(rec['controller_cluster'],
+                            [rec['controller_job_id']])
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                rec = _svc('deadsvc')
+                if rec['status'] == ServiceStatus.FAILED:
+                    break
+                time.sleep(1)
+            assert rec['status'] == ServiceStatus.FAILED, rec
+        finally:
+            serve_api.down('deadsvc')
+        assert _svc('deadsvc') is None
