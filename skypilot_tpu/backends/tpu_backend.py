@@ -211,15 +211,23 @@ class TpuBackend(Backend):
         if is_tpu:
             cmd = f'echo 1 > {rdir}/job_slots'
         else:
-            body = (
-                "from skypilot_tpu.jobs import scheduler\n"
-                "import os\n"
-                "path = os.path.join(os.path.expanduser("
-                "os.environ['SKYTPU_RUNTIME_DIR']), 'job_slots')\n"
-                "with open(path, 'w') as f:\n"
-                "    f.write(str(scheduler."
-                "get_job_parallelism()))\n")
-            cmd = codegen._wrap(rdir, body)  # pylint: disable=protected-access
+            # Pure shell (same memory/350MB heuristic as
+            # jobs/scheduler.get_job_parallelism, floor 4, env
+            # override) — a python snippet here put ~1-2 s of
+            # interpreter+import on EVERY launch/reuse, tripling the
+            # measured time-to-first-step.
+            cmd = (
+                # A malformed override falls back to the heuristic
+                # (same as scheduler.get_job_parallelism's
+                # ValueError path), never to 1.
+                'S="${SKYTPU_JOBS_PARALLELISM:-}"; '
+                'case "$S" in (*[!0-9]*|"") S=""; ;; esac; '
+                '[ -n "$S" ] && [ "$S" -ge 1 ] || { '
+                'S=$(awk '
+                "'/MemTotal/ {print int($2/1024/350)}' "
+                '/proc/meminfo); '
+                '[ "$S" -ge 4 ] 2>/dev/null || S=4; }; '
+                f'echo "$S" > {rdir}/job_slots')
         out = handle.head_agent().exec(cmd, timeout=30)
         if out.get('returncode') != 0:
             logger.warning('writing job_slots returned %s: %s',
