@@ -110,8 +110,14 @@ def _get_service(handle, name: str) -> Optional[Dict[str, Any]]:
 
 
 def up(task: Task, service_name: Optional[str] = None,
-       wait_ready_timeout: float = 300.0) -> str:
-    """Start a service; returns the endpoint URL."""
+       wait_ready_timeout: float = 1800.0) -> str:
+    """Start a service; returns the endpoint URL.
+
+    ``wait_ready_timeout`` defaults to 30 min: the first TPU replica
+    on a real cloud takes 5-15 min to provision + load weights, and a
+    timeout here TEARS THE SERVICE DOWN (never leave a half-up
+    service), so it must exceed worst-case bring-up, not ping time.
+    """
     from skypilot_tpu import admin_policy
     from skypilot_tpu import execution, provision
     task = admin_policy.apply(task, at='serve')
