@@ -141,6 +141,10 @@ class TpuBackend(Backend):
             handle.workdir = os.path.join(base, 'sky_workdir')
         state.add_or_update_cluster(cluster_name, handle,
                                     task.resources, ready=False)
+        # The cluster row now owns the provider resources; the
+        # mid-provision breadcrumb is superseded (reclaimers use the
+        # row + core.down from here on).
+        state.clear_provision_breadcrumb(cluster_name)
         self._post_provision_runtime_setup(handle)
         state.add_or_update_cluster(cluster_name, handle,
                                     task.resources, ready=True,

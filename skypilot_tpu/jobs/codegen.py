@@ -20,9 +20,11 @@ _PRELUDE = 'from skypilot_tpu.jobs import state as jobs_state\n'
 # Reconcile managed-job rows against the controller cluster's own
 # job table before any read/write: a dead controller PROCESS must not
 # leave its managed job RUNNING (or its task cluster billing)
-# forever. The logic lives in jobs_state (importable, unit-testable);
-# the snippet is one call.
-_RECONCILE = 'jobs_state.reconcile_dead_controllers()\n'
+# forever. Then drain the durable teardown queue — every RPC retries
+# any reclaim a previous reaper failed (or died) at. The logic lives
+# in jobs_state (importable, unit-testable); the snippet is two calls.
+_RECONCILE = ('jobs_state.reconcile_dead_controllers()\n'
+              'jobs_state.drain_pending_teardowns()\n')
 
 
 def _wrap(runtime_dir: str, body: str) -> str:
@@ -70,9 +72,17 @@ else:
 
 def cancel_job(runtime_dir: str, job_id: int) -> str:
     """Cancel controller-side. A still-queued controller job (its
-    cluster job is PENDING) is cancelled outright and the row made
-    terminal; a running controller gets the signal file and acts on
-    it (tears its task cluster down) within a poll interval."""
+    cluster job is INIT/PENDING) is cancelled outright and the row
+    made terminal; a running controller gets the signal file and acts
+    on it (tears its task cluster down) within a poll interval.
+
+    The queued-vs-running decision is made INSIDE job_lib's queue
+    lock (``only_if_statuses``), atomically with the kill: a
+    controller the scheduler starts between our status read and the
+    cancel is NOT hard-killed (that would force the row terminal,
+    hide it from reconcile, and leak whatever task cluster it had
+    launched — round-4 advisor finding) — it keeps running and acts
+    on the signal file instead."""
     body = _RECONCILE + f'''
 from skypilot_tpu.runtime import job_lib
 rec = jobs_state.get_job({job_id})
@@ -82,10 +92,11 @@ elif rec['status'].is_terminal():
     print('CANCEL:already-terminal')
 else:
     jobs_state.request_cancel({job_id})
-    cluster_status = job_lib.get_status({job_id})
-    if cluster_status is not None and \\
-            cluster_status.value in ('INIT', 'PENDING'):
-        job_lib.cancel_jobs([{job_id}])
+    hard = job_lib.cancel_jobs(
+        [{job_id}],
+        only_if_statuses=[job_lib.JobStatus.INIT,
+                          job_lib.JobStatus.PENDING])
+    if {job_id} in hard:
         jobs_state.set_status(
             {job_id}, jobs_state.ManagedJobStatus.CANCELLED)
         jobs_state.clear_cancel({job_id})

@@ -66,6 +66,19 @@ def _create_tables(cursor, conn):
         recovery_count INTEGER DEFAULT 0,
         dag_yaml_path TEXT,
         failure_reason TEXT)""")
+    # Durable teardown queue: clusters that lost their owner (dead
+    # controller) and must be reclaimed. Rows survive process death —
+    # every reconcile AND the controller skylet event drain them until
+    # the cluster is verifiably gone (fixes the round-4 fire-and-forget
+    # reaper: one lost Popen used to mean a TPU slice billing forever).
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS pending_teardowns (
+        cluster_name TEXT PRIMARY KEY,
+        job_id INTEGER,
+        enqueued_at REAL,
+        attempts INTEGER DEFAULT 0,
+        last_attempt_at REAL DEFAULT 0,
+        last_error TEXT)""")
     conn.commit()
 
 
@@ -213,10 +226,15 @@ def reconcile_dead_controllers() -> List[int]:
     (their controller-cluster job — same id — is terminal while the
     row is not; the controller always writes its terminal row BEFORE
     exiting) are marked FAILED_CONTROLLER and their task clusters
-    torn down (nothing else will ever reclaim them). Runs on the
-    controller host in front of every jobs RPC read/write (reference
-    analog: skylet-driven managed-job reconciliation,
-    sky/skylet/events.py). Returns the reconciled job ids."""
+    ENQUEUED for teardown (nothing else will ever reclaim them).
+    Runs on the controller host in front of every jobs RPC read/write
+    AND from the controller skylet event (reference analog:
+    skylet-driven managed-job reconciliation, sky/skylet/events.py).
+
+    Teardown itself is NOT attempted here (it can take minutes on a
+    real provider and would time out the status RPC that found the
+    body) — callers follow up with ``drain_pending_teardowns``.
+    Returns the reconciled job ids."""
     from skypilot_tpu.runtime import job_lib
     job_lib.update_job_statuses()
     reconciled = []
@@ -231,21 +249,159 @@ def reconcile_dead_controllers() -> List[int]:
             f'({cluster_status.value}) before the job reached a '
             'terminal state')
         reconciled.append(rec['job_id'])
-        if rec['task_cluster']:
-            # The task cluster is reachable only from this
-            # (controller) host and now has no owner. Teardown can
-            # take minutes on a real provider, so it runs DETACHED
-            # (jobs/reap.py retries with backoff) — blocking here
-            # would time out the status RPC that found the body.
-            import subprocess
-            import sys as sys_mod
-            subprocess.Popen(
-                [sys_mod.executable, '-m', 'skypilot_tpu.jobs.reap',
-                 rec['task_cluster']],
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-                start_new_session=True)
+        # Kill any lingering controller rank FIRST: the driver's
+        # death does not reach agent-side processes (own sessions),
+        # and a surviving controller keeps launching/promoting task
+        # clusters — it would race and beat the teardown below.
+        job_lib.kill_job_processes(rec['job_id'])
+        # Re-read task_cluster AFTER the kill: the dying rank may
+        # have recorded a newer cluster (multi-task DAG moving on)
+        # between our snapshot and its confirmed death — enqueueing
+        # only the stale snapshot would leak the newer cluster
+        # forever (this row is terminal now; nobody looks again).
+        # Enqueue BOTH if they differ: the queue is idempotent and a
+        # cluster that is already gone costs one cheap lookup.
+        fresh = get_job(rec['job_id'])
+        for cluster in {rec['task_cluster'],
+                        (fresh or rec)['task_cluster']}:
+            if cluster:
+                enqueue_teardown(cluster, rec['job_id'])
     return reconciled
+
+
+def enqueue_teardown(cluster_name: str, job_id: int) -> None:
+    """Persist 'this cluster must be reclaimed' in the jobs DB. The
+    row outlives any single reaper process and is only removed once
+    the cluster is verifiably gone (``drain_pending_teardowns``)."""
+    _db().execute_and_commit(
+        'INSERT OR IGNORE INTO pending_teardowns '
+        '(cluster_name, job_id, enqueued_at) VALUES (?,?,?)',
+        (cluster_name, job_id, time.time()))
+
+
+def pending_teardowns() -> List[Dict[str, Any]]:
+    rows = _db().cursor.execute(
+        'SELECT cluster_name, job_id, enqueued_at, attempts, '
+        'last_attempt_at, last_error FROM pending_teardowns '
+        'ORDER BY enqueued_at').fetchall()
+    return [{
+        'cluster_name': r[0],
+        'job_id': r[1],
+        'enqueued_at': r[2],
+        'attempts': r[3],
+        'last_attempt_at': r[4],
+        'last_error': r[5],
+    } for r in rows]
+
+
+def note_teardown_attempt(cluster_name: str,
+                          error: Optional[str]) -> None:
+    # COALESCE: a reaper SPAWN (error=None) must not wipe the
+    # previous failed attempt's diagnostic from the row.
+    _db().execute_and_commit(
+        'UPDATE pending_teardowns SET attempts=attempts+1, '
+        'last_attempt_at=?, last_error=COALESCE(?, last_error) '
+        'WHERE cluster_name=?',
+        (time.time(), error, cluster_name))
+
+
+def finish_teardown(cluster_name: str) -> None:
+    _db().execute_and_commit(
+        'DELETE FROM pending_teardowns WHERE cluster_name=?',
+        (cluster_name,))
+
+
+def drain_pending_teardowns(block: bool = False,
+                            spawn_min_interval: float = 15.0
+                            ) -> List[str]:
+    """Reclaim every cluster in the pending_teardowns queue. Called
+    from the jobs-RPC reconcile prelude and from the controller
+    skylet event, so a teardown that fails (or a reaper that dies
+    mid-flight) is retried on every subsequent tick/RPC until the
+    cluster is gone.
+
+    ``block=True`` (skylet event thread — may take minutes) tears
+    down inline. ``block=False`` (RPC path) tears down inline only
+    for the subsecond ``local`` provider — which also makes the
+    controller-death e2e deterministic: the RPC that observes the
+    death reclaims the cluster before returning — and spawns the
+    detached reaper (jobs/reap.py) for real clouds, rate-limited by
+    ``spawn_min_interval`` so overlapping RPCs don't stack reapers.
+    Returns clusters verified gone."""
+    import filelock
+
+    from skypilot_tpu import state as global_state
+    rows = pending_teardowns()
+    if not rows:
+        return []
+    # Serialize drains across processes (RPC snippets, skylet, any
+    # straggling reaper): double-down on one cluster is safe but
+    # wasteful, and the lock keeps attempt accounting sane.
+    lock = filelock.FileLock(
+        os.path.join(os.path.dirname(_db_path()), '.teardown.lock'))
+    try:
+        lock.acquire(timeout=30.0 if block else 0.0)
+    except filelock.Timeout:
+        return []  # another drainer is on it; rows persist for next tick
+    done: List[str] = []
+    try:
+        for row in rows:
+            cluster = row['cluster_name']
+            rec = global_state.get_cluster_from_name(cluster)
+            crumb = None if rec is not None else \
+                global_state.get_provision_breadcrumb(cluster)
+            if rec is None and crumb is None:
+                # Verifiably gone: no cluster row AND no in-flight
+                # provision breadcrumb.
+                finish_teardown(cluster)
+                done.append(cluster)
+                continue
+            provider = crumb['provider'] if crumb is not None else \
+                getattr(rec['handle'], 'provider', None)
+            if block or provider == 'local':
+                try:
+                    reclaim_cluster(cluster)
+                    finish_teardown(cluster)
+                    done.append(cluster)
+                except Exception as e:  # noqa: BLE001 — row persists
+                    note_teardown_attempt(cluster, repr(e))
+            else:
+                if time.time() - (row['last_attempt_at'] or 0) < \
+                        spawn_min_interval:
+                    continue
+                note_teardown_attempt(cluster, None)
+                import subprocess
+                import sys as sys_mod
+                subprocess.Popen(
+                    [sys_mod.executable, '-m',
+                     'skypilot_tpu.jobs.reap', cluster],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    start_new_session=True)
+    finally:
+        lock.release()
+    return done
+
+
+def reclaim_cluster(cluster_name: str) -> None:
+    """Tear a cluster down through whichever pointer still exists:
+    the cluster row (normal ``down --purge``), or — when the owner
+    died MID-PROVISION, before the row was written — the provision
+    breadcrumb, via provider-level terminate. Raises on failure (the
+    caller keeps the pending_teardowns row for the next tick)."""
+    from skypilot_tpu import state as global_state
+    rec = global_state.get_cluster_from_name(cluster_name)
+    if rec is not None:
+        from skypilot_tpu import core as core_lib
+        core_lib.down(cluster_name, purge=True)
+        return
+    crumb = global_state.get_provision_breadcrumb(cluster_name)
+    if crumb is None:
+        return  # verifiably gone
+    from skypilot_tpu import provision
+    provision.terminate_instances(crumb['provider'], crumb['region'],
+                                  crumb['cluster_name_on_cloud'])
+    global_state.clear_provision_breadcrumb(cluster_name)
 
 
 def request_cancel(job_id: int) -> None:

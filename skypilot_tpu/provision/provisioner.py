@@ -183,6 +183,16 @@ class RetryingProvisioner:
                 node_config=node_config, count=num_nodes,
                 ports_to_open=list(to_provision.ports or []))
             where = zone or region
+            # Breadcrumb BEFORE the create call: if this process is
+            # killed mid-provision, provider resources can exist with
+            # no cluster row yet — the breadcrumb lets a reclaimer
+            # (e.g. a dead managed-job controller's teardown queue)
+            # find and terminate them. Cleared by the backend once
+            # the real cluster row is written, or below once a failed
+            # attempt's cleanup ran.
+            from skypilot_tpu import state as state_lib
+            state_lib.set_provision_breadcrumb(
+                cluster_name, cluster_name_on_cloud, provider, region)
             try:
                 record = bulk_provision(config)
             except exceptions.StockoutError as e:
@@ -214,6 +224,10 @@ class RetryingProvisioner:
                                       zone=record.zone)
             return ProvisionResult(record=record, cluster_info=info,
                                    final_resources=final)
+        # Every attempt failed and bulk_provision cleaned each one up
+        # best-effort — the breadcrumb has nothing left to point at.
+        from skypilot_tpu import state as state_lib
+        state_lib.clear_provision_breadcrumb(cluster_name)
         raise exceptions.ResourcesUnavailableError(
             f'Failed to provision {to_provision!r} in all '
             f'{len(placements)} candidate placement(s). History: '

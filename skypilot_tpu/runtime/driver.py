@@ -115,6 +115,17 @@ def run_job(job_id: int) -> job_lib.JobStatus:
                              log_path=_remote_log_path(spec, rank),
                              env=env, cwd=spec.get('workdir'))
         proc_ids.append(proc_id)
+        # Record each rank the moment it exists: rank processes run
+        # in their own sessions on each host, so anything that kills
+        # THIS driver (cancel, OOM) cannot reach them through the
+        # process tree — cancellation and dead-driver cleanup kill
+        # them via this record. Incremental, not after the loop: a
+        # SIGTERM mid gang-start (multi-host starts take one HTTP
+        # round per host) must still see the ranks started so far.
+        _live_gang.append((client, proc_id))
+        job_lib.set_procs(job_id,
+                          [(h['ip'], h['agent_port'], p)
+                           for h, p in zip(hosts, proc_ids)])
     logger.info('Gang-started job %d on %d host(s)', job_id, n)
 
     # Wait until all succeed or any fails (kill-all-on-failure).
@@ -230,10 +241,33 @@ def _fetch_logs(clients: List[AgentClient], spec: Dict[str, Any],
     return new_offsets
 
 
+# (client, proc_id) pairs of the currently-running gang — the SIGTERM
+# handler's kill list. Module-level because signal handlers can't see
+# run_job's locals.
+_live_gang: List[tuple] = []
+
+
+def _sigterm_gang_kill(signum, frame):
+    """Cancellation sends SIGTERM to the driver's process group; the
+    rank processes live in their OWN sessions on each host and would
+    survive it — for a managed-jobs controller that means a zombie
+    controller still launching task clusters after its job row went
+    terminal. Gang-kill through the agents before dying."""
+    del signum, frame
+    for client, proc_id in _live_gang:
+        try:
+            client.kill(proc_id)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    os._exit(143)  # pylint: disable=protected-access
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--job-id', type=int, required=True)
     args = parser.parse_args()
+    import signal
+    signal.signal(signal.SIGTERM, _sigterm_gang_kill)
     try:
         status = run_job(args.job_id)
     except Exception:

@@ -74,6 +74,13 @@ def _create_tables(cursor, conn):
         resources TEXT,
         pid INTEGER DEFAULT null,
         spec_path TEXT DEFAULT null)""")
+    # procs: JSON [[ip, agent_port, proc_id], ...] — the gang's
+    # agent-side processes, recorded by the driver during gang start.
+    # Task processes run in their OWN sessions on each host
+    # (agent.py /run), so killing the driver's process group does NOT
+    # reach them; cancellation and dead-driver cleanup kill them
+    # through this record (kill_job_processes).
+    db_utils.add_column_to_table(cursor, conn, 'jobs', 'procs', 'TEXT')
     conn.commit()
 
 
@@ -144,6 +151,65 @@ def set_pid(job_id: int, pid: int) -> None:
                              (pid, job_id))
 
 
+def set_procs(job_id: int, procs: List[tuple]) -> None:
+    """Record the gang's agent-side processes: [(ip, agent_port,
+    proc_id), ...]."""
+    import json as json_lib
+    _db().execute_and_commit('UPDATE jobs SET procs=? WHERE job_id=?',
+                             (json_lib.dumps(procs), job_id))
+
+
+def get_procs(job_id: int) -> List[tuple]:
+    import json as json_lib
+    row = _db().cursor.execute(
+        'SELECT procs FROM jobs WHERE job_id=?', (job_id,)).fetchone()
+    if not row or not row[0]:
+        return []
+    return [tuple(p) for p in json_lib.loads(row[0])]
+
+
+def kill_job_processes(job_id: int, wait_seconds: float = 5.0
+                       ) -> None:
+    """Kill a job's agent-side rank processes through the host
+    agents. Idempotent and best-effort: used by cancellation and by
+    dead-controller reconciliation — a driver killed by SIGKILL (no
+    handler ran) leaves its ranks running, and for a managed-jobs
+    controller a surviving rank keeps LAUNCHING task clusters,
+    racing (and beating) the teardown that reconcile queued."""
+    procs = get_procs(job_id)
+    if not procs:
+        return
+    rec = get_job(job_id)
+    token = None
+    if rec and rec.get('spec_path') and \
+            os.path.exists(rec['spec_path']):
+        import json as json_lib
+        with open(rec['spec_path'], encoding='utf-8') as f:
+            token = json_lib.load(f).get('agent_token')
+    from skypilot_tpu.runtime.agent_client import AgentClient
+    clients = []
+    for (ip, port, proc_id) in procs:
+        try:
+            client = AgentClient(ip, port, token=token)
+            client.kill(proc_id)
+            clients.append((client, proc_id))
+        except Exception:  # pylint: disable=broad-except
+            pass  # host gone is fine — the process died with it
+    # SIGTERM is asynchronous: wait for confirmed exit so callers can
+    # act on "the controller is dead" (e.g. reap its task cluster)
+    # without racing its final writes. Bounded — a wedged process
+    # can't hold the reconcile hostage.
+    deadline = time.time() + wait_seconds
+    for client, proc_id in clients:
+        while time.time() < deadline:
+            try:
+                if not client.status(proc_id).get('running'):
+                    break
+            except Exception:  # pylint: disable=broad-except
+                break
+            time.sleep(0.1)
+
+
 def get_status(job_id: int) -> Optional[JobStatus]:
     row = _db().cursor.execute(
         'SELECT status FROM jobs WHERE job_id=?', (job_id,)).fetchone()
@@ -202,9 +268,15 @@ def get_latest_job_id() -> Optional[int]:
     return int(row[0]) if row else None
 
 
-def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
+def cancel_jobs(job_ids: Optional[List[int]] = None,
+                only_if_statuses: Optional[List['JobStatus']] = None
+                ) -> List[int]:
     """Cancel given jobs (default: all non-terminal). Kills driver
-    process groups."""
+    process groups. ``only_if_statuses`` restricts cancellation to
+    jobs whose status — re-read under the queue lock, so the check is
+    atomic with the kill — is in the set; jobs that raced past it
+    (e.g. a queued controller the scheduler just started) are left
+    alone and reported by omission from the returned list."""
     with queue_lock():
         if job_ids is None:
             records = get_jobs(JobStatus.nonterminal_statuses())
@@ -214,6 +286,9 @@ def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
             rec = get_job(job_id)
             if rec is None or rec['status'].is_terminal():
                 continue
+            if only_if_statuses is not None and \
+                    rec['status'] not in only_if_statuses:
+                continue
             pid = rec['pid']
             if pid:
                 try:
@@ -222,7 +297,18 @@ def cancel_jobs(job_ids: Optional[List[int]] = None) -> List[int]:
                     pass
             set_status(job_id, JobStatus.CANCELLED)
             cancelled.append(job_id)
-        return cancelled
+    # The driver's SIGTERM handler gang-kills its ranks, but don't
+    # bet on it having run (SIGKILL, handler raced at startup): kill
+    # the recorded agent-side processes directly. Outside the queue
+    # lock — these are HTTP calls to the host agents — and in
+    # parallel with one shared wait budget: a cancel-all of many
+    # jobs must stay well inside the backend's 60 s RPC timeout.
+    if cancelled:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=min(16, len(cancelled))) as ex:
+            list(ex.map(kill_job_processes, cancelled))
+    return cancelled
 
 
 def is_cluster_idle(idle_minutes: int) -> bool:

@@ -2,19 +2,69 @@
 ``events.py``).
 
 Every tick: run the FIFO scheduler, reconcile dead drivers, check
-autostop. Runs as a daemon started by instance_setup (or the local
-provisioner) on the head host.
+autostop. On CONTROLLER clusters (a ``managed`` state dir exists in
+the runtime dir) a second, slower loop reconciles managed jobs and
+serve health with NO client involved — the analog of the reference's
+``ManagedJobEvent`` / ``ServiceUpdateEvent``
+(``sky/skylet/events.py:64-88``): a dead controller's task cluster is
+reclaimed by the next tick even if no human ever runs
+``xsky jobs queue``. Runs as a daemon started by instance_setup (or
+the local provisioner) on the head host.
 """
 import argparse
+import os
 import subprocess
+import threading
 import time
 
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.runtime import autostop_lib, job_lib
+from skypilot_tpu.runtime.codegen import CONTROLLER_STATE_SUBDIR
 
 logger = tpu_logging.init_logger(__name__)
 
 EVENT_INTERVAL_SECONDS = 5.0
+# Reference: skylet reconciles managed jobs / serve every 20 s
+# (sky/skylet/events.py EVENT_CHECKING_INTERVAL_SECONDS).
+CONTROLLER_EVENT_INTERVAL_SECONDS = 20.0
+
+
+def run_controller_event() -> None:
+    """One reconcile pass over the controller-side state (no-op on
+    non-controller clusters). Blocking teardowns are fine here — this
+    runs on the dedicated controller-event thread, not the scheduler
+    tick."""
+    managed = os.path.join(job_lib.runtime_dir(),
+                           CONTROLLER_STATE_SUBDIR)
+    if not os.path.isdir(managed):
+        return
+    # jobs_state/serve_state/cluster-state all key off
+    # SKYTPU_STATE_DIR — same env contract the codegen RPC snippets
+    # and the detached reaper use (runtime/codegen.py).
+    os.environ['SKYTPU_STATE_DIR'] = managed
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import serve_state
+    reconciled = jobs_state.reconcile_dead_controllers()
+    if reconciled:
+        logger.info('controller event: reconciled dead controllers '
+                    'for managed jobs %s', reconciled)
+    reclaimed = jobs_state.drain_pending_teardowns(block=True)
+    if reclaimed:
+        logger.info('controller event: reclaimed orphaned clusters '
+                    '%s', reclaimed)
+    failed = serve_state.reconcile_dead_controllers()
+    if failed:
+        logger.info('controller event: marked dead services FAILED '
+                    '%s', failed)
+
+
+def _controller_event_loop(interval: float) -> None:
+    while True:
+        try:
+            run_controller_event()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('controller event failed')
+        time.sleep(interval)
 
 
 def run_once(scheduler: job_lib.FIFOScheduler) -> None:
@@ -39,6 +89,8 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--interval', type=float,
                         default=EVENT_INTERVAL_SECONDS)
+    parser.add_argument('--controller-interval', type=float,
+                        default=CONTROLLER_EVENT_INTERVAL_SECONDS)
     parser.add_argument('--runtime-dir', default=None,
                         help='Runtime dir to serve. Also an argv '
                              'marker so the start guard can pgrep '
@@ -51,7 +103,10 @@ def main():
     scheduler = job_lib.FIFOScheduler()
     logger.info('skylet started (interval %.1fs, runtime dir %s)',
                 args.interval, job_lib.runtime_dir())
-    import os
+    threading.Thread(
+        target=_controller_event_loop,
+        args=(args.controller_interval,),
+        daemon=True, name='controller-events').start()
     while True:
         if not os.path.isdir(job_lib.runtime_dir()):
             # Cluster torn down underneath us (local fake provider

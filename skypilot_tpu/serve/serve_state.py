@@ -180,6 +180,10 @@ def reconcile_dead_controllers() -> List[str]:
                 not cluster_status.is_terminal():
             continue
         set_service_status(svc['name'], ServiceStatus.FAILED)
+        # A lingering controller rank (driver death does not reach
+        # agent-side processes) would keep mutating replicas under a
+        # FAILED service — kill it before reporting.
+        job_lib.kill_job_processes(int(job_id))
         reconciled.append(svc['name'])
     return reconciled
 

@@ -70,6 +70,19 @@ def _create_tables(cursor, conn):
     cursor.execute("""\
         CREATE TABLE IF NOT EXISTS config (
         key TEXT PRIMARY KEY, value TEXT)""")
+    # Provision-in-flight breadcrumbs: written BEFORE each provider
+    # create attempt, cleared once the cluster row exists (or the
+    # failed attempt's cleanup ran). A process killed mid-provision
+    # leaves provider resources with NO cluster row — the breadcrumb
+    # is the only pointer a reclaimer (jobs/state.reclaim_cluster)
+    # has for terminating them.
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS provision_breadcrumbs (
+        cluster_name TEXT PRIMARY KEY,
+        cluster_name_on_cloud TEXT,
+        provider TEXT,
+        region TEXT,
+        started_at REAL)""")
     conn.commit()
 
 
@@ -179,6 +192,43 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
         db.execute_and_commit(
             'UPDATE clusters SET status=? WHERE name=?',
             (status_lib.ClusterStatus.STOPPED.value, cluster_name))
+
+
+# -- provision breadcrumbs --------------------------------------------
+
+
+def set_provision_breadcrumb(cluster_name: str,
+                             cluster_name_on_cloud: str,
+                             provider: str, region: str) -> None:
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO provision_breadcrumbs '
+        '(cluster_name, cluster_name_on_cloud, provider, region, '
+        'started_at) VALUES (?,?,?,?,?)',
+        (cluster_name, cluster_name_on_cloud, provider, region,
+         time.time()))
+
+
+def get_provision_breadcrumb(
+        cluster_name: str) -> Optional[Dict[str, Any]]:
+    row = _db().cursor.execute(
+        'SELECT cluster_name, cluster_name_on_cloud, provider, '
+        'region, started_at FROM provision_breadcrumbs '
+        'WHERE cluster_name=?', (cluster_name,)).fetchone()
+    if row is None:
+        return None
+    return {
+        'cluster_name': row[0],
+        'cluster_name_on_cloud': row[1],
+        'provider': row[2],
+        'region': row[3],
+        'started_at': row[4],
+    }
+
+
+def clear_provision_breadcrumb(cluster_name: str) -> None:
+    _db().execute_and_commit(
+        'DELETE FROM provision_breadcrumbs WHERE cluster_name=?',
+        (cluster_name,))
 
 
 def get_cluster_from_name(

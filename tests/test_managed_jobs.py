@@ -607,13 +607,38 @@ class TestControllerDeathReconciliation:
             rec['task_cluster'])
         meta = os_lib.path.join(ctrl_state, 'local_clusters',
                                 f'{mangled}.json')
-        # Generous window: the reaper is a detached python process
-        # (interpreter + package import before the down) and this
-        # suite runs under heavy parallel-test load.
-        deadline = time.time() + 180
+        # Deterministic: the reclaim is a durable pending_teardowns
+        # row drained inline (local provider) by the SAME RPC that
+        # reconciles, so the queue read that observed
+        # FAILED_CONTROLLER has already torn the task cluster down —
+        # no detached-process guess window. The short loop below
+        # only covers a drain that lost the cross-process teardown
+        # lock to the skylet event: each iteration actively drains
+        # again rather than waiting on anything.
+        deadline = time.time() + 30
         while time.time() < deadline and os_lib.path.exists(meta):
+            jobs.core.get(job_id)  # reconcile + drain runs in-RPC
             time.sleep(1)
-        assert not os_lib.path.exists(meta), 'task cluster leaked'
+        if os_lib.path.exists(meta):
+            # Dump the controller-side teardown queue for triage.
+            import sqlite3
+            diag = {}
+            try:
+                conn = sqlite3.connect(
+                    os_lib.path.join(ctrl_state, 'managed_jobs.db'))
+                diag['pending'] = list(conn.execute(
+                    'SELECT cluster_name, attempts, last_error '
+                    'FROM pending_teardowns'))
+                conn2 = sqlite3.connect(
+                    os_lib.path.join(ctrl_state, 'state.db'))
+                diag['crumbs'] = list(conn2.execute(
+                    'SELECT cluster_name, provider, region '
+                    'FROM provision_breadcrumbs'))
+                diag['clusters'] = list(conn2.execute(
+                    'SELECT name, status FROM clusters'))
+            except sqlite3.Error as e:
+                diag['db_error'] = repr(e)
+            raise AssertionError(f'task cluster leaked: {diag}')
 
     def test_reconcile_unit(self, monkeypatch, tmp_path):
         """reconcile_dead_controllers: terminal cluster job +
@@ -641,3 +666,131 @@ class TestControllerDeathReconciliation:
                               jobs_state.ManagedJobStatus.SUCCEEDED)
         assert jobs_state.get_job(row_id)['status'] == \
             jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+
+    def test_teardown_queue_survives_failed_reaper(self, monkeypatch):
+        """The pending_teardowns row is removed ONLY on verified
+        success: a teardown that fails (reaper killed mid-flight,
+        provider error) is retried by the NEXT drain — one lost
+        attempt can no longer leak a billing cluster."""
+        import types
+
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import state as global_state
+
+        alive = {'c': True}
+        monkeypatch.setattr(
+            global_state, 'get_cluster_from_name',
+            lambda name: ({'handle': types.SimpleNamespace(
+                provider='local')} if alive['c'] else None))
+        calls = {'n': 0}
+
+        def down(name, purge=False):
+            calls['n'] += 1
+            if calls['n'] == 1:
+                raise OSError('reaper died mid-teardown')
+            alive['c'] = False
+
+        monkeypatch.setattr(core_lib, 'down', down)
+        jobs_state.enqueue_teardown('mj-victim', 7)
+        # Re-enqueue is idempotent (every reconcile pass re-runs it).
+        jobs_state.enqueue_teardown('mj-victim', 7)
+        assert len(jobs_state.pending_teardowns()) == 1
+
+        # First drain: teardown dies. Row must survive with the
+        # failure recorded.
+        assert jobs_state.drain_pending_teardowns() == []
+        (row,) = jobs_state.pending_teardowns()
+        assert row['attempts'] == 1
+        assert 'mid-teardown' in row['last_error']
+
+        # Next tick (skylet event / any RPC): reclaimed for real.
+        assert jobs_state.drain_pending_teardowns() == ['mj-victim']
+        assert jobs_state.pending_teardowns() == []
+        assert not alive['c']
+
+    def test_skylet_controller_event_no_client(self, monkeypatch,
+                                               tmp_path):
+        """The controller skylet event reconciles + drains with NO
+        client RPC involved (reference ManagedJobEvent,
+        sky/skylet/events.py:64-88): a dead controller's task cluster
+        is reclaimed by the next tick even if nobody ever polls."""
+        import types
+
+        from skypilot_tpu.runtime import job_lib, skylet
+
+        rdir = tmp_path / 'ctrl-rt'
+        managed = rdir / 'managed'
+        managed.mkdir(parents=True)
+        monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(rdir))
+        monkeypatch.setenv('SKYTPU_STATE_DIR', str(managed))
+
+        # Dead controller: cluster job terminal, managed row RUNNING.
+        cluster_job = job_lib.add_job('ctl', 'ts-1', 'cpu',
+                                      str(tmp_path / 'spec.json'))
+        row_id = jobs_state.add_job('r', '/tmp/d.yaml', 'ctrl')
+        assert row_id == cluster_job
+        jobs_state.set_status(row_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        jobs_state.set_task_cluster(row_id, 'orphan-task')
+        job_lib.set_status(cluster_job, job_lib.JobStatus.FAILED_DRIVER)
+
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import state as global_state
+        alive = {'c': True}
+        monkeypatch.setattr(
+            global_state, 'get_cluster_from_name',
+            lambda name: ({'handle': types.SimpleNamespace(
+                provider='local')} if alive['c'] else None))
+
+        def down(name, purge=False):
+            assert name == 'orphan-task'
+            alive['c'] = False
+
+        monkeypatch.setattr(core_lib, 'down', down)
+
+        skylet.run_controller_event()
+
+        assert jobs_state.get_job(row_id)['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        assert jobs_state.pending_teardowns() == []
+        assert not alive['c']
+
+    def test_drain_spawns_rate_limited_reaper_for_real_clouds(
+            self, monkeypatch):
+        """Non-local providers: drain spawns the DETACHED reaper (a
+        blocking in-RPC teardown would time out the status call) and
+        rate-limits respawns so overlapping RPCs don't stack them —
+        but a stale attempt is retried once the interval passes."""
+        import subprocess
+        import types
+
+        from skypilot_tpu import state as global_state
+
+        monkeypatch.setattr(
+            global_state, 'get_cluster_from_name',
+            lambda name: {'handle': types.SimpleNamespace(
+                provider='gcp')})
+        spawned = []
+        monkeypatch.setattr(
+            subprocess, 'Popen',
+            lambda cmd, **kw: spawned.append(cmd) or
+            types.SimpleNamespace(pid=12345))
+
+        jobs_state.enqueue_teardown('tpu-victim', 3)
+        jobs_state.drain_pending_teardowns(spawn_min_interval=30.0)
+        assert len(spawned) == 1
+        assert 'skypilot_tpu.jobs.reap' in spawned[0]
+        assert 'tpu-victim' in spawned[0]
+        # Row persists until the reaper verifies the cluster gone.
+        (row,) = jobs_state.pending_teardowns()
+        assert row['attempts'] == 1
+        # Immediate re-drain: rate-limited, no reaper pile-up.
+        jobs_state.drain_pending_teardowns(spawn_min_interval=30.0)
+        assert len(spawned) == 1
+        # After the interval elapses, a lost reaper is replaced.
+        jobs_state.note_teardown_attempt('tpu-victim', None)
+        jobs_state._db().execute_and_commit(
+            'UPDATE pending_teardowns SET last_attempt_at=? '
+            'WHERE cluster_name=?', (time.time() - 60, 'tpu-victim'))
+        jobs_state.drain_pending_teardowns(spawn_min_interval=30.0)
+        assert len(spawned) == 2
