@@ -50,6 +50,38 @@ class TpuBackend(Backend):
                           retry_until_up: bool = False
                           ) -> Optional[ClusterHandle]:
         record = state.get_cluster_from_name(cluster_name)
+        if record is not None and not dryrun and \
+                record['status'] in (status_lib.ClusterStatus.UP,
+                                     status_lib.ClusterStatus.STOPPED):
+            # The record may be stale: an autostopped cluster stops
+            # ITSELF (its skylet runs the stop on the head), so the
+            # client DB can still say UP. One provider liveness query
+            # decides reuse vs transparent restart (the reference's
+            # launch-on-stopped-cluster behavior).
+            h: ClusterHandle = record['handle']
+            try:
+                statuses = provision.query_instances(
+                    h.provider, h.region, h.cluster_name_on_cloud)
+            except exceptions.SkyTpuError:
+                statuses = None  # provider unreachable: trust the DB
+            if statuses is not None and not statuses:
+                # Gone from the cloud (preempted / deleted out of
+                # band): fall through to a fresh provision.
+                state.remove_cluster(cluster_name, terminate=True)
+                record = None
+            elif record['status'] == status_lib.ClusterStatus.STOPPED \
+                    or (statuses is not None and
+                        any(v in ('stopped', 'stopping')
+                            for v in statuses.values())):
+                # Restart covers transitional states too: the
+                # provider's run_instances settles a STOPPING
+                # instance before resuming it — NEVER fall through
+                # to a fresh same-name provision while the old
+                # instance (and its on-disk state) still exists.
+                logger.info('Cluster %s is stopped; restarting it.',
+                            cluster_name)
+                self.restart_cluster(cluster_name, h)
+                record = state.get_cluster_from_name(cluster_name)
         if record is not None and \
                 record['status'] == status_lib.ClusterStatus.UP:
             handle: ClusterHandle = record['handle']
@@ -513,6 +545,55 @@ class TpuBackend(Backend):
             time.sleep(poll_interval)
 
     # -- autostop / teardown -------------------------------------------
+
+    def restart_cluster(self, cluster_name: str,
+                        handle: ClusterHandle) -> ClusterHandle:
+        """Restart a STOPPED cluster in place: re-run the provider
+        create (which resumes stopped instances), refresh host
+        addresses (IPs/agent ports can change across a stop), and
+        bring the runtime back up. State on the cluster's disk —
+        controller DBs, job queue, logs — survives. Callers hold the
+        cluster lock or accept launch-level racing (``core.start``
+        matches the reference's ``sky start``)."""
+        from skypilot_tpu.provision.common import ProvisionConfig
+        from skypilot_tpu.provision.provisioner import bulk_provision
+        res = handle.launched_resources
+        from skypilot_tpu import clouds as clouds_lib
+        if clouds_lib.from_name(handle.provider).is_local or \
+                res is None:
+            node_config: Dict[str, Any] = {
+                'num_hosts': handle.num_hosts or 1}
+        else:
+            # TPU slice vars, or the machine type of an
+            # accelerator-less controller VM — same split as
+            # provisioner.provision_with_retries.
+            node_config = res.make_deploy_variables(
+                handle.cluster_name_on_cloud)
+        node_config.update(getattr(res, '_extra_config', None) or {})
+        # Keep the original shared secret: local agents respawn with
+        # it (a token-less agent would accept unauthenticated shell).
+        if handle.agent_token is not None:
+            node_config['agent_token'] = handle.agent_token
+        bulk_provision(ProvisionConfig(
+            provider=handle.provider, region=handle.region,
+            zone=handle.zone, cluster_name=cluster_name,
+            cluster_name_on_cloud=handle.cluster_name_on_cloud,
+            node_config=node_config))
+        info = provision.get_cluster_info(handle.provider,
+                                          handle.region,
+                                          handle.cluster_name_on_cloud)
+        handle.hosts = [{
+            'ip': inst.internal_ip,
+            'external_ip': inst.external_ip,
+            'agent_port': inst.agent_port,
+            'runtime_dir': inst.tags.get('runtime_dir',
+                                         '~/.skypilot_tpu'),
+        } for inst in info.instances]
+        handle.head_runtime_dir = handle.hosts[0]['runtime_dir']
+        self._post_provision_runtime_setup(handle)
+        state.add_or_update_cluster(cluster_name, handle, None,
+                                    ready=True)
+        return handle
 
     def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
                      down: bool = False) -> None:

@@ -1,0 +1,24 @@
+"""Cross-cutting constants (analog of ``sky/skylet/constants.py``).
+
+Kept deliberately small: most tunables live in config.yaml
+(config.py); only values that define the framework's contract with
+itself belong here.
+"""
+import os
+
+# Controller clusters (managed jobs / serve) autostop after this many
+# idle minutes — a controller VM must not bill forever after its last
+# job finishes. The next ``jobs launch`` / ``serve up`` restarts it
+# transparently, state intact (the controller DBs live on its disk).
+# Mirrors the reference's CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP
+# (``sky/skylet/constants.py:284``, applied at
+# ``sky/jobs/core.py:150-151`` and ``sky/serve/core.py:249``).
+CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP = 10
+
+
+def controller_autostop_minutes() -> int:
+    """Env-overridable (tests use 0 for an immediate trigger; < 0
+    disables)."""
+    return int(
+        os.environ.get('SKYTPU_CONTROLLER_IDLE_MINUTES',
+                       CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP))

@@ -84,34 +84,7 @@ def start(cluster_name: str) -> None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
     handle: ClusterHandle = record['handle']
-    from skypilot_tpu.provision.common import ProvisionConfig
-    from skypilot_tpu.provision.provisioner import bulk_provision
-    res = handle.launched_resources
-    node_config: Dict[str, Any] = {'num_hosts': handle.num_hosts or 1}
-    if res is not None and res.accelerator is not None:
-        node_config = res.make_deploy_variables(
-            handle.cluster_name_on_cloud)
-    node_config.update(getattr(res, '_extra_config', None) or {})
-    bulk_provision(ProvisionConfig(
-        provider=handle.provider, region=handle.region,
-        zone=handle.zone, cluster_name=cluster_name,
-        cluster_name_on_cloud=handle.cluster_name_on_cloud,
-        node_config=node_config))
-    # Hosts may have new IPs/agent ports after a restart — rebuild
-    # the handle from fresh cluster info before health-checking.
-    info = provision.get_cluster_info(handle.provider, handle.region,
-                                      handle.cluster_name_on_cloud)
-    handle.hosts = [{
-        'ip': inst.internal_ip,
-        'external_ip': inst.external_ip,
-        'agent_port': inst.agent_port,
-        'runtime_dir': inst.tags.get('runtime_dir',
-                                     '~/.skypilot_tpu'),
-    } for inst in info.instances]
-    handle.head_runtime_dir = handle.hosts[0]['runtime_dir']
-    backend = TpuBackend()
-    backend._post_provision_runtime_setup(handle)  # pylint: disable=protected-access
-    state.add_or_update_cluster(cluster_name, handle, None, ready=True)
+    TpuBackend().restart_cluster(cluster_name, handle)
 
 
 @usage.entrypoint('autostop')

@@ -72,11 +72,18 @@ def _ensure_controller_cluster():
     """Provision (or reuse) the controller cluster; returns its
     handle. A run-less task goes through the ordinary launch path
     (provision + runtime bring-up, no job submitted)."""
+    from skypilot_tpu import constants
     up_task = Task(name='jobs-controller-up')
     up_task.set_resources(_controller_resources())
-    execution.launch(up_task, _controller_cluster_name(), fast=True,
-                     detach_run=True, quiet_optimizer=True,
-                     retry_until_up=True)
+    # Controller autostop: an idle controller VM stops itself (its
+    # own skylet runs the stop) instead of billing forever; this very
+    # launch restarts a stopped one transparently
+    # (tpu_backend.restart_cluster), controller state intact on its
+    # disk. Reference: sky/jobs/core.py:150-151.
+    execution.launch(
+        up_task, _controller_cluster_name(), fast=True,
+        detach_run=True, quiet_optimizer=True, retry_until_up=True,
+        idle_minutes_to_autostop=constants.controller_autostop_minutes())
     return _get_controller_handle()
 
 

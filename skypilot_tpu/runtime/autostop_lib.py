@@ -61,4 +61,50 @@ def should_trigger() -> Optional[Dict[str, Any]]:
         return None
     if not job_lib.is_cluster_idle(cfg['idle_minutes']):
         return None
+    if _controller_owes_teardowns():
+        return None
     return cfg
+
+
+def _controller_owes_teardowns() -> bool:
+    """A CONTROLLER cluster with queued task-cluster teardowns is not
+    idle: stopping it would strand the reclaim (the pending rows are
+    only drained by its RPCs/skylet) while the orphaned TPU slices
+    keep billing — the opposite of what autostop is for.
+
+    Reads the DB by explicit path (no SKYTPU_STATE_DIR mutation:
+    skylet's controller-event thread sets that var process-wide and
+    relies on it staying set mid-pass)."""
+    from skypilot_tpu.runtime.codegen import CONTROLLER_STATE_SUBDIR
+    managed = os.path.join(job_lib.runtime_dir(),
+                           CONTROLLER_STATE_SUBDIR)
+    db_path = os.path.join(managed, 'managed_jobs.db')
+    if not os.path.exists(db_path):
+        return False
+    import sqlite3
+    try:
+        conn = sqlite3.connect(db_path, timeout=5.0)
+        try:
+            row = conn.execute(
+                'SELECT COUNT(*) FROM pending_teardowns').fetchone()
+        finally:
+            conn.close()
+        return bool(row and row[0])
+    except sqlite3.OperationalError as e:
+        if 'no such table' in str(e):
+            return False  # pre-queue DB: nothing can be owed
+        _get_logger().warning(
+            'autostop blocked: cannot read pending_teardowns '
+            '(%s) — refusing to stop a controller that may owe '
+            'teardowns', e)
+        return True  # can't prove the queue is empty: don't stop
+    except Exception as e:  # pylint: disable=broad-except
+        _get_logger().warning(
+            'autostop blocked: pending_teardowns check failed '
+            '(%s)', e)
+        return True
+
+
+def _get_logger():
+    from skypilot_tpu import tpu_logging
+    return tpu_logging.init_logger(__name__)

@@ -676,7 +676,13 @@ int main(int argc, char** argv) {
   // Reap orphaned /run children we never re-query.
   // (waitpid in ProcTable handles tracked ones.)
 
-  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  // SOCK_CLOEXEC on the listen socket (and accept4 below on the
+  // connection sockets): /run children fork+exec into long-lived own
+  // sessions — without close-on-exec they inherit these fds, and a
+  // child (e.g. the skylet) holding the old listen fd keeps the port
+  // bound after the agent dies, so a restarted agent exits at bind()
+  // and the cluster never comes back healthy.
+  int listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (listen_fd < 0) { perror("socket"); return 1; }
   // SIGTERM: the handler does only async-signal-safe work (set a
   // flag, close the listen fd); the accept loop notices and runs the
@@ -700,7 +706,7 @@ int main(int argc, char** argv) {
   std::fprintf(stderr, "host_agent (cpp) listening on %s:%d\n", host.c_str(),
                port);
   while (true) {
-    int fd = accept(listen_fd, nullptr, nullptr);
+    int fd = accept4(listen_fd, nullptr, nullptr, SOCK_CLOEXEC);
     if (fd < 0) {
       if (g_stop) break;
       continue;

@@ -65,11 +65,16 @@ def _get_controller_handle(must_exist: bool = True):
 
 def _ensure_controller_cluster():
     from skypilot_tpu import execution
+    from skypilot_tpu import constants
     up_task = Task(name='serve-controller-up')
     up_task.set_resources(_controller_resources())
-    execution.launch(up_task, _controller_cluster_name(), fast=True,
-                     detach_run=True, quiet_optimizer=True,
-                     retry_until_up=True)
+    # Same autostop policy as the jobs controller (reference:
+    # sky/serve/core.py:249) — an idle serve controller stops itself;
+    # the next `serve up` restarts it with the serve DB intact.
+    execution.launch(
+        up_task, _controller_cluster_name(), fast=True,
+        detach_run=True, quiet_optimizer=True, retry_until_up=True,
+        idle_minutes_to_autostop=constants.controller_autostop_minutes())
     return _get_controller_handle()
 
 

@@ -475,6 +475,28 @@ class TestGcpFakeControllerEndToEnd:
                 return {'name': 'op-1', 'selfLink':
                         f'{gcp_client.COMPUTE_API}/op-self'}
             name = url.rsplit('/', 1)[-1].split(':')[0]
+            if method == 'POST' and ':' in url.rsplit('/', 1)[-1]:
+                verb = url.rsplit(':', 1)[-1]
+                if name not in vms:
+                    raise exceptions.ApiError('not found',
+                                              http_code=404)
+                if verb == 'stop':
+                    # A stopped VM's processes die with it. Wait for
+                    # the exit so the port is free when a restart
+                    # spawns the next agent on it.
+                    vms[name]['status'] = 'TERMINATED'
+                    info = runtime.get(name)
+                    if info and info['proc'] is not None:
+                        info['proc'].terminate()
+                        info['proc'].wait(timeout=10)
+                        info['proc'] = None
+                elif verb in ('start', 'resume'):
+                    vms[name]['status'] = 'RUNNING'
+                else:
+                    raise exceptions.ApiError('not found',
+                                              http_code=404)
+                return {'name': f'op-{verb}', 'selfLink':
+                        f'{gcp_client.COMPUTE_API}/op-self'}
             if method == 'GET':
                 if name in vms:
                     return vms[name]
@@ -557,6 +579,34 @@ class TestGcpFakeControllerEndToEnd:
         buf = io.StringIO()
         jobs.core.tail_logs(job_id, out=buf, follow=False)
         assert 'via-gcp-controller' in buf.getvalue()
+
+    def test_stopped_gcp_controller_restarts_on_launch(
+            self, gcp_fake, cleanup_clusters):
+        """GCE controller VM: stop through the (fake) compute API,
+        then the next jobs launch resumes the instance and the RPC
+        channel comes back with state intact (controller autostop's
+        restart half on the gcp path)."""
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu.jobs import core as jobs_core
+
+        vms, runtime = gcp_fake
+        j1 = jobs.launch(_local_task('echo g-one', name='gas-one'),
+                         detach=True)
+        assert jobs.core.wait(j1, timeout=180) == \
+            jobs_state.ManagedJobStatus.SUCCEEDED
+        ctrl_name = jobs_core._controller_cluster_name()
+        assert state.get_cluster_from_name(ctrl_name)['autostop'] == 10
+        core_lib.stop(ctrl_name)
+        name, vm = next(iter(vms.items()))
+        assert vm['status'] in ('TERMINATED', 'STOPPED', 'STOPPING')
+
+        j2 = jobs.launch(_local_task('echo g-two', name='gas-two'),
+                         detach=True)
+        assert jobs.core.wait(j2, timeout=180) == \
+            jobs_state.ManagedJobStatus.SUCCEEDED
+        assert vm['status'] == 'RUNNING'
+        ids = {r['job_id'] for r in jobs.core.queue()}
+        assert {j1, j2} <= ids
 
 
 class TestControllerDeathReconciliation:
@@ -794,3 +844,54 @@ class TestControllerDeathReconciliation:
             'WHERE cluster_name=?', (time.time() - 60, 'tpu-victim'))
         jobs_state.drain_pending_teardowns(spawn_min_interval=30.0)
         assert len(spawned) == 2
+
+
+class TestControllerAutostop:
+    """Controller clusters carry idle_minutes_to_autostop so an idle
+    controller VM stops itself (reference constant
+    sky/skylet/constants.py:284, applied at sky/jobs/core.py:150-151)
+    and the next launch restarts it transparently, state intact."""
+
+    def test_idle_controller_stops_then_restarts(self, monkeypatch,
+                                                 cleanup_clusters):
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import provision
+        from skypilot_tpu.jobs import core as jobs_core
+
+        j1 = jobs.launch(_local_task('echo one', name='as-one'),
+                         detach=True)
+        assert jobs.core.wait(j1, timeout=180) == \
+            jobs_state.ManagedJobStatus.SUCCEEDED
+        ctrl_name = jobs_core._controller_cluster_name()
+        rec = state.get_cluster_from_name(ctrl_name)
+        # `status` surface: the default controller autostop is
+        # recorded on the cluster row.
+        assert rec['autostop'] == 10
+        handle = rec['handle']
+
+        # Trigger the stop deterministically: idle-0 autostop, then
+        # the controller's OWN skylet runs the stop command within a
+        # tick (no client involvement from here on).
+        core_lib.autostop(ctrl_name, 0)
+        deadline = time.time() + 60
+        statuses = {}
+        while time.time() < deadline:
+            statuses = provision.query_instances(
+                handle.provider, handle.region,
+                handle.cluster_name_on_cloud)
+            if statuses and set(statuses.values()) == {'stopped'}:
+                break
+            time.sleep(2)
+        assert set(statuses.values()) == {'stopped'}, statuses
+
+        # Next managed-job launch must restart the stopped controller
+        # transparently (tpu_backend restart path) with all
+        # controller-side state intact on its disk.
+        monkeypatch.setenv('SKYTPU_CONTROLLER_IDLE_MINUTES', '10')
+        j2 = jobs.launch(_local_task('echo two', name='as-two'),
+                         detach=True)
+        assert jobs.core.wait(j2, timeout=180) == \
+            jobs_state.ManagedJobStatus.SUCCEEDED
+        ids = {r['job_id'] for r in jobs.core.queue()}
+        assert {j1, j2} <= ids  # pre-stop history survived
+
