@@ -242,18 +242,37 @@ def _write_csv(out_path: str, rows: List[Dict]) -> None:
         writer.writerows(rows)
 
 
+def write_tpu_catalog(out_path: str = None,
+                      generations: Dict[str, Dict] = None) -> str:
+    """Write ONLY tpu_catalog.csv (self-heal must not clobber the
+    OTHER catalog: a live-fetched file would silently revert to seed
+    prices)."""
+    data_dir = os.path.join(os.path.dirname(__file__), 'data')
+    if out_path is None:
+        out_path = os.path.join(data_dir, 'tpu_catalog.csv')
+    _write_csv(out_path, generate_rows(generations))
+    return out_path
+
+
+def write_vm_catalog(out_path: str = None,
+                     vm_types: Dict[str, Dict] = None) -> str:
+    """Write ONLY vm_catalog.csv (see write_tpu_catalog)."""
+    data_dir = os.path.join(os.path.dirname(__file__), 'data')
+    if out_path is None:
+        out_path = os.path.join(data_dir, 'vm_catalog.csv')
+    _write_csv(out_path, generate_vm_rows(vm_types))
+    return out_path
+
+
 def main(out_path: str = None,
          generations: Dict[str, Dict] = None,
          vm_types: Dict[str, Dict] = None) -> str:
     """Write both CSVs. ``generations``/``vm_types``: optional seed-
     table overrides (the live fetcher passes merged tables here
     instead of mutating this module's globals)."""
-    data_dir = os.path.join(os.path.dirname(__file__), 'data')
-    if out_path is None:
-        out_path = os.path.join(data_dir, 'tpu_catalog.csv')
-    _write_csv(out_path, generate_rows(generations))
-    vm_path = os.path.join(os.path.dirname(out_path), 'vm_catalog.csv')
-    _write_csv(vm_path, generate_vm_rows(vm_types))
+    out_path = write_tpu_catalog(out_path, generations)
+    write_vm_catalog(os.path.join(os.path.dirname(out_path),
+                                  'vm_catalog.csv'), vm_types)
     return out_path
 
 

@@ -84,9 +84,11 @@ def is_tpu(name: str) -> bool:
 @functools.lru_cache(maxsize=1)
 def _read_catalog() -> pd.DataFrame:
     if not os.path.exists(_CATALOG_PATH):
-        # Self-heal: regenerate from the in-tree seed tables.
+        # Self-heal: regenerate ONLY this catalog from the in-tree
+        # seed tables (data_gen.main would also clobber a
+        # live-fetched vm_catalog.csv).
         from skypilot_tpu.catalog import data_gen
-        data_gen.main(_CATALOG_PATH)
+        data_gen.write_tpu_catalog(_CATALOG_PATH)
     return pd.read_csv(_CATALOG_PATH)
 
 

@@ -28,10 +28,12 @@ _PLUS_RE = re.compile(r'^(\d+)\+?$')
 @functools.lru_cache(maxsize=1)
 def _read_catalog() -> pd.DataFrame:
     if not os.path.exists(_VM_CATALOG_PATH):
-        # Self-heal: regenerate from the in-tree seed tables (same
-        # pattern as tpu_catalog._read_catalog).
+        # Self-heal: regenerate ONLY this catalog from the in-tree
+        # seed tables — data_gen.main() would also rewrite
+        # tpu_catalog.csv, silently reverting a live-fetched
+        # (fetch_gcp) TPU catalog to seed prices.
         from skypilot_tpu.catalog import data_gen
-        data_gen.main()
+        data_gen.write_vm_catalog(_VM_CATALOG_PATH)
     return pd.read_csv(_VM_CATALOG_PATH)
 
 

@@ -50,14 +50,18 @@ def _wait_zone_op(project: str, zone: str,
                 first = err[0]
                 code = first.get('code', '')
                 msg = first.get('message', str(first))
-                if code in ('ZONE_RESOURCE_POOL_EXHAUSTED',
-                            'RESOURCE_POOL_EXHAUSTED',
-                            'QUOTA_EXCEEDED') and 'quota' not in \
-                        msg.lower():
-                    raise exceptions.StockoutError(msg, reason=code)
+                # Branch on the CODE first: QUOTA_EXCEEDED is a quota
+                # error regardless of the message's wording — routing
+                # it to the stockout path would fail over zone-by-
+                # zone inside a region whose quota is exhausted
+                # everywhere (round-4 advisor finding). Stockout is
+                # reserved for the resource-pool-exhausted codes.
                 if 'QUOTA' in code or 'quota' in msg.lower():
                     raise exceptions.QuotaExceededError(msg,
                                                         reason=code)
+                if code in ('ZONE_RESOURCE_POOL_EXHAUSTED',
+                            'RESOURCE_POOL_EXHAUSTED'):
+                    raise exceptions.StockoutError(msg, reason=code)
                 raise exceptions.ApiError(msg, reason=code)
             return
         time.sleep(2)

@@ -138,6 +138,13 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
             },
             'labels': {_LABEL_CLUSTER: node_id,
                        'skytpu-slice': str(slice_index),
+                       # Gang size travels WITH the nodes so another
+                       # process discovering this set knows exactly
+                       # how many slices to probe — a heuristic walk
+                       # cannot distinguish "2 misses past the end"
+                       # from "2 adjacent preempted slices with live
+                       # ones beyond" (round-4 advisor finding).
+                       'skytpu-gang-count': str(count),
                        **(node_cfg.get('labels') or {})},
             'metadata': {
                 'ssh-keys': node_cfg.get('ssh_public_key', ''),
@@ -422,11 +429,16 @@ def _locate(region: str, name: str
         node['_name'] = name
         _placement_cache[name] = ('tpu', node['_zone'], 1)
         return 'tpu', [node]
-    # Multi-slice set created by another process: probe the first two
-    # slice names (s0 may itself be the preempted one), then walk.
+    # Multi-slice set created by another process: find ANY surviving
+    # slice as the entry point (its gang-count label then gives the
+    # exact range). The probe window is wide — up to 10 leading
+    # slices may be holes (adjacent preemptions) and a too-narrow
+    # window here would make the survivors beyond undiscoverable,
+    # leaking live billing slices. Misses cost one GET each, only on
+    # the cluster-not-found path.
     first = None
     first_idx = 0
-    for i in (0, 1):
+    for i in range(10):
         first = _find_node(region, f'{name}-s{i}')
         if first is not None:
             first_idx = i
@@ -435,15 +447,62 @@ def _locate(region: str, name: str
         zone = first['_zone']
         first['_name'] = f'{name}-s{first_idx}'
         project = gcp_client.get_project_id()
+        gang_count = 0
+        try:
+            gang_count = int((first.get('labels') or {})
+                             .get('skytpu-gang-count', 0))
+        except (TypeError, ValueError):
+            gang_count = 0
+        if gang_count > 0:
+            # The create stamped the gang size on every node: probe
+            # EXACTLY that range — immune to any pattern of holes.
+            nodes = []
+            for slice_name in _slice_names(name, gang_count):
+                if slice_name == first['_name']:
+                    nodes.append(first)
+                    continue
+                node = _get_node(project, zone, slice_name)
+                if node is None:
+                    continue
+                node['_zone'] = zone
+                node['_name'] = slice_name
+                nodes.append(node)
+            # Cache the LABELED count: the cached path then reports
+            # a partial set as dead (len(nodes) < count) instead of
+            # a healthy smaller gang.
+            _placement_cache[name] = ('tpu', zone, gang_count)
+            return 'tpu', nodes
+        # Legacy nodes without the gang-count label: heuristic walk.
+        # Probe a further window past the miss limit so adjacent
+        # holes (>= 2 preempted slices with survivors beyond) still
+        # mark the set partial instead of truncating it silently.
         nodes = [first]
         i = first_idx + 1
         misses = 0
         saw_hole = first_idx > 0
-        while misses < 2:  # tolerate one interior hole
+        extra_probes = 8
+        while True:
             slice_name = f'{name}-s{i}'
             node = _get_node(project, zone, slice_name)
             if node is None:
                 misses += 1
+                if misses >= 2:
+                    # Look past the window before concluding "end".
+                    found_beyond = None
+                    for j in range(i + 1, i + 1 + extra_probes):
+                        probe = _get_node(project, zone,
+                                          f'{name}-s{j}')
+                        if probe is not None:
+                            found_beyond = (j, probe)
+                            break
+                    if found_beyond is None:
+                        break
+                    saw_hole = True
+                    misses = 0
+                    i, node = found_beyond
+                    node['_zone'] = zone
+                    node['_name'] = f'{name}-s{i}'
+                    nodes.append(node)
             else:
                 if misses > 0:
                     saw_hole = True
@@ -617,12 +676,43 @@ def terminate_instances(region: str,
     _delete_queued_resource(project, nodes[0]['_zone'],
                             f'{cluster_name_on_cloud}-qr')
     errors = []
+    max_idx = -1
+    zone = nodes[0]['_zone']
     for node in nodes:
         name = node.get('_name', cluster_name_on_cloud)
+        # Only slice-set member names count toward the sweep base —
+        # a BARE cluster name that happens to end in '-s<digits>'
+        # must not trigger it.
+        if name.startswith(f'{cluster_name_on_cloud}-s'):
+            suffix = name.rsplit('-s', 1)
+            if len(suffix) == 2 and suffix[1].isdigit():
+                max_idx = max(max_idx, int(suffix[1]))
         try:
             _delete_node(project, node['_zone'], name)
         except exceptions.SkyTpuError as e:
             errors.append((name, e))
+    if max_idx >= 0:
+        # Don't trust discovery to have seen every slice (holes can
+        # truncate a label-less legacy walk, and the cached count can
+        # undershoot): sweep indices beyond the highest known one so
+        # no trailing live slice is left billing. The window is wide
+        # (16 consecutive misses) because a miss here is one cheap
+        # GET at teardown time while a false "end" is a TPU slice
+        # billing forever.
+        misses = 0
+        i = max_idx + 1
+        while misses < 16:
+            slice_name = f'{cluster_name_on_cloud}-s{i}'
+            node = _get_node(project, zone, slice_name)
+            if node is None:
+                misses += 1
+            else:
+                misses = 0
+                try:
+                    _delete_node(project, zone, slice_name)
+                except exceptions.SkyTpuError as e:
+                    errors.append((slice_name, e))
+            i += 1
     if errors:
         raise exceptions.ApiError(
             f'Failed to delete slice(s) {errors}')
@@ -679,13 +769,25 @@ def _open_ports_locked(cluster_name_on_cloud: str,
         url = (f'{gcp_client.COMPUTE_API}/projects/{project}/global/'
                f'firewalls/{rule_name}')
         want_ports = {str(p) for p in ports}
-        for _ in range(5):
-            existing = gcp_client.request('GET', url)
+
+        def rule_ports():
+            rule = gcp_client.request('GET', url)
             have = set()
-            for allowed in existing.get('allowed', []):
+            for allowed in rule.get('allowed', []):
                 have.update(str(p) for p in allowed.get('ports', []))
+            return rule, have
+
+        # 6 read-check rounds around 5 PATCH attempts: every PATCH —
+        # including one on the final attempt — is followed by a
+        # verification read, so "succeeded on the last try" is never
+        # reported as failure (serve up would force-clean a service
+        # whose LB port is actually open).
+        for attempt in range(6):
+            existing, have = rule_ports()
             if want_ports <= have:
                 return
+            if attempt == 5:
+                break
             body = {
                 'allowed': [{
                     'IPProtocol': 'tcp',
@@ -700,8 +802,6 @@ def _open_ports_locked(cluster_name_on_cloud: str,
                 if patch_err.http_code == 412:  # fingerprint raced
                     continue
                 raise
-            # Verify after write: PATCH + a concurrent writer without
-            # fingerprint support must not silently drop our ports.
         raise exceptions.ApiError(
             f'Could not merge ports {sorted(want_ports)} into '
             f'firewall rule {rule_name} after 5 attempts '

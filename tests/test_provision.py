@@ -170,6 +170,7 @@ class TestGcpRunInstancesMocked:
                 nodes[node_id] = {
                     'state': 'READY',
                     'acceleratorType': body['acceleratorType'],
+                    'labels': body.get('labels') or {},
                     'networkEndpoints': [
                         {'ipAddress': '10.0.0.1',
                          'accessConfig': {'externalIp': '1.2.3.4'}},
@@ -721,6 +722,64 @@ class TestGcpMultiSlice:
                                           'ms-dead')
         assert info.num_hosts() == 4
         assert info.custom_metadata['num_slices'] == 2
+
+    def test_adjacent_holes_discovered_as_partial(self, fake_api,
+                                                  monkeypatch):
+        """>=2 ADJACENT lost slices with survivors beyond: the
+        gang-count label makes cold-cache discovery probe the exact
+        range, so the set reads partial (dead) — not a healthy
+        smaller gang — and terminate reclaims the survivors past the
+        hole (round-4 advisor medium finding: the 2-miss walk used to
+        truncate here and leak the trailing live slices)."""
+        _, nodes = fake_api
+        provision.run_instances(self._config(count=4))
+        del nodes['ms-dead-s1']
+        del nodes['ms-dead-s2']
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        assert provision.query_instances(
+            'gcp', 'us-east5', 'ms-dead') == {'ms-dead': 'terminated'}
+        provision.terminate_instances('gcp', 'us-east5', 'ms-dead')
+        assert nodes == {}, 'trailing live slice leaked'
+
+    def test_leading_holes_discovered_as_partial(self, fake_api,
+                                                 monkeypatch):
+        """BOTH leading slices lost (s0 AND s1): the widened entry
+        probe still finds a survivor, the label gives the range, and
+        terminate reclaims s2/s3 instead of declaring the cluster
+        gone while they bill."""
+        _, nodes = fake_api
+        provision.run_instances(self._config(count=4))
+        del nodes['ms-dead-s0']
+        del nodes['ms-dead-s1']
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        assert provision.query_instances(
+            'gcp', 'us-east5', 'ms-dead') == {'ms-dead': 'terminated'}
+        provision.terminate_instances('gcp', 'us-east5', 'ms-dead')
+        assert nodes == {}, 'surviving slices leaked'
+
+    def test_adjacent_holes_legacy_nodes_without_label(
+            self, fake_api, monkeypatch):
+        """Nodes created before the gang-count label existed: the
+        fallback walk probes PAST the 2-miss window, so adjacent
+        holes still mark the set partial and the trailing survivor
+        is discovered (and reclaimed)."""
+        _, nodes = fake_api
+        provision.run_instances(self._config(count=4))
+        for n in nodes.values():
+            n.pop('labels', None)
+        del nodes['ms-dead-s1']
+        del nodes['ms-dead-s2']
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        assert provision.query_instances(
+            'gcp', 'us-east5', 'ms-dead') == {'ms-dead': 'terminated'}
+        provision.terminate_instances('gcp', 'us-east5', 'ms-dead')
+        assert nodes == {}, 'trailing live slice leaked'
 
 
 class TestQueuedResources:
