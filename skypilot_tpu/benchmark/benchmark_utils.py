@@ -93,11 +93,20 @@ def launch_benchmark(task: Task, candidates: List[Resources],
                      timeout: float = 3600.0
                      ) -> List[BenchmarkResult]:
     """Run the task once per candidate (parallel), returning one
-    result per candidate, cheapest-$-per-step first."""
+    result per candidate, cheapest-$-per-step first. Results are
+    PERSISTED under ``benchmark_name`` (benchmark_state) so runs
+    remain comparable offline via ``xsky bench ls/show`` — the
+    reference stores exactly this (sky/benchmark/benchmark_state.py).
+    """
+    from skypilot_tpu.benchmark import benchmark_state
+    benchmark_state.add_benchmark(benchmark_name, task.name)
     results = []
     threads = []
     for i, candidate in enumerate(candidates):
-        cluster_name = f'{benchmark_name}-{i}'
+        # Reserved prefix: benchmark clusters must NEVER collide with
+        # (reuse, then purge!) a user cluster whose name happens to
+        # match the benchmark name (reference uses 'sky-bench-' too).
+        cluster_name = f'sky-bench-{benchmark_name}-{i}'
         result = BenchmarkResult(candidate=candidate,
                                  cluster_name=cluster_name)
         results.append(result)
@@ -108,6 +117,8 @@ def launch_benchmark(task: Task, candidates: List[Resources],
         t.start()
     for t in threads:
         t.join()
+    for result in results:
+        benchmark_state.add_result(benchmark_name, result)
     results.sort(key=lambda r: (r.cost_per_step is None,
                                 r.cost_per_step or 0))
     return results

@@ -66,8 +66,55 @@ def _task_from_entrypoint(entrypoint: Tuple[str, ...],
     return task
 
 
+_COMPLETION_RC = {
+    'bash': ('~/.bashrc',
+             'eval "$(_XSKY_COMPLETE=bash_source xsky)"'),
+    'zsh': ('~/.zshrc',
+            'eval "$(_XSKY_COMPLETE=zsh_source xsky)"'),
+    'fish': ('~/.config/fish/completions/xsky.fish',
+             '_XSKY_COMPLETE=fish_source xsky | source'),
+}
+
+
+def _install_completion(ctx, param, value):
+    """--install-completion [bash|zsh|fish|auto]: append click's
+    completion hook to the shell rc (reference ``sky/cli.py:347-404``
+    installs the same three shells)."""
+    del param
+    if not value or ctx.resilient_parsing:
+        return
+    shell = value
+    if shell == 'auto':
+        shell = os.path.basename(os.environ.get('SHELL', 'bash'))
+    if shell not in _COMPLETION_RC:
+        click.echo(f'Unsupported shell {shell!r}; choose from '
+                   f'{sorted(_COMPLETION_RC)}.', err=True)
+        ctx.exit(1)
+    rc_path, line = _COMPLETION_RC[shell]
+    rc_path = os.path.expanduser(rc_path)
+    os.makedirs(os.path.dirname(rc_path) or '.', exist_ok=True)
+    existing = ''
+    if os.path.exists(rc_path):
+        with open(rc_path, encoding='utf-8') as f:
+            existing = f.read()
+    if line in existing:
+        click.echo(f'{shell} completion already installed in '
+                   f'{rc_path}.')
+    else:
+        with open(rc_path, 'a', encoding='utf-8') as f:
+            f.write(f'\n# skypilot_tpu shell completion\n{line}\n')
+        click.echo(f'Installed {shell} completion in {rc_path}; '
+                   'restart your shell (or source the file) to '
+                   'activate.')
+    ctx.exit(0)
+
+
 @click.group()
 @click.version_option('0.1.0', prog_name='skypilot-tpu')
+@click.option('--install-completion', expose_value=False,
+              is_eager=True, callback=_install_completion,
+              type=click.Choice(['bash', 'zsh', 'fish', 'auto']),
+              help='Install shell tab-completion and exit.')
 def cli():
     """skypilot_tpu: TPU-native workload orchestration."""
 
@@ -542,33 +589,140 @@ def storage_delete(names, delete_all, yes):
 
 
 # ---------------------------------------------------------------------
-# Benchmark (analog of ``sky bench``, sky/cli.py:3560 — flattened to a
-# single command: launch candidates, wait, print the comparison).
+# Benchmark (analog of ``sky bench``, sky/cli.py:3560): launch runs the
+# candidates and persists results; ls/show compare past runs offline
+# from the benchmark DB (sky/benchmark/benchmark_state.py analog);
+# down/delete manage leftovers.
 # ---------------------------------------------------------------------
 
 
-@cli.command(name='bench')
+@cli.group(name='bench')
+def bench_group():
+    """Benchmark a task across TPU slice types; compare past runs."""
+
+
+@bench_group.command(name='launch')
 @click.argument('entrypoint', nargs=-1)
 @_apply(_task_options)
 @click.option('--candidates', required=True,
               help='Comma-separated accelerators, e.g. '
                    '"tpu-v5e-8,tpu-v5p-8".')
+@click.option('--benchmark', '-b', 'benchmark_name', default=None,
+              help='Name to store this run under (default: the task '
+                   'name, or "bench").')
 @click.option('--yes', '-y', is_flag=True)
-def bench_cmd(entrypoint, env, accelerator, num_nodes, use_spot,
-              workdir, name, candidates, yes):
+def bench_launch(entrypoint, env, accelerator, num_nodes, use_spot,
+                 workdir, name, candidates, benchmark_name, yes):
     """Run a task briefly on several TPU slice types and compare
-    sec/step and $/step."""
+    sec/step and $/step. Results persist for `bench ls` / `show`."""
     from skypilot_tpu.benchmark import benchmark_utils
     task = _task_from_entrypoint(entrypoint, env, accelerator,
                                  num_nodes, use_spot, workdir, name)
     base = next(iter(task.resources))
     cands = [base.copy(accelerators=c.strip())
              for c in candidates.split(',') if c.strip()]
+    if not cands:
+        raise exceptions.SkyTpuError(
+            '--candidates must name at least one accelerator '
+            '(e.g. "tpu-v5e-8,tpu-v5p-8").')
     if not yes and sys.stdin.isatty():
         click.confirm(f'Benchmark on {len(cands)} candidate(s)?',
                       default=True, abort=True)
-    results = benchmark_utils.launch_benchmark(task, cands)
+    bname = benchmark_name or task.name or 'bench'
+    results = benchmark_utils.launch_benchmark(
+        task, cands, benchmark_name=bname)
     click.echo(benchmark_utils.format_results(results))
+    click.echo(f'Saved as benchmark {bname!r} — compare later with '
+               f'`xsky bench show {bname}`.')
+
+
+@bench_group.command(name='ls')
+def bench_ls():
+    """List stored benchmarks."""
+    from skypilot_tpu.benchmark import benchmark_state
+    from skypilot_tpu.utils import ux_utils
+    rows = benchmark_state.get_benchmarks()
+    table = ux_utils.Table(['NAME', 'TASK', 'LAUNCHED', 'CANDIDATES'])
+    import datetime
+    for b in rows:
+        table.add_row([
+            b['name'], b['task_name'] or '-',
+            datetime.datetime.fromtimestamp(
+                b['launched_at']).strftime('%Y-%m-%d %H:%M'),
+            b['num_candidates'],
+        ])
+    click.echo(table.get_string())
+
+
+@bench_group.command(name='show')
+@click.argument('benchmark_name')
+@click.option('--steps', '-k', 'k_steps', type=int, default=1000,
+              help='Project cost to this many steps.')
+def bench_show(benchmark_name, k_steps):
+    """Show a stored benchmark's per-candidate results."""
+    from skypilot_tpu.benchmark import benchmark_state
+    from skypilot_tpu.utils import ux_utils
+    if benchmark_state.get_benchmark(benchmark_name) is None:
+        raise exceptions.SkyTpuError(
+            f'No benchmark named {benchmark_name!r}; see '
+            '`xsky bench ls`.')
+    table = ux_utils.Table(['CANDIDATE', 'CLUSTER', 'STATUS', 'STEPS',
+                            'SEC/STEP', '$/HR', '$/STEP',
+                            f'$/{k_steps}STEPS'])
+    for r in benchmark_state.get_results(benchmark_name):
+        cost_k = (r['cost_per_step'] * k_steps
+                  if r['cost_per_step'] else None)
+        table.add_row([
+            r['candidate'], r['cluster'],
+            r['status'] or (r['error'] or '-')[:30],
+            r['num_steps'] if r['num_steps'] is not None else '-',
+            f"{r['avg_step_seconds']:.3f}"
+            if r['avg_step_seconds'] else '-',
+            f"{r['price_per_hour']:.2f}"
+            if r['price_per_hour'] else '-',
+            f"{r['cost_per_step']:.6f}"
+            if r['cost_per_step'] else '-',
+            f'{cost_k:.2f}' if cost_k else '-',
+        ])
+    click.echo(table.get_string())
+
+
+@bench_group.command(name='down')
+@click.argument('benchmark_name')
+def bench_down(benchmark_name):
+    """Tear down any still-existing clusters of a benchmark (normally
+    they are removed when the run finishes; this reclaims leftovers
+    from an interrupted run)."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.benchmark import benchmark_state
+    if benchmark_state.get_benchmark(benchmark_name) is None:
+        raise exceptions.SkyTpuError(
+            f'No benchmark named {benchmark_name!r}; see '
+            '`xsky bench ls`.')
+    downed = 0
+    for r in benchmark_state.get_results(benchmark_name):
+        if state_lib.get_cluster_from_name(r['cluster']) is None:
+            continue
+        try:
+            core_lib.down(r['cluster'], purge=True)
+            downed += 1
+        except exceptions.SkyTpuError as e:
+            click.echo(f"down {r['cluster']}: {e}", err=True)
+    click.echo(f'Tore down {downed} cluster(s).')
+
+
+@bench_group.command(name='delete')
+@click.argument('benchmark_name')
+def bench_delete(benchmark_name):
+    """Delete a stored benchmark's records (keeps clusters; use
+    `bench down` first if any are still up)."""
+    from skypilot_tpu.benchmark import benchmark_state
+    if benchmark_state.get_benchmark(benchmark_name) is None:
+        raise exceptions.SkyTpuError(
+            f'No benchmark named {benchmark_name!r}.')
+    benchmark_state.delete_benchmark(benchmark_name)
+    click.echo(f'Deleted benchmark {benchmark_name!r}.')
 
 
 def main():

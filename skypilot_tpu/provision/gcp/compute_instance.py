@@ -177,7 +177,8 @@ def find_instance(region: str, name: str,
     ``_find_node``: an outage must not read as 'deleted')."""
     project = gcp_client.get_project_id()
     if zones is None:
-        zones = [f'{region}-{s}' for s in ('a', 'b', 'c', 'd', 'f')]
+        from skypilot_tpu.provision.gcp import zones as zones_lib
+        zones = zones_lib.candidate_zones(region)
     for zone in zones:
         try:
             inst = get_instance(project, zone, name)

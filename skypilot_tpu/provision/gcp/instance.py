@@ -371,8 +371,8 @@ def _find_node(region: str,
     --refresh``) cannot mistake an outage for a deleted cluster and
     drop a live, billing slice from the state DB."""
     project = gcp_client.get_project_id()
-    for suffix in ('a', 'b', 'c', 'd', 'f'):
-        zone = f'{region}-{suffix}'
+    from skypilot_tpu.provision.gcp import zones as zones_lib
+    for zone in zones_lib.candidate_zones(region):
         try:
             node = _get_node(project, zone, cluster_name_on_cloud)
         except exceptions.ApiError as e:
@@ -659,10 +659,10 @@ def terminate_instances(region: str,
         if config_lib.get_nested(('gcp', 'use_queued_resources'),
                                  False):
             project = gcp_client.get_project_id()
-            for suffix in ('a', 'b', 'c', 'd', 'f'):
+            from skypilot_tpu.provision.gcp import zones as zones_lib
+            for zone in zones_lib.candidate_zones(region):
                 _delete_queued_resource(
-                    project, f'{region}-{suffix}',
-                    f'{cluster_name_on_cloud}-qr')
+                    project, zone, f'{cluster_name_on_cloud}-qr')
         return
     kind, nodes = located
     _placement_cache.pop(cluster_name_on_cloud, None)

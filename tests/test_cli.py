@@ -109,9 +109,45 @@ class TestCliGroups:
         result = runner.invoke(cli.cli, ['storage', 'ls'])
         assert result.exit_code == 0, result.output
 
-    def test_bench_requires_candidates(self, runner):
-        result = runner.invoke(cli.cli, ['bench', 'echo hi'])
+    def test_bench_launch_requires_candidates(self, runner):
+        result = runner.invoke(cli.cli, ['bench', 'launch', 'echo hi'])
         assert result.exit_code != 0
+
+    def test_bench_history_roundtrip(self, runner):
+        """bench launch persists; ls/show compare offline; delete
+        removes (reference sky bench ls/show/delete,
+        sky/benchmark/benchmark_state.py)."""
+        from skypilot_tpu.benchmark import benchmark_state
+        from skypilot_tpu.benchmark import benchmark_utils
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        for bname in ('bh-one', 'bh-two'):
+            task = Task(name=bname, run='echo bench-ok')
+            benchmark_utils.launch_benchmark(
+                task, [Resources(cloud='local')],
+                benchmark_name=bname, timeout=120)
+
+        ls = runner.invoke(cli.cli, ['bench', 'ls'])
+        assert ls.exit_code == 0, ls.output
+        assert 'bh-one' in ls.output and 'bh-two' in ls.output
+
+        # Offline comparison: both runs readable from the DB with
+        # per-candidate rows (clusters are already torn down).
+        for bname in ('bh-one', 'bh-two'):
+            (row,) = benchmark_state.get_results(bname)
+            assert row['candidate'] == 'cpu-vm'
+            assert row['status'] == 'SUCCEEDED'
+            show = runner.invoke(cli.cli, ['bench', 'show', bname])
+            assert show.exit_code == 0, show.output
+            assert 'SUCCEEDED' in show.output
+
+        d = runner.invoke(cli.cli, ['bench', 'delete', 'bh-one'])
+        assert d.exit_code == 0, d.output
+        assert benchmark_state.get_benchmark('bh-one') is None
+        assert benchmark_state.get_benchmark('bh-two') is not None
+        ls = runner.invoke(cli.cli, ['bench', 'ls'])
+        assert 'bh-one' not in ls.output
 
     def test_jobs_launch_e2e_local(self, runner):
         """xsky jobs launch runs a managed job to completion on the

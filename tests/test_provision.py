@@ -938,3 +938,99 @@ class TestQueuedResources:
         assert len(prov.failover_history) >= 1
         assert all(isinstance(e, exceptions.StockoutError)
                    for e in prov.failover_history)
+
+
+class TestCatalogDrivenZones:
+    """Zone sweeps come from the catalog's AvailabilityZone rows, not
+    letter-suffix guesses (round-4 verdict weak #6): a region whose
+    zone has a non-standard name still round-trips
+    create -> cold-cache find -> terminate."""
+
+    REGION = 'weird-region1'
+    ZONE = 'weird-region1-z9'  # not reachable by {region}-{a..f}
+
+    @pytest.fixture
+    def zone_aware_fake(self, monkeypatch):
+        import pandas as pd
+
+        from skypilot_tpu.catalog import tpu_catalog
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+
+        base = tpu_catalog._read_catalog()
+        extra = pd.DataFrame([{
+            'AcceleratorName': 'tpu-v5e-8', 'Generation': 'v5e',
+            'Chips': 4, 'Cores': 8, 'NumHosts': 1,
+            'Topology': '2x2', 'MemoryGBPerChip': 16,
+            'vCPUsPerHost': 112, 'HostMemoryGB': 192,
+            'Region': self.REGION, 'AvailabilityZone': self.ZONE,
+            'Price': 1.0, 'SpotPrice': 0.3,
+        }])
+        monkeypatch.setattr(
+            tpu_catalog, '_read_catalog',
+            lambda: pd.concat([base, extra], ignore_index=True))
+
+        nodes = {}  # (zone, node_id) -> node
+
+        def fake_request(method, url, body=None, timeout=60.0):
+            if method == 'POST' and '/nodes?nodeId=' in url:
+                node_id = url.split('nodeId=')[1]
+                zone = url.split('/locations/')[1].split('/')[0]
+                nodes[(zone, node_id)] = {
+                    'state': 'READY',
+                    'acceleratorType': body['acceleratorType'],
+                    'labels': body.get('labels') or {},
+                    'networkEndpoints': [
+                        {'ipAddress': '10.0.0.1',
+                         'accessConfig': {'externalIp': '1.2.3.4'}},
+                    ],
+                }
+                return {'name': f'projects/p/operations/op-{node_id}'}
+            if method == 'GET' and '/operations/' in url:
+                return {'done': True}
+            if method == 'GET' and '/nodes/' in url:
+                zone = url.split('/locations/')[1].split('/')[0]
+                node_id = url.rsplit('/', 1)[1]
+                if (zone, node_id) in nodes:
+                    return nodes[(zone, node_id)]
+                raise exceptions.ApiError('not found', http_code=404)
+            if method == 'DELETE' and '/nodes/' in url:
+                zone = url.split('/locations/')[1].split('/')[0]
+                node_id = url.rsplit('/', 1)[1]
+                nodes.pop((zone, node_id), None)
+                return {'name': 'projects/p/operations/op-del',
+                        'done': True}
+            raise exceptions.ApiError('not found', http_code=404)
+
+        monkeypatch.setattr(gcp_client, 'request', fake_request)
+        monkeypatch.setattr(gcp_client, 'get_project_id', lambda: 'p')
+        monkeypatch.setattr(gcp_client, 'wait_operation',
+                            lambda url, **kw: {'done': True})
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        return nodes
+
+    def test_nonstandard_zone_roundtrip(self, zone_aware_fake,
+                                        monkeypatch):
+        nodes = zone_aware_fake
+        config = ProvisionConfig(
+            provider='gcp', region=self.REGION, zone=self.ZONE,
+            cluster_name='wz', cluster_name_on_cloud='wz-dead',
+            node_config={
+                'accelerator_type': 'v5e-8',
+                'runtime_version': 'v2-alpha-tpuv5-lite',
+                'num_hosts': 1,
+            }, count=1)
+        provision.run_instances(config)
+        assert (self.ZONE, 'wz-dead') in nodes
+
+        # Cold cache (another process): the catalog-driven sweep must
+        # find the cluster in its oddly-named zone.
+        from skypilot_tpu.provision.gcp import \
+            instance as gcp_instance
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        assert provision.query_instances(
+            'gcp', self.REGION, 'wz-dead') == {'wz-dead': 'running'}
+
+        monkeypatch.setattr(gcp_instance, '_placement_cache', {})
+        provision.terminate_instances('gcp', self.REGION, 'wz-dead')
+        assert nodes == {}
