@@ -57,7 +57,9 @@ def _run_one(task: Task, candidate: Resources, cluster_name: str,
         result.price_per_hour = candidate.get_hourly_price() \
             if candidate.accelerator else None
         _collect_callback_log(handle, result)
-    except (exceptions.SkyTpuError, TimeoutError) as e:
+    except Exception as e:  # noqa: BLE001 — a worker-thread escape
+        # would die with a stderr traceback while the main thread
+        # persists an all-None row that LOOKS like a silent success.
         result.error = str(e)
     finally:
         try:
@@ -110,9 +112,16 @@ def launch_benchmark(task: Task, candidates: List[Resources],
         result = BenchmarkResult(candidate=candidate,
                                  cluster_name=cluster_name)
         results.append(result)
+    # Persist the candidate -> cluster mapping BEFORE any run starts:
+    # `xsky bench down <name>` reclaims an INTERRUPTED run's clusters
+    # from these rows, which must not depend on the run finishing.
+    for result in results:
+        benchmark_state.add_result(benchmark_name, result)
+    for result in results:
         t = threading.Thread(target=_run_one,
-                             args=(task, candidate, cluster_name,
-                                   result, timeout))
+                             args=(task, result.candidate,
+                                   result.cluster_name, result,
+                                   timeout))
         threads.append(t)
         t.start()
     for t in threads:
@@ -178,20 +187,48 @@ def measure_time_to_first_step(task: Task,
                 pass
 
 
-def format_results(results: List[BenchmarkResult]) -> str:
+def format_result_rows(rows: List[Dict], k_steps: int = 0,
+                       show_cluster: bool = False) -> str:
+    """One table builder for live results AND stored history
+    (``bench show``) — dict rows shaped like benchmark_state's.
+    ``k_steps`` > 0 appends a cost-to-K-steps projection column."""
     from skypilot_tpu.utils import ux_utils
-    table = ux_utils.Table(['CANDIDATE', 'STATUS', 'STEPS',
-                            'SEC/STEP', '$/HR', '$/STEP'])
-    for r in results:
-        accel = r.candidate.accelerator or 'cpu-vm'
-        table.add_row([
-            accel,
-            (r.job_status.value if r.job_status else
-             (r.error or '-')[:30]),
-            r.num_steps if r.num_steps is not None else '-',
-            f'{r.avg_step_seconds:.3f}'
-            if r.avg_step_seconds else '-',
-            f'{r.price_per_hour:.2f}' if r.price_per_hour else '-',
-            f'{r.cost_per_step:.6f}' if r.cost_per_step else '-',
-        ])
+    header = ['CANDIDATE']
+    if show_cluster:
+        header.append('CLUSTER')
+    header += ['STATUS', 'STEPS', 'SEC/STEP', '$/HR', '$/STEP']
+    if k_steps:
+        header.append(f'$/{k_steps}STEPS')
+    table = ux_utils.Table(header)
+    for r in rows:
+        row = [r['candidate']]
+        if show_cluster:
+            row.append(r['cluster'])
+        row += [
+            r['status'] or (r['error'] or '-')[:30],
+            r['num_steps'] if r['num_steps'] is not None else '-',
+            f"{r['avg_step_seconds']:.3f}"
+            if r['avg_step_seconds'] else '-',
+            f"{r['price_per_hour']:.2f}"
+            if r['price_per_hour'] else '-',
+            f"{r['cost_per_step']:.6f}"
+            if r['cost_per_step'] else '-',
+        ]
+        if k_steps:
+            row.append(f"{r['cost_per_step'] * k_steps:.2f}"
+                       if r['cost_per_step'] else '-')
+        table.add_row(row)
     return table.get_string()
+
+
+def format_results(results: List[BenchmarkResult]) -> str:
+    return format_result_rows([{
+        'candidate': r.candidate.accelerator or 'cpu-vm',
+        'cluster': r.cluster_name,
+        'status': r.job_status.value if r.job_status else None,
+        'error': r.error,
+        'num_steps': r.num_steps,
+        'avg_step_seconds': r.avg_step_seconds,
+        'price_per_hour': r.price_per_hour,
+        'cost_per_step': r.cost_per_step,
+    } for r in results])

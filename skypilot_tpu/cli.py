@@ -628,7 +628,20 @@ def bench_launch(entrypoint, env, accelerator, num_nodes, use_spot,
     if not yes and sys.stdin.isatty():
         click.confirm(f'Benchmark on {len(cands)} candidate(s)?',
                       default=True, abort=True)
-    bname = benchmark_name or task.name or 'bench'
+    if benchmark_name is not None:
+        # Explicit -b replaces that name's history (documented); it
+        # also lands in cluster names, so validate it the same way.
+        from skypilot_tpu.utils import common_utils
+        common_utils.check_cluster_name_is_valid(
+            f'sky-bench-{benchmark_name}-0')
+        bname = benchmark_name
+    else:
+        # Default: unique per run, so re-benchmarking the same task
+        # ADDS a comparable entry instead of silently erasing the
+        # previous one (the whole point of persisted history).
+        import time as time_lib
+        bname = (f'{task.name or "bench"}-'
+                 f'{time_lib.strftime("%m%d-%H%M%S")}')
     results = benchmark_utils.launch_benchmark(
         task, cands, benchmark_name=bname)
     click.echo(benchmark_utils.format_results(results))
@@ -661,30 +674,14 @@ def bench_ls():
 def bench_show(benchmark_name, k_steps):
     """Show a stored benchmark's per-candidate results."""
     from skypilot_tpu.benchmark import benchmark_state
-    from skypilot_tpu.utils import ux_utils
+    from skypilot_tpu.benchmark import benchmark_utils
     if benchmark_state.get_benchmark(benchmark_name) is None:
         raise exceptions.SkyTpuError(
             f'No benchmark named {benchmark_name!r}; see '
             '`xsky bench ls`.')
-    table = ux_utils.Table(['CANDIDATE', 'CLUSTER', 'STATUS', 'STEPS',
-                            'SEC/STEP', '$/HR', '$/STEP',
-                            f'$/{k_steps}STEPS'])
-    for r in benchmark_state.get_results(benchmark_name):
-        cost_k = (r['cost_per_step'] * k_steps
-                  if r['cost_per_step'] else None)
-        table.add_row([
-            r['candidate'], r['cluster'],
-            r['status'] or (r['error'] or '-')[:30],
-            r['num_steps'] if r['num_steps'] is not None else '-',
-            f"{r['avg_step_seconds']:.3f}"
-            if r['avg_step_seconds'] else '-',
-            f"{r['price_per_hour']:.2f}"
-            if r['price_per_hour'] else '-',
-            f"{r['cost_per_step']:.6f}"
-            if r['cost_per_step'] else '-',
-            f'{cost_k:.2f}' if cost_k else '-',
-        ])
-    click.echo(table.get_string())
+    click.echo(benchmark_utils.format_result_rows(
+        benchmark_state.get_results(benchmark_name),
+        k_steps=k_steps, show_cluster=True))
 
 
 @bench_group.command(name='down')
