@@ -81,9 +81,22 @@ def serve_main() -> None:
                    donate_argnums=(2,))
     # Decode runs as ONE device-side scan dispatch — a per-token
     # Python loop pays a host round-trip per token, which through the
-    # serving tunnel costs 10x the actual weight-read time.
-    scan_fn = jax.jit(decode.decode_tokens_scan,
-                      static_argnums=(3, 4), donate_argnums=(2,))
+    # serving tunnel costs 10x the actual weight-read time. Windowed
+    # (BENCH_WINDOWED=1, default): length-aware cache reads — each
+    # segment compiles with a static window over the valid prefix
+    # instead of streaming all max_seq rows per token.
+    windowed = os.environ.get('BENCH_WINDOWED', '1') == '1'
+    window_block = int(os.environ.get('BENCH_WINDOW_BLOCK', '256'))
+
+    def scan_fn(params_, nxt_, cache_, config_, n_):
+        if windowed:
+            return decode.decode_tokens_windowed(
+                params_, nxt_, cache_, config_, n_,
+                start_pos=prompt_len, window_block=window_block)
+        return _plain_scan(params_, nxt_, cache_, config_, n_)
+
+    _plain_scan = jax.jit(decode.decode_tokens_scan,
+                          static_argnums=(3, 4), donate_argnums=(2,))
 
     # Fresh prompts per phase: the serving tunnel caches executions
     # across processes keyed on (executable, inputs) — see the note
@@ -141,6 +154,7 @@ def serve_main() -> None:
             'platform': jax.devices()[0].platform,
             'weights': 'int8' if quantized else 'bf16',
             'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'windowed': windowed,
             'batch': batch,
             'prompt_len': prompt_len,
             'generated': gen,
@@ -373,6 +387,14 @@ def main() -> None:
             except Exception as e:  # pylint: disable=broad-except
                 result['detail']['serve_8b'] = \
                     {'error': repr(e)[:200]}
+    if os.environ.get('BENCH_QLORA_8B', '1') == '1':
+        # The ACTUAL north star (BASELINE.json): Llama-3.1-8B
+        # finetune tokens/s/chip — int8-frozen-base LoRA is how 8B
+        # training fits a 16 GB v5e (bf16 base alone would not).
+        try:
+            result['detail']['qlora_8b'] = _qlora_probe()
+        except Exception as e:  # pylint: disable=broad-except
+            result['detail']['qlora_8b'] = {'error': repr(e)[:200]}
     if os.environ.get('BENCH_INLINE_LAUNCH', '1') == '1':
         # Launch time-to-first-step on the local fake (the second
         # half of BASELINE.json's north star) rides along too.
@@ -381,6 +403,74 @@ def main() -> None:
         except Exception as e:  # pylint: disable=broad-except
             result['detail']['launch'] = {'error': repr(e)[:200]}
     print(json.dumps(result))
+
+
+def _qlora_probe(model_name: str = 'llama3.1-8b', seq: int = 2048,
+                 batch: int = 4, steps: int = 5) -> dict:
+    """8B finetune on ONE v5e chip: int8 frozen base (~8 GB) + bf16
+    LoRA adapters/optimizer (parallel.init_qlora_state). Reference
+    anchor: llm/llama-3_1-finetuning/lora.yaml (the flagship recipe)
+    + BASELINE.json's north-star metric. The timed steps reuse one
+    FIXED batch so the recorded losses demonstrably decrease."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import (MeshConfig, build_train_step,
+                                       init_qlora_state, make_mesh)
+
+    seq = int(os.environ.get('BENCH_QLORA_SEQ', seq))
+    batch = int(os.environ.get('BENCH_QLORA_BATCH', batch))
+    lora_rank = int(os.environ.get('BENCH_QLORA_RANK', '16'))
+    config = llama.get_config(model_name, max_seq_len=seq,
+                              remat_saves='attn')
+    n_devices = len(jax.devices())
+    mesh = make_mesh(MeshConfig(fsdp=n_devices))
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(1e-3, b1=0.9, b2=0.95, eps=1e-8,
+                    mu_dtype=jnp.float32))
+    state, shardings = init_qlora_state(
+        config, mesh, jax.random.PRNGKey(0), lora_rank=lora_rank,
+        optimizer=optimizer)
+    step = build_train_step(config, mesh, shardings,
+                            optimizer=optimizer)
+    seed = int.from_bytes(os.urandom(4), 'little')
+    tokens = jax.random.randint(jax.random.PRNGKey(seed),
+                                (batch, seq + 1), 0,
+                                config.vocab_size, dtype=jnp.int32)
+    batch_dict = {'tokens': tokens}
+    for _ in range(2):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics['loss'])
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+        losses.append(float(metrics['loss']))
+    jax.block_until_ready(metrics['loss'])
+    dt = time.perf_counter() - t0
+    tok_s_chip = steps * batch * seq / dt / n_devices
+    flops_per_token = 4 * config.num_params()
+    return {
+        'mode': 'qlora',
+        'model': model_name,
+        'base': 'int8',
+        'lora_rank': lora_rank,
+        'seq': seq,
+        'batch': batch,
+        'step_time_s': round(dt / steps, 4),
+        'tokens_per_sec_per_chip': round(tok_s_chip, 2),
+        'achieved_tflops_per_chip':
+            round(flops_per_token * tok_s_chip / 1e12, 2),
+        # Fixed batch: these must decrease step over step.
+        'losses': [round(x, 4) for x in losses],
+        'loss_decreasing': all(b < a for a, b in
+                               zip(losses, losses[1:])),
+    }
 
 
 def _train_probe(model_name: str, seq: int, batch: int, steps: int,
@@ -517,8 +607,20 @@ def _serve_probe(model_name: Optional[str] = None,
     max_seq = 2048
     step = jax.jit(decode.forward_cached, static_argnums=(3, 4, 5),
                    donate_argnums=(2,))
-    scan_fn = jax.jit(decode.decode_tokens_scan,
-                      static_argnums=(3, 4), donate_argnums=(2,))
+    windowed = os.environ.get('BENCH_WINDOWED', '1') == '1'
+    window_block = int(os.environ.get('BENCH_WINDOW_BLOCK', '256'))
+    _plain_scan = jax.jit(decode.decode_tokens_scan,
+                          static_argnums=(3, 4), donate_argnums=(2,))
+
+    def scan_fn(params_, nxt_, cache_, config_, n_):
+        # Length-aware cache reads (see serve_main); the windows fit
+        # the valid prefix instead of the full max_seq allocation.
+        if windowed:
+            return decode.decode_tokens_windowed(
+                params_, nxt_, cache_, config_, n_,
+                start_pos=prompt_len, window_block=window_block)
+        return _plain_scan(params_, nxt_, cache_, config_, n_)
+
     seed = int.from_bytes(os.urandom(4), 'little')
 
     def prefill(s):
@@ -551,6 +653,7 @@ def _serve_probe(model_name: Optional[str] = None,
     bw_util = floor_ms / tpot_ms
     return {
         'weights': 'int8', 'kv_cache': 'int8', 'batch': batch,
+        'windowed': windowed,
         'model': model_name,
         'params': config.num_params(),
         'prompt_len': prompt_len, 'generated': gen,

@@ -323,6 +323,19 @@ def param_sharding_rules(config: LlamaConfig,
 # ---------------------------------------------------------------------
 
 
+def matmul(x: jax.Array, w) -> jax.Array:
+    """x @ w for plain or int8-quantized ({'q','s'}) weights — the
+    canonical impl (``models.quant`` re-exports it). The int8 operand
+    converts in-register (XLA fuses it into the dot); the per-output-
+    channel scale applies after the matmul (exact for that scaling).
+    Lives here so the TRAINING forward can run over an int8 frozen
+    base (QLoRA) without an import cycle (quant imports llama)."""
+    if isinstance(w, dict) and 'q' in w:
+        out = x @ w['q'].astype(x.dtype)
+        return out * w['s'].astype(out.dtype)
+    return x @ w
+
+
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float,
               offset: bool = False) -> jax.Array:
     xf = x.astype(jnp.float32)
@@ -472,9 +485,12 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
 
     h = _rms_norm(x, layer_params['attn_norm'], config.norm_eps,
                   config.norm_offset)
-    q = h @ layer_params['wq']
-    k = h @ layer_params['wk']
-    v = h @ layer_params['wv']
+    # ``matmul`` (not @): base projections may be int8-quantized
+    # dicts — frozen-base QLoRA trains bf16 adapters over an int8
+    # base that would not fit HBM in bf16 (8B on a 16 GB chip).
+    q = matmul(h, layer_params['wq'])
+    k = matmul(h, layer_params['wk'])
+    v = matmul(h, layer_params['wv'])
     if config.qkv_bias:
         q = q + layer_params['bq']
         k = k + layer_params['bk']
@@ -500,7 +516,7 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     v = checkpoint_name(v, 'qkv')
     attn = attn_impl(q, k, v, angles)
     attn = attn.reshape(b, t, nh * hd)
-    x = x + attn @ layer_params['wo']
+    x = x + matmul(attn, layer_params['wo'])
 
     h = _rms_norm(x, layer_params['mlp_norm'], config.norm_eps,
                   config.norm_offset)
@@ -513,10 +529,11 @@ def _layer(config: LlamaConfig, x: jax.Array, layer_params: Params,
     # elementwise ops here, not the two [d, ffn] matmuls. Separate
     # names so remat_saves can keep just one of them when HBM is
     # tight.
-    g_pre = checkpoint_name(h @ layer_params['w_gate'], 'mlp_gate')
-    up = checkpoint_name(h @ layer_params['w_up'], 'mlp_up')
+    g_pre = checkpoint_name(matmul(h, layer_params['w_gate']),
+                            'mlp_gate')
+    up = checkpoint_name(matmul(h, layer_params['w_up']), 'mlp_up')
     gate = mlp_act(config)(g_pre.astype(jnp.float32)).astype(h.dtype)
-    x = x + (gate * up) @ layer_params['w_down']
+    x = x + matmul(gate * up, layer_params['w_down'])
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -600,8 +617,12 @@ def forward_hidden(params: Params, tokens: jax.Array,
     angles = _rope_frequencies(config, positions)
 
     # Mixed precision: cast weights to the compute dtype at use site;
-    # gradients flow back to the (possibly fp32) master params.
-    cparams = jax.tree.map(lambda p: p.astype(config.dtype), params)
+    # gradients flow back to the (possibly fp32) master params. int8
+    # leaves (weight-only-quantized frozen base) must NOT upcast —
+    # they cross HBM as int8 and convert in-register inside matmul.
+    cparams = jax.tree.map(
+        lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
+        params)
 
     x = embed_tokens(cparams, tokens, config)  # [B, T, D] gather
     if activation_sharding is not None:
@@ -640,13 +661,18 @@ def forward_hidden(params: Params, tokens: jax.Array,
     return hidden
 
 
-def output_head(params: Params, config: LlamaConfig) -> jax.Array:
+def output_head(params: Params, config: LlamaConfig):
     """[D, V] output projection — the transposed embedding when the
     config ties them (Gemma, small Qwen; gradients flow back to the
-    embedding through the transpose)."""
+    embedding through the transpose). May be an int8 {'q','s'} pair
+    (weight-only-quantized serving / QLoRA frozen base) — consume it
+    with ``matmul`` / the fused CE, not ``@``."""
     if config.tie_embeddings:
         return params['embed'].astype(config.dtype).T
-    return params['lm_head'].astype(config.dtype)
+    head = params['lm_head']
+    if isinstance(head, dict) and 'q' in head:
+        return head
+    return head.astype(config.dtype)
 
 
 def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
@@ -657,7 +683,7 @@ def forward(params: Params, tokens: jax.Array, config: LlamaConfig,
     """tokens [B, T] int32 -> logits [B, T, vocab] (fp32)."""
     x = forward_hidden(params, tokens, config, positions, attn_impl,
                        lora, lora_scale)
-    return (x @ output_head(params, config)).astype(jnp.float32)
+    return matmul(x, output_head(params, config)).astype(jnp.float32)
 
 
 def _ce_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -668,6 +694,27 @@ def _ce_from_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
     tgt = jnp.take_along_axis(logits, targets[..., None],
                               axis=-1)[..., 0].astype(jnp.float32)
     return lse - tgt
+
+
+def _head_shape(lm_head) -> tuple:
+    if isinstance(lm_head, dict):
+        return lm_head['q'].shape
+    return lm_head.shape
+
+
+def _head_mm(h: jax.Array, lm_head) -> jax.Array:
+    """h @ W for a plain or int8 {'q','s'} head."""
+    return matmul(h, lm_head)
+
+
+def _head_mm_t(dlog: jax.Array, lm_head) -> jax.Array:
+    """dlog @ W^T. For the quantized head W = q * s with s per
+    OUTPUT channel (the V axis), dlog @ (q s)^T == (dlog * s) @ q^T —
+    scale the cotangent columns, then contract against int8 codes."""
+    if isinstance(lm_head, dict):
+        scaled = dlog * lm_head['s'].astype(dlog.dtype)
+        return scaled @ lm_head['q'].astype(dlog.dtype).T
+    return dlog @ lm_head.T
 
 
 @functools.lru_cache(maxsize=None)
@@ -684,8 +731,9 @@ def _fused_ce(train_lm_head: bool):
     in the upstream scalar, so deferring the g * (1/denom) factor to
     the backward is exact.
 
-    Args (to the returned fn): hid [n, B, C, D]; lm_head [D, V];
-    tgt/msk [n, B, C]. Returns mean NLL over unmasked positions.
+    Args (to the returned fn): hid [n, B, C, D]; lm_head [D, V] (or
+    an int8 {'q','s'} pair — FROZEN heads only: QLoRA); tgt/msk
+    [n, B, C]. Returns mean NLL over unmasked positions.
     """
 
     @jax.custom_vjp
@@ -693,7 +741,7 @@ def _fused_ce(train_lm_head: bool):
         def body(carry, xs):
             ns, ms = carry
             h, tg, mk = xs
-            nll = _ce_from_logits(h @ lm_head, tg)
+            nll = _ce_from_logits(_head_mm(h, lm_head), tg)
             return (ns + (nll * mk).sum(), ms + mk.sum()), None
 
         (ns, ms), _ = jax.lax.scan(
@@ -703,12 +751,13 @@ def _fused_ce(train_lm_head: bool):
         return ns / jnp.maximum(ms, 1.0)
 
     def fwd(hid, lm_head, tgt, msk):
-        d, v = lm_head.shape
+        d, v = _head_shape(lm_head)
 
         def body(carry, xs):
             ns, ms, dw = carry
             h, tg, mk = xs
-            logits = (h @ lm_head).astype(jnp.float32)  # [B, C, V]
+            logits = _head_mm(h, lm_head).astype(
+                jnp.float32)  # [B, C, V]
             lse = jax.nn.logsumexp(logits, axis=-1)
             tgt_logit = jnp.take_along_axis(
                 logits, tg[..., None], axis=-1)[..., 0]
@@ -718,7 +767,7 @@ def _fused_ce(train_lm_head: bool):
             dlog = jnp.exp(logits - lse[..., None])
             dlog = (dlog - jax.nn.one_hot(tg, v, dtype=jnp.float32))
             dlog = (dlog * mk[..., None]).astype(h.dtype)
-            dh = dlog @ lm_head.T
+            dh = _head_mm_t(dlog, lm_head)
             if train_lm_head:
                 dw = dw + jnp.einsum(
                     'bcd,bcv->dv', h, dlog,
@@ -733,17 +782,31 @@ def _fused_ce(train_lm_head: bool):
              dw0),
             (hid, tgt, msk))
         denom = jnp.maximum(ms, 1.0)
-        return ns / denom, (dh, dw, denom)
+        # A quantized frozen head needs a STRUCTURE-matching zero
+        # cotangent: float0 for the int8 codes (0 bytes) + a tiny
+        # zeros 's'. Dense frozen heads rebuild their zeros in bwd
+        # from shape info instead (a [D, V] zeros residual would not
+        # be free).
+        dlm_zero = None
+        if not train_lm_head and isinstance(lm_head, dict):
+            import numpy as np
+
+            from jax import dtypes as jax_dtypes
+            dlm_zero = {'q': np.zeros(lm_head['q'].shape,
+                                      dtype=jax_dtypes.float0),
+                        's': jnp.zeros_like(lm_head['s'])}
+        return ns / denom, (dh, dw, denom, dlm_zero)
 
     def bwd(res, g):
-        dh, dw, denom = res
+        dh, dw, denom, dlm_zero = res
         scale = g / denom
         dhid = dh * scale.astype(dh.dtype)
         if train_lm_head:
             dlm = (dw * scale).astype(dh.dtype)
+        elif dlm_zero is not None:
+            dlm = dlm_zero  # frozen quantized head: dead cotangent
         else:
-            # Shape carried by the 0-byte residual; the head is
-            # frozen so this cotangent is dead downstream.
+            # Frozen dense head: shape carried by the 0-byte residual.
             dlm = jnp.zeros((dh.shape[-1], dw.shape[-1]), dh.dtype)
         return dhid, dlm, None, None
 

@@ -47,14 +47,9 @@ def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
     return {'q': q, 's': s.astype(jnp.bfloat16)}
 
 
-def matmul(x: jax.Array, w) -> jax.Array:
-    """x @ w for plain or quantized ({'q','s'}) weights. The int8
-    operand converts in-register (XLA fuses it into the dot); the
-    scale is applied to the f32/bf16 product per output channel."""
-    if isinstance(w, dict) and 'q' in w:
-        out = x @ w['q'].astype(x.dtype)
-        return out * w['s'].astype(out.dtype)
-    return x @ w
+# Canonical impl lives in llama.py (the training forward also needs
+# it, and quant imports llama — re-export keeps one definition).
+matmul = llama.matmul
 
 
 def expert_einsum(subscript: str, x: jax.Array, w) -> jax.Array:

@@ -14,6 +14,7 @@ from skypilot_tpu.parallel.mesh import (
 from skypilot_tpu.parallel.train import (
     TrainState,
     build_train_step,
+    init_qlora_state,
     init_train_state,
     plan_train_state,
 )
@@ -27,6 +28,7 @@ __all__ = [
     'auto_mesh_config',
     'build_train_step',
     'distributed',
+    'init_qlora_state',
     'init_train_state',
     'lora',
     'make_mesh',

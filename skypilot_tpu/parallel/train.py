@@ -68,6 +68,37 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(('dp', 'fsdp', 'ep'), None))
 
 
+def opt_state_shardings(trainable_shape, trainable_shardings,
+                        opt_state_shape, mesh):
+    """Match opt-state leaves (Adam mu/nu mirror the trainable tree)
+    to their param's sharding by TREE PATH, not shape: wq and wo
+    share a shape but have transposed shardings, so shape matching
+    would pin wo's moments to wq's layout and reshard every step."""
+    trainable_by_path = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            trainable_shape)[0]:
+        shard = trainable_shardings
+        for path_key in path:
+            shard = shard[path_key.key]
+        trainable_by_path[tuple(str(k) for k in path)] = (
+            leaf.shape, shard)
+
+    def opt_sharding_for(path, shape_leaf):
+        opt_path = tuple(str(k) for k in path)
+        # The params-shaped subtree sits at some suffix of the opt
+        # path (e.g. opt_state[1].mu['layers']['wq'] ends with the
+        # param path ('layers', 'wq')).
+        for ppath, (pshape, shard) in trainable_by_path.items():
+            if (len(ppath) <= len(opt_path)
+                    and opt_path[-len(ppath):] == ppath
+                    and pshape == shape_leaf.shape):
+                return shard
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(
+        opt_sharding_for, opt_state_shape)
+
+
 def plan_train_state(config: llama.LlamaConfig, mesh,
                      optimizer: Optional[
                          optax.GradientTransformation] = None,
@@ -121,35 +152,11 @@ def plan_train_state(config: llama.LlamaConfig, mesh,
             mesh)
         trainable_shardings = lora_shardings
 
-    # Match opt-state leaves (Adam mu/nu mirror the trainable tree) to
-    # their param's sharding by TREE PATH, not shape: wq and wo share a
-    # shape but have transposed shardings, so shape matching would pin
-    # wo's moments to wq's layout and reshard every step.
     trainable_shape = (state_shape.lora if lora_rank is not None
                        else state_shape.params)
-    trainable_by_path = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(
-            trainable_shape)[0]:
-        shard = trainable_shardings
-        for path_key in path:
-            shard = shard[path_key.key]
-        trainable_by_path[tuple(str(k) for k in path)] = (
-            leaf.shape, shard)
-
-    def opt_sharding_for(path, shape_leaf):
-        opt_path = tuple(str(k) for k in path)
-        # The params-shaped subtree sits at some suffix of the opt
-        # path (e.g. opt_state[1].mu['layers']['wq'] ends with the
-        # param path ('layers', 'wq')).
-        for ppath, (pshape, shard) in trainable_by_path.items():
-            if (len(ppath) <= len(opt_path)
-                    and opt_path[-len(ppath):] == ppath
-                    and pshape == shape_leaf.shape):
-                return shard
-        return NamedSharding(mesh, P())
-
-    opt_shardings = jax.tree_util.tree_map_with_path(
-        opt_sharding_for, state_shape.opt_state)
+    opt_shardings = opt_state_shardings(
+        trainable_shape, trainable_shardings,
+        state_shape.opt_state, mesh)
     state_shardings = TrainState(
         step=NamedSharding(mesh, P()),
         params=param_shardings,
@@ -179,6 +186,85 @@ def init_train_state(config: llama.LlamaConfig, mesh: Mesh,
         lora_rank=lora_rank, key=key, lora_key=lora_key)
     init_fn = jax.jit(init, out_shardings=state_shardings)
     state = init_fn()
+    return state, state_shardings
+
+
+def _scale_spec(spec: P) -> P:
+    """Sharding for a quantized weight's per-output-channel scale
+    (shape = weight shape with the contraction axis collapsed to 1):
+    same spec with that size-1 axis unsharded."""
+    parts = list(spec)
+    if len(parts) >= 2:
+        parts[-2] = None
+    return P(*parts)
+
+
+def quantized_sharding_rules(config: llama.LlamaConfig,
+                             pipeline: bool = False) -> Params:
+    """``llama.param_sharding_rules`` mapped onto an int8-quantized
+    tree: {'q','s'} pairs for the big matmuls + lm_head (matching
+    ``quant.init_quantized``'s structure), originals elsewhere."""
+    from skypilot_tpu.models import quant as quant_mod
+    rules = llama.param_sharding_rules(config, pipeline=pipeline)
+    out = dict(rules)
+    layers = dict(rules['layers'])
+    for name in quant_mod._LAYER_MATMULS:  # pylint: disable=protected-access
+        if name in layers:
+            layers[name] = {'q': layers[name],
+                            's': _scale_spec(layers[name])}
+    out['layers'] = layers
+    if 'lm_head' in rules:
+        out['lm_head'] = {'q': rules['lm_head'],
+                          's': _scale_spec(rules['lm_head'])}
+    return out
+
+
+def init_qlora_state(config: llama.LlamaConfig, mesh: Mesh,
+                     key: jax.Array, lora_rank: int = 16,
+                     optimizer: Optional[
+                         optax.GradientTransformation] = None,
+                     lora_key: Optional[jax.Array] = None
+                     ) -> Tuple[TrainState, TrainState]:
+    """QLoRA train state: int8-quantized FROZEN base (streamed init —
+    the bf16 tree never fully materializes, so 8B fits a 16 GB chip)
+    + bf16 LoRA adapters and optimizer state, all mesh-sharded.
+    Matches the reference's flagship finetune recipe
+    (``llm/llama-3_1-finetuning/lora.yaml``) at 8B scale on hardware
+    where a bf16 base cannot fit; the forward runs the int8 base
+    through ``llama.matmul`` (in-register dequant on the MXU path).
+
+    Returns (state, state_shardings) — feed both to
+    ``build_train_step`` exactly like ``init_train_state``."""
+    from skypilot_tpu.models import quant as quant_mod
+    from skypilot_tpu.parallel import lora as lora_lib
+    if optimizer is None:
+        optimizer = default_optimizer()
+    use_pp = mesh.shape.get('pp', 1) > 1
+    qshard = _sharding_tree(quantized_sharding_rules(
+        config, pipeline=use_pp), mesh)
+    params = quant_mod.init_quantized(config, key)
+    params = jax.device_put(params, qshard)
+
+    lora_shardings = _sharding_tree(
+        lora_lib.lora_sharding_rules(config, pipeline=use_pp), mesh)
+
+    def _init_trainable():
+        lora_p = lora_lib.init_lora(
+            config, lora_key if lora_key is not None else key,
+            rank=lora_rank, dtype=jnp.bfloat16)
+        return lora_p, optimizer.init(lora_p)
+
+    lora_shape, opt_shape = jax.eval_shape(_init_trainable)
+    opt_shardings = opt_state_shardings(lora_shape, lora_shardings,
+                                        opt_shape, mesh)
+    lora_p, opt_state = jax.jit(
+        _init_trainable,
+        out_shardings=(lora_shardings, opt_shardings))()
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=opt_state, lora=lora_p)
+    state_shardings = TrainState(
+        step=NamedSharding(mesh, P()), params=qshard,
+        opt_state=opt_shardings, lora=lora_shardings)
     return state, state_shardings
 
 

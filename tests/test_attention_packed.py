@@ -1,0 +1,44 @@
+"""Head-packed flash-attention forward (ops/attention_packed):
+correctness vs the dense reference in interpret mode. The packed
+kernel is an EXPERIMENT for the hd-64 MXU under-fill wall — see the
+module docstring and docs/perf_notes.md for the accounting."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops.attention_packed import packed_flash_attention_fwd
+
+
+def _ref_attn(q, k, v, scale):
+    b, h, t, d = q.shape
+    hkv = k.shape[1]
+    g = h // hkv
+    kf = jnp.repeat(k, g, axis=1).astype(jnp.float32)
+    vf = jnp.repeat(v, g, axis=1).astype(jnp.float32)
+    s = jnp.einsum('bhtd,bhsd->bhts', q.astype(jnp.float32),
+                   kf) * scale
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum('bhts,bhsd->bhtd', p, vf)
+
+
+@pytest.mark.parametrize('h,hkv', [(8, 2), (4, 4)],
+                         ids=['gqa-shared-kv', 'mha-paired-kv'])
+def test_packed_fwd_matches_reference(h, hkv):
+    b, t, d = 2, 256, 64
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, h, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, t, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, t, d), jnp.float32)
+    out, lse = packed_flash_attention_fwd(
+        q, k, v, causal=True, block_q=128, block_k=128,
+        interpret=True)
+    assert out.shape == q.shape
+    assert lse.shape[:2] == (b, h)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref_attn(q, k, v,
+                                                    d ** -0.5)),
+                               atol=2e-3, rtol=2e-3)

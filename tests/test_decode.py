@@ -281,3 +281,64 @@ class TestGenerateEdgeCases:
             assert a == b
             if a == eos:
                 break
+
+
+class TestWindowedDecode:
+    """decode_tokens_windowed: length-aware (static-window) decode
+    must produce the SAME tokens and cache as the full-cache scan —
+    the windows only change which HBM rows are read."""
+
+    @pytest.mark.parametrize('kv_int8', [False, True])
+    def test_matches_full_scan(self, setup, kv_int8):
+        config, params = setup
+        max_seq = 64
+        prompt = jnp.asarray([[5, 9, 2, 7, 11], [1, 2, 3, 4, 5]],
+                             jnp.int32)
+        b, t0 = prompt.shape
+        gen = 23
+
+        def prefill():
+            cache = decode.init_cache(config, b, max_seq,
+                                      kv_int8=kv_int8)
+            logits, cache = decode.forward_cached(
+                params, prompt, cache, config, True, True)
+            return (logits[:, -1].argmax(-1).astype(jnp.int32),
+                    cache)
+
+        nxt, cache = prefill()
+        ref_toks, ref_cache = decode.decode_tokens_scan(
+            params, nxt, cache, config, gen)
+
+        nxt, cache = prefill()
+        # window_block 8 forces several segments (windows 8,16,24,32)
+        win_toks, win_cache = decode.decode_tokens_windowed(
+            params, nxt, cache, config, gen, start_pos=t0,
+            window_block=8)
+
+        np.testing.assert_array_equal(np.asarray(ref_toks),
+                                      np.asarray(win_toks))
+        valid = t0 + gen
+        # Cache values may differ by float reduction-order noise: the
+        # windowed softmax reduces over fewer (masked-identical)
+        # columns. Tokens above must still match exactly.
+        np.testing.assert_allclose(
+            np.asarray(ref_cache.k[:, :, :valid], np.float32),
+            np.asarray(win_cache.k[:, :, :valid], np.float32),
+            atol=1e-4, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(ref_cache.v[:, :, :valid], np.float32),
+            np.asarray(win_cache.v[:, :, :valid], np.float32),
+            atol=1e-4, rtol=1e-3)
+        assert int(win_cache.pos) == valid
+
+    def test_single_segment_when_window_covers_all(self, setup):
+        config, params = setup
+        prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+        nxt = jnp.asarray([2], jnp.int32)
+        cache = decode.init_cache(config, 1, 32)
+        _, cache = decode.forward_cached(params, prompt, cache,
+                                         config, True, True)
+        toks, _ = decode.decode_tokens_windowed(
+            params, nxt, cache, config, 4, start_pos=3,
+            window_block=32)
+        assert toks.shape == (1, 4)
