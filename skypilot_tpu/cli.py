@@ -318,6 +318,57 @@ def show_tpus(region, name_filter):
     click.echo(table.get_string())
 
 
+@cli.command(name='metrics')
+@click.argument('cluster', required=False)
+@click.option('--url', default=None,
+              help='Scrape an arbitrary /metrics URL instead (e.g. '
+                   'a service load balancer endpoint + /metrics).')
+@click.option('--filter', '-f', 'name_filter', default=None,
+              help='Only show metric families containing this '
+                   'substring.')
+@click.option('--raw', is_flag=True,
+              help='Emit the merged Prometheus text exposition '
+                   'instead of a table (pipe-able).')
+def metrics_cmd(cluster, url, name_filter, raw):
+    """Aggregated cluster metrics (scraped live from every host's
+    agent ``/metrics``; see docs/observability.md for the metric
+    names/labels contract). With no CLUSTER, scrapes every cluster
+    tracked in the local state DB."""
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.metrics import scrape as scrape_lib
+    if url is not None:
+        families = scrape_lib.scrape_url(url)
+        click.echo(scrape_lib.render_families(families) if raw else
+                   scrape_lib.format_families(families, name_filter))
+        return
+    if cluster is not None:
+        targets = [cluster]
+    else:
+        targets = [r['name'] for r in state_lib.get_clusters()]
+        if not targets:
+            click.echo('No clusters.')
+            return
+    if raw and len(targets) > 1:
+        # One VALID exposition: merge under a cluster label instead
+        # of concatenating (duplicate # TYPE lines / same-IP host
+        # series across clusters would break promtool).
+        merged = scrape_lib.merge_labeled(
+            [(name, scrape_lib.scrape_cluster(name))
+             for name in targets], 'cluster')
+        click.echo(scrape_lib.render_families(merged), nl=False)
+        return
+    for i, name in enumerate(targets):
+        families = scrape_lib.scrape_cluster(name)
+        if raw:
+            click.echo(scrape_lib.render_families(families), nl=False)
+            continue
+        if len(targets) > 1:
+            if i:
+                click.echo()
+            click.echo(f'== {name} ==')
+        click.echo(scrape_lib.format_families(families, name_filter))
+
+
 @cli.command(name='cost-report')
 def cost_report():
     """Estimated cost of clusters from recorded usage intervals."""

@@ -9,6 +9,8 @@ self-contained HTML page polling a JSON API.
 Routes:
   GET /            — HTML dashboard (auto-refreshes via fetch)
   GET /api/jobs    — jobs queue as JSON
+  GET /metrics     — Prometheus text exposition (jobs-by-status
+      gauges + whatever else this process recorded)
   POST /api/cancel?job=<id> — request cancellation (signal file,
       same mechanism as ``xsky jobs cancel``)
 """
@@ -91,7 +93,10 @@ async function cancelJob(id) {
 }
 refresh();
 setInterval(refresh, 5000);
-</script></body></html>
+</script>
+<p id="links"><a href="/metrics">metrics</a> — Prometheus text
+exposition of this queue (jobs by status; scrape-able)</p>
+</body></html>
 """
 
 
@@ -110,6 +115,29 @@ def _get_records():
     if handle is None:
         return jobs_state.get_jobs(), _local_cancel
     return jobs_core.queue(), jobs_core.cancel
+
+
+def _metrics_text() -> str:
+    """Jobs-by-status gauges, refreshed at scrape time, rendered with
+    everything else this process recorded (shared registry)."""
+    from skypilot_tpu import metrics as metrics_lib
+    reg = metrics_lib.registry()
+    by_status = reg.gauge('skytpu_jobs',
+                          'Managed jobs by status.', ('status',))
+    rows, _ = _get_records()
+    counts: dict = {}
+    for r in rows:
+        counts[r['status'].value] = counts.get(r['status'].value,
+                                               0) + 1
+    # Zero statuses that emptied since the last scrape (a gauge that
+    # silently stops updating reads as a stuck count).
+    for labels, child in by_status.collect():
+        status = dict(labels).get('status')
+        if status is not None and status not in counts:
+            child.set(0)
+    for status, count in counts.items():
+        by_status.labels(status=status).set(count)
+    return reg.render()
 
 
 def _jobs_json() -> bytes:
@@ -144,6 +172,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _PAGE.encode(), 'text/html; charset=utf-8')
         elif path == '/api/jobs':
             self._send(200, _jobs_json())
+        elif path == '/metrics':
+            self._send(200, _metrics_text().encode(),
+                       'text/plain; version=0.0.4; charset=utf-8')
         else:
             self._send(404, b'{"error": "not found"}')
 

@@ -1,0 +1,38 @@
+"""Cluster-wide metrics & telemetry.
+
+A dependency-free, process-local metrics registry (Counter / Gauge /
+Histogram, thread-safe, labeled) with Prometheus text exposition —
+the substrate every serving/runtime/training surface reports through:
+
+- ``runtime/agent.py`` exports proc-table and host gauges at
+  ``GET /metrics``;
+- ``serve/load_balancer.py`` records per-endpoint request counts,
+  errors, and latency histograms (and serves its own ``/metrics``);
+- ``serve/batching.py`` records queue-wait, TTFT, decode tokens/s and
+  slot occupancy;
+- ``serve/autoscalers.py`` scales on the MEASURED windowed QPS from
+  the LB registry instead of assuming the declared target;
+- ``parallel/train.py`` records step time and tokens/s;
+- ``metrics/scrape.py`` pulls every host's ``/metrics`` and merges
+  series under a ``host`` label (CLI: ``xsky metrics [CLUSTER]``).
+
+Metric names/labels contract: ``docs/observability.md``.
+"""
+from skypilot_tpu.metrics.exposition import (format_value, parse_text,
+                                             render_text)
+from skypilot_tpu.metrics.registry import (DEFAULT_BUCKETS, Counter,
+                                           Gauge, Histogram, Registry,
+                                           WindowedRate, registry)
+
+__all__ = [
+    'Counter',
+    'Gauge',
+    'Histogram',
+    'Registry',
+    'WindowedRate',
+    'DEFAULT_BUCKETS',
+    'registry',
+    'render_text',
+    'parse_text',
+    'format_value',
+]

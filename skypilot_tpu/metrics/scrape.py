@@ -1,0 +1,148 @@
+"""Driver-side metrics scraper/aggregator.
+
+Pulls ``GET /metrics`` from every host's agent (through the same
+tunnel/token plumbing every other agent call uses), parses the
+Prometheus text, and merges the per-host series into one view with a
+``host`` label — the analog of a one-shot Prometheus federation
+scrape, minus the server. ``xsky metrics [CLUSTER]`` renders it.
+"""
+import concurrent.futures
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.metrics import exposition
+
+logger = tpu_logging.init_logger(__name__)
+
+SCRAPE_TIMEOUT_SECONDS = 10.0
+
+
+def scrape_host(client, timeout: float = SCRAPE_TIMEOUT_SECONDS
+                ) -> Dict[str, exposition.Series]:
+    """Scrape one agent (an ``AgentClient``) and parse the payload."""
+    return exposition.parse_text(client.metrics(timeout=timeout))
+
+
+def scrape_url(url: str, timeout: float = SCRAPE_TIMEOUT_SECONDS
+               ) -> Dict[str, exposition.Series]:
+    """Scrape an arbitrary exporter (e.g. a load balancer's
+    ``/metrics``) by URL."""
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return exposition.parse_text(
+            resp.read().decode('utf-8', 'replace'))
+
+
+def merge_labeled(items: List[Tuple[str, Dict[str, exposition.Series]]],
+                  label: str) -> Dict[str, exposition.Series]:
+    """Merge parsed family dicts into one, prefixing every sample's
+    labels with ``<label>=<id>``. Families keep the first item's
+    kind/help (the schema is shared by construction). Used with
+    ``label='host'`` across a cluster's hosts and ``label='cluster'``
+    across clusters (``xsky metrics --raw`` with no CLUSTER — the
+    label keeps series from same-IP hosts in different clusters
+    distinguishable and the merged text valid)."""
+    merged: Dict[str, exposition.Series] = {}
+    for item_id, families in items:
+        for name, series in families.items():
+            target = merged.get(name)
+            if target is None:
+                target = exposition.Series(name, series.kind,
+                                           series.help, [])
+                merged[name] = target
+            for sample in series.samples:
+                target.samples.append(exposition.Sample(
+                    sample.name,
+                    ((label, item_id),) + sample.labels,
+                    sample.value))
+    return merged
+
+
+def merge_hosts(per_host: List[Tuple[str, Dict[str, exposition.Series]]]
+                ) -> Dict[str, exposition.Series]:
+    return merge_labeled(per_host, 'host')
+
+
+def scrape_cluster(cluster_name: str,
+                   timeout: float = SCRAPE_TIMEOUT_SECONDS
+                   ) -> Dict[str, exposition.Series]:
+    """Scrape every host of ``cluster_name`` in parallel and merge.
+
+    Unreachable hosts are skipped with a warning (a wedged host must
+    not make the whole cluster unobservable — observability degrades
+    per-host, never whole-cluster)."""
+    from skypilot_tpu import exceptions, state
+    record = state.get_cluster_from_name(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    return scrape_handle(handle, timeout=timeout)
+
+
+def scrape_handle(handle, timeout: float = SCRAPE_TIMEOUT_SECONDS
+                  ) -> Dict[str, exposition.Series]:
+    results: List[Tuple[str, Dict[str, exposition.Series]]] = []
+
+    def one(i: int):
+        host_id = handle.hosts[i].get('ip') or str(i)
+        try:
+            return host_id, scrape_host(handle.agent_client(i),
+                                        timeout=timeout)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('metrics scrape failed for host %s: %s',
+                           host_id, e)
+            return host_id, None
+
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, max(1, handle.num_hosts))) as pool:
+        for host_id, families in pool.map(one,
+                                          range(handle.num_hosts)):
+            if families is not None:
+                results.append((host_id, families))
+    return merge_hosts(results)
+
+
+def render_families(families: Dict[str, exposition.Series]) -> str:
+    """Aggregated scrape back to Prometheus text (``xsky metrics
+    --raw`` — pipe-able into promtool or a pushgateway)."""
+    lines: List[str] = []
+    for name in sorted(families):
+        series = families[name]
+        if series.help:
+            lines.append(f'# HELP {name} {series.help}')
+        if series.kind:
+            lines.append(f'# TYPE {name} {series.kind}')
+        for sample in series.samples:
+            lines.append(
+                f'{sample.name}'
+                f'{exposition.format_labels(sample.labels)} '
+                f'{exposition.format_value(sample.value)}')
+    return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def format_families(families: Dict[str, exposition.Series],
+                    name_filter: Optional[str] = None) -> str:
+    """Human-readable table of an aggregated scrape (CLI rendering).
+
+    Histograms render as count/sum (the per-bucket series stay
+    machine-side; the table is for operators eyeballing a cluster)."""
+    from skypilot_tpu.utils import ux_utils
+    table = ux_utils.Table(['METRIC', 'LABELS', 'VALUE'])
+    rows = 0
+    for name in sorted(families):
+        if name_filter and name_filter not in name:
+            continue
+        series = families[name]
+        samples = series.samples
+        if series.kind == 'histogram':
+            samples = [s for s in samples
+                       if s.name.endswith(('_sum', '_count'))]
+        for sample in samples:
+            labels = ','.join(f'{k}={v}' for k, v in sample.labels)
+            table.add_row([sample.name, labels or '-',
+                           exposition.format_value(sample.value)])
+            rows += 1
+    if rows == 0:
+        return 'No metrics.'
+    return table.get_string()

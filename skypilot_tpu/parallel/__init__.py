@@ -16,6 +16,7 @@ from skypilot_tpu.parallel.train import (
     build_train_step,
     init_qlora_state,
     init_train_state,
+    instrument_train_step,
     plan_train_state,
 )
 from skypilot_tpu.parallel import distributed
@@ -30,6 +31,7 @@ __all__ = [
     'distributed',
     'init_qlora_state',
     'init_train_state',
+    'instrument_train_step',
     'lora',
     'make_mesh',
     'pipeline',

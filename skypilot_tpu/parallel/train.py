@@ -14,7 +14,8 @@ accelerate), redesigned as pjit sharding rather than wrapper classes.
 """
 import dataclasses
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -399,3 +400,68 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
         out_shardings=(state_shardings, metrics_sharding),
         donate_argnums=(0,) if donate else (),
     )
+
+
+def instrument_train_step(step_fn: Callable,
+                          tokens_per_step: Optional[int] = None
+                          ) -> Callable:
+    """Wrap a ``train_step(state, batch)`` so every call records
+    step time and token throughput into the process metrics registry
+    (``skytpu_train_step_seconds`` / ``skytpu_train_tokens_total`` /
+    ``skytpu_train_tokens_per_sec`` — docs/observability.md).
+
+    Returned separately from ``build_train_step`` on purpose: the
+    bare jit object keeps its ``.trace``/``.lower`` surface for
+    compile-only validation, and the wrapper stays a thin host-side
+    shim the loop opts into (``recipes/finetune.py`` does).
+
+    Timing is the interval between successive calls — in a loop that
+    syncs per step (fetching the loss), that IS the step time; in a
+    free-running async loop it converges to true step time once
+    device backpressure throttles dispatch. The first call (compile)
+    records nothing.
+
+    Tokens per step default to ``batch['tokens'].shape`` minus the
+    shifted label column, matching ``llama.loss_fn``'s convention.
+    """
+    from skypilot_tpu import metrics as metrics_lib
+    reg = metrics_lib.registry()
+    step_hist = reg.histogram(
+        'skytpu_train_step_seconds',
+        'Wall time between consecutive train steps.',
+        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, 60.0, 120.0, 300.0))
+    tokens_total = reg.counter('skytpu_train_tokens_total',
+                               'Tokens trained on.')
+    steps_total = reg.counter('skytpu_train_steps_total',
+                              'Train steps executed.')
+    tok_s = reg.gauge('skytpu_train_tokens_per_sec',
+                      'Token throughput of the latest step.')
+    last_call: List[Optional[float]] = [None]
+
+    def _tokens_in(batch) -> int:
+        if tokens_per_step is not None:
+            return tokens_per_step
+        try:
+            tokens = batch['tokens']
+            return int(tokens.shape[0] * (tokens.shape[1] - 1))
+        except Exception:  # pylint: disable=broad-except
+            return 0
+
+    @functools.wraps(getattr(step_fn, '__wrapped__', step_fn))
+    def wrapper(state, batch):
+        now = time.perf_counter()
+        n_tokens = _tokens_in(batch)
+        if last_call[0] is not None:
+            dt = now - last_call[0]
+            step_hist.observe(dt)
+            if dt > 0 and n_tokens:
+                tok_s.set(n_tokens / dt)
+        last_call[0] = now
+        steps_total.inc()
+        if n_tokens:
+            tokens_total.inc(n_tokens)
+        return step_fn(state, batch)
+
+    wrapper.inner = step_fn
+    return wrapper

@@ -85,7 +85,9 @@ def main():
     from skypilot_tpu.models import llama
     from skypilot_tpu.parallel import (MeshConfig, auto_mesh_config,
                                        build_train_step,
-                                       init_train_state, make_mesh)
+                                       init_train_state,
+                                       instrument_train_step,
+                                       make_mesh)
     from skypilot_tpu.parallel.train import default_optimizer
 
     config = llama.get_config(args.model, max_seq_len=args.seq)
@@ -113,6 +115,10 @@ def main():
     step_fn = build_train_step(config, mesh, shardings,
                                optimizer=optimizer,
                                pipeline_microbatches=args.microbatches)
+    # Step-time / tokens-per-sec land in the process metrics registry
+    # (scraped cluster-wide via the host agent's /metrics).
+    step_fn = instrument_train_step(
+        step_fn, tokens_per_step=args.batch * args.seq)
 
     ckpt = None
     start_step = 0

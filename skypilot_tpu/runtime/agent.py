@@ -10,6 +10,7 @@ portable fallback and the executable spec of the protocol.
 
 Protocol (JSON over HTTP):
     GET  /health                  -> {ok, version, agent}
+    GET  /metrics                 -> Prometheus text exposition
     POST /run   {cmd, log_path, env?, cwd?}    -> {proc_id}
     GET  /status?proc_id=N[&wait=S] -> {running, returncode}
          (wait: long-poll up to S seconds for process exit)
@@ -33,16 +34,30 @@ import os
 import signal
 import subprocess
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
+
+try:
+    from skypilot_tpu import metrics as metrics_lib
+except ImportError:
+    # This file must stay runnable STANDALONE: the kubernetes
+    # bootstrap ships it alone into the pod (provision/kubernetes/
+    # instance.py runs `python3 /skytpu-boot/agent.py` before the
+    # package tree exists on the host). /metrics then renders the
+    # text exposition by hand — same gauges, no registry.
+    metrics_lib = None
 
 # '2': /status grew long-poll (wait=). The version handshake
 # (tpu_backend._ensure_runtime_version) restarts stale agents on
 # reused clusters — without the bump an old agent would ignore
 # `wait` and answer instantly, degrading the driver's long-poll loop
 # into a busy-loop.
-AGENT_VERSION = '2'
+# '3': GET /metrics (Prometheus exposition). Without the bump a
+# reused cluster keeps its old agent and every `xsky metrics` scrape
+# 404s host by host.
+AGENT_VERSION = '3'
 
 
 def served_version() -> str:
@@ -92,6 +107,14 @@ class _ProcTable:
         self._procs: Dict[int, subprocess.Popen] = {}
         self._next = 1
         self._shutdown = False
+
+    def counts(self):
+        """(started_total, running) for the /metrics gauges."""
+        with self._lock:
+            started = self._next - 1
+            running = sum(1 for p in self._procs.values()
+                          if p.poll() is None)
+        return started, running
 
     def start(self, cmd: str, log_path: str, env: Dict[str, str],
               cwd: str) -> int:
@@ -174,6 +197,113 @@ class _ProcTable:
 
 
 _procs = _ProcTable()
+# Monotonic (matches the C++ agent's steady_clock): an NTP step must
+# not make the exported uptime jump or go negative.
+_started_at = time.monotonic()
+
+
+def _read_meminfo() -> Dict[str, int]:
+    """/proc/meminfo fields in BYTES (kB there). Missing file (e.g.
+    macOS dev box) -> empty dict; the gauges are simply absent."""
+    out: Dict[str, int] = {}
+    try:
+        with open('/proc/meminfo', encoding='utf-8') as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0].endswith(':'):
+                    try:
+                        out[parts[0][:-1]] = int(parts[1]) * 1024
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+# Serializes the counter sync in metrics_text: two concurrent
+# scrapes (ThreadingHTTPServer) reading the same delta would both
+# inc it and double-count.
+_metrics_sync_lock = threading.Lock()
+
+
+def _collect_samples() -> List[Tuple[str, str, str, float]]:
+    """(name, kind, help, value) gauges sampled NOW — shared by the
+    registry and standalone renderers."""
+    started, running = _procs.counts()
+    out: List[Tuple[str, str, str, float]] = [
+        ('skytpu_agent_uptime_seconds', 'gauge',
+         'Seconds since this agent started.',
+         time.monotonic() - _started_at),
+        ('skytpu_agent_procs_running', 'gauge',
+         'Task processes currently running under this agent.',
+         float(running)),
+        ('skytpu_agent_procs_started_total', 'counter',
+         'Task processes ever started by this agent.',
+         float(started)),
+    ]
+    try:
+        load1, load5, load15 = os.getloadavg()
+        out += [('skytpu_host_load1', 'gauge',
+                 '1-minute load average.', load1),
+                ('skytpu_host_load5', 'gauge',
+                 '5-minute load average.', load5),
+                ('skytpu_host_load15', 'gauge',
+                 '15-minute load average.', load15)]
+    except OSError:
+        pass
+    cpus = os.cpu_count()
+    if cpus:
+        out.append(('skytpu_host_cpu_count', 'gauge',
+                    'Logical CPUs on this host.', float(cpus)))
+    meminfo = _read_meminfo()
+    if 'MemTotal' in meminfo:
+        out.append(('skytpu_host_memory_total_bytes', 'gauge',
+                    'Total host memory.',
+                    float(meminfo['MemTotal'])))
+    if 'MemAvailable' in meminfo:
+        out.append(('skytpu_host_memory_available_bytes', 'gauge',
+                    'Available host memory.',
+                    float(meminfo['MemAvailable'])))
+    return out
+
+
+def metrics_text() -> str:
+    """Prometheus exposition for this agent process: proc-table
+    gauges plus host health gauges. Values are sampled at scrape
+    time (a scrape is the only reader; no background sampler thread
+    to leak)."""
+    samples = _collect_samples()
+    if os.environ.get('SKYTPU_DEBUG', '0') == '1':
+        # Debug path: persist the Chrome trace on every scrape so it
+        # is retrievable (via /read) from this long-lived process,
+        # not only at interpreter exit.
+        try:
+            from skypilot_tpu.utils import timeline
+            timeline.flush()
+        except ImportError:
+            pass  # standalone bootstrap: no package, no tracer
+    if metrics_lib is None:
+        # Standalone (k8s bootstrap): hand-render the same format.
+        lines = []
+        for name, kind, help_text, value in samples:
+            lines.append(f'# HELP {name} {help_text}')
+            lines.append(f'# TYPE {name} {kind}')
+            lines.append(f'{name} {value!r}')
+        return '\n'.join(lines) + '\n'
+    reg = metrics_lib.registry()
+    with _metrics_sync_lock:
+        for name, kind, help_text, value in samples:
+            if kind == 'counter':
+                # Synced to the proc table (monotonic by
+                # construction: proc ids only count up, so the
+                # delta is never negative).
+                family = reg.counter(name, help_text)
+                delta = value - family.value
+                if delta > 0:
+                    family.inc(delta)
+            else:
+                reg.gauge(name, help_text).set(value)
+    return reg.render()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -211,6 +341,15 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed.path == '/health':
             self._json({'ok': True, 'version': served_version(),
                         'agent': 'py'})
+        elif parsed.path == '/metrics':
+            body = metrics_text().encode()
+            self.send_response(200)
+            self.send_header('Content-Type',
+                             'text/plain; version=0.0.4; '
+                             'charset=utf-8')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif parsed.path == '/status':
             proc_id = int(qs.get('proc_id', ['0'])[0])
             wait = float(qs.get('wait', ['0'])[0])

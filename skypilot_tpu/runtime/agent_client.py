@@ -89,6 +89,13 @@ class AgentClient:
     def health(self) -> Dict[str, Any]:
         return self._get('/health')
 
+    def metrics(self, timeout: Optional[float] = None) -> str:
+        """The host's Prometheus text exposition (``GET /metrics``;
+        the driver-side aggregator ``metrics/scrape.py`` merges these
+        across hosts)."""
+        return self._get('/metrics', raw=True,
+                         timeout=timeout).decode('utf-8', 'replace')
+
     def version(self) -> Optional[str]:
         """Agent protocol version, or None if unreachable."""
         try:

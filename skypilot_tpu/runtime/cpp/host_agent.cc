@@ -38,7 +38,7 @@
 namespace {
 
 // Keep in lockstep with agent.py AGENT_VERSION.
-constexpr const char* kVersion = "2";
+constexpr const char* kVersion = "3";
 
 // ---------------------------------------------------------------------
 // Minimal JSON: value = object | string | number | bool | null.
@@ -260,6 +260,18 @@ class ProcTable {
     Reap(&it->second);
     if (!it->second.exited) kill(-it->second.pid, SIGTERM);
     return true;
+  }
+
+  // started = procs ever started, running = still alive now
+  // (for the /metrics gauges; mirrors agent.py _ProcTable.counts).
+  void Counts(int* started, int* running) {
+    std::lock_guard<std::mutex> lock(mu_);
+    *started = next_id_ - 1;
+    *running = 0;
+    for (auto& kv : procs_) {
+      Reap(&kv.second);
+      if (!kv.second.exited) ++*running;
+    }
   }
 
   // Task processes run in their own sessions (setsid in Start), so
@@ -492,6 +504,66 @@ void SendJson(int fd, const std::string& json, int code = 200) {
   SendResponse(fd, code, "application/json", json);
 }
 
+const std::chrono::steady_clock::time_point g_agent_start =
+    std::chrono::steady_clock::now();
+
+void AppendMetric(std::string* out, const char* name, const char* kind,
+                  const char* help, double value) {
+  char buf[256];
+  std::snprintf(buf, sizeof(buf), "# HELP %s %s\n# TYPE %s %s\n%s %.17g\n",
+                name, help, name, kind, name, value);
+  out->append(buf);
+}
+
+// Prometheus text exposition: proc-table + host gauges, sampled at
+// scrape time. Same metric names as agent.py metrics_text (the
+// executable spec) so the driver-side aggregator merges py/cpp hosts
+// into one series set.
+std::string MetricsText() {
+  std::string out;
+  double uptime = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - g_agent_start)
+                      .count();
+  AppendMetric(&out, "skytpu_agent_uptime_seconds", "gauge",
+               "Seconds since this agent started.", uptime);
+  int started = 0, running = 0;
+  g_procs.Counts(&started, &running);
+  AppendMetric(&out, "skytpu_agent_procs_running", "gauge",
+               "Task processes currently running under this agent.", running);
+  AppendMetric(&out, "skytpu_agent_procs_started_total", "counter",
+               "Task processes ever started by this agent.", started);
+  double loads[3];
+  if (getloadavg(loads, 3) == 3) {
+    AppendMetric(&out, "skytpu_host_load1", "gauge",
+                 "1-minute load average.", loads[0]);
+    AppendMetric(&out, "skytpu_host_load5", "gauge",
+                 "5-minute load average.", loads[1]);
+    AppendMetric(&out, "skytpu_host_load15", "gauge",
+                 "15-minute load average.", loads[2]);
+  }
+  long cpus = sysconf(_SC_NPROCESSORS_ONLN);
+  if (cpus > 0) {
+    AppendMetric(&out, "skytpu_host_cpu_count", "gauge",
+                 "Logical CPUs on this host.", cpus);
+  }
+  FILE* f = fopen("/proc/meminfo", "r");
+  if (f != nullptr) {
+    char line[256];
+    while (fgets(line, sizeof(line), f) != nullptr) {
+      long kb = 0;
+      if (std::sscanf(line, "MemTotal: %ld kB", &kb) == 1) {
+        AppendMetric(&out, "skytpu_host_memory_total_bytes", "gauge",
+                     "Total host memory.", kb * 1024.0);
+      } else if (std::sscanf(line, "MemAvailable: %ld kB", &kb) == 1) {
+        AppendMetric(&out, "skytpu_host_memory_available_bytes", "gauge",
+                     "Available host memory.", kb * 1024.0);
+      }
+    }
+    fclose(f);
+  }
+  return out;
+}
+
 // ---------------------------------------------------------------------
 // Routes.
 // ---------------------------------------------------------------------
@@ -509,6 +581,9 @@ void HandleConnection(int fd) {
   if (req.method == "GET" && req.path == "/health") {
     SendJson(fd, std::string("{\"ok\": true, \"version\": \"") + kVersion +
                      "\", \"agent\": \"cpp\"}");
+  } else if (req.method == "GET" && req.path == "/metrics") {
+    SendResponse(fd, 200, "text/plain; version=0.0.4; charset=utf-8",
+                 MetricsText());
   } else if (req.method == "GET" && req.path == "/status") {
     int id = std::atoi(req.query["proc_id"].c_str());
     // wait=S: long-poll (thread-per-connection makes blocking safe).

@@ -27,6 +27,7 @@ import math
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 
@@ -88,6 +89,18 @@ class Autoscaler:
     def __init__(self, spec: SkyServiceSpec):
         self.spec = spec
         self.target_num_replicas = spec.min_replicas
+        # Measured-QPS source (the LB's windowed rate). When set, it
+        # is the PRIMARY load signal; the timestamp path remains the
+        # fallback so a controller without an instrumented LB (or
+        # older tests) keeps scaling on drained timestamps.
+        self._qps_source: Optional[Any] = None
+
+    def set_qps_source(self, qps_fn) -> None:
+        """``qps_fn() -> float``: measured requests/sec over the
+        LB's trailing window (``SkyServeLoadBalancer.measured_qps``).
+        The declared ``target_qps_per_replica`` stays what it says —
+        a per-replica target, not an assumed load."""
+        self._qps_source = qps_fn
 
     def collect_request_information(self, request_ts: List[float]
                                     ) -> None:
@@ -143,10 +156,22 @@ class RequestRateAutoscaler(Autoscaler):
         self.request_timestamps.extend(request_ts)
 
     def _current_qps(self, now: float) -> float:
+        # Prune BEFORE the measured-source branch: the controller
+        # keeps draining LB timestamps into this list every tick, so
+        # skipping the prune while a measured source is active would
+        # grow it unboundedly in the long-lived controller process.
         cutoff = now - QPS_WINDOW_SECONDS
         self.request_timestamps = [
             t for t in self.request_timestamps if t >= cutoff
         ]
+        if self._qps_source is not None:
+            try:
+                return float(self._qps_source())
+            except Exception:  # pylint: disable=broad-except
+                # A wedged LB must degrade to the fallback signal,
+                # not take the control loop down with it.
+                logger.exception('measured-QPS source failed; '
+                                 'falling back to drained timestamps')
         return len(self.request_timestamps) / QPS_WINDOW_SECONDS
 
     def evaluate_scaling(self, num_ready: int,
@@ -159,6 +184,7 @@ class RequestRateAutoscaler(Autoscaler):
         desired = max(self.spec.min_replicas,
                       min(self.spec.max_replicas, desired))
 
+        decision = None
         if desired > self.target_num_replicas:
             self._downscale_since = None
             if self._upscale_since is None:
@@ -167,7 +193,7 @@ class RequestRateAutoscaler(Autoscaler):
                     self.spec.upscale_delay_seconds:
                 self.target_num_replicas = desired
                 self._upscale_since = None
-                return AutoscalerDecision(
+                decision = AutoscalerDecision(
                     AutoscalerDecisionOperator.SCALE_UP, desired)
         elif desired < self.target_num_replicas:
             self._upscale_since = None
@@ -177,13 +203,26 @@ class RequestRateAutoscaler(Autoscaler):
                     self.spec.downscale_delay_seconds:
                 self.target_num_replicas = desired
                 self._downscale_since = None
-                return AutoscalerDecision(
+                decision = AutoscalerDecision(
                     AutoscalerDecisionOperator.SCALE_DOWN, desired)
         else:
             self._upscale_since = None
             self._downscale_since = None
-        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP,
-                                  self.target_num_replicas)
+        if decision is None:
+            decision = AutoscalerDecision(
+                AutoscalerDecisionOperator.NO_OP,
+                self.target_num_replicas)
+        # Gauges AFTER the branch: the exported target must be this
+        # tick's post-hysteresis value, not the previous tick's
+        # (docs/observability.md contract).
+        reg = metrics_lib.registry()
+        reg.gauge('skytpu_autoscaler_measured_qps',
+                  'Request rate the autoscaler is scaling on.'
+                  ).set(qps)
+        reg.gauge('skytpu_autoscaler_target_replicas',
+                  'Replica target after policy + hysteresis.'
+                  ).set(self.target_num_replicas)
+        return decision
 
 
 class _SpotMixOps:

@@ -33,11 +33,13 @@ TPU-first design:
 """
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models.quant import matmul as _mm
@@ -236,6 +238,37 @@ class _Request:
         self.max_new = max_new
         self.eos_id = eos_id
         self.out: 'queue.Queue' = queue.Queue()
+        self.submitted_at = time.time()
+
+
+def _engine_metrics():
+    """The engine's metric families (get-or-create: several engines
+    in one process share them; see docs/observability.md)."""
+    reg = metrics_lib.registry()
+    return {
+        'queue_wait': reg.histogram(
+            'skytpu_batch_queue_wait_seconds',
+            'submit() to slot admission (prefill start).'),
+        'ttft': reg.histogram(
+            'skytpu_batch_ttft_seconds',
+            'submit() to first generated token.'),
+        'tokens': reg.counter(
+            'skytpu_batch_decode_tokens_total',
+            'Generated tokens emitted to clients.'),
+        'requests': reg.counter(
+            'skytpu_batch_requests_total',
+            'Requests admitted into the decode batch.'),
+        'tok_s': reg.gauge(
+            'skytpu_batch_decode_tokens_per_sec',
+            'Decode throughput of the latest dispatch '
+            '(active rows * steps / wall time).'),
+        'occupancy': reg.gauge(
+            'skytpu_batch_slots_occupied',
+            'Decode slots currently holding a request.'),
+        'slots': reg.gauge(
+            'skytpu_batch_slots_total',
+            'Fixed decode slot count of the engine.'),
+    }
 
 
 class BatchingEngine:
@@ -306,6 +339,8 @@ class BatchingEngine:
                                 donate_argnums=(2,))
         self._insert = jax.jit(self._insert_impl,
                                donate_argnums=(0,))
+        self._metrics = _engine_metrics()
+        self._metrics['slots'].set(slots)
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -364,6 +399,9 @@ class BatchingEngine:
     # -- engine loop ----------------------------------------------------
 
     def _admit(self, req: _Request, row: int) -> None:
+        self._metrics['queue_wait'].observe(
+            time.time() - req.submitted_at)
+        self._metrics['requests'].inc()
         t0 = len(req.prompt_ids)
         bucket = 1
         while bucket < t0:
@@ -389,6 +427,9 @@ class BatchingEngine:
         self.tokens = self.tokens.at[row].set(first)
         self.slot_req[row] = req
         self.slot_left[row] = req.max_new - 1
+        # The first token is produced by the prefill itself.
+        self._metrics['ttft'].observe(time.time() - req.submitted_at)
+        self._metrics['tokens'].inc()
         req.out.put(first)
         if self.slot_left[row] <= 0 or first == req.eos_id:
             req.out.put(None)
@@ -437,6 +478,7 @@ class BatchingEngine:
                     self._admit(req, row)
             active_rows = [i for i, r in enumerate(self.slot_req)
                            if r is not None]
+            self._metrics['occupancy'].set(len(active_rows))
             if not active_rows:
                 self.wake.wait(timeout=0.5)
                 self.wake.clear()
@@ -451,18 +493,27 @@ class BatchingEngine:
             active = jnp.asarray(
                 [r is not None and self.slot_left[i] > 0
                  for i, r in enumerate(self.slot_req)], bool)
+            t_dispatch = time.perf_counter()
             toks, self.caches, self.pos = \
                 self._step_fn(self.params, self.tokens, self.caches,
                               self.pos, active,
                               self.config, n)
             self.tokens = toks[:, -1]
             host_toks = jax.device_get(toks)
+            dispatch_s = time.perf_counter() - t_dispatch
+            if dispatch_s > 0:
+                # device_get synchronizes, so this is real decode
+                # wall time for len(active_rows) * n tokens.
+                self._metrics['tok_s'].set(
+                    len(active_rows) * n / dispatch_s)
+            emitted = 0
             for i in active_rows:
                 req = self.slot_req[i]
                 emit = min(self.slot_left[i], n)
                 done = False
                 for t in host_toks[i][:emit]:
                     req.out.put(int(t))
+                    emitted += 1
                     self.slot_left[i] -= 1
                     if int(t) == req.eos_id:
                         # EOS retires the row NOW; anything the
@@ -474,3 +525,5 @@ class BatchingEngine:
                 if done or self.slot_left[i] <= 0:
                     req.out.put(None)
                     self.slot_req[i] = None
+            if emitted:
+                self._metrics['tokens'].inc(emitted)

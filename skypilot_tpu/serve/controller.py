@@ -38,6 +38,9 @@ class SkyServeController:
             lb_port, self.replica_manager.ready_endpoints,
             tls_keyfile=self.spec.tls_keyfile,
             tls_certfile=self.spec.tls_certfile)
+        # Scale on the LB's MEASURED windowed QPS; the drained
+        # timestamps below stay as the fallback signal.
+        self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
         self.version = 1
         self._stop = threading.Event()
 
@@ -93,6 +96,7 @@ class SkyServeController:
         # collapse to min_replicas.
         old_target = self.autoscaler.target_num_replicas
         self.autoscaler = make_autoscaler(self.spec)
+        self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
         self.autoscaler.target_num_replicas = max(
             min(old_target, self.spec.max_replicas
                 or old_target), self.spec.min_replicas)

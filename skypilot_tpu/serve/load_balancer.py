@@ -4,17 +4,28 @@ ThreadingHTTPServer here since this tree vendors no web framework).
 
 Policies (``sky/serve/load_balancing_policies.py``): round-robin and
 least-load (default).
+
+Observability: every proxied request is recorded in the process
+metrics registry (per-endpoint counts, errors, latency histograms —
+``docs/observability.md``) and into a trailing QPS window; the LB
+serves its own ``GET /metrics`` (reserved path, never proxied) and
+``measured_qps()`` feeds the autoscaler the MEASURED load.
 """
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional
 
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 
 logger = tpu_logging.init_logger(__name__)
+
+# Trailing window for the MEASURED QPS the autoscaler consumes.
+QPS_WINDOW_SECONDS = 60.0
 
 
 class LoadBalancingPolicy:
@@ -45,7 +56,15 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
 
 class LeastLoadPolicy(LoadBalancingPolicy):
-    """Default: route to the replica with fewest in-flight requests."""
+    """Default: route to the replica with fewest in-flight requests.
+
+    Ties break DETERMINISTICALLY on the endpoint string (min over
+    (count, endpoint)) so two LB threads observing the same state
+    pick the same replica, and tests/replays are stable. Counts for
+    endpoints that have left the ready set are dropped on the next
+    ``select`` so in-flight totals cannot leak across replica churn
+    (a recycled replica URL must start at zero, not inherit the dead
+    replica's count)."""
 
     def __init__(self):
         self._inflight: Dict[str, int] = {}
@@ -55,8 +74,14 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         if not endpoints:
             return None
         with self._lock:
+            ready = set(endpoints)
+            for stale in [e for e in self._inflight
+                          if e not in ready]:
+                del self._inflight[stale]
+            # (count, endpoint) key: least-loaded, ties broken
+            # lexicographically — one pass, no sort on the hot path.
             return min(endpoints,
-                       key=lambda e: self._inflight.get(e, 0))
+                       key=lambda e: (self._inflight.get(e, 0), e))
 
     def on_request_start(self, endpoint):
         with self._lock:
@@ -65,8 +90,16 @@ class LeastLoadPolicy(LoadBalancingPolicy):
 
     def on_request_end(self, endpoint):
         with self._lock:
-            self._inflight[endpoint] = max(
-                0, self._inflight.get(endpoint, 0) - 1)
+            count = self._inflight.get(endpoint)
+            if count is None:
+                # Endpoint was pruned (left the ready set) while this
+                # request was in flight — nothing to decrement, and
+                # recreating the key would resurrect a stale entry.
+                return
+            if count <= 1:
+                del self._inflight[endpoint]
+            else:
+                self._inflight[endpoint] = count - 1
 
 
 class SkyServeLoadBalancer:
@@ -89,6 +122,32 @@ class SkyServeLoadBalancer:
         self._ts_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Metrics: per-endpoint traffic accounting + the measured-QPS
+        # window the autoscaler scales on (docs/observability.md).
+        reg = metrics_lib.registry()
+        self._m_requests = reg.counter(
+            'skytpu_lb_requests_total',
+            'Requests proxied, by endpoint and status code.',
+            ('endpoint', 'code'))
+        self._m_errors = reg.counter(
+            'skytpu_lb_request_errors_total',
+            'Requests that failed at the replica or mid-stream.',
+            ('endpoint', 'kind'))
+        self._m_latency = reg.histogram(
+            'skytpu_lb_request_seconds',
+            'Request latency through the LB (first byte in to last '
+            'byte out).', ('endpoint',))
+        self._m_no_replica = reg.counter(
+            'skytpu_lb_no_ready_replica_total',
+            'Requests refused because no replica was ready.')
+        self._qps_window = metrics_lib.WindowedRate(QPS_WINDOW_SECONDS)
+
+    def measured_qps(self) -> float:
+        """MEASURED request rate over the trailing window — the
+        autoscaler's primary signal (the declared
+        target_qps_per_replica is only the per-replica divisor, not
+        an assumed load)."""
+        return self._qps_window.rate()
 
     def drain_request_timestamps(self) -> List[float]:
         with self._ts_lock:
@@ -112,11 +171,28 @@ class SkyServeLoadBalancer:
                            'transfer-encoding', 'upgrade',
                            'content-length', 'host'}
 
+            def _serve_metrics(self) -> None:
+                """The LB's OWN exposition — served here, never
+                proxied (a replica's /metrics stays reachable at the
+                replica endpoint directly; the LB path is reserved
+                for LB traffic accounting)."""
+                body = metrics_lib.registry().render().encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 'text/plain; version=0.0.4; '
+                                 'charset=utf-8')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _proxy(self, method: str):
+                t_start = time.time()
                 with lb._ts_lock:  # pylint: disable=protected-access
-                    lb.request_timestamps.append(time.time())
+                    lb.request_timestamps.append(t_start)
+                lb._qps_window.record(t_start)  # pylint: disable=protected-access
                 endpoint = lb.policy.select(lb.get_ready_endpoints())
                 if endpoint is None:
+                    lb._m_no_replica.inc()  # pylint: disable=protected-access
                     body = b'No ready replicas.'
                     self.send_response(503)
                     self.send_header('Content-Length',
@@ -134,11 +210,35 @@ class SkyServeLoadBalancer:
                         req.add_header(k, v)
                 lb.policy.on_request_start(endpoint)
                 self._headers_sent = False
+                self._resp_status: Optional[int] = None
                 try:
-                    with urllib.request.urlopen(req,
-                                                timeout=120) as resp:
-                        self._stream_response(resp)
+                    try:
+                        with urllib.request.urlopen(
+                                req, timeout=120) as resp:
+                            self._stream_response(resp)
+                    except urllib.error.HTTPError as he:
+                        # A replica's own 4xx/5xx is a RESPONSE, not
+                        # a proxy failure: stream it through verbatim
+                        # (it carries status/headers/body) so the
+                        # client sees the replica's real answer and
+                        # the metrics record its real code — NOT a
+                        # synthesized 502 or a replica_error count
+                        # for a healthy replica serving 404s.
+                        with he:
+                            self._stream_response(he)
+                    lb._m_requests.labels(  # pylint: disable=protected-access
+                        endpoint=endpoint,
+                        code=str(self._resp_status)).inc()
                 except (urllib.error.URLError, OSError) as e:
+                    # Attribution: URLError (incl. HTTP-layer errors
+                    # from urlopen) is the REPLICA's fault; a bare
+                    # OSError here came from OUR sockets — usually
+                    # the client hanging up — and must not climb the
+                    # replica's error series (an operator watching
+                    # per-endpoint errors would recycle a healthy
+                    # replica whenever clients are impatient).
+                    replica_fault = isinstance(e,
+                                               urllib.error.URLError)
                     if self._headers_sent:
                         # Mid-stream failure: the status line is long
                         # gone — writing a 502 now would inject a
@@ -146,6 +246,10 @@ class SkyServeLoadBalancer:
                         # Abort the connection so the client sees a
                         # truncated (invalid) stream, not garbage.
                         logger.warning('replica stream aborted: %s', e)
+                        lb._m_errors.labels(  # pylint: disable=protected-access
+                            endpoint=endpoint,
+                            kind='stream_abort' if replica_fault
+                            else 'client_abort').inc()
                         self.close_connection = True
                         try:
                             self.wfile.flush()
@@ -153,6 +257,16 @@ class SkyServeLoadBalancer:
                         except OSError:
                             pass
                         return
+                    if replica_fault:
+                        lb._m_errors.labels(  # pylint: disable=protected-access
+                            endpoint=endpoint,
+                            kind='replica_error').inc()
+                        lb._m_requests.labels(  # pylint: disable=protected-access
+                            endpoint=endpoint, code='502').inc()
+                    else:
+                        lb._m_errors.labels(  # pylint: disable=protected-access
+                            endpoint=endpoint,
+                            kind='client_abort').inc()
                     body = f'Replica error: {e}'.encode()
                     try:
                         self.send_response(502)
@@ -164,6 +278,9 @@ class SkyServeLoadBalancer:
                         pass  # client already gone
                 finally:
                     lb.policy.on_request_end(endpoint)
+                    lb._m_latency.labels(  # pylint: disable=protected-access
+                        endpoint=endpoint).observe(
+                            time.time() - t_start)
 
             def _stream_response(self, resp) -> None:
                 """Chunk-by-chunk pass-through so token streaming
@@ -172,6 +289,7 @@ class SkyServeLoadBalancer:
                 (reference LB is an async streaming proxy,
                 sky/serve/load_balancer.py:90)."""
                 self.send_response(resp.status)
+                self._resp_status = resp.status
                 self._headers_sent = True
                 upstream_length = resp.headers.get('Content-Length')
                 for k, v in resp.headers.items():
@@ -204,6 +322,13 @@ class SkyServeLoadBalancer:
                     self.wfile.flush()
 
             def do_GET(self):  # noqa: N802
+                # urlsplit, not a raw compare: '/metrics?x=1' must
+                # hit the reservation too (Prometheus scrape_configs
+                # routinely append params).
+                if urllib.parse.urlsplit(self.path).path == \
+                        '/metrics':
+                    self._serve_metrics()
+                    return
                 self._proxy('GET')
 
             def do_POST(self):  # noqa: N802

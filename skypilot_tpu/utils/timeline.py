@@ -120,6 +120,21 @@ def save(path: Optional[str] = None) -> Optional[str]:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with _lock:
         payload = {'traceEvents': list(_events)}
-    with open(path, 'w', encoding='utf-8') as f:
+    # Write-then-rename: flush() runs inside long-lived agent/LB
+    # processes while a reader may be pulling the file through the
+    # agent's /read — it must never observe a half-written JSON.
+    tmp = f'{path}.tmp.{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(payload, f)
+    os.replace(tmp, path)
     return path
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Persist the trace NOW (keeping the in-memory buffer), so
+    spans are retrievable from long-lived processes — agents, load
+    balancers — without waiting for interpreter exit. The agent's
+    ``/metrics`` handler calls this on every scrape when
+    SKYTPU_DEBUG=1; the atexit save still runs and supersedes the
+    last flush with the final event set."""
+    return save(path)

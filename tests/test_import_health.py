@@ -1,0 +1,39 @@
+"""Import-health tier-1 gate: every ``skypilot_tpu.*`` module must
+import cleanly on the CPU platform (conftest.py forces it) — a module
+that crashes at import time breaks its feature silently until some
+test happens to touch it; this catches it before any feature test
+runs, with the module named in the failure."""
+import importlib
+import pkgutil
+
+import skypilot_tpu
+
+
+def _iter_module_names():
+    for info in pkgutil.walk_packages(skypilot_tpu.__path__,
+                                      'skypilot_tpu.'):
+        yield info.name
+
+
+def test_every_module_imports():
+    failures = []
+    count = 0
+    for name in _iter_module_names():
+        count += 1
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # pylint: disable=broad-except
+            failures.append(f'{name}: {e!r}')
+    # Guard the walker itself: an empty walk (e.g. a packaging change
+    # hiding the tree) must fail loudly, not pass vacuously.
+    assert count > 50, f'only {count} modules discovered'
+    assert not failures, 'modules crashed at import:\n' + \
+        '\n'.join(failures)
+
+
+def test_top_level_lazy_attrs_resolve():
+    """The lazy SDK surface (``skypilot_tpu.Task`` etc.) must also
+    resolve — a broken lazy target passes the walk above (the
+    attribute is only materialized on access)."""
+    for attr in list(skypilot_tpu._LAZY_ATTRS):  # pylint: disable=protected-access
+        assert getattr(skypilot_tpu, attr) is not None

@@ -1,0 +1,699 @@
+"""Metrics subsystem tests: registry semantics, exposition
+round-trip, agent/LB /metrics surfaces, the driver-side scraper, and
+the measured-QPS autoscaler e2e (ISSUE 1 acceptance: a fake 2-host
+cluster is scraped and the autoscaler scales up from MEASURED load
+with no QPS hint beyond the declared per-replica target)."""
+import http.server
+import json
+import math
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import metrics as metrics_lib
+from skypilot_tpu.metrics import exposition, scrape
+from skypilot_tpu.serve import autoscalers, load_balancer
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------
+
+
+class TestRegistry:
+
+    def test_counter_monotonic(self):
+        reg = metrics_lib.Registry()
+        c = reg.counter('c_total', 'help')
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        assert c.value == 3.5
+
+    def test_get_or_create_returns_same_family(self):
+        reg = metrics_lib.Registry()
+        a = reg.counter('x_total', 'h', ('l',))
+        b = reg.counter('x_total', 'h', ('l',))
+        assert a is b
+        a.labels(l='v').inc()
+        assert b.labels(l='v').value == 1
+
+    def test_kind_and_schema_conflicts_raise(self):
+        reg = metrics_lib.Registry()
+        reg.counter('y_total', 'h')
+        with pytest.raises(ValueError):
+            reg.gauge('y_total', 'h')
+        reg.gauge('z', 'h', ('a',))
+        with pytest.raises(ValueError):
+            reg.gauge('z', 'h', ('b',))
+
+    def test_histogram_bucket_conflict_raises(self):
+        reg = metrics_lib.Registry()
+        h1 = reg.histogram('hb_seconds', 'h', buckets=(1.0, 2.0))
+        assert reg.histogram('hb_seconds', 'h',
+                             buckets=(2.0, 1.0)) is h1  # same, sorted
+        with pytest.raises(ValueError):
+            reg.histogram('hb_seconds', 'h', buckets=(60.0, 300.0))
+
+    def test_invalid_names_rejected(self):
+        reg = metrics_lib.Registry()
+        with pytest.raises(ValueError):
+            reg.counter('bad name', 'h')
+        with pytest.raises(ValueError):
+            reg.counter('1starts_with_digit', 'h')
+
+    def test_labeled_family_requires_labels(self):
+        reg = metrics_lib.Registry()
+        c = reg.counter('lbl_total', 'h', ('a',))
+        with pytest.raises(ValueError):
+            c.inc()
+        with pytest.raises(ValueError):
+            c.labels('x', 'y')
+        with pytest.raises(ValueError):
+            c.labels(wrong='x')
+
+    def test_label_cardinality_bounded(self):
+        reg = metrics_lib.Registry()
+        g = metrics_lib.Gauge('bounded', 'h', ('id',),
+                              max_label_sets=3)
+        for i in range(10):
+            g.labels(id=str(i)).set(i)
+        series = g.collect()
+        assert len(series) <= 4  # 3 real + 1 overflow
+        labels = {dict(lbls)['id'] for lbls, _ in series}
+        assert '__overflow__' in labels
+
+    def test_gauge_set_inc_dec(self):
+        reg = metrics_lib.Registry()
+        g = reg.gauge('g', 'h')
+        g.set(5)
+        g.inc(2)
+        g.dec(3)
+        assert g.value == 4
+
+    def test_histogram_bucket_edges_inclusive(self):
+        """Prometheus semantics: ``le`` is inclusive — an observation
+        exactly on a bucket edge counts in that bucket."""
+        h = metrics_lib.Histogram('h_seconds', 'h',
+                                  buckets=(1.0, 2.0))
+        h.observe(1.0)   # exactly on the first edge
+        h.observe(1.5)
+        h.observe(99.0)  # +Inf only
+        ((_, child),) = h.collect()
+        cumulative, total_sum, count = child.snapshot()
+        assert cumulative == [1, 2, 3]  # le=1, le=2, le=+Inf
+        assert count == 3
+        assert total_sum == pytest.approx(101.5)
+
+    def test_histogram_nan_ignored(self):
+        h = metrics_lib.Histogram('nan_seconds', 'h', buckets=(1.0,))
+        h.observe(float('nan'))
+        ((_, child),) = h.collect()
+        assert child.count == 0
+
+
+class TestWindowedRate:
+
+    def test_rate_over_window(self):
+        w = metrics_lib.WindowedRate(10)
+        now = 1000.0
+        for i in range(20):
+            w.record(now - i * 0.25)  # 20 events in 5s
+        assert w.rate(now) == pytest.approx(2.0)
+
+    def test_old_events_age_out(self):
+        w = metrics_lib.WindowedRate(5)
+        now = 2000.0
+        w.record(now - 60)
+        assert w.rate(now) == 0.0
+        w.record(now - 1)
+        assert w.rate(now) == pytest.approx(1 / 5)
+
+
+# ---------------------------------------------------------------------
+# Exposition round-trip
+# ---------------------------------------------------------------------
+
+
+class TestExposition:
+
+    def _roundtrip(self, reg):
+        return exposition.parse_text(exposition.render_text(reg))
+
+    def test_counter_gauge_round_trip(self):
+        reg = metrics_lib.Registry()
+        reg.counter('req_total', 'requests',
+                    ('endpoint', 'code')).labels(
+                        endpoint='http://a:1', code='200').inc(7)
+        reg.gauge('up', 'is up').set(1)
+        parsed = self._roundtrip(reg)
+        assert parsed['up'].kind == 'gauge'
+        assert parsed['up'].samples[0].value == 1
+        fam = parsed['req_total']
+        assert fam.kind == 'counter'
+        assert fam.help == 'requests'
+        (sample,) = fam.samples
+        assert dict(sample.labels) == {'endpoint': 'http://a:1',
+                                       'code': '200'}
+        assert sample.value == 7
+
+    def test_histogram_round_trip(self):
+        reg = metrics_lib.Registry()
+        h = reg.histogram('lat_seconds', 'latency', ('ep',),
+                          buckets=(0.1, 1.0))
+        h.labels(ep='e').observe(0.05)
+        h.labels(ep='e').observe(0.5)
+        h.labels(ep='e').observe(3.0)
+        parsed = self._roundtrip(reg)
+        fam = parsed['lat_seconds']
+        assert fam.kind == 'histogram'
+        by_name = {}
+        for s in fam.samples:
+            by_name.setdefault(s.name, []).append(s)
+        buckets = {dict(s.labels)['le']: s.value
+                   for s in by_name['lat_seconds_bucket']}
+        assert buckets == {'0.1': 1, '1': 2, '+Inf': 3}
+        assert by_name['lat_seconds_count'][0].value == 3
+        assert by_name['lat_seconds_sum'][0].value == \
+            pytest.approx(3.55)
+
+    def test_label_value_escaping_round_trip(self):
+        reg = metrics_lib.Registry()
+        nasty = 'a"b\\c\nd'
+        reg.gauge('esc', 'h', ('v',)).labels(v=nasty).set(1)
+        parsed = self._roundtrip(reg)
+        (sample,) = parsed['esc'].samples
+        assert dict(sample.labels)['v'] == nasty
+
+    def test_special_values(self):
+        assert exposition.format_value(math.inf) == '+Inf'
+        assert exposition._parse_value('+Inf') == math.inf
+        assert exposition._parse_value('-Inf') == -math.inf
+        assert math.isnan(exposition._parse_value('NaN'))
+        assert exposition.format_value(3.0) == '3'
+
+    def test_parser_ignores_comments_and_blank_lines(self):
+        parsed = exposition.parse_text(
+            '\n# just a comment\nfoo 1\n\n# TYPE bar gauge\nbar 2\n')
+        assert parsed['foo'].samples[0].value == 1
+        assert parsed['bar'].kind == 'gauge'
+
+
+# ---------------------------------------------------------------------
+# Agent /metrics
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(params=['py', 'cpp'])
+def py_agent(request, tmp_path):
+    """An agent of each implementation — /metrics is part of the
+    protocol, so the native agent must serve the same series."""
+    from skypilot_tpu.runtime import agent_client
+    from skypilot_tpu.runtime.agent_client import AgentClient
+    if request.param == 'cpp' and \
+            agent_client.resolve_agent_binary() is None:
+        pytest.skip('C++ agent not built')
+    port = _free_port()
+    proc = agent_client.start_local_agent(
+        port, runtime_dir=str(tmp_path),
+        use_cpp=(request.param == 'cpp'))
+    client = AgentClient('127.0.0.1', port)
+    client.wait_healthy(timeout=15)
+    yield client
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestAgentMetrics:
+
+    def test_metrics_endpoint_parses(self, py_agent):
+        families = exposition.parse_text(py_agent.metrics())
+        assert 'skytpu_agent_uptime_seconds' in families
+        assert 'skytpu_agent_procs_running' in families
+        assert families['skytpu_agent_procs_started_total'].kind == \
+            'counter'
+
+    def test_metrics_standalone_agent_file(self, tmp_path):
+        """The kubernetes bootstrap ships agent.py ALONE into the pod
+        (provision/kubernetes/instance.py runs it as a bare file
+        before the package exists on the host) — the agent must still
+        start and serve /metrics via its registry-free fallback."""
+        import os
+        import shutil
+        import subprocess
+        import sys
+        import skypilot_tpu.runtime.agent as agent_mod
+        dst = tmp_path / 'agent.py'
+        shutil.copy(agent_mod.__file__, str(dst))
+        env = {k: v for k, v in os.environ.items()
+               if k != 'PYTHONPATH'}
+        port = _free_port()
+        proc = subprocess.Popen(
+            [sys.executable, str(dst), '--port', str(port),
+             '--host', '127.0.0.1'], cwd=str(tmp_path), env=env)
+        try:
+            from skypilot_tpu.runtime.agent_client import AgentClient
+            client = AgentClient('127.0.0.1', port)
+            client.wait_healthy(timeout=15)
+            families = exposition.parse_text(client.metrics())
+            assert 'skytpu_agent_procs_running' in families
+            assert families['skytpu_agent_procs_started_total'] \
+                .kind == 'counter'
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_proc_counters_track_runs(self, py_agent, tmp_path):
+        before = exposition.parse_text(py_agent.metrics())
+        started0 = before['skytpu_agent_procs_started_total'] \
+            .samples[0].value
+        py_agent.run('sleep 30', str(tmp_path / 'l1.log'))
+        py_agent.run('true', str(tmp_path / 'l2.log'))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            fams = exposition.parse_text(py_agent.metrics())
+            started = fams['skytpu_agent_procs_started_total'] \
+                .samples[0].value
+            running = fams['skytpu_agent_procs_running'] \
+                .samples[0].value
+            if started == started0 + 2 and running == 1:
+                break
+            time.sleep(0.1)
+        assert started == started0 + 2
+        assert running == 1  # the sleep; `true` already exited
+
+
+# ---------------------------------------------------------------------
+# Load balancer metrics + measured QPS
+# ---------------------------------------------------------------------
+
+
+class _CountingReplica(http.server.BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.1'
+    hits = 0
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        type(self).hits += 1
+        body = b'ok'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def lb_with_replica():
+    class Replica(_CountingReplica):
+        hits = 0
+
+    server = http.server.ThreadingHTTPServer(('127.0.0.1', 0), Replica)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    endpoint = f'http://127.0.0.1:{server.server_address[1]}'
+    lb_port = _free_port()
+    lb = load_balancer.SkyServeLoadBalancer(lb_port,
+                                            lambda: [endpoint])
+    lb.start()
+    yield lb, lb_port, endpoint, Replica
+    lb.stop()
+    server.shutdown()
+
+
+class TestLoadBalancerMetrics:
+
+    def test_requests_latency_and_measured_qps(self, lb_with_replica):
+        lb, lb_port, endpoint, _ = lb_with_replica
+        n = 5
+        for _ in range(n):
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/x') as resp:
+                assert resp.read() == b'ok'
+        families = scrape.scrape_url(
+            f'http://127.0.0.1:{lb_port}/metrics')
+        counts = [s for s in
+                  families['skytpu_lb_requests_total'].samples
+                  if dict(s.labels) == {'endpoint': endpoint,
+                                        'code': '200'}]
+        assert counts and counts[0].value >= n
+        lat = families['skytpu_lb_request_seconds']
+        count_samples = [
+            s for s in lat.samples
+            if s.name == 'skytpu_lb_request_seconds_count' and
+            dict(s.labels).get('endpoint') == endpoint]
+        assert count_samples and count_samples[0].value >= n
+        assert lb.measured_qps() >= n / \
+            load_balancer.QPS_WINDOW_SECONDS
+
+    def test_metrics_path_not_proxied(self, lb_with_replica):
+        _, lb_port, _, replica_cls = lb_with_replica
+        hits_before = replica_cls.hits
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}/metrics') as resp:
+            assert b'# TYPE' in resp.read()
+        # Query strings must hit the reservation too (Prometheus
+        # scrape_configs append params).
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}/metrics?x=1') as resp:
+            assert b'# TYPE' in resp.read()
+        assert replica_cls.hits == hits_before
+
+    def test_replica_4xx_passes_through_with_real_code(self):
+        """A replica's own 404 is a RESPONSE: the client must see
+        404 (not a synthesized 502) and the metrics must record
+        code="404" with no replica_error count."""
+
+        class NotFoundReplica(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = b'missing'
+                self.send_response(404)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = http.server.ThreadingHTTPServer(('127.0.0.1', 0),
+                                                 NotFoundReplica)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        endpoint = f'http://127.0.0.1:{server.server_address[1]}'
+        lb_port = _free_port()
+        lb = load_balancer.SkyServeLoadBalancer(lb_port,
+                                                lambda: [endpoint])
+        lb.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/x')
+            assert err.value.code == 404
+            assert err.value.read() == b'missing'
+            families = scrape.scrape_url(
+                f'http://127.0.0.1:{lb_port}/metrics')
+            counts = {dict(s.labels)['code']: s.value
+                      for s in
+                      families['skytpu_lb_requests_total'].samples
+                      if dict(s.labels).get('endpoint') == endpoint}
+            assert counts.get('404', 0) >= 1
+            assert '502' not in counts
+            errors = [
+                s for s in families.get(
+                    'skytpu_lb_request_errors_total',
+                    exposition.Series('', '', '', [])).samples
+                if dict(s.labels).get('endpoint') == endpoint]
+            assert not errors
+        finally:
+            lb.stop()
+            server.shutdown()
+
+    def test_no_ready_replica_counted(self):
+        lb_port = _free_port()
+        lb = load_balancer.SkyServeLoadBalancer(lb_port, lambda: [])
+        lb.start()
+        try:
+            before = lb._m_no_replica.value  # pylint: disable=protected-access
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb_port}/x')
+            assert err.value.code == 503
+            assert lb._m_no_replica.value == before + 1  # pylint: disable=protected-access
+        finally:
+            lb.stop()
+
+
+class TestLeastLoadChurn:
+
+    def test_deterministic_tie_break(self):
+        p = load_balancer.LeastLoadPolicy()
+        # All-zero counts: the lexicographically smallest endpoint
+        # wins regardless of input order.
+        assert p.select(['b', 'a', 'c']) == 'a'
+        assert p.select(['c', 'b', 'a']) == 'a'
+
+    def test_inflight_dropped_on_replica_churn(self):
+        p = load_balancer.LeastLoadPolicy()
+        p.on_request_start('http://old:1')
+        p.on_request_start('http://old:1')
+        # 'old' leaves the ready set; its count must not leak into a
+        # later ready set that re-includes the same URL (recycled
+        # replica id -> same endpoint string).
+        assert p.select(['http://new:2']) == 'http://new:2'
+        assert 'http://old:1' not in p._inflight  # pylint: disable=protected-access
+        # The straggler end for the pruned endpoint is a no-op...
+        p.on_request_end('http://old:1')
+        assert 'http://old:1' not in p._inflight  # pylint: disable=protected-access
+        # ...so a recycled endpoint starts from zero (tie -> lexical).
+        assert p.select(['http://old:1', 'http://new:2']) == \
+            'http://new:2'
+        p.on_request_start('http://new:2')
+        assert p.select(['http://old:1', 'http://new:2']) == \
+            'http://old:1'
+
+
+# ---------------------------------------------------------------------
+# Scraper/aggregator + autoscaler e2e (fake 2-host cluster)
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_host_handle(tmp_path):
+    """A fake 2-host cluster: two real (local) py agents plus a
+    ClusterHandle wired to them, as the provisioner would build."""
+    from skypilot_tpu.backends.backend import ClusterHandle
+    from skypilot_tpu.runtime import agent_client
+    procs, hosts = [], []
+    for i in range(2):
+        port = _free_port()
+        procs.append(agent_client.start_local_agent(
+            port, runtime_dir=str(tmp_path / f'h{i}'), use_cpp=False))
+        hosts.append({'ip': '127.0.0.1', 'external_ip': '127.0.0.1',
+                      'agent_port': port,
+                      'runtime_dir': str(tmp_path / f'h{i}')})
+    handle = ClusterHandle(
+        cluster_name='fake2', cluster_name_on_cloud='fake2',
+        provider='local', region='local', zone=None,
+        launched_resources=None, hosts=hosts)
+    for i in range(2):
+        handle.agent_client(i).wait_healthy(timeout=15)
+    yield handle
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.wait(timeout=5)
+
+
+class TestScraperAggregator:
+
+    def test_two_host_scrape_merges_with_host_label(
+            self, two_host_handle, tmp_path):
+        # Distinguish the hosts: host 0 runs a process.
+        two_host_handle.agent_client(0).run(
+            'sleep 30', str(tmp_path / 'm.log'))
+        families = scrape.scrape_handle(two_host_handle)
+        samples = families['skytpu_agent_procs_running'].samples
+        # Both hosts present, distinguished by the host label; same
+        # 'ip' here so hosts share the label value — assert per-host
+        # sample count instead of distinct values.
+        assert len(samples) == 2
+        assert all(dict(s.labels).get('host') == '127.0.0.1'
+                   for s in samples)
+        assert sorted(s.value for s in samples) == [0, 1]
+
+    def test_unreachable_host_degrades_not_fails(self, two_host_handle):
+        dead_port = _free_port()
+        two_host_handle.hosts.append(
+            {'ip': '127.0.0.1', 'external_ip': '127.0.0.1',
+             'agent_port': dead_port, 'runtime_dir': '/tmp'})
+        families = scrape.scrape_handle(two_host_handle, timeout=2)
+        assert len(
+            families['skytpu_agent_procs_running'].samples) == 2
+
+    def test_merge_labeled_cluster_level(self):
+        fams_a = exposition.parse_text('# TYPE up gauge\nup 1\n')
+        fams_b = exposition.parse_text('# TYPE up gauge\nup 0\n')
+        merged = scrape.merge_labeled([('c1', fams_a), ('c2', fams_b)],
+                                      'cluster')
+        raw = scrape.render_families(merged)
+        # One TYPE line, two cluster-labeled series — valid text.
+        assert raw.count('# TYPE up gauge') == 1
+        reparsed = exposition.parse_text(raw)
+        clusters = sorted(dict(s.labels)['cluster']
+                          for s in reparsed['up'].samples)
+        assert clusters == ['c1', 'c2']
+
+    def test_render_and_table(self, two_host_handle):
+        families = scrape.scrape_handle(two_host_handle)
+        raw = scrape.render_families(families)
+        reparsed = exposition.parse_text(raw)
+        assert 'skytpu_agent_uptime_seconds' in reparsed
+        table = scrape.format_families(families,
+                                       name_filter='procs_running')
+        assert 'skytpu_agent_procs_running' in table
+
+
+class TestAutoscalerMeasuredQps:
+    """Acceptance e2e: replicas scale UP from MEASURED load — the
+    only configuration is the declared per-replica target; no QPS
+    hint is injected into the autoscaler."""
+
+    def _spec(self):
+        return SkyServiceSpec(min_replicas=1, max_replicas=4,
+                              target_qps_per_replica=0.05,
+                              upscale_delay_seconds=0,
+                              downscale_delay_seconds=300)
+
+    def test_scales_up_from_measured_load(self, lb_with_replica):
+        lb, lb_port, _, _ = lb_with_replica
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        a.set_qps_source(lb.measured_qps)
+        # Quiet: holds min.
+        d0 = a.evaluate_scaling(1)
+        assert d0.target_num_replicas == 1
+        # Real traffic through the LB: 12 requests inside the window
+        # -> 0.2 QPS measured -> ceil(0.2 / 0.05) = 4 replicas.
+        for _ in range(12):
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}/x').read()
+        d1 = a.evaluate_scaling(1)
+        assert d1.operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+        assert d1.target_num_replicas == 4
+        # generate_ops turns the target into concrete SCALE_UP ops.
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        records = [{'replica_id': 1, 'status': ReplicaStatus.READY,
+                    'use_spot': False, 'version': 1}]
+        ops = a.generate_ops(records)
+        assert len(ops) == 1
+        assert ops[0].operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+        assert ops[0].count == 3
+
+    def test_declared_target_is_fallback_not_assumed(self):
+        """No measured source and no traffic: the autoscaler holds
+        min_replicas — the declared target never manufactures
+        load."""
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        d = a.evaluate_scaling(1)
+        assert d.operator == \
+            autoscalers.AutoscalerDecisionOperator.NO_OP
+        assert d.target_num_replicas == 1
+
+    def test_target_gauge_is_post_decision(self, lb_with_replica):
+        """The exported target gauge must reflect THIS tick's
+        post-hysteresis target, not the previous tick's."""
+        lb, lb_port, _, _ = lb_with_replica
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+        a.set_qps_source(lb.measured_qps)
+        for _ in range(12):
+            urllib.request.urlopen(
+                f'http://127.0.0.1:{lb_port}/x').read()
+        d = a.evaluate_scaling(1)
+        assert d.operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+        reg = metrics_lib.registry()
+        assert reg.gauge('skytpu_autoscaler_target_replicas') \
+            .value == d.target_num_replicas
+
+    def test_broken_qps_source_falls_back_to_timestamps(self):
+        a = autoscalers.RequestRateAutoscaler(self._spec())
+
+        def boom():
+            raise RuntimeError('lb is wedged')
+
+        a.set_qps_source(boom)
+        now = time.time()
+        a.collect_request_information([now - i for i in range(12)])
+        d = a.evaluate_scaling(1, now=now)
+        assert d.operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+
+
+# ---------------------------------------------------------------------
+# Engine + train instrumentation (registry wiring, no TPU needed)
+# ---------------------------------------------------------------------
+
+
+class TestTrainInstrumentation:
+
+    def test_instrument_records_steps_and_tokens(self):
+        from skypilot_tpu.parallel.train import instrument_train_step
+        reg = metrics_lib.registry()
+        calls = []
+
+        def fake_step(state, batch):
+            calls.append(batch)
+            return state, {'loss': 0.0}
+
+        import numpy as np
+        step = instrument_train_step(fake_step)
+        batch = {'tokens': np.zeros((2, 9), dtype='int32')}
+        steps0 = reg.counter('skytpu_train_steps_total').value
+        tokens0 = reg.counter('skytpu_train_tokens_total').value
+        step('state', batch)
+        step('state', batch)
+        assert len(calls) == 2
+        assert reg.counter('skytpu_train_steps_total').value == \
+            steps0 + 2
+        # 2 rows x (9 - 1) label-shifted positions per step.
+        assert reg.counter('skytpu_train_tokens_total').value == \
+            tokens0 + 32
+        assert step.inner is fake_step
+
+
+class TestDashboardMetrics:
+
+    def test_dashboard_exports_jobs_by_status(self):
+        from skypilot_tpu.jobs import dashboard
+        from skypilot_tpu.jobs import state as jobs_state
+        job_id = jobs_state.add_job('metrics-test', '/tmp/dag.yaml',
+                                    'ctl')
+        jobs_state.set_status(job_id,
+                              jobs_state.ManagedJobStatus.RUNNING)
+        board = dashboard.Dashboard(port=0)
+        board.start()
+        try:
+            families = scrape.scrape_url(
+                f'http://127.0.0.1:{board.port}/metrics')
+            running = [s for s in families['skytpu_jobs'].samples
+                       if dict(s.labels).get('status') == 'RUNNING']
+            assert running and running[0].value >= 1
+        finally:
+            board.stop()
+
+
+class TestTimelineFlush:
+
+    def test_flush_persists_without_exit(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_DEBUG', '1')
+        from skypilot_tpu.utils import timeline
+        with timeline.Event('span-a'):
+            pass
+        out = tmp_path / 'trace.json'
+        path = timeline.flush(str(out))
+        assert path == str(out)
+        payload = json.loads(out.read_text())
+        names = [e['name'] for e in payload['traceEvents']]
+        assert 'span-a' in names
+        # Buffer survives the flush; a later flush sees MORE events.
+        with timeline.Event('span-b'):
+            pass
+        timeline.flush(str(out))
+        payload2 = json.loads(out.read_text())
+        assert len(payload2['traceEvents']) > len(names)
