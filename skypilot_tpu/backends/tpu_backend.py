@@ -15,6 +15,7 @@ from skypilot_tpu import exceptions, provision, state, status_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.backends.backend import Backend, ClusterHandle
 from skypilot_tpu.provision.provisioner import RetryingProvisioner
+from skypilot_tpu.resilience import policy as policy_lib
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.runtime import codegen, job_lib
 from skypilot_tpu.task import Task
@@ -24,6 +25,13 @@ logger = tpu_logging.init_logger(__name__)
 
 
 _PROVISION_RETRY_GAP_SECONDS = 30
+
+# retry_until_up sweep pacing: constant gap, no jitter cap games —
+# but routed through a policy so tests can patch `.sleeper`.
+PROVISION_SWEEP_POLICY = policy_lib.RetryPolicy(
+    max_attempts=2, base_delay=_PROVISION_RETRY_GAP_SECONDS,
+    max_delay=_PROVISION_RETRY_GAP_SECONDS, jitter=False,
+    name='provision_sweep')
 
 
 class TpuBackend(Backend):
@@ -142,7 +150,8 @@ class TpuBackend(Backend):
                     'All placements failed (%s); retry_until_up set — '
                     'sleeping %ds before the next sweep.', e,
                     _PROVISION_RETRY_GAP_SECONDS)
-                time.sleep(_PROVISION_RETRY_GAP_SECONDS)
+                PROVISION_SWEEP_POLICY.sleep(
+                    _PROVISION_RETRY_GAP_SECONDS)
 
         info = result.cluster_info
         # A resumed cluster keeps the token its agents were started

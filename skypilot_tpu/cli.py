@@ -369,6 +369,76 @@ def metrics_cmd(cluster, url, name_filter, raw):
         click.echo(scrape_lib.format_families(families, name_filter))
 
 
+# ---------------------------------------------------------------------
+# Chaos drills (docs/resilience.md): arm deterministic faults for
+# driver processes on this machine via $SKYTPU_STATE_DIR/chaos.conf.
+# ---------------------------------------------------------------------
+
+
+@cli.group()
+def chaos():
+    """Deterministic fault-injection drills (see docs/resilience.md).
+
+    Arms faults for DRIVER processes started after arming (managed-job
+    controllers, serve controllers, CLI launches) on this machine.
+    Grammar: ``site:kind:rate[:count]``, comma-separated.
+    """
+
+
+@chaos.command(name='arm')
+@click.argument('spec')
+def chaos_arm(spec):
+    """Arm SPEC, e.g. provision.launch:preempt:1.0:1 — the next
+    managed-job launch gets preempted exactly once (a recovery
+    drill); agent.health:error:0.3 makes 30% of agent health RPCs
+    fail (a retry/watchdog drill)."""
+    from skypilot_tpu.resilience import faults as faults_lib
+    specs = faults_lib.parse_specs(spec)  # validates; raises on typo
+    path = faults_lib.chaos_file_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write('\n'.join(s.render() for s in specs) + '\n')
+    for s in specs:
+        click.echo(f'Armed: {s.render()}')
+    click.echo(f'Written to {path}; driver processes started from '
+               'now on inject these faults. Disarm with '
+               '`xsky chaos clear`.')
+
+
+@chaos.command(name='status')
+def chaos_status():
+    """Show armed faults (chaos file + $SKYTPU_FAULTS)."""
+    from skypilot_tpu.resilience import faults as faults_lib
+    path = faults_lib.chaos_file_path()
+    shown = False
+    if os.path.exists(path):
+        with open(path, encoding='utf-8') as f:
+            text = f.read().strip()
+        if text:
+            click.echo(f'{path}:')
+            for line in text.splitlines():
+                click.echo(f'  {line}')
+            shown = True
+    env = os.environ.get(faults_lib.ENV_VAR)
+    if env:
+        click.echo(f'${faults_lib.ENV_VAR}={env}')
+        shown = True
+    if not shown:
+        click.echo('No faults armed.')
+
+
+@chaos.command(name='clear')
+def chaos_clear():
+    """Disarm all file-armed faults."""
+    from skypilot_tpu.resilience import faults as faults_lib
+    path = faults_lib.chaos_file_path()
+    try:
+        os.remove(path)
+        click.echo(f'Cleared {path}.')
+    except FileNotFoundError:
+        click.echo('No faults armed.')
+
+
 @cli.command(name='cost-report')
 def cost_report():
     """Estimated cost of clusters from recorded usage intervals."""

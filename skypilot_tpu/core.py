@@ -182,8 +182,8 @@ def wait_for_job(cluster_name: str, job_id: int,
     """Block until the job reaches a terminal state."""
     handle = _get_handle(cluster_name)
     backend = TpuBackend()
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         s = backend.job_status(handle, job_id)
         if s is not None and s.is_terminal():
             return s

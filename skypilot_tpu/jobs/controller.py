@@ -9,7 +9,7 @@ completion, advance the chain.
 """
 import argparse
 import os
-import time
+import threading
 from typing import Optional
 
 from skypilot_tpu import core as core_lib
@@ -17,6 +17,8 @@ from skypilot_tpu import exceptions, state
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import watchdog as watchdog_lib
 from skypilot_tpu.runtime import job_lib
 from skypilot_tpu.task import Task
 from skypilot_tpu.utils import common_utils
@@ -42,6 +44,12 @@ class JobsController:
         self.job_id = managed_job_id
         self.dag_yaml_path = dag_yaml_path
         self.tasks = self._load_tasks()
+        # Set by the health watchdog when the task cluster's agent
+        # goes dark: the poll loop wakes IMMEDIATELY instead of
+        # waiting out JOB_STATUS_CHECK_GAP_SECONDS, so recovery
+        # starts as soon as the preemption is observable.
+        self._wake = threading.Event()
+        self._watchdog: Optional[watchdog_lib.HealthWatchdog] = None
 
     def _load_tasks(self):
         configs = common_utils.read_yaml_all(self.dag_yaml_path)
@@ -90,6 +98,36 @@ class JobsController:
         from skypilot_tpu import status_lib
         return records[0]['status'] == status_lib.ClusterStatus.UP
 
+    # -- watchdog -------------------------------------------------------
+
+    def _arm_watchdog(self, cluster_name: str) -> None:
+        """(Re)point the heartbeat monitor at the CURRENT task
+        cluster's head agent. On sustained agent death it wakes the
+        poll loop so recovery starts immediately."""
+        self._disarm_watchdog()
+        if not watchdog_lib.enabled():
+            return
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return
+        handle = record['handle']
+
+        def probe() -> bool:
+            return handle.head_agent().is_healthy(fast=True)
+
+        dog = watchdog_lib.HealthWatchdog(
+            name=f'jobs-{self.job_id}-watchdog')
+        dog.add_target(cluster_name, probe)
+        dog.on_unhealthy(
+            lambda target, failures: self._wake.set())
+        dog.start()
+        self._watchdog = dog
+
+    def _disarm_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
     # -- main loop ------------------------------------------------------
 
     def run(self) -> jobs_state.ManagedJobStatus:
@@ -126,7 +164,19 @@ class JobsController:
             return jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.RUNNING)
+        self._arm_watchdog(cluster_name)
 
+        try:
+            return self._poll_until_terminal(idx, task, strategy,
+                                             cluster_name, job_id)
+        finally:
+            self._disarm_watchdog()
+
+    def _poll_until_terminal(
+            self, idx: int, task: Task,
+            strategy: recovery_strategy.StrategyExecutor,
+            cluster_name: str,
+            job_id: int) -> jobs_state.ManagedJobStatus:
         max_restarts = next(
             iter(task.resources)).max_restarts_on_errors
         restarts_on_errors = 0
@@ -139,7 +189,12 @@ class JobsController:
                 strategy.terminate_cluster(cluster_name)
                 jobs_state.clear_cancel(self.job_id)
                 return jobs_state.ManagedJobStatus.CANCELLED
-            time.sleep(JOB_STATUS_CHECK_GAP_SECONDS)
+            # Event-gated gap, not a sleep: the watchdog
+            # short-circuits it the moment the task cluster's agent
+            # goes dark, so a preemption does not sit undetected for
+            # the rest of the gap.
+            self._wake.wait(JOB_STATUS_CHECK_GAP_SECONDS)
+            self._wake.clear()
             status = self._poll_job_status(cluster_name, job_id)
             if status is None:
                 # Cluster unreachable — preemption suspect. Capture
@@ -166,6 +221,8 @@ class JobsController:
                         FAILED_NO_RESOURCE
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+                # Fresh cluster, fresh handle: re-point the watchdog.
+                self._arm_watchdog(cluster_name)
                 continue
             if status == job_lib.JobStatus.SUCCEEDED:
                 logger.info('Task %d succeeded; tearing down %s', idx,
@@ -220,9 +277,15 @@ class JobsController:
                         FAILED_NO_RESOURCE
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+                self._arm_watchdog(cluster_name)
 
     def _poll_job_status(self, cluster_name: str, job_id: int
                          ) -> Optional[job_lib.JobStatus]:
+        if faults.fire('jobs.poll') is not None:
+            # Any injected kind renders the poll unanswered — the
+            # controller must prove the cluster dead (liveness
+            # refresh) before it may call this a preemption.
+            return None
         try:
             return core_lib.job_status(cluster_name, job_id)
         except (exceptions.SkyTpuError, OSError):

@@ -196,8 +196,8 @@ def queue() -> List[Dict[str, Any]]:
 
 def wait(job_id: int, timeout: float = 3600.0,
          poll: float = 2.0) -> jobs_state.ManagedJobStatus:
-    deadline = time.time() + timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
         rec = get(job_id)
         if rec is None:
             raise exceptions.JobError(

@@ -22,6 +22,20 @@ import sys
 import time
 
 
+def _reap_policy():
+    """Teardown backoff (5s base, 60s cap), shared-policy shaped."""
+    from skypilot_tpu.resilience import policy as policy_lib
+    global _POLICY
+    if _POLICY is None:
+        _POLICY = policy_lib.RetryPolicy(
+            max_attempts=5, base_delay=5.0, max_delay=60.0,
+            jitter=False, name='jobs_reap')
+    return _POLICY
+
+
+_POLICY = None
+
+
 def _status_path(cluster_name: str) -> str:
     base = os.path.expanduser(
         os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
@@ -60,7 +74,7 @@ def main() -> int:
         except (exceptions.SkyTpuError, OSError) as e:
             last_err = e
             jobs_state.note_teardown_attempt(cluster_name, repr(e))
-            time.sleep(min(60.0, 5.0 * 2 ** attempt))
+            _reap_policy().sleep(_reap_policy().delay_for(attempt))
     # Give up on THIS process, not on the teardown: the pending row
     # stays, and the next reconcile/skylet event spawns a new reaper.
     _write_status(cluster_name, state='retrying', error=repr(last_err))

@@ -9,12 +9,13 @@ Two strategies, same as the reference:
   cluster in time and space, so the next region is usually the faster
   path back to running.
 """
-import time
 from typing import Optional, Set
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, execution
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import policy as policy_lib
 from skypilot_tpu.resources import Resources
 from skypilot_tpu.task import Task
 
@@ -22,6 +23,16 @@ logger = tpu_logging.init_logger(__name__)
 
 MAX_PROVISION_RETRIES = 3
 RETRY_GAP_SECONDS = 5.0
+
+# The relaunch backoff: full jitter on purpose — a zone-wide
+# preemption wakes every controller at once, and their relaunch
+# sweeps must decorrelate rather than stampede in lockstep. Tests
+# patch `.sleeper` to strip real waits.
+LAUNCH_RETRY_POLICY = policy_lib.RetryPolicy(
+    max_attempts=MAX_PROVISION_RETRIES,
+    base_delay=RETRY_GAP_SECONDS,
+    max_delay=120.0,
+    name='jobs_launch')
 
 _STRATEGIES = {}
 
@@ -61,6 +72,11 @@ class StrategyExecutor:
         from skypilot_tpu.jobs import scheduler
         for attempt in range(max_retries):
             try:
+                injected = faults.fire('provision.launch')
+                if injected in ('error', 'timeout'):
+                    raise exceptions.ResourcesUnavailableError(
+                        f'[fault:provision.launch] injected '
+                        f'{injected}')
                 # Bounded by the controller-wide launch budget: a
                 # zone-wide preemption wakes every controller at
                 # once; their relaunches must queue, not stampede
@@ -70,6 +86,12 @@ class StrategyExecutor:
                         task, cluster_name, detach_run=True,
                         quiet_optimizer=True,
                         retry_until_up=retry_until_up)
+                if injected == 'preempt':
+                    # Deterministic mid-run preemption: the launch
+                    # lands, then the slice dies out from under the
+                    # job — the exact scenario the controller's
+                    # recovery path exists for.
+                    self._inject_preemption(cluster_name)
                 return job_id
             except exceptions.ResourcesUnavailableError as e:
                 if e.no_failover:
@@ -77,10 +99,13 @@ class StrategyExecutor:
                 logger.warning(
                     'Launch attempt %d/%d failed: %s', attempt + 1,
                     max_retries, e)
-                # Exponential backoff: repeated failures usually mean
-                # capacity is gone everywhere; hammering faster does
-                # not bring it back.
-                time.sleep(RETRY_GAP_SECONDS * (2 ** attempt))
+                # Backoff: repeated failures usually mean capacity is
+                # gone everywhere; hammering faster does not bring it
+                # back. (No sleep after the LAST attempt — there is
+                # nothing left to wait for.)
+                if attempt + 1 < max_retries:
+                    LAUNCH_RETRY_POLICY.sleep(
+                        LAUNCH_RETRY_POLICY.delay_for(attempt))
             except (exceptions.CommandError, OSError) as e:
                 # Cluster died mid-launch (e.g. spot preemption while
                 # the job submit was in flight): reconcile the state
@@ -94,8 +119,30 @@ class StrategyExecutor:
                     core_lib.status([cluster_name], refresh=True)
                 except exceptions.SkyTpuError:
                     pass
-                time.sleep(RETRY_GAP_SECONDS * (2 ** attempt))
+                if attempt + 1 < max_retries:
+                    LAUNCH_RETRY_POLICY.sleep(
+                        LAUNCH_RETRY_POLICY.delay_for(attempt))
         return None
+
+    @staticmethod
+    def _inject_preemption(cluster_name: str) -> None:
+        """Kill the cluster's instances OUT-OF-BAND (provider-level,
+        state row left behind) so the controller's next poll sees a
+        genuine preemption, not an orderly teardown."""
+        from skypilot_tpu import provision, state
+        record = state.get_cluster_from_name(cluster_name)
+        if record is None:
+            return
+        handle = record['handle']
+        logger.warning('[fault:provision.launch] preempting %s',
+                       cluster_name)
+        try:
+            provision.terminate_instances(
+                handle.provider, handle.region,
+                handle.cluster_name_on_cloud)
+        except exceptions.SkyTpuError as e:
+            logger.warning('injected preemption of %s failed: %s',
+                           cluster_name, e)
 
     def terminate_cluster(self, cluster_name: str) -> None:
         try:

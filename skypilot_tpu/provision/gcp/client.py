@@ -116,8 +116,11 @@ def request(method: str, url: str,
     immediately since TPU ``nodes.create`` is not idempotent and the
     operation may have started server-side.
     """
+    from skypilot_tpu.resilience import policy as policy_lib
+    retry_policy = policy_lib.RetryPolicy(
+        max_attempts=max_retries + 1, base_delay=_RETRY_BACKOFF_S,
+        max_delay=30.0, name='gcp_api')
     data = json.dumps(body).encode() if body is not None else None
-    backoff = _RETRY_BACKOFF_S
     for attempt in range(max_retries + 1):
         req = urllib.request.Request(
             url, data=data, method=method,
@@ -132,14 +135,12 @@ def request(method: str, url: str,
         except urllib.error.HTTPError as e:
             if (method == 'GET' and e.code in _RETRYABLE_HTTP and
                     attempt < max_retries):
-                time.sleep(backoff)
-                backoff *= 2
+                retry_policy.sleep(retry_policy.delay_for(attempt))
                 continue
             raise classify_http_error(e) from e
         except (urllib.error.URLError, OSError) as e:
             if attempt < max_retries:
-                time.sleep(backoff)
-                backoff *= 2
+                retry_policy.sleep(retry_policy.delay_for(attempt))
                 continue
             # DNS failures / resets / timeouts must stay inside the
             # SkyTpuError taxonomy so bulk_provision's cleanup and the

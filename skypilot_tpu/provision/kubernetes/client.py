@@ -16,7 +16,6 @@ import json
 import os
 import ssl
 import tempfile
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -186,7 +185,11 @@ class KubeClient:
         headers = dict(self._headers)
         headers['Content-Type'] = 'application/json'
         headers['Accept'] = 'application/json'
-        backoff = _RETRY_BACKOFF_S
+        from skypilot_tpu.resilience import policy as policy_lib
+        retry_policy = policy_lib.RetryPolicy(
+            max_attempts=_MAX_RETRIES + 1,
+            base_delay=_RETRY_BACKOFF_S, max_delay=30.0,
+            name='k8s_api')
         for attempt in range(_MAX_RETRIES + 1):
             req = urllib.request.Request(url, data=data, method=method,
                                          headers=headers)
@@ -201,8 +204,7 @@ class KubeClient:
                 # retry retryable 5xx (mutations may have landed).
                 if (method == 'GET' and e.code in _RETRYABLE_HTTP
                         and attempt < _MAX_RETRIES):
-                    time.sleep(backoff)
-                    backoff *= 2
+                    retry_policy.sleep(retry_policy.delay_for(attempt))
                     continue
                 raise classify_http_error(e) from e
             except (urllib.error.URLError, OSError) as e:
@@ -210,8 +212,7 @@ class KubeClient:
                 # timed-out POST may have landed server-side, and
                 # re-POSTing a pod create 409s confusingly.
                 if method == 'GET' and attempt < _MAX_RETRIES:
-                    time.sleep(backoff)
-                    backoff *= 2
+                    retry_policy.sleep(retry_policy.delay_for(attempt))
                     continue
                 raise exceptions.ApiError(
                     f'network error talking to {url}: {e}') from e

@@ -67,10 +67,12 @@ def _host_alive(host: Dict[str, Any],
                 token: Optional[str] = None) -> bool:
     """Liveness = the agent answers /health. A pid check alone is
     wrong here: a SIGTERMed agent whose parent (this process) hasn't
-    reaped it yet is a zombie, and os.kill(pid, 0) still succeeds."""
+    reaped it yet is a zombie, and os.kill(pid, 0) still succeeds.
+    ``fast=True``: this is itself a poll primitive — inner retries
+    would only delay preemption detection."""
     return agent_client.AgentClient('127.0.0.1', host['port'],
                                     timeout=1,
-                                    token=token).is_healthy()
+                                    token=token).is_healthy(fast=True)
 
 
 def bootstrap_config(config: ProvisionConfig) -> ProvisionConfig:

@@ -24,9 +24,31 @@ from skypilot_tpu import tpu_logging
 from skypilot_tpu.provision.common import (ClusterInfo,
                                            ProvisionConfig,
                                            ProvisionRecord)
+from skypilot_tpu.resilience import policy as policy_lib
 from skypilot_tpu.resources import Resources
 
 logger = tpu_logging.init_logger(__name__)
+
+
+def _transient_api_error(exc: BaseException) -> bool:
+    """Retry-in-place classification: generic ApiErrors that look
+    like server blips (5xx/429/network). Stockout/quota are REAL
+    placement verdicts — they must fall through to the failover
+    sweep, not burn retries on a zone that said no."""
+    if isinstance(exc, (exceptions.StockoutError,
+                        exceptions.QuotaExceededError)):
+        return False
+    if not isinstance(exc, exceptions.ApiError):
+        return False
+    return (exc.http_code is None or
+            exc.http_code in policy_lib.TRANSIENT_HTTP_CODES)
+
+
+# Per-placement transient retry (same zone) before the placement is
+# declared failed; tests patch `.sleeper`.
+API_RETRY_POLICY = policy_lib.RetryPolicy(
+    max_attempts=3, base_delay=2.0, max_delay=15.0,
+    retryable=_transient_api_error, name='provision_api')
 
 
 def bulk_provision(config: ProvisionConfig) -> ProvisionRecord:
@@ -194,7 +216,12 @@ class RetryingProvisioner:
             state_lib.set_provision_breadcrumb(
                 cluster_name, cluster_name_on_cloud, provider, region)
             try:
-                record = bulk_provision(config)
+                # Transient API blips retry the SAME placement (with
+                # backoff) before the failover engine moves on — a
+                # 503 from the TPU API is not evidence the zone has
+                # no capacity. bulk_provision cleans up after itself
+                # on failure, so a retry re-provisions from scratch.
+                record = API_RETRY_POLICY.call(bulk_provision, config)
             except exceptions.StockoutError as e:
                 logger.warning('Stockout in %s: %s — blocklisting '
                                'zone, trying next.', where, e)

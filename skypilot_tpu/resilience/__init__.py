@@ -1,0 +1,29 @@
+"""Resilience subsystem: unified retry/backoff policy, circuit
+breakers, deterministic fault injection, and health watchdogs.
+
+Dependency-free by design (stdlib only), like ``metrics/``. Every
+layer that talks to an unreliable substrate — driver→agent RPCs,
+provision APIs, replica probes, the load balancer — routes its
+retries through :class:`RetryPolicy` and guards dead targets with a
+:class:`CircuitBreaker`, so backoff/jitter/deadline semantics are
+defined in exactly one place and every recovery path can be exercised
+deterministically via :mod:`skypilot_tpu.resilience.faults`.
+
+See ``docs/resilience.md`` for the knobs and the chaos-drill guide.
+"""
+from skypilot_tpu.resilience.policy import (CircuitBreaker,
+                                            CircuitOpenError,
+                                            CircuitState, RetryPolicy,
+                                            breaker_for,
+                                            default_retryable,
+                                            reset_breakers)
+
+__all__ = [
+    'CircuitBreaker',
+    'CircuitOpenError',
+    'CircuitState',
+    'RetryPolicy',
+    'breaker_for',
+    'default_retryable',
+    'reset_breakers',
+]

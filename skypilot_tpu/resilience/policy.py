@@ -1,0 +1,293 @@
+"""Retry policy (exponential backoff + full jitter + deadline) and
+per-target circuit breakers.
+
+The ONE retry implementation for the tree: agent RPCs
+(``runtime/agent_client.py``), managed-job relaunches
+(``jobs/recovery_strategy.py``), provision API calls
+(``provision/provisioner.py``, cloud clients) and the serve load
+balancer all delegate their sleep/backoff decisions here. Tests
+inject ``sleeper``/``clock``/``rng`` so no retry path ever needs a
+real ``time.sleep`` to be exercised.
+
+Backoff shape: full jitter (AWS architecture-blog style) —
+``delay = uniform(0, min(max_delay, base * 2**attempt))``. Full
+jitter beats equal-jitter for thundering herds: a zone-wide
+preemption wakes every controller at once, and their relaunches must
+decorrelate, not resynchronize on a shared schedule.
+"""
+import enum
+import http.client
+import random
+import threading
+import time
+import urllib.error
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+# HTTP statuses safe to retry (request may not have been processed, or
+# the server said "try again").
+TRANSIENT_HTTP_CODES = (408, 429, 500, 502, 503, 504)
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised (fail-fast) when a circuit breaker is OPEN.
+
+    Subclasses ``ConnectionError`` (an ``OSError``) so existing
+    ``except (URLError, OSError)`` handlers treat a tripped breaker
+    exactly like the dead host it stands in for."""
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """Transient-failure classification for HTTP-ish call sites.
+
+    5xx/408/429 retry; other HTTP errors are the server ANSWERING
+    (4xx) and retrying would just repeat the same mistake. A tripped
+    breaker is deliberately not retryable — its whole point is
+    failing fast."""
+    if isinstance(exc, CircuitOpenError):
+        return False
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code in TRANSIENT_HTTP_CODES
+    if isinstance(exc, urllib.error.URLError):
+        return True
+    # HTTPException: truncated/garbage response mid-read (e.g.
+    # BadStatusLine from a dying server) — transport-shaped, retry.
+    return isinstance(exc, (ConnectionError, TimeoutError,
+                            http.client.HTTPException))
+
+
+class RetryPolicy:
+    """Exponential backoff + full jitter + overall deadline.
+
+    ``retryable`` is a tuple of exception types OR a predicate
+    ``exc -> bool`` (default :func:`default_retryable`). ``sleeper``
+    and ``clock`` are injectable for tests (fake clock ⇒ zero real
+    waiting); ``rng`` is injectable for reproducible jitter.
+    """
+
+    def __init__(self,
+                 max_attempts: int = 3,
+                 base_delay: float = 0.5,
+                 max_delay: float = 30.0,
+                 deadline: Optional[float] = None,
+                 retryable: Union[None, Sequence[type],
+                                  Callable[[BaseException],
+                                           bool]] = None,
+                 jitter: bool = True,
+                 sleeper: Optional[Callable[[float], None]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 rng: Optional[random.Random] = None,
+                 name: str = 'default'):
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1')
+        self.max_attempts = max_attempts
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = deadline
+        self.jitter = jitter
+        self.name = name
+        self._retryable = retryable
+        # Public + mutable on purpose: tests patch `.sleeper` (and
+        # `.clock`) on module-level policy instances to strip real
+        # waits out of e2e recovery runs.
+        self.sleeper: Callable[[float], None] = sleeper or time.sleep
+        self.clock: Callable[[], float] = clock or time.monotonic
+        self.rng = rng or random.Random()
+
+    # -- classification -------------------------------------------------
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        if self._retryable is None:
+            return default_retryable(exc)
+        if callable(self._retryable):
+            return bool(self._retryable(exc))
+        return isinstance(exc, tuple(self._retryable))
+
+    # -- backoff --------------------------------------------------------
+
+    def delay_for(self, attempt: int) -> float:
+        """Delay before retry number ``attempt+1`` (0-based failure
+        count). Full jitter: uniform over (0, capped-exponential]."""
+        cap = min(self.max_delay,
+                  self.base_delay * (2.0 ** max(attempt, 0)))
+        if not self.jitter:
+            return cap
+        return self.rng.uniform(0.0, cap)
+
+    def sleep(self, seconds: float) -> None:
+        # The counter lives HERE, not in call(): the hand-rolled
+        # adoption points (recovery_strategy, cloud clients, reap)
+        # use delay_for()+sleep() directly and must still show up in
+        # skytpu_retries_total — the observability contract
+        # docs/resilience.md promises.
+        _retries_counter().labels(policy=self.name).inc()
+        if seconds > 0:
+            self.sleeper(seconds)
+
+    # -- driver ---------------------------------------------------------
+
+    def call(self, fn: Callable[..., Any], *args: Any,
+             **kwargs: Any) -> Any:
+        """Run ``fn`` with retries. Raises the LAST exception when
+        attempts are exhausted, the exception is not retryable, or
+        the next backoff would overrun the deadline."""
+        start = self.clock()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # pylint: disable=broad-except
+                attempt += 1
+                if attempt >= self.max_attempts or \
+                        not self.is_retryable(e):
+                    raise
+                delay = self.delay_for(attempt - 1)
+                if self.deadline is not None and \
+                        (self.clock() - start) + delay > self.deadline:
+                    raise
+                logger.debug('%s: retry %d/%d in %.2fs after %r',
+                             self.name, attempt,
+                             self.max_attempts - 1, delay, e)
+                self.sleep(delay)
+
+
+class CircuitState(enum.Enum):
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+
+class CircuitBreaker:
+    """Per-target breaker: CLOSED → (N consecutive failures) → OPEN →
+    (recovery timeout) → HALF_OPEN → one probe decides.
+
+    ``allow()`` gates calls; callers report outcomes with
+    ``record_success``/``record_failure``. While OPEN every call
+    fails fast (the caller raises :class:`CircuitOpenError`) instead
+    of burning its timeout against a dead host. State is exported as
+    the ``skytpu_circuit_breaker_state`` gauge (0 closed, 1
+    half-open, 2 open)."""
+
+    def __init__(self, target: str = '',
+                 failure_threshold: int = 5,
+                 recovery_timeout: float = 5.0,
+                 clock: Optional[Callable[[], float]] = None):
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1')
+        self.target = target
+        self.failure_threshold = failure_threshold
+        self.recovery_timeout = float(recovery_timeout)
+        self.clock: Callable[[], float] = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._state = CircuitState.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._export()
+
+    @property
+    def state(self) -> CircuitState:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    def allow(self) -> bool:
+        """True if a call may proceed. The OPEN→HALF_OPEN transition
+        happens here; the caller that observes it IS the probe — any
+        other caller in HALF_OPEN is rejected until the probe
+        reports."""
+        with self._lock:
+            if self._state == CircuitState.CLOSED:
+                return True
+            if self._state == CircuitState.OPEN:
+                if self.clock() - self._opened_at >= \
+                        self.recovery_timeout:
+                    self._state = CircuitState.HALF_OPEN
+                    self._export()
+                    return True
+                return False
+            return False  # HALF_OPEN: probe already in flight
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != CircuitState.CLOSED:
+                logger.info('circuit %s: closed (target recovered)',
+                            self.target)
+            self._state = CircuitState.CLOSED
+            self._export()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            tripped = (self._state == CircuitState.HALF_OPEN or
+                       self._consecutive_failures >=
+                       self.failure_threshold)
+            if tripped and self._state != CircuitState.OPEN:
+                logger.warning(
+                    'circuit %s: OPEN after %d consecutive failures',
+                    self.target, self._consecutive_failures)
+            if tripped:
+                self._state = CircuitState.OPEN
+                self._opened_at = self.clock()
+            self._export()
+
+    def _export(self) -> None:
+        # Called with the lock held — metrics take their own family
+        # lock only.
+        if self.target:
+            _breaker_gauge().labels(
+                target=self.target).set(self._state.value)
+
+
+# -- process-wide breaker registry ------------------------------------
+# One breaker per target (host:port) shared by every client instance
+# in the process: two AgentClients to the same dead host must share
+# the verdict, or each re-burns its own timeout budget.
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(target: str, failure_threshold: int = 5,
+                recovery_timeout: float = 5.0) -> CircuitBreaker:
+    """Get-or-create the process-wide breaker for ``target``.
+    Creation parameters apply only on first use."""
+    with _breakers_lock:
+        breaker = _breakers.get(target)
+        if breaker is None:
+            breaker = CircuitBreaker(
+                target=target, failure_threshold=failure_threshold,
+                recovery_timeout=recovery_timeout)
+            _breakers[target] = breaker
+        return breaker
+
+
+def reset_breakers() -> None:
+    """Drop all per-target breakers (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+# -- metrics (lazy so the module stays importable standalone) ---------
+
+
+def _retries_counter():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().counter(
+        'skytpu_retries_total',
+        'Retry sleeps taken, by policy name.', ('policy',))
+
+
+def _breaker_gauge():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().gauge(
+        'skytpu_circuit_breaker_state',
+        'Circuit state per target: 0 closed, 1 half-open, 2 open.',
+        ('target',))

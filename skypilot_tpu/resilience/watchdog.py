@@ -1,0 +1,224 @@
+"""Driver-side health watchdog.
+
+Polls registered probe targets (host agents' ``/health``, replica
+head agents) on an interval, tracks CONSECUTIVE failures per target,
+and fires callbacks on the healthy→unhealthy and unhealthy→healthy
+transitions. Consumers:
+
+  - the jobs controller short-circuits its poll gap when the task
+    cluster's agent goes dark, so preemption recovery starts
+    immediately instead of waiting out the status-check gap;
+  - the serve controller marks the replica suspect and triggers an
+    immediate ``probe_all``.
+
+A single flaky probe does nothing — only ``unhealthy_threshold``
+consecutive failures demote a target (the single-flake tolerance the
+raw ``is_healthy`` checks never had). Per-target liveness is
+exported as the ``skytpu_watchdog_target_healthy`` gauge.
+
+Tunables (env): ``SKYTPU_WATCHDOG_INTERVAL_SECONDS`` (default 10),
+``SKYTPU_WATCHDOG_THRESHOLD`` (default 3),
+``SKYTPU_WATCHDOG_ENABLED`` (default 1). ``clock``/``tick()`` are
+injectable/callable directly so tests never need a running thread or
+a real sleep.
+"""
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+DEFAULT_INTERVAL_SECONDS = 10.0
+DEFAULT_UNHEALTHY_THRESHOLD = 3
+
+
+def enabled() -> bool:
+    return os.environ.get('SKYTPU_WATCHDOG_ENABLED', '1') != '0'
+
+
+def _env_interval() -> float:
+    return float(os.environ.get('SKYTPU_WATCHDOG_INTERVAL_SECONDS',
+                                str(DEFAULT_INTERVAL_SECONDS)))
+
+
+def _env_threshold() -> int:
+    return int(os.environ.get('SKYTPU_WATCHDOG_THRESHOLD',
+                              str(DEFAULT_UNHEALTHY_THRESHOLD)))
+
+
+class HealthWatchdog:
+    """Heartbeat monitor over named probe targets.
+
+    ``probe`` callables return truthy for healthy; exceptions count
+    as failures (a probe that crashes IS an unhealthy signal, and one
+    misbehaving target must not kill the monitor loop)."""
+
+    def __init__(self, interval: Optional[float] = None,
+                 unhealthy_threshold: Optional[int] = None,
+                 name: str = 'watchdog',
+                 clock: Optional[Callable[[], float]] = None):
+        self.interval = (_env_interval() if interval is None
+                         else float(interval))
+        self.unhealthy_threshold = (
+            _env_threshold() if unhealthy_threshold is None
+            else int(unhealthy_threshold))
+        if self.unhealthy_threshold < 1:
+            raise ValueError('unhealthy_threshold must be >= 1')
+        self.name = name
+        self.clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._targets: Dict[str, Callable[[], bool]] = {}
+        self._failures: Dict[str, int] = {}
+        self._unhealthy: Dict[str, bool] = {}
+        self._on_unhealthy: List[Callable[[str, int], None]] = []
+        self._on_recovered: List[Callable[[str], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- target management ----------------------------------------------
+
+    def add_target(self, target: str,
+                   probe: Callable[[], bool]) -> None:
+        with self._lock:
+            fresh = target not in self._targets
+            self._targets[target] = probe
+            if fresh:
+                self._failures[target] = 0
+                self._unhealthy[target] = False
+        if fresh:
+            _healthy_gauge().labels(target=target).set(1)
+            _failures_gauge().labels(target=target).set(0)
+
+    def remove_target(self, target: str) -> None:
+        with self._lock:
+            self._targets.pop(target, None)
+            self._failures.pop(target, None)
+            self._unhealthy.pop(target, None)
+
+    def targets(self) -> List[str]:
+        with self._lock:
+            return sorted(self._targets)
+
+    def consecutive_failures(self, target: str) -> int:
+        with self._lock:
+            return self._failures.get(target, 0)
+
+    def is_unhealthy(self, target: str) -> bool:
+        with self._lock:
+            return self._unhealthy.get(target, False)
+
+    # -- callbacks ------------------------------------------------------
+
+    def on_unhealthy(self,
+                     callback: Callable[[str, int], None]) -> None:
+        """``callback(target, consecutive_failures)`` fired ONCE per
+        healthy→unhealthy transition (not every failed poll)."""
+        self._on_unhealthy.append(callback)
+
+    def on_recovered(self, callback: Callable[[str], None]) -> None:
+        self._on_recovered.append(callback)
+
+    # -- polling --------------------------------------------------------
+
+    def tick(self) -> Dict[str, bool]:
+        """One poll round over all targets; returns target→healthy.
+        Callable directly from tests (no thread, no sleep)."""
+        with self._lock:
+            snapshot = list(self._targets.items())
+        results: Dict[str, bool] = {}
+        for target, probe in snapshot:
+            try:
+                healthy = bool(probe())
+            except Exception as e:  # pylint: disable=broad-except
+                logger.debug('%s: probe %s raised: %r', self.name,
+                             target, e)
+                healthy = False
+            results[target] = healthy
+            self._account(target, healthy)
+        return results
+
+    def _account(self, target: str, healthy: bool) -> None:
+        fire_down = fire_up = False
+        failures = 0
+        with self._lock:
+            if target not in self._targets:
+                return  # removed mid-tick
+            if healthy:
+                was_unhealthy = self._unhealthy.get(target, False)
+                self._failures[target] = 0
+                self._unhealthy[target] = False
+                fire_up = was_unhealthy
+            else:
+                failures = self._failures.get(target, 0) + 1
+                self._failures[target] = failures
+                if failures >= self.unhealthy_threshold and \
+                        not self._unhealthy.get(target, False):
+                    self._unhealthy[target] = True
+                    fire_down = True
+        # The exported verdict is the THRESHOLDED one: a target below
+        # the consecutive-failure threshold still reads healthy.
+        _healthy_gauge().labels(target=target).set(
+            0 if self.is_unhealthy(target) else 1)
+        _failures_gauge().labels(target=target).set(
+            0 if healthy else failures)
+        if fire_down:
+            logger.warning(
+                '%s: target %s UNHEALTHY after %d consecutive '
+                'failures', self.name, target, failures)
+            for callback in list(self._on_unhealthy):
+                try:
+                    callback(target, failures)
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception('%s: on_unhealthy callback '
+                                     'failed for %s', self.name,
+                                     target)
+        if fire_up:
+            logger.info('%s: target %s recovered', self.name, target)
+            for callback in list(self._on_recovered):
+                try:
+                    callback(target)
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception('%s: on_recovered callback '
+                                     'failed for %s', self.name,
+                                     target)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=self.name, daemon=True)
+        self._thread.start()
+
+    def stop(self, join: bool = False) -> None:
+        self._stop.set()
+        thread = self._thread
+        if join and thread is not None:
+            thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('%s: tick failed', self.name)
+
+
+def _healthy_gauge():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().gauge(
+        'skytpu_watchdog_target_healthy',
+        'Watchdog liveness verdict per target (1 healthy).',
+        ('target',))
+
+
+def _failures_gauge():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().gauge(
+        'skytpu_watchdog_consecutive_failures',
+        'Consecutive failed health probes per target.', ('target',))

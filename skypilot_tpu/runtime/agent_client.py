@@ -1,4 +1,11 @@
-"""Client for the host-agent protocol (see ``runtime/agent.py``)."""
+"""Client for the host-agent protocol (see ``runtime/agent.py``).
+
+Resilience: every GET/POST helper retries transient failures
+(``URLError``/``ConnectionResetError``/5xx) through the shared
+:class:`~skypilot_tpu.resilience.RetryPolicy`, and a process-wide
+per-host circuit breaker fails fast against dead hosts instead of
+re-burning the HTTP timeout on every call (docs/resilience.md).
+"""
 import json
 import os
 import subprocess
@@ -6,12 +13,27 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.resilience import faults
+from skypilot_tpu.resilience import policy as policy_lib
 
 logger = tpu_logging.init_logger(__name__)
+
+# Defaults for the driver→agent RPC path: quick retries (the transient
+# blips here are connection resets and agent restarts, not capacity
+# waits), breaker trips after 5 straight failures and re-probes every
+# 2s so wait-for-recovery loops keep ~seconds granularity.
+_RETRY_ATTEMPTS = 3
+_RETRY_BASE_SECONDS = 0.1
+_RETRY_MAX_SECONDS = 2.0
+_BREAKER_FAILURES = 5
+_BREAKER_RECOVERY_SECONDS = 2.0
+
+# Request paths → fault-injection sites (docs/resilience.md).
+_FAULT_SITES = {'/health': 'agent.health', '/run': 'agent.run'}
 
 _CPP_AGENT_REL = 'runtime/cpp/host_agent'
 
@@ -49,12 +71,24 @@ class AgentClient:
     agent rejects requests without it."""
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 retry_policy: Optional[
+                     policy_lib.RetryPolicy] = None):
         self.host = host
         self.port = port
         self.timeout = timeout
         self.token = token
         self._base = f'http://{host}:{port}'
+        self._target = f'{host}:{port}'
+        self.retry_policy = retry_policy or policy_lib.RetryPolicy(
+            max_attempts=_RETRY_ATTEMPTS,
+            base_delay=_RETRY_BASE_SECONDS,
+            max_delay=_RETRY_MAX_SECONDS,
+            name='agent_client')
+        # Process-wide breaker shared by every client to this host.
+        self.breaker = policy_lib.breaker_for(
+            self._target, failure_threshold=_BREAKER_FAILURES,
+            recovery_timeout=_BREAKER_RECOVERY_SECONDS)
 
     # -- http helpers ---------------------------------------------------
 
@@ -64,25 +98,108 @@ class AgentClient:
             headers['X-Skytpu-Token'] = self.token
         return headers
 
+    def _open(self, req: urllib.request.Request, timeout: float,
+              path: str):
+        """One raw HTTP round trip, with the fault-injection hook and
+        the explicit-timeout satellite: a timeout must name WHICH
+        host and endpoint died, not surface as a bare URLError."""
+        site = _FAULT_SITES.get(path)
+        if site is not None:
+            kind = faults.fire(site)
+            if kind == 'timeout':
+                raise urllib.error.URLError(
+                    f'[fault:{site}] {req.get_method()} '
+                    f'http://{self._target}{path} timed out after '
+                    f'{timeout}s (injected)')
+            if kind is not None:
+                raise urllib.error.URLError(
+                    f'[fault:{site}] injected {kind}')
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except TimeoutError as e:
+            raise urllib.error.URLError(
+                f'{req.get_method()} http://{self._target}{path} '
+                f'timed out after {timeout}s') from e
+        except urllib.error.URLError as e:
+            if isinstance(e, urllib.error.HTTPError):
+                raise
+            if isinstance(getattr(e, 'reason', None), TimeoutError):
+                raise urllib.error.URLError(
+                    f'{req.get_method()} http://{self._target}{path} '
+                    f'timed out after {timeout}s') from e
+            raise
+
+    def _call(self, make_request: Callable[[], Any],
+              retry: bool = True):
+        """Run one RPC through the breaker (+retries).
+
+        ``retry=False`` is the liveness-poll fast path
+        (``wait_healthy``): it skips the breaker GATE (an explicit
+        wait for recovery must not be throttled by fail-fast) and the
+        inner retries (the outer loop IS the retry), but still
+        REPORTS outcomes so the breaker tracks reality."""
+        def attempt(gated: bool):
+            if gated and not self.breaker.allow():
+                raise policy_lib.CircuitOpenError(
+                    f'circuit open for agent {self._target} after '
+                    f'{self.breaker.consecutive_failures} consecutive '
+                    'failures')
+            try:
+                out = make_request()
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    # The host answered; it just didn't like us.
+                    self.breaker.record_success()
+                else:
+                    self.breaker.record_failure()
+                raise
+            except (urllib.error.URLError, OSError):
+                self.breaker.record_failure()
+                raise
+            except Exception:
+                # Non-transport failure (garbage 200 body failing
+                # json.loads, truncated status line): the host
+                # answered but answered broken — record it, or a
+                # HALF_OPEN probe hitting this path would leave the
+                # breaker wedged half-open forever.
+                self.breaker.record_failure()
+                raise
+            self.breaker.record_success()
+            return out
+
+        if not retry:
+            return attempt(gated=False)
+        return self.retry_policy.call(attempt, True)
+
     def _get(self, path: str, params: Optional[Dict[str, Any]] = None,
-             raw: bool = False, timeout: Optional[float] = None):
+             raw: bool = False, timeout: Optional[float] = None,
+             retry: bool = True):
         url = self._base + path
         if params:
             url += '?' + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url, headers=self._headers())
-        with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout) as resp:
-            data = resp.read()
-        return data if raw else json.loads(data)
+
+        def do():
+            req = urllib.request.Request(url,
+                                         headers=self._headers())
+            with self._open(req, timeout or self.timeout,
+                            path) as resp:
+                data = resp.read()
+            return data if raw else json.loads(data)
+
+        return self._call(do, retry=retry)
 
     def _post(self, path: str, body: Dict[str, Any],
-              timeout: Optional[float] = None):
-        req = urllib.request.Request(
-            self._base + path, data=json.dumps(body).encode(),
-            headers=self._headers())
-        with urllib.request.urlopen(
-                req, timeout=timeout or self.timeout) as resp:
-            return json.loads(resp.read())
+              timeout: Optional[float] = None, retry: bool = True):
+
+        def do():
+            req = urllib.request.Request(
+                self._base + path, data=json.dumps(body).encode(),
+                headers=self._headers())
+            with self._open(req, timeout or self.timeout,
+                            path) as resp:
+                return json.loads(resp.read())
+
+        return self._call(do, retry=retry)
 
     # -- API ------------------------------------------------------------
 
@@ -103,19 +220,31 @@ class AgentClient:
         except (urllib.error.URLError, OSError, ValueError):
             return None
 
-    def is_healthy(self) -> bool:
+    def is_healthy(self, fast: bool = False) -> bool:
+        """``fast=True``: single un-retried, un-gated probe — the
+        building block for outer poll loops (``wait_healthy``, the
+        watchdog supplies its own consecutive-failure tolerance)."""
         try:
-            return bool(self.health().get('ok'))
+            return bool(
+                self._get('/health', retry=not fast).get('ok'))
         except (urllib.error.URLError, OSError, ValueError):
             return False
 
     def wait_healthy(self, timeout: float = 60.0,
-                     interval: float = 0.25) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if self.is_healthy():
+                     interval: float = 0.25,
+                     clock: Callable[[], float] = time.monotonic,
+                     sleeper: Callable[[float], None] = time.sleep
+                     ) -> None:
+        """Poll until healthy. Deadline arithmetic is MONOTONIC: a
+        wall-clock jump (NTP step, VM migration) must neither
+        spuriously expire nor extend the wait."""
+        deadline = clock() + timeout
+        while True:
+            if self.is_healthy(fast=True):
                 return
-            time.sleep(interval)
+            if clock() >= deadline:
+                break
+            sleeper(interval)
         raise exceptions.FetchClusterInfoError(
             f'agent {self.host}:{self.port} not healthy after '
             f'{timeout}s')

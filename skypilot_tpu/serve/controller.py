@@ -9,6 +9,7 @@ import threading
 import time
 
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.resilience import watchdog as watchdog_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import (AutoscalerDecisionOperator,
                                             make_autoscaler)
@@ -43,6 +44,13 @@ class SkyServeController:
         self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
         self.version = 1
         self._stop = threading.Event()
+        # Set by the watchdog to short-circuit the sync interval: a
+        # replica whose host agent died gets re-probed (and demoted/
+        # replaced) NOW, not up to a full tick later.
+        self._tick_now = threading.Event()
+        self.watchdog = watchdog_lib.HealthWatchdog(
+            name=f'serve-{service_name}-watchdog')
+        self.watchdog.on_unhealthy(self._on_replica_unhealthy)
 
     def start(self) -> None:
         serve_state.set_service_status(self.service_name,
@@ -57,6 +65,8 @@ class SkyServeController:
             serve_state.set_service_endpoint(
                 self.service_name,
                 f'{scheme}://127.0.0.1:{self.load_balancer.port}')
+        if watchdog_lib.enabled():
+            self.watchdog.start()
         # Initial provisioning is the first tick's generate_ops
         # (shortfall from zero replicas) — an eager scale_up here
         # would bypass the fallback autoscalers' spot/on-demand mix
@@ -65,6 +75,49 @@ class SkyServeController:
 
     def stop(self) -> None:
         self._stop.set()
+        self._tick_now.set()
+
+    # -- watchdog -------------------------------------------------------
+
+    def _on_replica_unhealthy(self, target: str,
+                              failures: int) -> None:
+        """Watchdog verdict: the replica's host agent is dark. Mark
+        it suspect (next failed readiness probe demotes immediately)
+        and pull the next control tick forward."""
+        try:
+            rid = int(target.rsplit('-', 1)[-1])
+        except ValueError:
+            return
+        logger.warning(
+            'Watchdog: replica %d host agent unhealthy (%d '
+            'consecutive failures); probing now.', rid, failures)
+        self.replica_manager.mark_suspect(rid)
+        self._tick_now.set()
+
+    def _sync_watchdog_targets(self, records) -> None:
+        """Keep watchdog targets == live replicas with endpoints."""
+        want = {}
+        for rec in records:
+            if rec['status'] not in (ReplicaStatus.READY,
+                                     ReplicaStatus.NOT_READY):
+                continue
+            cluster_name = rec['cluster_name']
+
+            def probe(name=cluster_name) -> bool:
+                from skypilot_tpu import state as state_lib
+                crec = state_lib.get_cluster_from_name(name)
+                if crec is None:
+                    return False
+                return crec['handle'].head_agent().is_healthy(
+                    fast=True)
+
+            want[f'replica-{rec["replica_id"]}'] = probe
+        have = set(self.watchdog.targets())
+        for target in have - set(want):
+            self.watchdog.remove_target(target)
+        for target, probe in want.items():
+            if target not in have:
+                self.watchdog.add_target(target, probe)
 
     def _check_for_update(self) -> None:
         """Pick up a rolling-update request (serve.core.update bumps
@@ -118,6 +171,7 @@ class SkyServeController:
             return
         self._check_for_update()
         records = self.replica_manager.probe_all()
+        self._sync_watchdog_targets(records)
         old_alive = [r for r in records
                      if r['version'] < self.version and
                      not r['status'].is_terminal() and
@@ -190,8 +244,12 @@ class SkyServeController:
                 self.run_once()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('controller tick failed')
-            self._stop.wait(CONTROLLER_SYNC_INTERVAL)
+            # Interruptible gap: the watchdog (or stop()) pulls the
+            # next tick forward by setting _tick_now.
+            self._tick_now.wait(CONTROLLER_SYNC_INTERVAL)
+            self._tick_now.clear()
         # Shutdown: terminate replicas + LB.
+        self.watchdog.stop()
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.SHUTTING_DOWN)
         self.replica_manager.terminate_all()

@@ -222,8 +222,8 @@ def up(task: Task, service_name: Optional[str] = None,
                 controller_job_id, endpoint)
 
     from skypilot_tpu import core as core_lib
-    deadline = time.time() + wait_ready_timeout
-    while time.time() < deadline:
+    deadline = time.monotonic() + wait_ready_timeout
+    while time.monotonic() < deadline:
         rec = _get_service(handle, service_name)
         if rec is not None and rec['status'] == ServiceStatus.READY:
             logger.info('Service %s READY at %s', service_name,
@@ -312,10 +312,10 @@ def down(service_name: str, timeout: float = 120.0) -> None:
     _rpc(handle, serve_codegen.request_down(
         handle.head_runtime_dir, service_name))
     from skypilot_tpu import core as core_lib
-    deadline = time.time() + timeout
+    deadline = time.monotonic() + timeout
     controller_cluster = rec['controller_cluster']
     controller_job_id = rec['controller_job_id']
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         cur = _get_service(handle, service_name)
         if cur is None or cur['status'] == ServiceStatus.DOWN:
             break

@@ -27,6 +27,11 @@ logger = tpu_logging.init_logger(__name__)
 # Trailing window for the MEASURED QPS the autoscaler consumes.
 QPS_WINDOW_SECONDS = 60.0
 
+# Idempotent (GET) requests that die at one replica are retried on an
+# alternate READY replica before the client sees an error — bounded
+# total attempts so a fully-dark fleet still fails fast.
+MAX_PROXY_ATTEMPTS = 3
+
 
 class LoadBalancingPolicy:
 
@@ -140,6 +145,11 @@ class SkyServeLoadBalancer:
         self._m_no_replica = reg.counter(
             'skytpu_lb_no_ready_replica_total',
             'Requests refused because no replica was ready.')
+        self._m_failover = reg.counter(
+            'skytpu_lb_request_failovers_total',
+            'Idempotent requests retried on an alternate replica '
+            'after a replica fault (labeled by the FAILED replica).',
+            ('endpoint',))
         self._qps_window = metrics_lib.WindowedRate(QPS_WINDOW_SECONDS)
 
     def measured_qps(self) -> float:
@@ -202,85 +212,132 @@ class SkyServeLoadBalancer:
                     return
                 length = int(self.headers.get('Content-Length', '0'))
                 data = self.rfile.read(length) if length else None
-                url = endpoint.rstrip('/') + self.path
-                req = urllib.request.Request(url, data=data,
-                                             method=method)
-                for k, v in self.headers.items():
-                    if k.lower() not in self._HOP_BY_HOP:
-                        req.add_header(k, v)
-                lb.policy.on_request_start(endpoint)
                 self._headers_sent = False
                 self._resp_status: Optional[int] = None
-                try:
+                tried = set()
+                while True:
+                    # `current` pins this attempt's replica for the
+                    # in-flight + latency accounting below;
+                    # `endpoint` is reassigned on failover.
+                    current = endpoint
+                    t_attempt = time.time()
+                    url = current.rstrip('/') + self.path
+                    req = urllib.request.Request(url, data=data,
+                                                 method=method)
+                    for k, v in self.headers.items():
+                        if k.lower() not in self._HOP_BY_HOP:
+                            req.add_header(k, v)
+                    lb.policy.on_request_start(current)
                     try:
-                        with urllib.request.urlopen(
-                                req, timeout=120) as resp:
-                            self._stream_response(resp)
-                    except urllib.error.HTTPError as he:
-                        # A replica's own 4xx/5xx is a RESPONSE, not
-                        # a proxy failure: stream it through verbatim
-                        # (it carries status/headers/body) so the
-                        # client sees the replica's real answer and
-                        # the metrics record its real code — NOT a
-                        # synthesized 502 or a replica_error count
-                        # for a healthy replica serving 404s.
-                        with he:
-                            self._stream_response(he)
-                    lb._m_requests.labels(  # pylint: disable=protected-access
-                        endpoint=endpoint,
-                        code=str(self._resp_status)).inc()
-                except (urllib.error.URLError, OSError) as e:
-                    # Attribution: URLError (incl. HTTP-layer errors
-                    # from urlopen) is the REPLICA's fault; a bare
-                    # OSError here came from OUR sockets — usually
-                    # the client hanging up — and must not climb the
-                    # replica's error series (an operator watching
-                    # per-endpoint errors would recycle a healthy
-                    # replica whenever clients are impatient).
-                    replica_fault = isinstance(e,
-                                               urllib.error.URLError)
-                    if self._headers_sent:
-                        # Mid-stream failure: the status line is long
-                        # gone — writing a 502 now would inject a
-                        # second status line into the chunked body.
-                        # Abort the connection so the client sees a
-                        # truncated (invalid) stream, not garbage.
-                        logger.warning('replica stream aborted: %s', e)
-                        lb._m_errors.labels(  # pylint: disable=protected-access
-                            endpoint=endpoint,
-                            kind='stream_abort' if replica_fault
-                            else 'client_abort').inc()
-                        self.close_connection = True
                         try:
-                            self.wfile.flush()
-                            self.connection.close()
-                        except OSError:
-                            pass
-                        return
-                    if replica_fault:
-                        lb._m_errors.labels(  # pylint: disable=protected-access
-                            endpoint=endpoint,
-                            kind='replica_error').inc()
+                            with urllib.request.urlopen(
+                                    req, timeout=120) as resp:
+                                self._stream_response(resp)
+                        except urllib.error.HTTPError as he:
+                            # A replica's own 4xx/5xx is a
+                            # RESPONSE, not a proxy failure:
+                            # stream it through verbatim (it
+                            # carries status/headers/body) so the
+                            # client sees the replica's real
+                            # answer and the metrics record its
+                            # real code — NOT a synthesized 502
+                            # or a replica_error count for a
+                            # healthy replica serving 404s.
+                            with he:
+                                self._stream_response(he)
                         lb._m_requests.labels(  # pylint: disable=protected-access
-                            endpoint=endpoint, code='502').inc()
-                    else:
-                        lb._m_errors.labels(  # pylint: disable=protected-access
-                            endpoint=endpoint,
-                            kind='client_abort').inc()
-                    body = f'Replica error: {e}'.encode()
-                    try:
-                        self.send_response(502)
-                        self.send_header('Content-Length',
-                                         str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    except OSError:
-                        pass  # client already gone
-                finally:
-                    lb.policy.on_request_end(endpoint)
-                    lb._m_latency.labels(  # pylint: disable=protected-access
-                        endpoint=endpoint).observe(
-                            time.time() - t_start)
+                            endpoint=current,
+                            code=str(self._resp_status)).inc()
+                        return
+                    except (urllib.error.URLError, OSError) as e:
+                        # Attribution: URLError (incl. HTTP-layer
+                        # errors from urlopen) is the REPLICA's
+                        # fault; a bare OSError here came from
+                        # OUR sockets — usually the client
+                        # hanging up — and must not climb the
+                        # replica's error series (an operator
+                        # watching per-endpoint errors would
+                        # recycle a healthy replica whenever
+                        # clients are impatient).
+                        replica_fault = isinstance(
+                            e, urllib.error.URLError)
+                        if self._headers_sent:
+                            # Mid-stream failure: the status line
+                            # is long gone — writing a 502 now
+                            # would inject a second status line
+                            # into the chunked body. Abort the
+                            # connection so the client sees a
+                            # truncated (invalid) stream, not
+                            # garbage.
+                            logger.warning(
+                                'replica stream aborted: %s', e)
+                            lb._m_errors.labels(  # pylint: disable=protected-access
+                                endpoint=current,
+                                kind='stream_abort'
+                                if replica_fault
+                                else 'client_abort').inc()
+                            self.close_connection = True
+                            try:
+                                self.wfile.flush()
+                                self.connection.close()
+                            except OSError:
+                                pass
+                            return
+                        if replica_fault:
+                            lb._m_errors.labels(  # pylint: disable=protected-access
+                                endpoint=current,
+                                kind='replica_error').inc()
+                            # Idempotent request + nothing sent
+                            # yet: fail over to an alternate
+                            # READY replica instead of surfacing
+                            # one replica's death to the client.
+                            if method == 'GET' and \
+                                    len(tried) + 1 < \
+                                    MAX_PROXY_ATTEMPTS:
+                                tried.add(current)
+                                remaining = [
+                                    ep for ep in
+                                    lb.get_ready_endpoints()
+                                    if ep not in tried
+                                ]
+                                alt = (lb.policy.select(remaining)
+                                       if remaining else None)
+                                if alt is not None:
+                                    lb._m_failover.labels(  # pylint: disable=protected-access
+                                        endpoint=current).inc()
+                                    logger.warning(
+                                        'replica %s failed (%s);'
+                                        ' retrying GET on %s',
+                                        current, e, alt)
+                                    endpoint = alt
+                                    continue
+                            lb._m_requests.labels(  # pylint: disable=protected-access
+                                endpoint=current,
+                                code='502').inc()
+                        else:
+                            lb._m_errors.labels(  # pylint: disable=protected-access
+                                endpoint=current,
+                                kind='client_abort').inc()
+                        body = f'Replica error: {e}'.encode()
+                        try:
+                            self.send_response(502)
+                            self.send_header('Content-Length',
+                                             str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                        except OSError:
+                            pass  # client already gone
+                        return
+                    finally:
+                        lb.policy.on_request_end(current)
+                        # Latency is PER ATTEMPT, labeled by the
+                        # replica that served (or burned) it — a
+                        # failover must not charge the dead
+                        # replica's timeout to the healthy one
+                        # that answered.
+                        lb._m_latency.labels(  # pylint: disable=protected-access
+                            endpoint=current).observe(
+                                time.time() - t_attempt)
 
             def _stream_response(self, resp) -> None:
                 """Chunk-by-chunk pass-through so token streaming

@@ -3,22 +3,53 @@
 Launches/terminates replica clusters (each replica is an ordinary
 cluster running the service task), probes readiness over HTTP, and
 recovers preempted replicas.
+
+Health semantics are CONSECUTIVE-THRESHOLD (docs/resilience.md): a
+READY replica survives up to ``SKYTPU_SERVE_DEMOTE_AFTER - 1``
+straight failed probes (one flaky probe must not flap a serving
+replica out of the LB), and a recovering replica needs
+``SKYTPU_SERVE_PROMOTE_AFTER`` straight successes to (re)enter the
+ready set. ``probe_all`` probes replicas CONCURRENTLY with a bounded
+pool, so one slow replica cannot stretch the whole control tick by
+its probe timeout.
 """
+import concurrent.futures
+import http.client
+import os
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, execution, state
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.task import Task
 
 logger = tpu_logging.init_logger(__name__)
+
+
+def _demote_after() -> int:
+    """Consecutive failed probes before READY demotes (and before a
+    past-grace STARTING/NOT_READY replica is declared FAILED)."""
+    return max(1, int(os.environ.get('SKYTPU_SERVE_DEMOTE_AFTER',
+                                     '3')))
+
+
+def _promote_after() -> int:
+    """Consecutive successful probes before a replica is READY."""
+    return max(1, int(os.environ.get('SKYTPU_SERVE_PROMOTE_AFTER',
+                                     '1')))
+
+
+def _probe_parallelism() -> int:
+    return max(1, int(os.environ.get(
+        'SKYTPU_SERVE_PROBE_PARALLELISM', '8')))
 
 
 class ReplicaManager:
@@ -36,6 +67,12 @@ class ReplicaManager:
         self._next_replica_id = 1
         self._lock = threading.Lock()
         self._launch_threads: Dict[int, threading.Thread] = {}
+        # Consecutive probe outcome counters + watchdog suspicion
+        # (suspect replicas skip the demote tolerance: the watchdog
+        # already saw sustained agent death there).
+        self._fail_counts: Dict[int, int] = {}
+        self._ok_counts: Dict[int, int] = {}
+        self._suspect: Set[int] = set()
         # Local-provider port allocation: each replica gets its own
         # service port (one machine hosts all fake replicas).
         from skypilot_tpu import clouds
@@ -153,6 +190,7 @@ class ReplicaManager:
 
     def scale_down(self, replica_ids: List[int]) -> None:
         for replica_id in replica_ids:
+            self._forget_counters(replica_id)
             serve_state.set_replica_status(self.service_name,
                                            replica_id,
                                            ReplicaStatus.SHUTTING_DOWN)
@@ -169,8 +207,22 @@ class ReplicaManager:
 
     # -- probing --------------------------------------------------------
 
+    def mark_suspect(self, replica_id: int) -> None:
+        """Watchdog hook: sustained agent death was observed at this
+        replica's cluster — the next failed readiness probe demotes
+        it immediately instead of waiting out the consecutive-failure
+        tolerance."""
+        self._suspect.add(replica_id)
+
+    def _forget_counters(self, replica_id: int) -> None:
+        self._fail_counts.pop(replica_id, None)
+        self._ok_counts.pop(replica_id, None)
+        self._suspect.discard(replica_id)
+
     def probe(self, endpoint: str,
               spec: Optional[SkyServiceSpec] = None) -> bool:
+        if faults.fire('serve.probe') is not None:
+            return False  # any injected kind == failed probe
         spec = spec or self.spec
         url = endpoint.rstrip('/') + spec.readiness_path
         try:
@@ -178,13 +230,21 @@ class ReplicaManager:
                     url,
                     timeout=spec.readiness_timeout_seconds) as r:
                 return 200 <= r.status < 300
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError,
+                http.client.HTTPException):
+            # HTTPException: a misbehaving replica can emit a
+            # truncated/garbage status line, which surfaces as e.g.
+            # BadStatusLine — NOT an OSError. One malformed response
+            # must read as a failed probe, not crash the controller's
+            # probe loop.
             return False
 
     def probe_all(self) -> List[Dict]:
         """Probe every non-terminal replica; update statuses; detect
-        preemption (cluster gone) and relaunch."""
+        preemption (cluster gone) and relaunch. Probes run
+        concurrently (bounded pool); state updates stay serial."""
         records = serve_state.get_replicas(self.service_name)
+        candidates = []
         for rec in records:
             rid = rec['replica_id']
             if rec['status'] in (ReplicaStatus.PROVISIONING,
@@ -201,49 +261,101 @@ class ReplicaManager:
                 # with on-demand instead of like-for-like).
                 logger.warning('Replica %d cluster gone (preempted)',
                                rid)
+                self._forget_counters(rid)
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.PREEMPTED)
                 serve_state.remove_replica(self.service_name, rid)
                 continue
             spec = self._version_specs.get(rec['version'],
                                            self.spec)
-            ready = rec['endpoint'] is not None and \
-                self.probe(rec['endpoint'], spec)
-            if ready:
-                if rec['status'] != ReplicaStatus.READY:
-                    logger.info('Replica %d READY at %s', rid,
-                                rec['endpoint'])
+            candidates.append((rec, spec))
+
+        results: Dict[int, bool] = {}
+        if len(candidates) == 1:
+            rec, spec = candidates[0]
+            results[rec['replica_id']] = (
+                rec['endpoint'] is not None and
+                self.probe(rec['endpoint'], spec))
+        elif candidates:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(len(candidates),
+                                    _probe_parallelism()),
+                    thread_name_prefix='probe') as pool:
+                futs = {
+                    rec['replica_id']: pool.submit(
+                        self.probe, rec['endpoint'], spec)
+                    for rec, spec in candidates
+                    if rec['endpoint'] is not None
+                }
+                for rec, _ in candidates:
+                    fut = futs.get(rec['replica_id'])
+                    results[rec['replica_id']] = (
+                        bool(fut.result()) if fut is not None
+                        else False)
+
+        for rec, spec in candidates:
+            self._account_probe(rec, spec,
+                                results[rec['replica_id']])
+        return serve_state.get_replicas(self.service_name)
+
+    def _account_probe(self, rec: Dict, spec: SkyServiceSpec,
+                       ready: bool) -> None:
+        rid = rec['replica_id']
+        if ready:
+            self._fail_counts.pop(rid, None)
+            self._suspect.discard(rid)
+            if rec['status'] == ReplicaStatus.READY:
+                return
+            oks = self._ok_counts.get(rid, 0) + 1
+            if oks >= _promote_after():
+                self._ok_counts.pop(rid, None)
+                logger.info('Replica %d READY at %s', rid,
+                            rec['endpoint'])
                 serve_state.set_replica_status(self.service_name, rid,
                                                ReplicaStatus.READY)
             else:
-                grace = time.time() - (rec['launched_at'] or 0) < \
-                    spec.initial_delay_seconds
-                if rec['status'] == ReplicaStatus.READY:
-                    serve_state.set_replica_status(
-                        self.service_name, rid, ReplicaStatus.NOT_READY)
-                elif not grace and rec['status'] in (
-                        ReplicaStatus.STARTING,
-                        ReplicaStatus.NOT_READY):
-                    logger.warning(
-                        'Replica %d failed readiness after initial '
-                        'delay', rid)
-                    serve_state.set_replica_status(
-                        self.service_name, rid, ReplicaStatus.FAILED)
-                    # Tear the cluster down NOW: a failed replica's
-                    # task processes otherwise keep running (and keep
-                    # its port bound, so the replacement replica can
-                    # collide). The FAILED record stays for status
-                    # reporting (ref replica_managers.py:225
-                    # ReplicaStatusProperty — failed replicas are
-                    # terminated, their status preserved).
-                    try:
-                        core_lib.down(self._cluster_name(rid),
-                                      purge=True)
-                    except exceptions.SkyTpuError as e:
-                        logger.warning(
-                            'Teardown of failed replica %d: %s',
-                            rid, e)
-        return serve_state.get_replicas(self.service_name)
+                self._ok_counts[rid] = oks
+            return
+        self._ok_counts.pop(rid, None)
+        fails = self._fail_counts.get(rid, 0) + 1
+        self._fail_counts[rid] = fails
+        suspect = rid in self._suspect
+        threshold_hit = suspect or fails >= _demote_after()
+        grace = time.time() - (rec['launched_at'] or 0) < \
+            spec.initial_delay_seconds
+        if rec['status'] == ReplicaStatus.READY:
+            if threshold_hit:
+                logger.warning(
+                    'Replica %d demoted after %d consecutive failed '
+                    'probe(s)%s', rid, fails,
+                    ' (watchdog suspect)' if suspect else '')
+                self._suspect.discard(rid)
+                serve_state.set_replica_status(
+                    self.service_name, rid, ReplicaStatus.NOT_READY)
+            else:
+                logger.debug(
+                    'Replica %d failed probe %d/%d; still READY',
+                    rid, fails, _demote_after())
+        elif not grace and rec['status'] in (
+                ReplicaStatus.STARTING, ReplicaStatus.NOT_READY) and \
+                threshold_hit:
+            logger.warning(
+                'Replica %d failed readiness after initial delay',
+                rid)
+            self._forget_counters(rid)
+            serve_state.set_replica_status(
+                self.service_name, rid, ReplicaStatus.FAILED)
+            # Tear the cluster down NOW: a failed replica's task
+            # processes otherwise keep running (and keep its port
+            # bound, so the replacement replica can collide). The
+            # FAILED record stays for status reporting (ref
+            # replica_managers.py:225 ReplicaStatusProperty — failed
+            # replicas are terminated, their status preserved).
+            try:
+                core_lib.down(self._cluster_name(rid), purge=True)
+            except exceptions.SkyTpuError as e:
+                logger.warning('Teardown of failed replica %d: %s',
+                               rid, e)
 
     def ready_endpoints(self) -> List[str]:
         return [

@@ -9,7 +9,6 @@ import os
 import random
 import re
 import socket
-import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
@@ -116,14 +115,13 @@ class Backoff:
 
 def retry(fn: Callable, max_retries: int = 3,
           initial_backoff: float = 1.0) -> Any:
-    backoff = Backoff(initial_backoff)
-    for attempt in range(max_retries):
-        try:
-            return fn()
-        except Exception:  # pylint: disable=broad-except
-            if attempt == max_retries - 1:
-                raise
-            time.sleep(backoff.current_backoff())
+    """Retry-anything helper, delegating to the shared RetryPolicy
+    (resilience/policy.py) so backoff semantics live in one place."""
+    from skypilot_tpu.resilience import policy as policy_lib
+    return policy_lib.RetryPolicy(
+        max_attempts=max_retries, base_delay=initial_backoff,
+        max_delay=initial_backoff * Backoff.MULTIPLIER ** 4,
+        retryable=lambda e: True, name='common_retry').call(fn)
 
 
 def dump_yaml_str(config: Any) -> str:

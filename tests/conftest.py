@@ -53,7 +53,9 @@ def pytest_collection_modifyitems(config, items):
 def _isolated_state(tmp_path, monkeypatch, request):
     """Every test gets a fresh state dir / config — except the
     real-cloud smoke tier, which must see the operator's own gcloud
-    config and state."""
+    config and state. Resilience globals (per-host circuit breakers,
+    the fault-injection registry) are process-wide by design, so
+    they're reset here too."""
     if 'gcp' in request.keywords:
         yield
         return
@@ -61,6 +63,24 @@ def _isolated_state(tmp_path, monkeypatch, request):
     monkeypatch.setenv('SKYTPU_CONFIG', str(tmp_path / 'config.yaml'))
     monkeypatch.setenv('SKYTPU_USER_HASH', 'deadbeef')
     from skypilot_tpu import config as config_lib
+    from skypilot_tpu.resilience import faults as faults_lib
+    from skypilot_tpu.resilience import policy as policy_lib
     config_lib.reload_config()
+    policy_lib.reset_breakers()
+    faults_lib.reset()
     yield
     config_lib.reload_config()
+    policy_lib.reset_breakers()
+    faults_lib.reset()
+
+
+@pytest.fixture
+def faults():
+    """Deterministic fault injection (docs/resilience.md): arm with
+    ``faults.arm(site, kind, rate, count)``; seeded RNG so outcomes
+    are reproducible. Reset around each test by ``_isolated_state``;
+    this fixture just hands the module out with a fixed seed."""
+    from skypilot_tpu.resilience import faults as faults_lib
+    faults_lib.reset(seed=0)
+    yield faults_lib
+    faults_lib.reset()

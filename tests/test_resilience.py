@@ -1,0 +1,1187 @@
+"""Resilience subsystem: retry policy, circuit breakers,
+deterministic fault injection, health watchdog — and the wired-up
+recovery paths (agent client, recovery strategies, replica health,
+LB failover, managed-job preemption e2e).
+
+No test here takes a real retry sleep: policies get a recording
+sleeper, breakers/watchdogs get fake clocks, and faults are seeded.
+"""
+import http.client
+import io
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resilience import faults as faults_mod
+from skypilot_tpu.resilience import policy as policy_lib
+from skypilot_tpu.resilience import watchdog as watchdog_lib
+from skypilot_tpu.resilience.policy import (CircuitBreaker,
+                                            CircuitOpenError,
+                                            CircuitState, RetryPolicy)
+
+
+class FakeClock:
+
+    def __init__(self, start: float = 0.0):
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+# ---------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+
+    def test_retries_then_succeeds_no_real_sleep(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, base_delay=0.5,
+                             sleeper=sleeps.append)
+        calls = {'n': 0}
+
+        def flaky():
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise ConnectionResetError('flake')
+            return 'ok'
+
+        assert policy.call(flaky) == 'ok'
+        assert calls['n'] == 3
+        assert len(sleeps) == 2
+
+    def test_attempts_exhausted_raises_last(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=3, sleeper=sleeps.append)
+
+        def dead():
+            raise ConnectionResetError('always')
+
+        with pytest.raises(ConnectionResetError):
+            policy.call(dead)
+        assert len(sleeps) == 2  # max_attempts-1 backoffs
+
+    def test_non_retryable_raises_immediately(self):
+        sleeps = []
+        policy = RetryPolicy(max_attempts=5, sleeper=sleeps.append)
+
+        def bad():
+            raise ValueError('logic bug, not a flake')
+
+        with pytest.raises(ValueError):
+            policy.call(bad)
+        assert sleeps == []
+
+    def test_backoff_exponential_with_full_jitter(self):
+        import random
+        policy = RetryPolicy(base_delay=1.0, max_delay=8.0,
+                             rng=random.Random(7))
+        for attempt, cap in ((0, 1.0), (1, 2.0), (2, 4.0), (3, 8.0),
+                             (4, 8.0), (10, 8.0)):
+            for _ in range(20):
+                delay = policy.delay_for(attempt)
+                assert 0.0 <= delay <= cap
+
+    def test_no_jitter_is_deterministic(self):
+        policy = RetryPolicy(base_delay=1.0, max_delay=8.0,
+                             jitter=False)
+        assert [policy.delay_for(a) for a in range(5)] == \
+            [1.0, 2.0, 4.0, 8.0, 8.0]
+
+    def test_deadline_stops_retrying(self):
+        clock = FakeClock()
+        sleeps = []
+
+        def sleeper(s):
+            sleeps.append(s)
+            clock.advance(s)
+
+        policy = RetryPolicy(max_attempts=100, base_delay=4.0,
+                             jitter=False, deadline=10.0,
+                             sleeper=sleeper, clock=clock)
+
+        def dead():
+            raise TimeoutError('slow')
+
+        with pytest.raises(TimeoutError):
+            policy.call(dead)
+        # 4 + 8 = 12 > 10: second backoff would overrun the deadline.
+        assert sleeps == [4.0]
+
+    def test_classification_http(self):
+        policy = RetryPolicy()
+        err_500 = urllib.error.HTTPError('u', 503, 'oops', {}, None)
+        err_404 = urllib.error.HTTPError('u', 404, 'nope', {}, None)
+        assert policy.is_retryable(err_500)
+        assert not policy.is_retryable(err_404)
+        assert policy.is_retryable(urllib.error.URLError('reset'))
+        assert policy.is_retryable(TimeoutError())
+        assert not policy.is_retryable(CircuitOpenError('open'))
+        assert not policy.is_retryable(KeyError('x'))
+
+    def test_retryable_as_tuple(self):
+        policy = RetryPolicy(max_attempts=2, retryable=(KeyError,),
+                             sleeper=lambda s: None)
+        calls = {'n': 0}
+
+        def once():
+            calls['n'] += 1
+            if calls['n'] == 1:
+                raise KeyError('retry me')
+            return 1
+
+        assert policy.call(once) == 1
+
+
+# ---------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+
+    def test_trips_after_threshold_and_fails_fast(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(target='t1', failure_threshold=3,
+                                 recovery_timeout=5.0, clock=clock)
+        for _ in range(2):
+            assert breaker.allow()
+            breaker.record_failure()
+        assert breaker.state == CircuitState.CLOSED
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == CircuitState.OPEN
+        assert not breaker.allow()  # fail fast, no timeout burned
+
+    def test_half_open_probe_success_closes(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(target='t2', failure_threshold=1,
+                                 recovery_timeout=5.0, clock=clock)
+        breaker.record_failure()
+        assert breaker.state == CircuitState.OPEN
+        clock.advance(5.0)
+        assert breaker.allow()  # this caller is THE probe
+        assert breaker.state == CircuitState.HALF_OPEN
+        assert not breaker.allow()  # others rejected meanwhile
+        breaker.record_success()
+        assert breaker.state == CircuitState.CLOSED
+        assert breaker.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        clock = FakeClock()
+        breaker = CircuitBreaker(target='t3', failure_threshold=1,
+                                 recovery_timeout=5.0, clock=clock)
+        breaker.record_failure()
+        clock.advance(5.0)
+        assert breaker.allow()
+        breaker.record_failure()
+        assert breaker.state == CircuitState.OPEN
+        assert not breaker.allow()  # recovery timer restarted
+        clock.advance(5.0)
+        assert breaker.allow()
+
+    def test_success_resets_consecutive_count(self):
+        breaker = CircuitBreaker(target='t4', failure_threshold=2)
+        breaker.record_failure()
+        breaker.record_success()
+        breaker.record_failure()
+        assert breaker.state == CircuitState.CLOSED
+
+    def test_registry_shares_per_target(self):
+        b1 = policy_lib.breaker_for('host-a:1')
+        b2 = policy_lib.breaker_for('host-a:1')
+        b3 = policy_lib.breaker_for('host-b:1')
+        assert b1 is b2
+        assert b1 is not b3
+
+    def test_state_exported_as_gauge(self):
+        from skypilot_tpu import metrics as metrics_lib
+        breaker = CircuitBreaker(target='gauge-host:9',
+                                 failure_threshold=1)
+        breaker.record_failure()
+        gauge = metrics_lib.registry().gauge(
+            'skytpu_circuit_breaker_state',
+            labelnames=('target',))
+        assert gauge.labels(target='gauge-host:9').value == 2
+        breaker.record_success()
+        assert gauge.labels(target='gauge-host:9').value == 0
+
+
+# ---------------------------------------------------------------------
+# Fault injection
+# ---------------------------------------------------------------------
+
+
+class TestFaults:
+
+    def test_grammar(self):
+        specs = faults_mod.parse_specs(
+            'agent.health:error:0.3,provision.launch:preempt:1.0:1')
+        assert [(s.site, s.kind, s.rate, s.count) for s in specs] == \
+            [('agent.health', 'error', 0.3, None),
+             ('provision.launch', 'preempt', 1.0, 1)]
+
+    @pytest.mark.parametrize('bad', [
+        'nope.site:error:1.0',
+        'agent.health:explode:1.0',
+        'agent.health:error:2.0',
+        'agent.health:error',
+        'agent.health:error:1.0:0',
+        'agent.health:error:notafloat',
+    ])
+    def test_grammar_rejects(self, bad):
+        with pytest.raises(ValueError):
+            faults_mod.parse_specs(bad)
+
+    def test_count_exhaustion(self, faults):
+        faults.arm('jobs.poll', 'error', 1.0, count=2)
+        fired = [faults.fire('jobs.poll') for _ in range(5)]
+        assert fired == ['error', 'error', None, None, None]
+
+    def test_rate_is_seeded_and_reproducible(self, faults):
+        faults.arm('serve.probe', 'error', 0.5)
+        run1 = [faults.fire('serve.probe') for _ in range(30)]
+        faults.reset(seed=0)
+        faults.arm('serve.probe', 'error', 0.5)
+        run2 = [faults.fire('serve.probe') for _ in range(30)]
+        assert run1 == run2
+        assert 'error' in run1 and None in run1  # actually mixes
+
+    def test_unarmed_site_never_fires(self, faults):
+        assert all(faults.fire('agent.run') is None
+                   for _ in range(10))
+
+    def test_env_activation(self, faults, monkeypatch):
+        monkeypatch.setenv('SKYTPU_FAULTS',
+                           'jobs.poll:timeout:1.0:1')
+        faults.reset()
+        assert faults_mod.fire('jobs.poll') == 'timeout'
+        assert faults_mod.fire('jobs.poll') is None
+
+    def test_bad_env_is_ignored_not_fatal(self, faults, monkeypatch):
+        monkeypatch.setenv('SKYTPU_FAULTS', 'garbage')
+        faults.reset()
+        assert faults_mod.fire('jobs.poll') is None
+
+    def test_chaos_file_activation(self, faults, tmp_path,
+                                   monkeypatch):
+        # _isolated_state already points SKYTPU_STATE_DIR at tmp.
+        import os
+        path = faults_mod.chaos_file_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write('agent.run:error:1.0:1\n')
+        faults.reset()
+        assert faults_mod.fire('agent.run') == 'error'
+
+    def test_injections_counted_in_metrics(self, faults):
+        from skypilot_tpu import metrics as metrics_lib
+        counter = metrics_lib.registry().counter(
+            'skytpu_fault_injections_total',
+            labelnames=('site', 'kind'))
+        before = counter.labels(site='agent.run', kind='error').value
+        faults.arm('agent.run', 'error', 1.0, count=3)
+        for _ in range(5):
+            faults.fire('agent.run')
+        after = counter.labels(site='agent.run', kind='error').value
+        assert after - before == 3
+        assert faults.registry().fired_counts()[
+            ('agent.run', 'error')] == 3
+
+
+# ---------------------------------------------------------------------
+# AgentClient: retries, breaker, timeout message, fault absorption
+# ---------------------------------------------------------------------
+
+
+class _FakeResponse:
+
+    def __init__(self, payload):
+        self._data = json.dumps(payload).encode()
+        self.status = 200
+
+    def read(self):
+        return self._data
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def _client(host='127.0.0.1', port=45678):
+    from skypilot_tpu.runtime.agent_client import AgentClient
+    client = AgentClient(host, port, timeout=3.0)
+    sleeps = []
+    client.retry_policy.sleeper = sleeps.append
+    return client, sleeps
+
+
+class TestAgentClientResilience:
+
+    def test_transient_error_retried(self, monkeypatch):
+        client, sleeps = _client()
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise urllib.error.URLError(
+                    ConnectionResetError('reset'))
+            return _FakeResponse({'ok': True, 'version': '3'})
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        assert client.health()['ok'] is True
+        assert calls['n'] == 3
+        assert len(sleeps) == 2  # backoffs recorded, never slept
+
+    def test_4xx_not_retried_and_host_counted_alive(self,
+                                                    monkeypatch):
+        client, sleeps = _client()
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            raise urllib.error.HTTPError(req.full_url, 403,
+                                         'forbidden', {},
+                                         io.BytesIO(b''))
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        with pytest.raises(urllib.error.HTTPError):
+            client._get('/status', {'proc_id': 1})  # pylint: disable=protected-access
+        assert calls['n'] == 1
+        assert sleeps == []
+        # A 403 means the host is UP: breaker must not accumulate.
+        assert client.breaker.consecutive_failures == 0
+
+    def test_timeout_error_names_host_and_path(self, monkeypatch):
+        client, _ = _client(host='10.0.0.7', port=8123)
+
+        def urlopen(req, timeout=None):
+            raise urllib.error.URLError(TimeoutError('timed out'))
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        with pytest.raises(urllib.error.URLError) as err:
+            client._post('/run', {'cmd': 'x'})  # pylint: disable=protected-access
+        msg = str(err.value)
+        assert '10.0.0.7:8123' in msg
+        assert '/run' in msg
+        assert 'timed out after' in msg
+
+    def test_breaker_opens_then_fails_fast(self, monkeypatch):
+        client, _ = _client(port=45680)
+        clock = FakeClock()
+        client.breaker = CircuitBreaker(target='fastfail',
+                                        failure_threshold=2,
+                                        recovery_timeout=10.0,
+                                        clock=clock)
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            raise urllib.error.URLError(ConnectionRefusedError())
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        with pytest.raises((urllib.error.URLError, OSError)):
+            client.metrics()
+        assert client.breaker.state == CircuitState.OPEN
+        n_before = calls['n']
+        # Breaker open: next call fails fast WITHOUT hitting the
+        # network, raising the ConnectionError subclass existing
+        # handlers already catch.
+        with pytest.raises(CircuitOpenError):
+            client.metrics()
+        assert calls['n'] == n_before
+        assert client.is_healthy() is False  # swallowed like OSError
+        # After the recovery window a half-open probe goes through.
+        clock.advance(10.0)
+        monkeypatch.setattr(
+            urllib.request, 'urlopen',
+            lambda req, timeout=None: _FakeResponse({'ok': True}))
+        assert client.is_healthy() is True
+        assert client.breaker.state == CircuitState.CLOSED
+
+    def test_garbage_body_cannot_wedge_half_open(self, monkeypatch):
+        """A HALF_OPEN probe answered with a garbage 200 body (json
+        fails, NOT an OSError) must re-open the breaker, not leave it
+        wedged half-open rejecting every future call."""
+        client, _ = _client(port=45683)
+        clock = FakeClock()
+        client.breaker = CircuitBreaker(target='wedge',
+                                        failure_threshold=1,
+                                        recovery_timeout=5.0,
+                                        clock=clock)
+        monkeypatch.setattr(
+            urllib.request, 'urlopen',
+            lambda req, timeout=None: (_ for _ in ()).throw(
+                urllib.error.URLError('down')))
+        assert client.is_healthy() is False
+        assert client.breaker.state == CircuitState.OPEN
+        clock.advance(5.0)
+
+        class Garbage:
+            status = 200
+
+            def read(self):
+                return b'not-json'
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+        monkeypatch.setattr(urllib.request, 'urlopen',
+                            lambda req, timeout=None: Garbage())
+        assert client.is_healthy() is False
+        assert client.breaker.state == CircuitState.OPEN  # not wedged
+        clock.advance(5.0)
+        monkeypatch.setattr(
+            urllib.request, 'urlopen',
+            lambda req, timeout=None: _FakeResponse({'ok': True}))
+        assert client.is_healthy() is True
+        assert client.breaker.state == CircuitState.CLOSED
+
+    def test_wait_healthy_monotonic_no_real_sleep(self, monkeypatch):
+        client, _ = _client(port=45681)
+        clock = FakeClock()
+        sleeps = []
+
+        def sleeper(s):
+            sleeps.append(s)
+            clock.advance(s)
+
+        monkeypatch.setattr(
+            urllib.request, 'urlopen',
+            lambda req, timeout=None: (_ for _ in ()).throw(
+                urllib.error.URLError(ConnectionRefusedError())))
+        with pytest.raises(exceptions.FetchClusterInfoError):
+            client.wait_healthy(timeout=2.0, interval=0.5,
+                                clock=clock, sleeper=sleeper)
+        assert len(sleeps) == 4
+
+    def test_health_error_faults_absorbed_by_retries(
+            self, monkeypatch, faults):
+        """Acceptance: 30% agent.health:error armed — AgentClient
+        calls still succeed via retries; the watchdog keeps the host
+        healthy (no false demotion below the threshold). Seeded RNG
+        makes the whole run deterministic; no real sleeps."""
+        client, sleeps = _client(port=45682)
+        monkeypatch.setattr(
+            urllib.request, 'urlopen',
+            lambda req, timeout=None: _FakeResponse({'ok': True}))
+        faults.arm('agent.health', 'error', 0.3)
+
+        ok = sum(bool(client.is_healthy()) for _ in range(40))
+        assert ok == 40  # every call succeeded via retries
+        assert len(sleeps) > 0  # retries really happened...
+        injected = faults.registry().fired_counts().get(
+            ('agent.health', 'error'), 0)
+        assert injected > 0
+
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=3,
+                                          name='t-dog')
+        demoted = []
+        dog.on_unhealthy(lambda t, n: demoted.append(t))
+        dog.add_target('host-0', client.is_healthy)
+        for _ in range(25):
+            dog.tick()
+        assert demoted == []  # no false demotions
+        assert not dog.is_unhealthy('host-0')
+
+
+# ---------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------
+
+
+class TestWatchdog:
+
+    def test_threshold_and_single_transition_callback(self):
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=3)
+        health = {'up': True}
+        dog.add_target('h', lambda: health['up'])
+        events = []
+        dog.on_unhealthy(lambda t, n: events.append(('down', t, n)))
+        dog.on_recovered(lambda t: events.append(('up', t)))
+
+        assert dog.tick() == {'h': True}
+        health['up'] = False
+        dog.tick()
+        dog.tick()
+        assert events == []  # below threshold: single-flake tolerant
+        dog.tick()
+        assert events == [('down', 'h', 3)]
+        dog.tick()
+        assert events == [('down', 'h', 3)]  # fired ONCE
+        health['up'] = True
+        dog.tick()
+        assert events[-1] == ('up', 'h')
+        assert dog.consecutive_failures('h') == 0
+
+    def test_flake_resets_consecutive_count(self):
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=2)
+        seq = iter([False, True, False, True])
+        dog.add_target('h', lambda: next(seq))
+        events = []
+        dog.on_unhealthy(lambda t, n: events.append(t))
+        for _ in range(4):
+            dog.tick()
+        assert events == []
+
+    def test_probe_exception_counts_as_failure(self):
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=1)
+
+        def bad_probe():
+            raise RuntimeError('probe crashed')
+
+        dog.add_target('h', bad_probe)
+        events = []
+        dog.on_unhealthy(lambda t, n: events.append(t))
+        assert dog.tick() == {'h': False}
+        assert events == ['h']
+
+    def test_callback_crash_does_not_kill_tick(self):
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=1)
+        dog.add_target('h', lambda: False)
+        dog.on_unhealthy(lambda t, n: (_ for _ in ()).throw(
+            RuntimeError('cb boom')))
+        dog.tick()  # must not raise
+
+    def test_gauges_exported(self):
+        from skypilot_tpu import metrics as metrics_lib
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=2)
+        dog.add_target('g-host', lambda: False)
+        dog.tick()
+        healthy = metrics_lib.registry().gauge(
+            'skytpu_watchdog_target_healthy', labelnames=('target',))
+        fails = metrics_lib.registry().gauge(
+            'skytpu_watchdog_consecutive_failures',
+            labelnames=('target',))
+        assert healthy.labels(target='g-host').value == 1  # < N
+        assert fails.labels(target='g-host').value == 1
+        dog.tick()
+        assert healthy.labels(target='g-host').value == 0
+
+    def test_env_tunables(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_WATCHDOG_INTERVAL_SECONDS', '2.5')
+        monkeypatch.setenv('SKYTPU_WATCHDOG_THRESHOLD', '7')
+        dog = watchdog_lib.HealthWatchdog()
+        assert dog.interval == 2.5
+        assert dog.unhealthy_threshold == 7
+        monkeypatch.setenv('SKYTPU_WATCHDOG_ENABLED', '0')
+        assert not watchdog_lib.enabled()
+
+
+# ---------------------------------------------------------------------
+# Recovery strategies under injected provision faults (satellite)
+# ---------------------------------------------------------------------
+
+
+class TestRecoveryStrategyFaults:
+
+    @pytest.fixture(autouse=True)
+    def _no_sleeps(self, monkeypatch):
+        from skypilot_tpu.jobs import recovery_strategy
+        self.sleeps = []
+        monkeypatch.setattr(
+            recovery_strategy.LAUNCH_RETRY_POLICY, 'sleeper',
+            self.sleeps.append)
+        yield
+
+    def _strategy_env(self, monkeypatch):
+        """Patch the launch/teardown surface under the strategies:
+        record each execution.launch's region, no real clusters."""
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu.jobs import recovery_strategy
+        launched = []
+
+        def fake_launch(task, cluster_name, **kwargs):
+            launched.append(next(iter(task.resources)).region)
+            return len(launched), None
+
+        monkeypatch.setattr(recovery_strategy.execution, 'launch',
+                            fake_launch)
+        monkeypatch.setattr(core_lib, 'down',
+                            lambda name, purge=False: None)
+        return launched
+
+    def _task(self):
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+        task = Task(name='rt', run='echo x')
+        task.set_resources(
+            Resources(cloud='gcp', accelerators='tpu-v5e-8',
+                      use_spot=True))
+        return task
+
+    def test_failover_pins_preempted_region_first(self, monkeypatch,
+                                                  faults):
+        from skypilot_tpu.jobs import recovery_strategy
+        launched = self._strategy_env(monkeypatch)
+        # Exactly ONE injected failure: the pinned same-region
+        # attempt dies, the widened retry must then succeed.
+        faults.arm('provision.launch', 'error', 1.0, count=1)
+        strategy = recovery_strategy.get_strategy('FAILOVER')
+        job_id = strategy.recover(self._task(), 'c1',
+                                  preempted_region='us-central1')
+        assert job_id is not None
+        # The pinned attempt consumed the fault without launching;
+        # the recorded launch is the unpinned fallback.
+        assert launched == [None]
+
+    def test_failover_same_region_when_capacity_back(
+            self, monkeypatch, faults):
+        from skypilot_tpu.jobs import recovery_strategy
+        launched = self._strategy_env(monkeypatch)
+        strategy = recovery_strategy.get_strategy('FAILOVER')
+        job_id = strategy.recover(self._task(), 'c1',
+                                  preempted_region='us-central1')
+        assert job_id is not None
+        assert launched == ['us-central1']  # pinned retry won
+
+    def test_eager_next_region_blocklists(self, monkeypatch, faults):
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu.jobs import recovery_strategy
+        launched = self._strategy_env(monkeypatch)
+
+        def fake_optimize(dag, blocked_resources=None, quiet=False):
+            task = dag.tasks[0]
+            task.best_resources = next(
+                iter(task.resources)).copy(region='europe-west4')
+
+        monkeypatch.setattr(optimizer_lib, 'optimize', fake_optimize)
+        strategy = recovery_strategy.get_strategy('EAGER_NEXT_REGION')
+        job_id = strategy.recover(self._task(), 'c1',
+                                  preempted_region='us-central1')
+        assert job_id is not None
+        # Preempted region blocklisted at REGION granularity...
+        blocked = {(r.region, r.zone)
+                   for r in strategy.blocked_resources}
+        assert ('us-central1', None) in blocked
+        # ...and the relaunch went elsewhere.
+        assert launched == ['europe-west4']
+
+    def test_backoff_bounded_attempts(self, monkeypatch, faults):
+        from skypilot_tpu.jobs import recovery_strategy
+        launched = self._strategy_env(monkeypatch)
+        faults.arm('provision.launch', 'error', 1.0)  # unlimited
+        strategy = recovery_strategy.get_strategy('EAGER_NEXT_REGION')
+        job_id = strategy.launch(self._task(), 'c1')
+        assert job_id is None
+        assert launched == []  # every attempt injected away
+        # max_retries attempts, max_retries-1 patched (unslept)
+        # backoffs, exponential envelope base*2^k, full jitter.
+        assert len(self.sleeps) == \
+            recovery_strategy.MAX_PROVISION_RETRIES - 1
+        for k, delay in enumerate(self.sleeps):
+            assert 0.0 <= delay <= \
+                recovery_strategy.RETRY_GAP_SECONDS * (2 ** k)
+
+
+# ---------------------------------------------------------------------
+# Replica health thresholds + hardened probe
+# ---------------------------------------------------------------------
+
+
+def _make_manager(port=19999, demote=3, promote=1, monkeypatch=None):
+    from skypilot_tpu.resources import Resources
+    from skypilot_tpu.serve.replica_managers import ReplicaManager
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    from skypilot_tpu.task import Task
+    if monkeypatch is not None:
+        monkeypatch.setenv('SKYTPU_SERVE_DEMOTE_AFTER', str(demote))
+        monkeypatch.setenv('SKYTPU_SERVE_PROMOTE_AFTER', str(promote))
+    spec = SkyServiceSpec(readiness_path='/', initial_delay_seconds=0,
+                          readiness_timeout_seconds=1,
+                          min_replicas=1, port=port)
+    task = Task(name='svc', run='echo x')
+    res = Resources(cloud='local')
+    task.set_resources(res)
+    task.service = spec
+    return ReplicaManager('tsvc', spec, task)
+
+
+class TestReplicaHealthThresholds:
+
+    @pytest.fixture(autouse=True)
+    def _cluster_exists(self, monkeypatch):
+        import types
+
+        from skypilot_tpu.serve import replica_managers
+        monkeypatch.setattr(
+            replica_managers.state, 'get_cluster_from_name',
+            lambda name: {'handle': types.SimpleNamespace()})
+        yield
+
+    def _ready_replica(self, manager, rid=1):
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        serve_state.upsert_replica('tsvc', rid, f'tsvc-replica-{rid}',
+                                   ReplicaStatus.READY,
+                                   'http://127.0.0.1:1/')
+        return rid
+
+    def _status(self, rid):
+        from skypilot_tpu.serve import serve_state
+        return serve_state.get_replica('tsvc', rid)['status']
+
+    def test_ready_survives_below_threshold(self, monkeypatch):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        manager = _make_manager(monkeypatch=monkeypatch, demote=3)
+        rid = self._ready_replica(manager)
+        monkeypatch.setattr(manager, 'probe',
+                            lambda endpoint, spec=None: False)
+        manager.probe_all()
+        manager.probe_all()
+        assert self._status(rid) == ReplicaStatus.READY  # tolerated
+        manager.probe_all()
+        assert self._status(rid) == ReplicaStatus.NOT_READY
+
+    def test_flake_resets_failure_count(self, monkeypatch):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        manager = _make_manager(monkeypatch=monkeypatch, demote=2)
+        rid = self._ready_replica(manager)
+        seq = iter([False, True, False, False])
+        monkeypatch.setattr(manager, 'probe',
+                            lambda endpoint, spec=None: next(seq))
+        manager.probe_all()
+        manager.probe_all()
+        assert self._status(rid) == ReplicaStatus.READY
+        manager.probe_all()
+        assert self._status(rid) == ReplicaStatus.READY
+        manager.probe_all()
+        assert self._status(rid) == ReplicaStatus.NOT_READY
+
+    def test_promote_needs_consecutive_successes(self, monkeypatch):
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        manager = _make_manager(monkeypatch=monkeypatch, promote=2)
+        serve_state.upsert_replica('tsvc', 5, 'tsvc-replica-5',
+                                   ReplicaStatus.STARTING,
+                                   'http://127.0.0.1:1/')
+        monkeypatch.setattr(manager, 'probe',
+                            lambda endpoint, spec=None: True)
+        manager.probe_all()
+        assert self._status(5) == ReplicaStatus.STARTING
+        manager.probe_all()
+        assert self._status(5) == ReplicaStatus.READY
+
+    def test_watchdog_suspect_demotes_immediately(self, monkeypatch):
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        manager = _make_manager(monkeypatch=monkeypatch, demote=5)
+        rid = self._ready_replica(manager)
+        monkeypatch.setattr(manager, 'probe',
+                            lambda endpoint, spec=None: False)
+        manager.mark_suspect(rid)
+        manager.probe_all()  # one failed probe is enough now
+        assert self._status(rid) == ReplicaStatus.NOT_READY
+
+    def test_serve_probe_fault_site(self, monkeypatch, faults):
+        manager = _make_manager(monkeypatch=monkeypatch)
+        faults.arm('serve.probe', 'error', 1.0)
+        # No HTTP happens at all: the site fires before urlopen.
+        assert manager.probe('http://127.0.0.1:1/') is False
+
+    def test_probe_survives_garbage_response(self, monkeypatch):
+        manager = _make_manager(monkeypatch=monkeypatch)
+
+        def bad_urlopen(url, timeout=None):
+            raise http.client.BadStatusLine('garbage\x00line')
+
+        monkeypatch.setattr(urllib.request, 'urlopen', bad_urlopen)
+        assert manager.probe('http://127.0.0.1:1/') is False
+
+    def test_probe_all_concurrent(self, monkeypatch):
+        """N slow probes must overlap, not serialize."""
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        manager = _make_manager(monkeypatch=monkeypatch)
+        for rid in range(1, 5):
+            serve_state.upsert_replica('tsvc', rid,
+                                       f'tsvc-replica-{rid}',
+                                       ReplicaStatus.READY,
+                                       f'http://127.0.0.1:{rid}/')
+        barrier = threading.Barrier(4, timeout=10)
+
+        def probe(endpoint, spec=None):
+            barrier.wait()  # deadlocks unless all 4 run concurrently
+            return True
+
+        monkeypatch.setattr(manager, 'probe', probe)
+        records = manager.probe_all()
+        assert all(r['status'] == ReplicaStatus.READY
+                   for r in records)
+
+
+# ---------------------------------------------------------------------
+# Load balancer: alternate-replica failover for idempotent requests
+# ---------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class TestLoadBalancerFailover:
+
+    @pytest.fixture
+    def live_server(self):
+        class Handler(BaseHTTPRequestHandler):
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                body = b'alive-ok'
+                self.send_response(200)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                self.do_GET()
+
+        server = ThreadingHTTPServer(('127.0.0.1', 0), Handler)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        yield f'http://127.0.0.1:{server.server_address[1]}'
+        server.shutdown()
+        server.server_close()
+
+    def _lb(self, endpoints):
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        lb = lb_lib.SkyServeLoadBalancer(
+            _free_port(), lambda: list(endpoints),
+            policy=lb_lib.RoundRobinPolicy())
+        lb.start()
+        return lb
+
+    def test_get_retried_on_alternate_replica(self, live_server):
+        dead = f'http://127.0.0.1:{_free_port()}'  # nothing listens
+        lb = self._lb([dead, live_server])  # RR picks dead FIRST
+        try:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb.port}/x',
+                    timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.read() == b'alive-ok'
+            counter = lb._m_failover.labels(endpoint=dead)  # pylint: disable=protected-access
+            assert counter.value == 1
+            # Latency is attributed PER ATTEMPT: the dead replica
+            # owns its burned attempt; the healthy one only its own.
+            assert lb._m_latency.labels(  # pylint: disable=protected-access
+                endpoint=dead).count == 1
+            assert lb._m_latency.labels(  # pylint: disable=protected-access
+                endpoint=live_server).count == 1
+        finally:
+            lb.stop()
+
+    def test_post_not_retried(self, live_server):
+        """Non-idempotent requests must NOT silently replay."""
+        dead = f'http://127.0.0.1:{_free_port()}'
+        lb = self._lb([dead, live_server])
+        try:
+            req = urllib.request.Request(
+                f'http://127.0.0.1:{lb.port}/x', data=b'p',
+                method='POST')
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 502
+        finally:
+            lb.stop()
+
+    def test_all_replicas_dead_bounded(self):
+        dead = [f'http://127.0.0.1:{_free_port()}' for _ in range(5)]
+        lb = self._lb(dead)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f'http://127.0.0.1:{lb.port}/x', timeout=10)
+            assert err.value.code == 502  # bounded attempts, no hang
+        finally:
+            lb.stop()
+
+
+# ---------------------------------------------------------------------
+# Controller wiring: watchdog wakes pollers
+# ---------------------------------------------------------------------
+
+
+class TestControllerWatchdogWiring:
+
+    def test_jobs_watchdog_sets_wake_event(self, monkeypatch,
+                                           tmp_path):
+        import types
+        import yaml
+
+        from skypilot_tpu.jobs import controller as controller_mod
+        from skypilot_tpu.jobs import state as jobs_state
+        monkeypatch.setenv('SKYTPU_WATCHDOG_THRESHOLD', '2')
+        monkeypatch.setenv('SKYTPU_WATCHDOG_INTERVAL_SECONDS', '999')
+
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+        task = Task(name='wt', run='echo x')
+        task.set_resources(Resources(cloud='local'))
+        dag_yaml = tmp_path / 'dag.yaml'
+        with open(dag_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([task.to_yaml_config()], f)
+        job_id = jobs_state.add_job('wt', str(dag_yaml), 'x')
+        ctrl = controller_mod.JobsController(job_id, str(dag_yaml))
+
+        dead_agent = types.SimpleNamespace(
+            is_healthy=lambda fast=False: False)
+        handle = types.SimpleNamespace(
+            head_agent=lambda: dead_agent)
+        monkeypatch.setattr(
+            controller_mod.state, 'get_cluster_from_name',
+            lambda name: {'handle': handle})
+        ctrl._arm_watchdog('wt-cluster')  # pylint: disable=protected-access
+        try:
+            assert not ctrl._wake.is_set()  # pylint: disable=protected-access
+            ctrl._watchdog.tick()  # pylint: disable=protected-access
+            assert not ctrl._wake.is_set()  # pylint: disable=protected-access
+            ctrl._watchdog.tick()  # pylint: disable=protected-access
+            assert ctrl._wake.is_set()  # pylint: disable=protected-access
+        finally:
+            ctrl._disarm_watchdog()  # pylint: disable=protected-access
+
+    def test_serve_unhealthy_marks_suspect_and_ticks(self):
+        """The serve controller's callback contract, without a full
+        controller: replica target name → suspect id + tick event."""
+        from skypilot_tpu.serve.controller import SkyServeController
+        calls = []
+
+        class FakeSelf:
+            replica_manager = type(
+                'RM', (), {'mark_suspect':
+                           staticmethod(calls.append)})()
+            _tick_now = threading.Event()
+
+        SkyServeController._on_replica_unhealthy(  # pylint: disable=protected-access
+            FakeSelf, 'replica-7', 3)
+        assert calls == [7]
+        assert FakeSelf._tick_now.is_set()  # pylint: disable=protected-access
+
+
+# ---------------------------------------------------------------------
+# End-to-end: injected preemption, full recovery (acceptance)
+# ---------------------------------------------------------------------
+
+
+class TestManagedJobPreemptionE2E:
+
+    @pytest.fixture
+    def cleanup_clusters(self):
+        yield
+        from skypilot_tpu import core, state
+        for record in state.get_clusters():
+            try:
+                core.down(record['name'], purge=True)
+            except exceptions.SkyTpuError:
+                pass
+
+    def test_injected_preemption_recovers_and_succeeds(
+            self, tmp_path, monkeypatch, faults, cleanup_clusters):
+        """SKYTPU_FAULTS=provision.launch:preempt:1.0:1 semantics:
+        first launch lands then the cluster dies; the controller
+        observes RECOVERING → RUNNING → SUCCEEDED with EXACTLY one
+        recovery. No retry path takes a real sleep (policy sleepers
+        patched; the poll gap is an event wait)."""
+        import yaml
+
+        from skypilot_tpu.jobs import controller as controller_mod
+        from skypilot_tpu.jobs import recovery_strategy
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        monkeypatch.setattr(controller_mod,
+                            'JOB_STATUS_CHECK_GAP_SECONDS', 0.2)
+        monkeypatch.setenv('SKYTPU_WATCHDOG_INTERVAL_SECONDS', '0.2')
+        sleeps = []
+        monkeypatch.setattr(
+            recovery_strategy.LAUNCH_RETRY_POLICY, 'sleeper',
+            sleeps.append)
+        # Arm via the env grammar — the documented activation path.
+        monkeypatch.setenv('SKYTPU_FAULTS',
+                           'provision.launch:preempt:1.0:1')
+        faults.reset()
+
+        task = Task(name='pj', run='echo preempt-survivor')
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        dag_yaml = tmp_path / 'dag.yaml'
+        with open(dag_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([task.to_yaml_config()], f)
+        job_id = jobs_state.add_job('pj', str(dag_yaml), 'inproc')
+
+        statuses = []
+        real_set_status = jobs_state.set_status
+
+        def record_status(jid, status, **kwargs):
+            statuses.append(status)
+            return real_set_status(jid, status, **kwargs)
+
+        monkeypatch.setattr(jobs_state, 'set_status', record_status)
+
+        ctrl = controller_mod.JobsController(job_id, str(dag_yaml))
+        final = ctrl.run()
+
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        # Exactly ONE recovery recorded.
+        assert jobs_state.get_job(job_id)['recovery_count'] == 1
+        # Observed sequence: ... RUNNING → RECOVERING → RUNNING →
+        # SUCCEEDED.
+        S = jobs_state.ManagedJobStatus
+        assert statuses.count(S.RECOVERING) == 1
+        i_rec = statuses.index(S.RECOVERING)
+        assert S.RUNNING in statuses[:i_rec]
+        assert S.RUNNING in statuses[i_rec:]
+        assert statuses[-1] == S.SUCCEEDED
+        # The relaunch needed no backoff (capacity was 'there'):
+        # nothing slept, proving sleeps are policy-owned.
+        assert sleeps == []
+        # The injection is observable + exhausted.
+        assert faults_mod.registry().fired_counts()[
+            ('provision.launch', 'preempt')] == 1
+
+    def test_transient_poll_flake_is_not_a_preemption(
+            self, tmp_path, monkeypatch, faults, cleanup_clusters):
+        """jobs.poll error faults make polls come back unanswered;
+        the liveness check must classify the cluster as alive and
+        NOT trigger recovery."""
+        import yaml
+
+        from skypilot_tpu.jobs import controller as controller_mod
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        monkeypatch.setattr(controller_mod,
+                            'JOB_STATUS_CHECK_GAP_SECONDS', 0.2)
+        faults.arm('jobs.poll', 'error', 0.5)
+
+        task = Task(name='fj', run='echo flaky-polls-ok')
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        dag_yaml = tmp_path / 'dag.yaml'
+        with open(dag_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([task.to_yaml_config()], f)
+        job_id = jobs_state.add_job('fj', str(dag_yaml), 'inproc')
+        ctrl = controller_mod.JobsController(job_id, str(dag_yaml))
+        final = ctrl.run()
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        assert jobs_state.get_job(job_id)['recovery_count'] == 0
+
+
+# ---------------------------------------------------------------------
+# xsky chaos CLI
+# ---------------------------------------------------------------------
+
+
+class TestChaosCli:
+
+    def test_arm_status_clear_round_trip(self, faults):
+        import os
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu import cli as cli_mod
+        runner = CliRunner()
+        out = runner.invoke(
+            cli_mod.cli,
+            ['chaos', 'arm', 'provision.launch:preempt:1.0:1'])
+        assert out.exit_code == 0, out.output
+        assert os.path.exists(faults_mod.chaos_file_path())
+        # A driver process starting now picks the fault up.
+        faults.reset()
+        assert faults_mod.fire('provision.launch') == 'preempt'
+
+        out = runner.invoke(cli_mod.cli, ['chaos', 'status'])
+        assert 'provision.launch:preempt:1:1' in out.output
+        out = runner.invoke(cli_mod.cli, ['chaos', 'clear'])
+        assert out.exit_code == 0
+        assert not os.path.exists(faults_mod.chaos_file_path())
+
+    def test_arm_rejects_bad_spec(self):
+        from click.testing import CliRunner
+
+        from skypilot_tpu import cli as cli_mod
+        out = CliRunner().invoke(cli_mod.cli,
+                                 ['chaos', 'arm', 'bogus:nope:9'])
+        assert out.exit_code != 0
+
+
+# ---------------------------------------------------------------------
+# Lint: no hand-rolled sleeps in retry loops outside resilience/
+# ---------------------------------------------------------------------
+
+
+class TestNoSleepInRetryLoops:
+
+    # Poll/wait loops allowed to sleep directly: liveness waits on
+    # the agent's own processes (not retry loops).
+    ALLOWLIST = {
+        'provision/local/instance.py',  # agent process port-wait
+        'runtime/agent.py',             # the agent's process wait
+    }
+    MARKERS = ('attempt', 'backoff', 'retry')
+    WINDOW = 6
+
+    def test_no_time_sleep_in_retry_context(self):
+        import os
+
+        import skypilot_tpu
+        root = os.path.dirname(skypilot_tpu.__file__)
+        violations = []
+        for dirpath, _, files in os.walk(root):
+            if 'resilience' in dirpath or '__pycache__' in dirpath:
+                continue
+            for fn in files:
+                if not fn.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                if rel in self.ALLOWLIST:
+                    continue
+                with open(path, encoding='utf-8') as f:
+                    lines = f.read().splitlines()
+                for i, line in enumerate(lines):
+                    if 'time.sleep(' not in line:
+                        continue
+                    lo = max(0, i - self.WINDOW)
+                    ctx = '\n'.join(
+                        lines[lo:i + self.WINDOW + 1]).lower()
+                    hits = [m for m in self.MARKERS if m in ctx]
+                    if hits:
+                        violations.append(
+                            f'{rel}:{i + 1} time.sleep in a '
+                            f'retry-ish context ({hits}): '
+                            f'{line.strip()}')
+        assert not violations, (
+            'Hand-rolled retry sleeps found — route them through '
+            'resilience.RetryPolicy:\n' + '\n'.join(violations))
