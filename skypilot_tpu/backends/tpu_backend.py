@@ -74,7 +74,15 @@ class TpuBackend(Backend):
                 statuses = None  # provider unreachable: trust the DB
             if statuses is not None and not statuses:
                 # Gone from the cloud (preempted / deleted out of
-                # band): fall through to a fresh provision.
+                # band): fall through to a fresh provision. Tunnels
+                # and breakers go with it — a cached ssh tunnel to
+                # the dead host (its local listener can outlive the
+                # host by the ServerAlive window) must not be handed
+                # to the replacement cluster, and on SSH clouds the
+                # breaker targets ARE those tunnel endpoints.
+                from skypilot_tpu.runtime import tunnels
+                tunnels.close_tunnels(cluster_name)
+                _forget_agent_breakers(h)
                 state.remove_cluster(cluster_name, terminate=True)
                 record = None
             elif record['status'] == status_lib.ClusterStatus.STOPPED \
@@ -488,7 +496,8 @@ class TpuBackend(Backend):
     def job_status(self, handle: ClusterHandle,
                    job_id: int) -> Optional[job_lib.JobStatus]:
         cmd = codegen.get_job_status(handle.head_runtime_dir, job_id)
-        out = handle.head_agent().exec(cmd, timeout=60)
+        # Read-only query: safe to retry through transient agent blips.
+        out = handle.head_agent().exec(cmd, timeout=60, retry=True)
         value = codegen.parse_tagged(out.get('output', ''), 'STATUS')
         if value in (None, 'None'):
             return None
@@ -496,7 +505,7 @@ class TpuBackend(Backend):
 
     def job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
         cmd = codegen.get_job_queue(handle.head_runtime_dir)
-        out = handle.head_agent().exec(cmd, timeout=60)
+        out = handle.head_agent().exec(cmd, timeout=60, retry=True)
         payload = codegen.parse_tagged(out.get('output', ''), 'QUEUE')
         if payload is None:
             raise exceptions.CommandError(1, 'queue',
@@ -509,7 +518,9 @@ class TpuBackend(Backend):
     def cancel_jobs(self, handle: ClusterHandle,
                     job_ids: Optional[List[int]] = None) -> List[int]:
         cmd = codegen.cancel_jobs(handle.head_runtime_dir, job_ids)
-        out = handle.head_agent().exec(cmd, timeout=60)
+        # Idempotent (cancelling an already-cancelled job is a no-op):
+        # safe to retry, same rationale as /kill.
+        out = handle.head_agent().exec(cmd, timeout=60, retry=True)
         payload = codegen.parse_tagged(out.get('output', ''),
                                        'CANCELLED')
         return json.loads(payload) if payload else []
@@ -524,7 +535,7 @@ class TpuBackend(Backend):
         out = out or sys.stdout
         head = handle.head_agent()
         cmd = codegen.get_log_path(handle.head_runtime_dir, job_id)
-        resp = head.exec(cmd, timeout=60)
+        resp = head.exec(cmd, timeout=60, retry=True)
         log_path = codegen.parse_tagged(resp.get('output', ''), 'LOG')
         if not log_path:
             logger.warning('No log path for job %d', job_id)
@@ -650,7 +661,25 @@ class TpuBackend(Backend):
             logger.warning('teardown error ignored (purge=True)')
         from skypilot_tpu.runtime import tunnels
         tunnels.close_tunnels(handle.cluster_name)
+        _forget_agent_breakers(handle)
         state.remove_cluster(handle.cluster_name, terminate=terminate)
+
+
+def _forget_agent_breakers(handle: ClusterHandle) -> None:
+    """Drop per-host circuit-breaker state (+ gauge series) for a
+    cluster that is going away. Without this a long-lived controller
+    churning through preempted clusters grows the breaker registry
+    unboundedly and keeps exporting OPEN for hosts that no longer
+    exist. Tunnel-side endpoints are forgotten by close_tunnels;
+    this covers the direct-agent targets."""
+    from skypilot_tpu.resilience import policy as policy_lib
+    for host in handle.hosts:
+        port = host.get('agent_port')
+        if port is None:
+            continue
+        for addr in {host.get('ip'), host.get('external_ip')}:
+            if addr:
+                policy_lib.forget_breaker(f'{addr}:{port}')
 
 
 _submit_counter = [0]

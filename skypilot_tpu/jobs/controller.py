@@ -125,6 +125,11 @@ class JobsController:
 
     def _disarm_watchdog(self) -> None:
         if self._watchdog is not None:
+            # Remove targets (not just stop) so the old cluster's
+            # skytpu_watchdog_* series stop exporting — a preempted
+            # cluster's last verdict must not trip alerts forever.
+            for target in self._watchdog.targets():
+                self._watchdog.remove_target(target)
             self._watchdog.stop()
             self._watchdog = None
 
@@ -192,7 +197,11 @@ class JobsController:
             # Event-gated gap, not a sleep: the watchdog
             # short-circuits it the moment the task cluster's agent
             # goes dark, so a preemption does not sit undetected for
-            # the rest of the gap.
+            # the rest of the gap. Ordering invariant: clear comes
+            # AFTER wait returns and BEFORE the poll/recovery below —
+            # a wake landing during the tick stays set and skips the
+            # next gap (one landing in the wait→clear window is
+            # served by the poll that immediately follows).
             self._wake.wait(JOB_STATUS_CHECK_GAP_SECONDS)
             self._wake.clear()
             status = self._poll_job_status(cluster_name, job_id)

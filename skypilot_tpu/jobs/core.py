@@ -87,8 +87,11 @@ def _ensure_controller_cluster():
     return _get_controller_handle()
 
 
-def _controller_rpc(handle, cmd: str, timeout: float = 60.0) -> str:
-    out = handle.head_agent().exec(cmd, timeout=timeout)
+def _controller_rpc(handle, cmd: str, timeout: float = 60.0,
+                    retry: bool = False) -> str:
+    """``retry=True`` is for idempotent RPCs only (queries, or writes
+    the controller dedupes) — see AgentClient.exec."""
+    out = handle.head_agent().exec(cmd, timeout=timeout, retry=retry)
     if out.get('returncode') != 0:
         raise exceptions.CommandError(
             out.get('returncode', 1), 'jobs controller RPC',
@@ -163,7 +166,8 @@ def launch(dag_or_task: Union[Dag, Task],
     # the controller process gets a job slot (idempotent vs the
     # controller's own ensure_job).
     _controller_rpc(handle, jobs_codegen.ensure_job(
-        rdir, job_id, name, remote_dag, controller_cluster))
+        rdir, job_id, name, remote_dag, controller_cluster),
+                    retry=True)
     logger.info('Managed job %d submitted (controller cluster %s)',
                 job_id, controller_cluster)
     if not detach:
@@ -175,7 +179,7 @@ def get(job_id: int) -> Optional[Dict[str, Any]]:
     """One managed-job record from the controller, or None."""
     handle = _get_controller_handle()
     out = _controller_rpc(handle, jobs_codegen.get_job(
-        handle.head_runtime_dir, job_id))
+        handle.head_runtime_dir, job_id), retry=True)
     payload = _parse(out, 'JOB')
     if payload == 'null':
         return None
@@ -189,7 +193,7 @@ def queue() -> List[Dict[str, Any]]:
     if handle is None:
         return []
     out = _controller_rpc(handle, jobs_codegen.get_jobs(
-        handle.head_runtime_dir))
+        handle.head_runtime_dir), retry=True)
     import json
     return [_to_record(r) for r in json.loads(_parse(out, 'JOBS'))]
 
@@ -233,7 +237,8 @@ def tail_logs(job_id: int, out=None, follow: bool = True,
     offset = 0
     while True:
         resp = _controller_rpc(handle, jobs_codegen.dump_task_log(
-            handle.head_runtime_dir, job_id, offset), timeout=120.0)
+            handle.head_runtime_dir, job_id, offset), timeout=120.0,
+            retry=True)
         status = _parse(resp, 'STATUS')
         if status == 'UNKNOWN':
             raise exceptions.JobError(

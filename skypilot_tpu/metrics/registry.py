@@ -166,7 +166,7 @@ class _Family:
 
     # -- child access ---------------------------------------------------
 
-    def labels(self, *labelvalues, **labelkwargs):
+    def _label_key(self, labelvalues, labelkwargs) -> Tuple[str, ...]:
         if labelkwargs:
             if labelvalues:
                 raise ValueError('pass label values positionally OR '
@@ -182,7 +182,10 @@ class _Family:
             raise ValueError(
                 f'{self.name} takes labels {self.labelnames}, got '
                 f'{len(labelvalues)} value(s)')
-        key = tuple(str(v) for v in labelvalues)
+        return tuple(str(v) for v in labelvalues)
+
+    def labels(self, *labelvalues, **labelkwargs):
+        key = self._label_key(labelvalues, labelkwargs)
         with self._lock:
             child = self._children.get(key)
             if child is None:
@@ -198,6 +201,18 @@ class _Family:
                     child = _KIND_CHILD[self.kind](self)
                     self._children[key] = child
         return child
+
+    def remove(self, *labelvalues, **labelkwargs) -> None:
+        """Drop one labeled series (no-op if absent). For label
+        values naming entities with a lifecycle (replicas, hosts): a
+        scaled-away target must stop exporting its last sample, not
+        freeze it into dashboards/alerts forever."""
+        if not self.labelnames:
+            raise ValueError(
+                f'{self.name} is unlabeled; nothing to remove')
+        key = self._label_key(labelvalues, labelkwargs)
+        with self._lock:
+            self._children.pop(key, None)
 
     def _default_child(self):
         if self.labelnames:

@@ -202,7 +202,8 @@ def upgrade_agents_in_place(handle: ClusterHandle) -> bool:
         # pre-supervisor pod's PID-1 agent would take the whole pod
         # down permanently (restartPolicy: Never).
         probe = cl.exec(
-            'test -f "$HOME/.skypilot_tpu/supervised"', timeout=15)
+            'test -f "$HOME/.skypilot_tpu/supervised"', timeout=15,
+            retry=True)  # read-only probe: safe to retry
         if probe.get('returncode') != 0:
             raise exceptions.NotSupportedError(
                 f'host {i}: pre-supervisor pod')

@@ -269,10 +269,31 @@ def breaker_for(target: str, failure_threshold: int = 5,
         return breaker
 
 
+def forget_breaker(target: str) -> None:
+    """Drop ``target``'s breaker and its exported state series (no-op
+    if absent). Called when a target goes away for good (cluster
+    teardown, tunnel close): a dead host must not keep exporting its
+    last breaker state (often OPEN) forever, and preemption churn
+    through fresh endpoints must not grow the registry unboundedly.
+    """
+    with _breakers_lock:
+        _breakers.pop(target, None)
+    # Series removal is UNCONDITIONAL (not gated on registry
+    # membership): a live CircuitBreaker reference that outlived a
+    # previous forget can resurrect the series via _export(), and a
+    # repeat forget must still be able to drop it.
+    if target:
+        _breaker_gauge().remove(target=target)
+
+
 def reset_breakers() -> None:
     """Drop all per-target breakers (test isolation)."""
     with _breakers_lock:
+        targets = list(_breakers)
         _breakers.clear()
+    for target in targets:
+        if target:
+            _breaker_gauge().remove(target=target)
 
 
 # -- metrics (lazy so the module stays importable standalone) ---------

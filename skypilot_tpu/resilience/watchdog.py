@@ -82,21 +82,31 @@ class HealthWatchdog:
 
     def add_target(self, target: str,
                    probe: Callable[[], bool]) -> None:
+        # Gauge writes happen under the watchdog lock (here, in
+        # _account, and in remove_target) so an add/tick racing a
+        # remove cannot resurrect a just-removed series.
         with self._lock:
             fresh = target not in self._targets
             self._targets[target] = probe
             if fresh:
                 self._failures[target] = 0
                 self._unhealthy[target] = False
-        if fresh:
-            _healthy_gauge().labels(target=target).set(1)
-            _failures_gauge().labels(target=target).set(0)
+                _healthy_gauge().labels(target=target).set(1)
+                _failures_gauge().labels(target=target).set(0)
 
     def remove_target(self, target: str) -> None:
         with self._lock:
+            existed = target in self._targets
             self._targets.pop(target, None)
             self._failures.pop(target, None)
             self._unhealthy.pop(target, None)
+            if existed:
+                # Drop the exported series too: a scaled-down or
+                # replaced replica must not keep exporting its last
+                # verdict (e.g. unhealthy=0) forever, tripping alerts
+                # on a target that no longer exists.
+                _healthy_gauge().remove(target=target)
+                _failures_gauge().remove(target=target)
 
     def targets(self) -> List[str]:
         with self._lock:
@@ -158,12 +168,15 @@ class HealthWatchdog:
                         not self._unhealthy.get(target, False):
                     self._unhealthy[target] = True
                     fire_down = True
-        # The exported verdict is the THRESHOLDED one: a target below
-        # the consecutive-failure threshold still reads healthy.
-        _healthy_gauge().labels(target=target).set(
-            0 if self.is_unhealthy(target) else 1)
-        _failures_gauge().labels(target=target).set(
-            0 if healthy else failures)
+            # The exported verdict is the THRESHOLDED one: a target
+            # below the consecutive-failure threshold still reads
+            # healthy. Written under the lock so a concurrent
+            # remove_target cannot interleave and resurrect the
+            # series it just dropped.
+            _healthy_gauge().labels(target=target).set(
+                0 if self._unhealthy.get(target, False) else 1)
+            _failures_gauge().labels(target=target).set(
+                0 if healthy else failures)
         if fire_down:
             logger.warning(
                 '%s: target %s UNHEALTHY after %d consecutive '

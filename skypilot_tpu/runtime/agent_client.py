@@ -1,10 +1,14 @@
 """Client for the host-agent protocol (see ``runtime/agent.py``).
 
-Resilience: every GET/POST helper retries transient failures
-(``URLError``/``ConnectionResetError``/5xx) through the shared
-:class:`~skypilot_tpu.resilience.RetryPolicy`, and a process-wide
-per-host circuit breaker fails fast against dead hosts instead of
-re-burning the HTTP timeout on every call (docs/resilience.md).
+Resilience: GET helpers (and idempotent POSTs like ``/kill``) retry
+transient failures (``URLError``/``ConnectionResetError``/5xx)
+through the shared :class:`~skypilot_tpu.resilience.RetryPolicy`;
+non-idempotent POSTs (``/run``, ``/exec``) are NEVER retried — the
+agent spawns a process per request with no request-id dedup, so a
+retry after a landed-but-unanswered request would double-execute and
+orphan the first process. A process-wide per-host circuit breaker
+fails fast against dead hosts instead of re-burning the HTTP timeout
+on every call (docs/resilience.md).
 """
 import json
 import os
@@ -130,14 +134,22 @@ class AgentClient:
             raise
 
     def _call(self, make_request: Callable[[], Any],
-              retry: bool = True):
+              retry: bool = True,
+              gate: Optional[bool] = None):
         """Run one RPC through the breaker (+retries).
 
-        ``retry=False`` is the liveness-poll fast path
-        (``wait_healthy``): it skips the breaker GATE (an explicit
-        wait for recovery must not be throttled by fail-fast) and the
-        inner retries (the outer loop IS the retry), but still
-        REPORTS outcomes so the breaker tracks reality."""
+        ``retry`` controls the inner retries; ``gate`` controls the
+        breaker's fail-fast gate and defaults to ``retry``. The two
+        un-retried flavors: the liveness-poll fast path
+        (``wait_healthy``, ``retry=False``) also skips the GATE — an
+        explicit wait for recovery must not be throttled by
+        fail-fast — while non-idempotent POSTs (``/run``/``/exec``)
+        pass ``retry=False, gate=True``: fail fast against a dead
+        host, but never re-send a request that may already have
+        landed. Both still REPORT outcomes so the breaker tracks
+        reality."""
+        if gate is None:
+            gate = retry
         def attempt(gated: bool):
             if gated and not self.breaker.allow():
                 raise policy_lib.CircuitOpenError(
@@ -168,8 +180,8 @@ class AgentClient:
             return out
 
         if not retry:
-            return attempt(gated=False)
-        return self.retry_policy.call(attempt, True)
+            return attempt(gated=gate)
+        return self.retry_policy.call(attempt, gate)
 
     def _get(self, path: str, params: Optional[Dict[str, Any]] = None,
              raw: bool = False, timeout: Optional[float] = None,
@@ -189,7 +201,14 @@ class AgentClient:
         return self._call(do, retry=retry)
 
     def _post(self, path: str, body: Dict[str, Any],
-              timeout: Optional[float] = None, retry: bool = True):
+              timeout: Optional[float] = None, retry: bool = False):
+        """POSTs default to NO retries: ``/run`` and ``/exec`` spawn
+        work on the agent with no request-id dedup, so retrying a
+        request that landed but timed out on the answer would
+        double-execute it (and only the second proc_id would be
+        tracked — the first becomes an unkillable orphan). Idempotent
+        endpoints (``/kill``) opt back in with ``retry=True``. The
+        breaker still gates + records every attempt."""
 
         def do():
             req = urllib.request.Request(
@@ -199,7 +218,7 @@ class AgentClient:
                             path) as resp:
                 return json.loads(resp.read())
 
-        return self._call(do, retry=retry)
+        return self._call(do, retry=retry, gate=True)
 
     # -- API ------------------------------------------------------------
 
@@ -269,15 +288,22 @@ class AgentClient:
 
     def kill(self, proc_id: int) -> bool:
         try:
-            return bool(self._post('/kill',
-                                   {'proc_id': proc_id}).get('ok'))
+            # Idempotent (killing a dead/unknown proc is a no-op), so
+            # transient-failure retries are safe here.
+            return bool(self._post('/kill', {'proc_id': proc_id},
+                                   retry=True).get('ok'))
         except (urllib.error.URLError, OSError):
             return False
 
-    def exec(self, cmd: str, timeout: float = 600.0) -> Dict[str, Any]:
-        """Blocking remote command (setup steps)."""
+    def exec(self, cmd: str, timeout: float = 600.0,
+             retry: bool = False) -> Dict[str, Any]:
+        """Blocking remote command (setup steps). ``retry=True`` opts
+        back into transient-failure retries — only for commands the
+        caller knows are idempotent (read-only queries); retrying an
+        arbitrary command that landed but lost its answer would
+        double-execute it."""
         return self._post('/exec', {'cmd': cmd, 'timeout': timeout},
-                          timeout=timeout + 10)
+                          timeout=timeout + 10, retry=retry)
 
     def read_file(self, path: str, offset: int = 0) -> bytes:
         return self._get('/read', {'path': path, 'offset': offset},

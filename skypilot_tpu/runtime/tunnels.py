@@ -80,10 +80,13 @@ def get_endpoint(handle, host_index: int,
             local_port, proc = cached
             if proc.poll() is None and _port_listening(local_port):
                 return ('127.0.0.1', local_port)
-            # Dead tunnel — clean up and rebuild.
+            # Dead tunnel — clean up and rebuild. The rebuilt tunnel
+            # gets a FRESH local port, so the old endpoint's breaker
+            # must go with it or it exports a stale series forever.
             if proc.poll() is None:
                 proc.terminate()
             del _tunnels[key]
+            _forget_endpoint_breaker(local_port)
 
         host = handle.hosts[host_index]
         remote_addr = host.get('external_ip') or host['ip']
@@ -109,13 +112,23 @@ def get_endpoint(handle, host_index: int,
             f'not come up within {timeout}s')
 
 
+def _forget_endpoint_breaker(local_port: int) -> None:
+    """AgentClients reached this tunnel at 127.0.0.1:<local_port> —
+    the per-target circuit breaker is keyed the same way. Local ports
+    are never reused across tunnels, so a closed tunnel's breaker is
+    garbage: drop it and its gauge series."""
+    from skypilot_tpu.resilience import policy as policy_lib
+    policy_lib.forget_breaker(f'127.0.0.1:{local_port}')
+
+
 def close_tunnels(cluster_name: str) -> None:
     """Tear down all tunnels for a cluster (on down/stop)."""
     with _lock:
         for key in [k for k in _tunnels if k[0] == cluster_name]:
-            _, proc = _tunnels.pop(key)
+            local_port, proc = _tunnels.pop(key)
             if proc.poll() is None:
                 proc.terminate()
+            _forget_endpoint_breaker(local_port)
 
 
 def _close_all() -> None:

@@ -240,15 +240,25 @@ class SkyServeController:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            # Clear BEFORE the tick, not after the wait: a watchdog
+            # wake that lands during run_once (or between wait
+            # returning and acting) stays set and short-circuits the
+            # next wait, instead of being swallowed and stranding the
+            # suspect replica a full sync interval.
+            self._tick_now.clear()
             try:
                 self.run_once()
             except Exception:  # pylint: disable=broad-except
                 logger.exception('controller tick failed')
+            if self._stop.is_set():
+                break
             # Interruptible gap: the watchdog (or stop()) pulls the
             # next tick forward by setting _tick_now.
             self._tick_now.wait(CONTROLLER_SYNC_INTERVAL)
-            self._tick_now.clear()
-        # Shutdown: terminate replicas + LB.
+        # Shutdown: terminate replicas + LB. Remove watchdog targets
+        # (not just stop) so stale replica series stop exporting.
+        for target in self.watchdog.targets():
+            self.watchdog.remove_target(target)
         self.watchdog.stop()
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.SHUTTING_DOWN)

@@ -78,8 +78,11 @@ def _ensure_controller_cluster():
     return _get_controller_handle()
 
 
-def _rpc(handle, cmd: str, timeout: float = 120.0) -> str:
-    out = handle.head_agent().exec(cmd, timeout=timeout)
+def _rpc(handle, cmd: str, timeout: float = 120.0,
+         retry: bool = False) -> str:
+    """``retry=True`` is for idempotent RPCs only (read-only queries)
+    — see AgentClient.exec."""
+    out = handle.head_agent().exec(cmd, timeout=timeout, retry=retry)
     if out.get('returncode') != 0:
         raise exceptions.CommandError(
             out.get('returncode', 1), 'serve controller RPC',
@@ -107,7 +110,7 @@ def _to_service_record(svc: Dict[str, Any]) -> Dict[str, Any]:
 
 def _get_service(handle, name: str) -> Optional[Dict[str, Any]]:
     out = _rpc(handle, serve_codegen.get_service(
-        handle.head_runtime_dir, name))
+        handle.head_runtime_dir, name), retry=True)
     payload = _parse(out, 'SERVICE')
     if payload == 'null':
         return None
@@ -356,7 +359,7 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
         rec = _get_service(handle, service_name)
         return [rec] if rec is not None else []
     out = _rpc(handle, serve_codegen.get_services(
-        handle.head_runtime_dir))
+        handle.head_runtime_dir), retry=True)
     return [_to_service_record(s)
             for s in json.loads(_parse(out, 'SERVICES'))]
 
@@ -384,7 +387,7 @@ def tail_replica_logs(service_name: str, replica_id: int,
     handle = _get_controller_handle()
     resp = _rpc(handle, serve_codegen.dump_replica_log(
         handle.head_runtime_dir, service_name, replica_id),
-        timeout=120.0)
+        timeout=120.0, retry=True)
     from skypilot_tpu.runtime import codegen
     if codegen.parse_tagged(resp, 'NOREPLICA') is not None:
         raise exceptions.InvalidSpecError(

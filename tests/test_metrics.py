@@ -96,6 +96,25 @@ class TestRegistry:
         labels = {dict(lbls)['id'] for lbls, _ in series}
         assert '__overflow__' in labels
 
+    def test_remove_drops_one_series(self):
+        """Label values naming lifecycle-bound entities (replicas,
+        hosts) must be removable — a scaled-away target's series
+        should stop exporting, not freeze its last sample."""
+        g = metrics_lib.Gauge('lifecycle', 'h', ('id',))
+        g.labels(id='a').set(1)
+        g.labels(id='b').set(2)
+        g.remove(id='a')
+        labels = {dict(lbls)['id'] for lbls, _ in g.collect()}
+        assert labels == {'b'}
+        g.remove(id='a')  # absent: no-op
+        with pytest.raises(ValueError):
+            g.remove('x', 'y')  # label schema still enforced
+
+    def test_remove_on_unlabeled_family_rejected(self):
+        g = metrics_lib.Gauge('single_g', 'h')
+        with pytest.raises(ValueError):
+            g.remove()
+
     def test_gauge_set_inc_dec(self):
         reg = metrics_lib.Registry()
         g = reg.gauge('g', 'h')

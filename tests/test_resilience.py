@@ -217,6 +217,27 @@ class TestCircuitBreaker:
         breaker.record_success()
         assert gauge.labels(target='gauge-host:9').value == 0
 
+    def test_forget_breaker_drops_state_and_series(self):
+        """Cluster teardown forgets per-host breakers: a dead host
+        must not keep exporting OPEN forever, and churn through fresh
+        endpoints must not grow the registry unboundedly."""
+        from skypilot_tpu import metrics as metrics_lib
+        breaker = policy_lib.breaker_for('churned:7001',
+                                         failure_threshold=1)
+        breaker.record_failure()
+        assert breaker.state == CircuitState.OPEN
+        policy_lib.forget_breaker('churned:7001')
+        gauge = metrics_lib.registry().gauge(
+            'skytpu_circuit_breaker_state', labelnames=('target',))
+        targets = {dict(lbls).get('target')
+                   for lbls, _ in gauge.collect()}
+        assert 'churned:7001' not in targets
+        # A replacement host at the same endpoint starts clean.
+        fresh = policy_lib.breaker_for('churned:7001')
+        assert fresh is not breaker
+        assert fresh.state == CircuitState.CLOSED
+        policy_lib.forget_breaker('never-existed:1')  # no-op
+
 
 # ---------------------------------------------------------------------
 # Fault injection
@@ -365,6 +386,70 @@ class TestAgentClientResilience:
         assert sleeps == []
         # A 403 means the host is UP: breaker must not accumulate.
         assert client.breaker.consecutive_failures == 0
+
+    def test_non_idempotent_posts_not_retried(self, monkeypatch):
+        """/run and /exec spawn work on the agent with no request-id
+        dedup: a retry after a landed-but-unanswered request would
+        double-execute the task and orphan the first process. They
+        must surface transient errors after ONE attempt."""
+        client, sleeps = _client(port=45681)
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            raise urllib.error.URLError(
+                ConnectionResetError('reset'))
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        with pytest.raises((urllib.error.URLError, OSError)):
+            client.run('echo hi', '/tmp/l.log')
+        assert calls['n'] == 1
+        with pytest.raises((urllib.error.URLError, OSError)):
+            client.exec('echo hi')
+        assert calls['n'] == 2
+        assert sleeps == []
+        # The un-retried attempts still feed the breaker.
+        assert client.breaker.consecutive_failures == 2
+
+    def test_kill_is_idempotent_and_retried(self, monkeypatch):
+        client, sleeps = _client(port=45682)
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            if calls['n'] < 3:
+                raise urllib.error.URLError(
+                    ConnectionResetError('reset'))
+            return _FakeResponse({'ok': True})
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        assert client.kill(7) is True
+        assert calls['n'] == 3
+        assert len(sleeps) == 2
+
+    def test_breaker_gates_non_idempotent_posts(self, monkeypatch):
+        """retry=False on /run must NOT bypass the breaker gate (that
+        exemption is only for the wait_healthy liveness poll): a dead
+        host fails fast without re-sending anything."""
+        client, _ = _client(port=45684)
+        clock = FakeClock()
+        client.breaker = CircuitBreaker(target='rungate',
+                                        failure_threshold=1,
+                                        recovery_timeout=60.0,
+                                        clock=clock)
+        calls = {'n': 0}
+
+        def urlopen(req, timeout=None):
+            calls['n'] += 1
+            raise urllib.error.URLError(ConnectionRefusedError())
+
+        monkeypatch.setattr(urllib.request, 'urlopen', urlopen)
+        with pytest.raises((urllib.error.URLError, OSError)):
+            client.run('echo hi', '/tmp/l.log')
+        assert client.breaker.state == CircuitState.OPEN
+        with pytest.raises(CircuitOpenError):
+            client.run('echo hi', '/tmp/l.log')
+        assert calls['n'] == 1  # second call never hit the network
 
     def test_timeout_error_names_host_and_path(self, monkeypatch):
         client, _ = _client(host='10.0.0.7', port=8123)
@@ -580,6 +665,28 @@ class TestWatchdog:
         assert fails.labels(target='g-host').value == 1
         dog.tick()
         assert healthy.labels(target='g-host').value == 0
+
+    def test_remove_target_drops_gauge_series(self):
+        """A removed target's gauge series must disappear, not keep
+        exporting its last verdict (e.g. unhealthy=0) and trip alerts
+        on a replica that no longer exists."""
+        from skypilot_tpu import metrics as metrics_lib
+        dog = watchdog_lib.HealthWatchdog(interval=999,
+                                          unhealthy_threshold=1)
+        dog.add_target('gone-host', lambda: False)
+        dog.tick()
+        healthy = metrics_lib.registry().gauge(
+            'skytpu_watchdog_target_healthy', labelnames=('target',))
+        fails = metrics_lib.registry().gauge(
+            'skytpu_watchdog_consecutive_failures',
+            labelnames=('target',))
+        assert healthy.labels(target='gone-host').value == 0
+        dog.remove_target('gone-host')
+        for fam in (healthy, fails):
+            targets = {dict(lbls).get('target')
+                       for lbls, _ in fam.collect()}
+            assert 'gone-host' not in targets
+        dog.remove_target('gone-host')  # absent: no-op, no series
 
     def test_env_tunables(self, monkeypatch):
         monkeypatch.setenv('SKYTPU_WATCHDOG_INTERVAL_SECONDS', '2.5')
