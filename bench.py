@@ -666,6 +666,81 @@ def _serve_probe(model_name: Optional[str] = None,
     }
 
 
+def checkpoint_main() -> None:
+    """BENCH_MODE=checkpoint (or ``--bench checkpoint``): native
+    checkpoint engine throughput — save MB/s, restore MB/s, and the
+    async overlap ratio (how much of the background write hides
+    behind compute; 1.0 = the write is free, 0.0 = it serializes).
+    Env: BENCH_CKPT_MB (payload size, default 64),
+    BENCH_CKPT_LEAVES (default 16)."""
+    import tempfile
+
+    import numpy as np
+
+    from skypilot_tpu.checkpoint import NativeCheckpointManager
+
+    total_mb = float(os.environ.get('BENCH_CKPT_MB', '64'))
+    n_leaves = int(os.environ.get('BENCH_CKPT_LEAVES', '16'))
+    leaf_elems = int(total_mb * 1e6 / 4 / n_leaves)
+    rng = np.random.default_rng(0)
+    tree = {'params': {f'w{i}': rng.standard_normal(
+        leaf_elems).astype(np.float32) for i in range(n_leaves)}}
+    nbytes = sum(v.nbytes for v in tree['params'].values())
+
+    with tempfile.TemporaryDirectory() as d:
+        mgr = NativeCheckpointManager(d, save_interval_steps=1,
+                                      max_to_keep=None,
+                                      process_index=0,
+                                      process_count=1)
+        # Blocking save: submit + wait = the full write+commit cost.
+        t0 = time.perf_counter()
+        mgr.save(0, tree)
+        mgr.wait()
+        t_save = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        restored = mgr.restore_latest_raw()
+        t_restore = time.perf_counter() - t0
+        assert restored is not None
+
+        # Async overlap: kick a save, then "train" (busy host work
+        # sized ~ the save) while the writer streams in background.
+        def compute(seconds: float) -> None:
+            end = time.perf_counter() + seconds
+            x = np.ones((256, 256), np.float32)
+            while time.perf_counter() < end:
+                x = x @ x * 1e-3
+        t0 = time.perf_counter()
+        compute(t_save)
+        t_compute = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        mgr.save(1, tree)
+        compute(t_compute)
+        mgr.wait()
+        t_async = time.perf_counter() - t0
+        mgr.close()
+
+    overlap = max(0.0, min(1.0, (t_save + t_compute - t_async) /
+                           max(t_save, 1e-9)))
+    save_mbps = nbytes / 1e6 / t_save
+    print(json.dumps({
+        'metric': 'checkpoint_save_mb_per_sec',
+        'value': round(save_mbps, 2),
+        'unit': 'MB/s',
+        # First native measurement seeds the baseline.
+        'vs_baseline': 1.0,
+        'detail': {
+            'payload_mb': round(nbytes / 1e6, 2),
+            'leaves': n_leaves,
+            'save_s': round(t_save, 4),
+            'restore_s': round(t_restore, 4),
+            'restore_mb_per_sec': round(nbytes / 1e6 / t_restore, 2),
+            'async_total_s': round(t_async, 4),
+            'compute_s': round(t_compute, 4),
+            'async_overlap_ratio': round(overlap, 3),
+        },
+    }))
+
+
 def launch_main() -> None:
     """BENCH_MODE=launch: `launch` time-to-first-step on the local
     fake cloud (the un-measured half of BASELINE.json's north star —
@@ -719,13 +794,28 @@ def _reexec_on_cpu() -> None:
           'JAX_PLATFORMS=cpu', file=sys.stderr)
     sys.stderr.flush()
     sys.stdout.flush()
-    os.execve(sys.executable, [sys.executable, __file__], env)
+    # argv passes through so `--bench <mode>` survives the re-exec.
+    os.execve(sys.executable,
+              [sys.executable, __file__] + sys.argv[1:], env)
 
 
 if __name__ == '__main__':
     try:
         mode = os.environ.get('BENCH_MODE', 'train')
-        if mode == 'serve':
+        if '--bench' in sys.argv:
+            # `python bench.py --bench checkpoint` == BENCH_MODE=...
+            idx = sys.argv.index('--bench')
+            known = ('train', 'serve', 'serve_batch', 'launch',
+                     'checkpoint')
+            if idx + 1 >= len(sys.argv) or \
+                    sys.argv[idx + 1] not in known:
+                print(f'usage: bench.py --bench {"|".join(known)}',
+                      file=sys.stderr)
+                raise SystemExit(2)
+            mode = sys.argv[idx + 1]
+        if mode == 'checkpoint':
+            checkpoint_main()
+        elif mode == 'serve':
             serve_main()
         elif mode == 'serve_batch':
             serve_batch_main()

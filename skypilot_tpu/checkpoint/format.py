@@ -1,0 +1,250 @@
+"""On-disk checkpoint format: pytree metadata + shard files.
+
+A checkpoint step directory holds
+
+    step_00000042/
+        COMMITTED                  # commit marker (commit.py)
+        manifest.json              # merged manifest, written by rank 0
+        manifest.host0.json        # per-host manifests (multi-host)
+        h0_00000_0.bin             # shard files: h{proc}_{leaf}_{shard}
+        ...
+
+The manifest maps stable leaf keys (tree paths joined with ``/``) to
+dtype/global shape and a list of shards, each with its file, the
+global index it covers (``[[start, stop], ...]`` per dim), byte size
+and a crc32 checksum. A leaf sharded over hosts therefore assembles
+from several files; a replicated leaf is written once (by the process
+holding ``replica_id == 0`` of each shard).
+
+Keys are derived with ``jax.tree_util.tree_flatten_with_path`` so any
+registered pytree (dicts, lists, dataclasses like ``TrainState``,
+optax named tuples) round-trips. Raw (template-free) restore rebuilds
+nested dicts from the keys, turning all-digit levels back into lists.
+"""
+import json
+import os
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MANIFEST_NAME = 'manifest.json'
+HOST_MANIFEST_FMT = 'manifest.host{proc}.json'
+FORMAT_VERSION = 1
+
+
+class CheckpointError(Exception):
+    """A checkpoint save failed."""
+
+
+class CheckpointRestoreError(Exception):
+    """A checkpoint restore failed (missing/corrupt leaves)."""
+
+
+def key_str(path: Sequence[Any]) -> str:
+    """Stable string key for a tree path (GetAttrKey/DictKey/
+    SequenceKey/FlattenedIndexKey all reduce to their name/index)."""
+    parts = []
+    for k in path:
+        if hasattr(k, 'name'):       # GetAttrKey
+            parts.append(str(k.name))
+        elif hasattr(k, 'key'):      # DictKey / FlattenedIndexKey
+            parts.append(str(k.key))
+        elif hasattr(k, 'idx'):      # SequenceKey
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return '/'.join(parts)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def dtype_from_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 & friends live in ml_dtypes (a jax dependency),
+        # not numpy proper.
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def normalize_index(index, shape: Sequence[int]) -> List[List[int]]:
+    """Shard index (tuple of slices) -> [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def full_index(shape: Sequence[int]) -> List[List[int]]:
+    return [[0, int(d)] for d in shape]
+
+
+def write_shard_file(dirpath: str, filename: str,
+                     array: np.ndarray) -> Tuple[int, int]:
+    """Write one host-resident shard; returns (nbytes, crc32). The
+    file is fsynced — the commit rename must never land before its
+    data blocks do."""
+    # memoryview, not tobytes(): no second full copy of the shard on
+    # top of the snapshot the async writer already holds. ml_dtypes
+    # arrays (bfloat16 etc.) reject the buffer protocol, so they go
+    # through a (still zero-copy) uint8 reinterpreting view.
+    arr = np.ascontiguousarray(array)
+    try:
+        buf = memoryview(arr).cast('B')
+    except (ValueError, TypeError):
+        buf = memoryview(arr.reshape(-1).view(np.uint8))
+    path = os.path.join(dirpath, filename)
+    with open(path, 'wb') as f:
+        f.write(buf)
+        f.flush()
+        os.fsync(f.fileno())
+    return len(buf), zlib.crc32(buf)
+
+
+def read_shard_file(dirpath: str, entry: Dict[str, Any],
+                    dtype: np.dtype,
+                    shard_shape: Sequence[int]) -> np.ndarray:
+    path = os.path.join(dirpath, entry['file'])
+    with open(path, 'rb') as f:
+        data = f.read()
+    if len(data) != entry['nbytes']:
+        raise CheckpointRestoreError(
+            f'{path}: expected {entry["nbytes"]} bytes, '
+            f'got {len(data)}')
+    if zlib.crc32(data) != entry['checksum']:
+        raise CheckpointRestoreError(f'{path}: checksum mismatch '
+                                     '(corrupt shard)')
+    return np.frombuffer(data, dtype=dtype).reshape(shard_shape)
+
+
+def leaf_entry(dtype, shape: Sequence[int],
+               sharding: Optional[str] = None) -> Dict[str, Any]:
+    return {
+        'dtype': dtype_name(dtype),
+        'shape': [int(d) for d in shape],
+        'sharding': sharding,
+        'shards': [],
+    }
+
+
+def write_host_manifest(dirpath: str, proc: int,
+                        leaves: Dict[str, Any],
+                        process_count: int) -> None:
+    doc = {
+        'format_version': FORMAT_VERSION,
+        'process_index': proc,
+        'process_count': process_count,
+        'leaves': leaves,
+    }
+    _write_json(os.path.join(dirpath,
+                             HOST_MANIFEST_FMT.format(proc=proc)),
+                doc)
+
+
+def merge_host_manifests(dirpath: str,
+                         process_count: int) -> Dict[str, Any]:
+    """Rank 0's merge: union every host's leaf entries (shard lists
+    concatenate; dtype/shape must agree)."""
+    merged: Dict[str, Any] = {}
+    for proc in range(process_count):
+        path = os.path.join(dirpath,
+                            HOST_MANIFEST_FMT.format(proc=proc))
+        with open(path, encoding='utf-8') as f:
+            doc = json.load(f)
+        for key, entry in doc['leaves'].items():
+            if key not in merged:
+                merged[key] = {k: (list(v) if k == 'shards' else v)
+                               for k, v in entry.items()}
+                continue
+            have = merged[key]
+            if (have['dtype'] != entry['dtype'] or
+                    have['shape'] != entry['shape']):
+                raise CheckpointError(
+                    f'host manifests disagree on leaf {key!r}: '
+                    f'{have["dtype"]}{have["shape"]} vs '
+                    f'{entry["dtype"]}{entry["shape"]}')
+            have['shards'].extend(entry['shards'])
+    return merged
+
+
+def write_manifest(dirpath: str, step: int,
+                   leaves: Dict[str, Any],
+                   process_count: int) -> None:
+    doc = {
+        'format_version': FORMAT_VERSION,
+        'step': int(step),
+        'process_count': process_count,
+        'leaves': leaves,
+    }
+    _write_json(os.path.join(dirpath, MANIFEST_NAME), doc)
+
+
+def read_manifest(step_dir: str) -> Dict[str, Any]:
+    path = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointRestoreError(
+            f'unreadable manifest {path}: {e}') from e
+
+
+def assemble_leaf(step_dir: str, key: str,
+                  entry: Dict[str, Any]) -> np.ndarray:
+    """Reconstruct one leaf's global array from its shard files."""
+    dtype = dtype_from_name(entry['dtype'])
+    shape = tuple(entry['shape'])
+    shards = entry['shards']
+    if not shards:
+        raise CheckpointRestoreError(f'leaf {key!r} has no shards')
+    if len(shards) == 1 and shards[0]['index'] == full_index(shape):
+        return read_shard_file(step_dir, shards[0], dtype, shape)
+    out = np.empty(shape, dtype=dtype)
+    covered = 0
+    for shard in shards:
+        idx = tuple(slice(lo, hi) for lo, hi in shard['index'])
+        shard_shape = tuple(hi - lo for lo, hi in shard['index'])
+        out[idx] = read_shard_file(step_dir, shard, dtype,
+                                   shard_shape)
+        covered += int(np.prod(shard_shape)) if shard_shape else 1
+    want = int(np.prod(shape)) if shape else 1
+    if covered < want:
+        raise CheckpointRestoreError(
+            f'leaf {key!r}: shards cover {covered} of {want} '
+            'elements (incomplete multi-host write?)')
+    return out
+
+
+def nest(flat: Dict[str, Any]) -> Any:
+    """Rebuild a nested structure from ``key -> value``; levels whose
+    keys are all digits become lists (tuple/optax-state subtrees)."""
+    root: Dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split('/')
+        node = root
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return _listify(root)
+
+
+def _listify(node: Any) -> Any:
+    if not isinstance(node, dict):
+        return node
+    out = {k: _listify(v) for k, v in node.items()}
+    if out and all(k.isdigit() for k in out):
+        return [out[k] for k in sorted(out, key=int)]
+    return out
+
+
+def _write_json(path: str, doc: Dict[str, Any]) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())

@@ -1,0 +1,96 @@
+"""Optional orbax checkpoint engine.
+
+The pre-native engine, kept behind the ``data/checkpoint.py`` facade
+for users who want orbax/TensorStore semantics
+(``SKYTPU_CKPT_ENGINE=orbax``). This is the ONLY module in the tree
+allowed to import orbax — a grep lint (tests/test_checkpoint.py)
+enforces that the native path can never silently regress into a hard
+orbax dependency.
+"""
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+from skypilot_tpu import tpu_logging
+
+logger = tpu_logging.init_logger(__name__)
+
+
+class OrbaxCheckpointManager:
+    """Thin orbax wrapper with sane defaults for slice training."""
+
+    def __init__(self, path: str, save_interval_steps: int = 100,
+                 max_to_keep: Optional[int] = 3):
+        import orbax.checkpoint as ocp
+
+        path = os.path.expanduser(path)
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=save_interval_steps,
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=True,
+        )
+        self._manager = ocp.CheckpointManager(path, options=options)
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Save if the step hits the interval; async (training
+        continues while the write streams to the bucket)."""
+        import orbax.checkpoint as ocp
+        return self._manager.save(
+            step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore_or(self, state: Any) -> Tuple[Any, int]:
+        """Restore the latest checkpoint if one exists; returns
+        (state, next_step)."""
+        import orbax.checkpoint as ocp
+        step = self.latest_step()
+        if step is None:
+            return state, 0
+        logger.info('Restoring checkpoint step %d from %s', step,
+                    self.path)
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(state))
+        return restored, step + 1
+
+    def restore_latest_raw(self,
+                           keys: Optional[Sequence[str]] = None
+                           ) -> Optional[Any]:
+        """Restore the latest checkpoint WITHOUT a template — raw
+        (host) arrays in the saved tree structure. ``keys`` selects
+        top-level subtrees (e.g. ``('params', 'lora')``) via orbax
+        partial restore, so serving does NOT download/materialize the
+        optimizer moments — for an 8B fp32 TrainState that is ~64 GB
+        of Adam state skipped."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        logger.info('Restoring checkpoint step %d from %s', step,
+                    self.path)
+        if keys is None:
+            return self._manager.restore(step)
+        import orbax.checkpoint as ocp
+        # A read-only manager with an explicit PyTree handler: the
+        # main manager's registry is tied to StandardSave and cannot
+        # serve item_metadata before a save/restore happens in this
+        # process.
+        mgr = ocp.CheckpointManager(
+            self.path, item_handlers=ocp.PyTreeCheckpointHandler())
+        try:
+            meta = mgr.item_metadata(step)
+            tree = meta.tree if hasattr(meta, 'tree') else meta
+            item = {k: tree[k] for k in keys
+                    if k in tree and tree[k] is not None}
+            return mgr.restore(
+                step, args=ocp.args.PyTreeRestore(
+                    item=item, partial_restore=True))
+        finally:
+            mgr.close()
+
+    def wait(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()

@@ -7,6 +7,7 @@ script.
 """
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 import click
@@ -454,6 +455,140 @@ def cost_report():
 
 
 # ---------------------------------------------------------------------
+# Checkpoints group (native checkpoint subsystem,
+# skypilot_tpu/checkpoint/ — docs/checkpointing.md).
+# ---------------------------------------------------------------------
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ('B', 'KiB', 'MiB', 'GiB', 'TiB'):
+        if n < 1024 or unit == 'TiB':
+            return f'{n:.1f}{unit}' if unit != 'B' else f'{n}B'
+        n /= 1024
+    return f'{n}B'
+
+
+def _step_stats(step_dir: str):
+    """(bytes, files) under one step dir."""
+    total = files = 0
+    for dirpath, _, names in os.walk(step_dir):
+        for name in names:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+                files += 1
+            except OSError:
+                pass
+    return total, files
+
+
+@cli.group(name='checkpoints')
+def checkpoints_group():
+    """Inspect / garbage-collect native checkpoint directories."""
+
+
+@checkpoints_group.command(name='ls')
+@click.argument('directory')
+def checkpoints_ls(directory):
+    """List committed checkpoint steps (and torn writes) in a
+    checkpoint lineage directory."""
+    from skypilot_tpu.checkpoint import commit as commit_lib
+    directory = os.path.expanduser(directory)
+    steps = commit_lib.committed_steps(directory)
+    latest = steps[-1] if steps else None
+    table = ux_utils.Table(['STEP', 'SIZE', 'FILES', 'COMMITTED'])
+    for step in steps:
+        step_dir = os.path.join(directory,
+                                commit_lib.step_dir_name(step))
+        size, files = _step_stats(step_dir)
+        marker = os.path.join(step_dir, commit_lib.COMMITTED_MARKER)
+        try:
+            committed_at = time.strftime(
+                '%Y-%m-%d %H:%M:%S',
+                time.localtime(os.path.getmtime(marker)))
+        except OSError:
+            committed_at = '-'
+        name = f'{step} (latest)' if step == latest else str(step)
+        table.add_row([name, _fmt_bytes(size), files, committed_at])
+    click.echo(table.get_string() if steps else
+               f'No committed checkpoints in {directory}.')
+    # Both torn forms (mirrors commit.gc_orphaned_tmp): .tmp dirs AND
+    # markerless step dirs left by a torn non-atomic rename.
+    torn = []
+    for n in (os.listdir(directory)
+              if os.path.isdir(directory) else []):
+        path = os.path.join(directory, n)
+        if not os.path.isdir(path):
+            continue
+        if n.endswith(commit_lib.TMP_SUFFIX):
+            torn.append(n)
+        elif commit_lib.parse_step(n) is not None and \
+                not commit_lib.is_committed(path):
+            torn.append(n + ' (markerless)')
+    if torn:
+        click.echo(f'Torn writes (uncommitted, GC-able): '
+                   f'{", ".join(sorted(torn))}')
+
+
+@checkpoints_group.command(name='gc')
+@click.argument('directory')
+@click.option('--max-to-keep', type=int, default=None,
+              help='Keep only the newest N committed steps (the '
+                   'latest step is never deleted).')
+@click.option('--keep-period', type=int, default=None,
+              help='Steps divisible by this are milestone '
+                   'checkpoints and never deleted.')
+@click.option('--min-age', 'min_age', type=float, default=None,
+              help='Only sweep torn writes older than this many '
+                   'seconds (default 60 — a fresh torn dir may '
+                   'belong to a LIVE writer; pass 0 only if you '
+                   'know no save is in flight).')
+@click.option('--dry-run', is_flag=True)
+@click.option('--yes', '-y', is_flag=True)
+def checkpoints_gc(directory, max_to_keep, keep_period, min_age,
+                   dry_run, yes):
+    """Remove torn writes and apply retention to a checkpoint dir."""
+    from skypilot_tpu.checkpoint import commit as commit_lib
+    from skypilot_tpu.checkpoint import retention as retention_lib
+    directory = os.path.expanduser(directory)
+    if min_age is None:
+        min_age = commit_lib.GC_MIN_AGE_SECONDS
+    steps = commit_lib.committed_steps(directory)
+    doomed = retention_lib.plan_retention(steps, max_to_keep,
+                                          keep_period)
+    if dry_run:
+        click.echo(f'Would remove steps: {doomed or "none"} '
+                   f'(of {len(steps)} committed); plus torn writes '
+                   f'older than {min_age:g}s.')
+        return
+    if doomed and not yes and sys.stdin.isatty():
+        click.confirm(f'Remove {len(doomed)} checkpoint step(s) '
+                      f'{doomed} from {directory}?', default=False,
+                      abort=True)
+    torn_before = [
+        n for n in (os.listdir(directory)
+                    if os.path.isdir(directory) else [])
+        if (n.endswith(commit_lib.TMP_SUFFIX)
+            and commit_lib.parse_step(
+                n[:-len(commit_lib.TMP_SUFFIX)]) is not None)
+        or (commit_lib.parse_step(n) is not None
+            and not commit_lib.is_committed(
+                os.path.join(directory, n)))
+    ]
+    removed_tmp = commit_lib.gc_orphaned_tmp(
+        directory, min_age_seconds=min_age)
+    skipped = len(torn_before) - len(removed_tmp)
+    deleted = retention_lib.apply_retention(directory, max_to_keep,
+                                            keep_period)
+    msg = (f'Removed steps: {deleted or "none"}; torn writes '
+           f'swept: {len(removed_tmp)}.')
+    if skipped > 0:
+        msg += (f' Left {skipped} fresh torn write(s) younger than '
+                f'{min_age:g}s (possibly a live writer — pass '
+                '--min-age 0 to force).')
+    click.echo(msg)
+
+
+# ---------------------------------------------------------------------
 # Managed jobs group (analog of ``sky jobs``, sky/cli.py:3567).
 # ---------------------------------------------------------------------
 
@@ -494,10 +629,13 @@ def jobs_queue():
     from skypilot_tpu.jobs import core as jobs_core
     records = jobs_core.queue()
     table = ux_utils.Table(['ID', 'NAME', 'STATUS', 'RECOVERIES',
-                            'CLUSTER'])
+                            'RESUME@', 'CLUSTER'])
     for r in records:
+        resume = r.get('resume_step')
         table.add_row([r['job_id'], r['name'], r['status'].value,
-                       r['recovery_count'], r['task_cluster'] or '-'])
+                       r['recovery_count'],
+                       '-' if resume is None else resume,
+                       r['task_cluster'] or '-'])
     click.echo(table.get_string() if records else 'No managed jobs.')
 
 

@@ -4,9 +4,18 @@ The reference has NO checkpoint code — its pattern is user-level
 (mount a bucket, write checkpoints there; recipes demonstrate it,
 ``llm/llama-3_1-finetuning/lora.yaml:24-31``), with
 ``SKYPILOT_TASK_ID`` distinguishing runs. This module upgrades that
-pattern to a library: orbax async checkpointing into the mounted
-bucket path, keyed by task id, with restore-latest on (re)start —
-exactly what a managed job needs to survive TPU spot preemption.
+pattern to a library — and, as of the native checkpoint subsystem
+(``skypilot_tpu/checkpoint/``), owns the engine too: async sharded
+saves with atomic commit into the mounted bucket path, keyed by task
+id, with restore-latest on (re)start — exactly what a managed job
+needs to survive TPU spot preemption.
+
+This module is the ENGINE-SELECTING FACADE. The default engine is
+the dependency-free native one (stdlib + numpy/jax); orbax remains
+available for users who want TensorStore semantics:
+
+    SKYTPU_CKPT_ENGINE=native   (default)
+    SKYTPU_CKPT_ENGINE=orbax
 
 Usage in a training loop::
 
@@ -18,11 +27,24 @@ Usage in a training loop::
     ckpt.wait()
 """
 import os
-from typing import Any, Optional, Tuple
+import re
+from typing import Any, Optional, Sequence, Tuple
 
 from skypilot_tpu import tpu_logging
 
 logger = tpu_logging.init_logger(__name__)
+
+ENGINE_ENV_VAR = 'SKYTPU_CKPT_ENGINE'
+ENGINES = ('native', 'orbax')
+
+# Managed-job recovery stamps SKYTPU_TASK_ID as
+# ``managed-<job>-<task>-<launch_seq>`` (jobs/controller.py); the
+# trailing counter distinguishes launches, the stripped prefix is the
+# checkpoint lineage every retry shares. The strip is gated on the
+# ``managed-`` prefix so an ordinary task the USER happened to name
+# with a trailing ``-<digits>`` (e.g. ``exp-1`` vs ``exp-2``) never
+# has its lineage silently merged with a sibling's.
+_MANAGED_RETRY_RE = re.compile(r'^(managed-\d+-\d+)-\d+$')
 
 
 def task_checkpoint_dir(base_dir: str) -> str:
@@ -33,85 +55,76 @@ def task_checkpoint_dir(base_dir: str) -> str:
                              os.environ.get('SKYPILOT_TASK_ID',
                                             'default'))
     # Recovery runs share the lineage: strip trailing retry counters.
+    m = _MANAGED_RETRY_RE.match(task_id)
+    if m:
+        task_id = m.group(1)
     return os.path.join(os.path.expanduser(base_dir), task_id)
 
 
+def selected_engine(engine: Optional[str] = None) -> str:
+    engine = (engine or
+              os.environ.get(ENGINE_ENV_VAR, 'native')).lower()
+    if engine not in ENGINES:
+        raise ValueError(
+            f'unknown checkpoint engine {engine!r} '
+            f'(${ENGINE_ENV_VAR}); choose from {ENGINES}')
+    return engine
+
+
 class CheckpointManager:
-    """Thin orbax wrapper with sane defaults for slice training."""
+    """Engine-selecting facade over the native and orbax engines.
+
+    The surface the recipes (``recipes/finetune.py``,
+    ``recipes/serve_model.py``) program against; both engines
+    implement it in full.
+    """
 
     def __init__(self, base_dir: str, save_interval_steps: int = 100,
-                 max_to_keep: int = 3,
-                 use_task_namespace: bool = True):
-        import orbax.checkpoint as ocp
-
+                 max_to_keep: Optional[int] = 3,
+                 use_task_namespace: bool = True,
+                 engine: Optional[str] = None,
+                 **engine_kwargs):
+        engine = selected_engine(engine)
         path = (task_checkpoint_dir(base_dir) if use_task_namespace
                 else os.path.expanduser(base_dir))
-        os.makedirs(path, exist_ok=True)
-        self.path = path
-        options = ocp.CheckpointManagerOptions(
-            save_interval_steps=save_interval_steps,
-            max_to_keep=max_to_keep,
-            enable_async_checkpointing=True,
-        )
-        self._manager = ocp.CheckpointManager(path, options=options)
+        if engine == 'orbax':
+            from skypilot_tpu.checkpoint import orbax_engine
+            self._impl = orbax_engine.OrbaxCheckpointManager(
+                path, save_interval_steps=save_interval_steps,
+                max_to_keep=max_to_keep, **engine_kwargs)
+        else:
+            from skypilot_tpu.checkpoint import native
+            self._impl = native.NativeCheckpointManager(
+                path, save_interval_steps=save_interval_steps,
+                max_to_keep=max_to_keep, **engine_kwargs)
+        self.engine = engine
+        self.path = self._impl.path
 
     def maybe_save(self, step: int, state: Any) -> bool:
         """Save if the step hits the interval; async (training
         continues while the write streams to the bucket)."""
-        import orbax.checkpoint as ocp
-        return self._manager.save(
-            step, args=ocp.args.StandardSave(state))
+        return self._impl.maybe_save(step, state)
 
     def latest_step(self) -> Optional[int]:
-        return self._manager.latest_step()
+        return self._impl.latest_step()
 
     def restore_or(self, state: Any) -> Tuple[Any, int]:
         """Restore the latest checkpoint if one exists; returns
         (state, next_step)."""
-        import orbax.checkpoint as ocp
-        step = self.latest_step()
-        if step is None:
-            return state, 0
-        logger.info('Restoring checkpoint step %d from %s', step,
-                    self.path)
-        restored = self._manager.restore(
-            step, args=ocp.args.StandardRestore(state))
-        return restored, step + 1
+        return self._impl.restore_or(state)
 
-    def restore_latest_raw(self, keys=None) -> Optional[Any]:
+    def restore_latest_raw(self,
+                           keys: Optional[Sequence[str]] = None
+                           ) -> Optional[Any]:
         """Restore the latest checkpoint WITHOUT a template — raw
         (host) arrays in the saved tree structure. ``keys`` selects
-        top-level subtrees (e.g. ``('params', 'lora')``) via orbax
-        partial restore, so serving does NOT download/materialize the
-        optimizer moments — for an 8B fp32 TrainState that is ~64 GB
-        of Adam state skipped."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        logger.info('Restoring checkpoint step %d from %s', step,
-                    self.path)
-        if keys is None:
-            return self._manager.restore(step)
-        import orbax.checkpoint as ocp
-        # A read-only manager with an explicit PyTree handler: the
-        # main manager's registry is tied to StandardSave and cannot
-        # serve item_metadata before a save/restore happens in this
-        # process.
-        mgr = ocp.CheckpointManager(
-            self.path, item_handlers=ocp.PyTreeCheckpointHandler())
-        try:
-            meta = mgr.item_metadata(step)
-            tree = meta.tree if hasattr(meta, 'tree') else meta
-            item = {k: tree[k] for k in keys
-                    if k in tree and tree[k] is not None}
-            return mgr.restore(
-                step, args=ocp.args.PyTreeRestore(
-                    item=item, partial_restore=True))
-        finally:
-            mgr.close()
+        top-level subtrees (e.g. ``('params', 'lora')``), so serving
+        does NOT download/materialize the optimizer moments — for an
+        8B fp32 TrainState that is ~64 GB of Adam state skipped."""
+        return self._impl.restore_latest_raw(keys=keys)
 
     def wait(self) -> None:
-        self._manager.wait_until_finished()
+        self._impl.wait()
 
     def close(self) -> None:
-        self._manager.close()
+        self._impl.close()

@@ -10,7 +10,7 @@ completion, advance the chain.
 import argparse
 import os
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, state
@@ -50,6 +50,90 @@ class JobsController:
         # starts as soon as the preemption is observable.
         self._wake = threading.Event()
         self._watchdog: Optional[watchdog_lib.HealthWatchdog] = None
+        # Monotonic launch counter: every (re)launch of any task gets
+        # a distinct SKYTPU_TASK_ID suffix, while the stripped prefix
+        # (the checkpoint LINEAGE, data/checkpoint.py
+        # task_checkpoint_dir) stays stable across recoveries.
+        self._launch_seq = 0
+
+    # -- checkpoint lineage ---------------------------------------------
+
+    def _lineage_id(self, task_idx: int) -> str:
+        return f'managed-{self.job_id}-{task_idx}'
+
+    def _stamp_task_id(self, task: Task, task_idx: int) -> None:
+        """Give the NEXT launch a stable-prefix task id:
+        ``managed-<job>-<task>-<launch_seq>``. The trailing counter
+        distinguishes launches; checkpoints namespace by the stripped
+        prefix, so every recovery shares one lineage."""
+        self._launch_seq += 1
+        task_id = f'{self._lineage_id(task_idx)}-{self._launch_seq}'
+        # Task envs override the driver's generated id
+        # (runtime/driver.py applies spec envs after the contract).
+        task.update_envs({'SKYTPU_TASK_ID': task_id,
+                          'SKYPILOT_TASK_ID': task_id})
+
+    def _checkpoint_resume_step(
+            self, task: Task,
+            task_idx: int) -> 'Tuple[bool, Optional[int]]':
+        """``(visible, step)``: the latest COMMITTED native-checkpoint
+        step in the task's lineage dir, when the controller can see it
+        (the task declares its checkpoint base via the
+        SKYTPU_CHECKPOINT_DIR env; the atomic-commit markers make this
+        readable mid-save). ``visible=False`` means the base dir is
+        not reachable from the controller host (e.g. a bucket mounted
+        only on task clusters) — the resume state is UNKNOWN, which
+        must not be reported as a step-0 restart."""
+        base = task.envs.get('SKYTPU_CHECKPOINT_DIR')
+        if not base:
+            return False, None
+        base = os.path.expanduser(base)
+        if not os.path.isdir(base):
+            return False, None
+        from skypilot_tpu import checkpoint as checkpoint_lib
+        lineage_dir = os.path.join(base,
+                                   self._lineage_id(task_idx))
+        try:
+            return True, checkpoint_lib.latest_committed_step(
+                lineage_dir)
+        except OSError:
+            return False, None
+
+    def _prepare_relaunch(self, task: Task, task_idx: int) -> None:
+        """Everything a recovery relaunch needs, in lockstep: record
+        the resume point, then stamp the next launch's task id (the
+        stamp is what keeps the checkpoint lineage shared — skipping
+        it would silently restart training from step 0)."""
+        self._note_resume_point(task, task_idx)
+        self._stamp_task_id(task, task_idx)
+
+    def _note_resume_point(self, task: Task, task_idx: int) -> None:
+        """Surface "resuming at step N" in logs + managed-job state
+        before a recovery relaunch (or note the fresh start)."""
+        visible, step = self._checkpoint_resume_step(task, task_idx)
+        if not visible:
+            if task.envs.get('SKYTPU_CHECKPOINT_DIR'):
+                # The dir exists only on task clusters: the task will
+                # still resume via its own restore-latest; the
+                # controller just cannot SEE the step. Leave the last
+                # recorded value rather than asserting a fresh start.
+                logger.info(
+                    'Recovery of managed job %d: checkpoint dir not '
+                    'visible from the controller; resume state '
+                    'unknown (the task restores independently)',
+                    self.job_id)
+            return
+        jobs_state.set_resume_step(self.job_id, step)
+        if step is not None:
+            logger.info(
+                'Recovery of managed job %d will resume at '
+                'checkpoint step %d (lineage %s)', self.job_id, step,
+                self._lineage_id(task_idx))
+        else:
+            logger.info(
+                'Recovery of managed job %d found no committed '
+                'checkpoint yet (lineage %s); task restarts from '
+                'step 0', self.job_id, self._lineage_id(task_idx))
 
     def _load_tasks(self):
         configs = common_utils.read_yaml_all(self.dag_yaml_path)
@@ -164,6 +248,7 @@ class JobsController:
         jobs_state.set_status(self.job_id,
                               jobs_state.ManagedJobStatus.STARTING)
 
+        self._stamp_task_id(task, idx)
         job_id = strategy.launch(task, cluster_name)
         if job_id is None:
             return jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE
@@ -223,6 +308,7 @@ class JobsController:
                 jobs_state.set_status(
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
+                self._prepare_relaunch(task, idx)
                 job_id = strategy.recover(task, cluster_name,
                                           preempted_region)
                 if job_id is None:
@@ -256,6 +342,7 @@ class JobsController:
                     jobs_state.set_status(
                         self.job_id,
                         jobs_state.ManagedJobStatus.RECOVERING)
+                    self._prepare_relaunch(task, idx)
                     job_id = strategy.launch(task, cluster_name)
                     if job_id is not None:
                         jobs_state.set_status(
@@ -278,6 +365,7 @@ class JobsController:
                 jobs_state.set_status(
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
+                self._prepare_relaunch(task, idx)
                 job_id = strategy.recover(
                     task, cluster_name,
                     self._cluster_region(cluster_name))

@@ -41,8 +41,8 @@ _PAGE = """<!doctype html>
 <div id="updated"></div>
 <table id="jobs"><thead><tr>
  <th>ID</th><th>Name</th><th>Status</th><th>Submitted</th>
- <th>Duration</th><th>Recoveries</th><th>Cluster</th>
- <th>Failure</th><th></th>
+ <th>Duration</th><th>Recoveries</th><th>Resume step</th>
+ <th>Cluster</th><th>Failure</th><th></th>
 </tr></thead><tbody></tbody></table>
 <script>
 function fmtTs(t) {
@@ -66,8 +66,9 @@ async function refresh() {
     // textContent only — job names / failure reasons are user-
     // controlled strings; never interpolate them into HTML.
     const cells = [j.job_id, j.name, j.status, fmtTs(j.submitted_at),
-                   fmtDur(j), j.recovery_count, j.task_cluster || '-',
-                   j.failure_reason || ''];
+                   fmtDur(j), j.recovery_count,
+                   j.resume_step == null ? '-' : j.resume_step,
+                   j.task_cluster || '-', j.failure_reason || ''];
     for (let i = 0; i < cells.length; i++) {
       const td = document.createElement('td');
       td.textContent = String(cells[i]);

@@ -66,6 +66,12 @@ def _create_tables(cursor, conn):
         recovery_count INTEGER DEFAULT 0,
         dag_yaml_path TEXT,
         failure_reason TEXT)""")
+    # Migration for pre-checkpoint rows: the latest COMMITTED native
+    # checkpoint step observed for this job — recovery resumes here,
+    # and the queue/dashboard show "resuming at step N" instead of a
+    # silent fresh start.
+    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
+                                 'resume_step', 'INTEGER')
     # Durable teardown queue: clusters that lost their owner (dead
     # controller) and must be reclaimed. Rows survive process death —
     # every reconcile AND the controller skylet event drain them until
@@ -166,6 +172,14 @@ def set_controller_job(job_id: int, controller_job_id: int) -> None:
         (controller_job_id, job_id))
 
 
+def set_resume_step(job_id: int, step: Optional[int]) -> None:
+    """Record the latest committed checkpoint step for the job (the
+    step a recovery will resume from; None = no checkpoint seen)."""
+    _db().execute_and_commit(
+        'UPDATE managed_jobs SET resume_step=? WHERE job_id=?',
+        (step, job_id))
+
+
 def bump_recovery(job_id: int) -> int:
     db = _db()
     db.execute_and_commit(
@@ -182,15 +196,16 @@ def get_job(job_id: int) -> Optional[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason FROM managed_jobs WHERE job_id=?',
-        (job_id,)).fetchone()
+        'failure_reason, resume_step FROM managed_jobs '
+        'WHERE job_id=?', (job_id,)).fetchone()
     return _to_record(row) if row else None
 
 
 def _to_record(row) -> Dict[str, Any]:
     (job_id, name, status, submitted_at, started_at, ended_at,
      task_cluster, controller_cluster, controller_job_id,
-     recovery_count, dag_yaml_path, failure_reason) = row
+     recovery_count, dag_yaml_path, failure_reason,
+     resume_step) = row
     return {
         'job_id': job_id,
         'name': name,
@@ -204,6 +219,7 @@ def _to_record(row) -> Dict[str, Any]:
         'recovery_count': recovery_count,
         'dag_yaml_path': dag_yaml_path,
         'failure_reason': failure_reason,
+        'resume_step': resume_step,
     }
 
 
@@ -212,7 +228,7 @@ def get_jobs() -> List[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason FROM managed_jobs '
+        'failure_reason, resume_step FROM managed_jobs '
         'ORDER BY job_id DESC').fetchall()
     return [_to_record(r) for r in rows]
 

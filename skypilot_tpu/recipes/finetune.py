@@ -18,6 +18,7 @@ the v6e README):
         --lora-rank 16 --checkpoint-dir /checkpoints
 """
 import argparse
+import os
 import time
 
 import numpy as np
@@ -47,7 +48,12 @@ def parse_args():
     p.add_argument('--data', default=None,
                    help='tokenized dataset (.npy of token ids)')
     p.add_argument('--synthetic', action='store_true', default=None)
-    p.add_argument('--checkpoint-dir', default=None)
+    # Default from the env contract: a managed job declares its
+    # checkpoint base once (task env SKYTPU_CHECKPOINT_DIR), the
+    # recipe picks it up here AND the jobs controller reads the same
+    # env to report "resuming at step N" on recovery.
+    p.add_argument('--checkpoint-dir',
+                   default=os.environ.get('SKYTPU_CHECKPOINT_DIR'))
     p.add_argument('--checkpoint-interval', type=int, default=50)
     p.add_argument('--param-dtype', default='bf16',
                    choices=['bf16', 'f32'])

@@ -69,8 +69,24 @@ def main():
                                  use_task_namespace=False)
         raw = ckpt.restore_latest_raw(keys=('params', 'lora'))
         if raw is None:
+            # Name the RESOLVED directory and list what is actually
+            # there: finetune checkpoints are task-id namespaced
+            # (data/checkpoint.task_checkpoint_dir), so the committed
+            # steps usually live one subdirectory below the
+            # --checkpoint-dir the user passed.
+            resolved = ckpt.path
+            try:
+                entries = sorted(os.listdir(resolved))
+            except OSError:
+                entries = []
+            listing = ', '.join(entries[:20]) if entries else '(empty)'
             raise SystemExit(
-                f'no checkpoint found under {args.checkpoint_dir}')
+                f'no committed checkpoint found in {resolved} '
+                f'(from --checkpoint-dir {args.checkpoint_dir}); the '
+                f'directory contains: {listing}. Finetune runs '
+                'namespace checkpoints by task id — point '
+                '--checkpoint-dir at the task-id subdirectory that '
+                'holds the step_* dirs.')
         ckpt_params = raw['params']
         if raw.get('lora') is not None:
             # Serve merged weights — no adapter math in the hot

@@ -13,6 +13,9 @@ Sites (one per recovery path the paper cares about):
     provision.launch  managed-job cluster (re)launch
     serve.probe       replica readiness probe
     jobs.poll         managed-job status poll
+    checkpoint.save   native checkpoint write→commit window (a
+                      ``preempt`` tears the write between the shard
+                      files and the commit rename)
 
 Activation:
   - programmatically: ``faults.arm('agent.health', 'error', 0.3)``
@@ -39,7 +42,7 @@ from skypilot_tpu import tpu_logging
 logger = tpu_logging.init_logger(__name__)
 
 SITES = ('agent.run', 'agent.health', 'provision.launch',
-         'serve.probe', 'jobs.poll')
+         'serve.probe', 'jobs.poll', 'checkpoint.save')
 KINDS = ('error', 'timeout', 'preempt')
 
 ENV_VAR = 'SKYTPU_FAULTS'

@@ -1,0 +1,733 @@
+"""Native checkpoint subsystem (skypilot_tpu/checkpoint/):
+
+- sharded TrainState round-trip on CPU with orbax ABSENT;
+- atomic commit: a torn write (crash between shard files and the
+  commit rename) is never visible, startup GC sweeps it;
+- retention GC semantics (max_to_keep / keep_period / never-latest);
+- bounded queue-depth backpressure in the async writer;
+- multi-host coordination (rank 0 commits only after every host's
+  manifest lands; complementary shards assemble);
+- task-id lineage stripping (recovery retries share a checkpoint
+  lineage — the satellite regression);
+- injected-preemption e2e: the relaunched managed job RESUMES at the
+  last committed step, and the resume step is visible in managed-job
+  state (extends PR 2's recovery e2e, which only proved relaunch);
+- grep lint: ``import orbax`` nowhere outside the orbax engine.
+"""
+import builtins
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.checkpoint import (NativeCheckpointManager,
+                                     commit as commit_lib,
+                                     format as format_lib,
+                                     retention as retention_lib,
+                                     writer as writer_lib)
+from skypilot_tpu.checkpoint.format import (CheckpointError,
+                                            CheckpointRestoreError)
+from skypilot_tpu.data import checkpoint as facade
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))
+
+
+def _mgr(path, **kwargs):
+    kwargs.setdefault('save_interval_steps', 1)
+    kwargs.setdefault('process_index', 0)
+    kwargs.setdefault('process_count', 1)
+    return NativeCheckpointManager(str(path), **kwargs)
+
+
+def _age_dir(path, seconds=120):
+    """Backdate a torn-write dir past the GC's live-writer grace."""
+    past = time.time() - seconds
+    for name in os.listdir(path):
+        os.utime(os.path.join(path, name), (past, past))
+    os.utime(path, (past, past))
+
+
+def _np_tree():
+    return {
+        'params': {'w': np.arange(32, dtype=np.float32).reshape(8, 4),
+                   'b': np.ones(4, np.float32)},
+        'step': np.int64(7),
+    }
+
+
+class TestFormat:
+
+    def test_nest_rebuilds_lists_and_dicts(self):
+        flat = {
+            'params/w': 1,
+            'opt_state/0/mu': 2,
+            'opt_state/1/nu': 3,
+            'step': 4,
+        }
+        tree = format_lib.nest(flat)
+        assert tree['params'] == {'w': 1}
+        assert tree['opt_state'] == [{'mu': 2}, {'nu': 3}]
+        assert tree['step'] == 4
+
+    def test_checksum_detects_corruption(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(0, _np_tree())
+        mgr.wait()
+        step_dir = tmp_path / commit_lib.step_dir_name(0)
+        shard = next(p for p in step_dir.iterdir()
+                     if p.name.endswith('.bin'))
+        data = bytearray(shard.read_bytes())
+        data[0] ^= 0xFF
+        shard.write_bytes(bytes(data))
+        with pytest.raises(CheckpointRestoreError,
+                           match='checksum'):
+            mgr.restore_latest_raw()
+        mgr.close()
+
+
+class TestNativeRoundTrip:
+
+    def _block_orbax(self, monkeypatch):
+        """Simulate an environment with orbax absent — the tier-1
+        acceptance criterion for the native engine."""
+        real_import = builtins.__import__
+
+        def no_orbax(name, *args, **kwargs):
+            if name.split('.')[0] == 'orbax':
+                raise ImportError('orbax intentionally absent')
+            return real_import(name, *args, **kwargs)
+
+        monkeypatch.setattr(builtins, '__import__', no_orbax)
+
+    def test_sharded_trainstate_round_trip_without_orbax(
+            self, tmp_path, monkeypatch):
+        self._block_orbax(monkeypatch)
+        import jax
+
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.parallel import (MeshConfig,
+                                           init_train_state,
+                                           make_mesh)
+        config = llama.get_config('tiny')
+        mesh = make_mesh(MeshConfig(fsdp=8))
+        state, _ = init_train_state(config, mesh,
+                                    jax.random.PRNGKey(0),
+                                    lora_rank=4)
+        ckpt = facade.CheckpointManager(str(tmp_path / 'ck'),
+                                        save_interval_steps=1,
+                                        use_task_namespace=False)
+        assert ckpt.engine == 'native'
+        assert ckpt.maybe_save(3, state)
+        ckpt.wait()
+        ckpt.close()
+
+        # Restore into a DIFFERENTLY seeded template: every leaf must
+        # come back from disk, with the template's sharding.
+        other, _ = init_train_state(config, mesh,
+                                    jax.random.PRNGKey(9),
+                                    lora_rank=4)
+        ckpt2 = facade.CheckpointManager(str(tmp_path / 'ck'),
+                                         use_task_namespace=False)
+        restored, next_step = ckpt2.restore_or(other)
+        assert next_step == 4
+        for got, want in zip(jax.tree_util.tree_leaves(restored),
+                             jax.tree_util.tree_leaves(state)):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32),
+                np.asarray(want, np.float32))
+        wq = restored.params['layers']['wq']
+        assert wq.sharding == state.params['layers']['wq'].sharding
+
+        # Raw restore with subtree selection: the optimizer moments
+        # are never read (the serve warm-start path).
+        raw = ckpt2.restore_latest_raw(keys=('params', 'lora'))
+        assert 'params' in raw and 'lora' in raw
+        assert 'opt_state' not in raw and 'step' not in raw
+        # A selection matching NOTHING is "no usable checkpoint",
+        # not an empty success — serve's error path depends on it.
+        assert ckpt2.restore_latest_raw(keys=('nonexistent',)) is None
+        ckpt2.close()
+
+    def test_empty_dir_restores_template_at_step_zero(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        tree = _np_tree()
+        out, start = mgr.restore_or(tree)
+        assert start == 0 and out is tree
+        assert mgr.restore_latest_raw() is None
+        mgr.close()
+
+    def test_save_interval(self, tmp_path):
+        mgr = _mgr(tmp_path, save_interval_steps=2, max_to_keep=None)
+        for step in range(5):
+            saved = mgr.maybe_save(step, _np_tree())
+            assert saved == (step % 2 == 0)
+        mgr.wait()
+        assert mgr.all_steps() == [0, 2, 4]
+        mgr.close()
+
+    def test_template_mismatch_is_loud(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(0, _np_tree())
+        mgr.wait()
+        with pytest.raises(CheckpointRestoreError,
+                           match='missing'):
+            mgr.restore(0, {'params': {'w': np.zeros((8, 4)),
+                                       'UNKNOWN': np.zeros(2)},
+                            'step': np.int64(0)})
+        mgr.close()
+
+
+class TestAtomicCommit:
+
+    def test_torn_write_is_never_visible(self, tmp_path, faults):
+        mgr = _mgr(tmp_path)
+        tree = _np_tree()
+        mgr.save(1, tree)
+        mgr.wait()
+        assert mgr.latest_step() == 1
+
+        # Kill the save between the shard write and the commit
+        # rename (the checkpoint.save fault site sits exactly there).
+        faults.arm('checkpoint.save', 'preempt', 1.0, 1)
+        mgr.save(2, tree)
+        mgr.wait()  # abandoned silently, like a dead process
+        assert mgr.latest_step() == 1  # previous step still serves
+        torn = tmp_path / commit_lib.tmp_dir_name(2)
+        assert torn.is_dir()
+        mgr.close()
+
+        # While FRESH, the torn dir is spared (it could belong to a
+        # live writer in another process) ...
+        assert commit_lib.gc_orphaned_tmp(str(tmp_path)) == []
+        assert torn.is_dir()
+        # ... and a restore-only consumer (a serve replica booting
+        # against this lineage) never sweeps — it just ignores the
+        # markerless dir.
+        _age_dir(str(torn))
+        mgr2 = _mgr(tmp_path)
+        assert torn.is_dir()
+        assert mgr2.latest_step() == 1
+        raw = mgr2.restore_latest_raw()
+        np.testing.assert_array_equal(raw['params']['w'],
+                                      tree['params']['w'])
+        # The relaunched WRITER sweeps the (now old) orphan before
+        # its first save.
+        mgr2.save(3, tree)
+        mgr2.wait()
+        assert not torn.exists()
+        assert mgr2.all_steps() == [1, 3]
+        mgr2.close()
+
+    def test_injected_error_surfaces_on_wait(self, tmp_path, faults):
+        mgr = _mgr(tmp_path)
+        faults.arm('checkpoint.save', 'error', 1.0, 1)
+        mgr.save(0, _np_tree())
+        with pytest.raises(CheckpointError, match='checkpoint.save'):
+            mgr.wait()
+        assert mgr.latest_step() is None
+        mgr.close()
+
+    def test_failed_step_can_be_retried(self, tmp_path, faults):
+        """The same-step dedup must not swallow a retry of a save
+        whose background write FAILED."""
+        mgr = _mgr(tmp_path)
+        faults.arm('checkpoint.save', 'error', 1.0, 1)
+        mgr.save(0, _np_tree())
+        with pytest.raises(CheckpointError):
+            mgr.wait()  # failure surfaces, step 0 forgotten
+        assert mgr.save(0, _np_tree())  # retry actually retries
+        mgr.wait()
+        assert mgr.latest_step() == 0
+        mgr.close()
+
+    def test_torn_rename_cannot_carry_marker(self, tmp_path):
+        """The marker lands in the FINAL dir after the rename: a
+        partially 'renamed' dir (non-atomic-rename filesystems) is a
+        torn write, never a committed checkpoint."""
+        tmp = tmp_path / commit_lib.tmp_dir_name(4)
+        tmp.mkdir()
+        (tmp / 'h0_00000_0.bin').write_bytes(b'\x00' * 8)
+        assert not (tmp / commit_lib.COMMITTED_MARKER).exists()
+        commit_lib.commit(str(tmp_path), 4)
+        final = tmp_path / commit_lib.step_dir_name(4)
+        assert (final / commit_lib.COMMITTED_MARKER).exists()
+        assert commit_lib.committed_steps(str(tmp_path)) == [4]
+
+    def test_uncommitted_dir_is_not_a_checkpoint(self, tmp_path):
+        # A step dir WITHOUT the marker (non-atomic rename on an
+        # object-store mount, or a hand-copied partial dir) must be
+        # invisible to readers and swept before the next save.
+        fake = tmp_path / commit_lib.step_dir_name(5)
+        fake.mkdir(parents=True)
+        (fake / 'h0_00000_0.bin').write_bytes(b'\x00' * 16)
+        assert commit_lib.committed_steps(str(tmp_path)) == []
+        _age_dir(str(fake))
+        mgr = _mgr(tmp_path)
+        assert mgr.latest_step() is None  # invisible to readers
+        mgr.save(0, _np_tree())           # first save sweeps it
+        mgr.wait()
+        assert not fake.exists()
+        assert mgr.latest_step() == 0
+        mgr.close()
+
+
+class TestRetention:
+
+    def test_plan_never_deletes_latest_or_milestones(self):
+        steps = [1, 2, 3, 4, 5]
+        assert retention_lib.plan_retention(steps, None) == []
+        assert retention_lib.plan_retention(steps, 2) == [1, 2, 3]
+        assert retention_lib.plan_retention(
+            steps, 2, keep_period=2) == [1]
+        assert retention_lib.plan_retention(steps, 1) == [1, 2, 3, 4]
+        assert retention_lib.plan_retention([7], 1) == []
+
+    def test_gc_applies_on_every_commit(self, tmp_path):
+        mgr = _mgr(tmp_path, max_to_keep=2, keep_period=10)
+        for step in range(12):
+            mgr.save(step, _np_tree())
+        mgr.wait()
+        # 0 and 10 survive forever (keep_period milestones), 11 is
+        # the latest, and 9 is the one other step the max_to_keep=2
+        # budget retains (latest + 1).
+        assert mgr.all_steps() == [0, 9, 10, 11]
+        mgr.close()
+
+    def test_apply_retention_on_disk(self, tmp_path):
+        mgr = _mgr(tmp_path, max_to_keep=None)
+        for step in (1, 2, 3):
+            mgr.save(step, _np_tree())
+        mgr.wait()
+        mgr.close()
+        deleted = retention_lib.apply_retention(str(tmp_path), 1)
+        assert deleted == [1, 2]
+        assert commit_lib.committed_steps(str(tmp_path)) == [3]
+
+
+class TestBackpressure:
+
+    def test_submit_blocks_at_queue_depth(self):
+        release = threading.Event()
+        taken = []
+
+        def slow_write(step, payload):
+            taken.append(step)
+            assert release.wait(timeout=10)
+            return 0
+
+        writer = writer_lib.AsyncWriter(slow_write, queue_depth=1)
+        writer.submit(0, None)
+        deadline = time.monotonic() + 5
+        while not taken and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert taken == [0]      # writer thread holds snapshot 0
+        writer.submit(1, None)   # fills the depth-1 queue
+        third_done = threading.Event()
+
+        def third():
+            writer.submit(2, None)
+            third_done.set()
+
+        t = threading.Thread(target=third, daemon=True)
+        t.start()
+        assert not third_done.wait(0.3)  # blocked: queue is full
+        release.set()
+        assert third_done.wait(5)        # drained -> unblocked
+        writer.close()
+        assert taken == [0, 1, 2]
+
+    def test_queue_depth_gauge_bounded(self, tmp_path):
+        from skypilot_tpu import metrics as metrics_lib
+        mgr = _mgr(tmp_path, queue_depth=2, max_to_keep=None)
+        for step in range(6):
+            mgr.save(step, _np_tree())
+        mgr.wait()
+        gauge = metrics_lib.registry().gauge(
+            'skytpu_ckpt_queue_depth',
+            'Checkpoint snapshots waiting for the background '
+            'writer.')
+        assert 0 <= gauge.value <= 2
+        assert mgr.all_steps() == list(range(6))
+        mgr.close()
+
+
+class TestMultiHost:
+
+    def test_rank0_commits_only_after_all_manifests(self, tmp_path):
+        tree = _np_tree()
+        m0 = _mgr(tmp_path, process_index=0, process_count=2,
+                  barrier_timeout=30.0)
+        m1 = _mgr(tmp_path, process_index=1, process_count=2)
+        done0 = threading.Event()
+
+        def rank0():
+            m0.save(0, tree)
+            m0.wait()
+            done0.set()
+
+        t = threading.Thread(target=rank0, daemon=True)
+        t.start()
+        # Rank 0 must NOT commit while rank 1's manifest is missing.
+        assert not done0.wait(0.5)
+        assert commit_lib.latest_committed_step(str(tmp_path)) is None
+        m1.save(0, tree)
+        m1.wait()
+        assert done0.wait(10)
+        assert commit_lib.latest_committed_step(str(tmp_path)) == 0
+        m0.close()
+        m1.close()
+
+    def test_barrier_timeout_leaves_step_uncommitted(self, tmp_path):
+        m0 = _mgr(tmp_path, process_index=0, process_count=2,
+                  barrier_timeout=0.2)
+        m0.save(0, _np_tree())
+        with pytest.raises(CheckpointError, match='never wrote'):
+            m0.wait()
+        assert commit_lib.latest_committed_step(str(tmp_path)) is None
+        m0.close()
+
+    def test_complementary_shards_assemble(self, tmp_path):
+        """Two hosts each write half of one leaf; the merged
+        manifest assembles the full global array."""
+        step_tmp = tmp_path / commit_lib.tmp_dir_name(0)
+        step_tmp.mkdir()
+        full = np.arange(32, dtype=np.float32).reshape(8, 4)
+        for proc, rows in ((0, (0, 4)), (1, (4, 8))):
+            entry = format_lib.leaf_entry(full.dtype, full.shape)
+            size, crc = format_lib.write_shard_file(
+                str(step_tmp), f'h{proc}_w.bin', full[rows[0]:rows[1]])
+            entry['shards'].append({
+                'file': f'h{proc}_w.bin',
+                'index': [[rows[0], rows[1]], [0, 4]],
+                'nbytes': size,
+                'checksum': crc,
+            })
+            format_lib.write_host_manifest(str(step_tmp), proc,
+                                           {'w': entry}, 2)
+        merged = format_lib.merge_host_manifests(str(step_tmp), 2)
+        assert len(merged['w']['shards']) == 2
+        format_lib.write_manifest(str(step_tmp), 0, merged, 2)
+        commit_lib.commit(str(tmp_path), 0)
+        mgr = _mgr(tmp_path)
+        raw = mgr.restore_latest_raw()
+        np.testing.assert_array_equal(raw['w'], full)
+        mgr.close()
+
+
+class TestTaskCheckpointLineage:
+    """Satellite regression: recovery retries of one managed job
+    share a checkpoint lineage (trailing retry counters stripped)."""
+
+    def test_retry_counter_stripped(self, monkeypatch, tmp_path):
+        base = str(tmp_path)
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'managed-7-0-3')
+        first = facade.task_checkpoint_dir(base)
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'managed-7-0-12')
+        retried = facade.task_checkpoint_dir(base)
+        assert first == retried == os.path.join(base, 'managed-7-0')
+
+    def test_non_counter_ids_unchanged(self, monkeypatch, tmp_path):
+        base = str(tmp_path)
+        monkeypatch.setenv('SKYTPU_TASK_ID',
+                           'sky-2026-08-03-12-00-00-77-1-mytask')
+        assert facade.task_checkpoint_dir(base).endswith('-mytask')
+        # A USER-named trailing counter is not a retry counter: two
+        # unrelated runs 'exp-1'/'exp-2' must not merge lineages.
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'exp-1')
+        assert facade.task_checkpoint_dir(base) == \
+            os.path.join(base, 'exp-1')
+        monkeypatch.delenv('SKYTPU_TASK_ID', raising=False)
+        monkeypatch.delenv('SKYPILOT_TASK_ID', raising=False)
+        assert facade.task_checkpoint_dir(base) == \
+            os.path.join(base, 'default')
+
+    def test_lineage_shared_across_retries_end_to_end(
+            self, monkeypatch, tmp_path):
+        """The bug this satellite fixes: a recovered run used to get
+        a FRESH empty lineage, so resume silently never happened."""
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'managed-1-0-1')
+        mgr = facade.CheckpointManager(str(tmp_path),
+                                       save_interval_steps=1,
+                                       process_index=0,
+                                       process_count=1)
+        mgr.maybe_save(4, _np_tree())
+        mgr.wait()
+        mgr.close()
+        # The "recovered" launch: different trailing counter.
+        monkeypatch.setenv('SKYTPU_TASK_ID', 'managed-1-0-2')
+        mgr2 = facade.CheckpointManager(str(tmp_path),
+                                        process_index=0,
+                                        process_count=1)
+        tree, start = mgr2.restore_or(_np_tree())
+        assert start == 5  # resumed, not a fresh start
+        mgr2.close()
+
+
+class TestEngineSelection:
+
+    def test_env_selects_engine(self, monkeypatch):
+        assert facade.selected_engine() == 'native'
+        monkeypatch.setenv('SKYTPU_CKPT_ENGINE', 'orbax')
+        assert facade.selected_engine() == 'orbax'
+        monkeypatch.setenv('SKYTPU_CKPT_ENGINE', 'bogus')
+        with pytest.raises(ValueError, match='bogus'):
+            facade.selected_engine()
+
+    def test_no_orbax_import_outside_engine_module(self):
+        """Grep lint (style of PR 2's no-sleep-in-retry-loop lint):
+        the native path must never silently regress into a hard
+        orbax dependency."""
+        import skypilot_tpu
+        root = os.path.dirname(skypilot_tpu.__file__)
+        allowed = os.path.join('checkpoint', 'orbax_engine.py')
+        violations = []
+        for dirpath, _, files in os.walk(root):
+            if '__pycache__' in dirpath:
+                continue
+            for fn in files:
+                if not fn.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                if rel == allowed:
+                    continue
+                with open(path, encoding='utf-8') as f:
+                    for i, line in enumerate(f):
+                        stripped = line.strip()
+                        if stripped.startswith('import orbax') or \
+                                stripped.startswith('from orbax'):
+                            violations.append(f'{rel}:{i + 1}: '
+                                              f'{stripped}')
+        assert not violations, (
+            'orbax imported outside the optional engine module '
+            f'({allowed}):\n' + '\n'.join(violations))
+
+
+class TestServeWarmStartError:
+    """Satellite: the warm-start failure names the RESOLVED directory
+    and lists its contents — the task-id namespacing used to make the
+    bare --checkpoint-dir error misleading."""
+
+    def test_error_names_resolved_dir_and_contents(
+            self, tmp_path, monkeypatch):
+        from skypilot_tpu.recipes import serve_model
+        (tmp_path / 'managed-3-0').mkdir()
+        monkeypatch.setattr(sys, 'argv', [
+            'serve_model', '--model', 'tiny',
+            '--checkpoint-dir', str(tmp_path)])
+        with pytest.raises(SystemExit) as excinfo:
+            serve_model.main()
+        msg = str(excinfo.value)
+        assert str(tmp_path) in msg
+        assert 'managed-3-0' in msg  # what is ACTUALLY there
+        assert 'task-id subdirectory' in msg
+
+
+class TestCheckpointMetrics:
+
+    def test_save_restore_metrics_export(self, tmp_path):
+        from skypilot_tpu import metrics as metrics_lib
+        fams = writer_lib.ckpt_metrics()
+        saves_before = fams['saves_total'].labels(
+            outcome='ok').value
+        bytes_before = fams['bytes_total'].value
+        mgr = _mgr(tmp_path)
+        mgr.save(0, _np_tree())
+        mgr.wait()
+        raw = mgr.restore_latest_raw()
+        assert raw is not None
+        mgr.close()
+        assert fams['saves_total'].labels(outcome='ok').value == \
+            saves_before + 1
+        assert fams['bytes_total'].value > bytes_before
+        assert fams['last_committed_step'].value == 0
+        text = metrics_lib.render_text(metrics_lib.registry())
+        assert 'skytpu_ckpt_save_seconds' in text
+        assert 'skytpu_ckpt_restores_total' in text
+
+
+class TestCheckpointsCli:
+
+    @pytest.fixture
+    def runner(self):
+        from click.testing import CliRunner
+        return CliRunner()
+
+    def _seed(self, tmp_path, steps=(1, 2, 3)):
+        mgr = _mgr(tmp_path, max_to_keep=None)
+        for step in steps:
+            mgr.save(step, _np_tree())
+        mgr.wait()
+        mgr.close()
+
+    def test_ls_lists_committed_and_torn(self, runner, tmp_path):
+        from skypilot_tpu import cli
+        self._seed(tmp_path)
+        (tmp_path / commit_lib.tmp_dir_name(9)).mkdir()
+        result = runner.invoke(cli.cli,
+                               ['checkpoints', 'ls', str(tmp_path)])
+        assert result.exit_code == 0, result.output
+        assert '3 (latest)' in result.output
+        assert 'step_00000009.tmp' in result.output
+
+    def test_ls_empty(self, runner, tmp_path):
+        from skypilot_tpu import cli
+        result = runner.invoke(cli.cli,
+                               ['checkpoints', 'ls', str(tmp_path)])
+        assert result.exit_code == 0
+        assert 'No committed checkpoints' in result.output
+
+    def test_gc_applies_retention_and_sweeps_torn(self, runner,
+                                                  tmp_path):
+        from skypilot_tpu import cli
+        self._seed(tmp_path)
+        (tmp_path / commit_lib.tmp_dir_name(9)).mkdir()
+        _age_dir(str(tmp_path / commit_lib.tmp_dir_name(9)))
+        result = runner.invoke(
+            cli.cli, ['checkpoints', 'gc', str(tmp_path),
+                      '--max-to-keep', '1', '--yes'])
+        assert result.exit_code == 0, result.output
+        assert commit_lib.committed_steps(str(tmp_path)) == [3]
+        assert not (tmp_path / commit_lib.tmp_dir_name(9)).exists()
+
+    def test_gc_dry_run_changes_nothing(self, runner, tmp_path):
+        from skypilot_tpu import cli
+        self._seed(tmp_path)
+        result = runner.invoke(
+            cli.cli, ['checkpoints', 'gc', str(tmp_path),
+                      '--max-to-keep', '1', '--dry-run'])
+        assert result.exit_code == 0, result.output
+        assert 'Would remove steps: [1, 2]' in result.output
+        assert commit_lib.committed_steps(str(tmp_path)) == [1, 2, 3]
+
+
+class TestPreemptionResumeEndToEnd:
+    """Extends PR 2's recovery e2e: the relaunched managed job must
+    RESUME at the last committed step (not step 0), and the resume
+    step must be visible in managed-job state."""
+
+    @pytest.fixture(autouse=True)
+    def fast_poll(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '1')
+        from skypilot_tpu.jobs import controller as controller_mod
+        monkeypatch.setattr(controller_mod,
+                            'JOB_STATUS_CHECK_GAP_SECONDS', 1.0)
+
+    @pytest.fixture
+    def cleanup_clusters(self):
+        yield
+        from skypilot_tpu import core, exceptions, state
+        for record in state.get_clusters():
+            try:
+                core.down(record['name'], purge=True)
+            except exceptions.SkyTpuError:
+                pass
+
+    def _write_trainer(self, tmp_path, marker_dir):
+        """A 'training' script using the native engine through the
+        facade: commits steps 0..2, then idles to be preempted; a
+        recovered run must restore start=3 and exit cleanly."""
+        script = tmp_path / 'trainer.py'
+        script.write_text(f'''
+import os, sys, time
+sys.path.insert(0, {REPO_ROOT!r})
+import numpy as np
+from skypilot_tpu.data.checkpoint import CheckpointManager
+
+base = os.environ['SKYTPU_CHECKPOINT_DIR']
+ckpt = CheckpointManager(base, save_interval_steps=1,
+                         process_index=0, process_count=1)
+state = {{'w': np.arange(4, dtype=np.float32)}}
+state, start = ckpt.restore_or(state)
+open(os.path.join({str(marker_dir)!r}, 'start-%d' % start),
+     'w').close()
+if start == 0:
+    for step in range(3):
+        ckpt.maybe_save(step, state)
+    ckpt.wait()
+    ckpt.close()
+    time.sleep(30)   # hold the slice until the preemption lands
+else:
+    assert start == 3, 'resumed at %d, want 3' % start
+    ckpt.close()
+''')
+        return script
+
+    def test_preempted_job_resumes_at_committed_step(
+            self, tmp_path, cleanup_clusters, monkeypatch):
+        import yaml
+
+        from skypilot_tpu import provision, state
+        from skypilot_tpu.data.storage import Storage, StorageMode
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.jobs.controller import JobsController
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.task import Task
+
+        bucket_dir = tmp_path / 'fake-bucket'
+        mount_path = tmp_path / 'mnt' / 'ckpt'
+        marker_dir = tmp_path / 'markers'
+        marker_dir.mkdir()
+        monkeypatch.setattr(Storage, 'construct', lambda self: None)
+        monkeypatch.setattr(
+            Storage, 'mount_command',
+            lambda self, path: (
+                f'mkdir -p {bucket_dir} && '
+                f'mkdir -p $(dirname {path}) && '
+                f'ln -sfn {bucket_dir} {path}'))
+
+        script = self._write_trainer(tmp_path, marker_dir)
+        task = Task(name='mjresume',
+                    run=f'{sys.executable} {script}',
+                    envs={'SKYTPU_CHECKPOINT_DIR': str(mount_path)})
+        res = Resources(cloud='local')
+        task.set_resources(res)
+        task.set_storage_mounts(
+            {str(mount_path): Storage(name='fake-bucket',
+                                      mode=StorageMode.MOUNT)})
+        dag_yaml = str(tmp_path / 'dag.yaml')
+        with open(dag_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump_all([task.to_yaml_config()], f)
+        job_id = jobs_state.add_job('mjresume', dag_yaml, 'inproc')
+        ctrl = JobsController(job_id, dag_yaml)
+        cluster_name = f'mjresume-{job_id}-0'
+        lineage_dir = bucket_dir / f'managed-{job_id}-0'
+
+        def preempt():
+            # Kill the slice out-of-band once step 2 has COMMITTED.
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                rec = state.get_cluster_from_name(cluster_name)
+                committed = commit_lib.latest_committed_step(
+                    str(lineage_dir))
+                if rec is not None and committed == 2:
+                    handle = rec['handle']
+                    provision.terminate_instances(
+                        'local', handle.region,
+                        handle.cluster_name_on_cloud)
+                    return
+                time.sleep(0.25)
+
+        killer = threading.Thread(target=preempt, daemon=True)
+        killer.start()
+        final = ctrl.run()
+        killer.join(timeout=5)
+
+        assert final == jobs_state.ManagedJobStatus.SUCCEEDED
+        record = jobs_state.get_job(job_id)
+        assert record['recovery_count'] >= 1
+        # The resume step is visible in managed-job state: recovery
+        # observed committed step 2 before relaunching.
+        assert record['resume_step'] == 2
+        # First launch started fresh; the RECOVERED launch resumed at
+        # the step after the last committed one — not step 0.
+        assert (marker_dir / 'start-0').exists()
+        assert (marker_dir / 'start-3').exists()
+        # Both launches shared one lineage (trailing counters
+        # stripped), and the torn/tmp state never leaked.
+        assert commit_lib.committed_steps(str(lineage_dir)) == \
+            [0, 1, 2]

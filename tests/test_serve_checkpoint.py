@@ -53,9 +53,12 @@ class TestServeCheckpointHandoff:
         merged = lora_lib.merge_lora_host(raw['params'], raw['lora'])
         merged = jax.tree.map(jnp.asarray, merged)
         want = lora_lib.merge_lora(state.params, state.lora)
+        # rtol accommodates host-BLAS vs XLA fp32 accumulation-order
+        # differences in the rank-r update (observed rel diff ~2e-6
+        # on a handful of elements).
         np.testing.assert_allclose(
             np.asarray(merged['layers']['wq'], np.float32),
-            np.asarray(want['layers']['wq'], np.float32), rtol=1e-6)
+            np.asarray(want['layers']['wq'], np.float32), rtol=2e-5)
 
         # The restored+merged weights decode (the serve path).
         prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
