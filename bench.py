@@ -343,6 +343,7 @@ def main() -> None:
             'loss': float(metrics['loss']),
         },
     }
+    _note_partial(result)  # headline computed: never zero this round
 
     # Extra training rows (round-3 verdict: the single LoRA point is
     # not a training story): a full-finetune row (6N FLOPs/token,
@@ -352,18 +353,12 @@ def main() -> None:
             not full_ft:
         del state, step, shardings  # free HBM between probes
         state = step = shardings = None
-        try:
-            result['detail']['full_ft'] = _train_probe(
-                model_name, seq=seq, batch=batch, steps=3,
-                full_ft=True)
-        except Exception as e:  # pylint: disable=broad-except
-            result['detail']['full_ft'] = {'error': repr(e)[:200]}
-        try:
-            result['detail']['seq4096'] = _train_probe(
-                model_name, seq=4096, batch=max(1, batch // 2),
-                steps=3, full_ft=False, lora_rank=lora_rank)
-        except Exception as e:  # pylint: disable=broad-except
-            result['detail']['seq4096'] = {'error': repr(e)[:200]}
+        _run_probe(result, 'full_ft', _train_probe,
+                   model_name, seq=seq, batch=batch, steps=3,
+                   full_ft=True)
+        _run_probe(result, 'seq4096', _train_probe,
+                   model_name, seq=4096, batch=max(1, batch // 2),
+                   steps=3, full_ft=False, lora_rank=lora_rank)
 
     # Serve numbers as a first-class captured artifact: the driver
     # runs the default mode only, so the round-2 verdict flagged the
@@ -371,37 +366,44 @@ def main() -> None:
     # measurement (int8 weights + int8 KV — the shipped fast path)
     # rides along in detail. Failures never cost the train metric.
     if os.environ.get('BENCH_INLINE_SERVE', '1') == '1':
-        try:
-            if step is not None:
-                del state, step, shardings  # free HBM for serving
-            result['detail']['serve'] = _serve_probe()
-        except Exception as e:  # pylint: disable=broad-except
-            result['detail']['serve'] = {'error': repr(e)[:200]}
+        if step is not None:
+            del state, step, shardings  # free HBM for serving
+            state = step = shardings = None
+        _run_probe(result, 'serve', _serve_probe)
         if os.environ.get('BENCH_SERVE_8B', '1') == '1':
             # The north-star serving point: 8B int8 at batch 8, the
             # shape the JetStream baseline comparison is normalized
             # against (README serving table).
-            try:
-                result['detail']['serve_8b'] = _serve_probe(
-                    'llama3.1-8b', batch=8)
-            except Exception as e:  # pylint: disable=broad-except
-                result['detail']['serve_8b'] = \
-                    {'error': repr(e)[:200]}
+            _run_probe(result, 'serve_8b', _serve_probe,
+                       'llama3.1-8b', batch=8)
     if os.environ.get('BENCH_QLORA_8B', '1') == '1':
         # The ACTUAL north star (BASELINE.json): Llama-3.1-8B
         # finetune tokens/s/chip — int8-frozen-base LoRA is how 8B
         # training fits a 16 GB v5e (bf16 base alone would not).
-        try:
-            result['detail']['qlora_8b'] = _qlora_probe()
-        except Exception as e:  # pylint: disable=broad-except
-            result['detail']['qlora_8b'] = {'error': repr(e)[:200]}
+        _run_probe(result, 'qlora_8b', _qlora_probe)
+        qlora = result['detail']['qlora_8b']
+        if 'tokens_per_sec_per_chip' in qlora:
+            # Promote the 8B row to the HEADLINE metric — it IS the
+            # north star; the small-model run stays as an explicit
+            # proxy detail row (it was the headline only because 8B
+            # might not fit every harness chip).
+            result['detail']['proxy_small'] = {
+                'metric': result['metric'],
+                'value': result['value'],
+                'unit': result['unit'],
+                'vs_baseline': result['vs_baseline'],
+            }
+            result['metric'] = (f'{qlora["model"]}_qlora_finetune_'
+                                'tokens_per_sec_per_chip')
+            result['value'] = qlora['tokens_per_sec_per_chip']
+            result['vs_baseline'] = round(
+                qlora['achieved_tflops_per_chip'] * 1e12 /
+                baseline_flops_per_chip, 3)
+            _note_partial(result)
     if os.environ.get('BENCH_INLINE_LAUNCH', '1') == '1':
         # Launch time-to-first-step on the local fake (the second
         # half of BASELINE.json's north star) rides along too.
-        try:
-            result['detail']['launch'] = _launch_probe()
-        except Exception as e:  # pylint: disable=broad-except
-            result['detail']['launch'] = {'error': repr(e)[:200]}
+        _run_probe(result, 'launch', _launch_probe)
     print(json.dumps(result))
 
 
@@ -760,6 +762,109 @@ def launch_main() -> None:
     }))
 
 
+# ---------------------------------------------------------------------
+# Robustness rails (round-5 VERDICT weak #3): one hung or flaky probe
+# must not zero the round's BENCH_*.json.
+#
+# - backend init gets a bounded retry with backoff (fresh PROCESS per
+#   attempt — jax caches a failed platform bind, so an in-process
+#   retry would re-observe the first failure) before degrading to CPU;
+# - every inline probe runs under a SIGALRM watchdog so a wedged
+#   device call surfaces as that probe's error row, not a hang;
+# - the headline metric, once computed, is snapshotted — if a later
+#   probe (or the whole-run watchdog) kills the bench, the snapshot
+#   is emitted as a partial result instead of nothing.
+# ---------------------------------------------------------------------
+
+_PARTIAL: dict = {}
+
+
+class _ProbeTimeout(Exception):
+    """A probe outlived its watchdog."""
+
+
+def _note_partial(result: dict) -> None:
+    """Snapshot the best result so far for partial emission."""
+    _PARTIAL.clear()
+    _PARTIAL.update(result)
+
+
+def _probe_timeout_seconds() -> float:
+    return float(os.environ.get('BENCH_PROBE_TIMEOUT_SECONDS', '900'))
+
+
+def _with_timeout(fn, seconds: float, *args, **kwargs):
+    """Run ``fn`` under a SIGALRM watchdog (main thread only; probes
+    run there). A device call that never returns raises
+    _ProbeTimeout the moment it yields the GIL back."""
+    import signal as signal_mod
+    import threading
+    if seconds <= 0 or \
+            threading.current_thread() is not threading.main_thread():
+        return fn(*args, **kwargs)
+
+    def _expired(signum, frame):
+        del signum, frame
+        raise _ProbeTimeout(f'probe exceeded {seconds:.0f}s watchdog')
+
+    old = signal_mod.signal(signal_mod.SIGALRM, _expired)
+    signal_mod.setitimer(signal_mod.ITIMER_REAL, seconds)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        signal_mod.setitimer(signal_mod.ITIMER_REAL, 0)
+        signal_mod.signal(signal_mod.SIGALRM, old)
+
+
+def _run_probe(result: dict, name: str, fn, *args, **kwargs) -> None:
+    """One inline probe: watchdogged, errors quarantined to its own
+    detail row, partial snapshot updated either way."""
+    try:
+        result['detail'][name] = _with_timeout(
+            fn, _probe_timeout_seconds(), *args, **kwargs)
+    except BaseException as e:  # pylint: disable=broad-except
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        result['detail'][name] = {'error': repr(e)[:200]}
+    _note_partial(result)
+
+
+def _arm_run_watchdog() -> None:
+    """Whole-run backstop: if the bench outlives
+    BENCH_WATCHDOG_SECONDS (0 disables), emit the partial result (or
+    an error row) and hard-exit — the driver must always see its one
+    JSON line."""
+    import threading
+    total = float(os.environ.get('BENCH_WATCHDOG_SECONDS', '3600'))
+    if total <= 0:
+        return
+
+    def _expire():
+        if _PARTIAL.get('metric'):
+            out = dict(_PARTIAL)
+            out.setdefault('detail', {})['bench_error'] = (
+                f'run watchdog fired after {total:.0f}s; partial '
+                'result emitted')
+            print(json.dumps(out))
+            sys.stdout.flush()
+            os._exit(0)  # pylint: disable=protected-access
+        print(json.dumps({
+            'metric': 'bench_error',
+            'value': 0.0,
+            'unit': 'error',
+            'vs_baseline': 0.0,
+            'detail': {'error': f'run watchdog fired after '
+                                f'{total:.0f}s before any metric '
+                                'was computed'},
+        }))
+        sys.stdout.flush()
+        os._exit(1)  # pylint: disable=protected-access
+
+    timer = threading.Timer(total, _expire)
+    timer.daemon = True
+    timer.start()
+
+
 # Backend-INIT failure signatures worth a CPU retry (the experimental
 # TPU platform failing to come up — seen as `bench_error` rc=1 in
 # BENCH_r05 — must degrade to a real CPU number, not an error row).
@@ -799,8 +904,33 @@ def _reexec_on_cpu() -> None:
               [sys.executable, __file__] + sys.argv[1:], env)
 
 
+# Backend-init retry budget: 3 total attempts on the NATIVE platform
+# (a TPU runtime that is still booting often answers on the second
+# try) before degrading to the CPU re-exec above.
+_INIT_ATTEMPTS = 3
+_INIT_ATTEMPT_ENV = 'BENCH_INIT_ATTEMPT'
+
+
+def _reexec_retry_init(attempt: int) -> None:
+    """Bounded retry around backend init, with backoff. Each attempt
+    is a fresh process (same reason as _reexec_on_cpu: jax caches the
+    failed platform bind in-process)."""
+    delay = 2.0 * (2 ** (attempt - 1))  # 2s, 4s
+    print(f'bench: backend init failed (attempt {attempt}/'
+          f'{_INIT_ATTEMPTS}); retrying in {delay:.0f}s',
+          file=sys.stderr)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    time.sleep(delay)
+    env = dict(os.environ)
+    env[_INIT_ATTEMPT_ENV] = str(attempt)
+    os.execve(sys.executable,
+              [sys.executable, __file__] + sys.argv[1:], env)
+
+
 if __name__ == '__main__':
     try:
+        _arm_run_watchdog()
         mode = os.environ.get('BENCH_MODE', 'train')
         if '--bench' in sys.argv:
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
@@ -827,7 +957,19 @@ if __name__ == '__main__':
         if os.environ.get('BENCH_CPU_RETRY') != '1' and \
                 os.environ.get('JAX_PLATFORMS', '') != 'cpu' and \
                 _is_backend_init_failure(e):
+            attempt = int(os.environ.get(_INIT_ATTEMPT_ENV, '0')) + 1
+            if attempt < _INIT_ATTEMPTS:
+                _reexec_retry_init(attempt)  # no return
             _reexec_on_cpu()  # no return
+        if _PARTIAL.get('metric'):
+            # A probe died after the headline metric was computed:
+            # emit the partial result — a real number with an error
+            # annotation beats a zeroed round.
+            out = dict(_PARTIAL)
+            out.setdefault('detail', {})['bench_error'] = \
+                repr(e)[:200]
+            print(json.dumps(out))
+            sys.exit(0)
         # The driver records the single JSON line; never die silently.
         print(json.dumps({
             'metric': 'bench_error',

@@ -663,6 +663,18 @@ class TpuBackend(Backend):
         tunnels.close_tunnels(handle.cluster_name)
         _forget_agent_breakers(handle)
         state.remove_cluster(handle.cluster_name, terminate=terminate)
+        if terminate:
+            # Orphan sweep (docs/lifecycle.md): reap any supervised
+            # daemon registered against this cluster plus anything
+            # whose liveness anchor vanished with it. Best effort —
+            # never a teardown blocker.
+            try:
+                from skypilot_tpu.lifecycle import sweeper
+                sweeper.sweep(cluster=handle.cluster_name_on_cloud)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning('lifecycle sweep after teardown of %s '
+                               'failed', handle.cluster_name,
+                               exc_info=True)
 
 
 def _forget_agent_breakers(handle: ClusterHandle) -> None:

@@ -440,6 +440,70 @@ def chaos_clear():
         click.echo('No faults armed.')
 
 
+@cli.group()
+def lifecycle():
+    """Supervised-daemon registry & orphan sweeping
+    (docs/lifecycle.md).
+
+    Every daemon the orchestrator spawns records itself at birth;
+    ``ls`` shows the records with their liveness, ``sweep`` compacts
+    dead records and kill-ladders live orphans whose cluster is
+    gone.
+    """
+
+
+@lifecycle.command(name='ls')
+def lifecycle_ls():
+    """List supervised daemons from the lifecycle registry."""
+    from skypilot_tpu.lifecycle import registry as lc_registry
+    from skypilot_tpu.lifecycle import sweeper as lc_sweeper
+    from skypilot_tpu.lifecycle import terminate as lc_terminate
+    recs = lc_registry.records()
+    if not recs:
+        click.echo(f'No supervised daemons registered '
+                   f'({lc_registry.registry_path()}).')
+        return
+    table = ux_utils.Table(['ROLE', 'PID', 'CLUSTER', 'PORT',
+                            'AGE', 'STATE'])
+    now = time.time()
+    for r in sorted(recs, key=lambda x: x.get('created_at') or 0):
+        alive = lc_terminate.pid_alive(r['pid'], r.get('start_time'))
+        if not alive:
+            state_s = 'DEAD'
+        elif lc_sweeper.is_orphaned(r):
+            state_s = 'ORPHANED'
+        else:
+            state_s = 'ALIVE'
+        age_min = (now - (r.get('created_at') or now)) / 60.0
+        table.add_row([r.get('role'), r['pid'],
+                       r.get('cluster') or '-', r.get('port') or '-',
+                       f'{age_min:.0f}m', state_s])
+    click.echo(table.get_string())
+
+
+@lifecycle.command(name='sweep')
+@click.option('--dry-run', is_flag=True,
+              help='Report what would be reaped without signalling.')
+@click.option('--cluster', default=None,
+              help='Additionally condemn every daemon of this '
+                   'cluster (teardown semantics).')
+def lifecycle_sweep(dry_run, cluster):
+    """Compact dead records; kill-ladder live orphans."""
+    from skypilot_tpu.lifecycle import sweeper as lc_sweeper
+    summary = lc_sweeper.sweep(cluster=cluster, kill=not dry_run)
+    verb = 'would reap' if dry_run else 'reaped'
+    dead_verb = 'would be removed' if dry_run else 'removed'
+    click.echo(f'{summary["live"]} supervised, '
+               f'{summary["removed_dead"]} dead record(s) '
+               f'{dead_verb}, '
+               f'{verb} {summary["reaped_orphans"]} orphan(s)'
+               + (f', {summary["kill_failed"]} kill(s) unconfirmed'
+                  if summary['kill_failed'] else ''))
+    for rec in summary['orphans']:
+        click.echo(f'  {verb}: {rec.get("role")} pid {rec["pid"]} '
+                   f'(cluster {rec.get("cluster") or "-"})')
+
+
 @cli.command(name='cost-report')
 def cost_report():
     """Estimated cost of clusters from recorded usage intervals."""

@@ -54,6 +54,16 @@ def main() -> int:
     cluster_name = sys.argv[1]
     from skypilot_tpu import exceptions, state
     from skypilot_tpu.jobs import state as jobs_state
+    # Supervised-daemon registration (lifecycle/registry.py): the
+    # state dir is the reaper's liveness anchor; a reaper that
+    # outlives it (controller torn down mid-reap) is an orphan the
+    # sweeper may kill — the durable pending_teardowns row, not this
+    # process, is what guarantees the teardown happens.
+    from skypilot_tpu.lifecycle import registry as lifecycle_registry
+    lifecycle_registry.register_self(
+        'reap',
+        runtime_dir=os.path.expanduser(
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')))
 
     last_err = None
     for attempt in range(5):
@@ -94,4 +104,6 @@ if __name__ == '__main__':
         rc = main()
         log.write(f'{time.strftime("%F %T")} reap {sys.argv[1:]} '
                   f'rc={rc}\n')
+    from skypilot_tpu.lifecycle import registry as lifecycle_registry
+    lifecycle_registry.remove(os.getpid())
     raise SystemExit(rc)

@@ -72,6 +72,11 @@ def _create_tables(cursor, conn):
     # silent fresh start.
     db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
                                  'resume_step', 'INTEGER')
+    # Terminal-state fence columns (docs/lifecycle.md): a terminal
+    # status written by a reconciler that CONFIRMED the controller
+    # dead is stamped fenced; writes that bounce off it are counted.
+    from skypilot_tpu.lifecycle import fencing
+    fencing.add_fence_columns(cursor, conn, 'managed_jobs')
     # Durable teardown queue: clusters that lost their owner (dead
     # controller) and must be reclaimed. Rows survive process death —
     # every reconcile AND the controller skylet event drain them until
@@ -132,32 +137,53 @@ def ensure_job(job_id: int, name: str, dag_yaml_path: str,
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
-               failure_reason: Optional[str] = None) -> None:
+               failure_reason: Optional[str] = None,
+               fence: bool = False) -> bool:
+    """Write a managed-job status; returns True iff it applied.
+
+    ``fence=True`` is for the reconciler writing a terminal state
+    AFTER the controller's death was confirmed (the kill ladder ran):
+    the row is stamped fenced, pinning the verdict against any
+    straggler write. Ordinary terminal-is-final stays enforced IN the
+    UPDATE predicate (atomic — a read-then-write guard would race the
+    very late-writer it exists to block): a job already terminal
+    cannot be resurrected by an orphaned controller child.
+    """
+    from skypilot_tpu.lifecycle import fencing
     db = _db()
     now = time.time()
-    sets = ['status=?']
-    params: List[Any] = [status.value]
+    stamp_sql, stamp_params = fencing.stamp_sets()
+    sets = ['status=?', stamp_sql]
+    params: List[Any] = [status.value] + stamp_params
     if status == ManagedJobStatus.RUNNING:
         sets.append('started_at=COALESCE(started_at, ?)')
         params.append(now)
     if status.is_terminal():
         sets.append('ended_at=?')
         params.append(now)
+    if fence:
+        assert status.is_terminal(), (
+            f'fenced writes are terminal-only, got {status}')
+        sets.append('status_fenced=1')
     if failure_reason is not None:
         sets.append('failure_reason=?')
         params.append(failure_reason)
     params.append(job_id)
-    # Terminal is final, enforced IN the UPDATE predicate (atomic —
-    # a read-then-write guard would race the very late-writer it
-    # exists to block): a job already terminal (e.g. reconciled to
-    # FAILED_CONTROLLER) cannot be resurrected by an orphaned
-    # controller child.
     terminal_values = tuple(s.value for s in _TERMINAL)
     placeholders = ','.join('?' for _ in terminal_values)
     db.execute_and_commit(
         f'UPDATE managed_jobs SET {", ".join(sets)} '
         f'WHERE job_id=? AND status NOT IN ({placeholders})',
         tuple(params) + terminal_values)
+    applied = db.cursor.rowcount > 0
+    if not applied:
+        row = db.cursor.execute(
+            'SELECT status_fenced FROM managed_jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+        if row and row[0]:
+            fencing.note_refused('managed_jobs', str(job_id),
+                                 status.value)
+    return applied
 
 
 def set_task_cluster(job_id: int, cluster: str) -> None:
@@ -259,17 +285,20 @@ def reconcile_dead_controllers() -> List[int]:
         if cluster_status is None or \
                 not cluster_status.is_terminal():
             continue
+        # CONFIRM-THEN-MARK: kill any lingering controller rank
+        # FIRST and wait for its confirmed exit (the driver's death
+        # does not reach agent-side processes — own sessions; a
+        # surviving controller keeps launching/promoting task
+        # clusters and would race the teardown below), THEN write
+        # the fenced terminal verdict. The fence pins it against a
+        # straggler's late write (lifecycle/fencing.py).
+        job_lib.kill_job_processes(rec['job_id'])
         set_status(
             rec['job_id'], ManagedJobStatus.FAILED_CONTROLLER,
             failure_reason='controller process ended '
             f'({cluster_status.value}) before the job reached a '
-            'terminal state')
+            'terminal state', fence=True)
         reconciled.append(rec['job_id'])
-        # Kill any lingering controller rank FIRST: the driver's
-        # death does not reach agent-side processes (own sessions),
-        # and a surviving controller keeps launching/promoting task
-        # clusters — it would race and beat the teardown below.
-        job_lib.kill_job_processes(rec['job_id'])
         # Re-read task_cluster AFTER the kill: the dying rank may
         # have recorded a newer cluster (multi-task DAG moving on)
         # between our snapshot and its confirmed death — enqueueing

@@ -1,0 +1,49 @@
+"""Process lifecycle & supervision.
+
+Every daemon the orchestrator spawns (host agents, skylets, serve
+controllers, job drivers, detached reapers) must provably die when
+its cluster does — "no silent billing" is a process-lifetime
+guarantee, not just a cloud-API one. This package is the stdlib-only
+subsystem that makes daemon lifetime managed (consistent with
+``resilience/`` and ``checkpoint/``):
+
+- :mod:`~skypilot_tpu.lifecycle.registry` — a supervised-process
+  registry: every spawned daemon records ``{role, pid, start_time,
+  cluster, runtime_dir, token_path, port}`` at birth, so teardown
+  kills by record instead of by hope and sweepers can distinguish
+  ours from the world's.
+- :mod:`~skypilot_tpu.lifecycle.terminate` — the confirm-then-mark
+  kill ladder: SIGTERM → bounded wait → SIGKILL → verify
+  (pid, start_time) gone → only then may the caller write the
+  terminal state.
+- :mod:`~skypilot_tpu.lifecycle.fencing` — terminal-state guards:
+  a terminal FAILED/DOWN written by the process that CONFIRMED the
+  death is fenced; a zombie's late graceful write cannot resurrect
+  the row.
+- :mod:`~skypilot_tpu.lifecycle.sweeper` — the orphan sweeper:
+  walks the registry plus token-file/runtime-dir liveness, reaps
+  registered-but-dead records and kills live orphans whose cluster
+  is gone. Runs on the skylet tick and at local-provider teardown;
+  CLI: ``xsky lifecycle ls|sweep``.
+
+Contract details: ``docs/lifecycle.md``.
+"""
+from skypilot_tpu.lifecycle.registry import (records, register,
+                                             register_self,
+                                             registry_path, remove)
+from skypilot_tpu.lifecycle.sweeper import sweep
+from skypilot_tpu.lifecycle.terminate import (pid_alive,
+                                              proc_start_time,
+                                              terminate_process)
+
+__all__ = [
+    'pid_alive',
+    'proc_start_time',
+    'records',
+    'register',
+    'register_self',
+    'registry_path',
+    'remove',
+    'sweep',
+    'terminate_process',
+]

@@ -1,0 +1,70 @@
+"""Terminal-state fencing.
+
+The rule (docs/lifecycle.md): **terminal states are written only by
+the process that confirmed the death** — and once a reconciler has
+written such a FENCED terminal state, no other writer may overwrite
+it. The failure this kills: a reconciler declares a service FAILED
+after confirming its controller dead, then the controller's zombie
+(its graceful-shutdown tail, still flushing) writes DOWN last and
+wins — the service looks cleanly downed when it actually died
+(``tests/test_serve.py::TestServeControllerDeath``, red two rounds).
+
+Both status DBs (``serve/serve_state.py`` services,
+``jobs/state.py`` managed_jobs) carry three fence columns:
+
+    status_fenced      1 ⇔ the current terminal state was written by
+                       a reconciler that CONFIRMED the owner's death
+    status_writer_pid  pid of whoever last wrote the status
+    status_epoch       monotonic per-row write counter
+
+Writers stamp pid+epoch on every applied write; refused writes are
+counted in ``skytpu_lifecycle_fenced_writes_total`` so a zombie's
+late write is observable, not silent. The fence predicate itself
+lives IN the UPDATE's WHERE clause — a read-then-write guard would
+race the very late-writer it exists to block.
+"""
+import os
+from typing import Tuple
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.utils import db_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+FENCE_COLUMNS = (
+    ('status_fenced', 'INTEGER', 0),
+    ('status_writer_pid', 'INTEGER', None),
+    ('status_epoch', 'INTEGER', 0),
+)
+
+
+def add_fence_columns(cursor, conn, table: str) -> None:
+    """Idempotent migration: add the fence columns to ``table``."""
+    for name, col_type, default in FENCE_COLUMNS:
+        db_utils.add_column_to_table(cursor, conn, table, name,
+                                     col_type, default_value=default)
+
+
+def stamp_sets() -> Tuple[str, list]:
+    """SET fragments (and their params) every applied status write
+    carries: bump the epoch, record the writer pid."""
+    return ('status_epoch=COALESCE(status_epoch,0)+1, '
+            'status_writer_pid=?', [os.getpid()])
+
+
+def note_refused(table: str, key: str, attempted: str) -> None:
+    """A write bounced off a fence: count + log it (the zombie whose
+    write was refused is exactly the process we want visible)."""
+    logger.warning(
+        '%s[%s]: status write %r refused by terminal-state fence '
+        '(writer pid %d) — a reconciler already confirmed the owner '
+        'dead and fenced the row', table, key, attempted, os.getpid())
+    _fenced_writes_counter(table).inc()
+
+
+def _fenced_writes_counter(table: str):
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().counter(
+        'skytpu_lifecycle_fenced_writes_total',
+        'Status writes refused by the terminal-state fence, by '
+        'table.', ('table',)).labels(table=table)

@@ -1,0 +1,160 @@
+"""Supervised-process registry.
+
+A jsonl file (one record per line) under the state dir:
+
+    $SKYTPU_STATE_DIR/lifecycle/registry.jsonl
+
+Every daemon we spawn records itself (or is recorded by its spawner)
+at birth: ``{role, pid, start_time, created_at, cluster, runtime_dir,
+token_path, port}``. Teardown then kills BY RECORD — pid + start_time
+identity through :mod:`~skypilot_tpu.lifecycle.terminate` — instead
+of pattern-matching the process table and hoping, and the sweeper
+(:mod:`~skypilot_tpu.lifecycle.sweeper`) can tell our daemons from
+the world's.
+
+jsonl (not sqlite) on purpose: registrations come from short-lived
+subprocesses (drivers, reapers) where a one-line append under a file
+lock beats schema bootstrap, and a torn line is skipped, never a
+corrupt database. The file is compacted on every remove/sweep.
+"""
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.lifecycle import terminate
+
+logger = tpu_logging.init_logger(__name__)
+
+_REGISTRY_REL = os.path.join('lifecycle', 'registry.jsonl')
+# Daemon roles the subsystem knows about (free-form strings are
+# accepted; these are the ones the repo registers).
+ROLES = ('host_agent', 'skylet', 'serve_controller', 'job_driver',
+         'reap')
+
+
+def _base_dir(base: Optional[str] = None) -> str:
+    if base is None:
+        base = os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')
+    return os.path.expanduser(base)
+
+
+def registry_path(base: Optional[str] = None) -> str:
+    return os.path.join(_base_dir(base), _REGISTRY_REL)
+
+
+def _lock(base: Optional[str]):
+    import filelock
+    path = registry_path(base)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return filelock.FileLock(path + '.lock')
+
+
+def _read_records(path: str) -> List[Dict[str, Any]]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn append; dropped at next compaction
+        if isinstance(rec, dict) and rec.get('pid'):
+            out.append(rec)
+    return out
+
+
+def _write_records(path: str, recs: List[Dict[str, Any]]) -> None:
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + '\n')
+    os.replace(tmp, path)
+
+
+def register(role: str,
+             pid: int,
+             *,
+             start_time: Optional[float] = None,
+             cluster: Optional[str] = None,
+             runtime_dir: Optional[str] = None,
+             token_path: Optional[str] = None,
+             port: Optional[int] = None,
+             base: Optional[str] = None) -> Dict[str, Any]:
+    """Record a daemon at birth. Re-registering a pid replaces its
+    previous record (a respawn on the same pid after recycle must not
+    leave two identities). Never raises — a registry hiccup must not
+    take the daemon (or its spawner) down with it."""
+    rec = {
+        'role': role,
+        'pid': int(pid),
+        'start_time': (start_time if start_time is not None else
+                       terminate.proc_start_time(int(pid))),
+        'created_at': time.time(),
+        'cluster': cluster,
+        'runtime_dir': runtime_dir,
+        'token_path': token_path,
+        'port': port,
+    }
+    try:
+        with _lock(base):
+            path = registry_path(base)
+            recs = [r for r in _read_records(path)
+                    if r['pid'] != rec['pid']]
+            recs.append(rec)
+            _write_records(path, recs)
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('lifecycle registry: register(%s pid=%s) '
+                         'failed', role, pid)
+    return rec
+
+
+def register_self(role: str, **kwargs) -> Dict[str, Any]:
+    """Self-registration for daemons with no spawner-side hook
+    (skylet, drivers, controllers, reapers)."""
+    return register(role, os.getpid(), **kwargs)
+
+
+def records(base: Optional[str] = None,
+            cluster: Optional[str] = None) -> List[Dict[str, Any]]:
+    recs = _read_records(registry_path(base))
+    if cluster is not None:
+        recs = [r for r in recs if r.get('cluster') == cluster]
+    return recs
+
+
+def remove(pid: int, base: Optional[str] = None) -> bool:
+    """Drop a pid's record (confirmed-dead daemon, or a daemon
+    deregistering itself on clean exit)."""
+    try:
+        with _lock(base):
+            path = registry_path(base)
+            recs = _read_records(path)
+            kept = [r for r in recs if r['pid'] != int(pid)]
+            if len(kept) != len(recs):
+                _write_records(path, kept)
+                return True
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('lifecycle registry: remove(pid=%s) failed',
+                         pid)
+    return False
+
+
+def remove_pids(pids: List[int], base: Optional[str] = None) -> None:
+    """Drop a batch of confirmed-gone pids (sweeper compaction).
+    Read-filter-write happens under ONE lock hold — a snapshot taken
+    outside the lock would lose any record registered while the
+    sweep's kills were in flight."""
+    gone = {int(p) for p in pids}
+    with _lock(base):
+        path = registry_path(base)
+        kept = [r for r in _read_records(path)
+                if r['pid'] not in gone]
+        _write_records(path, kept)

@@ -1,0 +1,135 @@
+"""Orphan sweeper: the registry's garbage collector and backstop.
+
+Walks the supervised-process registry and, per record:
+
+- pid identity gone (dead, recycled, or zombie) → drop the record
+  (compaction);
+- process ALIVE but orphaned — its ``token_path`` or ``runtime_dir``
+  was deleted (cluster torn down underneath it), or its cluster is
+  the one being torn down right now — → run the kill ladder, drop
+  the record only on CONFIRMED death;
+- alive and anchored → leave it; it is supervised, not leaked.
+
+Runs from the skylet's controller-event loop (every tick), at
+local-provider teardown, from ``xsky lifecycle sweep``, and from the
+test session's end-of-run leak check. Exports:
+
+    skytpu_lifecycle_supervised            gauge — live supervised
+                                           daemons at last sweep
+    skytpu_lifecycle_reaped_orphans_total  counter — orphans the
+                                           ladder confirmed dead
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.lifecycle import registry, terminate
+
+logger = tpu_logging.init_logger(__name__)
+
+
+def is_orphaned(rec: Dict[str, Any]) -> bool:
+    """A record's daemon lost its liveness anchor (token file or
+    runtime dir deleted ⇒ the cluster is gone underneath it). Public:
+    `xsky lifecycle ls` renders this as the ORPHANED state."""
+    token_path = rec.get('token_path')
+    if token_path and not os.path.exists(token_path):
+        return True
+    runtime_dir = rec.get('runtime_dir')
+    if runtime_dir and not os.path.isdir(runtime_dir):
+        return True
+    return False
+
+
+def sweep(base: Optional[str] = None,
+          cluster: Optional[str] = None,
+          *,
+          kill: bool = True,
+          term_wait: float = terminate.DEFAULT_TERM_WAIT,
+          kill_wait: float = terminate.DEFAULT_KILL_WAIT
+          ) -> Dict[str, Any]:
+    """One sweep over the registry at ``base``.
+
+    ``cluster`` condemns every record of that cluster regardless of
+    anchor liveness (the teardown path: the cluster is going away, so
+    must its daemons). ``kill=False`` reports without signalling OR
+    compacting (the CLI's --dry-run is read-only — dead records keep
+    their role/cluster/port forensics until a real sweep).
+
+    Returns ``{'live': n, 'removed_dead': n, 'reaped_orphans': n,
+    'kill_failed': n, 'orphans': [records...]}``.
+    """
+    recs = registry.records(base)
+    live: List[Dict[str, Any]] = []
+    drop_pids: List[int] = []
+    reaped: List[Dict[str, Any]] = []
+    dead = 0
+    failed = 0
+    for rec in recs:
+        pid = rec['pid']
+        start_time = rec.get('start_time')
+        if not terminate.pid_alive(pid, start_time):
+            drop_pids.append(pid)
+            dead += 1
+            continue
+        condemned = (cluster is not None and
+                     rec.get('cluster') == cluster) or \
+            is_orphaned(rec)
+        if not condemned:
+            live.append(rec)
+            continue
+        if not kill:
+            reaped.append(rec)  # dry-run: report, don't signal
+            continue
+        if terminate.terminate_process(pid, start_time,
+                                       term_wait=term_wait,
+                                       kill_wait=kill_wait,
+                                       role=rec.get('role',
+                                                    'process')):
+            logger.warning('lifecycle sweep: reaped orphaned %s '
+                           '(pid %d, cluster %s)', rec.get('role'),
+                           pid, rec.get('cluster'))
+            drop_pids.append(pid)
+            reaped.append(rec)
+        else:
+            failed += 1
+            live.append(rec)  # keep the record; next sweep retries
+    if drop_pids and kill:
+        _drop(base, drop_pids)
+    if kill:
+        _export_metrics(len(live), len(reaped))
+    return {
+        'live': len(live),
+        'removed_dead': dead,
+        'reaped_orphans': len(reaped),
+        'kill_failed': failed,
+        'orphans': reaped,
+    }
+
+
+def _drop(base: Optional[str], pids: List[int]) -> None:
+    """Compact: remove confirmed-gone pids (single-lock filter in
+    the registry, so concurrent registrations are preserved)."""
+    try:
+        registry.remove_pids(pids, base)
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('lifecycle sweep: registry compaction '
+                         'failed')
+
+
+def _export_metrics(live: int, reaped: int) -> None:
+    try:
+        from skypilot_tpu import metrics as metrics_lib
+        reg = metrics_lib.registry()
+        reg.gauge(
+            'skytpu_lifecycle_supervised',
+            'Live supervised daemons in the lifecycle registry at '
+            'the last sweep.').set(float(live))
+        counter = reg.counter(
+            'skytpu_lifecycle_reaped_orphans_total',
+            'Orphaned supervised daemons the sweeper confirmed '
+            'dead.')
+        if reaped:
+            counter.inc(reaped)
+    except Exception:  # pylint: disable=broad-except
+        pass

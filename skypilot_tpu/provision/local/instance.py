@@ -11,12 +11,14 @@ Metadata lives at ``$SKYTPU_STATE_DIR/local_clusters/<name>.json``.
 """
 import json
 import os
-import signal
 import socket
 import time
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.lifecycle import registry as lifecycle_registry
+from skypilot_tpu.lifecycle import sweeper as lifecycle_sweeper
+from skypilot_tpu.lifecycle import terminate as lifecycle_terminate
 from skypilot_tpu.provision.common import (ClusterInfo, InstanceInfo,
                                            ProvisionConfig,
                                            ProvisionRecord)
@@ -53,14 +55,6 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('127.0.0.1', 0))
         return s.getsockname()[1]
-
-
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-        return True
-    except (ProcessLookupError, PermissionError):
-        return False
 
 
 def _host_alive(host: Dict[str, Any],
@@ -112,12 +106,20 @@ def run_instances(config: ProvisionConfig) -> ProvisionRecord:
         proc = agent_client.start_local_agent(port,
                                               runtime_dir=runtime_dir,
                                               token=agent_token)
-        hosts.append({
+        host = {
             'instance_id': f'{config.cluster_name_on_cloud}-{i}',
             'pid': proc.pid,
+            # (pid, start_time) is the identity the kill ladder
+            # verifies at teardown — a bare pid would confirm (or
+            # kill) a recycled id.
+            'start_time': lifecycle_terminate.proc_start_time(
+                proc.pid),
             'port': port,
             'runtime_dir': runtime_dir,
-        })
+        }
+        _register_agent(host, config.cluster_name_on_cloud,
+                        agent_token)
+        hosts.append(host)
     meta = {
         'cluster_name_on_cloud': config.cluster_name_on_cloud,
         'region': config.region,
@@ -204,12 +206,22 @@ def terminate_instances(region: str,
         os.remove(_meta_path(cluster_name_on_cloud))
     except FileNotFoundError:
         pass
-    # Remove the runtime base so any surviving skylet notices and
-    # exits (it was started via the agent's /exec under its own
-    # session, so the agent killpg may not reach it).
+    # Remove the runtime base so any surviving skylet/agent notices
+    # (their liveness anchor) and exits — daemons started via the
+    # agent's /exec run in their own sessions, so the agent killpg
+    # cannot reach them.
     import shutil
     shutil.rmtree(os.path.join(_meta_dir(), cluster_name_on_cloud),
                   ignore_errors=True)
+    # Orphan sweep (docs/lifecycle.md): compact this cluster's
+    # registry records and ladder-kill anything still alive whose
+    # anchor just vanished (skylet, drivers, a SIGTERM-ignoring
+    # agent). Best effort — the registry is supervision metadata,
+    # never a teardown blocker.
+    try:
+        lifecycle_sweeper.sweep(cluster=cluster_name_on_cloud)
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 def restart_agents(region: str, cluster_name_on_cloud: str) -> None:
@@ -223,37 +235,43 @@ def restart_agents(region: str, cluster_name_on_cloud: str) -> None:
         raise exceptions.FetchClusterInfoError(
             f'no such local cluster {cluster_name_on_cloud}')
     token = meta.get('agent_token')
-    _kill_agents(cluster_name_on_cloud)
-    # Wait for the PORT to stop answering, not the pid: agents
-    # spawned by this very process become zombies after SIGTERM
-    # (nothing reaps them) and a pid check would burn the whole
-    # deadline (see _host_alive's note). Escalate to SIGKILL on
-    # expiry; an old agent surviving both would make the respawn
-    # fail to bind and the handshake falsely "succeed" against the
-    # stale process — raise instead.
+    # Kill ladder with confirmed death (zombie-aware pid identity, so
+    # agents spawned by this very process — unreaped after SIGTERM —
+    # count as dead; the old port-poll workaround is unnecessary).
+    # An agent surviving even SIGKILL would make the respawn fail to
+    # bind and the handshake falsely "succeed" against the stale
+    # process — raise instead.
     for h in meta['hosts']:
+        if not lifecycle_terminate.terminate_process(
+                h['pid'], h.get('start_time'), role='host_agent'):
+            raise exceptions.SkyTpuError(
+                f'agent on port {h["port"]} (pid {h["pid"]}) '
+                'survived SIGKILL; cannot restart the runtime in '
+                'place')
+        lifecycle_registry.remove(h['pid'])
+        # The port may linger in TIME_WAIT for a beat after the
+        # confirmed death; both agents set SO_REUSEADDR, but a
+        # half-closed connection can still answer — drain it. If it
+        # STILL answers past the deadline, some out-of-registry
+        # daemon (a prior session's leak) is squatting it: raise
+        # rather than let the respawn die at bind() and the
+        # handshake falsely "succeed" against the squatter.
         deadline = time.time() + 5
         while _host_alive(h, token) and time.time() < deadline:
             time.sleep(0.05)
         if _host_alive(h, token):
-            try:
-                os.killpg(os.getpgid(h['pid']), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                try:
-                    os.kill(h['pid'], signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
-            deadline = time.time() + 5
-            while _host_alive(h, token) and time.time() < deadline:
-                time.sleep(0.05)
-        if _host_alive(h, token):
             raise exceptions.SkyTpuError(
-                f'agent on port {h["port"]} survived SIGKILL; '
-                'cannot restart the runtime in place')
+                f'port {h["port"]} still answers after the recorded '
+                f'agent (pid {h["pid"]}) was confirmed dead — an '
+                'unsupervised process is squatting it; cannot '
+                'restart the runtime in place')
     for h in meta['hosts']:
         proc = agent_client.start_local_agent(
             h['port'], runtime_dir=h['runtime_dir'], token=token)
         h['pid'] = proc.pid
+        h['start_time'] = lifecycle_terminate.proc_start_time(
+            proc.pid)
+        _register_agent(h, cluster_name_on_cloud, token)
     _save(cluster_name_on_cloud, meta)
     for h in meta['hosts']:
         agent_client.AgentClient(
@@ -261,19 +279,33 @@ def restart_agents(region: str, cluster_name_on_cloud: str) -> None:
             token=token).wait_healthy(timeout=30)
 
 
+def _register_agent(host: Dict[str, Any], cluster: str,
+                    token: Optional[str]) -> None:
+    """Record a spawned agent in the supervised-process registry
+    (lifecycle/registry.py) so teardown kills by record and sweepers
+    can tell ours from the world's."""
+    token_path = (os.path.join(host['runtime_dir'], 'agent_token')
+                  if token else None)
+    lifecycle_registry.register(
+        'host_agent', host['pid'],
+        start_time=host.get('start_time'), cluster=cluster,
+        runtime_dir=host['runtime_dir'], token_path=token_path,
+        port=host['port'])
+
+
 def _kill_agents(cluster_name_on_cloud: str) -> None:
+    """Confirm-then-mark teardown of the cluster's agents: the kill
+    ladder (SIGTERM → bounded wait → SIGKILL → verify pid+start_time
+    gone) replaces the old SIGTERM-and-hope. Registry records are
+    dropped only for CONFIRMED deaths; a survivor keeps its record
+    so the next sweep retries."""
     meta = _load(cluster_name_on_cloud)
     if meta is None:
         return
     for h in meta['hosts']:
-        if _pid_alive(h['pid']):
-            try:
-                os.killpg(os.getpgid(h['pid']), signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                try:
-                    os.kill(h['pid'], signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
+        if lifecycle_terminate.terminate_process(
+                h['pid'], h.get('start_time'), role='host_agent'):
+            lifecycle_registry.remove(h['pid'])
 
 
 def open_ports(region: str, cluster_name_on_cloud: str,

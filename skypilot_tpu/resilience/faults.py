@@ -16,6 +16,10 @@ Sites (one per recovery path the paper cares about):
     checkpoint.save   native checkpoint write→commit window (a
                       ``preempt`` tears the write between the shard
                       files and the commit rename)
+    lifecycle.kill    the kill ladder's SIGTERM rung (lifecycle/
+                      terminate.py) — an armed fault suppresses the
+                      SIGTERM, simulating a SIGTERM-ignoring hung
+                      daemon so the SIGKILL escalation is drilled
 
 Activation:
   - programmatically: ``faults.arm('agent.health', 'error', 0.3)``
@@ -42,7 +46,8 @@ from skypilot_tpu import tpu_logging
 logger = tpu_logging.init_logger(__name__)
 
 SITES = ('agent.run', 'agent.health', 'provision.launch',
-         'serve.probe', 'jobs.poll', 'checkpoint.save')
+         'serve.probe', 'jobs.poll', 'checkpoint.save',
+         'lifecycle.kill')
 KINDS = ('error', 'timeout', 'preempt')
 
 ENV_VAR = 'SKYTPU_FAULTS'

@@ -431,11 +431,49 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'error': 'not found'}, 404)
 
 
+LIVENESS_CHECK_SECONDS = 2.0
+
+
+def _liveness_guard(token_file: Optional[str],
+                    runtime_dir: Optional[str]) -> None:
+    """Exit when the cluster is gone underneath us: the runtime dir
+    (local provider removes it on terminate) or the token file is
+    the agent's liveness anchor — same contract as the skylet's
+    runtime-dir check (skylet.py main loop) and the C++ agent's
+    LivenessGuard. SIGTERM can miss (agent re-parented, supervisor
+    died first); the anchor cannot. Sweeps the proc table before
+    dying so task processes never outlive their cluster."""
+    token_file = os.path.expanduser(token_file) if token_file else None
+    runtime_dir = (os.path.expanduser(runtime_dir)
+                   if runtime_dir else None)
+    if not token_file and not runtime_dir:
+        return
+    while True:
+        time.sleep(LIVENESS_CHECK_SECONDS)
+        gone = ((runtime_dir and not os.path.isdir(runtime_dir)) or
+                (token_file and not os.path.exists(token_file)))
+        if gone:
+            # Same two-sweeps-around-a-grace dance as the SIGTERM
+            # handler: a /run racing the sweep self-kills on
+            # registration.
+            _procs.kill_all()
+            time.sleep(0.25)
+            _procs.kill_all()
+            os._exit(0)
+
+
 def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0',
-          token: Optional[str] = None) -> None:
+          token: Optional[str] = None,
+          token_file: Optional[str] = None,
+          runtime_dir: Optional[str] = None) -> None:
     global _token
     if token is not None:
         _token = token
+    if runtime_dir is None:
+        runtime_dir = os.environ.get('SKYTPU_RUNTIME_DIR')
+    threading.Thread(target=_liveness_guard,
+                     args=(token_file, runtime_dir),
+                     daemon=True, name='liveness-guard').start()
 
     def _terminate(_signum, _frame):
         # Two sweeps around a short grace: the first sets the
@@ -464,7 +502,8 @@ def main():
                              'requests must present it in the '
                              f'{TOKEN_HEADER} header.')
     args = parser.parse_args()
-    serve(args.port, args.host, token=_load_token(args.token_file))
+    serve(args.port, args.host, token=_load_token(args.token_file),
+          token_file=args.token_file)
 
 
 if __name__ == '__main__':

@@ -332,6 +332,53 @@ ProcTable g_procs;
 volatile sig_atomic_t g_stop = 0;
 int g_listen_fd = -1;
 
+// Liveness anchors (lifecycle subsystem, docs/lifecycle.md): the
+// token file the agent was started with and the runtime dir from
+// SKYTPU_RUNTIME_DIR. If either disappears the cluster is gone
+// underneath us — SIGTERM can miss (supervisor died first, agent
+// re-parented), the anchor cannot. Same contract as the Python
+// skylet's runtime-dir check (runtime/skylet.py main loop) and the
+// Python agent's _liveness_guard.
+std::string g_token_file;
+std::string g_runtime_dir;
+
+bool PathIsDir(const std::string& path) {
+  struct stat st{};
+  return stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+bool PathExists(const std::string& path) {
+  struct stat st{};
+  return stat(path.c_str(), &st) == 0;
+}
+
+// Checked from a detached thread (the accept loop blocks in
+// accept4): on anchor loss, trip the same shutdown machinery as
+// SIGTERM — set the stop flag and shutdown() the listen fd; main()
+// then runs the two-sweep process kill and exits. shutdown(), not
+// just close(): closing an fd from another thread does NOT wake a
+// blocked accept4 on Linux (the SIGTERM path only works because the
+// signal itself interrupts the syscall with EINTR); shutting the
+// listening socket down makes the blocked accept return.
+void LivenessGuard() {
+  while (!g_stop) {
+    usleep(2000000);
+    if (g_stop) return;
+    bool gone = false;
+    if (!g_runtime_dir.empty() && !PathIsDir(g_runtime_dir)) gone = true;
+    if (!g_token_file.empty() && !PathExists(g_token_file)) gone = true;
+    if (gone) {
+      std::fprintf(stderr,
+                   "host_agent: liveness anchor gone (runtime dir or "
+                   "token file removed); exiting\n");
+      g_stop = 1;
+      shutdown(g_listen_fd, SHUT_RDWR);
+      close(g_listen_fd);
+      return;
+    }
+  }
+}
+
 // Blocking exec with timeout; captures combined output.
 int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output) {
   int pipefd[2];
@@ -719,6 +766,7 @@ int main(int argc, char** argv) {
     if (std::strcmp(argv[i], "--token-file") == 0) token_file = argv[i + 1];
   }
   if (!token_file.empty()) {
+    g_token_file = ProcTable::Expand(token_file);
     FILE* f = fopen(ProcTable::Expand(token_file).c_str(), "rb");
     if (f == nullptr) {
       std::fprintf(stderr, "cannot read token file %s\n", token_file.c_str());
@@ -778,6 +826,12 @@ int main(int argc, char** argv) {
     return 1;
   }
   if (listen(listen_fd, 64) != 0) { perror("listen"); return 1; }
+  if (const char* rdir = std::getenv("SKYTPU_RUNTIME_DIR")) {
+    g_runtime_dir = ProcTable::Expand(rdir);
+  }
+  if (!g_runtime_dir.empty() || !g_token_file.empty()) {
+    std::thread(LivenessGuard).detach();
+  }
   std::fprintf(stderr, "host_agent (cpp) listening on %s:%d\n", host.c_str(),
                port);
   while (true) {

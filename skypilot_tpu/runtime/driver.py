@@ -268,12 +268,20 @@ def main():
     args = parser.parse_args()
     import signal
     signal.signal(signal.SIGTERM, _sigterm_gang_kill)
+    # Supervised-daemon registration (lifecycle/registry.py): the
+    # runtime dir is the liveness anchor — a driver outliving its
+    # cluster's runtime dir is an orphan the sweeper may reap.
+    from skypilot_tpu.lifecycle import registry as lifecycle_registry
+    lifecycle_registry.register_self('job_driver',
+                                     runtime_dir=job_lib.runtime_dir())
     try:
         status = run_job(args.job_id)
     except Exception:
         job_lib.set_status(args.job_id,
                            job_lib.JobStatus.FAILED_DRIVER)
         raise
+    finally:
+        lifecycle_registry.remove(os.getpid())
     raise SystemExit(0 if status == job_lib.JobStatus.SUCCEEDED else 1)
 
 

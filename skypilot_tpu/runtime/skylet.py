@@ -58,12 +58,45 @@ def run_controller_event() -> None:
                     '%s', failed)
 
 
-def _controller_event_loop(interval: float) -> None:
+def run_lifecycle_sweep(startup_base=None) -> None:
+    """Orphan sweep on the skylet tick (docs/lifecycle.md): walk the
+    supervised-process registry, compact dead records, kill daemons
+    whose token file / runtime dir is gone. Runs on EVERY cluster
+    (not just controllers) — any head host can strand a daemon.
+
+    Sweeps the CURRENT state dir (on controller clusters
+    run_controller_event re-points it at the managed dir, where the
+    controller's replica/task-cluster provisions register their
+    agents) and, if different, the state dir the skylet STARTED
+    with (where the skylet itself and this cluster's daemons are
+    registered)."""
+    from skypilot_tpu.lifecycle import registry, sweeper
+    bases = [None]
+    if startup_base is not None and \
+            registry.registry_path(startup_base) != \
+            registry.registry_path(None):
+        bases.append(startup_base)
+    for base in bases:
+        summary = sweeper.sweep(base)
+        if summary['reaped_orphans'] or summary['removed_dead']:
+            logger.info('lifecycle sweep: %d orphan(s) reaped, %d '
+                        'dead record(s) compacted, %d supervised',
+                        summary['reaped_orphans'],
+                        summary['removed_dead'], summary['live'])
+
+
+def _controller_event_loop(interval: float, startup_base) -> None:
     while True:
         try:
             run_controller_event()
         except Exception:  # pylint: disable=broad-except
             logger.exception('controller event failed')
+        try:
+            # Blocking kill ladders are fine on this thread (see
+            # run_controller_event's note).
+            run_lifecycle_sweep(startup_base)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('lifecycle sweep failed')
         time.sleep(interval)
 
 
@@ -103,15 +136,29 @@ def main():
     scheduler = job_lib.FIFOScheduler()
     logger.info('skylet started (interval %.1fs, runtime dir %s)',
                 args.interval, job_lib.runtime_dir())
+    # Supervised-daemon registration (lifecycle/registry.py): the
+    # runtime dir doubles as the liveness anchor the sweeper checks.
+    # The base is captured NOW, RESOLVED — on controller clusters the
+    # event loop later re-points SKYTPU_STATE_DIR at the managed dir,
+    # and a raw None would silently resolve to the managed dir too,
+    # skipping the startup-registry sweep and deregistering from the
+    # wrong registry on exit.
+    from skypilot_tpu.lifecycle import registry as lifecycle_registry
+    startup_base = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    lifecycle_registry.register_self(
+        'skylet', runtime_dir=job_lib.runtime_dir(),
+        base=startup_base)
     threading.Thread(
         target=_controller_event_loop,
-        args=(args.controller_interval,),
+        args=(args.controller_interval, startup_base),
         daemon=True, name='controller-events').start()
     while True:
         if not os.path.isdir(job_lib.runtime_dir()):
             # Cluster torn down underneath us (local fake provider
             # removes the runtime dir on terminate).
             logger.info('runtime dir gone; skylet exiting')
+            lifecycle_registry.remove(os.getpid(), base=startup_base)
             return
         run_once(scheduler)
         time.sleep(args.interval)

@@ -37,8 +37,22 @@ def register_service(runtime_dir: str, name: str, spec_json: str,
     REGISTER:exists."""
     body = f'''
 import filelock
+import socket
 lock = filelock.FileLock(os.path.join(
     os.environ['SKYTPU_STATE_DIR'], '.serve_lb_ports.lock'))
+def _bindable(p):
+    # Probe-bind before allocating: a port squatted by a daemon the
+    # registry does not know about (e.g. leaked by a previous
+    # session) must be SKIPPED here, not crashed into by the LB.
+    try:
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(('0.0.0.0', p))
+        return True
+    except (OSError, OverflowError):
+        # OverflowError: p > 65535 (an env-configured range running
+        # off the end of port space) is "not bindable", not a crash.
+        return False
 with lock:
     if serve_state.get_service({name!r}) is not None:
         print('REGISTER:exists')
@@ -46,7 +60,7 @@ with lock:
         used = set(serve_state.used_lb_ports())
         port = None
         for p in range({port_start}, {port_end} + 1):
-            if p not in used:
+            if p not in used and _bindable(p):
                 port = p
                 break
         if port is None:

@@ -279,6 +279,14 @@ def main():
     task = Task.from_yaml_config(config)
     serve_state.set_service_controller_pid(args.service_name,
                                            os.getpid())
+    # Supervised-daemon registration (lifecycle/registry.py): the
+    # serve state dir (SKYTPU_STATE_DIR, set by the launch command)
+    # anchors liveness — a controller outliving its state dir is an
+    # orphan the sweeper may reap.
+    from skypilot_tpu.lifecycle import registry as lifecycle_registry
+    lifecycle_registry.register_self(
+        'serve_controller', port=args.lb_port,
+        runtime_dir=os.environ.get('SKYTPU_STATE_DIR'))
     controller = SkyServeController(args.service_name, task,
                                     args.lb_port)
 
@@ -288,7 +296,10 @@ def main():
         controller.stop()
 
     signal.signal(signal.SIGTERM, _sigterm)
-    controller.start()
+    try:
+        controller.start()
+    finally:
+        lifecycle_registry.remove(os.getpid())
 
 
 if __name__ == '__main__':

@@ -35,9 +35,22 @@ logger = tpu_logging.init_logger(__name__)
 
 CONTROLLER_CLUSTER_PREFIX = 'sky-serve-controller-'
 # One LB port per service, allocated from this range (reference:
-# load-balancer ports 30001-30100, sky/serve/constants.py).
+# load-balancer ports 30001-30100, sky/serve/constants.py). The env
+# overrides let test sessions pick disjoint ranges so a daemon
+# leaked by a PREVIOUS session holding 30001 cannot poison this one;
+# allocation additionally probe-binds each candidate (codegen
+# register_service) so an out-of-registry squatter is skipped, not
+# crashed into.
 LB_PORT_START = 30001
 LB_PORT_END = 30100
+
+
+def lb_port_range() -> tuple:
+    start = int(os.environ.get('SKYTPU_SERVE_LB_PORT_START',
+                               LB_PORT_START))
+    end = int(os.environ.get('SKYTPU_SERVE_LB_PORT_END',
+                             start + (LB_PORT_END - LB_PORT_START)))
+    return start, end
 
 
 def _controller_cluster_name() -> str:
@@ -142,10 +155,11 @@ def up(task: Task, service_name: Optional[str] = None,
 
     # Atomic controller-side register: existence check + LB-port
     # allocation + service row.
+    port_start, port_end = lb_port_range()
     out = _rpc(handle, serve_codegen.register_service(
         rdir, service_name,
         json.dumps(task.service.to_yaml_config()),
-        LB_PORT_START, LB_PORT_END))
+        port_start, port_end))
     result = _parse(out, 'REGISTER')
     if result == 'exists':
         raise exceptions.InvalidSpecError(
@@ -153,8 +167,8 @@ def up(task: Task, service_name: Optional[str] = None,
             'down first.')
     if result == 'no-free-port':
         raise exceptions.SkyTpuError(
-            f'No free load-balancer port in [{LB_PORT_START}, '
-            f'{LB_PORT_END}] — too many services on this controller.')
+            f'No free load-balancer port in [{port_start}, '
+            f'{port_end}] — too many services on this controller.')
     lb_port = int(result)
 
     task_config = task.to_yaml_config()

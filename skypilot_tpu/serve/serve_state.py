@@ -73,11 +73,23 @@ def _create_tables(cursor, conn):
             'ALTER TABLE services ADD COLUMN '
             'controller_job_id INTEGER',
             'ALTER TABLE replicas ADD COLUMN use_spot INTEGER '
-            'DEFAULT 0'):
+            'DEFAULT 0',
+            # Reconcile grace: when the controller job went terminal
+            # but the controller PROCESS is still alive (a graceful
+            # shutdown in flight), stamp the first observation here
+            # and only escalate after the grace elapses.
+            'ALTER TABLE services ADD COLUMN suspect_since REAL',
+            # /proc starttime of controller_pid: pid+start_time is
+            # the process IDENTITY the kill ladder verifies — a bare
+            # pid check would confirm (or kill) a recycled pid.
+            'ALTER TABLE services ADD COLUMN '
+            'controller_pid_start REAL'):
         try:
             cursor.execute(stmt)
         except sqlite3.OperationalError:
             pass  # column already exists
+    from skypilot_tpu.lifecycle import fencing
+    fencing.add_fence_columns(cursor, conn, 'services')
     conn.commit()
 
 
@@ -102,19 +114,72 @@ def add_service(name: str, spec_json: str,
          spec_json, lb_port))
 
 
-def set_service_status(name: str, status: ServiceStatus) -> None:
-    # FAILED is sticky except toward DOWN (atomic, in the UPDATE
-    # predicate): once reconciliation declared the controller dead, a
-    # surviving orphan's READY ticks must not flap the status back
-    # (mirror of jobs/state.set_status finality).
+def set_service_status(name: str, status: ServiceStatus,
+                       fence: bool = False) -> bool:
+    """Write a service status; returns True iff the write applied.
+
+    ``fence=True`` is for reconcilers ONLY, writing a terminal
+    FAILED/DOWN *after* the kill ladder CONFIRMED the controller
+    dead (lifecycle/terminate.py). A fenced terminal state cannot be
+    overwritten by ordinary writes — the zombie controller's late
+    graceful DOWN must not resurrect (or sanitize) a death a
+    reconciler already recorded. Both guards live in the UPDATE's
+    WHERE clause (atomic; a read-then-write check would race the
+    very late-writer it blocks):
+
+    - FAILED is sticky except toward a *fenced* DOWN (the unfenced
+      graceful DOWN is exactly the zombie write);
+    - a fenced terminal row accepts no unfenced write at all.
+    """
+    from skypilot_tpu.lifecycle import fencing
+    db = _db()
+    stamp_sql, stamp_params = fencing.stamp_sets()
+    if fence:
+        assert status in (ServiceStatus.FAILED, ServiceStatus.DOWN), (
+            'fenced writes are for confirmed-death terminal states, '
+            f'got {status}')
+        # A fenced FAILED never overwrites a completed DOWN: a
+        # controller the ladder SIGTERMed may finish its graceful
+        # shutdown (and write DOWN) inside the term_wait before the
+        # death is confirmed — that service downed CLEANLY, and
+        # "FAILED + fenced" would make the clean shutdown look like
+        # an unfixable crash. A fenced DOWN may still overwrite
+        # FAILED (`serve down` force-clean after its own
+        # confirmation).
+        guard = ('' if status == ServiceStatus.DOWN
+                 else ' AND status != ?')
+        guard_params = ([] if status == ServiceStatus.DOWN
+                        else [ServiceStatus.DOWN.value])
+        db.execute_and_commit(
+            f'UPDATE services SET status=?, status_fenced=1, '
+            f'suspect_since=NULL, {stamp_sql} WHERE name=?{guard}',
+            tuple([status.value] + stamp_params + [name] +
+                  guard_params))
+        return db.cursor.rowcount > 0
+    terminal = (ServiceStatus.FAILED.value, ServiceStatus.DOWN.value)
     if status == ServiceStatus.DOWN:
-        _db().execute_and_commit(
-            'UPDATE services SET status=? WHERE name=?',
-            (status.value, name))
-        return
-    _db().execute_and_commit(
-        'UPDATE services SET status=? WHERE name=? AND status != ?',
-        (status.value, name, ServiceStatus.FAILED.value))
+        db.execute_and_commit(
+            f'UPDATE services SET status=?, {stamp_sql} '
+            f'WHERE name=? AND NOT (COALESCE(status_fenced,0)=1 '
+            f'AND status IN (?,?))',
+            tuple([status.value] + stamp_params + [name] +
+                  list(terminal)))
+    else:
+        db.execute_and_commit(
+            f'UPDATE services SET status=?, {stamp_sql} '
+            f'WHERE name=? AND status != ? AND NOT '
+            f'(COALESCE(status_fenced,0)=1 AND status IN (?,?))',
+            tuple([status.value] + stamp_params +
+                  [name, ServiceStatus.FAILED.value] +
+                  list(terminal)))
+    applied = db.cursor.rowcount > 0
+    if not applied:
+        row = db.cursor.execute(
+            'SELECT status_fenced FROM services WHERE name=?',
+            (name,)).fetchone()
+        if row and row[0]:
+            fencing.note_refused('services', name, status.value)
+    return applied
 
 
 def set_service_endpoint(name: str, endpoint: str) -> None:
@@ -124,16 +189,19 @@ def set_service_endpoint(name: str, endpoint: str) -> None:
 
 
 def set_service_controller_pid(name: str, pid: int) -> None:
+    from skypilot_tpu.lifecycle import terminate
     _db().execute_and_commit(
-        'UPDATE services SET controller_pid=? WHERE name=?',
-        (pid, name))
+        'UPDATE services SET controller_pid=?, '
+        'controller_pid_start=? WHERE name=?',
+        (pid, terminate.proc_start_time(pid), name))
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
     row = _db().cursor.execute(
         'SELECT name, status, created_at, spec_json, endpoint, '
         'controller_pid, target_version, target_task_yaml, lb_port, '
-        'down_requested, controller_cluster, controller_job_id '
+        'down_requested, controller_cluster, controller_job_id, '
+        'controller_pid_start '
         'FROM services WHERE name=?', (name,)).fetchone()
     if row is None:
         return None
@@ -150,7 +218,36 @@ def get_service(name: str) -> Optional[Dict[str, Any]]:
         'down_requested': bool(row[9]),
         'controller_cluster': row[10],
         'controller_job_id': row[11],
+        'controller_pid_start': row[12],
     }
+
+
+# Grace for a controller whose job went terminal while its PROCESS
+# is still alive: that is a graceful shutdown in flight (cancel →
+# SIGTERM → terminate replicas → write DOWN → exit), not a death.
+# Escalate to the kill ladder only if it outlives the grace.
+RECONCILE_GRACE_SECONDS = float(
+    os.environ.get('SKYTPU_SERVE_RECONCILE_GRACE_SECONDS', '15'))
+# SIGTERM wait when the reconciler ladders a live-but-overdue
+# controller: its SIGTERM handler drains replicas, which takes real
+# time on real clouds (terminate.py's header calls this exact caller
+# out as needing more than the 5s default).
+CONTROLLER_TERM_WAIT_SECONDS = float(
+    os.environ.get('SKYTPU_SERVE_CONTROLLER_TERM_WAIT_SECONDS',
+                   '60'))
+
+
+def _get_suspect_since(name: str) -> Optional[float]:
+    row = _db().cursor.execute(
+        'SELECT suspect_since FROM services WHERE name=?',
+        (name,)).fetchone()
+    return row[0] if row else None
+
+
+def _set_suspect_since(name: str, at: Optional[float]) -> None:
+    _db().execute_and_commit(
+        'UPDATE services SET suspect_since=? WHERE name=?',
+        (at, name))
 
 
 def reconcile_dead_controllers() -> List[str]:
@@ -159,9 +256,18 @@ def reconcile_dead_controllers() -> List[str]:
     service is not DOWN/FAILED) are marked FAILED — a dead controller
     cannot probe replicas or act on down flags, so a stale READY
     would be a lie to ``serve status`` (same pattern as
-    jobs/state.reconcile_dead_controllers). Replica clusters are
-    left for ``serve down``'s force-clean (they may still be
-    serving). Returns the reconciled service names."""
+    jobs/state.reconcile_dead_controllers).
+
+    CONFIRM-THEN-MARK (lifecycle/terminate.py): the terminal FAILED
+    is written — FENCED — only after the controller process is
+    verifiably gone, so its zombie cannot overwrite the verdict with
+    a late graceful DOWN. A controller still ALIVE under a terminal
+    job is a graceful shutdown in flight: it gets
+    ``RECONCILE_GRACE_SECONDS`` to finish writing its own DOWN
+    before the kill ladder escalates. Replica clusters are left for
+    ``serve down``'s force-clean (they may still be serving).
+    Returns the reconciled service names."""
+    from skypilot_tpu.lifecycle import terminate
     from skypilot_tpu.runtime import job_lib
     job_lib.update_job_statuses()
     reconciled = []
@@ -178,13 +284,38 @@ def reconcile_dead_controllers() -> List[str]:
         cluster_status = job_lib.get_status(int(job_id))
         if cluster_status is None or \
                 not cluster_status.is_terminal():
+            if _get_suspect_since(svc['name']) is not None:
+                _set_suspect_since(svc['name'], None)
             continue
-        set_service_status(svc['name'], ServiceStatus.FAILED)
-        # A lingering controller rank (driver death does not reach
+        pid = svc['controller_pid']
+        pid_start = svc.get('controller_pid_start')
+        if pid and terminate.pid_alive(int(pid), pid_start):
+            now = time.time()
+            since = _get_suspect_since(svc['name'])
+            if since is None:
+                _set_suspect_since(svc['name'], now)
+                continue
+            if now - since < RECONCILE_GRACE_SECONDS:
+                continue
+            # Outlived the grace: a wedged (or SIGTERM-ignoring)
+            # controller. Ladder it; only a CONFIRMED death may be
+            # marked. The term_wait is sized for a controller whose
+            # SIGTERM handler drains replicas (minutes on real
+            # clouds) — the default 5s would SIGKILL it mid-drain
+            # and leave half the replica fleet running and billing.
+            if not terminate.terminate_process(
+                    int(pid), pid_start, role='serve_controller',
+                    term_wait=CONTROLLER_TERM_WAIT_SECONDS):
+                continue  # unkillable (D-state); retry next tick
+        # Lingering controller ranks (driver death does not reach
         # agent-side processes) would keep mutating replicas under a
-        # FAILED service — kill it before reporting.
+        # FAILED service — kill them BEFORE writing the verdict.
         job_lib.kill_job_processes(int(job_id))
-        reconciled.append(svc['name'])
+        if set_service_status(svc['name'], ServiceStatus.FAILED,
+                              fence=True):
+            reconciled.append(svc['name'])
+        # else: the controller completed its graceful DOWN inside
+        # the ladder's term_wait — nothing to reconcile.
     return reconciled
 
 

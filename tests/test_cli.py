@@ -59,6 +59,36 @@ class TestCli:
         result = runner.invoke(cli.cli, ['cost-report'])
         assert result.exit_code == 0
 
+    def test_lifecycle_ls_empty(self, runner):
+        result = runner.invoke(cli.cli, ['lifecycle', 'ls'])
+        assert result.exit_code == 0, result.output
+        assert 'No supervised daemons' in result.output
+
+    def test_lifecycle_ls_and_sweep(self, runner):
+        import os as os_mod
+        from skypilot_tpu.lifecycle import registry
+        # A record whose pid is ours (alive, anchored) and one whose
+        # pid is certainly dead.
+        registry.register('skylet', os_mod.getpid(), cluster='c1')
+        registry.register('host_agent', 2 ** 22 + 1, start_time=1.0)
+        result = runner.invoke(cli.cli, ['lifecycle', 'ls'])
+        assert result.exit_code == 0, result.output
+        assert 'ALIVE' in result.output
+        assert 'DEAD' in result.output
+        result = runner.invoke(cli.cli,
+                               ['lifecycle', 'sweep', '--dry-run'])
+        assert result.exit_code == 0, result.output
+        assert '1 dead record(s) would be removed' in result.output
+        # Dry run is read-only: the dead record survives for a real
+        # sweep to compact.
+        assert len(registry.records()) == 2
+        result = runner.invoke(cli.cli, ['lifecycle', 'sweep'])
+        assert result.exit_code == 0, result.output
+        assert '1 dead record(s) removed' in result.output
+        assert [r['pid'] for r in registry.records()] == \
+            [os_mod.getpid()]
+        registry.remove(os_mod.getpid())
+
     def test_env_parsing(self, runner, tmp_path):
         yaml_path = tmp_path / 'task.yaml'
         yaml_path.write_text('envs:\n  X: default\nrun: echo $X\n')

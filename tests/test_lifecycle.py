@@ -1,0 +1,362 @@
+"""Process lifecycle & supervision subsystem (docs/lifecycle.md):
+registry round-trip, confirm-then-mark kill ladder (incl. the
+``lifecycle.kill`` escalation drill), terminal-state fencing on both
+status DBs, the orphan sweeper, and the agents' token/runtime-dir
+liveness exit (py + cpp)."""
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.lifecycle import fencing, registry, sweeper, terminate
+
+
+def _spawn_child(extra_code: str = '') -> subprocess.Popen:
+    """A child in its OWN session (like every daemon we supervise)
+    that signals readiness on stdout — registrations and signals must
+    never race the interpreter's startup."""
+    code = (f'import signal, sys, time\n{extra_code}\n'
+            "print('ready', flush=True)\n"
+            'time.sleep(120)\n')
+    proc = subprocess.Popen([sys.executable, '-c', code],
+                            stdout=subprocess.PIPE,
+                            start_new_session=True)
+    assert proc.stdout.readline().strip() == b'ready'
+    return proc
+
+
+def _reap(proc: subprocess.Popen) -> None:
+    try:
+        proc.kill()
+    except ProcessLookupError:
+        pass
+    proc.wait(timeout=10)
+
+
+class TestRegistry:
+
+    def test_round_trip(self, tmp_path):
+        base = str(tmp_path)
+        proc = _spawn_child()
+        try:
+            rec = registry.register(
+                'host_agent', proc.pid, cluster='c1',
+                runtime_dir=str(tmp_path), port=1234, base=base)
+            # start_time filled from /proc at registration.
+            assert rec['start_time'] == \
+                terminate.proc_start_time(proc.pid)
+            got = registry.records(base=base)
+            assert [r['pid'] for r in got] == [proc.pid]
+            assert got[0]['role'] == 'host_agent'
+            assert got[0]['port'] == 1234
+            # Cluster filter.
+            assert registry.records(base=base, cluster='c1') == got
+            assert registry.records(base=base, cluster='other') == []
+            # Remove drops it; a second remove is a no-op.
+            assert registry.remove(proc.pid, base=base) is True
+            assert registry.records(base=base) == []
+            assert registry.remove(proc.pid, base=base) is False
+        finally:
+            _reap(proc)
+
+    def test_reregister_replaces(self, tmp_path):
+        base = str(tmp_path)
+        proc = _spawn_child()
+        try:
+            registry.register('skylet', proc.pid, cluster='old',
+                              base=base)
+            registry.register('skylet', proc.pid, cluster='new',
+                              base=base)
+            got = registry.records(base=base)
+            assert len(got) == 1
+            assert got[0]['cluster'] == 'new'
+        finally:
+            _reap(proc)
+
+    def test_torn_line_skipped(self, tmp_path):
+        base = str(tmp_path)
+        proc = _spawn_child()
+        try:
+            registry.register('reap', proc.pid, base=base)
+            # A torn append (process died mid-write) must be skipped,
+            # not corrupt the registry.
+            with open(registry.registry_path(base), 'a',
+                      encoding='utf-8') as f:
+                f.write('{"role": "host_agent", "pid": 99')
+            got = registry.records(base=base)
+            assert [r['pid'] for r in got] == [proc.pid]
+        finally:
+            _reap(proc)
+
+
+class TestKillLadder:
+
+    def test_clean_child_dies_on_sigterm(self):
+        proc = _spawn_child()
+        start = terminate.proc_start_time(proc.pid)
+        assert terminate.terminate_process(proc.pid, start,
+                                           term_wait=5.0) is True
+        proc.wait(timeout=5)
+        assert not terminate.pid_alive(proc.pid, start)
+
+    def test_sigterm_ignoring_child_escalates(self, faults):
+        """The escalation drill (ISSUE acceptance): a SIGTERM-ignoring
+        daemon, with the ``lifecycle.kill`` fault site armed so the
+        ladder's SIGTERM rung is suppressed deterministically, must
+        still be CONFIRMED dead via SIGKILL."""
+        faults.arm(terminate.KILL_FAULT_SITE, 'error', 1.0, count=1)
+        proc = _spawn_child(
+            'signal.signal(signal.SIGTERM, signal.SIG_IGN)')
+        start = terminate.proc_start_time(proc.pid)
+        t0 = time.monotonic()
+        assert terminate.terminate_process(proc.pid, start,
+                                           term_wait=0.5) is True
+        assert time.monotonic() - t0 >= 0.5  # the SIGTERM wait ran
+        assert faults.registry().fired_counts().get(
+            (terminate.KILL_FAULT_SITE, 'error')) == 1
+        proc.wait(timeout=5)
+        assert not terminate.pid_alive(proc.pid, start)
+
+    def test_recycled_pid_identity_not_killed(self):
+        """A (pid, start_time) mismatch means the pid was recycled:
+        the ladder confirms 'gone' WITHOUT signalling the innocent
+        process now wearing the pid."""
+        proc = _spawn_child()
+        try:
+            wrong_start = (terminate.proc_start_time(proc.pid) or
+                           0.0) + 12345.0
+            assert terminate.terminate_process(
+                proc.pid, wrong_start, term_wait=0.1) is True
+            # The live process was not touched.
+            assert proc.poll() is None
+            assert terminate.pid_alive(proc.pid)
+        finally:
+            _reap(proc)
+
+    def test_zombie_counts_as_dead(self):
+        """An unreaped SIGTERMed child is a zombie: it runs no code
+        and must count as dead (the old pid-check-only teardown
+        burned whole deadlines waiting on zombies)."""
+        proc = _spawn_child()
+        proc.kill()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                with open(f'/proc/{proc.pid}/stat', 'rb') as f:
+                    if b') Z' in f.read():
+                        break
+            except OSError:
+                break
+            time.sleep(0.05)
+        assert not terminate.pid_alive(proc.pid)
+        proc.wait(timeout=5)  # reap
+
+
+class TestFencing:
+
+    def test_serve_fenced_failed_refuses_late_down(self):
+        """The TestServeControllerDeath fix in unit form: reconciler
+        confirms death → writes FAILED fenced; the zombie's late
+        graceful DOWN bounces; a FENCED DOWN (e.g. `serve down`
+        force-clean after its own confirmation) still lands."""
+        from skypilot_tpu.serve import serve_state
+        serve_state.add_service('svc', '{}', lb_port=30001)
+        assert serve_state.set_service_status(
+            'svc', serve_state.ServiceStatus.READY) is True
+        assert serve_state.set_service_status(
+            'svc', serve_state.ServiceStatus.FAILED,
+            fence=True) is True
+        # Late graceful write from the zombie: refused.
+        assert serve_state.set_service_status(
+            'svc', serve_state.ServiceStatus.DOWN) is False
+        assert serve_state.get_service('svc')['status'] is \
+            serve_state.ServiceStatus.FAILED
+        # So is any non-terminal resurrection.
+        assert serve_state.set_service_status(
+            'svc', serve_state.ServiceStatus.READY) is False
+        assert serve_state.get_service('svc')['status'] is \
+            serve_state.ServiceStatus.FAILED
+        # A fenced DOWN (another confirmed-death writer) may proceed.
+        assert serve_state.set_service_status(
+            'svc', serve_state.ServiceStatus.DOWN, fence=True) is True
+
+    def test_serve_unfenced_graceful_down_still_lands(self):
+        from skypilot_tpu.serve import serve_state
+        serve_state.add_service('graceful', '{}', lb_port=30002)
+        serve_state.set_service_status(
+            'graceful', serve_state.ServiceStatus.READY)
+        # No fence anywhere: the controller's own graceful DOWN (the
+        # normal shutdown path) applies.
+        assert serve_state.set_service_status(
+            'graceful', serve_state.ServiceStatus.DOWN) is True
+        assert serve_state.get_service('graceful')['status'] is \
+            serve_state.ServiceStatus.DOWN
+
+    def test_serve_fence_requires_terminal(self):
+        from skypilot_tpu.serve import serve_state
+        serve_state.add_service('svc2', '{}')
+        with pytest.raises(AssertionError):
+            serve_state.set_service_status(
+                'svc2', serve_state.ServiceStatus.READY, fence=True)
+
+    def test_jobs_fenced_terminal_is_sticky(self):
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs_state.ensure_job(7, 'j', '/dev/null', 'ctrl')
+        assert jobs_state.set_status(
+            7, jobs_state.ManagedJobStatus.RUNNING) is True
+        assert jobs_state.set_status(
+            7, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+            fence=True) is True
+        # The zombie controller's late SUCCEEDED: refused.
+        assert jobs_state.set_status(
+            7, jobs_state.ManagedJobStatus.SUCCEEDED) is False
+        assert jobs_state.get_job(7)['status'] is \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+
+    def test_fence_columns_migrate_existing_db(self, tmp_path):
+        """add_fence_columns is an idempotent migration."""
+        import sqlite3
+        path = str(tmp_path / 'x.db')
+        conn = sqlite3.connect(path)
+        cursor = conn.cursor()
+        cursor.execute('CREATE TABLE t (k TEXT, status TEXT)')
+        fencing.add_fence_columns(cursor, conn, 't')
+        fencing.add_fence_columns(cursor, conn, 't')  # idempotent
+        cols = [r[1] for r in
+                cursor.execute('PRAGMA table_info(t)').fetchall()]
+        assert {'status_fenced', 'status_writer_pid',
+                'status_epoch'} <= set(cols)
+        conn.close()
+
+
+class TestSweeper:
+
+    def test_compacts_dead_record(self, tmp_path):
+        base = str(tmp_path)
+        proc = _spawn_child()
+        registry.register('job_driver', proc.pid, base=base)
+        _reap(proc)  # dead AND reaped: identity gone
+        summary = sweeper.sweep(base)
+        assert summary['removed_dead'] == 1
+        assert summary['reaped_orphans'] == 0
+        assert registry.records(base=base) == []
+
+    def test_reaps_live_orphan_on_token_loss(self, tmp_path):
+        """Token dir deleted ⇒ daemon must die: the sweeper ladders a
+        LIVE process whose liveness anchor is gone and drops its
+        record only on confirmed death."""
+        base = str(tmp_path / 'reg')
+        token = tmp_path / 'cluster' / 'agent_token'
+        token.parent.mkdir()
+        token.write_text('tok')
+        proc = _spawn_child()
+        try:
+            registry.register('host_agent', proc.pid,
+                              token_path=str(token), base=base)
+            # Anchored: left alone.
+            summary = sweeper.sweep(base)
+            assert summary['live'] == 1
+            assert proc.poll() is None
+            # Anchor gone: reaped.
+            shutil.rmtree(token.parent)
+            summary = sweeper.sweep(base)
+            assert summary['reaped_orphans'] == 1
+            assert registry.records(base=base) == []
+            proc.wait(timeout=5)
+        finally:
+            _reap(proc)
+
+    def test_cluster_teardown_condemns_and_dry_run_reports(
+            self, tmp_path):
+        base = str(tmp_path / 'reg')
+        anchor = tmp_path / 'anchor'
+        anchor.mkdir()
+        proc = _spawn_child()
+        try:
+            registry.register('skylet', proc.pid, cluster='doomed',
+                              runtime_dir=str(anchor), base=base)
+            # Dry run: reported, not signalled.
+            summary = sweeper.sweep(base, cluster='doomed',
+                                    kill=False)
+            assert summary['reaped_orphans'] == 1
+            assert proc.poll() is None
+            assert registry.records(base=base) != []
+            # Teardown semantics: anchored-but-condemned is killed.
+            summary = sweeper.sweep(base, cluster='doomed')
+            assert summary['reaped_orphans'] == 1
+            assert registry.records(base=base) == []
+            proc.wait(timeout=5)
+        finally:
+            _reap(proc)
+
+    def test_metrics_exported(self, tmp_path):
+        from skypilot_tpu import metrics as metrics_lib
+        base = str(tmp_path)
+        reaped_before = metrics_lib.registry().counter(
+            'skytpu_lifecycle_reaped_orphans_total').value
+        proc = _spawn_child()
+        try:
+            token = tmp_path / 'tok'
+            token.write_text('t')
+            registry.register('host_agent', proc.pid,
+                              token_path=str(token), base=base)
+            token.unlink()
+            sweeper.sweep(base)
+            reg = metrics_lib.registry()
+            assert reg.counter(
+                'skytpu_lifecycle_reaped_orphans_total').value == \
+                reaped_before + 1
+            assert reg.gauge(
+                'skytpu_lifecycle_supervised').value == 0.0
+        finally:
+            _reap(proc)
+
+
+class TestAgentLivenessExit:
+    """Tentpole (e): both agent implementations exit when their
+    liveness anchor (token file / runtime dir) disappears — same
+    contract as the skylet's runtime-dir check."""
+
+    @pytest.fixture(params=['py', 'cpp'])
+    def running_agent(self, request, tmp_path):
+        from skypilot_tpu.runtime import agent_client
+        if request.param == 'cpp' and \
+                agent_client.resolve_agent_binary() is None:
+            pytest.skip('C++ agent not built')
+        import socket
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            port = s.getsockname()[1]
+        rdir = tmp_path / 'runtime'
+        rdir.mkdir()
+        proc = agent_client.start_local_agent(
+            port, runtime_dir=str(rdir), token='tok',
+            use_cpp=(request.param == 'cpp'))
+        client = agent_client.AgentClient('127.0.0.1', port,
+                                          token='tok')
+        client.wait_healthy(timeout=15)
+        yield proc, rdir
+        _reap(proc)
+
+    def _assert_exits(self, proc, within: float = 15.0) -> None:
+        deadline = time.time() + within
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                return
+            time.sleep(0.2)
+        pytest.fail('agent did not exit after losing its liveness '
+                    'anchor')
+
+    def test_exits_on_token_file_removal(self, running_agent):
+        proc, rdir = running_agent
+        os.remove(rdir / 'agent_token')
+        self._assert_exits(proc)
+
+    def test_exits_on_runtime_dir_removal(self, running_agent):
+        proc, rdir = running_agent
+        shutil.rmtree(rdir)
+        self._assert_exits(proc)

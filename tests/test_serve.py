@@ -412,6 +412,12 @@ class TestReplicaLaunchPlumbing:
         assert captured['task'].storage_mounts == {'/ckpt': marker}
 
 
+# Replica ports come from bind(0), never a fixed constant: a daemon
+# leaked by a previous session squatting a fixed port must not be
+# able to poison this suite (VERDICT weak #6).
+from conftest import _ephemeral_port  # noqa: E402
+
+
 def _svc(name):
     """Service record via the controller RPC (the client-local
     serve_state knows nothing in the controller-side-state world)."""
@@ -443,7 +449,8 @@ class TestServeEndToEnd:
         task.set_resources(res)
         task.service = SkyServiceSpec(
             readiness_path='/', initial_delay_seconds=60,
-            readiness_timeout_seconds=3, min_replicas=1, port=18200)
+            readiness_timeout_seconds=3, min_replicas=1,
+            port=_ephemeral_port())
 
         endpoint = serve_api.up(task, 'echosvc',
                                 wait_ready_timeout=120)
@@ -469,9 +476,9 @@ class TestServeEndToEnd:
             assert cc and cc.startswith(
                 serve_core.CONTROLLER_CLUSTER_PREFIX), rec
             assert state_lib.get_cluster_from_name(cc) is not None
+            lb_start, lb_end = serve_core.lb_port_range()
             assert rec['lb_port'] is not None and \
-                serve_core.LB_PORT_START <= rec['lb_port'] <= \
-                serve_core.LB_PORT_END
+                lb_start <= rec['lb_port'] <= lb_end
             assert core_lib.job_status(
                 cc, rec['controller_job_id']) == JobStatus.RUNNING
 
@@ -526,7 +533,8 @@ class TestTlsServeEndToEnd:
         task.set_resources(res)
         task.service = SkyServiceSpec(
             readiness_path='/', initial_delay_seconds=60,
-            readiness_timeout_seconds=3, min_replicas=1, port=18500,
+            readiness_timeout_seconds=3, min_replicas=1,
+            port=_ephemeral_port(),
             tls_keyfile=str(key), tls_certfile=str(cert))
 
         endpoint = serve_api.up(task, 'tlssvc',
@@ -567,7 +575,8 @@ class TestFallbackServeEndToEnd:
         task.set_resources(res)
         task.service = SkyServiceSpec(
             readiness_path='/', initial_delay_seconds=60,
-            readiness_timeout_seconds=3, min_replicas=2, port=18400,
+            readiness_timeout_seconds=3, min_replicas=2,
+            port=_ephemeral_port(),
             base_ondemand_fallback_replicas=1)
 
         endpoint = serve_api.up(task, 'fbsvc',
@@ -668,7 +677,8 @@ class TestRollingUpdate:
                 port=port)
             return task
 
-        endpoint = serve_api.up(make_task('one', 18300), 'updsvc',
+        svc_port = _ephemeral_port()
+        endpoint = serve_api.up(make_task('one', svc_port), 'updsvc',
                                 wait_ready_timeout=120)
         try:
             with urllib.request.urlopen(endpoint, timeout=10) as r:
@@ -677,7 +687,7 @@ class TestRollingUpdate:
                            for r in _replicas('updsvc')}
 
             version = serve_api.update('updsvc',
-                                       make_task('two', 18300))
+                                       make_task('two', svc_port))
             assert version == 2
 
             deadline = time.time() + 150
@@ -704,19 +714,22 @@ class TestRollingUpdate:
 
 @pytest.mark.slow
 class TestServeControllerDeath:
-    """A dead serve-controller process must surface as FAILED in
-    `serve status`, not a stale READY
-    (serve_state.reconcile_dead_controllers)."""
+    """Controller death vs graceful shutdown (docs/lifecycle.md).
 
-    def test_dead_controller_reconciles_to_failed(self, monkeypatch):
-        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
-        from skypilot_tpu import core as core_lib
-        from skypilot_tpu import serve as serve_api
+    REAL death (SIGKILL — no handler ran, nothing graceful coming)
+    must reconcile to FAILED, and that FAILED must be STICKY: the
+    reconciler wrote it fenced only after confirming the process
+    dead, so a zombie's late graceful DOWN cannot overwrite it.
+    A GRACEFUL shutdown (cancel → SIGTERM → controller drains and
+    writes DOWN itself) must end DOWN, not FAILED — the reconcile
+    grace distinguishes a live controller finishing its shutdown
+    from a corpse."""
+
+    def _make_task(self, name):
         from skypilot_tpu.resources import Resources
         from skypilot_tpu.task import Task
-
         task = Task(
-            name='dead-svc',
+            name=name,
             run=('python3 -m http.server $SKYTPU_REPLICA_PORT '
                  '--bind 127.0.0.1'))
         res = Resources(cloud='local')
@@ -724,21 +737,88 @@ class TestServeControllerDeath:
         task.set_resources(res)
         task.service = SkyServiceSpec(
             readiness_path='/', initial_delay_seconds=60,
-            readiness_timeout_seconds=3, min_replicas=1, port=18600)
-        serve_api.up(task, 'deadsvc', wait_ready_timeout=120)
+            readiness_timeout_seconds=3, min_replicas=1,
+            port=_ephemeral_port())
+        return task
+
+    def test_real_death_reconciles_to_failed_and_is_sticky(
+            self, monkeypatch):
+        import os
+        import signal
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu import state as state_lib
+        serve_api.up(self._make_task('dead-svc'), 'deadsvc',
+                     wait_ready_timeout=120)
         try:
             rec = _svc('deadsvc')
             assert rec['status'] == ServiceStatus.READY
-            # Kill the controller PROCESS out-of-band.
-            core_lib.cancel(rec['controller_cluster'],
-                            [rec['controller_job_id']])
-            deadline = time.time() + 60
+            pid = rec['controller_pid']
+            assert pid, rec
+            # REAL death: SIGKILL the controller PROCESS — no
+            # handler runs, no graceful write is coming.
+            os.kill(int(pid), signal.SIGKILL)
+            deadline = time.time() + 90
             while time.time() < deadline:
                 rec = _svc('deadsvc')
                 if rec['status'] == ServiceStatus.FAILED:
                     break
                 time.sleep(1)
             assert rec['status'] == ServiceStatus.FAILED, rec
+
+            # STICKY: replay the zombie's late graceful write —
+            # an unfenced DOWN against the controller-side DB. The
+            # fence must refuse it (lifecycle/fencing.py).
+            ctrl = state_lib.get_cluster_from_name(
+                rec['controller_cluster'])['handle']
+            import os as os_lib
+            ctrl_state = os_lib.path.join(ctrl.head_runtime_dir,
+                                          'managed')
+            with monkeypatch.context() as m:
+                m.setenv('SKYTPU_STATE_DIR', ctrl_state)
+                applied = serve_state.set_service_status(
+                    'deadsvc', ServiceStatus.DOWN)
+            assert applied is False
+            rec = _svc('deadsvc')
+            assert rec['status'] == ServiceStatus.FAILED, rec
         finally:
             serve_api.down('deadsvc')
         assert _svc('deadsvc') is None
+
+    def test_graceful_cancel_reconciles_to_down(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        # Generous reconcile grace: a cancelled controller is ALIVE
+        # and draining; the reconciler must not ladder-kill it while
+        # the teardown runs (slow CI). Must be set BEFORE up() so the
+        # controller cluster's agents inherit it (the reconcile
+        # prelude runs through them).
+        monkeypatch.setenv('SKYTPU_SERVE_RECONCILE_GRACE_SECONDS',
+                           '120')
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import serve as serve_api
+        serve_api.up(self._make_task('grace-svc'), 'gracesvc',
+                     wait_ready_timeout=120)
+        try:
+            rec = _svc('gracesvc')
+            assert rec['status'] == ServiceStatus.READY
+            # GRACEFUL: cancel the controller job — SIGTERM reaches
+            # the controller, which drains replicas and writes DOWN
+            # itself.
+            core_lib.cancel(rec['controller_cluster'],
+                            [rec['controller_job_id']])
+            deadline = time.time() + 90
+            saw_failed = False
+            while time.time() < deadline:
+                rec = _svc('gracesvc')
+                if rec is None or \
+                        rec['status'] == ServiceStatus.DOWN:
+                    break
+                saw_failed |= rec['status'] == ServiceStatus.FAILED
+                time.sleep(1)
+            assert rec is None or \
+                rec['status'] == ServiceStatus.DOWN, rec
+            assert not saw_failed, (
+                'graceful shutdown was mis-reconciled as a death')
+        finally:
+            serve_api.down('gracesvc')
+        assert _svc('gracesvc') is None
