@@ -39,7 +39,7 @@ from typing import Optional
 # here (tests do that in their own conftest).
 
 
-def serve_main() -> None:
+def serve_main() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -144,7 +144,7 @@ def serve_main() -> None:
     # (2147.98 output tok/s on v6e; see module docstring).
     vs_baseline = (out_tok_s * n_active / 6.74e9) / 2147.98
 
-    print(json.dumps({
+    return {
         'metric': f'{model_name}_serve_output_tokens_per_sec',
         'value': round(out_tok_s, 2),
         'unit': 'tokens/s',
@@ -163,10 +163,10 @@ def serve_main() -> None:
             'prefill_tok_s': round(batch * prompt_len / ttft_s, 1),
             'params_active': n_active,
         },
-    }))
+    }
 
 
-def serve_batch_main() -> None:
+def serve_batch_main() -> dict:
     """Continuous-batching request throughput (BENCH_MODE=serve_batch):
     R concurrent requests share the decode batch via
     serve/batching.BatchingEngine — the baseline analog is JetStream's
@@ -225,7 +225,7 @@ def serve_batch_main() -> None:
     # the baseline's prompt/gen mix is unpublished; the detail block
     # carries the raw token throughput for the stricter comparison.
     vs_baseline = (req_s * n_active / 6.74e9) / 11.42
-    print(json.dumps({
+    return {
         'metric': f'{model_name}_serve_requests_per_sec',
         'value': round(req_s, 2),
         'unit': 'req/s',
@@ -241,10 +241,10 @@ def serve_batch_main() -> None:
             'output_tok_s': round(out_tok_s, 1),
             'total_s': round(dt, 2),
         },
-    }))
+    }
 
 
-def main() -> None:
+def main() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -404,7 +404,7 @@ def main() -> None:
         # Launch time-to-first-step on the local fake (the second
         # half of BASELINE.json's north star) rides along too.
         _run_probe(result, 'launch', _launch_probe)
-    print(json.dumps(result))
+    return result
 
 
 def _qlora_probe(model_name: str = 'llama3.1-8b', seq: int = 2048,
@@ -668,7 +668,7 @@ def _serve_probe(model_name: Optional[str] = None,
     }
 
 
-def checkpoint_main() -> None:
+def checkpoint_main() -> dict:
     """BENCH_MODE=checkpoint (or ``--bench checkpoint``): native
     checkpoint engine throughput — save MB/s, restore MB/s, and the
     async overlap ratio (how much of the background write hides
@@ -724,7 +724,7 @@ def checkpoint_main() -> None:
     overlap = max(0.0, min(1.0, (t_save + t_compute - t_async) /
                            max(t_save, 1e-9)))
     save_mbps = nbytes / 1e6 / t_save
-    print(json.dumps({
+    return {
         'metric': 'checkpoint_save_mb_per_sec',
         'value': round(save_mbps, 2),
         'unit': 'MB/s',
@@ -740,10 +740,10 @@ def checkpoint_main() -> None:
             'compute_s': round(t_compute, 4),
             'async_overlap_ratio': round(overlap, 3),
         },
-    }))
+    }
 
 
-def launch_main() -> None:
+def launch_main() -> dict:
     """BENCH_MODE=launch: `launch` time-to-first-step on the local
     fake cloud (the un-measured half of BASELINE.json's north star —
     the reference publishes no number, BASELINE.md:32; this records
@@ -751,7 +751,7 @@ def launch_main() -> None:
     bring-up + submit + schedule, everything but the cloud API's
     VM-creation latency)."""
     breakdown = _launch_probe()
-    print(json.dumps({
+    return {
         'metric': 'launch_time_to_first_step_seconds',
         'value': round(breakdown['time_to_first_step'], 3),
         'unit': 's',
@@ -759,7 +759,7 @@ def launch_main() -> None:
         # this run seeds the baseline.
         'vs_baseline': 1.0,
         'detail': breakdown,
-    }))
+    }
 
 
 # ---------------------------------------------------------------------
@@ -928,10 +928,62 @@ def _reexec_retry_init(attempt: int) -> None:
               [sys.executable, __file__] + sys.argv[1:], env)
 
 
+# ---------------------------------------------------------------------
+# Perf regression gate (ROADMAP open item 1): every completed run is
+# committed into benchmark_state's sqlite history; with
+# --assert-no-regress the run FIRST compares its headline metric
+# against the best committed run of the same metric and exits nonzero
+# on a >SKYTPU_BENCH_REGRESS_PCT% (default 5) regression — perf claims
+# stay continuously proven instead of round-by-round archaeology.
+# ``xsky bench diff`` renders the same comparison offline.
+# ---------------------------------------------------------------------
+
+REGRESS_EXIT_CODE = 3
+
+
+# The state dir the bench STARTED with: the launch probe re-points
+# SKYTPU_STATE_DIR at a throwaway tempdir and the history must not
+# follow it there (a gate comparing against an always-empty DB would
+# pass forever).
+_GATE_STATE_DIR = os.environ.get('SKYTPU_STATE_DIR')
+
+
+def _record_and_gate(result: dict, assert_no_regress: bool) -> int:
+    """Returns the process exit code. Compare-then-record: the run
+    under test must never be its own bar. Recording failures (read-
+    only state dir) degrade to a warning — the bench's one-JSON-line
+    contract survives."""
+    if _GATE_STATE_DIR is None:
+        os.environ.pop('SKYTPU_STATE_DIR', None)
+    else:
+        os.environ['SKYTPU_STATE_DIR'] = _GATE_STATE_DIR
+    regressions = []
+    try:
+        from skypilot_tpu.benchmark import benchmark_state
+        regressions = benchmark_state.check_regression(result)
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'bench: regression check unavailable: {e!r}',
+              file=sys.stderr)
+    # Recording degrades independently: a read-only state dir must
+    # not swallow an ALREADY-DETECTED regression verdict.
+    try:
+        from skypilot_tpu.benchmark import benchmark_state
+        benchmark_state.record_bench_run(result)
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'bench: history recording unavailable: {e!r}',
+              file=sys.stderr)
+    if not assert_no_regress:
+        return 0
+    for msg in regressions:
+        print(f'bench: REGRESSION: {msg}', file=sys.stderr)
+    return REGRESS_EXIT_CODE if regressions else 0
+
+
 if __name__ == '__main__':
     try:
         _arm_run_watchdog()
         mode = os.environ.get('BENCH_MODE', 'train')
+        assert_flag = '--assert-no-regress' in sys.argv
         if '--bench' in sys.argv:
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
             idx = sys.argv.index('--bench')
@@ -944,15 +996,20 @@ if __name__ == '__main__':
                 raise SystemExit(2)
             mode = sys.argv[idx + 1]
         if mode == 'checkpoint':
-            checkpoint_main()
+            bench_result = checkpoint_main()
         elif mode == 'serve':
-            serve_main()
+            bench_result = serve_main()
         elif mode == 'serve_batch':
-            serve_batch_main()
+            bench_result = serve_batch_main()
         elif mode == 'launch':
-            launch_main()
+            bench_result = launch_main()
         else:
-            main()
+            bench_result = main()
+        print(json.dumps(bench_result))
+        sys.stdout.flush()
+        rc = _record_and_gate(bench_result, assert_flag)
+        if rc:
+            sys.exit(rc)
     except Exception as e:  # pylint: disable=broad-except
         if os.environ.get('BENCH_CPU_RETRY') != '1' and \
                 os.environ.get('JAX_PLATFORMS', '') != 'cpu' and \
@@ -964,12 +1021,16 @@ if __name__ == '__main__':
         if _PARTIAL.get('metric'):
             # A probe died after the headline metric was computed:
             # emit the partial result — a real number with an error
-            # annotation beats a zeroed round.
+            # annotation beats a zeroed round. The regression gate
+            # still runs on it: a crashed side probe must not let a
+            # regressed HEADLINE slip through --assert-no-regress.
             out = dict(_PARTIAL)
             out.setdefault('detail', {})['bench_error'] = \
                 repr(e)[:200]
             print(json.dumps(out))
-            sys.exit(0)
+            sys.stdout.flush()
+            sys.exit(_record_and_gate(
+                out, '--assert-no-regress' in sys.argv))
         # The driver records the single JSON line; never die silently.
         print(json.dumps({
             'metric': 'bench_error',

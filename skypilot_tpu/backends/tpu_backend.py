@@ -13,6 +13,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions, provision, state, status_lib
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.backends.backend import Backend, ClusterHandle
 from skypilot_tpu.provision.provisioner import RetryingProvisioner
 from skypilot_tpu.resilience import policy as policy_lib
@@ -465,7 +466,11 @@ class TpuBackend(Backend):
             'agent_token': getattr(handle, 'agent_token', None),
             'setup_cmd': task.setup if include_setup else None,
             'run_cmd': run_cmd,
-            'envs': dict(task.envs),
+            # Trace propagation: the submitting trace's context rides
+            # the spec to the head-side job driver (which brackets
+            # setup/run with spans and re-stamps each rank) — the
+            # task's own env wins if it already pins a context.
+            'envs': {**trace_lib.context_env(), **task.envs},
             'num_chips_per_node': handle.num_chips_per_host,
             'workdir': handle.workdir,
             'log_dir': log_dir,

@@ -7,7 +7,16 @@ candidate. Two runs become comparable OFFLINE (``xsky bench ls/show``)
 long after their clusters are gone — the reference persists exactly
 this and the round-4 verdict flagged our one-shot
 launch-wait-print as the gap (missing #3).
+
+The ``bench_runs`` table additionally records every ``bench.py``
+headline result (metric / value / unit / vs_baseline + detail JSON).
+That history is what turns perf claims from round-by-round
+archaeology into a SELF-ENFORCING gate: ``bench.py
+--assert-no-regress`` compares the current run against the best
+committed run per metric and exits nonzero past the threshold
+(``xsky bench diff`` shows the same comparison; ROADMAP open item 1).
 """
+import json
 import os
 import time
 from typing import Any, Dict, List, Optional
@@ -41,6 +50,15 @@ def _create_tables(cursor, conn):
         error TEXT,
         recorded_at REAL,
         PRIMARY KEY (benchmark, cluster))""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS bench_runs (
+        run_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        metric TEXT,
+        value REAL,
+        unit TEXT,
+        vs_baseline REAL,
+        recorded_at REAL,
+        detail TEXT)""")
     conn.commit()
 
 
@@ -129,6 +147,153 @@ def get_results(benchmark: str) -> List[Dict[str, Any]]:
         'error': r[8],
         'recorded_at': r[9],
     } for r in rows]
+
+
+# ---------------------------------------------------------------------
+# bench.py run history + regression gate (ROADMAP open item 1).
+# ---------------------------------------------------------------------
+
+# Metrics where SMALLER is better, by unit. Everything else (tokens/s,
+# req/s, MB/s, ...) is a throughput where bigger is better.
+_LOWER_IS_BETTER_UNITS = frozenset({'s', 'ms'})
+
+# Never gate on (or store as history) the error sentinel row.
+_UNGATED_METRICS = frozenset({'bench_error'})
+
+
+def lower_is_better(unit: Optional[str]) -> bool:
+    return (unit or '') in _LOWER_IS_BETTER_UNITS
+
+
+def regress_threshold_pct() -> float:
+    """Regression threshold in percent (>THIS fails the gate).
+    Env-tunable: SKYTPU_BENCH_REGRESS_PCT, default 5."""
+    try:
+        return float(os.environ.get('SKYTPU_BENCH_REGRESS_PCT', '5'))
+    except ValueError:
+        return 5.0
+
+
+def record_bench_run(result: Dict[str, Any]) -> Optional[int]:
+    """Persist one bench.py headline result; returns the run id (or
+    None for the error sentinel / malformed rows — an env-error round
+    must never become the 'best committed run' anything is gated
+    against)."""
+    metric = result.get('metric')
+    value = result.get('value')
+    if not metric or metric in _UNGATED_METRICS or \
+            not isinstance(value, (int, float)):
+        return None
+    db = _db()
+    try:
+        db.cursor.execute(
+            'INSERT INTO bench_runs (metric, value, unit, '
+            'vs_baseline, recorded_at, detail) VALUES (?,?,?,?,?,?)',
+            (metric, float(value), result.get('unit'),
+             result.get('vs_baseline'), time.time(),
+             json.dumps(result.get('detail') or {})))
+        run_id = db.cursor.lastrowid
+    finally:
+        db.conn.commit()
+    return int(run_id) if run_id is not None else None
+
+
+def bench_runs(metric: Optional[str] = None) -> List[Dict[str, Any]]:
+    sql = ('SELECT run_id, metric, value, unit, vs_baseline, '
+           'recorded_at, detail FROM bench_runs')
+    params: tuple = ()
+    if metric is not None:
+        sql += ' WHERE metric=?'
+        params = (metric,)
+    sql += ' ORDER BY recorded_at'
+    rows = _db().cursor.execute(sql, params).fetchall()
+    return [{
+        'run_id': r[0],
+        'metric': r[1],
+        'value': r[2],
+        'unit': r[3],
+        'vs_baseline': r[4],
+        'recorded_at': r[5],
+        'detail': r[6],
+    } for r in rows]
+
+
+def best_bench_run(metric: str) -> Optional[Dict[str, Any]]:
+    """The best COMMITTED run of this metric (max value; min for
+    lower-is-better units) — the bar the regression gate compares
+    against."""
+    runs = bench_runs(metric)
+    if not runs:
+        return None
+    if lower_is_better(runs[-1]['unit']):
+        return min(runs, key=lambda r: r['value'])
+    return max(runs, key=lambda r: r['value'])
+
+
+def check_regression(result: Dict[str, Any],
+                     threshold_pct: Optional[float] = None
+                     ) -> List[str]:
+    """Compare a bench result against the best committed run of the
+    same metric; returns human-readable regression messages (empty =
+    gate passes). A metric with no history trivially passes — the
+    FIRST committed run becomes the bar."""
+    if threshold_pct is None:
+        threshold_pct = regress_threshold_pct()
+    metric = result.get('metric')
+    value = result.get('value')
+    if not metric or metric in _UNGATED_METRICS or \
+            not isinstance(value, (int, float)):
+        return []
+    best = best_bench_run(metric)
+    if best is None or not best['value']:
+        return []
+    if lower_is_better(result.get('unit')):
+        delta_pct = (value - best['value']) / best['value'] * 100.0
+    else:
+        delta_pct = (best['value'] - value) / best['value'] * 100.0
+    if delta_pct > threshold_pct:
+        return [
+            f'{metric}: {value:g} {result.get("unit") or ""} is '
+            f'{delta_pct:.1f}% worse than the best committed run '
+            f'({best["value"]:g}, run {best["run_id"]}) — '
+            f'threshold {threshold_pct:g}%'
+        ]
+    return []
+
+
+def bench_diff() -> List[Dict[str, Any]]:
+    """Per-metric latest-vs-best comparison for ``xsky bench diff``:
+    [{metric, unit, best, latest, delta_pct, regressed}]."""
+    out: List[Dict[str, Any]] = []
+    metrics = [r[0] for r in _db().cursor.execute(
+        'SELECT DISTINCT metric FROM bench_runs '
+        'ORDER BY metric').fetchall()]
+    threshold = regress_threshold_pct()
+    for metric in metrics:
+        runs = bench_runs(metric)
+        latest = runs[-1]
+        best = best_bench_run(metric)
+        assert best is not None
+        if not best['value']:
+            delta_pct = 0.0
+        elif lower_is_better(latest['unit']):
+            delta_pct = ((latest['value'] - best['value']) /
+                         best['value'] * 100.0)
+        else:
+            delta_pct = ((best['value'] - latest['value']) /
+                         best['value'] * 100.0)
+        out.append({
+            'metric': metric,
+            'unit': latest['unit'],
+            'best': best['value'],
+            'best_run': best['run_id'],
+            'latest': latest['value'],
+            'latest_run': latest['run_id'],
+            'runs': len(runs),
+            'delta_pct': delta_pct,
+            'regressed': delta_pct > threshold,
+        })
+    return out
 
 
 def delete_benchmark(name: str) -> None:

@@ -170,6 +170,14 @@ class NativeCheckpointManager:
         return restored, step + 1
 
     def restore(self, step: int, state: Any) -> Any:
+        # Span is a no-op outside a trace; inside one (preemption
+        # resume under a managed job) the restore cost shows in the
+        # recovery waterfall.
+        from skypilot_tpu import trace as trace_lib
+        with trace_lib.span('ckpt.restore', attrs={'step': step}):
+            return self._restore_traced(step, state)
+
+    def _restore_traced(self, step: int, state: Any) -> Any:
         step_dir = os.path.join(self.path,
                                 commit_lib.step_dir_name(step))
         manifest = format_lib.read_manifest(step_dir)

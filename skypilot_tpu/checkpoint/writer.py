@@ -91,9 +91,13 @@ class AsyncWriter:
 
     def submit(self, step: int, payload: Any) -> None:
         """Enqueue a host snapshot; blocks when ``queue_depth``
-        writes are already in flight (bounded backpressure)."""
+        writes are already in flight (bounded backpressure). The
+        submitter's trace context rides along so the background
+        write's `ckpt.save` span parents into the train step that
+        triggered it (contextvars don't cross the writer thread)."""
         self.raise_pending_error()
-        self._queue.put((step, payload))
+        from skypilot_tpu import trace as trace_lib
+        self._queue.put((step, payload, trace_lib.current()))
         self._metrics['queue_depth'].set(self._queue.qsize())
 
     def wait(self) -> None:
@@ -122,18 +126,23 @@ class AsyncWriter:
     # -- writer thread --------------------------------------------------
 
     def _run(self) -> None:
+        from skypilot_tpu import trace as trace_lib
         while True:
             item = self._queue.get()
             if item is None:
                 self._queue.task_done()
                 return
-            step, payload = item
+            step, payload, trace_ctx = item
             t0 = time.perf_counter()
+            t0_wall = time.time()
+            span_status = 'OK'
+            span_bytes = 0
             try:
                 nbytes = self._write_fn(step, payload)
             except _AbandonedSave:
                 # Injected preemption mid-save: the tmp dir stays
                 # torn on disk, exactly as if the process had died.
+                span_status = 'ERROR'
                 self._metrics['saves_total'].labels(
                     outcome='abandoned').inc()
                 logger.warning('checkpoint save of step %d abandoned '
@@ -141,6 +150,7 @@ class AsyncWriter:
                 if self._on_abandoned is not None:
                     self._on_abandoned(step)
             except BaseException as e:  # pylint: disable=broad-except
+                span_status = 'ERROR'
                 with self._error_lock:
                     self._error = e
                 self._metrics['saves_total'].labels(
@@ -152,6 +162,7 @@ class AsyncWriter:
                 if isinstance(nbytes, tuple):
                     nbytes, committed = nbytes
                 dt = time.perf_counter() - t0
+                span_bytes = nbytes or 0
                 self._metrics['save_seconds'].observe(dt)
                 if nbytes:
                     self._metrics['bytes_total'].inc(nbytes)
@@ -160,6 +171,11 @@ class AsyncWriter:
                 if committed:
                     self._metrics['last_committed_step'].set(step)
             finally:
+                trace_lib.record_span(
+                    'ckpt.save', t0_wall,
+                    t0_wall + (time.perf_counter() - t0), trace_ctx,
+                    attrs={'step': step, 'bytes': span_bytes},
+                    status=span_status)
                 self._queue.task_done()
                 self._metrics['queue_depth'].set(self._queue.qsize())
 

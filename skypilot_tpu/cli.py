@@ -371,6 +371,84 @@ def metrics_cmd(cluster, url, name_filter, raw):
 
 
 # ---------------------------------------------------------------------
+# Distributed tracing (docs/observability.md, Tracing): assemble a
+# trace from the per-process span sinks and render the waterfall.
+# ---------------------------------------------------------------------
+
+
+@cli.command(name='trace')
+@click.argument('trace_id', required=False)
+@click.option('--job', 'job_id', type=int, default=None,
+              help='Render the trace of this managed job (looks the '
+                   'trace id up in the jobs controller state).')
+@click.option('--last', 'last', is_flag=True,
+              help='Render the most recently started trace.')
+@click.option('--chrome', 'chrome_out', default=None,
+              help='Write Chrome trace-event JSON (chrome://tracing '
+                   '/ Perfetto) to this path instead of rendering '
+                   'the waterfall.')
+@click.option('--root', 'roots', multiple=True,
+              help='Extra directories to scan for span sinks '
+                   '(default: the state dir + every known cluster\'s '
+                   'runtime tree).')
+def trace_cmd(trace_id, job_id, last, chrome_out, roots):
+    """Render a distributed trace as a waterfall tree.
+
+    TRACE_ID may be a unique prefix (the `[tid=...]` stamp in any
+    log line is enough). Span sinks are jsonl files written by every
+    traced process under its state dir
+    (``$SKYTPU_STATE_DIR/trace/``); see docs/observability.md for
+    the span-name contract.
+    """
+    from skypilot_tpu import trace as trace_lib
+    scan_roots = list(roots) or trace_lib.collect.default_roots()
+    selectors = sum(bool(x) for x in (trace_id, job_id is not None,
+                                      last))
+    if selectors != 1:
+        raise exceptions.SkyTpuError(
+            'Pass exactly one of TRACE_ID, --job ID, or --last.')
+    if job_id is not None:
+        from skypilot_tpu.jobs import core as jobs_core
+        rec = jobs_core.get(job_id)
+        if rec is None:
+            raise exceptions.SkyTpuError(
+                f'Managed job {job_id} unknown to the controller.')
+        trace_id = rec.get('trace_id')
+        if not trace_id:
+            raise exceptions.SkyTpuError(
+                f'Managed job {job_id} has no recorded trace id '
+                '(submitted before tracing, or SKYTPU_TRACE=0).')
+    if last:
+        # One pass over the sinks: pick the latest id and filter in
+        # memory (sinks can be tens of MB; don't parse them twice).
+        all_spans = trace_lib.collect.load_spans(scan_roots)
+        ids = trace_lib.collect.trace_ids(all_spans)
+        if not ids:
+            raise exceptions.SkyTpuError(
+                'No spans found under: ' + ', '.join(scan_roots))
+        trace_id = ids[0]
+        spans = [s for s in all_spans if s['trace_id'] == trace_id]
+    else:
+        spans = trace_lib.collect.load_spans(scan_roots,
+                                             trace_id=trace_id)
+    if not spans:
+        raise exceptions.SkyTpuError(
+            f'No spans for trace {trace_id!r} under: '
+            + ', '.join(scan_roots))
+    if chrome_out:
+        import json as json_lib
+        payload = trace_lib.collect.to_chrome(spans)
+        with open(os.path.expanduser(chrome_out), 'w',
+                  encoding='utf-8') as f:
+            json_lib.dump(payload, f)
+        click.echo(f'Wrote {len(payload["traceEvents"])} events to '
+                   f'{chrome_out} (load in chrome://tracing or '
+                   'Perfetto).')
+        return
+    click.echo(trace_lib.collect.render_waterfall(spans))
+
+
+# ---------------------------------------------------------------------
 # Chaos drills (docs/resilience.md): arm deterministic faults for
 # driver processes on this machine via $SKYTPU_STATE_DIR/chaos.conf.
 # ---------------------------------------------------------------------
@@ -1005,6 +1083,37 @@ def bench_show(benchmark_name, k_steps):
     click.echo(benchmark_utils.format_result_rows(
         benchmark_state.get_results(benchmark_name),
         k_steps=k_steps, show_cluster=True))
+
+
+@bench_group.command(name='diff')
+def bench_diff():
+    """Compare the latest bench.py run against the best committed
+    run per metric (the perf regression gate's view; `bench.py
+    --assert-no-regress` fails on the same >threshold regressions —
+    docs/observability.md, Bench gate)."""
+    from skypilot_tpu.benchmark import benchmark_state
+    rows = benchmark_state.bench_diff()
+    if not rows:
+        click.echo('No bench runs recorded yet (bench.py commits '
+                   'every completed run).')
+        return
+    table = ux_utils.Table(['METRIC', 'UNIT', 'BEST', 'LATEST',
+                            'DELTA', 'RUNS', 'VERDICT'])
+    regressed = False
+    for r in rows:
+        regressed |= r['regressed']
+        table.add_row([
+            r['metric'], r['unit'] or '-',
+            f'{r["best"]:g}', f'{r["latest"]:g}',
+            f'{-r["delta_pct"]:+.1f}%', r['runs'],
+            'REGRESSED' if r['regressed'] else 'ok',
+        ])
+    click.echo(table.get_string())
+    click.echo(f'Threshold: '
+               f'{benchmark_state.regress_threshold_pct():g}% '
+               '(SKYTPU_BENCH_REGRESS_PCT).')
+    if regressed:
+        raise SystemExit(1)
 
 
 @bench_group.command(name='down')

@@ -62,20 +62,29 @@ def _execute(task: Task, *, cluster_name: str,
     backend = TpuBackend()
     common_utils.check_cluster_name_is_valid(cluster_name)
     import time as time_lib
-    from skypilot_tpu.utils import timeline
+    from skypilot_tpu import trace as trace_lib
     timing: dict = {}
     # A failed launch must not leave the previous launch's numbers
     # readable as if they were this one's.
     _launch_timing_tls.timing = timing
     t_start = time_lib.monotonic()
+    # The launch's trace root: a bare `sky launch` starts a fresh
+    # trace here; a launch nested in a managed-job/serve controller
+    # (or any traced caller) becomes a child of THAT trace, so the
+    # whole provision→sync→submit subtree shows up under the request
+    # that caused it (docs/observability.md, Tracing).
+    launch_span = trace_lib.span('launch', new_trace=True,
+                                 attrs={'cluster': cluster_name})
 
     class _Timed:
         """Wall-clock one launch stage into the breakdown (and the
-        Chrome trace when SKYTPU_DEBUG=1)."""
+        trace: one `launch.<stage>` span per stage — the
+        BASELINE.json time-to-first-step breakdown and the waterfall
+        are the same numbers)."""
 
         def __init__(self, key: str):
             self.key = key
-            self._span = timeline.Event(f'launch.{key}')
+            self._span = trace_lib.span(f'launch.{key}')
 
         def __enter__(self):
             self._t0 = time_lib.monotonic()
@@ -88,98 +97,109 @@ def _execute(task: Task, *, cluster_name: str,
                 time_lib.monotonic() - self._t0
             return False
 
-    # Org integration point: the configured admin policy may mutate or
-    # reject the request (reference sky/admin_policy.py:101, applied
-    # at sky/execution.py entry).
-    from skypilot_tpu import admin_policy
-    task = admin_policy.apply(task, at='launch')
+    launch_span.__enter__()
+    try:
+        # Org integration point: the configured admin policy may
+        # mutate or reject the request (reference
+        # sky/admin_policy.py:101, applied at sky/execution.py entry).
+        from skypilot_tpu import admin_policy
+        task = admin_policy.apply(task, at='launch')
 
-    # Default-cloud resolution: tasks that don't pin a cloud go to
-    # gcp when credentials exist, else to the local fake provider
-    # (reference: enabled-clouds gate the optimizer's candidates,
-    # sky/check.py:19 + optimizer).
-    if not dryrun and any(r.cloud is None for r in task.resources):
-        import skypilot_tpu.check as check_lib
-        enabled = check_lib.get_cached_enabled_clouds_or_refresh()
-        if 'gcp' not in enabled:
-            task.set_resources({
-                r.copy(cloud='local') if r.cloud is None else r
-                for r in task.resources
-            })
+        # Default-cloud resolution: tasks that don't pin a cloud go to
+        # gcp when credentials exist, else to the local fake provider
+        # (reference: enabled-clouds gate the optimizer's candidates,
+        # sky/check.py:19 + optimizer).
+        if not dryrun and any(r.cloud is None for r in task.resources):
+            import skypilot_tpu.check as check_lib
+            enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+            if 'gcp' not in enabled:
+                task.set_resources({
+                    r.copy(cloud='local') if r.cloud is None else r
+                    for r in task.resources
+                })
 
-    to_provision: Optional[Resources] = None
-    if Stage.OPTIMIZE in stages:
-        existing = state.get_cluster_from_name(cluster_name)
-        if existing is not None and \
-                existing['status'] == status_lib.ClusterStatus.UP:
-            # Reuse path: no optimization needed (reference skips
-            # optimize for existing clusters).
-            to_provision = existing['handle'].launched_resources
+        to_provision: Optional[Resources] = None
+        if Stage.OPTIMIZE in stages:
+            existing = state.get_cluster_from_name(cluster_name)
+            if existing is not None and \
+                    existing['status'] == status_lib.ClusterStatus.UP:
+                # Reuse path: no optimization needed (reference skips
+                # optimize for existing clusters).
+                to_provision = existing['handle'].launched_resources
+            else:
+                with _Timed('optimize'):
+                    with Dag() as dag:
+                        dag.add(task)
+                    optimizer.optimize(dag, optimize_target,
+                                       quiet=quiet_optimizer)
+                    to_provision = task.best_resources  # type: ignore[attr-defined]
+        if to_provision is None:
+            to_provision = next(iter(task.resources))
+
+        handle = None
+        if Stage.PROVISION in stages:
+            with _Timed('provision'):
+                handle = backend.provision(
+                    task, to_provision, dryrun=dryrun,
+                    stream_logs=stream_logs,
+                    cluster_name=cluster_name,
+                    retry_until_up=retry_until_up)
         else:
-            with _Timed('optimize'):
-                with Dag() as dag:
-                    dag.add(task)
-                optimizer.optimize(dag, optimize_target,
-                                   quiet=quiet_optimizer)
-                to_provision = task.best_resources  # type: ignore[attr-defined]
-    if to_provision is None:
-        to_provision = next(iter(task.resources))
+            record = state.get_cluster_from_name(cluster_name)
+            assert record is not None, cluster_name
+            handle = record['handle']
+        if dryrun:
+            logger.info('Dryrun finished.')
+            return None, None
+        assert handle is not None
 
-    handle = None
-    if Stage.PROVISION in stages:
-        with _Timed('provision'):
-            handle = backend.provision(task, to_provision,
-                                       dryrun=dryrun,
-                                       stream_logs=stream_logs,
-                                       cluster_name=cluster_name,
-                                       retry_until_up=retry_until_up)
-    else:
-        record = state.get_cluster_from_name(cluster_name)
-        assert record is not None, cluster_name
-        handle = record['handle']
-    if dryrun:
-        logger.info('Dryrun finished.')
-        return None, None
-    assert handle is not None
+        if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+            with _Timed('sync_workdir'):
+                backend.sync_workdir(handle, task.workdir)
 
-    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
-        with _Timed('sync_workdir'):
-            backend.sync_workdir(handle, task.workdir)
+        if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                                 task.storage_mounts):
+            with _Timed('file_mounts'):
+                if task.storage_mounts:
+                    # Client side: ensure buckets exist, upload
+                    # sources.
+                    task.sync_storage_mounts()
+                # Cluster side: rsync file mounts, run mount scripts
+                # on every host (reference:
+                # cloud_vm_ray_backend.py:3138 sync stage +
+                # mounting_utils.py:265 mount script).
+                backend.sync_file_mounts(handle, task.file_mounts,
+                                         task.storage_mounts)
 
-    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
-                                             task.storage_mounts):
-        with _Timed('file_mounts'):
-            if task.storage_mounts:
-                # Client side: ensure buckets exist, upload sources.
-                task.sync_storage_mounts()
-            # Cluster side: rsync file mounts, run mount scripts on
-            # every host (reference: cloud_vm_ray_backend.py:3138
-            # sync stage + mounting_utils.py:265 mount script).
-            backend.sync_file_mounts(handle, task.file_mounts,
-                                     task.storage_mounts)
+        job_id = None
+        if Stage.EXEC in stages:
+            include_setup = Stage.SETUP in stages
+            with _Timed('submit'):
+                job_id = backend.execute(handle, task,
+                                         detach_run=detach_run,
+                                         include_setup=include_setup)
 
-    job_id = None
-    if Stage.EXEC in stages:
-        include_setup = Stage.SETUP in stages
-        with _Timed('submit'):
-            job_id = backend.execute(handle, task,
-                                     detach_run=detach_run,
-                                     include_setup=include_setup)
-
-    # `--down` without an idle threshold means "tear down once the
-    # job is done": expressed as autostop(idle=0, down=True) so it is
-    # safe with detach_run (an immediate teardown would kill the job
-    # that was just submitted).
-    if down and idle_minutes_to_autostop is None:
-        idle_minutes_to_autostop = 0
-    if idle_minutes_to_autostop is not None:
-        backend.set_autostop(handle, idle_minutes_to_autostop, down)
-    timing['total'] = time_lib.monotonic() - t_start
-    if job_id is not None:
-        logger.info(
-            'Launch timing (s): %s',
-            ', '.join(f'{k}={v:.2f}' for k, v in timing.items()))
-    return job_id, handle
+        # `--down` without an idle threshold means "tear down once the
+        # job is done": expressed as autostop(idle=0, down=True) so it
+        # is safe with detach_run (an immediate teardown would kill
+        # the job that was just submitted).
+        if down and idle_minutes_to_autostop is None:
+            idle_minutes_to_autostop = 0
+        if idle_minutes_to_autostop is not None:
+            backend.set_autostop(handle, idle_minutes_to_autostop,
+                                 down)
+        timing['total'] = time_lib.monotonic() - t_start
+        if job_id is not None:
+            logger.info(
+                'Launch timing (s): %s',
+                ', '.join(f'{k}={v:.2f}' for k, v in timing.items()))
+        return job_id, handle
+    except BaseException as e:
+        launch_span.status = 'ERROR'
+        launch_span.attrs.setdefault('error', repr(e)[:200])
+        raise
+    finally:
+        launch_span.__exit__(None, None, None)
 
 
 @usage.entrypoint('launch')

@@ -15,6 +15,7 @@ from typing import Optional, Tuple
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, state
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.resilience import faults
@@ -220,17 +221,36 @@ class JobsController:
     # -- main loop ------------------------------------------------------
 
     def run(self) -> jobs_state.ManagedJobStatus:
-        try:
-            final = self._run_all_tasks()
-        except Exception as e:  # pylint: disable=broad-except
-            logger.exception('controller crashed')
-            jobs_state.set_status(
-                self.job_id,
-                jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
-                failure_reason=repr(e))
-            return jobs_state.ManagedJobStatus.FAILED_CONTROLLER
-        jobs_state.set_status(self.job_id, final)
-        return final
+        # The controller's span: a child of the client's jobs.submit
+        # trace (adopted from the SKYTPU_TRACE_CONTEXT env stamp the
+        # gang driver applied), or a fresh root when run standalone.
+        # The trace_id lands in the managed_jobs row either way, so
+        # `xsky trace --job ID` resolves.
+        ctl_span = trace_lib.span('jobs.controller', new_trace=True,
+                                  attrs={'job_id': self.job_id})
+        with ctl_span:
+            if ctl_span.context is not None:
+                jobs_state.set_trace_id(self.job_id,
+                                        ctl_span.context.trace_id)
+            try:
+                final = self._run_all_tasks()
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception('controller crashed')
+                jobs_state.set_status(
+                    self.job_id,
+                    jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason=repr(e))
+                final = jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+                ctl_span.attrs.setdefault('error', repr(e)[:200])
+            else:
+                jobs_state.set_status(self.job_id, final)
+            # The root span's status must tell the same story as the
+            # job row (every other instrumented path marks ERROR on
+            # failure).
+            ctl_span.set_attr('status', final.value)
+            if final != jobs_state.ManagedJobStatus.SUCCEEDED:
+                ctl_span.status = 'ERROR'
+            return final
 
     def _run_all_tasks(self) -> jobs_state.ManagedJobStatus:
         for idx, task in enumerate(self.tasks):
@@ -249,7 +269,12 @@ class JobsController:
                               jobs_state.ManagedJobStatus.STARTING)
 
         self._stamp_task_id(task, idx)
-        job_id = strategy.launch(task, cluster_name)
+        # The initial launch span (the nested execution.launch emits
+        # its own optimize/provision/submit children inside it).
+        with trace_lib.span('jobs.launch',
+                            attrs={'task_idx': idx,
+                                   'cluster': cluster_name}):
+            job_id = strategy.launch(task, cluster_name)
         if job_id is None:
             return jobs_state.ManagedJobStatus.FAILED_NO_RESOURCE
         jobs_state.set_status(self.job_id,
@@ -309,8 +334,11 @@ class JobsController:
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
                 self._prepare_relaunch(task, idx)
-                job_id = strategy.recover(task, cluster_name,
-                                          preempted_region)
+                with trace_lib.span('jobs.recovery',
+                                    attrs={'attempt': recoveries,
+                                           'kind': 'preemption'}):
+                    job_id = strategy.recover(task, cluster_name,
+                                              preempted_region)
                 if job_id is None:
                     return jobs_state.ManagedJobStatus.\
                         FAILED_NO_RESOURCE
@@ -343,7 +371,11 @@ class JobsController:
                         self.job_id,
                         jobs_state.ManagedJobStatus.RECOVERING)
                     self._prepare_relaunch(task, idx)
-                    job_id = strategy.launch(task, cluster_name)
+                    with trace_lib.span(
+                            'jobs.recovery',
+                            attrs={'attempt': restarts_on_errors,
+                                   'kind': 'user_failure'}):
+                        job_id = strategy.launch(task, cluster_name)
                     if job_id is not None:
                         jobs_state.set_status(
                             self.job_id,
@@ -366,9 +398,12 @@ class JobsController:
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
                 self._prepare_relaunch(task, idx)
-                job_id = strategy.recover(
-                    task, cluster_name,
-                    self._cluster_region(cluster_name))
+                with trace_lib.span('jobs.recovery',
+                                    attrs={'attempt': recoveries,
+                                           'kind': 'driver_death'}):
+                    job_id = strategy.recover(
+                        task, cluster_name,
+                        self._cluster_region(cluster_name))
                 if job_id is None:
                     return jobs_state.ManagedJobStatus.\
                         FAILED_NO_RESOURCE
@@ -398,6 +433,7 @@ def main():
     parser.add_argument('--name', default='managed-job')
     parser.add_argument('--controller-cluster', default='')
     args = parser.parse_args()
+    trace_lib.set_component('jobs_controller')
     job_id = args.job_id
     if job_id is None:
         job_id = int(os.environ['SKYTPU_CLUSTER_JOB_ID'])

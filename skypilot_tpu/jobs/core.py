@@ -133,6 +133,21 @@ def launch(dag_or_task: Union[Dag, Task],
                  else dag_or_task)
         name = first.name or 'managed-job'
 
+    # The managed job's trace roots HERE, at client submit; the
+    # controller process inherits it through the job-spec env stamp
+    # and records the trace_id into the managed_jobs row, so
+    # `xsky trace --job ID` finds the whole submit → schedule →
+    # launch → recovery tree.
+    from skypilot_tpu import trace as trace_lib
+    with trace_lib.span('jobs.submit', new_trace=True,
+                        attrs={'name': name}):
+        job_id = _launch_traced(dag_or_task, name)
+    if not detach:
+        wait(job_id)
+    return job_id
+
+
+def _launch_traced(dag_or_task: Union[Dag, Task], name: str) -> int:
     handle = _ensure_controller_cluster()
     controller_cluster = _controller_cluster_name()
 
@@ -170,8 +185,6 @@ def launch(dag_or_task: Union[Dag, Task],
                     retry=True)
     logger.info('Managed job %d submitted (controller cluster %s)',
                 job_id, controller_cluster)
-    if not detach:
-        wait(job_id)
     return job_id
 
 

@@ -72,6 +72,11 @@ def _create_tables(cursor, conn):
     # silent fresh start.
     db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
                                  'resume_step', 'INTEGER')
+    # Migration for pre-tracing rows: the distributed-trace id of the
+    # job's submit→launch→recovery tree (docs/observability.md,
+    # Tracing) — `xsky trace --job ID` resolves through this.
+    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
+                                 'trace_id', 'TEXT')
     # Terminal-state fence columns (docs/lifecycle.md): a terminal
     # status written by a reconciler that CONFIRMED the controller
     # dead is stamped fenced; writes that bounce off it are counted.
@@ -206,6 +211,15 @@ def set_resume_step(job_id: int, step: Optional[int]) -> None:
         (step, job_id))
 
 
+def set_trace_id(job_id: int, trace_id: Optional[str]) -> None:
+    """Record the job's distributed-trace id (set once by the
+    controller at startup; COALESCE keeps the FIRST submit's id if a
+    restarted controller re-registers)."""
+    _db().execute_and_commit(
+        'UPDATE managed_jobs SET trace_id=COALESCE(trace_id, ?) '
+        'WHERE job_id=?', (trace_id, job_id))
+
+
 def bump_recovery(job_id: int) -> int:
     db = _db()
     db.execute_and_commit(
@@ -222,7 +236,7 @@ def get_job(job_id: int) -> Optional[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason, resume_step FROM managed_jobs '
+        'failure_reason, resume_step, trace_id FROM managed_jobs '
         'WHERE job_id=?', (job_id,)).fetchone()
     return _to_record(row) if row else None
 
@@ -231,7 +245,7 @@ def _to_record(row) -> Dict[str, Any]:
     (job_id, name, status, submitted_at, started_at, ended_at,
      task_cluster, controller_cluster, controller_job_id,
      recovery_count, dag_yaml_path, failure_reason,
-     resume_step) = row
+     resume_step, trace_id) = row
     return {
         'job_id': job_id,
         'name': name,
@@ -246,6 +260,7 @@ def _to_record(row) -> Dict[str, Any]:
         'dag_yaml_path': dag_yaml_path,
         'failure_reason': failure_reason,
         'resume_step': resume_step,
+        'trace_id': trace_id,
     }
 
 
@@ -254,7 +269,7 @@ def get_jobs() -> List[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason, resume_step FROM managed_jobs '
+        'failure_reason, resume_step, trace_id FROM managed_jobs '
         'ORDER BY job_id DESC').fetchall()
     return [_to_record(r) for r in rows]
 

@@ -423,8 +423,19 @@ def instrument_train_step(step_fn: Callable,
 
     Tokens per step default to ``batch['tokens'].shape`` minus the
     shifted label column, matching ``llama.loss_fn``'s convention.
+
+    Tracing: when the loop runs inside a trace (a managed job's task
+    gets the ``SKYTPU_TRACE_CONTEXT`` stamp from the gang driver),
+    every step emits a ``train.step`` span covering the SAME interval
+    the ``skytpu_train_step_seconds`` histogram observed — metrics
+    and traces agree by construction. The step span stays the ambient
+    context until the next call, so a checkpoint save submitted
+    between steps nests under it as a ``ckpt.save`` child. The final
+    step's span closes on the next call only (a loop that stops never
+    reports its last interval to the histogram either).
     """
     from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu import trace as trace_lib
     reg = metrics_lib.registry()
     step_hist = reg.histogram(
         'skytpu_train_step_seconds',
@@ -438,6 +449,13 @@ def instrument_train_step(step_fn: Callable,
     tok_s = reg.gauge('skytpu_train_tokens_per_sec',
                       'Token throughput of the latest step.')
     last_call: List[Optional[float]] = [None]
+    # Open train.step span state: (context, parent, start_wall,
+    # ambient-token, step_index). The span's identity is
+    # pre-allocated (trace.child_context) so children recorded while
+    # it is ambient parent correctly; it is EMITTED when the next
+    # call closes the interval.
+    open_step: List[Optional[tuple]] = [None]
+    step_idx = [0]
 
     def _tokens_in(batch) -> int:
         if tokens_per_step is not None:
@@ -448,20 +466,52 @@ def instrument_train_step(step_fn: Callable,
         except Exception:  # pylint: disable=broad-except
             return 0
 
-    @functools.wraps(getattr(step_fn, '__wrapped__', step_fn))
     def wrapper(state, batch):
         now = time.perf_counter()
+        now_wall = time.time()
         n_tokens = _tokens_in(batch)
         if last_call[0] is not None:
             dt = now - last_call[0]
             step_hist.observe(dt)
             if dt > 0 and n_tokens:
                 tok_s.set(n_tokens / dt)
+            prev = open_step[0]
+            if prev is not None:
+                ctx, parent, start_wall, token, idx = prev
+                trace_lib.reset_current(token)
+                # SAME dt as the histogram observation above.
+                trace_lib.emit_span(ctx, parent, 'train.step',
+                                    start_wall, start_wall + dt,
+                                    attrs={'step': idx,
+                                           'tokens': n_tokens})
+                open_step[0] = None
         last_call[0] = now
+        parent = trace_lib.current()
+        if parent is not None:
+            ctx = trace_lib.child_context(parent)
+            token = trace_lib.set_current(ctx)
+            open_step[0] = (ctx, parent, now_wall, token,
+                            step_idx[0])
+        step_idx[0] += 1
         steps_total.inc()
         if n_tokens:
             tokens_total.inc(n_tokens)
         return step_fn(state, batch)
 
+    # Identity copy done BY HAND, not functools.wraps: wraps()
+    # silently skips attributes the target lacks, so wrapping a
+    # callable object (older jit wrappers, partials, mocks) used to
+    # leave the wrapper named 'wrapper' with this function's
+    # docstring gone. Fall back through __wrapped__ → the callable →
+    # its type.
+    target = getattr(step_fn, '__wrapped__', step_fn)
+    wrapper.__name__ = getattr(
+        target, '__name__', type(step_fn).__name__)
+    wrapper.__qualname__ = getattr(
+        target, '__qualname__', wrapper.__name__)
+    wrapper.__doc__ = getattr(target, '__doc__', None)
+    wrapper.__module__ = getattr(
+        target, '__module__', wrapper.__module__)
+    wrapper.__wrapped__ = step_fn
     wrapper.inner = step_fn
     return wrapper

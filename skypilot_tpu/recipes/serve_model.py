@@ -14,6 +14,8 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from skypilot_tpu import trace as trace_lib
+
 
 def main():
     parser = argparse.ArgumentParser()
@@ -48,6 +50,7 @@ def main():
                              'task-id subdir, e.g. a mounted bucket '
                              'path.')
     args = parser.parse_args()
+    trace_lib.set_component('replica')
     if args.quant == 'int8' and args.tp > 1:
         # Reject before the (expensive) sharded init, not after.
         parser.error('--quant int8 with --tp > 1 is not supported yet')
@@ -253,6 +256,22 @@ def main():
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
             stream = bool(body.get('stream'))
+            # Adopt the LB's traceparent hop (attach(None) is a
+            # barrier: an untraced request must not inherit this
+            # replica process's own launch-time trace context).
+            ctx = trace_lib.parse_traceparent(
+                self.headers.get(trace_lib.TRACEPARENT_HEADER))
+            with trace_lib.attach(ctx), \
+                    trace_lib.span('replica.generate',
+                                   attrs={'prompt_len':
+                                          len(prompt_ids),
+                                          'max_new': max_new}):
+                self._generate_response(prompt_ids, max_new,
+                                        temperature, top_p, seed,
+                                        eos_id, stream)
+
+        def _generate_response(self, prompt_ids, max_new, temperature,
+                               top_p, seed, eos_id, stream):
             if stream and engine is not None and temperature is None \
                     and top_p is None:
                 # SSE: tokens leave as the engine produces them (per

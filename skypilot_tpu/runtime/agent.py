@@ -49,6 +49,20 @@ except ImportError:
     # text exposition by hand — same gauges, no registry.
     metrics_lib = None
 
+try:
+    from skypilot_tpu import trace as trace_lib
+except ImportError:
+    # Standalone bootstrap: no tracer — requests still work, the
+    # traceparent header is simply forwarded into spawned-process
+    # env by raw string (see _trace_env_from_header).
+    trace_lib = None
+
+# The env var the traceparent header is re-stamped into for spawned
+# processes (kept as a literal so the standalone bootstrap needs no
+# tracer import to propagate context).
+TRACE_CONTEXT_ENV = 'SKYTPU_TRACE_CONTEXT'
+TRACEPARENT_HEADER = 'traceparent'
+
 # '2': /status grew long-poll (wait=). The version handshake
 # (tpu_backend._ensure_runtime_version) restarts stale agents on
 # reused clusters — without the bump an old agent would ignore
@@ -124,6 +138,12 @@ class _ProcTable:
         log_path = os.path.expanduser(log_path)
         os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
         full_env = dict(os.environ)
+        # Trace context reaches spawned processes ONLY explicitly
+        # (request env or the re-stamped traceparent header) — never
+        # inherited from this agent process's own environment, which
+        # would glue every spawn to whatever trace launched the
+        # agent.
+        full_env.pop(TRACE_CONTEXT_ENV, None)
         full_env.update(env or {})
         logf = open(log_path, 'ab')
         cwd = os.path.expanduser(cwd) if cwd else None
@@ -306,11 +326,38 @@ def metrics_text() -> str:
     return reg.render()
 
 
+def _trace_env_from_header(header_value: Optional[str],
+                           env: Dict[str, str]) -> Dict[str, str]:
+    """Cross-process trace propagation at the spawn boundary: the
+    caller's traceparent header is re-stamped into the spawned
+    process's env (the request's own env wins if it already pins a
+    context). Pure string plumbing so the standalone (k8s bootstrap)
+    agent propagates too."""
+    if header_value and TRACE_CONTEXT_ENV not in env:
+        env = dict(env)
+        env[TRACE_CONTEXT_ENV] = header_value
+    return env
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = 'HTTP/1.1'
 
     def log_message(self, fmt, *args):  # quiet
         pass
+
+    def _trace_header(self) -> Optional[str]:
+        return self.headers.get(TRACEPARENT_HEADER)
+
+    def _span(self, name: str):
+        """A server-side span under the REQUEST's context (header
+        only — never the agent process's ambient env, which would
+        glue every request to the agent's own launch trace). No-op
+        context manager when untraced or standalone."""
+        if trace_lib is None:
+            import contextlib
+            return contextlib.nullcontext()
+        ctx = trace_lib.parse_traceparent(self._trace_header())
+        return trace_lib.span(name, parent=ctx)
 
     def _json(self, obj, code=200):
         body = json.dumps(obj).encode()
@@ -407,21 +454,35 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'error': 'bad json'}, 400)
             return
         if parsed.path == '/run':
-            proc_id = _procs.start(body['cmd'],
-                                   body.get('log_path', '/dev/null'),
-                                   body.get('env') or {},
-                                   body.get('cwd') or '')
+            env = _trace_env_from_header(self._trace_header(),
+                                         body.get('env') or {})
+            with self._span('agent.run') as sp:
+                proc_id = _procs.start(body['cmd'],
+                                       body.get('log_path',
+                                                '/dev/null'),
+                                       env, body.get('cwd') or '')
+                if sp is not None and hasattr(sp, 'set_attr'):
+                    sp.set_attr('proc_id', proc_id)
             self._json({'proc_id': proc_id})
         elif parsed.path == '/kill':
             ok = _procs.kill(int(body['proc_id']))
             self._json({'ok': ok})
         elif parsed.path == '/exec':
             timeout = float(body.get('timeout', 600))
+            # The request's header ALWAYS wins over the agent's own
+            # environment (which may carry the stale stamp of
+            # whatever trace launched the agent); no header = no
+            # stamp.
+            exec_env = dict(os.environ)
+            exec_env.pop(TRACE_CONTEXT_ENV, None)
+            exec_env = _trace_env_from_header(self._trace_header(),
+                                              exec_env)
             try:
-                out = subprocess.run(
-                    ['/bin/bash', '-c', body['cmd']],
-                    capture_output=True, text=True, timeout=timeout,
-                    check=False)
+                with self._span('agent.exec'):
+                    out = subprocess.run(
+                        ['/bin/bash', '-c', body['cmd']],
+                        capture_output=True, text=True,
+                        timeout=timeout, env=exec_env, check=False)
                 self._json({'returncode': out.returncode,
                             'output': (out.stdout or '') +
                                       (out.stderr or '')})
@@ -469,6 +530,8 @@ def serve(port: int = DEFAULT_PORT, host: str = '0.0.0.0',
     global _token
     if token is not None:
         _token = token
+    if trace_lib is not None:
+        trace_lib.set_component('host_agent')
     if runtime_dir is None:
         runtime_dir = os.environ.get('SKYTPU_RUNTIME_DIR')
     threading.Thread(target=_liveness_guard,

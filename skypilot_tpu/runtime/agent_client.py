@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.resilience import policy as policy_lib
 
@@ -100,6 +101,13 @@ class AgentClient:
         headers = {'Content-Type': 'application/json'}
         if self.token:
             headers['X-Skytpu-Token'] = self.token
+        # Trace propagation: every RPC carries the caller's context;
+        # the agent adopts it (and injects it into processes it
+        # spawns for /run and /exec) so the trace crosses the
+        # driver→host hop.
+        stamp = trace_lib.format_traceparent()
+        if stamp is not None:
+            headers[trace_lib.TRACEPARENT_HEADER] = stamp
         return headers
 
     def _open(self, req: urllib.request.Request, timeout: float,
@@ -218,7 +226,13 @@ class AgentClient:
                             path) as resp:
                 return json.loads(resp.read())
 
-        return self._call(do, retry=retry, gate=True)
+        # One client-side span per POST (the RPCs that DO work —
+        # /run, /exec, /kill); GET polls stay span-free so liveness
+        # loops don't flood traces.
+        with trace_lib.span('agent.rpc',
+                            attrs={'host': self._target,
+                                   'path': path}):
+            return self._call(do, retry=retry, gate=True)
 
     # -- API ------------------------------------------------------------
 
@@ -353,6 +367,11 @@ def start_local_agent(port: int,
     ``<runtime_dir>/agent_token`` (0600) and enforced on every
     request."""
     env = dict(os.environ)
+    # A daemon belongs to no request trace: a traced spawner (e.g. a
+    # managed-job controller) must not stamp its launch-time context
+    # onto the agent for the agent's whole lifetime — request context
+    # arrives per-RPC via the traceparent header instead.
+    env.pop(trace_lib.ENV_CONTEXT, None)
     if runtime_dir:
         env['SKYTPU_RUNTIME_DIR'] = runtime_dir
     binary = resolve_agent_binary() if use_cpp in (None, True) else None

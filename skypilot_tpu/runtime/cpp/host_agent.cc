@@ -219,6 +219,10 @@ class ProcTable {
         dup2(fd, STDERR_FILENO);
         close(fd);
       }
+      // Trace context reaches spawned processes only explicitly
+      // (request env / re-stamped header), never inherited from the
+      // agent's own environment.
+      unsetenv("SKYTPU_TRACE_CONTEXT");
       for (const auto& kv : env) {
         if (kv.second.type == JsonValue::kString) {
           setenv(kv.first.c_str(), kv.second.str.c_str(), 1);
@@ -380,7 +384,8 @@ void LivenessGuard() {
 }
 
 // Blocking exec with timeout; captures combined output.
-int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output) {
+int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output,
+                 const std::string& trace_ctx = std::string()) {
   int pipefd[2];
   if (pipe(pipefd) != 0) return -1;
   pid_t pid = fork();
@@ -391,6 +396,12 @@ int ExecBlocking(const std::string& cmd, double timeout_s, std::string* output) 
     dup2(pipefd[1], STDOUT_FILENO);
     dup2(pipefd[1], STDERR_FILENO);
     close(pipefd[1]);
+    // Trace pass-through (mirrors the /run env stamp): snippets the
+    // driver execs on this host stay in the caller's trace; the
+    // header always wins over (and absent it, clears) any stale
+    // stamp in the agent's own environment.
+    unsetenv("SKYTPU_TRACE_CONTEXT");
+    if (!trace_ctx.empty()) setenv("SKYTPU_TRACE_CONTEXT", trace_ctx.c_str(), 1);
     execl("/bin/bash", "bash", "-c", cmd.c_str(), nullptr);
     _exit(127);
   }
@@ -432,7 +443,13 @@ struct Request {
   std::map<std::string, std::string> query;
   std::string body;
   std::string token;       // X-SkyTpu-Token header, if present
+  std::string traceparent; // traceparent header, if present
 };
+
+// Env var the traceparent header is re-stamped into for processes
+// this agent spawns (/run, /exec) — the cross-process trace
+// propagation hop (mirrors runtime/agent.py TRACE_CONTEXT_ENV).
+constexpr const char kTraceContextEnv[] = "SKYTPU_TRACE_CONTEXT";
 
 // Per-cluster shared secret (empty = auth disabled). Loaded in main()
 // from --token-file / SKYTPU_AGENT_TOKEN; every request must present
@@ -517,6 +534,11 @@ bool ReadRequest(int fd, Request* req) {
         std::string value = h.substr(colon + 1);
         size_t start = value.find_first_not_of(" \t");
         req->token = start == std::string::npos ? "" : value.substr(start);
+      } else if (name == "traceparent") {
+        std::string value = h.substr(colon + 1);
+        size_t start = value.find_first_not_of(" \t");
+        req->traceparent =
+            start == std::string::npos ? "" : value.substr(start);
       }
     }
     pos = eol + 2;
@@ -726,6 +748,15 @@ void HandleConnection(int fd) {
       if (it != body.obj.end() && it->second.type == JsonValue::kObject) {
         env = it->second.obj;
       }
+      // Trace pass-through: re-stamp the caller's traceparent header
+      // into the spawned process env (request env wins if it already
+      // pins a context).
+      if (!req.traceparent.empty() && env.find(kTraceContextEnv) == env.end()) {
+        JsonValue v;
+        v.type = JsonValue::kString;
+        v.str = req.traceparent;
+        env[kTraceContextEnv] = v;
+      }
       int id = g_procs.Start(body.obj["cmd"].str, body.obj["log_path"].str, env,
                              body.obj["cwd"].str);
       char buf[48];
@@ -741,7 +772,8 @@ void HandleConnection(int fd) {
         timeout = it->second.num;
       }
       std::string output;
-      int rc = ExecBlocking(body.obj["cmd"].str, timeout, &output);
+      int rc = ExecBlocking(body.obj["cmd"].str, timeout, &output,
+                            req.traceparent);
       std::string json = "{\"returncode\": " + std::to_string(rc) +
                          ", \"output\": \"" + JsonEscape(output) + "\"}";
       SendJson(fd, json);

@@ -22,6 +22,7 @@ from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Any, Dict, List
 
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.runtime import env_contract, job_lib
 from skypilot_tpu.runtime.agent_client import AgentClient
 
@@ -77,6 +78,17 @@ def _remote_log_path(spec: Dict[str, Any], rank: int) -> str:
 
 def run_job(job_id: int) -> job_lib.JobStatus:
     spec = _load_spec(job_id)
+    # Adopt the SUBMITTER's trace (stamped into the spec envs by
+    # tpu_backend.execute): setup/run spans — and every agent RPC the
+    # driver makes — land in the launch's trace tree.
+    ctx = trace_lib.parse_traceparent(
+        (spec.get('envs') or {}).get(trace_lib.ENV_CONTEXT))
+    with trace_lib.attach(ctx):
+        return _run_job_traced(job_id, spec)
+
+
+def _run_job_traced(job_id: int,
+                    spec: Dict[str, Any]) -> job_lib.JobStatus:
     hosts = spec['hosts']
     n = len(hosts)
     ips = [h['ip'] for h in hosts]
@@ -88,11 +100,35 @@ def run_job(job_id: int) -> job_lib.JobStatus:
 
     # SETUP phase.
     job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP)
-    if not _run_setup(clients, spec, log_dir):
+    with trace_lib.span('job.setup', attrs={'job_id': job_id,
+                                            'hosts': n}):
+        setup_ok = _run_setup(clients, spec, log_dir)
+    if not setup_ok:
         job_lib.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
         return job_lib.JobStatus.FAILED_SETUP
 
-    # RUN phase: gang start.
+    # RUN phase: gang start. The span covers gang start → last rank
+    # exit; each rank process is re-stamped with THIS span's context
+    # so whatever the task does (train steps, checkpoint saves,
+    # controller work) nests under `job.run`.
+    run_span = trace_lib.span('job.run', attrs={'job_id': job_id,
+                                                'hosts': n})
+    run_span.__enter__()
+    try:
+        return _gang_run(job_id, spec, clients, hosts, ips, n,
+                         log_dir, run_span)
+    except BaseException:
+        # Gang start itself failed (dead agent mid-start): the span
+        # must still record — a failed launch is exactly what the
+        # trace exists to explain.
+        run_span.status = 'ERROR'
+        run_span.__exit__(None, None, None)
+        raise
+
+
+def _gang_run(job_id: int, spec: Dict[str, Any], clients, hosts,
+              ips, n: int, log_dir: str,
+              run_span) -> job_lib.JobStatus:
     job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
     task_id = (f'sky-{spec["run_timestamp"]}-'
                f'{spec.get("task_name") or "task"}')
@@ -106,6 +142,7 @@ def run_job(job_id: int) -> job_lib.JobStatus:
             # contract (hosts are rank-ordered slice-major).
             num_slices=spec.get('num_slices') or 1)
         env.update(spec.get('envs') or {})
+        env.update(trace_lib.context_env())
         # The cluster-local job id, so jobs that ARE controllers
         # (managed jobs / serve) can self-identify: managed job id ==
         # controller-cluster job id (reference contract,
@@ -152,7 +189,7 @@ def run_job(job_id: int) -> job_lib.JobStatus:
 
     states: List[Dict[str, Any]] = [
         {'running': True, 'returncode': None} for _ in range(n)]
-    final: job_lib.JobStatus
+    final: job_lib.JobStatus = job_lib.JobStatus.FAILED_DRIVER
     try:
         with ThreadPoolExecutor(max_workers=n) as pool:
             while True:
@@ -190,6 +227,10 @@ def run_job(job_id: int) -> job_lib.JobStatus:
     finally:
         stop_pump.set()
         pump.join(timeout=fetch_interval + 5)
+        if final != job_lib.JobStatus.SUCCEEDED:
+            run_span.status = 'ERROR'
+        run_span.set_attr('status', final.value)
+        run_span.__exit__(None, None, None)
     with offsets_lock:
         _fetch_logs(clients, spec, offsets, run_log)
 
@@ -268,6 +309,7 @@ def main():
     args = parser.parse_args()
     import signal
     signal.signal(signal.SIGTERM, _sigterm_gang_kill)
+    trace_lib.set_component('job_driver')
     # Supervised-daemon registration (lifecycle/registry.py): the
     # runtime dir is the liveness anchor — a driver outliving its
     # cluster's runtime dir is an orphan the sweeper may reap.

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models.quant import matmul as _mm
 
@@ -239,6 +240,12 @@ class _Request:
         self.eos_id = eos_id
         self.out: 'queue.Queue' = queue.Queue()
         self.submitted_at = time.time()
+        # Trace context captured at submit (the engine loop runs on
+        # its own thread — contextvars don't cross it): queue-wait /
+        # prefill / TTFT / decode-chunk spans are emitted under the
+        # SUBMITTING request's trace. None = untraced request, spans
+        # cost nothing.
+        self.trace_ctx = trace_lib.current()
 
 
 def _engine_metrics():
@@ -399,8 +406,14 @@ class BatchingEngine:
     # -- engine loop ----------------------------------------------------
 
     def _admit(self, req: _Request, row: int) -> None:
+        # One clock read for the metric observation AND the span end
+        # — the histogram and the trace must tell the same story.
+        t_admit = time.time()
         self._metrics['queue_wait'].observe(
-            time.time() - req.submitted_at)
+            t_admit - req.submitted_at)
+        trace_lib.record_span('batch.queue_wait', req.submitted_at,
+                              t_admit, req.trace_ctx,
+                              attrs={'slot': row})
         self._metrics['requests'].inc()
         t0 = len(req.prompt_ids)
         bucket = 1
@@ -419,6 +432,7 @@ class BatchingEngine:
         # of a non-power-of-two prompt). Right-padding is causally
         # safe — see module docstring.
         last_only = (bucket == t0)
+        t_prefill = time.time()
         logits, cache = self._prefill(self.params, prompt, cache,
                                       self.config, last_only, True)
         first = int(logits[0, -1 if last_only else t0 - 1].argmax(-1))
@@ -427,8 +441,19 @@ class BatchingEngine:
         self.tokens = self.tokens.at[row].set(first)
         self.slot_req[row] = req
         self.slot_left[row] = req.max_new - 1
-        # The first token is produced by the prefill itself.
-        self._metrics['ttft'].observe(time.time() - req.submitted_at)
+        # The first token is produced by the prefill itself. The TTFT
+        # observation and the batch.first_token span end on the SAME
+        # clock read; batch.prefill covers prefill dispatch → slot
+        # insert (the int() above synchronizes, so this is real wall
+        # time).
+        t_first = time.time()
+        trace_lib.record_span('batch.prefill', t_prefill, t_first,
+                              req.trace_ctx,
+                              attrs={'prompt_len': t0,
+                                     'bucket': bucket})
+        trace_lib.record_span('batch.first_token', req.submitted_at,
+                              t_first, req.trace_ctx)
+        self._metrics['ttft'].observe(t_first - req.submitted_at)
         self._metrics['tokens'].inc()
         req.out.put(first)
         if self.slot_left[row] <= 0 or first == req.eos_id:
@@ -506,14 +531,22 @@ class BatchingEngine:
                 # wall time for len(active_rows) * n tokens.
                 self._metrics['tok_s'].set(
                     len(active_rows) * n / dispatch_s)
+            # Per-chunk decode spans: one `batch.decode` per traced
+            # request per dispatch, all sharing the dispatch's wall
+            # window — a request's TTFT decomposes as queue_wait +
+            # prefill + its decode chunks in the waterfall.
+            t_chunk_end = time.time()
+            t_chunk_start = t_chunk_end - dispatch_s
             emitted = 0
             for i in active_rows:
                 req = self.slot_req[i]
                 emit = min(self.slot_left[i], n)
                 done = False
+                row_emitted = 0
                 for t in host_toks[i][:emit]:
                     req.out.put(int(t))
                     emitted += 1
+                    row_emitted += 1
                     self.slot_left[i] -= 1
                     if int(t) == req.eos_id:
                         # EOS retires the row NOW; anything the
@@ -522,6 +555,11 @@ class BatchingEngine:
                         # reuse).
                         done = True
                         break
+                if row_emitted:
+                    trace_lib.record_span(
+                        'batch.decode', t_chunk_start, t_chunk_end,
+                        req.trace_ctx,
+                        attrs={'tokens': row_emitted, 'slot': i})
                 if done or self.slot_left[i] <= 0:
                     req.out.put(None)
                     self.slot_req[i] = None

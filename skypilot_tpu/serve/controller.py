@@ -274,6 +274,8 @@ def main():
     parser.add_argument('--task-yaml', required=True)
     parser.add_argument('--lb-port', type=int, required=True)
     args = parser.parse_args()
+    from skypilot_tpu import trace as trace_lib
+    trace_lib.set_component('serve_controller')
     from skypilot_tpu.utils import common_utils
     config = common_utils.read_yaml(args.task_yaml)
     task = Task.from_yaml_config(config)

@@ -140,7 +140,7 @@ def up(task: Task, service_name: Optional[str] = None,
     service), so it must exceed worst-case bring-up, not ping time.
     """
     from skypilot_tpu import admin_policy
-    from skypilot_tpu import execution, provision
+    from skypilot_tpu import trace as trace_lib
     task = admin_policy.apply(task, at='serve')
     if task.service is None:
         raise exceptions.InvalidSpecError(
@@ -148,6 +148,18 @@ def up(task: Task, service_name: Optional[str] = None,
     if service_name is None:
         service_name = task.name or 'service'
     common_utils.check_cluster_name_is_valid(service_name)
+    # Root the bring-up trace here: controller-cluster launch,
+    # registration RPCs and the controller task submit all nest under
+    # one `serve.up` (per-REQUEST traces are rooted by the LB, not
+    # here).
+    with trace_lib.span('serve.up', new_trace=True,
+                        attrs={'service': service_name}):
+        return _up_traced(task, service_name, wait_ready_timeout)
+
+
+def _up_traced(task: Task, service_name: str,
+               wait_ready_timeout: float) -> str:
+    from skypilot_tpu import execution, provision
 
     handle = _ensure_controller_cluster()
     controller_cluster = _controller_cluster_name()

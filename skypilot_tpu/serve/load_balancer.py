@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
+from skypilot_tpu import trace as trace_lib
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -196,13 +197,48 @@ class SkyServeLoadBalancer:
                 self.wfile.write(body)
 
             def _proxy(self, method: str):
-                t_start = time.time()
+                # ONE wall + ONE monotonic read anchor the whole
+                # request: every latency metric observation and every
+                # span timestamp below derives from these two reads,
+                # so `skytpu_lb_request_seconds` and the trace
+                # durations can never skew apart.
+                t_start_wall = time.time()
+                t_start_mono = time.monotonic()
                 with lb._ts_lock:  # pylint: disable=protected-access
-                    lb.request_timestamps.append(t_start)
-                lb._qps_window.record(t_start)  # pylint: disable=protected-access
+                    lb.request_timestamps.append(t_start_wall)
+                lb._qps_window.record(t_start_wall)  # pylint: disable=protected-access
+                # The LB roots the serve request's trace; a client
+                # that sent its own traceparent gets the LB span as a
+                # CHILD of its trace instead (never the LB process's
+                # ambient launch-time context — parent is explicit).
+                # New roots are head-sampled (SKYTPU_TRACE_SAMPLE) so
+                # a production fleet bounds per-request span volume;
+                # header-carrying requests are always traced.
+                incoming = trace_lib.parse_traceparent(
+                    self.headers.get(trace_lib.TRACEPARENT_HEADER))
+                req_span = trace_lib.span(
+                    'lb.request',
+                    new_trace=(incoming is not None or
+                               trace_lib.sample_root()),
+                    parent=incoming,
+                    attrs={'path':
+                           urllib.parse.urlsplit(self.path).path})
+                with req_span:
+                    self._proxy_inner(method, t_start_wall,
+                                      t_start_mono, req_span)
+
+            def _proxy_inner(self, method: str, t_start_wall: float,
+                             t_start_mono: float,
+                             req_span) -> None:
+
+                def wall_at(mono: float) -> float:
+                    return t_start_wall + (mono - t_start_mono)
+
                 endpoint = lb.policy.select(lb.get_ready_endpoints())
                 if endpoint is None:
                     lb._m_no_replica.inc()  # pylint: disable=protected-access
+                    req_span.set_attr('code', '503')
+                    req_span.status = 'ERROR'
                     body = b'No ready replicas.'
                     self.send_response(503)
                     self.send_header('Content-Length',
@@ -220,13 +256,29 @@ class SkyServeLoadBalancer:
                     # in-flight + latency accounting below;
                     # `endpoint` is reassigned on failover.
                     current = endpoint
-                    t_attempt = time.time()
+                    t_attempt = time.monotonic()
                     url = current.rstrip('/') + self.path
                     req = urllib.request.Request(url, data=data,
                                                  method=method)
                     for k, v in self.headers.items():
-                        if k.lower() not in self._HOP_BY_HOP:
+                        if k.lower() not in self._HOP_BY_HOP and \
+                                k.lower() != \
+                                trace_lib.TRACEPARENT_HEADER:
                             req.add_header(k, v)
+                    # LB→replica hop: the replica adopts the request
+                    # span's context (the client's own traceparent,
+                    # if any, was already absorbed as lb.request's
+                    # parent — never forwarded twice). STRICTLY the
+                    # span's own context: an unsampled request has
+                    # none, and falling back to the ambient would
+                    # forward the LB process's launch-time stamp —
+                    # gluing every unsampled request's replica spans
+                    # to the dead serve-up trace.
+                    if req_span.context is not None:
+                        req.add_header(
+                            trace_lib.TRACEPARENT_HEADER,
+                            trace_lib.format_traceparent(
+                                req_span.context))
                     lb.policy.on_request_start(current)
                     try:
                         try:
@@ -248,6 +300,11 @@ class SkyServeLoadBalancer:
                         lb._m_requests.labels(  # pylint: disable=protected-access
                             endpoint=current,
                             code=str(self._resp_status)).inc()
+                        # Same endpoint/code values as the metric
+                        # labels, so series and spans join cleanly.
+                        req_span.set_attr('endpoint', current)
+                        req_span.set_attr('code',
+                                          str(self._resp_status))
                         return
                     except (urllib.error.URLError, OSError) as e:
                         # Attribution: URLError (incl. HTTP-layer
@@ -276,6 +333,11 @@ class SkyServeLoadBalancer:
                                 kind='stream_abort'
                                 if replica_fault
                                 else 'client_abort').inc()
+                            req_span.set_attr('endpoint', current)
+                            if self._resp_status is not None:
+                                req_span.set_attr(
+                                    'code', str(self._resp_status))
+                            req_span.status = 'ERROR'
                             self.close_connection = True
                             try:
                                 self.wfile.flush()
@@ -318,6 +380,9 @@ class SkyServeLoadBalancer:
                             lb._m_errors.labels(  # pylint: disable=protected-access
                                 endpoint=current,
                                 kind='client_abort').inc()
+                        req_span.set_attr('endpoint', current)
+                        req_span.set_attr('code', '502')
+                        req_span.status = 'ERROR'
                         body = f'Replica error: {e}'.encode()
                         try:
                             self.send_response(502)
@@ -334,10 +399,20 @@ class SkyServeLoadBalancer:
                         # replica that served (or burned) it — a
                         # failover must not charge the dead
                         # replica's timeout to the healthy one
-                        # that answered.
+                        # that answered. ONE monotonic read feeds
+                        # BOTH the histogram observation and the
+                        # attempt span's duration (no skew).
+                        t_end = time.monotonic()
+                        dt = t_end - t_attempt
                         lb._m_latency.labels(  # pylint: disable=protected-access
-                            endpoint=current).observe(
-                                time.time() - t_attempt)
+                            endpoint=current).observe(dt)
+                        trace_lib.record_span(
+                            'lb.proxy', wall_at(t_attempt),
+                            wall_at(t_end), req_span.context,
+                            attrs={'endpoint': current,
+                                   'code': str(self._resp_status)
+                                   if self._resp_status is not None
+                                   else '502'})
 
             def _stream_response(self, resp) -> None:
                 """Chunk-by-chunk pass-through so token streaming

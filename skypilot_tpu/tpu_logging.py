@@ -10,7 +10,8 @@ import os
 import sys
 import threading
 
-FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+FORMAT = ('%(levelname).1s %(asctime)s %(filename)s:%(lineno)d]'
+          '%(trace_id)s %(message)s')
 DATE_FORMAT = '%m-%d %H:%M:%S'
 
 _FORMATTER = None
@@ -33,6 +34,25 @@ class NewLineFormatter(logging.Formatter):
         return msg
 
 
+class _TraceContextFilter(logging.Filter):
+    """Stamps the active trace id onto every log line (as
+    `` [tid=<8 hex>]``, empty when untraced) so logs and traces
+    cross-link: grep the prefix from a log, feed it to
+    ``xsky trace`` (ids resolve by unique prefix)."""
+
+    def filter(self, record):
+        trace_id = ''
+        try:
+            from skypilot_tpu import trace as trace_lib
+            ctx = trace_lib.current()
+            if ctx is not None:
+                trace_id = f' [tid={ctx.trace_id[:8]}]'
+        except Exception:  # pylint: disable=broad-except
+            pass  # logging must never fail on the tracer's account
+        record.trace_id = trace_id
+        return True
+
+
 def _root_logger() -> logging.Logger:
     return logging.getLogger('skypilot_tpu')
 
@@ -49,6 +69,7 @@ def _setup():
         handler.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
         _FORMATTER = NewLineFormatter(FORMAT, datefmt=DATE_FORMAT)
         handler.setFormatter(_FORMATTER)
+        handler.addFilter(_TraceContextFilter())
         root.addHandler(handler)
         root.propagate = False
         _initialized = True

@@ -1,0 +1,183 @@
+"""Driver-side trace collection: assemble one trace from the jsonl
+span sinks of many processes (and, on the local provider, many
+"hosts"), render a waterfall tree, export Chrome trace JSON.
+
+Sinks are ``spans-*.jsonl`` files under any number of roots (state
+dirs, cluster runtime dirs); a torn/partial line — a process died
+mid-append — is SKIPPED, never an error (same contract as the
+lifecycle registry's jsonl).
+"""
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+SINK_PREFIX = 'spans-'
+
+
+def iter_sink_files(roots: Sequence[str]) -> Iterator[str]:
+    seen = set()
+    for root in roots:
+        root = os.path.expanduser(root)
+        if not os.path.isdir(root):
+            continue
+        for dirpath, _, files in os.walk(root):
+            for fn in files:
+                if fn.startswith(SINK_PREFIX) and \
+                        (fn.endswith('.jsonl') or
+                         fn.endswith('.jsonl.1')):
+                    path = os.path.realpath(
+                        os.path.join(dirpath, fn))
+                    if path not in seen:
+                        seen.add(path)
+                        yield path
+
+
+def load_spans(roots: Sequence[str],
+               trace_id: Optional[str] = None
+               ) -> List[Dict[str, Any]]:
+    """Every parseable span under ``roots`` (optionally one trace's).
+    ``trace_id`` may be a unique prefix (ids are 32 hex; nobody types
+    those)."""
+    spans: List[Dict[str, Any]] = []
+    for path in iter_sink_files(roots):
+        try:
+            with open(path, encoding='utf-8') as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn append — skip, never raise
+            if not isinstance(rec, dict) or 'span_id' not in rec \
+                    or 'trace_id' not in rec:
+                continue
+            if trace_id is not None and \
+                    not rec['trace_id'].startswith(trace_id):
+                continue
+            spans.append(rec)
+    return spans
+
+
+def trace_ids(spans: Sequence[Dict[str, Any]]) -> List[str]:
+    """Distinct trace ids, most recently started first."""
+    latest: Dict[str, float] = {}
+    for s in spans:
+        tid = s['trace_id']
+        latest[tid] = max(latest.get(tid, 0.0), s.get('start', 0.0))
+    return sorted(latest, key=lambda t: -latest[t])
+
+
+def last_trace_id(roots: Sequence[str]) -> Optional[str]:
+    ids = trace_ids(load_spans(roots))
+    return ids[0] if ids else None
+
+
+def build_tree(spans: Sequence[Dict[str, Any]]
+               ) -> List[Dict[str, Any]]:
+    """Roots of the span forest; each node gains a ``children`` list
+    sorted by start time. Spans whose parent never made it to a sink
+    (process died before the parent closed) surface as roots rather
+    than vanishing."""
+    by_id = {s['span_id']: dict(s, children=[]) for s in spans}
+    roots = []
+    for node in by_id.values():
+        parent = node.get('parent_id')
+        if parent and parent in by_id:
+            by_id[parent]['children'].append(node)
+        else:
+            roots.append(node)
+    for node in by_id.values():
+        node['children'].sort(key=lambda n: n.get('start', 0.0))
+    roots.sort(key=lambda n: n.get('start', 0.0))
+    return roots
+
+
+def _fmt_attrs(attrs: Dict[str, Any]) -> str:
+    if not attrs:
+        return ''
+    parts = [f'{k}={v}' for k, v in sorted(attrs.items())]
+    return '  ' + ' '.join(parts)
+
+
+def render_waterfall(spans: Sequence[Dict[str, Any]],
+                     width: int = 32) -> str:
+    """Human waterfall of ONE trace: offset + proportional bar +
+    duration + name [component] attrs, indented by tree depth."""
+    if not spans:
+        return '(no spans)'
+    ids = trace_ids(spans)
+    if len(ids) > 1:
+        spans = [s for s in spans if s['trace_id'] == ids[0]]
+    t0 = min(s['start'] for s in spans)
+    t1 = max(s['end'] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    lines = [f'Trace {spans[0]["trace_id"]} — {len(spans)} span(s), '
+             f'{total * 1e3:.1f} ms']
+
+    def emit(node: Dict[str, Any], depth: int) -> None:
+        off = node['start'] - t0
+        dur = max(0.0, node['end'] - node['start'])
+        lo = int(off / total * width)
+        hi = max(lo + 1, int((off + dur) / total * width))
+        bar = ' ' * lo + '█' * min(hi - lo, width - lo)
+        flag = ' !' if node.get('status') == 'ERROR' else ''
+        lines.append(
+            f'{off * 1e3:9.1f}ms |{bar:<{width}}| '
+            f'{dur * 1e3:9.1f}ms  '
+            f'{"  " * depth}{node["name"]}{flag} '
+            f'[{node.get("component", "?")}]'
+            f'{_fmt_attrs(node.get("attrs") or {})}')
+        for child in node['children']:
+            emit(child, depth + 1)
+
+    for root in build_tree(spans):
+        emit(root, 0)
+    return '\n'.join(lines)
+
+
+def to_chrome(spans: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON ('X' complete events; pid = the real
+    producing process, so chrome://tracing / Perfetto lanes the
+    waterfall per process)."""
+    events = []
+    for s in sorted(spans, key=lambda x: x.get('start', 0.0)):
+        events.append({
+            'name': s['name'],
+            'ph': 'X',
+            'ts': s['start'] * 1e6,
+            'dur': max(0.0, s['end'] - s['start']) * 1e6,
+            'pid': s.get('pid', 0),
+            'tid': 0,
+            'args': dict(s.get('attrs') or {},
+                         trace_id=s['trace_id'],
+                         component=s.get('component', '?'),
+                         status=s.get('status', 'OK')),
+        })
+    return {'traceEvents': events}
+
+
+def default_roots() -> List[str]:
+    """Where this machine's spans live: the client state dir plus
+    every known cluster's runtime tree (the local provider keeps
+    per-host runtime dirs — and the controller state dirs under them
+    — on this filesystem; real clouds need the sinks pulled first)."""
+    roots = [os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))]
+    try:
+        from skypilot_tpu import state as state_lib
+        for rec in state_lib.get_clusters():
+            handle = rec.get('handle')
+            rdir = getattr(handle, 'head_runtime_dir', None)
+            if rdir:
+                # The dir ABOVE host-0/... so every host's sink (and
+                # the controller 'managed' state dir) is covered.
+                roots.append(os.path.dirname(
+                    os.path.expanduser(rdir)))
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return roots

@@ -1,20 +1,23 @@
-"""Chrome-trace-event tracing (analog of ``sky/utils/timeline.py``).
+"""Chrome-trace facade over the distributed tracer (analog of
+``sky/utils/timeline.py``).
 
-``@timeline.event`` decorates functions; spans are written to a
-Chrome trace JSON at process exit when SKYTPU_DEBUG=1 (load in
-chrome://tracing or Perfetto). FileLockEvent wraps lock acquisition
-the same way the reference wraps provisioning filelocks.
+ONE tracing system, not two: ``timeline.Event`` IS a tracer span
+(``skypilot_tpu/trace``) — when the surrounding code is in a trace
+the event nests into it like any span; under ``SKYTPU_DEBUG=1`` every
+span additionally lands in the tracer's in-process Chrome buffer,
+which :func:`save`/:func:`flush` export for chrome://tracing /
+Perfetto. ``@timeline.event`` decorates functions; FileLockEvent
+wraps lock acquisition the same way the reference wraps provisioning
+filelocks. A cross-process Chrome export of a FULL trace is
+``xsky trace <id> --chrome out.json``.
 """
 import atexit
 import functools
-import json
 import os
-import threading
-import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
-_events: List[Dict[str, Any]] = []
-_lock = threading.Lock()
+from skypilot_tpu import trace as trace_lib
+
 _registered = False
 
 
@@ -22,45 +25,35 @@ def _enabled() -> bool:
     return os.environ.get('SKYTPU_DEBUG', '0') == '1'
 
 
-def _trace_path() -> str:
-    base = os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
-    return os.path.join(base, f'timeline-{os.getpid()}.json')
-
-
-def _record(name: str, phase: str, ts_us: float,
-            args: Optional[Dict[str, Any]] = None) -> None:
+def _register_atexit() -> None:
     global _registered
-    with _lock:
-        _events.append({
-            'name': name,
-            'ph': phase,
-            'ts': ts_us,
-            'pid': os.getpid(),
-            'tid': threading.get_ident() % (1 << 31),
-            **({'args': args} if args else {}),
-        })
-        if not _registered:
-            _registered = True
-            atexit.register(save)
+    if not _registered:
+        _registered = True
+        atexit.register(save)
 
 
 class Event:
-    """Context manager emitting a begin/end span."""
+    """Context manager emitting a begin/end span. Delegates to the
+    tracer: nests into any ambient trace, and is buffered for the
+    Chrome export when SKYTPU_DEBUG=1."""
 
     def __init__(self, name: str,
                  args: Optional[Dict[str, Any]] = None):
         self.name = name
         self.args = args
+        self._span: Optional[trace_lib.Span] = None
 
     def __enter__(self):
         if _enabled():
-            _record(self.name, 'B', time.time() * 1e6, self.args)
+            _register_atexit()
+        self._span = trace_lib.span(self.name, attrs=self.args)
+        self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
-        if _enabled():
-            _record(self.name, 'E', time.time() * 1e6)
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            self._span = None
         return False
 
 
@@ -68,11 +61,11 @@ def event(name_or_fn=None):
     """Decorator: ``@timeline.event`` or ``@timeline.event('name')``."""
 
     def deco(fn: Callable, name: Optional[str] = None):
-        span = name or f'{fn.__module__}.{fn.__qualname__}'
+        span_name = name or f'{fn.__module__}.{fn.__qualname__}'
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with Event(span):
+            with Event(span_name):
                 return fn(*args, **kwargs)
 
         return wrapper
@@ -90,19 +83,19 @@ class FileLockEvent:
         import filelock
         self._lockfile = lockfile
         self._lock = filelock.FileLock(lockfile)
+        self._hold: Optional[Event] = None
 
     def acquire(self):
         with Event(f'filelock.wait {self._lockfile}'):
             self._lock.acquire()
-        if _enabled():
-            _record(f'filelock.hold {self._lockfile}', 'B',
-                    time.time() * 1e6)
+        self._hold = Event(f'filelock.hold {self._lockfile}')
+        self._hold.__enter__()
 
     def release(self):
         self._lock.release()
-        if _enabled():
-            _record(f'filelock.hold {self._lockfile}', 'E',
-                    time.time() * 1e6)
+        if self._hold is not None:
+            self._hold.__exit__(None, None, None)
+            self._hold = None
 
     def __enter__(self):
         self.acquire()
@@ -114,25 +107,15 @@ class FileLockEvent:
 
 
 def save(path: Optional[str] = None) -> Optional[str]:
-    if not _events:
-        return None
-    path = path or _trace_path()
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    with _lock:
-        payload = {'traceEvents': list(_events)}
-    # Write-then-rename: flush() runs inside long-lived agent/LB
-    # processes while a reader may be pulling the file through the
-    # agent's /read — it must never observe a half-written JSON.
-    tmp = f'{path}.tmp.{os.getpid()}'
-    with open(tmp, 'w', encoding='utf-8') as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)
-    return path
+    """Write the Chrome trace buffer (write-then-rename; a reader
+    pulling the file through the agent's /read must never observe a
+    half-written JSON). No-op (None) when the buffer is empty."""
+    return trace_lib.chrome_export(path)
 
 
 def flush(path: Optional[str] = None) -> Optional[str]:
-    """Persist the trace NOW (keeping the in-memory buffer), so
-    spans are retrievable from long-lived processes — agents, load
+    """Persist the trace NOW (keeping the in-memory buffer), so spans
+    are retrievable from long-lived processes — agents, load
     balancers — without waiting for interpreter exit. The agent's
     ``/metrics`` handler calls this on every scrape when
     SKYTPU_DEBUG=1; the atexit save still runs and supersedes the

@@ -95,16 +95,36 @@ def _isolated_state(tmp_path, monkeypatch, request):
     monkeypatch.setenv('SKYTPU_SERVE_LB_PORT_END',
                        str(lb_base + 99))
     from skypilot_tpu import config as config_lib
+    from skypilot_tpu import trace as trace_lib
     from skypilot_tpu.resilience import faults as faults_lib
     from skypilot_tpu.resilience import policy as policy_lib
     config_lib.reload_config()
     policy_lib.reset_breakers()
     faults_lib.reset()
+    trace_lib.reset_sink()
+    # Span-sink leak guard: a span emitted by this test must land
+    # under ITS state dir — a new sink file appearing in the USER's
+    # default trace dir means some process ran without the test's
+    # SKYTPU_STATE_DIR and is polluting (and persisting into) the
+    # real home.
+    default_trace_dir = os.path.expanduser('~/.skypilot_tpu/trace')
+    sinks_before = set()
+    if os.path.isdir(default_trace_dir):
+        sinks_before = set(os.listdir(default_trace_dir))
     yield
     _reap_test_daemons(tmp_path / 'state')
     config_lib.reload_config()
     policy_lib.reset_breakers()
     faults_lib.reset()
+    trace_lib.reset_sink()
+    leaked_sinks = set()
+    if os.path.isdir(default_trace_dir):
+        leaked_sinks = set(os.listdir(default_trace_dir)) - \
+            sinks_before
+    assert not leaked_sinks, (
+        f'test leaked span sink file(s) outside its per-test state '
+        f'dir into {default_trace_dir}: {sorted(leaked_sinks)} — '
+        'some traced process ran without SKYTPU_STATE_DIR')
 
 
 def _reap_test_daemons(state_dir) -> None:

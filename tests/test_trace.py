@@ -1,0 +1,808 @@
+"""Distributed tracing (skypilot_tpu/trace) + perf regression gate.
+
+Covers the PR-6 contract end to end:
+- span tree assembly + waterfall rendering from jsonl sinks;
+- env AND header propagation across REAL spawned processes (a bare
+  subprocess, then the host agent's /run and /exec injection);
+- serve e2e: one trace_id across >= 3 OS processes (client → LB in
+  the serve-controller process → replica), with the LB root span
+  carrying the same endpoint/code attrs as the metrics;
+- TTFT decomposition spans from the batching engine
+  (queue_wait / prefill / first_token / per-chunk decode);
+- torn/partial jsonl sink lines skipped, never raised;
+- regression-gate semantics (best-committed-run bar, >threshold
+  fails, lower-is-better units, env threshold override, bench.py's
+  exit-code path fed a synthetic regressed run);
+- span-name grep lint: every literal span name emitted in-tree is in
+  docs/observability.md's contract table;
+- instrument_train_step: per-step spans + ckpt-save child nesting +
+  __name__/__doc__ preservation.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from skypilot_tpu import trace
+
+
+def _spans(roots, trace_id=None):
+    return trace.collect.load_spans([str(r) for r in roots],
+                                    trace_id=trace_id)
+
+
+def _state_dir():
+    return os.environ['SKYTPU_STATE_DIR']
+
+
+class TestSpanModel:
+
+    def test_tree_assembly_and_waterfall(self):
+        with trace.span('launch', new_trace=True,
+                        attrs={'cluster': 'c1'}) as root:
+            tid = root.context.trace_id
+            with trace.span('launch.optimize'):
+                pass
+            with trace.span('launch.provision'):
+                with trace.span('agent.rpc',
+                                attrs={'path': '/run'}):
+                    pass
+        spans = _spans([_state_dir()], trace_id=tid)
+        assert sorted(s['name'] for s in spans) == [
+            'agent.rpc', 'launch', 'launch.optimize',
+            'launch.provision']
+        roots = trace.collect.build_tree(spans)
+        assert len(roots) == 1 and roots[0]['name'] == 'launch'
+        children = {c['name']: c for c in roots[0]['children']}
+        assert set(children) == {'launch.optimize',
+                                 'launch.provision'}
+        grand = children['launch.provision']['children']
+        assert [g['name'] for g in grand] == ['agent.rpc']
+        out = trace.collect.render_waterfall(spans)
+        assert 'launch.provision' in out and tid in out
+        # Chrome export carries every span as a complete event.
+        chrome = trace.collect.to_chrome(spans)
+        assert len(chrome['traceEvents']) == 4
+        assert all(e['ph'] == 'X' for e in chrome['traceEvents'])
+
+    def test_orphan_spans_record_nothing(self):
+        with trace.span('launch'):  # no parent, no new_trace
+            pass
+        assert _spans([_state_dir()]) == []
+
+    def test_error_status_and_attr(self):
+        with pytest.raises(RuntimeError):
+            with trace.span('launch', new_trace=True):
+                raise RuntimeError('boom')
+        spans = _spans([_state_dir()])
+        assert len(spans) == 1
+        assert spans[0]['status'] == 'ERROR'
+        assert 'boom' in spans[0]['attrs']['error']
+
+    def test_disabled_records_nothing(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TRACE', '0')
+        with trace.span('launch', new_trace=True):
+            pass
+        assert _spans([_state_dir()]) == []
+
+    def test_torn_sink_lines_skipped(self, tmp_path):
+        sink = tmp_path / 'trace' / 'spans-x-1.jsonl'
+        sink.parent.mkdir(parents=True)
+        good = {'trace_id': 'a' * 32, 'span_id': 'b' * 16,
+                'parent_id': None, 'name': 'launch',
+                'start': 1.0, 'end': 2.0, 'status': 'OK',
+                'attrs': {}, 'component': 'x', 'pid': 1}
+        sink.write_text(json.dumps(good) + '\n' +
+                        '{"trace_id": "abc", "span_id"' + '\n' +
+                        'not json at all\n' +
+                        '{"no_ids": true}\n')
+        spans = _spans([tmp_path])
+        assert len(spans) == 1 and spans[0]['name'] == 'launch'
+
+    def test_traceparent_round_trip(self):
+        ctx = trace.SpanContext('ab' * 16, 'cd' * 8)
+        stamp = trace.format_traceparent(ctx)
+        assert stamp == f'00-{"ab" * 16}-{"cd" * 8}-01'
+        assert trace.parse_traceparent(stamp) == ctx
+        # Malformed input is untraced, never an error.
+        for bad in (None, '', 'nonsense', '00-zz-yy-01', 'a-b-c-d-e'):
+            assert trace.parse_traceparent(bad) is None
+
+    def test_attach_none_blocks_env_fallback(self, monkeypatch):
+        ctx = trace.SpanContext('12' * 16, '34' * 8)
+        monkeypatch.setenv(trace.ENV_CONTEXT,
+                           trace.format_traceparent(ctx))
+        assert trace.current() == ctx  # env fallback
+        with trace.attach(None):
+            assert trace.current() is None  # explicit barrier
+        assert trace.current() == ctx
+
+
+class TestCrossProcessPropagation:
+
+    def test_env_stamp_reaches_subprocess_span(self):
+        with trace.span('jobs.submit', new_trace=True) as root:
+            env = dict(os.environ)
+            env.update(trace.context_env())
+            child_prog = ('from skypilot_tpu import trace\n'
+                          "with trace.span('launch'):\n"
+                          '    pass\n')
+            subprocess.run([sys.executable, '-c', child_prog],
+                           env=env, check=True, timeout=60)
+        spans = _spans([_state_dir()],
+                       trace_id=root.context.trace_id)
+        by_name = {s['name']: s for s in spans}
+        assert set(by_name) == {'jobs.submit', 'launch'}
+        # The child's span is parented to the ambient span that
+        # stamped the env.
+        assert by_name['launch']['parent_id'] == \
+            by_name['jobs.submit']['span_id']
+        assert by_name['launch']['pid'] != os.getpid()
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _cpp_available() -> bool:
+    from skypilot_tpu.runtime import agent_client
+    return agent_client.resolve_agent_binary() is not None
+
+
+@pytest.fixture(params=['py', 'cpp'])
+def live_agent(request, tmp_path):
+    from skypilot_tpu.runtime import agent_client
+    from skypilot_tpu.runtime.agent_client import AgentClient
+    if request.param == 'cpp' and not _cpp_available():
+        pytest.skip('C++ agent not built')
+    port = _free_port()
+    proc = agent_client.start_local_agent(
+        port, runtime_dir=str(tmp_path / 'rt'),
+        use_cpp=(request.param == 'cpp'))
+    client = AgentClient('127.0.0.1', port)
+    client.wait_healthy(timeout=15)
+    yield client
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestAgentHeaderPropagation:
+    """The traceparent header crosses the driver→agent hop and is
+    re-stamped into the env of everything the agent spawns — for BOTH
+    agent implementations (py and the C++ host_agent)."""
+
+    def test_run_injects_trace_context(self, live_agent, tmp_path):
+        log = str(tmp_path / 'run.log')
+        with trace.span('job.run', new_trace=True) as sp:
+            tid = sp.context.trace_id
+            proc_id = live_agent.run(
+                'echo "CTX=$SKYTPU_TRACE_CONTEXT"', log)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not live_agent.status(proc_id)['running']:
+                break
+            time.sleep(0.1)
+        text = open(log, encoding='utf-8').read()
+        assert f'CTX=00-{tid}-' in text, text
+
+    def test_exec_injects_trace_context(self, live_agent):
+        with trace.span('job.setup', new_trace=True) as sp:
+            tid = sp.context.trace_id
+            out = live_agent.exec(
+                'echo "CTX=$SKYTPU_TRACE_CONTEXT"')
+        assert f'CTX=00-{tid}-' in out['output'], out
+
+    def test_untraced_run_gets_no_stamp(self, live_agent, tmp_path):
+        log = str(tmp_path / 'untraced.log')
+        proc_id = live_agent.run(
+            'echo "CTX=[$SKYTPU_TRACE_CONTEXT]"', log)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not live_agent.status(proc_id)['running']:
+                break
+            time.sleep(0.1)
+        assert 'CTX=[]' in open(log, encoding='utf-8').read()
+
+
+@pytest.fixture(params=['py', 'cpp'])
+def stamped_env_agent(request, tmp_path, monkeypatch):
+    """An agent whose SPAWNER was traced (SKYTPU_TRACE_CONTEXT in the
+    spawner's environment when start_local_agent ran) — the stale
+    stamp must reach neither the daemon nor anything it spawns."""
+    from skypilot_tpu.runtime import agent_client
+    from skypilot_tpu.runtime.agent_client import AgentClient
+    if request.param == 'cpp' and not _cpp_available():
+        pytest.skip('C++ agent not built')
+    monkeypatch.setenv(trace.ENV_CONTEXT,
+                       f'00-{"77" * 16}-{"88" * 8}-01')
+    port = _free_port()
+    proc = agent_client.start_local_agent(
+        port, runtime_dir=str(tmp_path / 'rt'),
+        use_cpp=(request.param == 'cpp'))
+    # Only the SPAWN was traced; the client making later RPCs is a
+    # different, untraced caller (otherwise its own header would
+    # legitimately stamp everything).
+    monkeypatch.delenv(trace.ENV_CONTEXT)
+    client = AgentClient('127.0.0.1', port)
+    client.wait_healthy(timeout=15)
+    yield client
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestNoStaleTraceInheritance:
+    """Review fix: a traced SPAWNER's launch-time context must not
+    glue every later request/spawn on the agent to that dead trace —
+    context reaches spawned processes only via the request's header
+    or explicit env, for BOTH agent implementations."""
+
+    def test_untraced_exec_sees_no_inherited_stamp(
+            self, stamped_env_agent):
+        out = stamped_env_agent.exec(
+            'echo "CTX=[$SKYTPU_TRACE_CONTEXT]"')
+        assert 'CTX=[]' in out['output'], out
+
+    def test_header_beats_any_inherited_stamp(self,
+                                              stamped_env_agent):
+        with trace.span('job.setup', new_trace=True) as sp:
+            tid = sp.context.trace_id
+            out = stamped_env_agent.exec(
+                'echo "CTX=$SKYTPU_TRACE_CONTEXT"')
+        assert tid != '77' * 16
+        assert f'CTX=00-{tid}-' in out['output'], out
+
+    def test_untraced_run_sees_no_inherited_stamp(
+            self, stamped_env_agent, tmp_path):
+        log = str(tmp_path / 'stale.log')
+        proc_id = stamped_env_agent.run(
+            'echo "CTX=[$SKYTPU_TRACE_CONTEXT]"', log)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not stamped_env_agent.status(proc_id)['running']:
+                break
+            time.sleep(0.1)
+        assert 'CTX=[]' in open(log, encoding='utf-8').read()
+
+
+class TestSamplingAndRotation:
+
+    def test_sample_root_env_semantics(self, monkeypatch):
+        assert trace.sample_root() is True  # default: everything
+        monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '0')
+        assert trace.sample_root() is False
+        monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '1')
+        assert trace.sample_root() is True
+        monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', 'garbage')
+        assert trace.sample_root() is True
+        monkeypatch.setenv('SKYTPU_TRACE_SAMPLE', '0.5')
+        monkeypatch.setenv('SKYTPU_TRACE', '0')
+        assert trace.sample_root() is False  # disabled wins
+
+    def test_sink_rotates_at_size_cap(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TRACE_MAX_MB', '0.001')  # 1 kB
+        tids = []
+        for _ in range(12):  # ~300 bytes/span: forces >= 1 rotation
+            with trace.span('launch', new_trace=True,
+                            attrs={'pad': 'x' * 120}) as sp:
+                tids.append(sp.context.trace_id)
+        sink_files = list(trace.collect.iter_sink_files(
+            [_state_dir()]))
+        assert any(p.endswith('.jsonl.1') for p in sink_files), \
+            sink_files
+        # No single file exceeds ~cap + one record.
+        for p in sink_files:
+            assert os.path.getsize(p) < 2000, p
+        # ONE rotated generation is kept by design (older ones are
+        # dropped — bounded disk beats complete history); the
+        # collector reads both the live file and the rotation, so
+        # the most recent spans always survive.
+        collected = {s['trace_id'] for s in _spans([_state_dir()])}
+        assert tids[-1] in collected
+        assert len(collected) >= 2
+
+
+class TestServeTraceEndToEnd:
+    """Acceptance: one trace_id spanning client → LB → replica →
+    batching engine across >= 3 OS processes, rendered as a single
+    waterfall with the TTFT decomposition
+    (queue-wait/prefill/first-token/decode child spans)."""
+
+    def test_one_trace_across_three_processes(self, monkeypatch,
+                                              tmp_path):
+        monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '1')
+        import json as json_lib
+
+        from skypilot_tpu import serve as serve_api
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        from skypilot_tpu.task import Task
+
+        import skypilot_tpu
+        repo_root = os.path.dirname(
+            os.path.dirname(skypilot_tpu.__file__))
+        # The REAL serving replica (tiny model, continuous batching):
+        # it adopts the LB's traceparent hop and its engine emits the
+        # TTFT-decomposition spans. PYTHONPATH because the agent's
+        # cwd is not on sys.path for -m in every spawn context;
+        # JAX_PLATFORMS because the replica is a fresh process (the
+        # conftest forces CPU via jax.config, which does not
+        # propagate).
+        task = Task(name='traced-svc',
+                    run=('python3 -m skypilot_tpu.recipes.serve_model'
+                         ' --model tiny --slots 2'
+                         ' --max-new-tokens 8'),
+                    envs={'PYTHONPATH': repo_root,
+                          'JAX_PLATFORMS': 'cpu'})
+        res = Resources(cloud='local')
+        res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+        task.set_resources(res)
+        task.service = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=180,
+            readiness_timeout_seconds=5, min_replicas=1,
+            port=_free_port())
+
+        endpoint = serve_api.up(task, 'tracedsvc',
+                                wait_ready_timeout=240)
+        try:
+            with trace.span('client.request',
+                            new_trace=True) as root:
+                tid = root.context.trace_id
+                body = json_lib.dumps(
+                    {'prompt_ids': [1, 2, 3],
+                     'max_new_tokens': 6}).encode()
+                req = urllib.request.Request(
+                    endpoint + '/generate', data=body,
+                    headers={'Content-Type': 'application/json',
+                             trace.TRACEPARENT_HEADER:
+                             trace.format_traceparent()})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    assert r.status == 200
+                    assert len(json_lib.loads(
+                        r.read())['output_ids']) == 6
+        finally:
+            serve_api.down('tracedsvc')
+
+        # Sinks: the client state dir covers everything here — the
+        # local provider keeps cluster runtime dirs (and the
+        # controller state dir) under the test's state tree.
+        spans = _spans([_state_dir()], trace_id=tid)
+        by_name = {s['name']: s for s in spans}
+        assert {'client.request', 'lb.request', 'replica.generate',
+                'batch.queue_wait', 'batch.prefill',
+                'batch.first_token',
+                'batch.decode'} <= set(by_name), sorted(by_name)
+        # ONE trace, >= 3 distinct OS processes (client, serve
+        # controller/LB, replica).
+        pids = {s['pid'] for s in spans}
+        assert len(pids) >= 3, pids
+        # Parentage: client → lb.request → replica.generate →
+        # batching engine spans.
+        assert by_name['lb.request']['parent_id'] == \
+            by_name['client.request']['span_id']
+        assert by_name['replica.generate']['parent_id'] == \
+            by_name['lb.request']['span_id']
+        for batch_span in ('batch.queue_wait', 'batch.prefill',
+                           'batch.first_token', 'batch.decode'):
+            assert by_name[batch_span]['parent_id'] == \
+                by_name['replica.generate']['span_id'], batch_span
+        # The LB span records the same endpoint/code attrs as the
+        # metrics (satellite: spans and series join cleanly).
+        lb_attrs = by_name['lb.request']['attrs']
+        assert lb_attrs['code'] == '200'
+        assert lb_attrs['endpoint'].startswith('http://')
+        # lb.proxy attempt span exists and matches the histogram's
+        # clock (duration equals the observation by construction —
+        # here assert presence + the same code label value).
+        assert by_name['lb.proxy']['attrs']['code'] == '200'
+        # And the whole thing renders as one waterfall.
+        out = trace.collect.render_waterfall(spans)
+        for name in ('client.request', 'lb.request',
+                     'replica.generate', 'batch.first_token'):
+            assert name in out
+
+
+class TestBatchingTtftSpans:
+    """TTFT decomposition from the batching engine: queue_wait +
+    prefill + first_token + per-chunk decode spans, all under the
+    submitting request's trace."""
+
+    def test_ttft_breakdown_spans(self):
+        import jax
+
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.serve.batching import BatchingEngine
+        config = llama.get_config('tiny')
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        engine = BatchingEngine(params, config, slots=2, max_seq=64,
+                                steps_per_dispatch=4)
+        try:
+            with trace.span('replica.generate',
+                            new_trace=True) as root:
+                tid = root.context.trace_id
+                out = engine.generate([1, 2, 3], 9)
+            assert len(out) == 9
+        finally:
+            engine.close()
+        spans = _spans([_state_dir()], trace_id=tid)
+        names = [s['name'] for s in spans]
+        for expected in ('batch.queue_wait', 'batch.prefill',
+                         'batch.first_token'):
+            assert names.count(expected) == 1, names
+        # 9 tokens: 1 from prefill + 8 decoded in >= 2 dispatches of
+        # 4 — at least two per-chunk decode spans.
+        decode_chunks = [s for s in spans
+                         if s['name'] == 'batch.decode']
+        assert len(decode_chunks) >= 2
+        assert sum(s['attrs']['tokens'] for s in decode_chunks) == 8
+        # Every engine span is a CHILD of the submitting span.
+        for s in spans:
+            if s['name'].startswith('batch.'):
+                assert s['parent_id'] == root.context.span_id
+        # first_token span covers submit → first token (>= queue
+        # wait, >= prefill start).
+        ft = [s for s in spans if s['name'] == 'batch.first_token'][0]
+        qw = [s for s in spans if s['name'] == 'batch.queue_wait'][0]
+        assert ft['start'] == pytest.approx(qw['start'])
+        assert ft['end'] >= qw['end']
+
+    def test_untraced_submit_records_nothing(self):
+        import jax
+
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.serve.batching import BatchingEngine
+        config = llama.get_config('tiny')
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        engine = BatchingEngine(params, config, slots=2, max_seq=64,
+                                steps_per_dispatch=4)
+        try:
+            engine.generate([1, 2, 3], 4)
+        finally:
+            engine.close()
+        assert [s for s in _spans([_state_dir()])
+                if s['name'].startswith('batch.')] == []
+
+
+class TestRegressionGate:
+
+    @staticmethod
+    def _run(metric='m_tok_s', value=100.0, unit='tokens/s'):
+        return {'metric': metric, 'value': value, 'unit': unit,
+                'vs_baseline': 1.0, 'detail': {}}
+
+    def test_first_run_passes_and_seeds_the_bar(self):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        assert bs.check_regression(self._run()) == []
+        bs.record_bench_run(self._run())
+        best = bs.best_bench_run('m_tok_s')
+        assert best is not None and best['value'] == 100.0
+
+    def test_synthetic_regression_fails_current_best_passes(self):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        bs.record_bench_run(self._run(value=100.0))
+        # Within threshold: passes.
+        assert bs.check_regression(self._run(value=96.0)) == []
+        # Synthetic >5% throughput regression: fails.
+        msgs = bs.check_regression(self._run(value=90.0))
+        assert msgs and 'worse than the best committed run' in \
+            msgs[0]
+        # A run AT the current best passes.
+        assert bs.check_regression(self._run(value=100.0)) == []
+        # The bar is the BEST committed run, not the latest.
+        bs.record_bench_run(self._run(value=90.0))
+        assert bs.check_regression(self._run(value=91.0))
+
+    def test_lower_is_better_units(self):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        bs.record_bench_run(self._run(metric='ttfs', value=10.0,
+                                      unit='s'))
+        assert bs.check_regression(
+            self._run(metric='ttfs', value=10.4, unit='s')) == []
+        assert bs.check_regression(
+            self._run(metric='ttfs', value=11.0, unit='s'))
+
+    def test_env_threshold_override(self, monkeypatch):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        bs.record_bench_run(self._run(value=100.0))
+        monkeypatch.setenv('SKYTPU_BENCH_REGRESS_PCT', '15')
+        assert bs.check_regression(self._run(value=90.0)) == []
+        monkeypatch.setenv('SKYTPU_BENCH_REGRESS_PCT', '2')
+        assert bs.check_regression(self._run(value=97.0))
+
+    def test_error_sentinel_never_gates_or_records(self):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        assert bs.record_bench_run(
+            {'metric': 'bench_error', 'value': 0.0,
+             'unit': 'error'}) is None
+        bs.record_bench_run(self._run(value=100.0))
+        assert bs.check_regression(
+            {'metric': 'bench_error', 'value': 0.0}) == []
+
+    def test_bench_assert_no_regress_exit_codes(self):
+        """bench.py's gate path: a synthetic regressed run exits
+        nonzero; a run at the committed best exits 0."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            'bench_under_test',
+            os.path.join(os.path.dirname(__file__), '..',
+                         'bench.py'))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        bs.record_bench_run(self._run(value=100.0))
+        rc = bench._record_and_gate(  # pylint: disable=protected-access
+            self._run(value=90.0), assert_no_regress=True)
+        assert rc == bench.REGRESS_EXIT_CODE != 0
+        rc = bench._record_and_gate(  # pylint: disable=protected-access
+            self._run(value=100.0), assert_no_regress=True)
+        assert rc == 0
+
+    def test_bench_diff_rows(self):
+        from skypilot_tpu.benchmark import benchmark_state as bs
+        bs.record_bench_run(self._run(value=100.0))
+        bs.record_bench_run(self._run(value=90.0))
+        rows = bs.bench_diff()
+        row = [r for r in rows if r['metric'] == 'm_tok_s'][0]
+        assert row['best'] == 100.0 and row['latest'] == 90.0
+        assert row['regressed']
+
+
+class TestInstrumentTrainStepSpans:
+
+    def test_per_step_spans_with_ckpt_child(self):
+        from skypilot_tpu.parallel import instrument_train_step
+        calls = []
+
+        def my_step(state, batch):
+            """Step docs."""
+            calls.append(1)
+            return state, {}
+
+        wrapped = instrument_train_step(my_step, tokens_per_step=64)
+        batch = {'tokens': None}
+        with trace.span('job.run', new_trace=True) as root:
+            tid = root.context.trace_id
+            wrapped(None, batch)
+            # Between steps the OPEN step span is ambient: a
+            # checkpoint save submitted here must nest under it.
+            ckpt_parent = trace.current()
+            trace.record_span('ckpt.save', time.time(),
+                              time.time(), ckpt_parent,
+                              attrs={'step': 0, 'bytes': 1})
+            wrapped(None, batch)
+            wrapped(None, batch)
+        spans = _spans([_state_dir()], trace_id=tid)
+        steps = [s for s in spans if s['name'] == 'train.step']
+        # 3 calls close 2 intervals (the histogram observes the same
+        # 2).
+        assert len(steps) == 2
+        root_span = [s for s in spans if s['name'] == 'job.run'][0]
+        assert all(s['parent_id'] == root_span['span_id']
+                   for s in steps)
+        saves = [s for s in spans if s['name'] == 'ckpt.save']
+        assert len(saves) == 1
+        # The save is a CHILD of the first step span.
+        first_step = min(steps, key=lambda s: s['start'])
+        assert saves[0]['parent_id'] == first_step['span_id']
+        assert all(s['attrs']['tokens'] == 64 for s in steps)
+
+    def test_wrapper_preserves_name_and_doc(self):
+        import jax
+
+        from skypilot_tpu.parallel import instrument_train_step
+
+        def my_step(state, batch):
+            """Step docs."""
+            return state, {}
+
+        for target in (my_step, jax.jit(my_step)):
+            w = instrument_train_step(target)
+            assert w.__name__ == 'my_step'
+            assert w.__doc__ == 'Step docs.'
+            assert w.inner is target
+
+        # Callable OBJECT with no __name__/__doc__/__wrapped__:
+        # functools.wraps used to leave the wrapper named 'wrapper';
+        # now it falls back to the type name.
+        class StepObj:
+            def __call__(self, state, batch):
+                return state, {}
+
+        w = instrument_train_step(StepObj())
+        assert w.__name__ == 'StepObj'
+
+    def test_untraced_loop_records_nothing(self):
+        from skypilot_tpu.parallel import instrument_train_step
+
+        def my_step(state, batch):
+            return state, {}
+
+        wrapped = instrument_train_step(my_step, tokens_per_step=8)
+        for _ in range(3):
+            wrapped(None, {})
+        assert [s for s in _spans([_state_dir()])
+                if s['name'] == 'train.step'] == []
+
+
+class TestAsyncWriterSaveSpans:
+
+    def test_ckpt_save_span_under_submitting_trace(self, tmp_path):
+        import numpy as np
+
+        from skypilot_tpu.checkpoint import NativeCheckpointManager
+        mgr = NativeCheckpointManager(str(tmp_path / 'ckpt'),
+                                      save_interval_steps=1,
+                                      process_index=0,
+                                      process_count=1)
+        tree = {'params': {'w': np.ones((8,), np.float32)}}
+        try:
+            with trace.span('train.loop', new_trace=True) as root:
+                tid = root.context.trace_id
+                mgr.save(0, tree)
+                mgr.wait()
+        finally:
+            mgr.close()
+        spans = _spans([_state_dir()], trace_id=tid)
+        saves = [s for s in spans if s['name'] == 'ckpt.save']
+        assert len(saves) == 1
+        assert saves[0]['attrs']['step'] == 0
+        assert saves[0]['attrs']['bytes'] > 0
+        assert saves[0]['status'] == 'OK'
+
+    def test_restore_span(self, tmp_path):
+        import numpy as np
+
+        from skypilot_tpu.checkpoint import NativeCheckpointManager
+        mgr = NativeCheckpointManager(str(tmp_path / 'ckpt'),
+                                      save_interval_steps=1,
+                                      process_index=0,
+                                      process_count=1)
+        tree = {'params': {'w': np.ones((8,), np.float32)}}
+        try:
+            mgr.save(0, tree)
+            mgr.wait()
+            with trace.span('jobs.recovery', new_trace=True) as root:
+                tid = root.context.trace_id
+                mgr.restore(0, tree)
+        finally:
+            mgr.close()
+        spans = _spans([_state_dir()], trace_id=tid)
+        assert [s['name'] for s in spans
+                if s['name'] == 'ckpt.restore'] == ['ckpt.restore']
+
+
+class TestLogTraceCrossLink:
+
+    def test_formatter_stamps_trace_id(self):
+        """Log ↔ trace cross-link: the filter stamps the active
+        trace id (`` [tid=<8 hex>]``), empty when untraced, and the
+        line format renders it right after the location field."""
+        import logging
+
+        from skypilot_tpu import tpu_logging
+        filt = tpu_logging._TraceContextFilter()  # pylint: disable=protected-access
+        fmt = tpu_logging.NewLineFormatter(
+            tpu_logging.FORMAT, datefmt=tpu_logging.DATE_FORMAT)
+
+        def render(msg):
+            rec = logging.LogRecord('skypilot_tpu.x', logging.INFO,
+                                    'f.py', 1, msg, (), None)
+            assert filt.filter(rec) is True
+            return fmt.format(rec)
+
+        with trace.span('launch', new_trace=True) as sp:
+            line = render('traced message')
+            assert f'[tid={sp.context.trace_id[:8]}]' in line
+        line = render('untraced message')
+        assert '[tid=' not in line
+
+
+class TestTimelineFacade:
+
+    def test_timeline_event_is_a_tracer_span(self):
+        from skypilot_tpu.utils import timeline
+        with trace.span('launch', new_trace=True) as root:
+            tid = root.context.trace_id
+            with timeline.Event('custom-stage'):
+                pass
+        spans = _spans([_state_dir()], trace_id=tid)
+        by_name = {s['name']: s for s in spans}
+        assert 'custom-stage' in by_name
+        assert by_name['custom-stage']['parent_id'] == \
+            by_name['launch']['span_id']
+
+
+class TestManagedJobTraceId:
+
+    def test_controller_records_trace_id(self, tmp_path,
+                                         monkeypatch):
+        """The controller adopts the env stamp and records the
+        trace_id into the managed_jobs row (what `xsky trace --job`
+        resolves through) — exercised controller-side without a full
+        e2e."""
+        from skypilot_tpu.jobs import state as jobs_state
+        jobs_state.ensure_job(7, 'tj', '/dev/null', 'cc')
+        ctx = trace.SpanContext('fe' * 16, 'dc' * 8)
+        monkeypatch.setenv(trace.ENV_CONTEXT,
+                           trace.format_traceparent(ctx))
+        with trace.span('jobs.controller', new_trace=True) as sp:
+            jobs_state.set_trace_id(7, sp.context.trace_id)
+        rec = jobs_state.get_job(7)
+        assert rec['trace_id'] == 'fe' * 16
+        # First submit wins over a restarted controller's re-stamp.
+        jobs_state.set_trace_id(7, 'other')
+        assert jobs_state.get_job(7)['trace_id'] == 'fe' * 16
+
+
+SPAN_NAME_PATTERNS = (
+    re.compile(r"""(?:trace_lib|trace)\.span\(\s*\n?\s*'([^']+)'"""),
+    re.compile(r"""record_span\(\s*\n?\s*'([^']+)'"""),
+    re.compile(r"""emit_span\([^)]*?'([a-z0-9_.]+)'"""),
+    # The host agent's request-scoped helper (agent.py _span).
+    re.compile(r"""self\._span\('([^']+)'\)"""),
+)
+
+
+class TestSpanNameContractLint:
+    """Grep lint (style of the no-orbax and no-time.sleep lints):
+    every LITERAL span name emitted in-tree must appear in
+    docs/observability.md's span-name contract table — span names are
+    stable API exactly like metric names."""
+
+    def test_all_emitted_span_names_documented(self):
+        import skypilot_tpu
+        root = os.path.dirname(skypilot_tpu.__file__)
+        docs = open(os.path.join(os.path.dirname(root), 'docs',
+                                 'observability.md'),
+                    encoding='utf-8').read()
+        emitted = {}
+        for dirpath, _, files in os.walk(root):
+            if '__pycache__' in dirpath:
+                continue
+            for fn in files:
+                if not fn.endswith('.py'):
+                    continue
+                path = os.path.join(dirpath, fn)
+                text = open(path, encoding='utf-8').read()
+                for pat in SPAN_NAME_PATTERNS:
+                    for name in pat.findall(text):
+                        emitted.setdefault(name, path)
+        assert emitted, 'lint found no span emissions at all — ' \
+                        'did the emission API change?'
+        missing = [f'{name} (from {path})'
+                   for name, path in sorted(emitted.items())
+                   if f'`{name}`' not in docs]
+        assert not missing, (
+            'span names emitted in-tree but missing from the '
+            'docs/observability.md contract table:\n  ' +
+            '\n  '.join(missing))
+
+    def test_known_span_names_are_emitted(self):
+        """Meta-check that the lint's regexes actually see the core
+        emission sites (a regex rot here would make the lint
+        vacuous)."""
+        import skypilot_tpu
+        root = os.path.dirname(skypilot_tpu.__file__)
+        emitted = set()
+        for dirpath, _, files in os.walk(root):
+            if '__pycache__' in dirpath:
+                continue
+            for fn in files:
+                if fn.endswith('.py'):
+                    text = open(os.path.join(dirpath, fn),
+                                encoding='utf-8').read()
+                    for pat in SPAN_NAME_PATTERNS:
+                        emitted.update(pat.findall(text))
+        for expected in ('launch', 'lb.request', 'lb.proxy',
+                         'batch.queue_wait', 'batch.first_token',
+                         'jobs.submit', 'jobs.recovery', 'ckpt.save',
+                         'train.step', 'agent.rpc', 'agent.run',
+                         'job.run', 'serve.up'):
+            assert expected in emitted, expected
