@@ -301,16 +301,24 @@ def main() -> dict:
     jax.block_until_ready(metrics['loss'])
     dt = time.perf_counter() - t0
 
+    profile_rows = None
     if os.environ.get('BENCH_PROFILE', '0') == '1':
         # Per-op device-time table to stderr (the JSON line below
-        # stays the only stdout output).
+        # stays the only stdout output) AND into the result detail,
+        # so the bench_runs history carries it — `xsky bench diff`
+        # then shows per-op deltas between runs (the evidence loop
+        # the packed-attention verdict needs).
         from skypilot_tpu.utils import profiling
         with profiling.capture_trace() as tdir:
             for _ in range(2):
                 state, metrics = step(state, batch_dict)
             jax.block_until_ready(metrics['loss'])
-        print(profiling.format_summary(
-            profiling.summarize_trace(tdir, top=30)), file=sys.stderr)
+        profile_rows = profiling.summarize_trace(tdir, top=30)
+        if not profile_rows:  # CPU backend: no device tracks
+            profile_rows = profiling.summarize_trace(
+                tdir, top=30, device_only=False)
+        print(profiling.format_summary(profile_rows),
+              file=sys.stderr)
 
     tokens_per_step = batch * seq
     tokens_per_sec = steps * tokens_per_step / dt
@@ -343,6 +351,11 @@ def main() -> dict:
             'loss': float(metrics['loss']),
         },
     }
+    if profile_rows:
+        result['detail']['op_time_summary'] = [
+            {'name': r.name, 'total_ms': round(r.total_ms, 3),
+             'count': r.count, 'category': r.category}
+            for r in profile_rows]
     _note_partial(result)  # headline computed: never zero this round
 
     # Extra training rows (round-3 verdict: the single LoRA point is

@@ -472,6 +472,12 @@ class TpuBackend(Backend):
             # task's own env wins if it already pins a context.
             'envs': {**trace_lib.context_env(), **task.envs},
             'num_chips_per_node': handle.num_chips_per_host,
+            # Accelerator name for the task env stamp
+            # (SKYTPU_ACCELERATOR): the train process resolves its
+            # chip's catalog peak FLOPs for MFU from it
+            # (metrics/goodput.py).
+            'accelerator': (handle.launched_resources.accelerator
+                            if handle.launched_resources else None),
             'workdir': handle.workdir,
             'log_dir': log_dir,
         }

@@ -296,6 +296,38 @@ def bench_diff() -> List[Dict[str, Any]]:
     return out
 
 
+def op_time_delta(metric: str, top: int = 5
+                  ) -> Optional[List[Dict[str, Any]]]:
+    """Top-``top`` per-op device-time deltas (latest vs best run of
+    ``metric``) when BOTH runs carry a profiling summary in their
+    detail (``bench.py`` records one under BENCH_PROFILE=1). None
+    when either side lacks a summary, or latest IS best — `xsky
+    bench diff` then simply has no op story to tell."""
+    runs = bench_runs(metric)
+    if not runs:
+        return None
+    latest = runs[-1]
+    best = best_bench_run(metric)
+    if best is None or best['run_id'] == latest['run_id']:
+        return None
+
+    def rows_of(run) -> Optional[List[Dict[str, Any]]]:
+        try:
+            detail = json.loads(run.get('detail') or '{}')
+        except ValueError:
+            return None
+        rows = detail.get('op_time_summary')
+        return rows if isinstance(rows, list) and rows else None
+
+    best_rows = rows_of(best)
+    latest_rows = rows_of(latest)
+    if best_rows is None or latest_rows is None:
+        return None
+    from skypilot_tpu.utils import profiling
+    return profiling.diff_summaries({'rows': best_rows},
+                                    {'rows': latest_rows}, top=top)
+
+
 def delete_benchmark(name: str) -> None:
     db = _db()
     db.execute_and_commit(

@@ -9,6 +9,7 @@ from skypilot_tpu.catalog.tpu_catalog import (
     get_zones,
     is_tpu,
     list_accelerators,
+    peak_flops_per_chip,
     validate_region_zone,
 )
 from skypilot_tpu.catalog.vm_catalog import (
@@ -30,6 +31,7 @@ __all__ = [
     'get_zones',
     'is_tpu',
     'list_accelerators',
+    'peak_flops_per_chip',
     'validate_region_zone',
     'DEFAULT_CONTROLLER_CPUS',
     'get_vm_hourly_cost',

@@ -30,6 +30,34 @@ _GEN_ALIASES = {'v5litepod': 'v5e'}
 # Generations whose slice size is named in TensorCores (2 cores/chip).
 _CORES_NAMED_GENS = {'v2', 'v3', 'v4', 'v5p'}
 
+# Published peak dense bf16 TFLOPs per CHIP, by generation (cloud.
+# google.com/tpu/docs system architecture pages; v2/v3 figures are
+# the published mixed-precision peaks). The MFU denominator
+# (metrics/goodput.py): achieved model FLOPs / (chips * this).
+PEAK_BF16_TFLOPS_PER_CHIP = {
+    'v2': 46.0,
+    'v3': 123.0,
+    'v4': 275.0,
+    'v5e': 197.0,
+    'v5p': 459.0,
+    'v6e': 918.0,
+}
+
+
+def peak_flops_per_chip(name: str) -> Optional[float]:
+    """Peak bf16 FLOPs/s (not TFLOPs) for one chip of this slice
+    type; None for unknown generations (MFU is then not derivable
+    and simply not exported)."""
+    try:
+        canonical = canonicalize(name)
+    except exceptions.InvalidSpecError:
+        return None
+    gen = canonical.split('-')[1]
+    tflops = PEAK_BF16_TFLOPS_PER_CHIP.get(gen)
+    if tflops is None:
+        return None
+    return tflops * 1e12
+
 
 @dataclasses.dataclass(frozen=True)
 class TpuSpec:

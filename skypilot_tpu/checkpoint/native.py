@@ -119,8 +119,20 @@ class NativeCheckpointManager:
         step = int(step)
         if step == self._last_submitted:
             return False
-        payload = self._snapshot(state)
-        self._writer.submit(step, payload)
+        # Goodput: only the LOOP-BLOCKING portion of a save counts
+        # against the checkpoint bucket — the device->host snapshot
+        # and any submit backpressure. The async background write
+        # overlaps compute and costs no goodput (that overlap is the
+        # whole point of the async writer). A save that raises still
+        # blocked the loop for its duration — note in finally.
+        t0 = time.monotonic()
+        try:
+            payload = self._snapshot(state)
+            self._writer.submit(step, payload)
+        finally:
+            from skypilot_tpu.metrics import goodput as goodput_lib
+            goodput_lib.note('checkpoint_save',
+                             time.monotonic() - t0)
         self._last_submitted = step
         return True
 
@@ -128,6 +140,7 @@ class NativeCheckpointManager:
         return self.maybe_save(step, state, force=True)
 
     def wait(self) -> None:
+        t0 = time.monotonic()
         try:
             self._writer.wait()
         except BaseException:
@@ -135,6 +148,10 @@ class NativeCheckpointManager:
             # same-step dedup in maybe_save doesn't swallow a retry.
             self._last_submitted = None
             raise
+        finally:
+            from skypilot_tpu.metrics import goodput as goodput_lib
+            goodput_lib.note('checkpoint_save',
+                             time.monotonic() - t0)
 
     def close(self) -> None:
         try:
@@ -172,10 +189,16 @@ class NativeCheckpointManager:
     def restore(self, step: int, state: Any) -> Any:
         # Span is a no-op outside a trace; inside one (preemption
         # resume under a managed job) the restore cost shows in the
-        # recovery waterfall.
+        # recovery waterfall. The goodput accountant gets the same
+        # interval (restore blocks the loop by definition).
         from skypilot_tpu import trace as trace_lib
-        with trace_lib.span('ckpt.restore', attrs={'step': step}):
-            return self._restore_traced(step, state)
+        from skypilot_tpu.metrics import goodput as goodput_lib
+        t0 = time.monotonic()
+        try:
+            with trace_lib.span('ckpt.restore', attrs={'step': step}):
+                return self._restore_traced(step, state)
+        finally:
+            goodput_lib.note('restore', time.monotonic() - t0)
 
     def _restore_traced(self, step: int, state: Any) -> Any:
         step_dir = os.path.join(self.path,

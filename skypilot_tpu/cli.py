@@ -370,6 +370,133 @@ def metrics_cmd(cluster, url, name_filter, raw):
         click.echo(scrape_lib.format_families(families, name_filter))
 
 
+@cli.command(name='top')
+@click.argument('clusters', nargs=-1)
+@click.option('--once', is_flag=True,
+              help='Print a single snapshot and exit (scriptable).')
+@click.option('--interval', '-n', type=float, default=2.0,
+              show_default=True,
+              help='Refresh interval for the live view.')
+def top_cmd(clusters, once, interval):
+    """Live fleet dashboard: per-host CPU/memory/process counts,
+    per-device HBM, train throughput + MFU + goodput, serve QPS and
+    latency percentiles, circuit-breaker and watchdog states —
+    aggregated across every tracked cluster (or just CLUSTERS).
+    See docs/observability.md, Compute plane."""
+    from skypilot_tpu.metrics import top as top_lib
+    top_lib.run(list(clusters) or None, interval=interval, once=once,
+                echo=click.echo)
+
+
+@cli.command(name='profile')
+@click.argument('cluster')
+@click.option('--steps', type=int, default=5, show_default=True,
+              help='Train/decode steps to capture.')
+@click.option('--host', 'host_index', type=int, default=0,
+              show_default=True,
+              help='Host index of the cluster to profile.')
+@click.option('--wait', type=float, default=120.0, show_default=True,
+              help='Seconds to wait for an instrumented loop to '
+                   'produce the summary.')
+@click.option('--diff', 'show_diff', is_flag=True,
+              help='Also show top-5 op-time deltas against the '
+                   'previously fetched summary for this cluster.')
+def profile_cmd(cluster, steps, host_index, wait, show_diff):
+    """Arm on-demand runtime profiling on CLUSTER and render the
+    op-time summary: the next N steps of any instrumented loop
+    (train step wrapper, serve batching engine) are captured with
+    jax.profiler and summarized per op — kernel regressions become
+    a diffable table, not a 100 MB trace blob. See
+    docs/observability.md, On-demand profiling."""
+    import json as json_lib
+
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.utils import profiling as profiling_lib
+    record = state_lib.get_cluster_from_name(cluster)
+    if record is None:
+        raise exceptions.SkyTpuError(
+            f'Cluster {cluster!r} does not exist.')
+    handle = record['handle']
+    if not 0 <= host_index < handle.num_hosts:
+        raise exceptions.SkyTpuError(
+            f'--host {host_index} out of range '
+            f'(cluster has {handle.num_hosts} host(s)).')
+    client = handle.agent_client(host_index)
+    runtime_dir = handle.hosts[host_index].get('runtime_dir')
+
+    def fetch_summary(remote_dir):
+        raw = client.read_file(
+            os.path.join(remote_dir, profiling_lib.LATEST_SUMMARY))
+        if not raw:
+            return None
+        try:
+            return json_lib.loads(raw)
+        except ValueError:
+            return None
+
+    # Baseline BEFORE arming (presence/change of the summary is the
+    # completion signal — remote clocks may be skewed): a fast decode
+    # loop can consume the trigger and write the new summary within
+    # one round trip, so reading the baseline after arming would
+    # wait forever for a change that already happened. The profile
+    # dir defaults to <runtime_dir>/profiles; if the armed agent
+    # reports a different dir (env override on the host), fall back
+    # to a post-arm baseline there — strictly better than nothing.
+    before = None
+    guessed_dir = (os.path.join(runtime_dir, 'profiles')
+                   if runtime_dir else None)
+    if guessed_dir:
+        before = fetch_summary(guessed_dir)
+    resp = client.profile(steps=steps, runtime_dir=runtime_dir)
+    remote_dir = resp.get('dir')
+    if not remote_dir:
+        raise exceptions.SkyTpuError(
+            f'agent did not report a profile dir: {resp}')
+    if remote_dir != guessed_dir:
+        before = fetch_summary(remote_dir)
+    click.echo(f'Armed capture of the next {steps} step(s) on '
+               f'{cluster} host {host_index}; waiting for an '
+               'instrumented loop...')
+    deadline = time.monotonic() + wait
+    summary = None
+    while time.monotonic() < deadline:
+        cur = fetch_summary(remote_dir)
+        if cur is not None and cur != before:
+            summary = cur
+            break
+        time.sleep(1.0)
+    if summary is None:
+        raise exceptions.SkyTpuError(
+            f'no profile summary appeared within {wait:g}s — is an '
+            'instrumented loop (train step / batching engine) '
+            'running on that host?')
+    click.echo(profiling_lib.format_summary_payload(summary))
+    # Local history for --diff: last fetched summary per cluster.
+    prev_dir = os.path.join(os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu')),
+        'profiles')
+    prev_path = os.path.join(prev_dir, f'{cluster}.json')
+    if show_diff:
+        try:
+            with open(prev_path, encoding='utf-8') as f:
+                prev = json_lib.load(f)
+        except (OSError, ValueError):
+            prev = None
+        if prev is None:
+            click.echo('\nNo previously fetched summary for this '
+                       'cluster to diff against.')
+        else:
+            deltas = profiling_lib.diff_summaries(prev, summary)
+            click.echo('\nTop op-time deltas vs previous fetch:')
+            click.echo(profiling_lib.format_diff(deltas)
+                       if deltas else '  (no change)')
+    os.makedirs(prev_dir, exist_ok=True)
+    tmp = prev_path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json_lib.dump(summary, f)
+    os.replace(tmp, prev_path)
+
+
 # ---------------------------------------------------------------------
 # Distributed tracing (docs/observability.md, Tracing): assemble a
 # trace from the per-process span sinks and render the waterfall.
@@ -1112,6 +1239,17 @@ def bench_diff():
     click.echo(f'Threshold: '
                f'{benchmark_state.regress_threshold_pct():g}% '
                '(SKYTPU_BENCH_REGRESS_PCT).')
+    # Per-op device-time deltas when both latest and best runs carry
+    # a BENCH_PROFILE summary — the kernel-level WHY behind a
+    # headline regression (docs/observability.md, On-demand
+    # profiling).
+    from skypilot_tpu.utils import profiling as profiling_lib
+    for r in rows:
+        deltas = benchmark_state.op_time_delta(r['metric'])
+        if deltas:
+            click.echo(f'\nTop op-time deltas for {r["metric"]} '
+                       '(latest vs best):')
+            click.echo(profiling_lib.format_diff(deltas))
     if regressed:
         raise SystemExit(1)
 

@@ -1,0 +1,178 @@
+"""Framework callback adapters: flax train loops & HF ``Trainer``.
+
+The in-tree instrumentation (``parallel.instrument_train_step``)
+wraps OUR jitted step functions — but the ROADMAP promises the same
+observability to workloads users bring (VERDICT Missing #4): a torch
+HF ``Trainer`` run from a task YAML, or a hand-written flax loop.
+These adapters forward the frameworks' step/save events into the
+same surfaces the native path feeds:
+
+- the generic benchmark callbacks (``skypilot_tpu.callbacks`` —
+  per-step timing JSON for ``xsky bench``);
+- the metrics registry (the ``skytpu_train_*`` families, same names
+  and buckets as the native path, via ``metrics.goodput
+  .train_metrics``);
+- the goodput accountant (``skytpu_goodput_seconds_total{bucket}``,
+  with checkpoint saves carved out of the step they interrupt).
+
+Neither adapter imports its framework: :class:`FlaxTrainHook` is a
+plain object whose methods you call from any loop, and
+:class:`SkyTpuHFCallback` duck-types ``transformers.TrainerCallback``
+(the Trainer only ever *calls* callback methods, so the class needs
+no base — it works whether or not transformers is installed).
+
+Flax loop::
+
+    hook = FlaxTrainHook(tokens_per_step=batch * seq)
+    for step in range(steps):
+        hook.on_step_begin(step)
+        state, loss = train_step(state, batch)
+        jax.block_until_ready(loss)
+        hook.on_step_end(step)
+        if step % 100 == 0:
+            with hook.checkpoint_save():
+                save_checkpoint(state)
+
+HF Trainer::
+
+    trainer = Trainer(..., callbacks=[
+        SkyTpuHFCallback(tokens_per_step=batch * seq)])
+"""
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import callbacks as generic_callbacks
+
+
+class _StepEventAdapter:
+    """Shared step/save accounting behind both adapters.
+
+    Timing model: ``begin -> end`` brackets (the framework owns the
+    loop, so inter-call intervals are not ours to define). The first
+    completed step is attributed to the ``compile`` goodput bucket,
+    the rest to ``compute``; a save inside :meth:`checkpoint_save`
+    is carved out of its enclosing interval by the accountant.
+    """
+
+    def __init__(self, tokens_per_step: Optional[int] = None,
+                 param_count: Optional[int] = None,
+                 accelerator: Optional[str] = None,
+                 full_finetune: bool = True):
+        from skypilot_tpu.metrics import goodput as goodput_lib
+        self._tokens = tokens_per_step
+        self._fams = goodput_lib.train_metrics()
+        self._acct = goodput_lib.accountant()
+        if param_count and tokens_per_step:
+            import os
+            n_chips = 1
+            try:
+                chips = os.environ.get('SKYTPU_NUM_CHIPS_PER_NODE')
+                nodes = os.environ.get('SKYTPU_NUM_NODES')
+                if chips and nodes:
+                    n_chips = max(1, int(chips) * int(nodes))
+            except ValueError:
+                pass
+            self._acct.set_model_info(param_count, tokens_per_step,
+                                      n_chips=n_chips,
+                                      accelerator=accelerator,
+                                      full_finetune=full_finetune)
+        self._t0: Optional[float] = None
+        self._steps_done = 0
+
+    def step_begin(self) -> None:
+        self._t0 = time.perf_counter()
+        generic_callbacks.step_begin()
+
+    def step_end(self, tokens: Optional[int] = None) -> None:
+        generic_callbacks.step_end()
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        n_tokens = tokens if tokens is not None else self._tokens
+        self._fams['step_seconds'].observe(dt)
+        self._fams['steps_total'].inc()
+        if n_tokens:
+            self._fams['tokens_total'].inc(n_tokens)
+            if dt > 0:
+                self._fams['tokens_per_sec'].set(n_tokens / dt)
+        self._acct.observe_step(
+            dt, compile_step=(self._steps_done == 0))
+        self._steps_done += 1
+
+    def note_save(self, seconds: float) -> None:
+        self._acct.note('checkpoint_save', seconds)
+
+    @contextlib.contextmanager
+    def checkpoint_save(self):
+        """Bracket a blocking checkpoint save so its wall time lands
+        in the checkpoint_save goodput bucket."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.note_save(time.monotonic() - t0)
+
+
+class FlaxTrainHook(_StepEventAdapter):
+    """Adapter for hand-written flax/jax train loops (see module
+    docstring for the call pattern). ``on_step_*`` take the step
+    index for signature parity with common flax training utilities;
+    the index is not required to be contiguous (resumed loops)."""
+
+    def on_train_begin(self, total_steps: Optional[int] = None,
+                       log_dir: Optional[str] = None) -> None:
+        """Optional: also arm the generic benchmark recorder (the
+        per-step timing JSON ``xsky bench`` consumes)."""
+        if log_dir is not None:
+            generic_callbacks.init(log_dir, total_steps=total_steps)
+
+    def on_step_begin(self, step: int) -> None:
+        del step
+        self.step_begin()
+
+    def on_step_end(self, step: int,
+                    metrics: Optional[Dict[str, Any]] = None,
+                    tokens: Optional[int] = None) -> None:
+        del step, metrics
+        self.step_end(tokens=tokens)
+
+
+class SkyTpuHFCallback(_StepEventAdapter):
+    """HF ``transformers.TrainerCallback`` duck-type: pass an
+    instance in the Trainer's ``callbacks=[...]`` list. Signatures
+    follow the TrainerCallback protocol (``args, state, control``
+    positionals + keyword soup); every hook tolerates the Trainer's
+    evolving kwargs via ``**kwargs``.
+
+    ``on_save`` measures the save it FOLLOWS: the Trainer calls
+    ``on_step_end`` before saving and ``on_save`` after, so the save
+    interval is bracketed by those two events.
+    """
+
+    def on_train_begin(self, args=None, state=None, control=None,
+                       **kwargs) -> None:
+        del args, state, control, kwargs
+        self._save_started: Optional[float] = None
+
+    def on_step_begin(self, args=None, state=None, control=None,
+                      **kwargs) -> None:
+        del args, state, control, kwargs
+        self.step_begin()
+
+    def on_step_end(self, args=None, state=None, control=None,
+                    **kwargs) -> None:
+        del args, state, control, kwargs
+        self.step_end()
+        # If the Trainer decides to save now, the time until on_save
+        # is checkpoint time.
+        self._save_started = time.monotonic()
+
+    def on_save(self, args=None, state=None, control=None,
+                **kwargs) -> None:
+        del args, state, control, kwargs
+        started = getattr(self, '_save_started', None)
+        if started is not None:
+            self.note_save(time.monotonic() - started)
+            self._save_started = None

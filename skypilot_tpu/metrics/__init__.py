@@ -12,9 +12,14 @@ the substrate every serving/runtime/training surface reports through:
   slot occupancy;
 - ``serve/autoscalers.py`` scales on the MEASURED windowed QPS from
   the LB registry instead of assuming the declared target;
-- ``parallel/train.py`` records step time and tokens/s;
+- ``parallel/train.py`` records step time and tokens/s — plus
+  goodput buckets and MFU (``metrics/goodput.py``);
+- ``metrics/device.py`` samples per-device HBM used/limit/peak;
+- ``metrics/publish.py`` bridges compute-process registries into the
+  host agent's ``/metrics`` (textfile collector pattern);
 - ``metrics/scrape.py`` pulls every host's ``/metrics`` and merges
-  series under a ``host`` label (CLI: ``xsky metrics [CLUSTER]``).
+  series under a ``host`` label (CLI: ``xsky metrics [CLUSTER]``);
+- ``metrics/top.py`` aggregates the fleet view (CLI: ``xsky top``).
 
 Metric names/labels contract: ``docs/observability.md``.
 """

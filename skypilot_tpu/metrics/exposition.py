@@ -59,8 +59,14 @@ def format_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
 _labels_str = format_labels
 
 
-def render_text(registry) -> str:
-    """Render every family in ``registry`` as Prometheus text."""
+def render_text(registry,
+                extra_labels: Tuple[Tuple[str, str], ...] = ()
+                ) -> str:
+    """Render every family in ``registry`` as Prometheus text.
+    ``extra_labels`` are injected before each sample's own labels
+    (the textfile publisher stamps its ``proc`` identity this way —
+    metrics/publish.py)."""
+    extra = tuple((str(k), str(v)) for k, v in extra_labels)
     lines: List[str] = []
     for fam in registry.families():
         if fam.help:
@@ -69,6 +75,7 @@ def render_text(registry) -> str:
             lines.append(f'# HELP {fam.name} {help_text}')
         lines.append(f'# TYPE {fam.name} {fam.kind}')
         for labels, child in fam.collect():
+            labels = extra + labels
             if fam.kind == 'histogram':
                 cumulative, total_sum, count = child.snapshot()
                 edges = list(fam.buckets) + [math.inf]

@@ -1,0 +1,382 @@
+"""`xsky top` — live fleet dashboard over the metrics plane.
+
+Aggregates three scrape surfaces into one operator view:
+
+- every tracked cluster's hosts via the driver-side agent scraper
+  (``metrics/scrape.py`` — host gauges, plus the compute-process
+  series that reach the agents through the textfile bridge:
+  train tok/s, MFU, goodput, per-device HBM, batching/KV gauges);
+- every service's load-balancer ``/metrics`` (request rate, latency
+  percentiles);
+- THIS driver process's own registry (circuit-breaker states,
+  watchdog verdicts — those live driver-side by design).
+
+``snapshot()`` returns plain dicts (the test surface);
+``render()`` draws the tables; ``run()`` is the live loop the CLI
+wraps (``--once`` for scripts/tests).
+"""
+import math
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.metrics import exposition
+from skypilot_tpu.metrics import scrape
+
+logger = tpu_logging.init_logger(__name__)
+
+SCRAPE_TIMEOUT_SECONDS = 5.0
+
+
+# -- extraction helpers ------------------------------------------------
+
+
+def _samples(families: Dict[str, exposition.Series],
+             name: str) -> List[exposition.Sample]:
+    series = families.get(name)
+    return list(series.samples) if series is not None else []
+
+
+def _sum_by_label(families, name: str, label: str
+                  ) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for s in _samples(families, name):
+        key = dict(s.labels).get(label, '')
+        out[key] = out.get(key, 0.0) + s.value
+    return out
+
+
+def _max_value(families, name: str) -> Optional[float]:
+    vals = [s.value for s in _samples(families, name)]
+    return max(vals) if vals else None
+
+
+def quantile_from_buckets(samples: List[exposition.Sample],
+                          q: float) -> Optional[float]:
+    """Approximate quantile from Prometheus cumulative ``_bucket``
+    samples (possibly merged across hosts: same-``le`` buckets are
+    summed first). Returns the upper edge of the bucket holding the
+    q-th observation — the standard histogram_quantile coarseness."""
+    by_le: Dict[float, float] = {}
+    for s in samples:
+        if not s.name.endswith('_bucket'):
+            continue
+        le = dict(s.labels).get('le')
+        if le is None:
+            continue
+        edge = math.inf if le == '+Inf' else float(le)
+        by_le[edge] = by_le.get(edge, 0.0) + s.value
+    if not by_le:
+        return None
+    edges = sorted(by_le)
+    total = by_le[edges[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    for edge in edges:
+        if by_le[edge] >= rank:
+            return edge
+    return edges[-1]
+
+
+# -- snapshot ----------------------------------------------------------
+
+
+def _scrape_hosts(handle, timeout: float
+                  ) -> Dict[str, exposition.Series]:
+    """Like ``scrape.scrape_handle`` but with UNIQUE host ids: local
+    fake clusters run every agent on 127.0.0.1, and `top`'s per-host
+    rows must not merge two hosts into one. Duplicate ips get a
+    ``#<rank>`` suffix (real fleets have distinct ips and keep the
+    plain label)."""
+    import concurrent.futures
+    ips = [h.get('ip') or str(i)
+           for i, h in enumerate(handle.hosts)]
+    ids = []
+    for i, ip in enumerate(ips):
+        ids.append(f'{ip}#{i}' if ips.count(ip) > 1 else ip)
+
+    def one(i: int):
+        try:
+            return ids[i], scrape.scrape_host(
+                handle.agent_client(i), timeout=timeout)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('top: scrape failed for host %s: %s',
+                           ids[i], e)
+            return ids[i], None
+
+    results = []
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, max(1, handle.num_hosts))) as pool:
+        for host_id, families in pool.map(one,
+                                          range(handle.num_hosts)):
+            if families is not None:
+                results.append((host_id, families))
+    return scrape.merge_hosts(results)
+
+
+def _host_rows(families) -> List[Dict[str, Any]]:
+    """Per-host rows from one cluster's merged scrape."""
+    hosts: Dict[str, Dict[str, Any]] = {}
+
+    def host_of(sample) -> str:
+        return dict(sample.labels).get('host', '?')
+
+    def put(name: str, key: str, combine='last'):
+        for s in _samples(families, name):
+            row = hosts.setdefault(host_of(s), {})
+            if combine == 'sum':
+                row[key] = row.get(key, 0.0) + s.value
+            elif combine == 'max':
+                row[key] = max(row.get(key, -math.inf), s.value)
+            else:
+                row[key] = s.value
+
+    put('skytpu_host_load1', 'load1')
+    put('skytpu_host_cpu_count', 'cpus')
+    put('skytpu_host_memory_total_bytes', 'mem_total')
+    put('skytpu_host_memory_available_bytes', 'mem_available')
+    put('skytpu_agent_procs_running', 'procs', combine='sum')
+    put('skytpu_device_hbm_used_bytes', 'hbm_used', combine='sum')
+    put('skytpu_device_hbm_limit_bytes', 'hbm_limit', combine='sum')
+    # A host can run several publishers; per-host throughput is the
+    # max (each train process reports the global-batch rate).
+    put('skytpu_train_tokens_per_sec', 'train_tok_s', combine='max')
+    put('skytpu_mfu_ratio', 'mfu', combine='max')
+    put('skytpu_goodput_ratio', 'goodput', combine='max')
+    put('skytpu_batch_decode_tokens_per_sec', 'decode_tok_s',
+        combine='max')
+    put('skytpu_batch_slots_occupied', 'slots_occupied',
+        combine='sum')
+    put('skytpu_batch_slots_total', 'slots_total', combine='sum')
+    put('skytpu_batch_kv_cache_used_bytes', 'kv_used', combine='sum')
+    put('skytpu_batch_kv_cache_bytes', 'kv_bytes', combine='sum')
+    return [dict(row, host=host)
+            for host, row in sorted(hosts.items())]
+
+
+def snapshot(cluster_names: Optional[List[str]] = None,
+             timeout: float = SCRAPE_TIMEOUT_SECONDS
+             ) -> Dict[str, Any]:
+    """One fleet sample. Unreachable clusters/services degrade to a
+    row with an ``error`` — `top` must render a partial fleet, never
+    crash out of the loop because one box is down."""
+    import concurrent.futures
+
+    from skypilot_tpu import state as state_lib
+    records = state_lib.get_clusters()
+    if cluster_names:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+
+    def one_cluster(rec) -> Dict[str, Any]:
+        name = rec['name']
+        try:
+            families = _scrape_hosts(rec['handle'], timeout=timeout)
+            return {'name': name, 'status': rec['status'].value,
+                    'hosts': _host_rows(families)}
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug('top: scrape of %s failed: %s', name, e)
+            return {'name': name, 'status': rec['status'].value,
+                    'hosts': [], 'error': str(e)}
+
+    # Clusters scrape CONCURRENTLY: the live loop's refresh latency
+    # is the slowest cluster, not the sum — two unreachable clusters
+    # must not freeze the dashboard for 2x the scrape timeout.
+    clusters: List[Dict[str, Any]] = []
+    if records:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(16, len(records))) as pool:
+            clusters = list(pool.map(one_cluster, records))
+
+    services: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu.serve import serve_state
+        service_records = serve_state.get_services()
+    except Exception:  # pylint: disable=broad-except
+        service_records = []
+    for svc in service_records:
+        row: Dict[str, Any] = {
+            'name': svc['name'],
+            'status': (svc['status'].value
+                       if hasattr(svc['status'], 'value')
+                       else str(svc['status'])),
+            'endpoint': svc.get('endpoint'),
+        }
+        endpoint = svc.get('endpoint')
+        if endpoint:
+            try:
+                fams = scrape.scrape_url(endpoint + '/metrics',
+                                         timeout=timeout)
+                row['qps'] = _max_value(
+                    fams, 'skytpu_autoscaler_measured_qps')
+                lat = _samples(fams, 'skytpu_lb_request_seconds')
+                row['p50_s'] = quantile_from_buckets(lat, 0.5)
+                row['p99_s'] = quantile_from_buckets(lat, 0.99)
+                counts = _sum_by_label(fams,
+                                       'skytpu_lb_requests_total',
+                                       'code')
+                row['requests'] = sum(counts.values())
+                row['errors'] = sum(v for k, v in counts.items()
+                                    if k.startswith('5'))
+            except Exception as e:  # pylint: disable=broad-except
+                row['error'] = str(e)
+        services.append(row)
+
+    # Driver-local resilience state (these series live in THIS
+    # process: the breakers/watchdogs guarding its RPCs).
+    from skypilot_tpu import metrics as metrics_lib
+    breakers: List[Tuple[str, float]] = []
+    watchdogs: List[Tuple[str, float]] = []
+    for fam in metrics_lib.registry().families():
+        if fam.name == 'skytpu_circuit_breaker_state':
+            for labels, child in fam.collect():
+                breakers.append((dict(labels).get('target', '?'),
+                                 child.value))
+        elif fam.name == 'skytpu_watchdog_target_healthy':
+            for labels, child in fam.collect():
+                watchdogs.append((dict(labels).get('target', '?'),
+                                  child.value))
+    return {
+        'at': time.time(),
+        'clusters': clusters,
+        'services': services,
+        'breakers': [{'target': t, 'state': v} for t, v in breakers],
+        'watchdogs': [{'target': t, 'healthy': bool(v)}
+                      for t, v in watchdogs],
+    }
+
+
+# -- rendering ---------------------------------------------------------
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return '-'
+    for unit in ('B', 'KiB', 'MiB', 'GiB', 'TiB'):
+        if abs(n) < 1024 or unit == 'TiB':
+            return f'{n:.0f}{unit}' if unit == 'B' else f'{n:.1f}{unit}'
+        n /= 1024
+    return f'{n:.1f}TiB'
+
+
+def _fmt_ratio(v: Optional[float]) -> str:
+    return '-' if v is None else f'{100.0 * v:.1f}%'
+
+
+def _fmt_num(v: Optional[float], fmt: str = '{:.1f}') -> str:
+    return '-' if v is None else fmt.format(v)
+
+
+_BREAKER_STATES = {0: 'closed', 1: 'OPEN', 2: 'half-open'}
+
+
+def render(snap: Dict[str, Any]) -> str:
+    from skypilot_tpu.utils import ux_utils
+    out: List[str] = []
+    stamp = time.strftime('%Y-%m-%d %H:%M:%S',
+                          time.localtime(snap['at']))
+    out.append(f'xsky top — {stamp}')
+
+    table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
+                            'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
+                            'SERVE TOK/S', 'SLOTS', 'KV'])
+    rows = 0
+    for cluster in snap['clusters']:
+        if cluster.get('error') or not cluster['hosts']:
+            # Scrape failed outright, or every host was unreachable
+            # (the scraper degrades per-host): the cluster still gets
+            # a row — partial fleet visibility beats none.
+            table.add_row([cluster['name'], '(unreachable)', '-', '-',
+                           '-', '-', '-', '-', '-', '-', '-', '-'])
+            rows += 1
+            continue
+        for h in cluster['hosts']:
+            load = (f'{h["load1"]:.1f}/{h["cpus"]:.0f}'
+                    if 'load1' in h and 'cpus' in h else '-')
+            mem = '-'
+            if 'mem_total' in h and 'mem_available' in h \
+                    and h['mem_total']:
+                used_pct = 100.0 * (1 - h['mem_available'] /
+                                    h['mem_total'])
+                mem = f'{used_pct:.0f}%'
+            hbm = '-'
+            if 'hbm_limit' in h and h['hbm_limit']:
+                hbm = (f'{_fmt_bytes(h.get("hbm_used", 0))}/'
+                       f'{_fmt_bytes(h["hbm_limit"])}')
+            slots = '-'
+            if h.get('slots_total'):
+                slots = (f'{h.get("slots_occupied", 0):.0f}/'
+                         f'{h["slots_total"]:.0f}')
+            kv = '-'
+            if h.get('kv_bytes'):
+                kv = (f'{_fmt_bytes(h.get("kv_used", 0))}/'
+                      f'{_fmt_bytes(h["kv_bytes"])}')
+            table.add_row([
+                cluster['name'], h['host'], load, mem,
+                _fmt_num(h.get('procs'), '{:.0f}'), hbm,
+                _fmt_num(h.get('train_tok_s'), '{:.0f}'),
+                _fmt_ratio(h.get('mfu')),
+                _fmt_ratio(h.get('goodput')),
+                _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
+                slots, kv,
+            ])
+            rows += 1
+    out.append(table.get_string() if rows else 'No clusters.')
+
+    if snap['services']:
+        stable = ux_utils.Table(['SERVICE', 'STATUS', 'QPS',
+                                 'P50', 'P99', 'REQS', '5XX'])
+        for s in snap['services']:
+            stable.add_row([
+                s['name'], s['status'],
+                _fmt_num(s.get('qps'), '{:.2f}'),
+                _fmt_num(s.get('p50_s'), '{:.3f}s'),
+                _fmt_num(s.get('p99_s'), '{:.3f}s'),
+                _fmt_num(s.get('requests'), '{:.0f}'),
+                _fmt_num(s.get('errors'), '{:.0f}'),
+            ])
+        out.append('')
+        out.append(stable.get_string())
+
+    if snap['breakers'] or snap['watchdogs']:
+        parts = []
+        open_breakers = [b for b in snap['breakers']
+                         if b['state'] != 0]
+        parts.append(f'breakers: {len(snap["breakers"])} '
+                     f'({len(open_breakers)} not closed'
+                     + (': ' + ', '.join(
+                         f'{b["target"]}='
+                         f'{_BREAKER_STATES.get(int(b["state"]), "?")}'
+                         for b in open_breakers[:5])
+                        if open_breakers else '') + ')')
+        unhealthy = [w for w in snap['watchdogs']
+                     if not w['healthy']]
+        parts.append(f'watchdogs: {len(snap["watchdogs"])} '
+                     f'({len(unhealthy)} unhealthy'
+                     + (': ' + ', '.join(w['target']
+                                         for w in unhealthy[:5])
+                        if unhealthy else '') + ')')
+        out.append('')
+        out.append('  '.join(parts))
+    return '\n'.join(out)
+
+
+def run(cluster_names: Optional[List[str]] = None,
+        interval: float = 2.0, once: bool = False,
+        echo=print) -> None:
+    """The `xsky top` loop. ``once`` prints a single snapshot (the
+    scriptable/testable mode); otherwise redraws every ``interval``
+    seconds until interrupted."""
+    while True:
+        snap = snapshot(cluster_names)
+        text = render(snap)
+        if once:
+            echo(text)
+            return
+        # ANSI clear + home — same trick every `top` uses.
+        echo('\x1b[2J\x1b[H' + text)
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return

@@ -403,7 +403,10 @@ def build_train_step(config: llama.LlamaConfig, mesh: Mesh,
 
 
 def instrument_train_step(step_fn: Callable,
-                          tokens_per_step: Optional[int] = None
+                          tokens_per_step: Optional[int] = None,
+                          model_config=None,
+                          accelerator: Optional[str] = None,
+                          full_finetune: bool = False
                           ) -> Callable:
     """Wrap a ``train_step(state, batch)`` so every call records
     step time and token throughput into the process metrics registry
@@ -433,21 +436,40 @@ def instrument_train_step(step_fn: Callable,
     between steps nests under it as a ``ckpt.save`` child. The final
     step's span closes on the next call only (a loop that stops never
     reports its last interval to the histogram either).
+
+    Goodput & MFU (docs/observability.md, Compute plane): every
+    inter-step interval feeds the process goodput accountant — the
+    first interval as ``compile``, the rest as ``compute`` minus any
+    blocking time the checkpoint subsystem noted inside it. With
+    ``model_config`` (param count) and a resolvable accelerator
+    (``accelerator`` arg or the ``SKYTPU_ACCELERATOR`` env stamp →
+    catalog peak FLOPs), each compute step also updates
+    ``skytpu_mfu_ratio``. ``full_finetune`` selects 6N vs 4N
+    FLOPs/token (frozen-base LoRA skips the base weight-grad).
+
+    On-demand profiling: the wrapper polls the host profile dir for
+    a trigger (armed by the agent's ``POST /profile`` / ``xsky
+    profile``) and, when armed, captures the next N steps with
+    ``jax.profiler`` and writes the op-time summary for the agent to
+    serve back (utils/profiling.py).
     """
-    from skypilot_tpu import metrics as metrics_lib
     from skypilot_tpu import trace as trace_lib
-    reg = metrics_lib.registry()
-    step_hist = reg.histogram(
-        'skytpu_train_step_seconds',
-        'Wall time between consecutive train steps.',
-        buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
-                 10.0, 30.0, 60.0, 120.0, 300.0))
-    tokens_total = reg.counter('skytpu_train_tokens_total',
-                               'Tokens trained on.')
-    steps_total = reg.counter('skytpu_train_steps_total',
-                              'Train steps executed.')
-    tok_s = reg.gauge('skytpu_train_tokens_per_sec',
-                      'Token throughput of the latest step.')
+    from skypilot_tpu.metrics import goodput as goodput_lib
+    from skypilot_tpu.utils import profiling as profiling_lib
+    fams = goodput_lib.train_metrics()
+    step_hist = fams['step_seconds']
+    tokens_total = fams['tokens_total']
+    steps_total = fams['steps_total']
+    tok_s = fams['tokens_per_sec']
+    acct = goodput_lib.accountant()
+    profiler = profiling_lib.StepProfiler('train')
+    model_armed = [False]
+    if model_config is not None and tokens_per_step is not None:
+        acct.set_model_info(model_config.num_params(), tokens_per_step,
+                            n_chips=jax.device_count(),
+                            accelerator=accelerator,
+                            full_finetune=full_finetune)
+        model_armed[0] = True
     last_call: List[Optional[float]] = [None]
     # Open train.step span state: (context, parent, start_wall,
     # ambient-token, step_index). The span's identity is
@@ -470,9 +492,18 @@ def instrument_train_step(step_fn: Callable,
         now = time.perf_counter()
         now_wall = time.time()
         n_tokens = _tokens_in(batch)
+        if model_config is not None and not model_armed[0] \
+                and n_tokens:
+            # tokens_per_step was derived from the first batch.
+            acct.set_model_info(model_config.num_params(), n_tokens,
+                                n_chips=jax.device_count(),
+                                accelerator=accelerator,
+                                full_finetune=full_finetune)
+            model_armed[0] = True
         if last_call[0] is not None:
             dt = now - last_call[0]
             step_hist.observe(dt)
+            acct.observe_step(dt, compile_step=(step_idx[0] == 1))
             if dt > 0 and n_tokens:
                 tok_s.set(n_tokens / dt)
             prev = open_step[0]
@@ -496,6 +527,7 @@ def instrument_train_step(step_fn: Callable,
         steps_total.inc()
         if n_tokens:
             tokens_total.inc(n_tokens)
+        profiler.on_step()
         return step_fn(state, batch)
 
     # Identity copy done BY HAND, not functools.wraps: wraps()

@@ -121,10 +121,16 @@ def main():
     step_fn = build_train_step(config, mesh, shardings,
                                optimizer=optimizer,
                                pipeline_microbatches=args.microbatches)
-    # Step-time / tokens-per-sec land in the process metrics registry
-    # (scraped cluster-wide via the host agent's /metrics).
+    # Step-time / tokens-per-sec / goodput buckets / MFU land in the
+    # process metrics registry and are published to the host agent's
+    # /metrics (textfile bridge) so the driver scrapes them
+    # cluster-wide. The accelerator for the MFU peak arrives via the
+    # SKYTPU_ACCELERATOR env stamp (runtime/env_contract.py).
     step_fn = instrument_train_step(
-        step_fn, tokens_per_step=args.batch * args.seq)
+        step_fn, tokens_per_step=args.batch * args.seq,
+        model_config=config, full_finetune=args.full_ft)
+    from skypilot_tpu.metrics import publish as publish_lib
+    publisher = publish_lib.start_publisher('train')
 
     ckpt = None
     start_step = 0
@@ -164,6 +170,7 @@ def main():
     if ckpt is not None:
         ckpt.wait()
         ckpt.close()
+    publisher.close()
     if jax.process_index() == 0:
         print('finetune done.')
 

@@ -144,6 +144,14 @@ def main():
         engine = BatchingEngine(params, config, slots=args.slots,
                                 kv_int8=args.kv_int8)
 
+    # Publish this replica's registry (batching queue/TTFT/KV-cache
+    # gauges + device HBM) to the host agent's /metrics via the
+    # textfile bridge, so `xsky metrics`/`xsky top` see the serving
+    # data plane, not just host gauges. Daemon thread; the stale-file
+    # TTL cleans up after a crash.
+    from skypilot_tpu.metrics import publish as publish_lib
+    publish_lib.start_publisher('replica')
+
     def generate(prompt_ids, max_new, temperature=None, top_p=None,
                  seed=None, eos_id=None):
         if (engine is not None and temperature is None

@@ -71,7 +71,11 @@ TRACEPARENT_HEADER = 'traceparent'
 # '3': GET /metrics (Prometheus exposition). Without the bump a
 # reused cluster keeps its old agent and every `xsky metrics` scrape
 # 404s host by host.
-AGENT_VERSION = '3'
+# '4': /metrics ingests compute-process textfiles (metrics.d/*.prom
+# — goodput/MFU/HBM/KV series) and POST /profile arms on-demand
+# profiling. Without the bump a reused cluster's old agent would
+# 404 `xsky profile` and scrape hosts without their compute series.
+AGENT_VERSION = '4'
 
 
 def served_version() -> str:
@@ -287,11 +291,110 @@ def _collect_samples() -> List[Tuple[str, str, str, float]]:
     return out
 
 
+# Textfile-collector staleness cutoff: a compute process that
+# stopped refreshing its .prom file (crash) stops being exported.
+# Mirrors metrics/publish.STALE_SECONDS (kept literal: this file
+# must run standalone in the k8s bootstrap).
+TEXTFILE_STALE_SECONDS = 120.0
+
+
+def _textfile_dir() -> str:
+    """Where compute processes publish their registries
+    (metrics/publish.textfile_dir — same resolution order, inlined
+    for the standalone bootstrap)."""
+    override = os.environ.get('SKYTPU_METRICS_DIR')
+    if override:
+        return os.path.expanduser(override)
+    runtime_dir = os.environ.get('SKYTPU_RUNTIME_DIR')
+    if runtime_dir:
+        return os.path.join(os.path.expanduser(runtime_dir),
+                            'metrics.d')
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state_dir, 'metrics.d')
+
+
+def _profile_dir() -> str:
+    """Where POST /profile arms the trigger and instrumented loops
+    drop their op-time summaries (utils/profiling.profile_dir —
+    same resolution order, inlined for the standalone bootstrap)."""
+    override = os.environ.get('SKYTPU_PROFILE_DIR')
+    if override:
+        return os.path.expanduser(override)
+    runtime_dir = os.environ.get('SKYTPU_RUNTIME_DIR')
+    if runtime_dir:
+        return os.path.join(os.path.expanduser(runtime_dir),
+                            'profiles')
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state_dir, 'profiles')
+
+
+def _read_textfiles() -> str:
+    """Fresh metrics.d/*.prom contents, # HELP/# TYPE deduped (two
+    publishers sharing a family keep one header; samples stay
+    distinct via their proc label). Pure stdlib so the standalone
+    bootstrap ingests too."""
+    directory = _textfile_dir()
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return ''
+    now = time.time()
+    lines: List[str] = []
+    seen: set = set()
+    for name in names:
+        if not name.endswith('.prom'):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.path.getmtime(path) > TEXTFILE_STALE_SECONDS:
+                # Crashed publisher: sweep so it stops haunting
+                # dashboards (a live one refreshes every ~10 s).
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+        except OSError:
+            continue
+        for line in text.splitlines():
+            if line.startswith('#'):
+                parts = line.split(None, 3)
+                if len(parts) >= 3 and parts[1] in ('HELP', 'TYPE'):
+                    key = (parts[1], parts[2])
+                    if key in seen:
+                        continue
+                    seen.add(key)
+            if line:
+                lines.append(line)
+    return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def arm_profile(steps: int) -> Dict[str, object]:
+    """POST /profile body: write the trigger file the instrumented
+    loops poll for (utils/profiling.consume_trigger). Stdlib-only —
+    the standalone bootstrap arms too."""
+    directory = _profile_dir()
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, 'trigger.json')
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump({'steps': int(steps), 'requested_at': time.time()},
+                  f)
+    os.replace(tmp, path)
+    return {'ok': True, 'steps': int(steps), 'dir': directory}
+
+
 def metrics_text() -> str:
     """Prometheus exposition for this agent process: proc-table
-    gauges plus host health gauges. Values are sampled at scrape
-    time (a scrape is the only reader; no background sampler thread
-    to leak)."""
+    gauges plus host health gauges, plus any fresh compute-process
+    textfiles (metrics.d/*.prom — the goodput/MFU/HBM/KV series
+    published by train loops and serve replicas on this host).
+    Values are sampled at scrape time (a scrape is the only reader;
+    no background sampler thread to leak)."""
     samples = _collect_samples()
     if os.environ.get('SKYTPU_DEBUG', '0') == '1':
         # Debug path: persist the Chrome trace on every scrape so it
@@ -309,7 +412,7 @@ def metrics_text() -> str:
             lines.append(f'# HELP {name} {help_text}')
             lines.append(f'# TYPE {name} {kind}')
             lines.append(f'{name} {value!r}')
-        return '\n'.join(lines) + '\n'
+        return '\n'.join(lines) + '\n' + _read_textfiles()
     reg = metrics_lib.registry()
     with _metrics_sync_lock:
         for name, kind, help_text, value in samples:
@@ -323,7 +426,7 @@ def metrics_text() -> str:
                     family.inc(delta)
             else:
                 reg.gauge(name, help_text).set(value)
-    return reg.render()
+    return reg.render() + _read_textfiles()
 
 
 def _trace_env_from_header(header_value: Optional[str],
@@ -467,6 +570,23 @@ class _Handler(BaseHTTPRequestHandler):
         elif parsed.path == '/kill':
             ok = _procs.kill(int(body['proc_id']))
             self._json({'ok': ok})
+        elif parsed.path == '/profile':
+            # Arm on-demand profiling: the next N train/decode steps
+            # of any instrumented loop on this host get captured and
+            # summarized (docs/observability.md, On-demand
+            # profiling). Idempotent — re-arming overwrites.
+            try:
+                steps = int(body.get('steps', 5))
+            except (TypeError, ValueError):
+                self._json({'error': 'steps must be an int'}, 400)
+                return
+            if steps < 1:
+                self._json({'error': 'steps must be >= 1'}, 400)
+                return
+            try:
+                self._json(arm_profile(steps))
+            except OSError as e:
+                self._json({'error': str(e)}, 500)
         elif parsed.path == '/exec':
             timeout = float(body.get('timeout', 600))
             # The request's header ALWAYS wins over the agent's own

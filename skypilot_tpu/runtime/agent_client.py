@@ -309,6 +309,32 @@ class AgentClient:
         except (urllib.error.URLError, OSError):
             return False
 
+    def profile(self, steps: int = 5,
+                runtime_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Arm on-demand profiling on this host (``POST /profile``):
+        the next ``steps`` train/decode steps of any instrumented
+        loop get captured and summarized (docs/observability.md).
+        Returns ``{ok, steps, dir}`` — ``dir`` is where the host
+        writes ``latest.json`` (fetch via :meth:`read_file`).
+
+        Fallback for agents predating protocol v4 (404): the trigger
+        FILE is the real protocol, so write it directly through
+        ``/put`` into ``runtime_dir``'s profile dir."""
+        try:
+            # Idempotent (re-arming overwrites one trigger file), so
+            # transient-failure retries are safe.
+            return self._post('/profile', {'steps': int(steps)},
+                              retry=True)
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or not runtime_dir:
+                raise
+        directory = os.path.join(runtime_dir, 'profiles')
+        payload = json.dumps({'steps': int(steps),
+                              'requested_at': time.time()}).encode()
+        self.put_file(os.path.join(directory, 'trigger.json'),
+                      payload)
+        return {'ok': True, 'steps': int(steps), 'dir': directory}
+
     def exec(self, cmd: str, timeout: float = 600.0,
              retry: bool = False) -> Dict[str, Any]:
         """Blocking remote command (setup steps). ``retry=True`` opts

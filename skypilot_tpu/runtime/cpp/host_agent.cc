@@ -14,6 +14,7 @@
 // parser/writer tailored to the protocol's flat messages.
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -29,8 +30,11 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <algorithm>
 #include <map>
 #include <mutex>
+#include <set>
+#include <sstream>
 #include <string>
 #include <thread>
 #include <vector>
@@ -38,7 +42,7 @@
 namespace {
 
 // Keep in lockstep with agent.py AGENT_VERSION.
-constexpr const char* kVersion = "3";
+constexpr const char* kVersion = "4";
 
 // ---------------------------------------------------------------------
 // Minimal JSON: value = object | string | number | bool | null.
@@ -584,10 +588,122 @@ void AppendMetric(std::string* out, const char* name, const char* kind,
   out->append(buf);
 }
 
+// Shared-directory resolution for the textfile metrics bridge and
+// the profile trigger (keep in lockstep with agent.py _textfile_dir
+// / _profile_dir and metrics/publish.py / utils/profiling.py):
+// env override, else $SKYTPU_RUNTIME_DIR/<sub>, else
+// $SKYTPU_STATE_DIR/<sub> (default ~/.skypilot_tpu/<sub>).
+std::string SharedDir(const char* override_env, const char* sub) {
+  if (const char* v = std::getenv(override_env)) {
+    if (*v != '\0') return ProcTable::Expand(v);
+  }
+  if (const char* rdir = std::getenv("SKYTPU_RUNTIME_DIR")) {
+    if (*rdir != '\0')
+      return ProcTable::Expand(std::string(rdir) + "/" + sub);
+  }
+  std::string state = "~/.skypilot_tpu";
+  if (const char* sdir = std::getenv("SKYTPU_STATE_DIR")) {
+    if (*sdir != '\0') state = sdir;
+  }
+  return ProcTable::Expand(state + "/" + sub);
+}
+
+constexpr double kTextfileStaleSeconds = 120.0;
+
+// Textfile collector (agent.py _read_textfiles): append fresh
+// metrics.d/*.prom published by compute processes (goodput/MFU/HBM/
+// KV series), deduplicating # HELP/# TYPE headers by family name —
+// samples stay distinct via each publisher's proc label. Stale
+// files (dead publishers) are skipped and swept.
+void AppendTextfiles(std::string* out) {
+  std::string dir = SharedDir("SKYTPU_METRICS_DIR", "metrics.d");
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return;
+  std::vector<std::string> names;
+  while (struct dirent* ent = readdir(d)) {
+    std::string name = ent->d_name;
+    if (name.size() > 5 && name.rfind(".prom") == name.size() - 5) {
+      names.push_back(name);
+    }
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  std::set<std::string> seen_headers;
+  time_t now = time(nullptr);
+  for (const std::string& name : names) {
+    std::string path = dir + "/" + name;
+    struct stat st;
+    if (stat(path.c_str(), &st) != 0) continue;
+    if (now - st.st_mtime > kTextfileStaleSeconds) {
+      unlink(path.c_str());
+      continue;
+    }
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) continue;
+    std::string text;
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+    fclose(f);
+    std::istringstream lines(text);
+    std::string line;
+    while (std::getline(lines, line)) {
+      if (line.empty()) continue;
+      if (line[0] == '#') {
+        std::istringstream parts(line);
+        std::string hash, kw, fam;
+        parts >> hash >> kw >> fam;
+        if (kw == "HELP" || kw == "TYPE") {
+          std::string key = kw + " " + fam;
+          if (seen_headers.count(key)) continue;
+          seen_headers.insert(key);
+        }
+      }
+      out->append(line);
+      out->append("\n");
+    }
+  }
+}
+
+// POST /profile (agent.py arm_profile): write the trigger file the
+// instrumented loops poll for (utils/profiling.consume_trigger).
+// Returns the profile dir, or "" on write failure.
+std::string ArmProfile(int steps) {
+  std::string dir = SharedDir("SKYTPU_PROFILE_DIR", "profiles");
+  // mkdir -p.
+  for (size_t i = 1; i <= dir.size(); ++i) {
+    if (i == dir.size() || dir[i] == '/') {
+      mkdir(dir.substr(0, i).c_str(), 0755);
+    }
+  }
+  std::string path = dir + "/trigger.json";
+  std::string tmp = path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (f == nullptr) return "";
+  char body[128];
+  int len = std::snprintf(body, sizeof(body),
+                          "{\"steps\": %d, \"requested_at\": %.3f}",
+                          steps,
+                          std::chrono::duration<double>(
+                              std::chrono::system_clock::now()
+                                  .time_since_epoch())
+                              .count());
+  size_t written = fwrite(body, 1, len, f);
+  if (fclose(f) != 0 || written != static_cast<size_t>(len)) {
+    unlink(tmp.c_str());
+    return "";
+  }
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return "";
+  }
+  return dir;
+}
+
 // Prometheus text exposition: proc-table + host gauges, sampled at
-// scrape time. Same metric names as agent.py metrics_text (the
-// executable spec) so the driver-side aggregator merges py/cpp hosts
-// into one series set.
+// scrape time, plus any fresh compute-process textfiles. Same metric
+// names as agent.py metrics_text (the executable spec) so the
+// driver-side aggregator merges py/cpp hosts into one series set.
 std::string MetricsText() {
   std::string out;
   double uptime = std::chrono::duration<double>(
@@ -630,6 +746,7 @@ std::string MetricsText() {
     }
     fclose(f);
   }
+  AppendTextfiles(&out);
   return out;
 }
 
@@ -765,6 +882,29 @@ void HandleConnection(int fd) {
     } else if (req.path == "/kill") {
       bool ok = g_procs.Kill(static_cast<int>(body.obj["proc_id"].num));
       SendJson(fd, ok ? "{\"ok\": true}" : "{\"ok\": false}");
+    } else if (req.path == "/profile") {
+      // Arm on-demand profiling (mirror of agent.py /profile): the
+      // trigger file is the protocol, so loops need no agent flavor
+      // awareness.
+      int steps = 5;
+      auto sit = body.obj.find("steps");
+      if (sit != body.obj.end() && sit->second.type == JsonValue::kNumber) {
+        steps = static_cast<int>(sit->second.num);
+      }
+      if (steps < 1) {
+        SendJson(fd, "{\"error\": \"steps must be >= 1\"}", 400);
+        close(fd);
+        return;
+      }
+      std::string dir = ArmProfile(steps);
+      if (dir.empty()) {
+        SendJson(fd, "{\"error\": \"cannot write trigger\"}", 500);
+      } else {
+        std::string json = "{\"ok\": true, \"steps\": " +
+                           std::to_string(steps) + ", \"dir\": \"" +
+                           JsonEscape(dir) + "\"}";
+        SendJson(fd, json);
+      }
     } else if (req.path == "/exec") {
       double timeout = 600;
       auto it = body.obj.find("timeout");

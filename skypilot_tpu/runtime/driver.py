@@ -140,7 +140,8 @@ def _gang_run(job_id: int, spec: Dict[str, Any], clients, hosts,
             task_id=task_id,
             # Multi-slice runs additionally get the megascale DCN
             # contract (hosts are rank-ordered slice-major).
-            num_slices=spec.get('num_slices') or 1)
+            num_slices=spec.get('num_slices') or 1,
+            accelerator=spec.get('accelerator'))
         env.update(spec.get('envs') or {})
         env.update(trace_lib.context_env())
         # The cluster-local job id, so jobs that ARE controllers

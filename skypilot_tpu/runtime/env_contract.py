@@ -19,6 +19,11 @@ ENV_COORDINATOR_PORT = 'SKYTPU_COORDINATOR_PORT'
 ENV_COORDINATOR_ADDRESS = 'SKYTPU_COORDINATOR_ADDRESS'
 ENV_NUM_CHIPS_PER_NODE = 'SKYTPU_NUM_CHIPS_PER_NODE'
 ENV_TASK_ID = 'SKYTPU_TASK_ID'
+# The slice's accelerator name (e.g. 'tpu-v5p-8'): the MFU
+# denominator comes from the catalog peak for this chip
+# (metrics/goodput.py reads it — keep in sync with
+# goodput.ENV_ACCELERATOR).
+ENV_ACCELERATOR = 'SKYTPU_ACCELERATOR'
 ENV_CLUSTER_INFO = 'SKYTPU_CLUSTER_INFO'
 ENV_NUM_SLICES = 'SKYTPU_NUM_SLICES'
 ENV_SLICE_ID = 'SKYTPU_SLICE_ID'
@@ -33,7 +38,8 @@ def build_env(node_rank: int, node_ips: List[str],
               num_chips_per_node: int = 0,
               task_id: Optional[str] = None,
               coordinator_port: int = COORDINATOR_PORT,
-              num_slices: int = 1
+              num_slices: int = 1,
+              accelerator: Optional[str] = None
               ) -> Dict[str, str]:
     """Env for one task process on host ``node_rank``.
 
@@ -72,6 +78,8 @@ def build_env(node_rank: int, node_ips: List[str],
         env['MEGASCALE_COORDINATOR_ADDRESS'] = \
             f'{node_ips[0]}:{MEGASCALE_PORT}'
         env['MEGASCALE_PORT'] = str(MEGASCALE_PORT)
+    if accelerator:
+        env[ENV_ACCELERATOR] = accelerator
     if task_id is not None:
         env[ENV_TASK_ID] = env['SKYPILOT_TASK_ID'] = task_id
     return env

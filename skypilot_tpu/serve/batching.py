@@ -275,6 +275,17 @@ def _engine_metrics():
         'slots': reg.gauge(
             'skytpu_batch_slots_total',
             'Fixed decode slot count of the engine.'),
+        'kv_bytes': reg.gauge(
+            'skytpu_batch_kv_cache_bytes',
+            'Resident KV-cache allocation of the engine (codes + '
+            'scales) — the HBM the slots pin whether or not they '
+            'hold requests.'),
+        'kv_used': reg.gauge(
+            'skytpu_batch_kv_cache_used_bytes',
+            'KV-cache bytes logically written by admitted requests '
+            '(occupied slots x their row positions) — the '
+            'fragmentation gap to skytpu_batch_kv_cache_bytes is '
+            'what the paged-KV roadmap item reclaims.'),
     }
 
 
@@ -348,6 +359,17 @@ class BatchingEngine:
                                donate_argnums=(0,))
         self._metrics = _engine_metrics()
         self._metrics['slots'].set(slots)
+        self._cache_bytes = sum(
+            int(c.nbytes) for c in self.caches if c is not None)
+        self._bytes_per_row = self._cache_bytes / (slots *
+                                                   self.max_seq)
+        self._metrics['kv_bytes'].set(self._cache_bytes)
+        # Host-side written-length per slot (prompt + generated) for
+        # the used-bytes gauge — mirrors the device-side pos without
+        # a device_get in the hot loop.
+        self.slot_len = [0] * slots
+        from skypilot_tpu.utils import profiling as profiling_lib
+        self._profiler = profiling_lib.StepProfiler('decode')
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
 
@@ -441,6 +463,7 @@ class BatchingEngine:
         self.tokens = self.tokens.at[row].set(first)
         self.slot_req[row] = req
         self.slot_left[row] = req.max_new - 1
+        self.slot_len[row] = t0
         # The first token is produced by the prefill itself. The TTFT
         # observation and the batch.first_token span end on the SAME
         # clock read; batch.prefill covers prefill dispatch → slot
@@ -504,10 +527,15 @@ class BatchingEngine:
             active_rows = [i for i, r in enumerate(self.slot_req)
                            if r is not None]
             self._metrics['occupancy'].set(len(active_rows))
+            self._metrics['kv_used'].set(self._bytes_per_row * sum(
+                self.slot_len[i] for i in active_rows))
             if not active_rows:
                 self.wake.wait(timeout=0.5)
                 self.wake.clear()
                 continue
+            # On-demand profiling hook: one "step" per decode
+            # dispatch (docs/observability.md, On-demand profiling).
+            self._profiler.on_step()
             # Fixed dispatch length: a data-dependent n would compile
             # one executable per distinct remaining-count (observed as
             # multi-second stalls in the tail of a request wave).
@@ -524,6 +552,10 @@ class BatchingEngine:
                               self.pos, active,
                               self.config, n)
             self.tokens = toks[:, -1]
+            for i in active_rows:
+                if self.slot_left[i] > 0:
+                    self.slot_len[i] = min(self.slot_len[i] + n,
+                                           self.max_seq)
             host_toks = jax.device_get(toks)
             dispatch_s = time.perf_counter() - t_dispatch
             if dispatch_s > 0:

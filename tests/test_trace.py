@@ -806,3 +806,112 @@ class TestSpanNameContractLint:
                          'train.step', 'agent.rpc', 'agent.run',
                          'job.run', 'serve.up'):
             assert expected in emitted, expected
+
+
+# Metric-name construction sites (the general contract lint — the
+# span-name lint above, extended to the metric plane):
+#  - registry constructors: reg.counter('skytpu_...') / .gauge /
+#    .histogram (possibly with the name on the next line);
+#  - the agents' hand-rendered sample tuples:
+#    ('skytpu_x', 'gauge', ...) in agent.py _collect_samples and
+#    AppendMetric(&out, "skytpu_x", "gauge", ...) in host_agent.cc.
+METRIC_NAME_PATTERNS = (
+    re.compile(r"""\.(?:counter|gauge|histogram)\(\s*\n?\s*"""
+               r"""'(skytpu_[a-z0-9_]+)'"""),
+    re.compile(r"""\('(skytpu_[a-z0-9_]+)',\s*\n?\s*"""
+               r"""'(?:gauge|counter|histogram)'"""),
+    re.compile(r'''AppendMetric\(&out,\s*"(skytpu_[a-z0-9_]+)"'''),
+)
+
+_DOC_METRIC_TOKEN = re.compile(r'`(skytpu_[a-z0-9_]+)`')
+_FULL_METRIC_NAME = re.compile(r'skytpu_[a-z0-9_]+$')
+
+
+def _constructed_metric_names():
+    """{name: first path} for every metric-name literal constructed
+    in skypilot_tpu/ (py AND the C++ agent)."""
+    import skypilot_tpu
+    root = os.path.dirname(skypilot_tpu.__file__)
+    names = {}
+    for dirpath, _, files in os.walk(root):
+        if '__pycache__' in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(('.py', '.cc')):
+                continue
+            path = os.path.join(dirpath, fn)
+            text = open(path, encoding='utf-8').read()
+            for pat in METRIC_NAME_PATTERNS:
+                for name in pat.findall(text):
+                    names.setdefault(name, path)
+    return names
+
+
+class TestMetricNameContractLint:
+    """Both directions of the metric-name contract
+    (docs/observability.md): every metric constructed in-tree is
+    documented, and every documented name exists in-tree — the
+    contract cannot silently drift either way."""
+
+    @staticmethod
+    def _docs_text():
+        import skypilot_tpu
+        root = os.path.dirname(os.path.dirname(
+            skypilot_tpu.__file__))
+        return open(os.path.join(root, 'docs', 'observability.md'),
+                    encoding='utf-8').read()
+
+    def test_all_constructed_metric_names_documented(self):
+        docs = self._docs_text()
+        names = _constructed_metric_names()
+        assert names, 'lint found no metric constructions at all — '\
+                      'did the registry API change?'
+        missing = [f'{name} (from {path})'
+                   for name, path in sorted(names.items())
+                   if f'`{name}`' not in docs]
+        assert not missing, (
+            'metric names constructed in-tree but missing from the '
+            'docs/observability.md contract tables:\n  ' +
+            '\n  '.join(missing))
+
+    def test_all_documented_metric_names_constructed(self):
+        """Reverse direction over the curated tables: every
+        backticked full `skytpu_*` token in the doc must be
+        constructed somewhere in-tree (tokens with globs/labels —
+        `skytpu_agent_*`, `skytpu_jobs{...}` — aren't full names and
+        are skipped by the fullmatch)."""
+        docs = self._docs_text()
+        constructed = set(_constructed_metric_names())
+        documented = {m for m in _DOC_METRIC_TOKEN.findall(docs)
+                      if _FULL_METRIC_NAME.fullmatch(m)}
+        assert documented, 'no documented metric names found — did '\
+                           'the docs table format change?'
+        stale = sorted(documented - constructed)
+        assert not stale, (
+            'metric names documented in docs/observability.md but '
+            'constructed nowhere in skypilot_tpu/:\n  ' +
+            '\n  '.join(stale))
+
+    def test_known_metric_names_are_seen(self):
+        """Meta-check against regex rot: the lint must see at least
+        the long-standing core families from every construction
+        style (registry call, py agent tuple, C++ AppendMetric)."""
+        names = _constructed_metric_names()
+        for expected in ('skytpu_train_step_seconds',       # registry
+                         'skytpu_agent_uptime_seconds',     # py tuple
+                         'skytpu_host_load5',               # py tuple
+                         'skytpu_lb_requests_total',
+                         'skytpu_goodput_seconds_total',
+                         'skytpu_mfu_ratio',
+                         'skytpu_device_hbm_used_bytes',
+                         'skytpu_batch_kv_cache_bytes'):
+            assert expected in names, expected
+        # The C++ agent's names all shadow py-agent ones (same
+        # protocol), so check its pattern against the file directly.
+        import skypilot_tpu
+        cc_path = os.path.join(os.path.dirname(skypilot_tpu.__file__),
+                               'runtime', 'cpp', 'host_agent.cc')
+        cc_names = METRIC_NAME_PATTERNS[-1].findall(
+            open(cc_path, encoding='utf-8').read())
+        assert 'skytpu_agent_uptime_seconds' in cc_names, \
+            'lint no longer sees the C++ agent metrics'
