@@ -1,0 +1,41 @@
+"""Fleet health plane: SLO/alert rules over retained metrics.
+
+The retained half of observability (PRs 1/6/7 built the
+point-in-time half): ``metrics/history.py`` keeps bounded
+time-series of every scrape, and this package watches them —
+
+- ``rules.py`` — declarative rule kinds: threshold, rate-over-
+  window, absent/staleness, multi-window burn-rate SLO;
+- ``engine.py`` — pending → firing → resolved state machine with
+  hysteresis, a jsonl alert journal, and persisted per-scope state
+  snapshots;
+- ``builtin.py`` — the built-in rule pack (replica 5xx, p99 TTFT,
+  goodput drops, HBM headroom, stuck breakers, stale scrapes,
+  orphan daemons, checkpoint failures, recovery storms) plus
+  SLO objectives declared in the service spec YAML.
+
+Alert-driven control loops: the serve controller demotes replicas
+on firing replica alerts (recording an exemplar trace_id from the
+offending LB span, so ``xsky trace`` explains the page) and the
+autoscaler treats a burn-rate page as scale-up pressure. Surfaces:
+``xsky alerts``, ``xsky slo``, the ALERTS column in ``xsky top``.
+Contract: docs/observability.md, Alerts & SLOs.
+"""
+from skypilot_tpu.alerts import builtin, journal
+from skypilot_tpu.alerts.engine import (FIRING, PENDING, RESOLVED,
+                                        AlertEngine, all_alerts,
+                                        load_states)
+from skypilot_tpu.alerts.rules import KINDS, AlertRule
+
+__all__ = [
+    'AlertEngine',
+    'AlertRule',
+    'KINDS',
+    'PENDING',
+    'FIRING',
+    'RESOLVED',
+    'all_alerts',
+    'builtin',
+    'journal',
+    'load_states',
+]

@@ -1,0 +1,173 @@
+"""Built-in alert rule pack.
+
+Rule ids are stable API exactly like metric and span names: each id
+below must be backticked in docs/observability.md's Built-in rules
+table, and every documented id must be constructed here (grep lint
+in tests/test_trace.py, both directions).
+
+Two packs, matching where the engines run:
+
+- ``serve_rules(spec)`` — per-service rules ticked by the serve
+  controller (and by ``xsky alerts`` against a scraped LB): replica
+  probe/5xx health, TTFT latency, plus a multi-window burn-rate
+  rule when the service spec declares an ``slo:`` objective;
+- ``fleet_rules()`` — cluster/driver-level rules ticked by the
+  skylet and by ``xsky alerts``: stale scrapes, stuck breakers,
+  orphan-daemon reaps, checkpoint failures, recovery storms,
+  goodput drops, HBM headroom.
+
+``SKYTPU_ALERTS_FOR_SECONDS`` / ``SKYTPU_ALERTS_WINDOW_SECONDS``
+override every rule's hold/window uniformly — the chaos-drill and
+test knob (a drill must not wait out production windows).
+"""
+import os
+from typing import List, Optional
+
+from skypilot_tpu.alerts.rules import AlertRule
+
+
+def _env_override(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def _apply_overrides(rules: List[AlertRule]) -> List[AlertRule]:
+    for_s = _env_override('SKYTPU_ALERTS_FOR_SECONDS')
+    window = _env_override('SKYTPU_ALERTS_WINDOW_SECONDS')
+    for rule in rules:
+        if for_s is not None:
+            rule.for_seconds = for_s
+        if window is not None:
+            rule.window = window
+            rule.max_age = window
+            rule.long_window = window
+            rule.short_window = max(1.0, window / 12.0)
+    return rules
+
+
+def serve_rules(spec=None) -> List[AlertRule]:
+    """Per-service pack. ``spec`` is a SkyServiceSpec (or None);
+    its ``slo_objective`` adds the burn-rate page."""
+    rules = [
+        AlertRule(
+            id='replica-probe-errors', kind='rate',
+            metric='skytpu_serve_probe_failures_total',
+            threshold=0.0, op='>', window=120.0, for_seconds=10.0,
+            severity='page',
+            summary='Replica readiness probes are failing.'),
+        AlertRule(
+            id='replica-5xx-rate', kind='rate',
+            metric='skytpu_lb_requests_total',
+            labels={'code': ('prefix', '5')},
+            threshold=0.1, op='>', window=300.0, for_seconds=60.0,
+            severity='page',
+            summary='Replicas are answering 5xx through the LB.'),
+        AlertRule(
+            id='lb-no-ready-replica', kind='rate',
+            metric='skytpu_lb_no_ready_replica_total',
+            threshold=0.0, op='>', window=120.0, for_seconds=0.0,
+            severity='page',
+            summary='LB refused requests with an empty ready set.'),
+    ]
+    objective = getattr(spec, 'slo_objective', None) \
+        if spec is not None else None
+    if objective:
+        window = float(getattr(spec, 'slo_window_seconds', 3600.0)
+                       or 3600.0)
+        rules.append(AlertRule(
+            id='slo-burn-rate', kind='burn_rate',
+            objective=float(objective),
+            bad_metric='skytpu_lb_requests_total',
+            bad_labels={'code': ('prefix', '5')},
+            total_metric='skytpu_lb_requests_total',
+            long_window=window,
+            short_window=max(1.0, window / 12.0),
+            burn_factor=14.4, for_seconds=0.0, severity='page',
+            summary=f'Error-budget burn vs the {objective:g} SLO '
+                    'exceeds the page threshold on both windows.'))
+    return _apply_overrides(rules)
+
+
+def fleet_rules() -> List[AlertRule]:
+    """Cluster/driver-level pack (skylet tick + `xsky alerts`)."""
+    rules = [
+        # p99-ttft-high lives in the FLEET pack, not the serve pack:
+        # the TTFT histogram is recorded by replica worker processes
+        # and reaches history through the textfile bridge → host
+        # agent → CLUSTER-scope scrapes; service-scope stores
+        # (LB/controller registry) never carry it.
+        AlertRule(
+            id='p99-ttft-high', kind='threshold',
+            metric='skytpu_batch_ttft_seconds', quantile=0.99,
+            threshold=2.0, resolve_threshold=1.5, op='>',
+            window=300.0, for_seconds=120.0,
+            summary='p99 time-to-first-token over budget.'),
+        AlertRule(
+            id='agent-scrape-stale', kind='absent',
+            metric='skytpu_agent_uptime_seconds',
+            max_age=180.0, for_seconds=0.0, severity='page',
+            summary='No fresh agent scrape — host or scraper dark.'),
+        AlertRule(
+            id='breaker-stuck-open', kind='threshold',
+            metric='skytpu_circuit_breaker_state',
+            threshold=1.0, op='>=', resolve_threshold=1.0,
+            aggregate='max',  # the worst breaker, not a state sum
+            window=900.0, for_seconds=300.0,
+            summary='A circuit breaker has been OPEN/half-open for '
+                    'minutes — its target is persistently dark.'),
+        AlertRule(
+            id='orphan-daemon-reaps', kind='rate',
+            metric='skytpu_lifecycle_reaped_orphans_total',
+            threshold=0.0, op='>', window=600.0, for_seconds=0.0,
+            summary='The lifecycle sweeper is reaping orphaned '
+                    'daemons — something is leaking processes.'),
+        AlertRule(
+            id='checkpoint-save-failures', kind='rate',
+            metric='skytpu_ckpt_saves_total',
+            labels={'outcome': 'error'},
+            threshold=0.0, op='>', window=900.0, for_seconds=0.0,
+            severity='page',
+            summary='Checkpoint saves are erroring — recovery '
+                    'protection is degrading.'),
+        AlertRule(
+            id='job-recovery-storm', kind='rate',
+            metric='skytpu_job_recoveries_total',
+            threshold=3.0 / 600.0, op='>', window=600.0,
+            for_seconds=0.0, severity='page',
+            summary='Managed jobs are recovering repeatedly '
+                    '(preemption storm or crash loop).'),
+        AlertRule(
+            id='goodput-ratio-drop', kind='threshold',
+            metric='skytpu_goodput_ratio',
+            threshold=0.5, resolve_threshold=0.6, op='<',
+            aggregate='min',  # the worst host's ratio, never a sum
+            window=900.0, for_seconds=300.0,
+            summary='Training goodput dropped below 50% of wall '
+                    'clock.'),
+        AlertRule(
+            id='hbm-headroom-low', kind='threshold',
+            metric='skytpu_device_hbm_used_bytes',
+            denominator='skytpu_device_hbm_limit_bytes',
+            threshold=0.92, resolve_threshold=0.88, op='>',
+            aggregate='max',  # per-device ratio; one full device
+                              # pages even among idle neighbors
+            window=300.0, for_seconds=120.0,
+            summary='Device HBM above 92% of capacity — OOM risk.'),
+    ]
+    return _apply_overrides(rules)
+
+
+def all_rule_ids() -> List[str]:
+    """Every built-in rule id (the doc-lint's ground truth). The
+    spec passed to ``serve_rules`` here is a stand-in that declares
+    an SLO so the burn-rate rule is included."""
+    class _Slo:
+        slo_objective = 0.999
+        slo_window_seconds = 3600.0
+    return sorted({r.id for r in serve_rules(_Slo())} |
+                  {r.id for r in fleet_rules()})

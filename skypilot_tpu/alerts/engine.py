@@ -1,0 +1,326 @@
+"""Alert engine: evaluates rules on a tick, runs the
+pending → firing → resolved state machine, journals transitions,
+and persists a per-scope state snapshot other processes can render.
+
+Lifecycle (Prometheus semantics, with journaled hysteresis):
+
+- condition newly true → PENDING (journaled); it must HOLD for the
+  rule's ``for_seconds`` before escalating — a one-tick blip never
+  pages;
+- still true past the hold → FIRING (journaled, stamped with an
+  exemplar trace_id from the offending LB span when the host
+  process can provide one);
+- condition false while pending → back to inactive (journaled as
+  resolved-from-pending);
+- firing resolves only when the value clears the rule's
+  ``resolve_threshold`` (hysteresis — no flapping at the line).
+
+The engine is deliberately host-agnostic: the serve controller
+ticks one per service, the skylet ticks one per cluster, and
+``xsky alerts`` ticks one per scrape target in the driver. Each
+persists ``$SKYTPU_STATE_DIR/alerts/state-<scope>.json`` (atomic
+write) so any of them — and ``xsky top`` — can render the union
+without re-evaluating."""
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.alerts import journal as journal_lib
+from skypilot_tpu.alerts.rules import AlertRule
+from skypilot_tpu.metrics.history import HistoryStore, _safe_scope
+
+logger = tpu_logging.init_logger(__name__)
+
+PENDING = 'pending'
+FIRING = 'firing'
+RESOLVED = 'resolved'
+
+
+def _metrics():
+    from skypilot_tpu import metrics as metrics_lib
+    reg = metrics_lib.registry()
+    return (
+        reg.gauge('skytpu_alerts_firing',
+                  'Alerts currently firing, per engine scope.',
+                  ('scope',)),
+        reg.counter('skytpu_alert_transitions_total',
+                    'Alert state transitions.', ('rule', 'state')),
+    )
+
+
+class AlertEngine:
+
+    def __init__(self, store: HistoryStore,
+                 rules: Sequence[AlertRule],
+                 scope: str,
+                 base: Optional[str] = None,
+                 clock: Callable[[], float] = time.time,
+                 exemplar_fn: Optional[Callable[[], Optional[str]]]
+                 = None,
+                 attrs: Optional[Dict[str, str]] = None,
+                 resume: bool = True):
+        self.store = store
+        self.rules = list(rules)
+        self.scope = scope
+        self._base = base
+        self._clock = clock
+        self._exemplar_fn = exemplar_fn
+        # Constant context stamped into every state/journal record
+        # (e.g. {'cluster': name} / {'service': name}) so `xsky top`
+        # can attribute alerts to its rows.
+        self._attrs = dict(attrs or {})
+        self._states: Dict[str, Dict[str, Any]] = {}
+        if resume:
+            # Continue this scope's state machine across processes:
+            # `xsky alerts` is one invocation per tick, and a
+            # restarted controller must not re-journal a years-long
+            # page as a fresh pending.
+            self._resume()
+
+    def _resume(self) -> None:
+        try:
+            with open(self.state_path(), encoding='utf-8') as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            return
+        for entry in snap.get('alerts', []):
+            if isinstance(entry, dict) and entry.get('rule'):
+                self._states[entry['rule']] = entry
+
+    # -- state machine --------------------------------------------------
+
+    def tick(self, now: Optional[float] = None
+             ) -> List[Dict[str, Any]]:
+        """Evaluate every rule once; journal + persist transitions;
+        return this tick's transition events."""
+        now = self._clock() if now is None else now
+        events: List[Dict[str, Any]] = []
+        for rule in self.rules:
+            try:
+                fire, keep, value = rule.evaluate(self.store, now)
+            except Exception:  # pylint: disable=broad-except
+                # One broken rule must not take the control loop (or
+                # the other rules) down with it.
+                logger.exception('alert rule %s evaluation failed',
+                                 rule.id)
+                continue
+            events.extend(
+                self._advance(rule, fire, keep, value, now))
+        events.extend(self._resolve_orphans(now))
+        self._persist(now)
+        self._export_metrics()
+        return events
+
+    def _resolve_orphans(self, now: float) -> List[Dict[str, Any]]:
+        """A live state whose rule left the rule set (a service
+        update dropped its `slo:` block) would otherwise stay
+        FIRING forever — nothing evaluates it, and each tick's
+        persist keeps it TTL-fresh. Resolve it explicitly."""
+        current = {r.id for r in self.rules}
+        events: List[Dict[str, Any]] = []
+        for rule_id, entry in list(self._states.items()):
+            if rule_id in current or \
+                    entry.get('state') not in (PENDING, FIRING):
+                continue
+            resolved = dict(entry, state=RESOLVED, since=now,
+                            resolved_from=entry['state'],
+                            resolved_reason='rule-removed')
+            self._states[rule_id] = resolved
+            event = dict(resolved, ts=now, kind='transition')
+            journal_lib.append_event(event, base=self._base)
+            _metrics()[1].labels(rule=rule_id,
+                                 state=RESOLVED).inc()
+            events.append(event)
+        return events
+
+    def _advance(self, rule: AlertRule, fire: bool, keep: bool,
+                 value: Optional[float], now: float
+                 ) -> List[Dict[str, Any]]:
+        entry = self._states.get(rule.id)
+        state = entry['state'] if entry else None
+        events: List[Dict[str, Any]] = []
+
+        def transition(new_state: str, **extra):
+            nonlocal entry
+            entry = {
+                'rule': rule.id, 'scope': self.scope,
+                'severity': rule.severity, 'summary': rule.summary,
+                'state': new_state, 'since': now, 'value': value,
+                **self._attrs,
+            }
+            if extra:
+                entry.update(extra)
+            prev = self._states.get(rule.id) or {}
+            if prev.get('exemplar_trace_id') and \
+                    'exemplar_trace_id' not in entry:
+                entry['exemplar_trace_id'] = \
+                    prev['exemplar_trace_id']
+            self._states[rule.id] = entry
+            event = dict(entry, ts=now, kind='transition')
+            journal_lib.append_event(event, base=self._base)
+            _metrics()[1].labels(rule=rule.id,
+                                 state=new_state).inc()
+            events.append(event)
+
+        if state in (None, RESOLVED):
+            if fire:
+                transition(PENDING)
+                if rule.for_seconds <= 0:
+                    transition(
+                        FIRING,
+                        exemplar_trace_id=self._exemplar())
+        elif state == PENDING:
+            if not fire:
+                transition(RESOLVED, resolved_from=PENDING)
+            elif now - entry['since'] >= rule.for_seconds:
+                transition(FIRING,
+                           exemplar_trace_id=self._exemplar())
+            else:
+                entry['value'] = value
+        elif state == FIRING:
+            if keep:
+                entry['value'] = value
+            else:
+                transition(RESOLVED, resolved_from=FIRING)
+        return events
+
+    def _exemplar(self) -> Optional[str]:
+        if self._exemplar_fn is None:
+            return None
+        try:
+            return self._exemplar_fn()
+        except Exception:  # pylint: disable=broad-except
+            return None
+
+    # -- queries --------------------------------------------------------
+
+    def states(self) -> List[Dict[str, Any]]:
+        return [dict(e) for e in self._states.values()]
+
+    def firing(self) -> List[Dict[str, Any]]:
+        return [dict(e) for e in self._states.values()
+                if e['state'] == FIRING]
+
+    def note_action(self, rule_id: str, action: str,
+                    **details: Any) -> Dict[str, Any]:
+        """Journal an alert-driven control action (demote, scale-up
+        pressure) against the alert's exemplar, so `xsky alerts
+        --history` shows WHAT the page made the system do and `xsky
+        trace <exemplar>` shows WHY."""
+        entry = self._states.get(rule_id) or {}
+        event = {
+            'kind': 'action', 'rule': rule_id, 'scope': self.scope,
+            'action': action, 'ts': self._clock(),
+            'exemplar_trace_id': entry.get('exemplar_trace_id'),
+            **self._attrs, **details,
+        }
+        journal_lib.append_event(event, base=self._base)
+        if entry:
+            entry['last_action'] = action
+        return event
+
+    # -- persistence ----------------------------------------------------
+
+    def state_path(self) -> str:
+        return os.path.join(
+            journal_lib.alerts_dir(self._base),
+            f'state-{_safe_scope(self.scope)}.json')
+
+    def _persist(self, now: float) -> None:
+        payload = {'scope': self.scope, 'updated_at': now,
+                   'alerts': self.states()}
+        path = self.state_path()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _export_metrics(self) -> None:
+        try:
+            _metrics()[0].labels(scope=self.scope).set(
+                float(len(self.firing())))
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    def clear_persisted(self) -> None:
+        """Remove this scope's snapshot (a gracefully shutting-down
+        controller must not leave a firing alert rendered forever —
+        the snapshot's author is gone, nobody will resolve it)."""
+        try:
+            os.unlink(self.state_path())
+        except OSError:
+            pass
+
+
+# A snapshot whose engine stopped updating it is a corpse: nothing
+# will ever resolve its alerts. Renderers drop snapshots older than
+# this (live engines re-persist every tick, so a real long-running
+# page stays fresh).
+STATE_TTL_SECONDS = 3600.0
+
+
+def _state_ttl() -> float:
+    try:
+        return float(os.environ.get('SKYTPU_ALERTS_STATE_TTL_SECONDS',
+                                    STATE_TTL_SECONDS))
+    except (TypeError, ValueError):
+        return STATE_TTL_SECONDS
+
+
+def load_states(base: Optional[str] = None,
+                max_age: Optional[float] = None
+                ) -> List[Dict[str, Any]]:
+    """Every scope's persisted alert states under a state dir (the
+    union `xsky top` and `xsky alerts` render alongside their own
+    fresh evaluation). Unreadable/torn snapshots are skipped;
+    snapshots not refreshed within ``max_age`` (default
+    ``SKYTPU_ALERTS_STATE_TTL_SECONDS``) are dropped AND unlinked —
+    a dead engine's firing page must age out, not haunt `xsky top`
+    forever."""
+    directory = journal_lib.alerts_dir(base)
+    if max_age is None:
+        max_age = _state_ttl()
+    import time as time_mod
+    now = time_mod.time()
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith('state-') and name.endswith('.json')):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path, encoding='utf-8') as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not (isinstance(snap, dict) and
+                isinstance(snap.get('alerts'), list)):
+            continue
+        updated = snap.get('updated_at')
+        if isinstance(updated, (int, float)) and \
+                now - updated > max_age:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        out.append(snap)
+    return out
+
+
+def all_alerts(base: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Flattened alert entries across every persisted scope."""
+    out: List[Dict[str, Any]] = []
+    for snap in load_states(base):
+        out.extend(a for a in snap['alerts']
+                   if isinstance(a, dict))
+    return out

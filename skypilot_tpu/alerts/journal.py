@@ -1,0 +1,126 @@
+"""Alert journal: append-only jsonl record of every alert
+transition and every alert-driven control action.
+
+The journal is the audit trail `xsky alerts --history` renders: who
+fired, when, at what value, what the control loop did about it, and
+the exemplar trace_id that explains the page. Same durability rules
+as every jsonl surface in the tree (lifecycle registry, trace
+sinks): single ``O_APPEND`` writes, torn lines skipped on read,
+bounded by compaction (``SKYTPU_ALERTS_JOURNAL_MAX_LINES``).
+"""
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+ALERTS_SUBDIR = 'alerts'
+JOURNAL_FILE = 'journal.jsonl'
+DEFAULT_MAX_LINES = 5000
+# Compact only when the journal overgrows the cap by this slack, so
+# a steady append stream isn't rewriting the file every line.
+_COMPACT_SLACK = 256
+# Line-count checks read the whole file; run one only every N
+# appends (per process), or when the file's SIZE crosses the byte
+# gate (covers many short-lived CLI processes that each append once
+# and would never reach N).
+_COMPACT_CHECK_EVERY = 64
+_SIZE_GATE_BYTES = 2 * 1024 * 1024
+
+# One FileLock instance per path per process (filelock is reentrant
+# per INSTANCE; a fresh instance on the same path would deadlock
+# against ourselves). Appends hold it too: a bare O_APPEND racing
+# another process's compaction rewrite would land on the replaced
+# inode and silently vanish — the same race history.py documents.
+_locks: Dict[str, Any] = {}
+_append_counts: Dict[str, int] = {}
+
+
+def _lock_for(path: str):
+    lock = _locks.get(path)
+    if lock is None:
+        import filelock
+        lock = filelock.FileLock(path + '.lock')
+        _locks[path] = lock
+    return lock
+
+
+def alerts_dir(base: Optional[str] = None) -> str:
+    state_dir = os.path.expanduser(
+        base or os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state_dir, ALERTS_SUBDIR)
+
+
+def journal_path(base: Optional[str] = None) -> str:
+    return os.path.join(alerts_dir(base), JOURNAL_FILE)
+
+
+def _max_lines() -> int:
+    try:
+        return int(os.environ.get('SKYTPU_ALERTS_JOURNAL_MAX_LINES',
+                                  DEFAULT_MAX_LINES))
+    except (TypeError, ValueError):
+        return DEFAULT_MAX_LINES
+
+
+def append_event(event: Dict[str, Any],
+                 base: Optional[str] = None) -> None:
+    """Append one event (stamped with ``ts`` if absent). Never
+    raises into the caller's control loop — an unwritable state dir
+    degrades to an unjournaled transition."""
+    path = journal_path(base)
+    event = dict(event)
+    event.setdefault('ts', time.time())
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with _lock_for(path):
+            with open(path, 'a', encoding='utf-8') as f:
+                f.write(json.dumps(event,
+                                   separators=(',', ':')) + '\n')
+            count = _append_counts.get(path, 0) + 1
+            _append_counts[path] = count
+            if count % _COMPACT_CHECK_EVERY == 0 or \
+                    os.path.getsize(path) > _SIZE_GATE_BYTES:
+                _maybe_compact(path)
+    except OSError:
+        pass
+
+
+def read_events(base: Optional[str] = None,
+                limit: Optional[int] = None,
+                rule: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Events oldest-first; torn lines skipped. ``limit`` keeps the
+    newest N after filtering."""
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(journal_path(base), encoding='utf-8') as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if rule is not None and rec.get('rule') != rule:
+                    continue
+                out.append(rec)
+    except OSError:
+        return []
+    if limit is not None:
+        out = out[-limit:]
+    return out
+
+
+def _maybe_compact(path: str) -> None:
+    """Caller holds the path's file lock."""
+    cap = _max_lines()
+    try:
+        with open(path, encoding='utf-8') as f:
+            lines = f.readlines()
+        if len(lines) <= cap + _COMPACT_SLACK:
+            return
+        tmp = path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            f.writelines(lines[-cap:])
+        os.replace(tmp, path)
+    except OSError:
+        pass

@@ -1,0 +1,201 @@
+"""Declarative alert rules over the metrics history store.
+
+Four rule kinds (the Prometheus/SRE-Workbook vocabulary, sized for
+a stdlib tree):
+
+- ``threshold`` — compare a gauge's latest value (optionally a
+  histogram ``quantile`` over ``window``, optionally a ratio against
+  a ``denominator`` metric) to ``threshold`` with ``op``;
+- ``rate`` — reset-aware counter increase per second over
+  ``window`` compared to ``threshold``;
+- ``absent`` — no sample of ``metric`` appended within ``max_age``
+  (a dark agent/scraper, the inverse of every other rule);
+- ``burn_rate`` — multi-window error-budget burn (Google SRE
+  Workbook ch. 5): ``bad/total`` ratio over a long AND a short
+  window, each divided by the budget ``1 - objective``; fires only
+  when BOTH exceed ``burn_factor`` (long window = significance,
+  short window = still-happening).
+
+``evaluate`` returns ``(fire, keep, value)``: ``fire`` is the
+firing condition, ``keep`` the (hysteresis) stay-firing condition
+against ``resolve_threshold`` — a value oscillating around the
+threshold cannot flap the alert.
+"""
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu.metrics.history import HistoryStore
+
+KINDS = ('threshold', 'rate', 'absent', 'burn_rate')
+
+_OPS = {
+    '>': lambda a, b: a > b,
+    '>=': lambda a, b: a >= b,
+    '<': lambda a, b: a < b,
+    '<=': lambda a, b: a <= b,
+}
+
+
+@dataclasses.dataclass
+class AlertRule:
+    """One rule. ``id`` is stable API (kebab-case, backticked in
+    docs/observability.md — the grep lint in tests/test_trace.py
+    holds both directions)."""
+    id: str
+    kind: str
+    summary: str = ''
+    severity: str = 'warn'  # 'warn' | 'page'
+    metric: str = ''
+    labels: Optional[Dict[str, Any]] = None
+    op: str = '>'
+    threshold: float = 0.0
+    # Hysteresis: once firing, the alert resolves only when the
+    # value no longer satisfies ``op`` vs ``resolve_threshold``
+    # (defaults to ``threshold`` — no hysteresis band).
+    resolve_threshold: Optional[float] = None
+    # Pending hold: the condition must stay true this long before
+    # pending escalates to firing.
+    for_seconds: float = 60.0
+    window: float = 300.0
+    # threshold extras:
+    quantile: Optional[float] = None
+    denominator: Optional[str] = None
+    # How per-series values combine into the rule's one value:
+    # 'sum' (counters/occupancy totals), 'max' (worst-of ratios
+    # compared with '>'), 'min' (worst-of ratios compared with '<').
+    # With ``denominator`` the ratio is computed PER SERIES (labels
+    # joined) before aggregating — a ratio of sums masks the one
+    # device at 98% HBM behind seven idle ones.
+    aggregate: str = 'sum'
+    # absent:
+    max_age: float = 180.0
+    fire_if_never_seen: bool = False
+    # burn_rate:
+    objective: Optional[float] = None
+    bad_metric: str = ''
+    bad_labels: Optional[Dict[str, Any]] = None
+    total_metric: str = ''
+    total_labels: Optional[Dict[str, Any]] = None
+    long_window: float = 3600.0
+    short_window: float = 300.0
+    burn_factor: float = 14.4
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f'unknown rule kind {self.kind!r}; '
+                             f'choose from {KINDS}')
+        if self.op not in _OPS:
+            raise ValueError(f'unknown op {self.op!r}')
+        if self.severity not in ('warn', 'page'):
+            raise ValueError(f'severity must be warn|page, got '
+                             f'{self.severity!r}')
+        if self.aggregate not in ('sum', 'max', 'min'):
+            raise ValueError(
+                f'{self.id}: aggregate must be sum|max|min')
+        if self.kind == 'burn_rate':
+            if not 0.0 < (self.objective or 0.0) < 1.0:
+                raise ValueError(
+                    f'{self.id}: burn_rate needs 0 < objective < 1')
+            if not self.bad_metric or not self.total_metric:
+                raise ValueError(
+                    f'{self.id}: burn_rate needs bad_metric and '
+                    'total_metric')
+        elif not self.metric:
+            raise ValueError(f'{self.id}: rule needs a metric')
+
+    # -- evaluation -----------------------------------------------------
+
+    def evaluate(self, store: HistoryStore, now: float
+                 ) -> Tuple[bool, bool, Optional[float]]:
+        if self.kind == 'threshold':
+            value = self._threshold_value(store, now)
+        elif self.kind == 'rate':
+            # Per-series increase summed (store.window_increase), so
+            # a removed series (scaled-away replica) cannot read as
+            # a counter reset of the summed value.
+            value = 0.0 if self.window <= 0 else \
+                store.window_increase(
+                    self.metric, self.labels, window=self.window,
+                    now=now) / self.window
+        elif self.kind == 'absent':
+            return self._evaluate_absent(store, now)
+        else:  # burn_rate
+            return self._evaluate_burn(store, now)
+        if value is None:
+            # No data is NOT an alert for value rules (absent rules
+            # exist for that); an unscraped service must not page.
+            return False, False, None
+        cmp = _OPS[self.op]
+        resolve = self.threshold if self.resolve_threshold is None \
+            else self.resolve_threshold
+        return cmp(value, self.threshold), cmp(value, resolve), value
+
+    def _threshold_value(self, store: HistoryStore,
+                         now: float) -> Optional[float]:
+        if self.quantile is not None:
+            return store.window_quantile(
+                self.metric, self.quantile, self.window,
+                labels=self.labels, now=now)
+        num = store.latest_by_series(self.metric, self.labels,
+                                     window=self.window, now=now)
+        if not num:
+            return None
+        if self.denominator is None:
+            values = list(num.values())
+        else:
+            den = store.latest_by_series(
+                self.denominator, self.labels,
+                window=self.window, now=now)
+            # Ratio PER SERIES (joined on the full label set —
+            # used/limit gauges share their device/host/proc
+            # labels), then aggregate.
+            values = [v / den[lbls]
+                      for lbls, v in num.items()
+                      if den.get(lbls)]
+            if not values:
+                return None
+        if self.aggregate == 'max':
+            return max(values)
+        if self.aggregate == 'min':
+            return min(values)
+        return sum(values)
+
+    def _evaluate_absent(self, store: HistoryStore, now: float
+                         ) -> Tuple[bool, bool, Optional[float]]:
+        age = store.last_seen_age(self.metric, now=now)
+        if age is None:
+            active = self.fire_if_never_seen
+            return active, active, None
+        active = age > self.max_age
+        return active, active, age
+
+    def _burn(self, store: HistoryStore, window: float,
+              now: float) -> Optional[float]:
+        bad = store.window_increase(self.bad_metric,
+                                    self.bad_labels,
+                                    window=window, now=now)
+        total = store.window_increase(self.total_metric,
+                                      self.total_labels,
+                                      window=window, now=now)
+        if total <= 0:
+            return None  # no traffic burns no budget
+        budget = 1.0 - self.objective
+        if budget <= 0:
+            return None
+        return (bad / total) / budget
+
+    def _evaluate_burn(self, store: HistoryStore, now: float
+                       ) -> Tuple[bool, bool, Optional[float]]:
+        long_burn = self._burn(store, self.long_window, now)
+        short_burn = self._burn(store, self.short_window, now)
+        if long_burn is None or short_burn is None:
+            return False, False, long_burn
+        # Both windows must agree: the long one proves the burn is
+        # significant, the short one proves it is still happening
+        # (so a resolved incident stops paging without waiting out
+        # the long window).
+        value = min(long_burn, short_burn)
+        fire = value > self.burn_factor
+        resolve = self.resolve_threshold if \
+            self.resolve_threshold is not None else self.burn_factor
+        return fire, value > resolve, value

@@ -330,12 +330,23 @@ def show_tpus(region, name_filter):
 @click.option('--raw', is_flag=True,
               help='Emit the merged Prometheus text exposition '
                    'instead of a table (pipe-able).')
-def metrics_cmd(cluster, url, name_filter, raw):
+@click.option('--history', 'show_history', is_flag=True,
+              help='Render sparkline history from the retained '
+                   'per-cluster metrics store instead of a live '
+                   'table (each scrape also extends the store).')
+@click.option('--window', type=float, default=3600.0,
+              show_default=True,
+              help='History window in seconds (with --history).')
+def metrics_cmd(cluster, url, name_filter, raw, show_history,
+                window):
     """Aggregated cluster metrics (scraped live from every host's
     agent ``/metrics``; see docs/observability.md for the metric
     names/labels contract). With no CLUSTER, scrapes every cluster
-    tracked in the local state DB."""
+    tracked in the local state DB. Every scrape is also appended to
+    the bounded per-cluster history store; ``--history`` renders
+    that store as sparklines."""
     from skypilot_tpu import state as state_lib
+    from skypilot_tpu.metrics import history as history_lib
     from skypilot_tpu.metrics import scrape as scrape_lib
     if url is not None:
         families = scrape_lib.scrape_url(url)
@@ -347,26 +358,42 @@ def metrics_cmd(cluster, url, name_filter, raw):
     else:
         targets = [r['name'] for r in state_lib.get_clusters()]
         if not targets:
-            click.echo('No clusters.')
-            return
+            if show_history:
+                # History outlives clusters: still render whatever
+                # scopes the store retains.
+                targets = history_lib.list_scopes()
+            if not targets:
+                click.echo('No clusters.')
+                return
     if raw and len(targets) > 1:
         # One VALID exposition: merge under a cluster label instead
         # of concatenating (duplicate # TYPE lines / same-IP host
         # series across clusters would break promtool).
         merged = scrape_lib.merge_labeled(
-            [(name, scrape_lib.scrape_cluster(name))
+            [(name, scrape_lib.scrape_cluster(name,
+                                              record_history=True))
              for name in targets], 'cluster')
         click.echo(scrape_lib.render_families(merged), nl=False)
         return
     for i, name in enumerate(targets):
-        families = scrape_lib.scrape_cluster(name)
-        if raw:
-            click.echo(scrape_lib.render_families(families), nl=False)
-            continue
-        if len(targets) > 1:
+        if len(targets) > 1 and not raw:
             if i:
                 click.echo()
             click.echo(f'== {name} ==')
+        if show_history:
+            try:
+                scrape_lib.scrape_cluster(name, record_history=True)
+            except exceptions.SkyTpuError:
+                pass  # cluster gone; render retained history anyway
+            click.echo(history_lib.format_history(
+                history_lib.HistoryStore(name), name_filter,
+                window=window))
+            continue
+        families = scrape_lib.scrape_cluster(name,
+                                             record_history=True)
+        if raw:
+            click.echo(scrape_lib.render_families(families), nl=False)
+            continue
         click.echo(scrape_lib.format_families(families, name_filter))
 
 
@@ -386,6 +413,271 @@ def top_cmd(clusters, once, interval):
     from skypilot_tpu.metrics import top as top_lib
     top_lib.run(list(clusters) or None, interval=interval, once=once,
                 echo=click.echo)
+
+
+# ---------------------------------------------------------------------
+# Fleet health plane (docs/observability.md, Alerts & SLOs): evaluate
+# the built-in rule packs over live scrapes + retained history, merge
+# with every persisted alert scope, render.
+# ---------------------------------------------------------------------
+
+
+def _evaluate_alerts(cluster_names: Optional[List[str]] = None
+                     ) -> List[Dict]:
+    """One driver-side alert evaluation pass. Scrapes every target
+    cluster (recording history), this process's own registry, and
+    every known service LB; ticks the rule packs; merges with alert
+    states persisted by other engines (serve controllers, skylet)."""
+    import json as json_lib
+
+    from skypilot_tpu import alerts as alerts_lib
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu import state as state_lib
+    from skypilot_tpu.metrics import history as history_lib
+    from skypilot_tpu.metrics import scrape as scrape_lib
+    import concurrent.futures
+
+    evaluated: Dict[str, List[Dict]] = {}
+    records = state_lib.get_clusters()
+    if cluster_names:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+    try:
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        service_records = serve_state.get_services()
+    except Exception:  # pylint: disable=broad-except
+        service_records = []
+    service_records = [s for s in service_records
+                       if s.get('endpoint')]
+
+    # Scrapes run CONCURRENTLY (same reason `xsky top` does): with
+    # --watch, an evaluation pass must cost one slowest-target
+    # timeout, not the sum over every dark cluster/LB — the outage
+    # is exactly when this command is being watched.
+    def scrape_cluster_job(rec):
+        try:
+            return scrape_lib.scrape_handle(rec['handle'],
+                                            timeout=5.0)
+        except Exception:  # pylint: disable=broad-except
+            return {}
+
+    def scrape_service_job(svc):
+        try:
+            return scrape_lib.scrape_url(
+                svc['endpoint'] + '/metrics', timeout=5.0)
+        except Exception:  # pylint: disable=broad-except
+            return {}
+
+    jobs = [('cluster', rec, scrape_cluster_job)
+            for rec in records]
+    jobs += [('service', svc, scrape_service_job)
+             for svc in service_records]
+    scraped = []
+    if jobs:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(16, len(jobs))) as pool:
+            futures = [pool.submit(fn, target)
+                       for _, target, fn in jobs]
+            scraped = [f.result() for f in futures]
+
+    for (kind, target, _), families in zip(jobs, scraped):
+        if kind == 'cluster':
+            name = target['name']
+            store = history_lib.record_families(name, families)
+            engine = alerts_lib.AlertEngine(
+                store, alerts_lib.builtin.fleet_rules(),
+                scope=f'cluster-{name}', attrs={'cluster': name})
+        else:
+            name = target['name']
+            scope = f'service-{name}'
+            store = history_lib.record_families(scope, families)
+            spec = None
+            try:
+                spec = SkyServiceSpec.from_yaml_config(
+                    json_lib.loads(target['spec_json']))
+            except Exception:  # pylint: disable=broad-except
+                pass
+            engine = alerts_lib.AlertEngine(
+                store, alerts_lib.builtin.serve_rules(spec),
+                scope=scope, attrs={'service': name})
+        engine.tick()
+        evaluated[engine.scope] = engine.states()
+
+    # This driver process's own registry (breakers, watchdogs,
+    # recovery counters when run on a controller).
+    store = history_lib.HistoryStore('driver')
+    try:
+        store.append_registry(metrics_lib.registry())
+    except OSError:
+        pass
+    engine = alerts_lib.AlertEngine(
+        store, alerts_lib.builtin.fleet_rules(), scope='driver')
+    engine.tick()
+    evaluated[engine.scope] = engine.states()
+    # Persisted scopes someone else evaluates (a live serve
+    # controller's engine, the skylet's) — fresh wins on overlap.
+    out: List[Dict] = []
+    for scope, states in evaluated.items():
+        out.extend(dict(s, scope=scope) for s in states)
+    for snap in alerts_lib.load_states():
+        if snap['scope'] not in evaluated:
+            out.extend(a for a in snap['alerts']
+                       if isinstance(a, dict))
+    return out
+
+
+def _fmt_alert_rows(entries: List[Dict]) -> str:
+    if not entries:
+        return 'No alerts (no rule has ever gone pending).'
+    order = {'firing': 0, 'pending': 1, 'resolved': 2}
+    table = ux_utils.Table(['SCOPE', 'RULE', 'SEV', 'STATE', 'SINCE',
+                            'VALUE', 'EXEMPLAR', 'SUMMARY'])
+    for a in sorted(entries,
+                    key=lambda a: (order.get(a.get('state'), 9),
+                                   a.get('scope', ''),
+                                   a.get('rule', ''))):
+        since = a.get('since')
+        since_str = time.strftime('%H:%M:%S',
+                                  time.localtime(since)) \
+            if since else '-'
+        value = a.get('value')
+        exemplar = a.get('exemplar_trace_id')
+        table.add_row([
+            a.get('scope', '-'), a.get('rule', '?'),
+            a.get('severity', '-'),
+            (a.get('state') or '?').upper(), since_str,
+            '-' if value is None else f'{value:.4g}',
+            exemplar[:8] if exemplar else '-',
+            a.get('summary', ''),
+        ])
+    return table.get_string()
+
+
+@cli.command(name='alerts')
+@click.argument('clusters', nargs=-1)
+@click.option('--watch', is_flag=True,
+              help='Re-evaluate and redraw every --interval '
+                   'seconds.')
+@click.option('--interval', '-n', type=float, default=10.0,
+              show_default=True)
+@click.option('--history', 'show_history', is_flag=True,
+              help='Render the alert journal (transitions + control '
+                   'actions) instead of current states.')
+@click.option('--limit', type=int, default=50, show_default=True,
+              help='Journal entries to show (with --history).')
+def alerts_cmd(clusters, watch, interval, show_history, limit):
+    """Fleet alert states: evaluate the built-in SLO/alert rule
+    packs over live scrapes + the retained metrics history, merged
+    with alerts persisted by serve controllers and skylets. A firing
+    alert's EXEMPLAR is a trace id — feed it to `xsky trace` to see
+    the exact request behind the page. See docs/observability.md,
+    Alerts & SLOs."""
+    from skypilot_tpu import alerts as alerts_lib
+    if show_history:
+        events = alerts_lib.journal.read_events(limit=limit)
+        if not events:
+            click.echo('Alert journal is empty.')
+            return
+        table = ux_utils.Table(['TIME', 'KIND', 'SCOPE', 'RULE',
+                                'STATE/ACTION', 'VALUE', 'EXEMPLAR'])
+        for e in events:
+            exemplar = e.get('exemplar_trace_id')
+            value = e.get('value')
+            table.add_row([
+                time.strftime('%H:%M:%S',
+                              time.localtime(e.get('ts', 0))),
+                e.get('kind', '?'), e.get('scope', '-'),
+                e.get('rule', '?'),
+                e.get('state') or e.get('action') or '-',
+                '-' if value is None else f'{value:.4g}',
+                exemplar[:8] if exemplar else '-',
+            ])
+        click.echo(table.get_string())
+        return
+    while True:
+        entries = _evaluate_alerts(list(clusters) or None)
+        text = _fmt_alert_rows(entries)
+        if not watch:
+            click.echo(text)
+            return
+        click.echo('\x1b[2J\x1b[H' + text)
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return
+
+
+@cli.command(name='slo')
+@click.option('--window', type=float, default=None,
+              help='Override the accounting window in seconds '
+                   '(default: each service\'s declared slo window).')
+def slo_cmd(window):
+    """Per-service SLO report: objective, window error ratio from
+    the retained LB history, burn rate, and error budget remaining.
+    Services declare objectives in the service YAML (`service: slo:
+    {objective: 0.999}`); undeclared services report against the
+    implicit 99.9%. See docs/observability.md, Alerts & SLOs."""
+    import json as json_lib
+
+    from skypilot_tpu.metrics import history as history_lib
+    from skypilot_tpu.metrics import scrape as scrape_lib
+    try:
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        service_records = serve_state.get_services()
+    except Exception:  # pylint: disable=broad-except
+        service_records = []
+    if not service_records:
+        click.echo('No services.')
+        return
+    table = ux_utils.Table(['SERVICE', 'OBJECTIVE', 'WINDOW', 'REQS',
+                            'ERR RATIO', 'BURN', 'BUDGET LEFT'])
+    for svc in service_records:
+        name = svc['name']
+        objective, slo_window, declared = 0.999, 3600.0, False
+        try:
+            spec = SkyServiceSpec.from_yaml_config(
+                json_lib.loads(svc['spec_json']))
+            if spec.slo_objective is not None:
+                objective = spec.slo_objective
+                slo_window = spec.slo_window_seconds
+                declared = True
+        except Exception:  # pylint: disable=broad-except
+            pass
+        if window is not None:
+            slo_window = window
+        endpoint = svc.get('endpoint')
+        scope = f'service-{name}'
+        store = history_lib.HistoryStore(scope)
+        if endpoint:
+            try:
+                store.append(scrape_lib.scrape_url(
+                    endpoint + '/metrics', timeout=5.0))
+            except Exception:  # pylint: disable=broad-except
+                pass
+        # Per-series increases summed (endpoint churn must not read
+        # as counter resets of the summed value).
+        total = store.window_increase('skytpu_lb_requests_total',
+                                      window=slo_window)
+        bad = store.window_increase('skytpu_lb_requests_total',
+                                    {'code': ('prefix', '5')},
+                                    window=slo_window)
+        if total > 0:
+            ratio = bad / total
+            burn = ratio / (1.0 - objective)
+            budget_left = max(0.0, 1.0 - burn)
+            ratio_s, burn_s = f'{ratio:.5f}', f'{burn:.2f}x'
+            budget_s = f'{100.0 * budget_left:.1f}%'
+        else:
+            ratio_s = burn_s = budget_s = '-'
+        table.add_row([
+            name,
+            f'{objective:g}' + ('' if declared else ' (default)'),
+            f'{slo_window:g}s', f'{total:.0f}', ratio_s, burn_s,
+            budget_s,
+        ])
+    click.echo(table.get_string())
 
 
 @cli.command(name='profile')

@@ -32,6 +32,17 @@ MAX_RECOVERIES = int(os.environ.get('SKYTPU_JOBS_MAX_RECOVERIES',
                                     '10'))
 
 
+def _count_recovery(kind: str) -> None:
+    """Recovery accounting for the alert plane: the
+    `job-recovery-storm` built-in rule rates this counter over its
+    window (docs/observability.md, Alerts & SLOs)."""
+    from skypilot_tpu import metrics as metrics_lib
+    metrics_lib.registry().counter(
+        'skytpu_job_recoveries_total',
+        'Managed-job recovery attempts, by cause.',
+        ('kind',)).labels(kind=kind).inc()
+
+
 def archived_log_path(job_id: int) -> str:
     """Controller-local archive of the managed job's task logs."""
     base = os.path.expanduser(
@@ -334,6 +345,7 @@ class JobsController:
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
                 self._prepare_relaunch(task, idx)
+                _count_recovery('preemption')
                 with trace_lib.span('jobs.recovery',
                                     attrs={'attempt': recoveries,
                                            'kind': 'preemption'}):
@@ -371,6 +383,7 @@ class JobsController:
                         self.job_id,
                         jobs_state.ManagedJobStatus.RECOVERING)
                     self._prepare_relaunch(task, idx)
+                    _count_recovery('user_failure')
                     with trace_lib.span(
                             'jobs.recovery',
                             attrs={'attempt': restarts_on_errors,
@@ -398,6 +411,7 @@ class JobsController:
                     self.job_id,
                     jobs_state.ManagedJobStatus.RECOVERING)
                 self._prepare_relaunch(task, idx)
+                _count_recovery('driver_death')
                 with trace_lib.span('jobs.recovery',
                                     attrs={'attempt': recoveries,
                                            'kind': 'driver_death'}):
@@ -446,8 +460,24 @@ def main():
             jobs_state.ManagedJobStatus.CANCELLED:
         # Cancelled while still queued; nothing to do.
         raise SystemExit(1)
+    # Textfile bridge: this process's registry (recovery counters —
+    # the `job-recovery-storm` rule's signal) must reach the host
+    # agent's /metrics, or the counter increments in a registry no
+    # scrape ever sees. No device collector: the controller holds no
+    # accelerators and must not import jax.
+    from skypilot_tpu.metrics import publish as publish_lib
+    publisher = publish_lib.MetricsPublisher(
+        f'jobs_controller-{job_id}')
+    try:
+        publisher.publish_once()
+    except OSError:
+        pass  # unwritable metrics dir: run unpublished, not crashed
+    publisher.start()
     controller = JobsController(job_id, args.dag_yaml)
-    final = controller.run()
+    try:
+        final = controller.run()
+    finally:
+        publisher.close()
     logger.info('managed job %d finished: %s', job_id, final.value)
     raise SystemExit(
         0 if final == jobs_state.ManagedJobStatus.SUCCEEDED else 1)

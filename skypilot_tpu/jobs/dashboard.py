@@ -9,6 +9,9 @@ self-contained HTML page polling a JSON API.
 Routes:
   GET /            — HTML dashboard (auto-refreshes via fetch)
   GET /api/jobs    — jobs queue as JSON
+  GET /api/alerts  — persisted alert states under this state dir
+      (the fleet-health banner; docs/observability.md, Alerts &
+      SLOs)
   GET /metrics     — Prometheus text exposition (jobs-by-status
       gauges + whatever else this process recorded)
   POST /api/cancel?job=<id> — request cancellation (signal file,
@@ -35,9 +38,12 @@ _PAGE = """<!doctype html>
  .CANCELLED { color: #777; }
  button { font-family: inherit; }
  #updated { color: #777; font-size: 0.9em; }
+ #alerts { color: #b00; font-weight: bold; margin-bottom: 0.8em; }
+ #alerts.ok { color: #0a7d00; font-weight: normal; }
 </style></head>
 <body>
 <h2>Managed jobs</h2>
+<div id="alerts" class="ok"></div>
 <div id="updated"></div>
 <table id="jobs"><thead><tr>
  <th>ID</th><th>Name</th><th>Status</th><th>Submitted</th>
@@ -92,8 +98,26 @@ async function cancelJob(id) {
   await fetch('/api/cancel?job=' + id, {method: 'POST'});
   refresh();
 }
+async function refreshAlerts() {
+  const div = document.getElementById('alerts');
+  try {
+    const firing = (await (await fetch('/api/alerts')).json())
+        .filter(a => a.state === 'firing');
+    if (firing.length === 0) {
+      div.className = 'ok';
+      div.textContent = 'alerts: none firing';
+    } else {
+      div.className = '';
+      // textContent only — rule summaries stay un-interpolated.
+      div.textContent = 'ALERTS FIRING: ' +
+          firing.map(a => a.rule).join(', ');
+    }
+  } catch (e) { div.textContent = ''; }
+}
 refresh();
+refreshAlerts();
 setInterval(refresh, 5000);
+setInterval(refreshAlerts, 5000);
 </script>
 <p id="links"><a href="/metrics">metrics</a> — Prometheus text
 exposition of this queue (jobs by status; scrape-able)</p>
@@ -173,6 +197,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _PAGE.encode(), 'text/html; charset=utf-8')
         elif path == '/api/jobs':
             self._send(200, _jobs_json())
+        elif path == '/api/alerts':
+            from skypilot_tpu import alerts as alerts_lib
+            self._send(200,
+                       json.dumps(alerts_lib.all_alerts()).encode())
         elif path == '/metrics':
             self._send(200, _metrics_text().encode(),
                        'text/plain; version=0.0.4; charset=utf-8')

@@ -1,0 +1,543 @@
+"""On-host metrics history: a bounded, append-only time-series store.
+
+Every scrape surface in the stack is point-in-time — the driver
+scraper, the agents' ``/metrics``, `xsky top` — so nothing could
+answer "what was the 5xx rate over the last five minutes" without a
+human watching. This module is the retained half of the metrics
+plane: scrapers append each scrape's samples with a timestamp into a
+per-scope jsonl ring buffer under the state dir, and the alert rule
+engine (``skypilot_tpu/alerts/``), ``xsky slo``, and ``xsky metrics
+--history`` query it back as windows.
+
+Design constraints (mirroring ``trace/`` and ``lifecycle/``):
+
+- stdlib-only, jsonl lines, torn lines skipped on read (a process
+  dying mid-append must never corrupt the store for readers);
+- BOUNDED by construction: ``SKYTPU_METRICS_HISTORY_MAX_POINTS``
+  appends per scope and ``SKYTPU_METRICS_HISTORY_MAX_AGE_SECONDS``
+  of wall clock, enforced by compaction on append — the store can
+  never grow past its caps no matter how long the process runs;
+- DOWNSAMPLED on the way in: appends closer than
+  ``SKYTPU_METRICS_HISTORY_MIN_INTERVAL_SECONDS`` to the previous
+  one are dropped (a tight controller tick must not burn the
+  retention window in seconds);
+- multi-process safe: appends are single ``O_APPEND`` writes,
+  compaction happens under a file lock, readers take no lock.
+
+File layout: ``$SKYTPU_STATE_DIR/metrics_history/<scope>.jsonl``
+(``SKYTPU_METRICS_HISTORY_DIR`` overrides the directory), one line
+per append: ``{"ts": <unix>, "s": [[name, [[k, v], ...], value],
+...]}``. A rotated ``<scope>.jsonl.1`` (the C++ agent's simpler
+size-cap rotation) is read first when present.
+"""
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.metrics import exposition
+from skypilot_tpu.metrics import query
+
+HISTORY_SUBDIR = 'metrics_history'
+
+DEFAULT_MAX_POINTS = 720
+DEFAULT_MAX_AGE_SECONDS = 6 * 3600.0
+DEFAULT_MIN_INTERVAL_SECONDS = 0.0
+# Per-append sample cap: one scrape of a many-replica LB carries a
+# few hundred samples; a runaway-cardinality family must degrade to
+# a truncated line, not an unbounded one.
+DEFAULT_MAX_SAMPLES_PER_POINT = 4000
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def history_dir(base: Optional[str] = None) -> str:
+    if base:
+        return os.path.join(os.path.expanduser(base), HISTORY_SUBDIR)
+    override = os.environ.get('SKYTPU_METRICS_HISTORY_DIR')
+    if override:
+        return os.path.expanduser(override)
+    state_dir = os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return os.path.join(state_dir, HISTORY_SUBDIR)
+
+
+def _safe_scope(scope: str) -> str:
+    return ''.join(c if (c.isalnum() or c in '-_.') else '_'
+                   for c in scope) or '_'
+
+
+def labels_match(sample_labels: Sequence[Tuple[str, str]],
+                 want: Optional[Dict[str, Any]]) -> bool:
+    """Subset match. A wanted value may be an exact string or
+    ``('prefix', p)`` — how the 5xx rules select ``code`` label
+    values ``5..`` without a regex engine."""
+    if not want:
+        return True
+    have = dict(sample_labels)
+    for key, expect in want.items():
+        got = have.get(key)
+        if got is None:
+            return False
+        if isinstance(expect, (tuple, list)):
+            if len(expect) != 2 or expect[0] != 'prefix':
+                return False
+            if not got.startswith(str(expect[1])):
+                return False
+        elif got != str(expect):
+            return False
+    return True
+
+
+class HistoryStore:
+    """One scope's bounded history (scope = a cluster name, a
+    service, or a process role like ``driver``/``host``)."""
+
+    def __init__(self, scope: str, base: Optional[str] = None,
+                 max_points: Optional[int] = None,
+                 max_age_seconds: Optional[float] = None,
+                 min_interval_seconds: Optional[float] = None):
+        self.scope = scope
+        self._dir = history_dir(base)
+        self.path = os.path.join(self._dir,
+                                 f'{_safe_scope(scope)}.jsonl')
+        self.max_points = max_points if max_points is not None else \
+            _env_int('SKYTPU_METRICS_HISTORY_MAX_POINTS',
+                     DEFAULT_MAX_POINTS)
+        self.max_age = max_age_seconds if max_age_seconds is not None \
+            else _env_float('SKYTPU_METRICS_HISTORY_MAX_AGE_SECONDS',
+                            DEFAULT_MAX_AGE_SECONDS)
+        self.min_interval = min_interval_seconds \
+            if min_interval_seconds is not None else _env_float(
+                'SKYTPU_METRICS_HISTORY_MIN_INTERVAL_SECONDS',
+                DEFAULT_MIN_INTERVAL_SECONDS)
+        self.max_samples = _env_int(
+            'SKYTPU_METRICS_HISTORY_MAX_SAMPLES',
+            DEFAULT_MAX_SAMPLES_PER_POINT)
+        self._mutex = threading.Lock()
+        self._count: Optional[int] = None  # lazy; this writer's view
+        self._last_ts: Optional[float] = None
+        self._oldest_ts: Optional[float] = None
+        # File size after OUR last write: a mismatch on the next
+        # append means another process wrote too, and our line count
+        # is stale — recount so the caps bind across writers, not
+        # per writer.
+        self._expected_size: Optional[int] = None
+        # Parsed-file cache keyed by (size, mtime) of both
+        # generations: an alert tick evaluates many rules against
+        # one unchanged file — parse it once per change, not once
+        # per rule.
+        self._parse_cache: Optional[Tuple[tuple, list]] = None
+        # ONE FileLock instance per store (filelock is reentrant per
+        # instance, NOT per path — a fresh instance inside an
+        # already-locked section would deadlock against ourselves).
+        self._flock = None
+
+    # -- writing --------------------------------------------------------
+
+    def _file_lock(self):
+        if self._flock is None:
+            import filelock
+            os.makedirs(self._dir, exist_ok=True)
+            self._flock = filelock.FileLock(self.path + '.lock')
+        return self._flock
+
+    def _bootstrap_counts(self) -> None:
+        """First append in this process: learn the on-disk state so
+        the caps hold across restarts, not just within one run."""
+        count, last_ts, oldest = 0, None, None
+        for ts, _ in self._iter_lines():
+            count += 1
+            last_ts = ts
+            if oldest is None:
+                oldest = ts
+        self._count = count
+        self._last_ts = last_ts
+        self._oldest_ts = oldest
+        try:
+            self._expected_size = os.path.getsize(self.path)
+        except OSError:
+            self._expected_size = 0
+
+    def append(self, families: Dict[str, exposition.Series],
+               now: Optional[float] = None) -> bool:
+        """Record one scrape. Returns False when downsampled away
+        (previous append is closer than ``min_interval``)."""
+        now = time.time() if now is None else now
+        with self._mutex, self._file_lock():
+            # The file lock spans the whole write: a bare O_APPEND
+            # write racing another process's compaction (read →
+            # rewrite → os.replace) would land on the replaced inode
+            # and silently vanish.
+            try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            if self._count is None or size != self._expected_size:
+                self._bootstrap_counts()
+            if self._last_ts is not None and self.min_interval > 0 \
+                    and now - self._last_ts < self.min_interval:
+                return False
+            samples: List[List[Any]] = []
+            for series in families.values():
+                for s in series.samples:
+                    samples.append([s.name, list(s.labels), s.value])
+                    if len(samples) >= self.max_samples:
+                        break
+                if len(samples) >= self.max_samples:
+                    break
+            line = json.dumps({'ts': now, 's': samples},
+                              separators=(',', ':')) + '\n'
+            os.makedirs(self._dir, exist_ok=True)
+            with open(self.path, 'ab') as f:
+                # Self-heal a predecessor's torn final line (writer
+                # died mid-append, no newline): ours must start on a
+                # fresh line or both records are lost.
+                if f.tell() > 0:
+                    with open(self.path, 'rb') as rf:
+                        rf.seek(-1, os.SEEK_END)
+                        if rf.read(1) != b'\n':
+                            f.write(b'\n')
+                f.write(line.encode('utf-8'))
+                self._expected_size = f.tell()
+            self._count += 1
+            self._last_ts = now
+            if self._oldest_ts is None:
+                self._oldest_ts = now
+            # Caps are enforced on APPEND (both of them): the store
+            # is over-bound for at most the one line just written.
+            if self._count > self.max_points or \
+                    self._oldest_ts < now - self.max_age:
+                self._compact(now)
+        return True
+
+    def _compact_slack(self) -> int:
+        """Compaction rewrites the whole file; compacting down to
+        ``max_points - slack`` amortizes that to one rewrite per
+        ``slack`` appends instead of every append at steady state
+        (the cap itself stays strict — the file never HOLDS more
+        than max_points after an append)."""
+        return max(1, min(64, self.max_points // 10))
+
+    def append_registry(self, registry, now: Optional[float] = None
+                        ) -> bool:
+        """Snapshot a live process registry into history (the serve
+        controller's per-tick self-scrape; the skylet's)."""
+        return self.append(
+            exposition.parse_text(exposition.render_text(registry)),
+            now=now)
+
+    def _compact(self, now: float) -> None:
+        """Rewrite keeping the newest lines younger than
+        ``max_age``, compacted down past the cap by the slack.
+        Called with the mutex AND the (reentrant) file lock held."""
+        cutoff = now - self.max_age
+        with self._file_lock():
+            kept: List[str] = []
+            try:
+                with open(self.path, encoding='utf-8') as f:
+                    for raw in f:
+                        ts = _line_ts(raw)
+                        if ts is None or ts < cutoff:
+                            continue
+                        kept.append(raw if raw.endswith('\n')
+                                    else raw + '\n')
+            except OSError:
+                kept = []
+            kept = kept[-max(1, self.max_points -
+                             self._compact_slack()):]
+            tmp = self.path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                f.writelines(kept)
+            os.replace(tmp, self.path)
+            self._count = len(kept)
+            self._oldest_ts = _line_ts(kept[0]) if kept else None
+            self._expected_size = sum(len(k.encode('utf-8'))
+                                      for k in kept)
+
+    # -- reading --------------------------------------------------------
+
+    def _iter_lines(self):
+        """Yield (ts, samples_raw) for every intact line, oldest
+        first, including a rotated ``.1`` generation. Torn lines
+        (writer died mid-append) are skipped, never an error."""
+        for path in (self.path + '.1', self.path):
+            try:
+                with open(path, encoding='utf-8') as f:
+                    for raw in f:
+                        try:
+                            rec = json.loads(raw)
+                        except ValueError:
+                            continue
+                        if not isinstance(rec, dict):
+                            continue
+                        ts = rec.get('ts')
+                        if not isinstance(ts, (int, float)):
+                            continue
+                        yield float(ts), rec.get('s') or []
+            except OSError:
+                continue
+
+    def point_count(self) -> int:
+        return len(self._read_parsed())
+
+    def _read_parsed(self
+                     ) -> List[Tuple[float, List[exposition.Sample]]]:
+        """Every intact append, parsed to Samples, oldest first —
+        cached until either file generation changes on disk (rules
+        re-query the same unchanged file many times per tick)."""
+        key = []
+        for path in (self.path + '.1', self.path):
+            try:
+                st = os.stat(path)
+                key.append((st.st_size, st.st_mtime_ns))
+            except OSError:
+                key.append(None)
+        cache_key = tuple(key)
+        with self._mutex:
+            if self._parse_cache is not None and \
+                    self._parse_cache[0] == cache_key:
+                return self._parse_cache[1]
+        parsed = []
+        for ts, raw_samples in self._iter_lines():
+            samples = []
+            for item in raw_samples:
+                try:
+                    name, labels, value = item
+                    samples.append(exposition.Sample(
+                        str(name),
+                        tuple((str(k), str(v)) for k, v in labels),
+                        float(value)))
+                except (TypeError, ValueError):
+                    continue
+            parsed.append((ts, samples))
+        with self._mutex:
+            self._parse_cache = (cache_key, parsed)
+        return parsed
+
+    def points(self, window: Optional[float] = None,
+               now: Optional[float] = None
+               ) -> List[Tuple[float, List[exposition.Sample]]]:
+        """Parsed appends in the window, oldest first."""
+        now = time.time() if now is None else now
+        cutoff = None if window is None else now - window
+        return [(ts, samples)
+                for ts, samples in self._read_parsed()
+                if cutoff is None or ts >= cutoff]
+
+    def range(self, name: str,
+              labels: Optional[Dict[str, Any]] = None,
+              window: Optional[float] = None,
+              now: Optional[float] = None) -> List[query.Point]:
+        """(ts, value) per append for samples named ``name`` whose
+        labels subset-match ``labels``; several matching samples in
+        one append are SUMMED (the per-endpoint 5xx counters roll up
+        into one service-level series)."""
+        out: List[query.Point] = []
+        for ts, samples in self.points(window=window, now=now):
+            matched = [s.value for s in samples
+                       if s.name == name and
+                       labels_match(s.labels, labels)]
+            if matched:
+                out.append((ts, sum(matched)))
+        return out
+
+    def series_ranges(self, name: str,
+                      labels: Optional[Dict[str, Any]] = None,
+                      window: Optional[float] = None,
+                      now: Optional[float] = None
+                      ) -> Dict[Tuple[Tuple[str, str], ...],
+                                List[query.Point]]:
+        """Matched points grouped by FULL label set (one entry per
+        underlying series)."""
+        out: Dict[Tuple[Tuple[str, str], ...],
+                  List[query.Point]] = {}
+        for ts, samples in self.points(window=window, now=now):
+            for s in samples:
+                if s.name == name and labels_match(s.labels, labels):
+                    out.setdefault(s.labels, []).append(
+                        (ts, s.value))
+        return out
+
+    def latest_by_series(self, name: str,
+                         labels: Optional[Dict[str, Any]] = None,
+                         window: Optional[float] = None,
+                         now: Optional[float] = None
+                         ) -> Dict[Tuple[Tuple[str, str], ...],
+                                   float]:
+        """Last value in the window, per underlying series — the
+        primitive for threshold rules that must NOT sum (a ratio
+        gauge like goodput summed across hosts is meaningless; the
+        alert wants the worst series, not the total)."""
+        return {series_labels: pts[-1][1]
+                for series_labels, pts in self.series_ranges(
+                    name, labels, window=window, now=now).items()
+                if pts}
+
+    def window_increase(self, name: str,
+                        labels: Optional[Dict[str, Any]] = None,
+                        window: Optional[float] = None,
+                        now: Optional[float] = None) -> float:
+        """Counter increase over the window: reset-aware increase
+        PER SERIES, then summed — Prometheus ``sum(increase(...))``
+        semantics. Summing values first and diffing the sums would
+        misread a disappearing series (a scaled-away replica's
+        removed counter) as a reset and invent increase out of the
+        survivors' standing values."""
+        return sum(query.counter_increase(pts)
+                   for pts in self.series_ranges(
+                       name, labels, window=window, now=now).values())
+
+    def last_seen_age(self, name: str,
+                      now: Optional[float] = None) -> Optional[float]:
+        """Seconds since a sample of ``name`` (any labels) was last
+        appended; None if never seen. The staleness/absent rules'
+        primitive."""
+        now = time.time() if now is None else now
+        last = None
+        for ts, samples in self.points():
+            if any(s.name == name or
+                   s.name.startswith(name + '_') for s in samples):
+                last = ts
+        return None if last is None else now - last
+
+    def latest(self, name: str,
+               labels: Optional[Dict[str, Any]] = None,
+               window: Optional[float] = None,
+               now: Optional[float] = None) -> Optional[float]:
+        pts = self.range(name, labels, window=window, now=now)
+        return pts[-1][1] if pts else None
+
+    def window_quantile(self, family: str, q: float, window: float,
+                        labels: Optional[Dict[str, Any]] = None,
+                        now: Optional[float] = None
+                        ) -> Optional[float]:
+        """Quantile of a histogram family's observations WITHIN the
+        window: per-``le`` counter increase over the window, then the
+        bucket quantile — `p99 TTFT over the last 5 minutes`, not
+        since process start."""
+        now = time.time() if now is None else now
+        import math as _math
+        # Per-SERIES reset-aware increase, then summed per edge (a
+        # merged cluster scrape carries one series per host; the
+        # full label set — host + le — identifies the series).
+        # Feeding interleaved raw samples straight into the increase
+        # would misread every cross-series value drop as a counter
+        # reset and inflate the counts ~50x (review repro).
+        by_le: Dict[float, float] = {}
+        for series_labels, pts in self.series_ranges(
+                family + '_bucket', labels, window=window,
+                now=now).items():
+            le = dict(series_labels).get('le')
+            if le is None:
+                continue
+            edge = _math.inf if le == '+Inf' else float(le)
+            by_le[edge] = by_le.get(edge, 0.0) + \
+                query.counter_increase(pts)
+        return query.quantile_from_le_map(by_le, q)
+
+
+def list_scopes(base: Optional[str] = None) -> List[str]:
+    """Scope names with history on disk (for ``xsky metrics
+    --history`` discovery)."""
+    directory = history_dir(base)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(n[:-len('.jsonl')] for n in names
+                  if n.endswith('.jsonl'))
+
+
+def record_families(scope: str,
+                    families: Dict[str, exposition.Series],
+                    base: Optional[str] = None,
+                    now: Optional[float] = None) -> HistoryStore:
+    """One-shot convenience for scrape call sites (`xsky metrics`,
+    `xsky top`, `xsky alerts`): append and hand back the store."""
+    store = HistoryStore(scope, base=base)
+    try:
+        store.append(families, now=now)
+    except OSError:
+        pass  # unwritable state dir degrades to "not recorded"
+    return store
+
+
+# -- rendering (``xsky metrics --history``) ----------------------------
+
+_SPARK_BLOCKS = '▁▂▃▄▅▆▇█'
+
+
+def sparkline(values: Sequence[float], width: int = 40) -> str:
+    """Unicode sparkline of a value series (downsampled to ``width``
+    by taking the last value per cell — gauges' natural reading)."""
+    values = [v for v in values if v == v]  # drop NaN
+    if not values:
+        return ''
+    if len(values) > width:
+        step = len(values) / width
+        values = [values[min(len(values) - 1, int((i + 1) * step) - 1)]
+                  for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    out = []
+    for v in values:
+        idx = 0 if span <= 0 else \
+            int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+        out.append(_SPARK_BLOCKS[idx])
+    return ''.join(out)
+
+
+def format_history(store: 'HistoryStore',
+                   name_filter: Optional[str] = None,
+                   window: float = 3600.0,
+                   now: Optional[float] = None) -> str:
+    """Table of per-series sparklines over ``window`` (gauges and
+    counters; histogram bucket series are folded to their ``_count``
+    so the table stays readable)."""
+    from skypilot_tpu.utils import ux_utils
+    now = time.time() if now is None else now
+    series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                 List[query.Point]] = {}
+    for ts, samples in store.points(window=window, now=now):
+        for s in samples:
+            if s.name.endswith('_bucket') or s.name.endswith('_sum'):
+                continue
+            if name_filter and name_filter not in s.name:
+                continue
+            series.setdefault((s.name, s.labels), []).append(
+                (ts, s.value))
+    if not series:
+        return 'No history.'
+    table = ux_utils.Table(['METRIC', 'LABELS', 'POINTS', 'LAST',
+                            f'HISTORY ({window:g}s)'])
+    for (name, labels), pts in sorted(series.items()):
+        labels_str = ','.join(f'{k}={v}' for k, v in labels) or '-'
+        table.add_row([
+            name, labels_str, str(len(pts)),
+            exposition.format_value(pts[-1][1]),
+            sparkline([v for _, v in pts]),
+        ])
+    return table.get_string()
+
+
+def _line_ts(raw: str) -> Optional[float]:
+    try:
+        rec = json.loads(raw)
+        ts = rec.get('ts')
+        return float(ts) if isinstance(ts, (int, float)) else None
+    except (ValueError, AttributeError):
+        return None

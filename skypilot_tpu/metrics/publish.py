@@ -36,9 +36,22 @@ from skypilot_tpu.metrics import exposition
 
 TEXTFILE_SUBDIR = 'metrics.d'
 # A publisher ticks every PUBLISH_INTERVAL; anything not refreshed
-# within STALE_SECONDS is a dead process's leftovers.
+# within the staleness threshold is a dead process's leftovers.
 PUBLISH_INTERVAL_SECONDS = 10.0
 STALE_SECONDS = 120.0
+
+
+def stale_seconds() -> float:
+    """Textfile staleness threshold. ``SKYTPU_METRICS_TEXTFILE_
+    MAX_AGE`` overrides the 120 s default (both host agents honor
+    the same variable) — slow publishers (a train loop blocked in a
+    long compile) can be granted a longer leash without recompiling
+    anything."""
+    try:
+        return float(os.environ.get('SKYTPU_METRICS_TEXTFILE_MAX_AGE',
+                                    STALE_SECONDS))
+    except (TypeError, ValueError):
+        return STALE_SECONDS
 
 
 def textfile_dir(base: Optional[str] = None) -> str:
@@ -67,7 +80,7 @@ def render_labeled(registry,
 
 
 def read_textfiles(directory: Optional[str] = None,
-                   stale_seconds: float = STALE_SECONDS,
+                   stale_after: Optional[float] = None,
                    now: Optional[float] = None) -> str:
     """Concatenate fresh ``*.prom`` files for an agent's /metrics
     response, dropping duplicate ``# HELP``/``# TYPE`` lines (two
@@ -77,6 +90,8 @@ def read_textfiles(directory: Optional[str] = None,
     sweeps crashes."""
     directory = textfile_dir(directory)
     now = time.time() if now is None else now
+    if stale_after is None:
+        stale_after = stale_seconds()
     lines: List[str] = []
     seen_headers: set = set()
     for path in sorted(glob.glob(os.path.join(directory, '*.prom'))):
@@ -84,7 +99,7 @@ def read_textfiles(directory: Optional[str] = None,
             mtime = os.path.getmtime(path)
         except OSError:
             continue
-        if now - mtime > stale_seconds:
+        if now - mtime > stale_after:
             try:
                 os.unlink(path)
             except OSError:

@@ -1,0 +1,69 @@
+"""Shared metric-query math: histogram quantiles and counter rates.
+
+One implementation used by every consumer of scraped series — the
+``xsky top`` renderer, the alert rule engine (``skypilot_tpu/
+alerts/``), and ``xsky slo`` — so a quantile shown in `top` and a
+quantile that fires a page can never disagree about the math.
+``quantile_from_buckets`` lived in ``metrics/top.py`` first; it is
+promoted here and re-exported there for compat.
+"""
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.metrics import exposition
+
+Point = Tuple[float, float]  # (unix ts, value)
+
+
+def quantile_from_buckets(samples: Sequence[exposition.Sample],
+                          q: float) -> Optional[float]:
+    """Approximate quantile from Prometheus cumulative ``_bucket``
+    samples (possibly merged across hosts: same-``le`` buckets are
+    summed first). Returns the upper edge of the bucket holding the
+    q-th observation — the standard histogram_quantile coarseness."""
+    by_le: Dict[float, float] = {}
+    for s in samples:
+        if not s.name.endswith('_bucket'):
+            continue
+        le = dict(s.labels).get('le')
+        if le is None:
+            continue
+        edge = math.inf if le == '+Inf' else float(le)
+        by_le[edge] = by_le.get(edge, 0.0) + s.value
+    return quantile_from_le_map(by_le, q)
+
+
+def quantile_from_le_map(by_le: Dict[float, float],
+                         q: float) -> Optional[float]:
+    """Quantile from an already-aggregated {le_edge: cumulative_count}
+    map (the alert engine aggregates bucket DELTAS over a window into
+    this shape before asking for the quantile)."""
+    if not by_le:
+        return None
+    edges = sorted(by_le)
+    total = by_le[edges[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    for edge in edges:
+        if by_le[edge] >= rank:
+            return edge
+    return edges[-1]
+
+
+def counter_increase(points: Sequence[Point]) -> float:
+    """Increase of a counter over a point series, reset-aware: a
+    value DROP means the exporting process restarted, so the
+    post-reset value is all new increase (Prometheus ``increase``
+    semantics, minus the extrapolation)."""
+    if len(points) < 2:
+        return 0.0
+    total = 0.0
+    prev = points[0][1]
+    for _, value in points[1:]:
+        if value >= prev:
+            total += value - prev
+        else:  # reset: everything since zero is new
+            total += value
+        prev = value
+    return total

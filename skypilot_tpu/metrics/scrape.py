@@ -64,20 +64,29 @@ def merge_hosts(per_host: List[Tuple[str, Dict[str, exposition.Series]]]
 
 
 def scrape_cluster(cluster_name: str,
-                   timeout: float = SCRAPE_TIMEOUT_SECONDS
+                   timeout: float = SCRAPE_TIMEOUT_SECONDS,
+                   record_history: bool = False
                    ) -> Dict[str, exposition.Series]:
     """Scrape every host of ``cluster_name`` in parallel and merge.
 
     Unreachable hosts are skipped with a warning (a wedged host must
     not make the whole cluster unobservable — observability degrades
-    per-host, never whole-cluster)."""
+    per-host, never whole-cluster). ``record_history`` appends the
+    merged scrape to the cluster's driver-side history store
+    (metrics/history.py) — the CLI scrape surfaces pass it so every
+    look at a cluster also extends the retained series the alert
+    rules and ``xsky metrics --history`` query."""
     from skypilot_tpu import exceptions, state
     record = state.get_cluster_from_name(cluster_name)
     if record is None:
         raise exceptions.ClusterDoesNotExist(
             f'Cluster {cluster_name!r} does not exist.')
     handle = record['handle']
-    return scrape_handle(handle, timeout=timeout)
+    families = scrape_handle(handle, timeout=timeout)
+    if record_history:
+        from skypilot_tpu.metrics import history as history_lib
+        history_lib.record_families(cluster_name, families)
+    return families
 
 
 def scrape_handle(handle, timeout: float = SCRAPE_TIMEOUT_SECONDS

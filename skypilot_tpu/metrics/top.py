@@ -22,6 +22,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.metrics import exposition
 from skypilot_tpu.metrics import scrape
+# One histogram-quantile implementation for `top`, the alert engine,
+# and `xsky slo` (metrics/query.py); re-exported here for compat —
+# quantile_from_buckets was born in this module.
+from skypilot_tpu.metrics.query import quantile_from_buckets  # noqa: F401  pylint: disable=unused-import
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -49,34 +53,6 @@ def _sum_by_label(families, name: str, label: str
 def _max_value(families, name: str) -> Optional[float]:
     vals = [s.value for s in _samples(families, name)]
     return max(vals) if vals else None
-
-
-def quantile_from_buckets(samples: List[exposition.Sample],
-                          q: float) -> Optional[float]:
-    """Approximate quantile from Prometheus cumulative ``_bucket``
-    samples (possibly merged across hosts: same-``le`` buckets are
-    summed first). Returns the upper edge of the bucket holding the
-    q-th observation — the standard histogram_quantile coarseness."""
-    by_le: Dict[float, float] = {}
-    for s in samples:
-        if not s.name.endswith('_bucket'):
-            continue
-        le = dict(s.labels).get('le')
-        if le is None:
-            continue
-        edge = math.inf if le == '+Inf' else float(le)
-        by_le[edge] = by_le.get(edge, 0.0) + s.value
-    if not by_le:
-        return None
-    edges = sorted(by_le)
-    total = by_le[edges[-1]]
-    if total <= 0:
-        return None
-    rank = q * total
-    for edge in edges:
-        if by_le[edge] >= rank:
-            return edge
-    return edges[-1]
 
 
 # -- snapshot ----------------------------------------------------------
@@ -189,6 +165,21 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                 max_workers=min(16, len(records))) as pool:
             clusters = list(pool.map(one_cluster, records))
 
+    # Alert plane (docs/observability.md, Alerts & SLOs): the union
+    # of persisted per-scope alert states under this driver's state
+    # dir — written by `xsky alerts` evaluations and by any serve
+    # controller sharing the state tree. Feeds the ALERTS columns.
+    alert_entries: List[Dict[str, Any]] = []
+    try:
+        from skypilot_tpu import alerts as alerts_lib
+        alert_entries = alerts_lib.all_alerts()
+    except Exception:  # pylint: disable=broad-except
+        pass
+    firing = [a for a in alert_entries if a.get('state') == 'firing']
+    for cluster in clusters:
+        cluster['alerts_firing'] = sum(
+            1 for a in firing if a.get('cluster') == cluster['name'])
+
     services: List[Dict[str, Any]] = []
     try:
         from skypilot_tpu.serve import serve_state
@@ -202,6 +193,10 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                        if hasattr(svc['status'], 'value')
                        else str(svc['status'])),
             'endpoint': svc.get('endpoint'),
+            'alerts_firing': sum(
+                1 for a in firing
+                if a.get('service') == svc['name'] or
+                a.get('scope') == f'service-{svc["name"]}'),
         }
         endpoint = svc.get('endpoint')
         if endpoint:
@@ -241,6 +236,7 @@ def snapshot(cluster_names: Optional[List[str]] = None,
         'at': time.time(),
         'clusters': clusters,
         'services': services,
+        'alerts': alert_entries,
         'breakers': [{'target': t, 'state': v} for t, v in breakers],
         'watchdogs': [{'target': t, 'healthy': bool(v)}
                       for t, v in watchdogs],
@@ -280,15 +276,17 @@ def render(snap: Dict[str, Any]) -> str:
 
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
-                            'SERVE TOK/S', 'SLOTS', 'KV'])
+                            'SERVE TOK/S', 'SLOTS', 'KV', 'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
+        alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
         if cluster.get('error') or not cluster['hosts']:
             # Scrape failed outright, or every host was unreachable
             # (the scraper degrades per-host): the cluster still gets
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
-                           '-', '-', '-', '-', '-', '-', '-', '-'])
+                           '-', '-', '-', '-', '-', '-', '-', '-',
+                           alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -319,14 +317,15 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_ratio(h.get('mfu')),
                 _fmt_ratio(h.get('goodput')),
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
-                slots, kv,
+                slots, kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')
 
     if snap['services']:
         stable = ux_utils.Table(['SERVICE', 'STATUS', 'QPS',
-                                 'P50', 'P99', 'REQS', '5XX'])
+                                 'P50', 'P99', 'REQS', '5XX',
+                                 'ALERTS'])
         for s in snap['services']:
             stable.add_row([
                 s['name'], s['status'],
@@ -335,9 +334,19 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(s.get('p99_s'), '{:.3f}s'),
                 _fmt_num(s.get('requests'), '{:.0f}'),
                 _fmt_num(s.get('errors'), '{:.0f}'),
+                str(s.get('alerts_firing', 0) or '-'),
             ])
         out.append('')
         out.append(stable.get_string())
+
+    firing = [a for a in snap.get('alerts', [])
+              if a.get('state') == 'firing']
+    if firing:
+        names = ', '.join(sorted({a.get('rule', '?')
+                                  for a in firing}))
+        out.append('')
+        out.append(f'ALERTS FIRING: {len(firing)} ({names}) — '
+                   'see `xsky alerts`')
 
     if snap['breakers'] or snap['watchdogs']:
         parts = []

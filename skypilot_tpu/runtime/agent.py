@@ -293,9 +293,18 @@ def _collect_samples() -> List[Tuple[str, str, str, float]]:
 
 # Textfile-collector staleness cutoff: a compute process that
 # stopped refreshing its .prom file (crash) stops being exported.
-# Mirrors metrics/publish.STALE_SECONDS (kept literal: this file
-# must run standalone in the k8s bootstrap).
+# Mirrors metrics/publish.stale_seconds (kept literal + env-read:
+# this file must run standalone in the k8s bootstrap).
 TEXTFILE_STALE_SECONDS = 120.0
+
+
+def _textfile_stale_seconds() -> float:
+    try:
+        return float(os.environ.get(
+            'SKYTPU_METRICS_TEXTFILE_MAX_AGE',
+            TEXTFILE_STALE_SECONDS))
+    except (TypeError, ValueError):
+        return TEXTFILE_STALE_SECONDS
 
 
 def _textfile_dir() -> str:
@@ -348,7 +357,8 @@ def _read_textfiles() -> str:
             continue
         path = os.path.join(directory, name)
         try:
-            if now - os.path.getmtime(path) > TEXTFILE_STALE_SECONDS:
+            if now - os.path.getmtime(path) > \
+                    _textfile_stale_seconds():
                 # Crashed publisher: sweep so it stops haunting
                 # dashboards (a live one refreshes every ~10 s).
                 try:
@@ -371,6 +381,65 @@ def _read_textfiles() -> str:
             if line:
                 lines.append(line)
     return '\n'.join(lines) + ('\n' if lines else '')
+
+
+# On-host metrics history (docs/observability.md, Alerts & SLOs):
+# every /metrics scrape also appends this agent's own gauges to a
+# bounded jsonl history under the runtime dir, so on-host consumers
+# (skylet fleet rules, post-mortems over /read) get retained series
+# even when no driver is scraping on an interval. Pure stdlib —
+# mirrors metrics/history.py's line format ({"ts", "s": [[name,
+# labels, value], ...]}) so HistoryStore('host', base=runtime_dir)
+# reads it; the C++ agent appends the same shape.
+HISTORY_MIN_INTERVAL_SECONDS = 5.0
+HISTORY_MAX_BYTES = 4 * 1024 * 1024
+_history_last_append = 0.0
+
+
+def _history_path() -> str:
+    override = os.environ.get('SKYTPU_METRICS_HISTORY_DIR')
+    if override:
+        base = os.path.expanduser(override)
+    else:
+        runtime_dir = os.environ.get('SKYTPU_RUNTIME_DIR')
+        root = os.path.expanduser(
+            runtime_dir if runtime_dir else
+            os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+        base = os.path.join(root, 'metrics_history')
+    return os.path.join(base, 'host.jsonl')
+
+
+def _append_history(samples) -> None:
+    """Best-effort bounded append (min-interval downsample +
+    size-cap rotation to ``.1``); never fails a scrape."""
+    global _history_last_append
+    now = time.time()
+    try:
+        min_interval = float(os.environ.get(
+            'SKYTPU_METRICS_HISTORY_MIN_INTERVAL_SECONDS',
+            HISTORY_MIN_INTERVAL_SECONDS))
+    except (TypeError, ValueError):
+        min_interval = HISTORY_MIN_INTERVAL_SECONDS
+    if now - _history_last_append < min_interval:
+        return
+    path = _history_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            if os.path.getsize(path) > HISTORY_MAX_BYTES:
+                os.replace(path, path + '.1')
+        except OSError:
+            pass
+        line = json.dumps(
+            {'ts': now,
+             's': [[name, [], value]
+                   for name, _kind, _help, value in samples]},
+            separators=(',', ':'))
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(line + '\n')
+        _history_last_append = now
+    except OSError:
+        pass
 
 
 def arm_profile(steps: int) -> Dict[str, object]:
@@ -396,6 +465,7 @@ def metrics_text() -> str:
     Values are sampled at scrape time (a scrape is the only reader;
     no background sampler thread to leak)."""
     samples = _collect_samples()
+    _append_history(samples)
     if os.environ.get('SKYTPU_DEBUG', '0') == '1':
         # Debug path: persist the Chrome trace on every scrape so it
         # is retrievable (via /read) from this long-lived process,

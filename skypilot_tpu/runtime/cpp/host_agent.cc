@@ -608,7 +608,17 @@ std::string SharedDir(const char* override_env, const char* sub) {
   return ProcTable::Expand(state + "/" + sub);
 }
 
-constexpr double kTextfileStaleSeconds = 120.0;
+// Staleness cutoff for textfile metrics; SKYTPU_METRICS_TEXTFILE_
+// MAX_AGE overrides the 120 s default (same env var as the Python
+// agent and metrics/publish.stale_seconds — keep in lockstep).
+double TextfileStaleSeconds() {
+  if (const char* v = std::getenv("SKYTPU_METRICS_TEXTFILE_MAX_AGE")) {
+    char* end = nullptr;
+    double parsed = std::strtod(v, &end);
+    if (end != v && parsed > 0) return parsed;
+  }
+  return 120.0;
+}
 
 // Textfile collector (agent.py _read_textfiles): append fresh
 // metrics.d/*.prom published by compute processes (goodput/MFU/HBM/
@@ -634,7 +644,7 @@ void AppendTextfiles(std::string* out) {
     std::string path = dir + "/" + name;
     struct stat st;
     if (stat(path.c_str(), &st) != 0) continue;
-    if (now - st.st_mtime > kTextfileStaleSeconds) {
+    if (now - st.st_mtime > TextfileStaleSeconds()) {
       unlink(path.c_str());
       continue;
     }
@@ -700,6 +710,92 @@ std::string ArmProfile(int steps) {
   return dir;
 }
 
+// ---------------------------------------------------------------------
+// On-host metrics history (agent.py _append_history, the executable
+// spec): every /metrics scrape appends this agent's own gauges as
+// one jsonl line {"ts": <unix>, "s": [["name", [], value], ...]}
+// under <runtime_dir>/metrics_history/host.jsonl — the shape
+// metrics/history.HistoryStore('host', base=runtime_dir) reads.
+// Bounded: min-interval downsample + size-cap rotation to ".1".
+// ---------------------------------------------------------------------
+
+constexpr double kHistoryMinIntervalSeconds = 5.0;
+constexpr long kHistoryMaxBytes = 4 * 1024 * 1024;
+std::mutex g_history_mutex;
+double g_history_last_append = 0.0;
+
+std::string HistoryPath() {
+  if (const char* v = std::getenv("SKYTPU_METRICS_HISTORY_DIR")) {
+    if (*v != '\0')
+      return ProcTable::Expand(std::string(v) + "/host.jsonl");
+  }
+  std::string root = "~/.skypilot_tpu";
+  if (const char* rdir = std::getenv("SKYTPU_RUNTIME_DIR")) {
+    if (*rdir != '\0') root = rdir;
+  } else if (const char* sdir = std::getenv("SKYTPU_STATE_DIR")) {
+    if (*sdir != '\0') root = sdir;
+  }
+  return ProcTable::Expand(root + "/metrics_history/host.jsonl");
+}
+
+// agent_metrics is the agent-gauge portion of the exposition (no
+// textfiles): plain unlabeled `name value` lines + # comments.
+void AppendHistory(const std::string& agent_metrics) {
+  std::lock_guard<std::mutex> lock(g_history_mutex);
+  double now = std::chrono::duration<double>(
+                   std::chrono::system_clock::now().time_since_epoch())
+                   .count();
+  double min_interval = kHistoryMinIntervalSeconds;
+  if (const char* v =
+          std::getenv("SKYTPU_METRICS_HISTORY_MIN_INTERVAL_SECONDS")) {
+    char* end = nullptr;
+    double parsed = std::strtod(v, &end);
+    if (end != v && parsed >= 0) min_interval = parsed;
+  }
+  if (now - g_history_last_append < min_interval) return;
+  std::string path = HistoryPath();
+  // mkdir -p of the parent directory.
+  size_t slash = path.rfind('/');
+  if (slash != std::string::npos) {
+    std::string dir = path.substr(0, slash);
+    for (size_t i = 1; i <= dir.size(); ++i) {
+      if (i == dir.size() || dir[i] == '/') {
+        mkdir(dir.substr(0, i).c_str(), 0755);
+      }
+    }
+  }
+  struct stat st;
+  if (stat(path.c_str(), &st) == 0 && st.st_size > kHistoryMaxBytes) {
+    rename(path.c_str(), (path + ".1").c_str());
+  }
+  std::string line;
+  char head[64];
+  std::snprintf(head, sizeof(head), "{\"ts\":%.3f,\"s\":[", now);
+  line = head;
+  bool first = true;
+  std::istringstream lines(agent_metrics);
+  std::string raw;
+  while (std::getline(lines, raw)) {
+    if (raw.empty() || raw[0] == '#') continue;
+    size_t sp = raw.rfind(' ');
+    if (sp == std::string::npos) continue;
+    std::string name = raw.substr(0, sp);
+    std::string value = raw.substr(sp + 1);
+    // Agent gauges are unlabeled simple names; anything else
+    // (shouldn't happen here) is skipped rather than mis-quoted.
+    if (name.find('{') != std::string::npos) continue;
+    if (!first) line += ",";
+    first = false;
+    line += "[\"" + name + "\",[]," + value + "]";
+  }
+  line += "]}\n";
+  FILE* f = fopen(path.c_str(), "ab");
+  if (f == nullptr) return;
+  fwrite(line.data(), 1, line.size(), f);
+  fclose(f);
+  g_history_last_append = now;
+}
+
 // Prometheus text exposition: proc-table + host gauges, sampled at
 // scrape time, plus any fresh compute-process textfiles. Same metric
 // names as agent.py metrics_text (the executable spec) so the
@@ -746,6 +842,7 @@ std::string MetricsText() {
     }
     fclose(f);
   }
+  AppendHistory(out);  // agent gauges only — before the textfiles
   AppendTextfiles(&out);
   return out;
 }

@@ -85,6 +85,32 @@ def run_lifecycle_sweep(startup_base=None) -> None:
                         summary['removed_dead'], summary['live'])
 
 
+# Fleet alert plane on the skylet tick (docs/observability.md,
+# Alerts & SLOs): the lifecycle/goodput gauges this process records
+# during sweeps are snapshotted into a bounded history store and the
+# fleet rule pack (orphan reaps, recovery storms, stuck breakers...)
+# is evaluated against it — an on-host watcher with no driver in the
+# loop. Lazily constructed so the store lands under the state dir
+# run_controller_event may have re-pointed.
+_fleet_alerts = None
+
+
+def run_fleet_alert_tick() -> None:
+    global _fleet_alerts
+    from skypilot_tpu import alerts as alerts_lib
+    from skypilot_tpu import metrics as metrics_lib
+    from skypilot_tpu.metrics import history as history_lib
+    if _fleet_alerts is None:
+        store = history_lib.HistoryStore('skylet')
+        _fleet_alerts = alerts_lib.AlertEngine(
+            store, alerts_lib.builtin.fleet_rules(), scope='skylet')
+    _fleet_alerts.store.append_registry(metrics_lib.registry())
+    for event in _fleet_alerts.tick():
+        logger.warning('fleet alert %s -> %s (value=%s)',
+                       event['rule'], event['state'],
+                       event.get('value'))
+
+
 def _controller_event_loop(interval: float, startup_base) -> None:
     while True:
         try:
@@ -97,6 +123,10 @@ def _controller_event_loop(interval: float, startup_base) -> None:
             run_lifecycle_sweep(startup_base)
         except Exception:  # pylint: disable=broad-except
             logger.exception('lifecycle sweep failed')
+        try:
+            run_fleet_alert_tick()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('fleet alert tick failed')
         time.sleep(interval)
 
 

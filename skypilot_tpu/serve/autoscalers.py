@@ -94,6 +94,12 @@ class Autoscaler:
         # fallback so a controller without an instrumented LB (or
         # older tests) keeps scaling on drained timestamps.
         self._qps_source: Optional[Any] = None
+        # Alert-driven scale-up pressure (docs/observability.md,
+        # Alerts & SLOs): while a burn-rate/5xx page is firing, the
+        # effective target gets one extra replica on top of the QPS
+        # policy — user-visible errors mean the measured QPS already
+        # under-counts the demand the fleet is shedding.
+        self._alert_pressure = False
 
     def set_qps_source(self, qps_fn) -> None:
         """``qps_fn() -> float``: measured requests/sec over the
@@ -101,6 +107,27 @@ class Autoscaler:
         The declared ``target_qps_per_replica`` stays what it says —
         a per-replica target, not an assumed load."""
         self._qps_source = qps_fn
+
+    def set_alert_pressure(self, firing: bool) -> None:
+        """Arm/clear alert pressure. Idempotent per tick — the serve
+        controller sets it from the union of firing page alerts."""
+        self._alert_pressure = bool(firing)
+        metrics_lib.registry().gauge(
+            'skytpu_autoscaler_alert_pressure',
+            'Whether a firing alert is adding scale-up pressure.'
+        ).set(1.0 if self._alert_pressure else 0.0)
+
+    def effective_target(self) -> int:
+        """Policy target plus alert pressure, bounded by the spec's
+        max — hysteresis state (`target_num_replicas`) is never
+        mutated, so pressure releasing cleanly returns the fleet to
+        the policy target."""
+        target = self.target_num_replicas
+        if self._alert_pressure:
+            target = min(self.spec.max_replicas
+                         if self.spec.max_replicas else target,
+                         target + 1)
+        return target
 
     def collect_request_information(self, request_ts: List[float]
                                     ) -> None:
@@ -120,7 +147,7 @@ class Autoscaler:
         replicas in one step."""
         nonterm = _nonterminal(records)
         self.evaluate_scaling(len(_ready(records)), now)
-        delta = self.target_num_replicas - len(nonterm)
+        delta = self.effective_target() - len(nonterm)
         if delta > 0:
             return [ScalingOp(AutoscalerDecisionOperator.SCALE_UP,
                               count=delta)]
@@ -241,7 +268,7 @@ class _SpotMixOps:
     def _mix_ops(self, records: List[Dict[str, Any]]
                  ) -> List[ScalingOp]:
         spec = self.spec  # type: ignore[attr-defined]
-        target = self.target_num_replicas  # type: ignore[attr-defined]
+        target = self.effective_target()  # type: ignore[attr-defined]
         base = min(spec.base_ondemand_fallback_replicas, target)
         want_spot = target - base
         nonterm = _nonterminal(records)

@@ -7,8 +7,13 @@ import json
 import os
 import threading
 import time
+from typing import Set
 
+from skypilot_tpu import alerts as alerts_lib
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
+from skypilot_tpu.metrics import history as history_lib
+from skypilot_tpu.metrics import query as query_lib
 from skypilot_tpu.resilience import watchdog as watchdog_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.autoscalers import (AutoscalerDecisionOperator,
@@ -51,6 +56,24 @@ class SkyServeController:
         self.watchdog = watchdog_lib.HealthWatchdog(
             name=f'serve-{service_name}-watchdog')
         self.watchdog.on_unhealthy(self._on_replica_unhealthy)
+        # Alert plane (docs/observability.md, Alerts & SLOs): every
+        # control tick snapshots this process's registry (LB traffic,
+        # probe failures, batching) into the service's history store
+        # and evaluates the serve rule pack — incl. the burn-rate
+        # page when the spec declares an `slo:` objective. Firing
+        # alerts feed back into control: replica alerts demote, page
+        # alerts add autoscaler pressure.
+        self._alert_store = history_lib.HistoryStore(
+            f'service-{service_name}')
+        self._alert_engine = alerts_lib.AlertEngine(
+            self._alert_store,
+            alerts_lib.builtin.serve_rules(self.spec),
+            scope=f'service-{service_name}',
+            exemplar_fn=self.load_balancer.recent_error_exemplar,
+            attrs={'service': service_name})
+        # Replicas already demoted for the CURRENT firing episode —
+        # one demote per episode, not one per tick.
+        self._alert_demoted: Set[int] = set()
 
     def start(self) -> None:
         serve_state.set_service_status(self.service_name,
@@ -150,9 +173,75 @@ class SkyServeController:
         old_target = self.autoscaler.target_num_replicas
         self.autoscaler = make_autoscaler(self.spec)
         self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
+        # The new version may declare a different SLO.
+        self._alert_engine.rules = \
+            alerts_lib.builtin.serve_rules(self.spec)
         self.autoscaler.target_num_replicas = max(
             min(old_target, self.spec.max_replicas
                 or old_target), self.spec.min_replicas)
+
+    # -- alert-driven control -------------------------------------------
+
+    def _alert_tick(self, records) -> None:
+        """One alert-plane pass: record history, evaluate rules,
+        and CONSUME firing alerts — the control loop the alerts
+        exist for. Never raises into the control tick."""
+        try:
+            self._alert_store.append_registry(metrics_lib.registry())
+            self._alert_engine.tick()
+            firing = {a['rule'] for a in self._alert_engine.firing()}
+            if 'replica-probe-errors' in firing:
+                self._demote_offenders(records)
+            else:
+                self._alert_demoted.clear()
+            # A page means users see errors: treat it as scale-up
+            # pressure on top of the measured QPS (which undercounts
+            # demand the fleet is shedding).
+            pressure = bool(firing & {'slo-burn-rate',
+                                      'replica-5xx-rate',
+                                      'lb-no-ready-replica'})
+            was = getattr(self.autoscaler, '_alert_pressure', False)
+            self.autoscaler.set_alert_pressure(pressure)
+            if pressure and not was:
+                rule = next(iter(sorted(
+                    firing & {'slo-burn-rate', 'replica-5xx-rate',
+                              'lb-no-ready-replica'})))
+                self._alert_engine.note_action(
+                    rule, 'scale-up-pressure')
+                logger.warning(
+                    'Alert %s firing: adding autoscaler scale-up '
+                    'pressure.', rule)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('alert tick failed')
+
+    def _demote_offenders(self, records) -> None:
+        """`replica-probe-errors` is firing: mark every replica
+        whose OWN failure counter moved in the rule window suspect
+        (next failed probe demotes immediately), once per episode,
+        journaling the demote with the alert's exemplar trace."""
+        window = next((r.window for r in self._alert_engine.rules
+                       if r.id == 'replica-probe-errors'), 120.0)
+        for rec in records:
+            rid = rec['replica_id']
+            if rid in self._alert_demoted:
+                continue
+            if rec['status'] not in (ReplicaStatus.READY,
+                                     ReplicaStatus.NOT_READY):
+                continue
+            increase = query_lib.counter_increase(
+                self._alert_store.range(
+                    'skytpu_serve_probe_failures_total',
+                    {'replica': str(rid)}, window=window))
+            if increase <= 0:
+                continue
+            self._alert_demoted.add(rid)
+            self.replica_manager.mark_suspect(rid)
+            event = self._alert_engine.note_action(
+                'replica-probe-errors', 'demote', replica=rid)
+            logger.warning(
+                'Alert replica-probe-errors firing: demoting '
+                'replica %d (exemplar trace %s).', rid,
+                event.get('exemplar_trace_id') or '-')
 
     def run_once(self) -> None:
         """One control tick: probe replicas, feed QPS to the
@@ -172,6 +261,7 @@ class SkyServeController:
         self._check_for_update()
         records = self.replica_manager.probe_all()
         self._sync_watchdog_targets(records)
+        self._alert_tick(records)
         old_alive = [r for r in records
                      if r['version'] < self.version and
                      not r['status'].is_terminal() and
@@ -260,6 +350,9 @@ class SkyServeController:
         for target in self.watchdog.targets():
             self.watchdog.remove_target(target)
         self.watchdog.stop()
+        # This engine is the snapshot's only author; a service going
+        # down must not leave a firing alert rendered forever.
+        self._alert_engine.clear_persisted()
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.SHUTTING_DOWN)
         self.replica_manager.terminate_all()

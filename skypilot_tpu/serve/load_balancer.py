@@ -11,6 +11,7 @@ metrics registry (per-endpoint counts, errors, latency histograms —
 serves its own ``GET /metrics`` (reserved path, never proxied) and
 ``measured_qps()`` feeds the autoscaler the MEASURED load.
 """
+import collections
 import threading
 import time
 import urllib.error
@@ -152,6 +153,28 @@ class SkyServeLoadBalancer:
             'after a replica fault (labeled by the FAILED replica).',
             ('endpoint',))
         self._qps_window = metrics_lib.WindowedRate(QPS_WINDOW_SECONDS)
+        # Recent ERROR request exemplars: (wall ts, trace_id). The
+        # alert engine stamps the newest one onto a firing alert so
+        # `xsky trace <id>` shows the exact request behind the page.
+        self._error_exemplars: collections.deque = \
+            collections.deque(maxlen=16)
+
+    def _note_error_exemplar(self, span) -> None:
+        ctx = getattr(span, 'context', None)
+        if ctx is not None:
+            self._error_exemplars.append((time.time(), ctx.trace_id))
+
+    def recent_error_exemplar(self,
+                              max_age: float = 600.0
+                              ) -> Optional[str]:
+        """trace_id of the newest errored LB request (None when no
+        recent error was traced)."""
+        if not self._error_exemplars:
+            return None
+        ts, trace_id = self._error_exemplars[-1]
+        if time.time() - ts > max_age:
+            return None
+        return trace_id
 
     def measured_qps(self) -> float:
         """MEASURED request rate over the trailing window — the
@@ -239,6 +262,7 @@ class SkyServeLoadBalancer:
                     lb._m_no_replica.inc()  # pylint: disable=protected-access
                     req_span.set_attr('code', '503')
                     req_span.status = 'ERROR'
+                    lb._note_error_exemplar(req_span)  # pylint: disable=protected-access
                     body = b'No ready replicas.'
                     self.send_response(503)
                     self.send_header('Content-Length',
@@ -305,6 +329,11 @@ class SkyServeLoadBalancer:
                         req_span.set_attr('endpoint', current)
                         req_span.set_attr('code',
                                           str(self._resp_status))
+                        if (self._resp_status or 0) >= 500:
+                            # A replica's own 5xx is an alertable
+                            # error too — the 5xx-rate page wants
+                            # this request as its exemplar.
+                            lb._note_error_exemplar(req_span)  # pylint: disable=protected-access
                         return
                     except (urllib.error.URLError, OSError) as e:
                         # Attribution: URLError (incl. HTTP-layer
@@ -338,6 +367,7 @@ class SkyServeLoadBalancer:
                                 req_span.set_attr(
                                     'code', str(self._resp_status))
                             req_span.status = 'ERROR'
+                            lb._note_error_exemplar(req_span)  # pylint: disable=protected-access
                             self.close_connection = True
                             try:
                                 self.wfile.flush()
@@ -383,6 +413,7 @@ class SkyServeLoadBalancer:
                         req_span.set_attr('endpoint', current)
                         req_span.set_attr('code', '502')
                         req_span.status = 'ERROR'
+                        lb._note_error_exemplar(req_span)  # pylint: disable=protected-access
                         body = f'Replica error: {e}'.encode()
                         try:
                             self.send_response(502)

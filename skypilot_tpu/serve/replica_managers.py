@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Set
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, execution, state
+from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.resilience import faults
 from skypilot_tpu.serve import serve_state
@@ -73,6 +74,17 @@ class ReplicaManager:
         self._fail_counts: Dict[int, int] = {}
         self._ok_counts: Dict[int, int] = {}
         self._suspect: Set[int] = set()
+        # Probe-health series: the alert plane's raw signal
+        # (docs/observability.md, Alerts & SLOs). The failure counter
+        # is per-replica so the controller's alert consumer can name
+        # the offending replica when a probe-error alert fires.
+        reg = metrics_lib.registry()
+        self._m_probe_failures = reg.counter(
+            'skytpu_serve_probe_failures_total',
+            'Failed readiness probes, by replica.', ('replica',))
+        self._m_ready = reg.gauge(
+            'skytpu_serve_replicas_ready',
+            'Replicas currently READY.')
         # Local-provider port allocation: each replica gets its own
         # service port (one machine hosts all fake replicas).
         from skypilot_tpu import clouds
@@ -218,6 +230,10 @@ class ReplicaManager:
         self._fail_counts.pop(replica_id, None)
         self._ok_counts.pop(replica_id, None)
         self._suspect.discard(replica_id)
+        # A scaled-away replica stops exporting its failure series
+        # (the registry's series-removal contract — a dead replica's
+        # last count must not keep feeding the alert rules).
+        self._m_probe_failures.remove(str(replica_id))
 
     def probe(self, endpoint: str,
               spec: Optional[SkyServiceSpec] = None) -> bool:
@@ -296,7 +312,11 @@ class ReplicaManager:
         for rec, spec in candidates:
             self._account_probe(rec, spec,
                                 results[rec['replica_id']])
-        return serve_state.get_replicas(self.service_name)
+        records = serve_state.get_replicas(self.service_name)
+        self._m_ready.set(float(sum(
+            1 for r in records
+            if r['status'] == ReplicaStatus.READY)))
+        return records
 
     def _account_probe(self, rec: Dict, spec: SkyServiceSpec,
                        ready: bool) -> None:
@@ -319,6 +339,7 @@ class ReplicaManager:
         self._ok_counts.pop(rid, None)
         fails = self._fail_counts.get(rid, 0) + 1
         self._fail_counts[rid] = fails
+        self._m_probe_failures.labels(str(rid)).inc()
         suspect = rid in self._suspect
         threshold_hit = suspect or fails >= _demote_after()
         grace = time.time() - (rec['launched_at'] or 0) < \

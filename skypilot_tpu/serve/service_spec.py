@@ -27,6 +27,8 @@ class SkyServiceSpec:
         dynamic_ondemand_fallback: bool = False,
         tls_keyfile: Optional[str] = None,
         tls_certfile: Optional[str] = None,
+        slo_objective: Optional[float] = None,
+        slo_window_seconds: float = 3600.0,
     ):
         if min_replicas < 0:
             raise exceptions.InvalidSpecError('min_replicas must be '
@@ -67,6 +69,19 @@ class SkyServiceSpec:
                 'tls requires both keyfile and certfile.')
         self.tls_keyfile = tls_keyfile
         self.tls_certfile = tls_certfile
+        # SLO objective (docs/observability.md, Alerts & SLOs): a
+        # declared availability target arms a multi-window burn-rate
+        # page in the serve controller's alert engine and is what
+        # `xsky slo` reports error budget against.
+        if slo_objective is not None and \
+                not 0.0 < slo_objective < 1.0:
+            raise exceptions.InvalidSpecError(
+                'slo.objective must be in (0, 1), e.g. 0.999')
+        if slo_window_seconds <= 0:
+            raise exceptions.InvalidSpecError(
+                'slo.window_seconds must be > 0')
+        self.slo_objective = slo_objective
+        self.slo_window_seconds = float(slo_window_seconds)
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]
@@ -83,6 +98,7 @@ class SkyServiceSpec:
             policy.setdefault('min_replicas', replicas)
         port = config.pop('port', 8080)
         tls = dict(config.pop('tls', {}) or {})
+        slo = dict(config.pop('slo', {}) or {})
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown service fields: {sorted(config)}')
@@ -108,6 +124,8 @@ class SkyServiceSpec:
                 'dynamic_ondemand_fallback', False),
             tls_keyfile=tls.get('keyfile'),
             tls_certfile=tls.get('certfile'),
+            slo_objective=slo.get('objective'),
+            slo_window_seconds=slo.get('window_seconds', 3600.0),
         )
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -137,4 +155,7 @@ class SkyServiceSpec:
         if self.tls_keyfile:
             out['tls'] = {'keyfile': self.tls_keyfile,
                           'certfile': self.tls_certfile}
+        if self.slo_objective is not None:
+            out['slo'] = {'objective': self.slo_objective,
+                          'window_seconds': self.slo_window_seconds}
         return out

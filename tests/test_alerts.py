@@ -1,0 +1,985 @@
+"""Fleet health plane tests (docs/observability.md, Alerts & SLOs).
+
+Covers the ISSUE 9 contract end to end:
+
+- metrics history: bounded ring-buffer semantics — retention caps,
+  downsampling, torn-line skip, label/prefix matching, reset-aware
+  counter math, windowed histogram quantiles;
+- rule kinds: threshold (+hysteresis, quantile, ratio), rate,
+  absent, multi-window burn-rate — all under a fake clock;
+- engine state machine: pending hold, pending cancel,
+  firing→resolved hysteresis, journal round-trip, persistence +
+  resume across engine instances;
+- SLO declaration in the service spec YAML;
+- autoscaler alert pressure;
+- the e2e acceptance: with SKYTPU_FAULTS=serve.probe:error:1.0
+  armed, the replica-error alert walks pending→firing→resolved in a
+  REAL in-process serve controller, drives a demote carrying an
+  exemplar trace_id from the offending LB span, is visible via
+  `xsky alerts` and the `xsky top` ALERTS column, and the history
+  store stays under its configured retention bound throughout.
+"""
+import http.server
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.alerts import builtin as builtin_rules
+from skypilot_tpu.alerts import engine as engine_lib
+from skypilot_tpu.alerts import journal as journal_lib
+from skypilot_tpu.alerts.rules import AlertRule
+from skypilot_tpu.metrics import exposition
+from skypilot_tpu.metrics import query
+from skypilot_tpu.metrics.history import (HistoryStore, labels_match,
+                                          sparkline)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _fams(text: str):
+    return exposition.parse_text(text)
+
+
+class FakeClock:
+
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------
+# History store
+# ---------------------------------------------------------------------
+
+
+class TestHistoryStore:
+
+    def test_append_and_range(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        clock = FakeClock()
+        for v in (1.0, 2.0, 5.0):
+            store.append(_fams(f'skytpu_x_total {v}\n'),
+                         now=clock.advance(10))
+        pts = store.range('skytpu_x_total', window=100, now=clock.t)
+        assert [v for _, v in pts] == [1.0, 2.0, 5.0]
+        assert query.counter_increase(pts) == 4.0
+
+    def test_window_excludes_old_points(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        clock = FakeClock()
+        store.append(_fams('skytpu_x_total 1\n'), now=clock.t)
+        store.append(_fams('skytpu_x_total 2\n'),
+                     now=clock.advance(100))
+        pts = store.range('skytpu_x_total', window=50, now=clock.t)
+        assert [v for _, v in pts] == [2.0]
+
+    def test_max_points_retention_bound(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path), max_points=7)
+        clock = FakeClock()
+        for i in range(40):
+            store.append(_fams(f'skytpu_x_total {i}\n'),
+                         now=clock.advance(1))
+            # The bound holds THROUGHOUT, not just at the end.
+            assert store.point_count() <= 7
+        vals = [v for _, v in store.range('skytpu_x_total',
+                                          now=clock.t)]
+        # Compaction keeps a contiguous newest suffix (the exact
+        # length varies by the amortization slack, never over cap).
+        assert vals == [float(v) for v in
+                        range(40 - len(vals), 40)]
+        assert len(vals) >= store.max_points - \
+            store._compact_slack()  # pylint: disable=protected-access
+
+    def test_series_removal_is_not_an_increase(self, tmp_path):
+        """Regression (review finding): a labeled series vanishing
+        (a scaled-away replica's pruned failure counter) must not
+        read as a counter reset of the summed value — that invented
+        failures out of the survivors' standing counts and paged on
+        routine scale-downs."""
+        store = HistoryStore('s', base=str(tmp_path))
+        both = ('skytpu_serve_probe_failures_total{replica="1"} 5\n'
+                'skytpu_serve_probe_failures_total{replica="2"} 3\n')
+        only2 = 'skytpu_serve_probe_failures_total{replica="2"} 3\n'
+        store.append(_fams(both), now=1000.0)
+        store.append(_fams(both), now=1010.0)
+        store.append(_fams(only2), now=1020.0)  # replica 1 removed
+        assert store.window_increase(
+            'skytpu_serve_probe_failures_total', window=100,
+            now=1021.0) == 0.0
+        rule = AlertRule(id='replica-probe-errors', kind='rate',
+                         metric='skytpu_serve_probe_failures_total',
+                         threshold=0.0, op='>', window=100,
+                         for_seconds=0)
+        assert rule.evaluate(store, 1021.0)[0] is False
+        # A REAL reset within one surviving series still counts.
+        store.append(_fams(
+            'skytpu_serve_probe_failures_total{replica="2"} 1\n'),
+            now=1030.0)
+        assert store.window_increase(
+            'skytpu_serve_probe_failures_total', window=100,
+            now=1031.0) == 1.0
+
+    def test_max_age_retention(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path), max_points=5,
+                             max_age_seconds=100.0)
+        clock = FakeClock()
+        store.append(_fams('skytpu_x_total 1\n'), now=clock.t)
+        for _ in range(6):  # overflow max_points → compaction runs
+            store.append(_fams('skytpu_x_total 2\n'),
+                         now=clock.advance(200))
+        ages = [ts for ts, _ in store.range('skytpu_x_total',
+                                            now=clock.t)]
+        assert all(clock.t - ts <= 100.0 for ts in ages)
+
+    def test_env_caps_respected(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_METRICS_HISTORY_MAX_POINTS', '3')
+        store = HistoryStore('s', base=str(tmp_path))
+        assert store.max_points == 3
+        clock = FakeClock()
+        for i in range(10):
+            store.append(_fams(f'skytpu_x_total {i}\n'),
+                         now=clock.advance(1))
+        assert store.point_count() <= 3
+
+    def test_downsample_min_interval(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path),
+                             min_interval_seconds=10.0)
+        clock = FakeClock()
+        assert store.append(_fams('skytpu_x_total 1\n'), now=clock.t)
+        # Too close to the previous append: dropped.
+        assert not store.append(_fams('skytpu_x_total 2\n'),
+                                now=clock.advance(5))
+        assert store.append(_fams('skytpu_x_total 3\n'),
+                            now=clock.advance(6))
+        assert store.point_count() == 2
+
+    def test_torn_line_skipped(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        clock = FakeClock()
+        store.append(_fams('skytpu_x_total 1\n'), now=clock.t)
+        with open(store.path, 'a', encoding='utf-8') as f:
+            f.write('{"ts": 123, "s": [["skytpu_x_to')  # torn
+        store.append(_fams('skytpu_x_total 2\n'),
+                     now=clock.advance(1))
+        assert store.point_count() == 2
+        assert [v for _, v in store.range('skytpu_x_total',
+                                          now=clock.t)] == [1.0, 2.0]
+
+    def test_label_subset_and_prefix_match(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        text = ('skytpu_lb_requests_total{endpoint="a",code="200"} 7\n'
+                'skytpu_lb_requests_total{endpoint="a",code="502"} 3\n'
+                'skytpu_lb_requests_total{endpoint="b",code="503"} 2\n')
+        store.append(_fams(text), now=1000.0)
+        # Subset match + summing across matched samples.
+        pts = store.range('skytpu_lb_requests_total',
+                          {'code': ('prefix', '5')}, now=1001.0)
+        assert pts == [(1000.0, 5.0)]
+        pts = store.range('skytpu_lb_requests_total',
+                          {'endpoint': 'a'}, now=1001.0)
+        assert pts == [(1000.0, 10.0)]
+        assert labels_match((('a', 'x'),), None)
+        assert not labels_match((('code', '404'),),
+                                {'code': ('prefix', '5')})
+
+    def test_counter_reset_awareness(self):
+        # A restart (value drop) adds the post-reset value, never a
+        # negative increase.
+        pts = [(1.0, 100.0), (2.0, 110.0), (3.0, 5.0), (4.0, 8.0)]
+        assert query.counter_increase(pts) == 10.0 + 5.0 + 3.0
+
+    def test_window_quantile(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        # Two appends of cumulative buckets; the window delta holds
+        # 10 obs ≤0.1 and 10 more ≤1.0 → p50=0.1, p99=1.0.
+        t0 = ('skytpu_batch_ttft_seconds_bucket{le="0.1"} 0\n'
+              'skytpu_batch_ttft_seconds_bucket{le="1.0"} 0\n'
+              'skytpu_batch_ttft_seconds_bucket{le="+Inf"} 0\n')
+        t1 = ('skytpu_batch_ttft_seconds_bucket{le="0.1"} 10\n'
+              'skytpu_batch_ttft_seconds_bucket{le="1.0"} 20\n'
+              'skytpu_batch_ttft_seconds_bucket{le="+Inf"} 20\n')
+        store.append(_fams(t0), now=1000.0)
+        store.append(_fams(t1), now=1010.0)
+        assert store.window_quantile('skytpu_batch_ttft_seconds',
+                                     0.5, 100, now=1011.0) == 0.1
+        assert store.window_quantile('skytpu_batch_ttft_seconds',
+                                     0.99, 100, now=1011.0) == 1.0
+
+    def test_last_seen_age(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        assert store.last_seen_age('skytpu_agent_uptime_seconds',
+                                   now=50.0) is None
+        store.append(_fams('skytpu_agent_uptime_seconds 5\n'),
+                     now=1000.0)
+        assert store.last_seen_age('skytpu_agent_uptime_seconds',
+                                   now=1030.0) == pytest.approx(30.0)
+
+    def test_sparkline(self):
+        assert sparkline([]) == ''
+        line = sparkline([0, 1, 2, 3])
+        assert len(line) == 4
+        assert line[0] == '▁' and line[-1] == '█'
+        assert len(sparkline(list(range(200)), width=40)) == 40
+
+
+# ---------------------------------------------------------------------
+# Rule kinds (fake clock)
+# ---------------------------------------------------------------------
+
+
+class TestRuleKinds:
+
+    def test_threshold_with_hysteresis_band(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='goodput-ratio-drop', kind='threshold',
+                         metric='skytpu_goodput_ratio', op='<',
+                         threshold=0.5, resolve_threshold=0.6,
+                         window=100, for_seconds=0)
+        store.append(_fams('skytpu_goodput_ratio 0.8\n'), now=10.0)
+        fire, keep, value = rule.evaluate(store, 11.0)
+        assert (fire, keep, value) == (False, False, 0.8)
+        store.append(_fams('skytpu_goodput_ratio 0.4\n'), now=20.0)
+        fire, keep, _ = rule.evaluate(store, 21.0)
+        assert fire and keep
+        # In the hysteresis band: does not (re)fire, but keeps an
+        # already-firing alert firing.
+        store.append(_fams('skytpu_goodput_ratio 0.55\n'), now=30.0)
+        fire, keep, _ = rule.evaluate(store, 31.0)
+        assert not fire and keep
+        store.append(_fams('skytpu_goodput_ratio 0.7\n'), now=40.0)
+        fire, keep, _ = rule.evaluate(store, 41.0)
+        assert not fire and not keep
+
+    def test_threshold_no_data_is_not_active(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='goodput-ratio-drop', kind='threshold',
+                         metric='skytpu_goodput_ratio', op='<',
+                         threshold=0.5)
+        assert rule.evaluate(store, 1.0) == (False, False, None)
+
+    def test_threshold_ratio_denominator(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='hbm-headroom-low', kind='threshold',
+                         metric='skytpu_device_hbm_used_bytes',
+                         denominator='skytpu_device_hbm_limit_bytes',
+                         op='>', threshold=0.92, window=100)
+        store.append(_fams('skytpu_device_hbm_used_bytes 95\n'
+                           'skytpu_device_hbm_limit_bytes 100\n'),
+                     now=10.0)
+        fire, _, value = rule.evaluate(store, 11.0)
+        assert fire and value == pytest.approx(0.95)
+
+    def test_ratio_aggregated_per_series_not_of_sums(self,
+                                                     tmp_path):
+        """Regression (review finding): one device at 98% HBM among
+        idle neighbors must page — a ratio of SUMS averages the OOM
+        risk away."""
+        store = HistoryStore('s', base=str(tmp_path))
+        text = ('skytpu_device_hbm_used_bytes{device="0"} 98\n'
+                'skytpu_device_hbm_used_bytes{device="1"} 50\n'
+                'skytpu_device_hbm_limit_bytes{device="0"} 100\n'
+                'skytpu_device_hbm_limit_bytes{device="1"} 100\n')
+        store.append(_fams(text), now=10.0)
+        rule = AlertRule(id='hbm-headroom-low', kind='threshold',
+                         metric='skytpu_device_hbm_used_bytes',
+                         denominator='skytpu_device_hbm_limit_bytes',
+                         op='>', threshold=0.92, aggregate='max',
+                         window=100, for_seconds=0)
+        fire, _, value = rule.evaluate(store, 11.0)
+        assert fire and value == pytest.approx(0.98)
+
+    def test_gauge_min_aggregate_catches_worst_host(self, tmp_path):
+        """Regression (review finding): goodput ratios summed across
+        hosts could never drop below a per-host threshold; `min`
+        pages on the worst host's collapse."""
+        store = HistoryStore('s', base=str(tmp_path))
+        store.append(_fams(
+            'skytpu_goodput_ratio{host="a"} 0.05\n'
+            'skytpu_goodput_ratio{host="b"} 0.9\n'), now=10.0)
+        rule = AlertRule(id='goodput-ratio-drop', kind='threshold',
+                         metric='skytpu_goodput_ratio', op='<',
+                         threshold=0.5, aggregate='min',
+                         window=100, for_seconds=0)
+        fire, _, value = rule.evaluate(store, 11.0)
+        assert fire and value == pytest.approx(0.05)
+        # The shipped pack uses these aggregations.
+        pack = {r.id: r for r in builtin_rules.fleet_rules()}
+        assert pack['goodput-ratio-drop'].aggregate == 'min'
+        assert pack['hbm-headroom-low'].aggregate == 'max'
+        assert pack['breaker-stuck-open'].aggregate == 'max'
+
+    def test_rate_rule_windows(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='checkpoint-save-failures', kind='rate',
+                         metric='skytpu_ckpt_saves_total',
+                         labels={'outcome': 'error'},
+                         op='>', threshold=0.0, window=60,
+                         for_seconds=0)
+        store.append(
+            _fams('skytpu_ckpt_saves_total{outcome="error"} 0\n'),
+            now=0.0)
+        assert rule.evaluate(store, 1.0)[0] is False
+        store.append(
+            _fams('skytpu_ckpt_saves_total{outcome="error"} 2\n'),
+            now=10.0)
+        fire, _, value = rule.evaluate(store, 11.0)
+        assert fire and value == pytest.approx(2.0 / 60.0)
+        # Outside the window the increase ages out.
+        assert rule.evaluate(store, 200.0)[0] is False
+
+    def test_absent_rule(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='agent-scrape-stale', kind='absent',
+                         metric='skytpu_agent_uptime_seconds',
+                         max_age=30.0, for_seconds=0)
+        # Never seen: quiet by default (an unscraped cluster must
+        # not page at arm time).
+        assert rule.evaluate(store, 100.0)[0] is False
+        store.append(_fams('skytpu_agent_uptime_seconds 1\n'),
+                     now=100.0)
+        assert rule.evaluate(store, 120.0)[0] is False
+        fire, _, age = rule.evaluate(store, 140.0)
+        assert fire and age == pytest.approx(40.0)
+
+    def test_burn_rate_needs_both_windows(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='slo-burn-rate', kind='burn_rate',
+                         objective=0.999,
+                         bad_metric='skytpu_lb_requests_total',
+                         bad_labels={'code': ('prefix', '5')},
+                         total_metric='skytpu_lb_requests_total',
+                         long_window=3600.0, short_window=300.0,
+                         burn_factor=14.4, for_seconds=0)
+
+        def append(now, total, bad):
+            store.append(_fams(
+                f'skytpu_lb_requests_total{{code="200"}} '
+                f'{total - bad}\n'
+                f'skytpu_lb_requests_total{{code="502"}} {bad}\n'),
+                now=now)
+
+        # An OLD incident inside the long window but outside the
+        # short one: long burn high, short burn zero → no fire (the
+        # incident is over; paging now would be noise).
+        append(0.0, 0, 0)
+        append(10.0, 1000, 900)
+        append(3400.0, 1100, 900)   # short-window baseline
+        append(3500.0, 1200, 900)
+        fire, _, _ = rule.evaluate(store, 3510.0)
+        assert fire is False
+        # Errors in BOTH windows → page. 90% errors vs 0.1% budget
+        # is a ~900x burn.
+        append(3550.0, 1400, 1080)
+        fire, _, value = rule.evaluate(store, 3560.0)
+        assert fire and value > 14.4
+
+    def test_burn_rate_no_traffic_is_quiet(self, tmp_path):
+        store = HistoryStore('s', base=str(tmp_path))
+        rule = AlertRule(id='slo-burn-rate', kind='burn_rate',
+                         objective=0.99,
+                         bad_metric='skytpu_lb_requests_total',
+                         bad_labels={'code': ('prefix', '5')},
+                         total_metric='skytpu_lb_requests_total')
+        assert rule.evaluate(store, 10.0) == (False, False, None)
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            AlertRule(id='x', kind='nope', metric='m')
+        with pytest.raises(ValueError):
+            AlertRule(id='x', kind='threshold', metric='m', op='!~')
+        with pytest.raises(ValueError):
+            AlertRule(id='x', kind='burn_rate', objective=1.5,
+                      bad_metric='b', total_metric='t')
+        with pytest.raises(ValueError):
+            AlertRule(id='x', kind='threshold', metric='')
+
+
+# ---------------------------------------------------------------------
+# Engine state machine + journal
+# ---------------------------------------------------------------------
+
+
+def _gauge_rule(**kw):
+    defaults = dict(id='goodput-ratio-drop', kind='threshold',
+                    metric='skytpu_goodput_ratio', op='<',
+                    threshold=0.5, window=10_000.0, for_seconds=30.0)
+    defaults.update(kw)
+    return AlertRule(**defaults)
+
+
+class TestEngine:
+
+    def _engine(self, tmp_path, clock, **rule_kw):
+        store = HistoryStore('svc', base=str(tmp_path))
+        engine = engine_lib.AlertEngine(
+            store, [_gauge_rule(**rule_kw)], scope='svc',
+            base=str(tmp_path), clock=clock)
+        return store, engine
+
+    def test_pending_hold_then_firing_then_resolved(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock)
+        store.append(_fams('skytpu_goodput_ratio 0.9\n'),
+                     now=clock.t)
+        assert engine.tick() == []
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.advance(10))
+        events = engine.tick()
+        assert [e['state'] for e in events] == ['pending']
+        # Still inside the hold: no escalation.
+        clock.advance(10)
+        assert engine.tick() == []
+        # Past the hold: firing.
+        clock.advance(25)
+        events = engine.tick()
+        assert [e['state'] for e in events] == ['firing']
+        assert engine.firing()[0]['rule'] == 'goodput-ratio-drop'
+        # Recovery → resolved.
+        store.append(_fams('skytpu_goodput_ratio 0.9\n'),
+                     now=clock.advance(10))
+        events = engine.tick()
+        assert [e['state'] for e in events] == ['resolved']
+        assert events[0]['resolved_from'] == 'firing'
+        assert engine.firing() == []
+
+    def test_pending_cancelled_by_recovery(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        assert [e['state'] for e in engine.tick()] == ['pending']
+        store.append(_fams('skytpu_goodput_ratio 0.9\n'),
+                     now=clock.advance(5))
+        events = engine.tick()
+        assert [e['state'] for e in events] == ['resolved']
+        assert events[0]['resolved_from'] == 'pending'
+        # A blip never fires.
+        clock.advance(100)
+        assert engine.tick() == []
+
+    def test_firing_hysteresis_no_flap(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock,
+                                     resolve_threshold=0.6,
+                                     for_seconds=0.0)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        states = [e['state'] for e in engine.tick()]
+        assert states == ['pending', 'firing']
+        # Oscillating inside the band: still firing, no transitions.
+        for v in (0.55, 0.45, 0.58):
+            store.append(_fams(f'skytpu_goodput_ratio {v}\n'),
+                         now=clock.advance(5))
+            assert engine.tick() == []
+            assert engine.firing()
+        store.append(_fams('skytpu_goodput_ratio 0.7\n'),
+                     now=clock.advance(5))
+        assert [e['state'] for e in engine.tick()] == ['resolved']
+
+    def test_journal_round_trip_and_torn_lines(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock,
+                                     for_seconds=0.0)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        engine.tick()
+        # Torn line from a dying writer + junk: skipped, never an
+        # error.
+        path = journal_lib.journal_path(str(tmp_path))
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write('{"ts": 1, "rule": "to')
+            f.write('\nnot json either\n')
+        store.append(_fams('skytpu_goodput_ratio 0.9\n'),
+                     now=clock.advance(5))
+        engine.tick()
+        events = journal_lib.read_events(str(tmp_path))
+        assert [e['state'] for e in events] == \
+            ['pending', 'firing', 'resolved']
+        only = journal_lib.read_events(str(tmp_path),
+                                       rule='goodput-ratio-drop',
+                                       limit=1)
+        assert len(only) == 1 and only[0]['state'] == 'resolved'
+
+    def test_journal_retention_cap(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_ALERTS_JOURNAL_MAX_LINES', '10')
+        for i in range(400):
+            journal_lib.append_event({'kind': 'transition',
+                                      'rule': 'r', 'n': i},
+                                     base=str(tmp_path))
+        events = journal_lib.read_events(str(tmp_path))
+        # Bounded by cap + compaction slack, and the newest survive.
+        assert len(events) <= 10 + 256 + 1
+        assert events[-1]['n'] == 399
+
+    def test_state_persisted_and_resumed(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock,
+                                     for_seconds=0.0)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        engine.tick()
+        assert os.path.exists(engine.state_path())
+        # A NEW engine (fresh process) resumes the machine: the
+        # still-bad value is not re-journaled as a fresh pending.
+        engine2 = engine_lib.AlertEngine(
+            store, [_gauge_rule(for_seconds=0.0)], scope='svc',
+            base=str(tmp_path), clock=clock)
+        assert engine2.firing()
+        clock.advance(5)
+        assert engine2.tick() == []  # no new transitions
+        # (fake clock timestamps are ancient wall-clock-wise, so
+        # disable the TTL for this read)
+        snaps = engine_lib.load_states(str(tmp_path),
+                                       max_age=float('inf'))
+        assert len(snaps) == 1 and snaps[0]['scope'] == 'svc'
+
+    def test_stale_snapshot_aged_out_and_cleared(self, tmp_path):
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock,
+                                     for_seconds=0.0)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        engine.tick()
+        # The fake-clock snapshot is ancient in wall-clock terms:
+        # the default TTL drops AND unlinks it — a dead engine's
+        # firing page cannot haunt `xsky top` forever.
+        assert engine_lib.load_states(str(tmp_path)) == []
+        assert not os.path.exists(engine.state_path())
+        # clear_persisted is the graceful-shutdown path.
+        engine.tick()
+        assert os.path.exists(engine.state_path())
+        engine.clear_persisted()
+        assert not os.path.exists(engine.state_path())
+
+    def test_window_quantile_multi_series_not_inflated(self,
+                                                       tmp_path):
+        """Regression (review finding): same-edge bucket samples
+        from DIFFERENT label sets (a merged cluster scrape has one
+        series per host) must be summed per append before the
+        reset-aware increase — interleaving them misreads every
+        cross-series drop as a counter reset."""
+        store = HistoryStore('s', base=str(tmp_path))
+        text0 = ('skytpu_batch_ttft_seconds_bucket'
+                 '{host="a",le="+Inf"} 100\n'
+                 'skytpu_batch_ttft_seconds_bucket'
+                 '{host="b",le="+Inf"} 5\n'
+                 'skytpu_batch_ttft_seconds_bucket'
+                 '{host="a",le="1.0"} 100\n'
+                 'skytpu_batch_ttft_seconds_bucket'
+                 '{host="b",le="1.0"} 5\n')
+        text1 = text0.replace(' 100\n', ' 101\n').replace(
+            ' 5\n', ' 6\n')
+        store.append(_fams(text0), now=1000.0)
+        store.append(_fams(text1), now=1010.0)
+        # True window increase: 2 observations, all ≤ 1.0.
+        q = store.window_quantile('skytpu_batch_ttft_seconds', 0.99,
+                                  100, now=1011.0)
+        assert q == 1.0
+        # And the counts behind it must be 2, not inflated by
+        # phantom "resets" (107 before the fix).
+        pts = []
+        for ts, samples in store.points(window=100, now=1011.0):
+            total = sum(s.value for s in samples
+                        if s.name.endswith('_bucket') and
+                        dict(s.labels).get('le') == '+Inf')
+            pts.append((ts, total))
+        assert query.counter_increase(pts) == 2.0
+
+    def test_exemplar_stamped_on_firing(self, tmp_path):
+        clock = FakeClock()
+        store = HistoryStore('svc', base=str(tmp_path))
+        engine = engine_lib.AlertEngine(
+            store, [_gauge_rule(for_seconds=0.0)], scope='svc',
+            base=str(tmp_path), clock=clock,
+            exemplar_fn=lambda: 'abcd' * 8)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        events = engine.tick()
+        firing = [e for e in events if e['state'] == 'firing']
+        assert firing[0]['exemplar_trace_id'] == 'abcd' * 8
+        action = engine.note_action('goodput-ratio-drop', 'demote',
+                                    replica=3)
+        assert action['exemplar_trace_id'] == 'abcd' * 8
+        kinds = [e['kind'] for e in
+                 journal_lib.read_events(str(tmp_path))]
+        assert kinds == ['transition', 'transition', 'action']
+
+    def test_removed_rule_resolves_not_fires_forever(self,
+                                                     tmp_path):
+        """Regression (review finding): swapping the rule set (a
+        rolling update dropping the `slo:` block) must RESOLVE a
+        firing alert whose rule vanished — nothing evaluates it
+        anymore, and each persist would keep it TTL-fresh forever
+        (permanent page + permanent autoscaler pressure)."""
+        clock = FakeClock()
+        store, engine = self._engine(tmp_path, clock,
+                                     for_seconds=0.0)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        engine.tick()
+        assert engine.firing()
+        engine.rules = []  # the update dropped the rule
+        clock.advance(5)
+        events = engine.tick()
+        assert [e['state'] for e in events] == ['resolved']
+        assert events[0]['resolved_reason'] == 'rule-removed'
+        assert engine.firing() == []
+        # And it stays quiet.
+        clock.advance(5)
+        assert engine.tick() == []
+
+    def test_broken_rule_isolated(self, tmp_path):
+        clock = FakeClock()
+        store = HistoryStore('svc', base=str(tmp_path))
+
+        class BadRule:
+            id = 'bad'
+
+            def evaluate(self, *_a):
+                raise RuntimeError('boom')
+
+        engine = engine_lib.AlertEngine(
+            store, [BadRule(), _gauge_rule(for_seconds=0.0)],
+            scope='svc', base=str(tmp_path), clock=clock)
+        store.append(_fams('skytpu_goodput_ratio 0.3\n'),
+                     now=clock.t)
+        # The good rule still advances.
+        assert [e['state'] for e in engine.tick()] == \
+            ['pending', 'firing']
+
+
+# ---------------------------------------------------------------------
+# SLO in the service spec YAML + builtin pack
+# ---------------------------------------------------------------------
+
+
+class TestSloSpec:
+
+    def test_yaml_round_trip(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        spec = SkyServiceSpec.from_yaml_config({
+            'port': 9000,
+            'replicas': 2,
+            'slo': {'objective': 0.999, 'window_seconds': 1800},
+        })
+        assert spec.slo_objective == 0.999
+        assert spec.slo_window_seconds == 1800
+        out = spec.to_yaml_config()
+        assert out['slo'] == {'objective': 0.999,
+                              'window_seconds': 1800.0}
+        again = SkyServiceSpec.from_yaml_config(out)
+        assert again.slo_objective == 0.999
+
+    def test_undeclared_slo_omitted(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        spec = SkyServiceSpec.from_yaml_config({'port': 9000})
+        assert spec.slo_objective is None
+        assert 'slo' not in spec.to_yaml_config()
+
+    def test_invalid_objective_rejected(self):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec.from_yaml_config(
+                {'slo': {'objective': 1.5}})
+
+    def test_slo_arms_burn_rate_rule(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        spec = SkyServiceSpec.from_yaml_config(
+            {'slo': {'objective': 0.99, 'window_seconds': 1200}})
+        rules = builtin_rules.serve_rules(spec)
+        burn = [r for r in rules if r.id == 'slo-burn-rate']
+        assert len(burn) == 1
+        assert burn[0].objective == 0.99
+        assert burn[0].long_window == 1200.0
+        assert burn[0].short_window == pytest.approx(100.0)
+        assert not [r for r in builtin_rules.serve_rules(None)
+                    if r.id == 'slo-burn-rate']
+
+    def test_env_overrides_scale_pack(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_ALERTS_FOR_SECONDS', '0.5')
+        monkeypatch.setenv('SKYTPU_ALERTS_WINDOW_SECONDS', '6')
+        for rule in (builtin_rules.serve_rules() +
+                     builtin_rules.fleet_rules()):
+            assert rule.for_seconds == 0.5
+            assert rule.window == 6.0
+
+
+# ---------------------------------------------------------------------
+# Autoscaler alert pressure
+# ---------------------------------------------------------------------
+
+
+class TestAlertPressure:
+
+    def _spec(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        return SkyServiceSpec(min_replicas=1, max_replicas=3,
+                              target_qps_per_replica=10,
+                              upscale_delay_seconds=0,
+                              downscale_delay_seconds=0)
+
+    def test_pressure_adds_one_replica_bounded(self):
+        from skypilot_tpu.serve import autoscalers
+        scaler = autoscalers.RequestRateAutoscaler(self._spec())
+        assert scaler.effective_target() == 1
+        scaler.set_alert_pressure(True)
+        assert scaler.effective_target() == 2
+        scaler.target_num_replicas = 3  # already at max
+        assert scaler.effective_target() == 3
+        scaler.set_alert_pressure(False)
+        assert scaler.effective_target() == 3
+
+    def test_pressure_generates_scale_up_op(self):
+        from skypilot_tpu.serve import autoscalers
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        scaler = autoscalers.RequestRateAutoscaler(self._spec())
+        records = [{'replica_id': 1, 'status': ReplicaStatus.READY}]
+        assert scaler.generate_ops(records, now=1.0) == []
+        scaler.set_alert_pressure(True)
+        ops = scaler.generate_ops(records, now=2.0)
+        assert len(ops) == 1
+        assert ops[0].operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_UP
+        assert ops[0].count == 1
+        # Pressure released: the extra replica drains back out.
+        scaler.set_alert_pressure(False)
+        records.append({'replica_id': 2,
+                        'status': ReplicaStatus.READY})
+        ops = scaler.generate_ops(records, now=3.0)
+        assert len(ops) == 1
+        assert ops[0].operator == \
+            autoscalers.AutoscalerDecisionOperator.SCALE_DOWN
+
+
+# ---------------------------------------------------------------------
+# E2E: fault-injected probe failures → alert → demote → resolution
+# ---------------------------------------------------------------------
+
+
+class _OkHandler(http.server.BaseHTTPRequestHandler):
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        body = b'ok'
+        self.send_response(200)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _start_replica_server(port):
+    server = http.server.HTTPServer(('127.0.0.1', port), _OkHandler)
+    thread = threading.Thread(target=server.serve_forever,
+                              daemon=True)
+    thread.start()
+    return server
+
+
+class TestAlertDrivenControlE2E:
+
+    def test_probe_fault_fires_demotes_and_resolves(
+            self, monkeypatch, faults):
+        """ISSUE 9 acceptance: deterministic fault injection walks
+        `replica-probe-errors` through pending→firing→resolved in a
+        real in-process serve controller; the firing alert demotes
+        the replica with an exemplar trace_id from the offending LB
+        span; `xsky alerts` and the `xsky top` ALERTS column render
+        it; the history store honors its retention cap throughout."""
+        import click.testing
+
+        from skypilot_tpu import cli as cli_mod
+        from skypilot_tpu import state as state_lib
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.serve import controller as controller_mod
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        from skypilot_tpu.task import Task
+
+        # Drill-speed rule pack + a tight retention bound the test
+        # asserts against on every tick.
+        monkeypatch.setenv('SKYTPU_ALERTS_FOR_SECONDS', '0.3')
+        monkeypatch.setenv('SKYTPU_ALERTS_WINDOW_SECONDS', '4')
+        monkeypatch.setenv('SKYTPU_METRICS_HISTORY_MAX_POINTS', '15')
+        monkeypatch.setenv('SKYTPU_SERVE_DEMOTE_AFTER', '5')
+
+        replica_port = _free_port()
+        server = _start_replica_server(replica_port)
+        svc = 'alertsvc'
+        spec = SkyServiceSpec(
+            readiness_path='/', initial_delay_seconds=600,
+            readiness_timeout_seconds=2, min_replicas=1,
+            max_replicas=2, target_qps_per_replica=100,
+            upscale_delay_seconds=0, downscale_delay_seconds=600,
+            port=replica_port, slo_objective=0.999)
+        task = Task(name=svc, run='true')
+        res = Resources(cloud='local')
+        task.set_resources(res)
+        task.service = spec
+
+        serve_state.add_service(svc,
+                                json.dumps(spec.to_yaml_config()),
+                                lb_port=_free_port())
+        endpoint = f'http://127.0.0.1:{replica_port}'
+        serve_state.upsert_replica(svc, 1, f'{svc}-replica-1',
+                                   ReplicaStatus.STARTING, endpoint)
+        # probe_all treats a missing cluster record as preemption;
+        # the fake replica has no cluster, so pin a live record.
+        monkeypatch.setattr(state_lib, 'get_cluster_from_name',
+                            lambda name: {'name': name})
+
+        ctrl = controller_mod.SkyServeController(
+            svc, task, lb_port=_free_port())
+        serve_state.set_service_endpoint(
+            svc, f'http://127.0.0.1:{ctrl.load_balancer.port}')
+        ctrl.load_balancer.start()
+        # Replica launches/terminations are the real serve e2e's
+        # business; here they must be inert so the autoscaler's
+        # alert-pressure op is observable without a cloud.
+        scale_ups, scale_downs = [], []
+        monkeypatch.setattr(
+            ctrl.replica_manager, 'scale_up',
+            lambda n=1, use_spot=None: scale_ups.append(n) or [])
+        monkeypatch.setattr(
+            ctrl.replica_manager, 'scale_down',
+            lambda ids: scale_downs.append(list(ids)))
+
+        def tick():
+            ctrl.run_once()
+            # Retention bound holds THROUGHOUT (acceptance).
+            assert ctrl._alert_store.point_count() <= 15  # pylint: disable=protected-access
+
+        def lb_get():
+            import urllib.error
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                        f'http://127.0.0.1:'
+                        f'{ctrl.load_balancer.port}/',
+                        timeout=10) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+            except OSError:
+                return None
+
+        try:
+            tick()
+            replicas = serve_state.get_replicas(svc)
+            assert replicas[0]['status'] == ReplicaStatus.READY
+            assert lb_get() == 200
+            assert not ctrl._alert_engine.firing()  # pylint: disable=protected-access
+
+            # ---- inject: kill the replica server AND arm the
+            # deterministic probe fault (the ISSUE's drill).
+            server.shutdown()
+            server.server_close()
+            monkeypatch.setenv('SKYTPU_FAULTS',
+                               'serve.probe:error:1.0')
+            faults.reset(seed=0)  # re-arms lazily from the env
+            # The offending LB request: a traced 502 whose trace_id
+            # becomes the alert's exemplar.
+            assert lb_get() == 502
+
+            tick()  # probe fails → the counter's first sample lands
+            tick()  # second sample → windowed increase > 0 → PENDING
+            states = {s['rule']: s['state']
+                      for s in ctrl._alert_engine.states()}  # pylint: disable=protected-access
+            assert states['replica-probe-errors'] == 'pending'
+            time.sleep(0.4)  # past the pending hold
+            tick()  # → FIRING + consumed: demote marked
+            assert 'replica-probe-errors' in {
+                a['rule']
+                for a in ctrl._alert_engine.firing()}  # pylint: disable=protected-access
+            tick()  # suspect replica's next failed probe demotes
+            replicas = serve_state.get_replicas(svc)
+            assert replicas[0]['status'] == ReplicaStatus.NOT_READY
+
+            # The demote action is journaled WITH the exemplar from
+            # the offending LB span.
+            actions = [e for e in journal_lib.read_events()
+                       if e.get('kind') == 'action' and
+                       e.get('action') == 'demote']
+            assert actions, journal_lib.read_events()
+            exemplar = actions[-1]['exemplar_trace_id']
+            assert exemplar and len(exemplar) == 32
+            assert actions[-1]['replica'] == 1
+
+            # Page pressure: the empty ready set 503s a request,
+            # lb-no-ready-replica fires, and the autoscaler emits a
+            # scale-up op above the policy target.
+            assert lb_get() == 503
+            tick()
+            time.sleep(0.4)
+            tick()
+            firing_rules = {a['rule']
+                            for a in ctrl._alert_engine.firing()}  # pylint: disable=protected-access
+            assert 'lb-no-ready-replica' in firing_rules
+            assert scale_ups, 'alert pressure produced no scale-up'
+
+            # ---- surfaces while firing.
+            runner = click.testing.CliRunner()
+            result = runner.invoke(cli_mod.cli, ['alerts'])
+            assert result.exit_code == 0, result.output
+            assert 'replica-probe-errors' in result.output
+            assert 'FIRING' in result.output
+            assert exemplar[:8] in result.output
+            result = runner.invoke(cli_mod.cli, ['top', '--once'])
+            assert result.exit_code == 0, result.output
+            assert 'ALERTS' in result.output
+            assert 'ALERTS FIRING' in result.output
+            assert 'replica-probe-errors' in result.output
+            result = runner.invoke(cli_mod.cli, ['slo'])
+            assert result.exit_code == 0, result.output
+            assert svc in result.output
+
+            # ---- clear the fault, bring the replica back.
+            monkeypatch.delenv('SKYTPU_FAULTS')
+            faults.reset(seed=0)
+            server = _start_replica_server(replica_port)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                tick()
+                firing_rules = {
+                    a['rule']
+                    for a in ctrl._alert_engine.firing()}  # pylint: disable=protected-access
+                if not firing_rules:
+                    break
+                time.sleep(0.5)
+            assert not firing_rules, firing_rules
+            replicas = serve_state.get_replicas(svc)
+            assert replicas[0]['status'] == ReplicaStatus.READY
+            # Pressure released with the page.
+            assert ctrl.autoscaler.effective_target() == \
+                ctrl.autoscaler.target_num_replicas
+
+            # Journal tells the whole story, in order.
+            probe_events = [
+                e['state']
+                for e in journal_lib.read_events(
+                    rule='replica-probe-errors')
+                if e.get('kind') == 'transition']
+            assert probe_events[:3] == ['pending', 'firing',
+                                        'resolved'] or \
+                probe_events == ['pending', 'firing', 'resolved']
+        finally:
+            ctrl.load_balancer.stop()
+            server.shutdown()
+            server.server_close()

@@ -89,6 +89,58 @@ class TestCli:
             [os_mod.getpid()]
         registry.remove(os_mod.getpid())
 
+    def test_alerts_smoke(self, runner):
+        """`xsky alerts` must run clean on an empty fleet (the
+        docs-mandated smoke so the command can't rot)."""
+        result = runner.invoke(cli.cli, ['alerts'])
+        assert result.exit_code == 0, result.output
+        # The process-global registry may carry series from earlier
+        # tests in this session (a real driver-scope evaluation);
+        # either the quiet message or a rendered table is healthy.
+        assert 'No alerts' in result.output or \
+            'RULE' in result.output
+        result = runner.invoke(cli.cli, ['alerts', '--history'])
+        assert result.exit_code == 0, result.output
+
+    def test_alerts_renders_persisted_states(self, runner):
+        """A scope persisted by another engine (a serve controller)
+        shows up in `xsky alerts` without re-evaluation."""
+        from skypilot_tpu import alerts as alerts_lib
+        from skypilot_tpu.metrics import history as history_lib
+        from skypilot_tpu.metrics.exposition import parse_text
+        store = history_lib.HistoryStore('service-demo')
+        store.append(parse_text('skytpu_lb_no_ready_replica_total 0\n'))
+        store.append(parse_text('skytpu_lb_no_ready_replica_total 5\n'))
+        engine = alerts_lib.AlertEngine(
+            store, alerts_lib.builtin.serve_rules(),
+            scope='service-demo', attrs={'service': 'demo'})
+        engine.tick()
+        assert engine.firing(), engine.states()
+        result = runner.invoke(cli.cli, ['alerts'])
+        assert result.exit_code == 0, result.output
+        assert 'lb-no-ready-replica' in result.output
+        assert 'FIRING' in result.output
+        result = runner.invoke(cli.cli, ['alerts', '--history'])
+        assert result.exit_code == 0, result.output
+        assert 'lb-no-ready-replica' in result.output
+
+    def test_slo_smoke(self, runner):
+        result = runner.invoke(cli.cli, ['slo'])
+        assert result.exit_code == 0, result.output
+        assert 'No services' in result.output
+
+    def test_metrics_history_smoke(self, runner):
+        """`xsky metrics --history` renders retained scopes even
+        when their cluster is gone."""
+        from skypilot_tpu.metrics import history as history_lib
+        from skypilot_tpu.metrics.exposition import parse_text
+        store = history_lib.HistoryStore('oldcluster')
+        for v in (1, 2, 3):
+            store.append(parse_text(f'skytpu_host_load1 {v}\n'))
+        result = runner.invoke(cli.cli, ['metrics', '--history'])
+        assert result.exit_code == 0, result.output
+        assert 'skytpu_host_load1' in result.output
+
     def test_env_parsing(self, runner, tmp_path):
         yaml_path = tmp_path / 'task.yaml'
         yaml_path.write_text('envs:\n  X: default\nrun: echo $X\n')

@@ -27,6 +27,78 @@ def _free_port() -> int:
 
 
 # ---------------------------------------------------------------------
+# Textfile-bridge staleness boundary (docs/observability.md): the
+# 120 s default threshold is exact — age <= threshold is served, age
+# > threshold is skipped AND swept — and SKYTPU_METRICS_TEXTFILE_
+# MAX_AGE moves it on both the publisher-side reader and the agent.
+# ---------------------------------------------------------------------
+
+
+class TestTextfileStaleness:
+
+    @staticmethod
+    def _write_prom(directory, name, age_seconds, now):
+        import os
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(str(directory), name)
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write('skytpu_train_steps_total 1\n')
+        mtime = now - age_seconds
+        os.utime(path, (mtime, mtime))
+        return path
+
+    def test_default_120s_boundary(self, tmp_path):
+        import os
+        from skypilot_tpu.metrics import publish
+        now = time.time()
+        fresh = self._write_prom(tmp_path, 'fresh.prom', 119.0, now)
+        stale = self._write_prom(tmp_path, 'stale.prom', 121.0, now)
+        text = publish.read_textfiles(str(tmp_path), now=now)
+        assert 'skytpu_train_steps_total' in text
+        assert os.path.exists(fresh)
+        # Past the boundary: skipped AND unlinked (a crashed
+        # publisher stops haunting dashboards).
+        assert not os.path.exists(stale)
+
+    def test_env_override_moves_boundary(self, tmp_path,
+                                         monkeypatch):
+        import os
+        from skypilot_tpu.metrics import publish
+        monkeypatch.setenv('SKYTPU_METRICS_TEXTFILE_MAX_AGE', '10')
+        assert publish.stale_seconds() == 10.0
+        now = time.time()
+        kept = self._write_prom(tmp_path, 'kept.prom', 9.0, now)
+        swept = self._write_prom(tmp_path, 'swept.prom', 11.0, now)
+        text = publish.read_textfiles(str(tmp_path), now=now)
+        assert 'skytpu_train_steps_total' in text
+        assert os.path.exists(kept) and not os.path.exists(swept)
+
+    def test_env_override_bad_value_falls_back(self, monkeypatch):
+        from skypilot_tpu.metrics import publish
+        monkeypatch.setenv('SKYTPU_METRICS_TEXTFILE_MAX_AGE',
+                           'not-a-number')
+        assert publish.stale_seconds() == publish.STALE_SECONDS
+
+    def test_agent_append_honors_env(self, tmp_path, monkeypatch):
+        """The AGENT-side reader (runtime/agent.py, standalone-safe
+        inline copy) honors the same env var: a stale file vanishes
+        from the agent's /metrics under a tightened threshold."""
+        import os
+        from skypilot_tpu.runtime import agent
+        monkeypatch.setenv('SKYTPU_METRICS_DIR', str(tmp_path))
+        now = time.time()
+        self._write_prom(tmp_path, 'old.prom', 60.0, now)
+        # Default (120 s): a 60 s-old file is served.
+        assert 'skytpu_train_steps_total' in agent._read_textfiles()  # pylint: disable=protected-access
+        self._write_prom(tmp_path, 'old.prom', 60.0, now)
+        monkeypatch.setenv('SKYTPU_METRICS_TEXTFILE_MAX_AGE', '30')
+        assert 'skytpu_train_steps_total' not in \
+            agent._read_textfiles()  # pylint: disable=protected-access
+        assert not os.path.exists(os.path.join(str(tmp_path),
+                                               'old.prom'))
+
+
+# ---------------------------------------------------------------------
 # Registry semantics
 # ---------------------------------------------------------------------
 

@@ -354,6 +354,28 @@ class TestAgentScrapeEndToEnd:
         fams2 = exposition.parse_text(live_agent.metrics())
         assert 'skytpu_device_hbm_used_bytes' not in fams2
 
+    def test_scrape_appends_host_history(self, live_agent,
+                                         tmp_path):
+        """Both agents append each /metrics scrape's own gauges to
+        the bounded on-host history (docs/observability.md, Alerts &
+        SLOs): one jsonl line per scrape under
+        <runtime_dir>/metrics_history/host.jsonl, readable by the
+        driver-side HistoryStore."""
+        from skypilot_tpu.metrics.history import HistoryStore
+        live_agent.metrics()
+        store = HistoryStore('host', base=str(tmp_path / 'rt'))
+        deadline = time.time() + 5
+        while time.time() < deadline and store.point_count() == 0:
+            time.sleep(0.2)
+        assert store.point_count() >= 1
+        uptime = store.latest('skytpu_agent_uptime_seconds')
+        assert uptime is not None and uptime >= 0
+        # Min-interval downsampling: an immediate re-scrape (well
+        # inside the agents' 5 s default) adds no line.
+        before = store.point_count()
+        live_agent.metrics()
+        assert store.point_count() == before
+
     def test_profile_arm_round_trip(self, live_agent, tmp_path):
         resp = live_agent.profile(steps=7)
         assert resp['ok'] and resp['steps'] == 7

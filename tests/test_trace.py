@@ -915,3 +915,77 @@ class TestMetricNameContractLint:
             open(cc_path, encoding='utf-8').read())
         assert 'skytpu_agent_uptime_seconds' in cc_names, \
             'lint no longer sees the C++ agent metrics'
+
+
+# Alert-rule ids are the third stable-name contract (after spans and
+# metrics): every `AlertRule(id='...')` constructed in-tree must be
+# backticked in docs/observability.md's Built-in rules table, and
+# every id documented there must be constructed.
+ALERT_RULE_ID_PATTERN = re.compile(
+    r"""AlertRule\(\s*\n?\s*id='([a-z0-9-]+)'""")
+_DOC_RULE_TOKEN = re.compile(r'`([a-z0-9]+(?:-[a-z0-9]+)+)`')
+
+
+def _constructed_rule_ids():
+    import skypilot_tpu
+    root = os.path.dirname(skypilot_tpu.__file__)
+    ids = {}
+    for dirpath, _, files in os.walk(root):
+        if '__pycache__' in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fn)
+            for rule_id in ALERT_RULE_ID_PATTERN.findall(
+                    open(path, encoding='utf-8').read()):
+                ids.setdefault(rule_id, path)
+    return ids
+
+
+class TestAlertRuleContractLint:
+
+    @staticmethod
+    def _rules_doc_section():
+        docs = TestMetricNameContractLint._docs_text()  # pylint: disable=protected-access
+        marker = '### Built-in rules'
+        assert marker in docs, \
+            'docs/observability.md lost its Built-in rules section'
+        section = docs.split(marker, 1)[1]
+        # The table ends at the next heading.
+        for stop in ('\n## ', '\n# '):
+            if stop in section:
+                section = section.split(stop, 1)[0]
+        return section
+
+    def test_all_constructed_rule_ids_documented(self):
+        docs = TestMetricNameContractLint._docs_text()  # pylint: disable=protected-access
+        ids = _constructed_rule_ids()
+        assert ids, 'lint found no AlertRule constructions — did ' \
+                    'the rule API change?'
+        missing = [f'{rule_id} (from {path})'
+                   for rule_id, path in sorted(ids.items())
+                   if f'`{rule_id}`' not in docs]
+        assert not missing, (
+            'alert rule ids constructed in-tree but missing from '
+            'docs/observability.md:\n  ' + '\n  '.join(missing))
+
+    def test_all_documented_rule_ids_constructed(self):
+        constructed = set(_constructed_rule_ids())
+        documented = set(
+            _DOC_RULE_TOKEN.findall(self._rules_doc_section()))
+        assert documented, 'no rule ids found in the Built-in ' \
+                           'rules table — did its format change?'
+        stale = sorted(documented - constructed)
+        assert not stale, (
+            'rule ids documented in docs/observability.md but '
+            'constructed nowhere in skypilot_tpu/:\n  ' +
+            '\n  '.join(stale))
+
+    def test_builtin_pack_matches_construction_lint(self):
+        """Meta-check: the runtime's own enumeration of the built-in
+        pack agrees with the grep — regex rot on either side shows
+        up as a diff here."""
+        from skypilot_tpu.alerts import builtin
+        assert set(builtin.all_rule_ids()) == \
+            set(_constructed_rule_ids())
