@@ -244,6 +244,195 @@ def serve_batch_main() -> dict:
     }
 
 
+def _open_loop_load(engine, prompts, gen: int,
+                    interarrival_s: float) -> dict:
+    """Drive an OPEN-LOOP request schedule at the engine: request i
+    is submitted at t0 + i * interarrival regardless of completions
+    (closed-loop drivers hide queueing collapse — an overloaded
+    server slows the load down). Returns tokens/s over the makespan
+    and client-side TTFT stats measured from each request's
+    SCHEDULED arrival (so admission queueing counts)."""
+    import threading
+
+    n = len(prompts)
+    ttfts = [None] * n
+    counts = [0] * n
+    done_at = [0.0] * n
+    errors = [None] * n
+
+    def collect(i, q, sched):
+        first = True
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            if isinstance(tok, BaseException):
+                # Record, don't raise: an exception in this daemon
+                # thread would vanish and silently LIGHTEN the load
+                # the arm is credited with.
+                errors[i] = tok
+                continue
+            if first:
+                ttfts[i] = time.perf_counter() - sched
+                first = False
+            counts[i] += 1
+        done_at[i] = time.perf_counter()
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(prompts):
+        sched = t0 + i * interarrival_s
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        q = engine.submit(prompt, gen)
+        th = threading.Thread(target=collect, args=(i, q, sched),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=600)
+    failed = [repr(e)[:120] for e in errors if e is not None]
+    if failed or not all(done_at):
+        # Both arms are sized so every request must complete; a typed
+        # failure or hung collector means the bench itself is broken
+        # — fail loudly instead of reporting a lighter load as a win.
+        raise RuntimeError(
+            f'open-loop load lost requests: {len(failed)} failed '
+            f'({failed[:3]}), '
+            f'{sum(1 for d in done_at if not d)} unfinished')
+    makespan = max(done_at) - t0
+    ttft_ms = sorted(t * 1000.0 for t in ttfts if t is not None)
+    p99 = ttft_ms[max(0, int(len(ttft_ms) * 0.99) - 1)] \
+        if ttft_ms else float('nan')
+    return {
+        'tokens': sum(counts),
+        'tokens_per_sec': round(sum(counts) / makespan, 2),
+        'requests_per_sec': round(n / makespan, 2),
+        'makespan_s': round(makespan, 2),
+        'p50_ttft_ms': round(ttft_ms[len(ttft_ms) // 2], 1),
+        'p99_ttft_ms': round(p99, 1),
+        'max_ttft_ms': round(ttft_ms[-1], 1),
+    }
+
+
+def serve_continuous_main() -> dict:
+    """BENCH_MODE=serve_continuous (``--bench serve_continuous``):
+    paged-KV engine vs a static-slot configuration of the SAME engine
+    under a mixed short/long-prompt OPEN-LOOP load — the
+    PagedAttention/continuous-batching comparison (ROADMAP item 2).
+
+    Both arms get the SAME KV HBM budget (half the slabs the decode
+    width could use) and the SAME decode batch width. The static arm
+    is the old fixed-slab regime expressed in pool terms: block_size
+    = max_seq (one block == one whole slab, so admission is by free
+    slabs — at 2 slabs of HBM only 2 of its 4 decode rows can ever
+    hold requests, and the dispatch still pays for all 4) and an
+    unbounded prefill budget (whole-prompt prefill stalls every
+    in-flight decode — the TTFT pathology chunking fixes). The paged
+    arm packs small blocks into the same bytes, fills ALL its rows
+    with the mixed-length mix, and interleaves chunked prefill with
+    decode under a token budget. Same compute budget, more of it
+    useful — the PagedAttention occupancy claim measured directly.
+
+    Env: BENCH_SC_MODEL (default tiny — the CPU proxy; set a real
+    model on-chip), BENCH_SC_REQUESTS, BENCH_SC_SHORT/LONG (prompt
+    lengths), BENCH_SC_GEN, BENCH_SC_RATE (req/s), BENCH_KV_INT8.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_SC_MODEL', 'tiny')
+    requests = int(os.environ.get('BENCH_SC_REQUESTS', '32'))
+    short_len = int(os.environ.get('BENCH_SC_SHORT', '16'))
+    long_len = int(os.environ.get('BENCH_SC_LONG', '256'))
+    gen = int(os.environ.get('BENCH_SC_GEN', '32'))
+    # The arrival rate must SATURATE the static arm (its 4 slots):
+    # an under-driven open loop shows neither queueing nor
+    # fragmentation and both arms tie at the arrival rate.
+    rate = float(os.environ.get('BENCH_SC_RATE', '100'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    max_seq = -(-(long_len + gen + 8) // block) * block
+    rows = int(os.environ.get('BENCH_SC_ROWS', '4'))
+    # KV HBM budget: half the slabs the decode width could pin —
+    # the slack regime where packing density decides occupancy.
+    hbm_slabs = max(1, rows // 2)
+
+    config = llama.get_config(model_name)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    # Every 4th request is long — the mix that makes whole-prompt
+    # prefill stalls visible in SHORT requests' p99 TTFT.
+    prompts = [
+        rng.integers(1, config.vocab_size,
+                     size=(long_len if i % 4 == 3 else short_len)
+                     ).tolist()
+        for i in range(requests)]
+
+    def run_arm(name, **engine_kwargs):
+        engine = BatchingEngine(params, config, max_seq=max_seq,
+                                steps_per_dispatch=4,
+                                kv_int8=kv_int8, **engine_kwargs)
+        try:
+            # Warm both prompt-shape compile paths before timing.
+            engine.generate(prompts[0][:short_len], 2)
+            engine.generate(
+                rng.integers(1, config.vocab_size,
+                             size=long_len).tolist(), 2)
+            out = _open_loop_load(engine, prompts, gen, 1.0 / rate)
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    # Same pool HBM and same decode width both arms; only the
+    # admission granularity and prefill scheduling differ.
+    static = run_arm(
+        'static_slots', slots=rows, block_size=max_seq,
+        num_blocks=hbm_slabs + 1, prefill_chunk=max_seq,
+        max_num_batched_tokens=None)
+    paged = run_arm(
+        'paged', slots=rows, block_size=block,
+        num_blocks=hbm_slabs * (max_seq // block) + 1,
+        prefill_chunk=64, max_num_batched_tokens=64)
+
+    speedup = (paged['tokens_per_sec'] /
+               max(static['tokens_per_sec'], 1e-9))
+    ttft_ratio = (static['p99_ttft_ms'] /
+                  max(paged['p99_ttft_ms'], 1e-9))
+    return {
+        'metric': f'{model_name}_serve_continuous_tokens_per_sec',
+        'value': paged['tokens_per_sec'],
+        'unit': 'tokens/s',
+        # vs_baseline here is paged vs the static-slot engine under
+        # the identical load and KV HBM budget (>1 = paged wins).
+        'vs_baseline': round(speedup, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'requests': requests,
+            'short_prompt': short_len,
+            'long_prompt': long_len,
+            'generated_per_request': gen,
+            'arrival_rate_req_s': rate,
+            'max_seq': max_seq,
+            'paged': paged,
+            'static': static,
+            'tokens_per_sec_speedup': round(speedup, 3),
+            'p99_ttft_speedup': round(ttft_ratio, 3),
+        },
+    }
+
+
 def main() -> dict:
     import jax
     import jax.numpy as jnp
@@ -1000,8 +1189,8 @@ if __name__ == '__main__':
         if '--bench' in sys.argv:
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
             idx = sys.argv.index('--bench')
-            known = ('train', 'serve', 'serve_batch', 'launch',
-                     'checkpoint')
+            known = ('train', 'serve', 'serve_batch',
+                     'serve_continuous', 'launch', 'checkpoint')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
                 print(f'usage: bench.py --bench {"|".join(known)}',
@@ -1014,6 +1203,8 @@ if __name__ == '__main__':
             bench_result = serve_main()
         elif mode == 'serve_batch':
             bench_result = serve_batch_main()
+        elif mode == 'serve_continuous':
+            bench_result = serve_continuous_main()
         elif mode == 'launch':
             bench_result = launch_main()
         else:

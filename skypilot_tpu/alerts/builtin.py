@@ -107,6 +107,18 @@ def fleet_rules() -> List[AlertRule]:
             threshold=2.0, resolve_threshold=1.5, op='>',
             window=300.0, for_seconds=120.0,
             summary='p99 time-to-first-token over budget.'),
+        # kv-pool-exhausted sits in the FLEET pack for the same
+        # reason as p99-ttft-high: the preemption counter is recorded
+        # by replica worker processes and reaches history via the
+        # textfile bridge → host agent → cluster-scope scrapes.
+        AlertRule(
+            id='kv-pool-exhausted', kind='rate',
+            metric='skytpu_batch_preemptions_total',
+            threshold=0.0, op='>', window=300.0, for_seconds=60.0,
+            summary='The serving KV block pool is exhausted — the '
+                    'batching engine is preempting requests '
+                    '(recomputed on resume: latency, not '
+                    'correctness). Size num_blocks / shed load.'),
         AlertRule(
             id='agent-scrape-stale', kind='absent',
             metric='skytpu_agent_uptime_seconds',

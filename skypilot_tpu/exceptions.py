@@ -111,6 +111,16 @@ class ServeUserTerminatedError(SkyTpuError):
     """Service was torn down by the user while an op was in flight."""
 
 
+class KVPoolExhaustedError(SkyTpuError):
+    """The paged-KV block pool cannot ever satisfy a request.
+
+    Raised to the SUBMITTING client (via its token queue / a
+    ``generate()`` re-raise) when a single request needs more KV
+    blocks than the pool has usable blocks in total — transient
+    exhaustion is handled by preempt-and-requeue instead, and must
+    never fail unrelated in-flight requests."""
+
+
 class StorageError(SkyTpuError):
     """Storage (bucket) operation failed."""
 

@@ -127,6 +127,16 @@ def _host_rows(families) -> List[Dict[str, Any]]:
     put('skytpu_batch_slots_total', 'slots_total', combine='sum')
     put('skytpu_batch_kv_cache_used_bytes', 'kv_used', combine='sum')
     put('skytpu_batch_kv_cache_bytes', 'kv_bytes', combine='sum')
+    # Paged-KV block pool (serve/kv_pool.py): used/total blocks is
+    # the serve data plane's real occupancy signal (slots only say
+    # how many requests, not how much KV they pin); preemptions > 0
+    # means the pool is running dry under load.
+    put('skytpu_batch_kv_blocks_used', 'kv_blocks_used',
+        combine='sum')
+    put('skytpu_batch_kv_blocks_total', 'kv_blocks_total',
+        combine='sum')
+    put('skytpu_batch_preemptions_total', 'preemptions',
+        combine='sum')
     return [dict(row, host=host)
             for host, row in sorted(hosts.items())]
 
@@ -276,7 +286,8 @@ def render(snap: Dict[str, Any]) -> str:
 
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
-                            'SERVE TOK/S', 'SLOTS', 'KV', 'ALERTS'])
+                            'SERVE TOK/S', 'BLOCKS', 'PREEMPT', 'KV',
+                            'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
         alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
@@ -286,7 +297,7 @@ def render(snap: Dict[str, Any]) -> str:
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
                            '-', '-', '-', '-', '-', '-', '-', '-',
-                           alerts_cell])
+                           '-', alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -302,10 +313,17 @@ def render(snap: Dict[str, Any]) -> str:
             if 'hbm_limit' in h and h['hbm_limit']:
                 hbm = (f'{_fmt_bytes(h.get("hbm_used", 0))}/'
                        f'{_fmt_bytes(h["hbm_limit"])}')
-            slots = '-'
-            if h.get('slots_total'):
-                slots = (f'{h.get("slots_occupied", 0):.0f}/'
-                         f'{h["slots_total"]:.0f}')
+            # Block-pool utilization replaced the slot-occupancy-only
+            # view: used/total KV blocks is what admission is
+            # actually bounded by. Engines predating the paged pool
+            # (no block gauges) fall back to slots.
+            blocks = '-'
+            if h.get('kv_blocks_total'):
+                blocks = (f'{h.get("kv_blocks_used", 0):.0f}/'
+                          f'{h["kv_blocks_total"]:.0f}')
+            elif h.get('slots_total'):
+                blocks = (f'{h.get("slots_occupied", 0):.0f}/'
+                          f'{h["slots_total"]:.0f} slots')
             kv = '-'
             if h.get('kv_bytes'):
                 kv = (f'{_fmt_bytes(h.get("kv_used", 0))}/'
@@ -317,7 +335,9 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_ratio(h.get('mfu')),
                 _fmt_ratio(h.get('goodput')),
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
-                slots, kv, alerts_cell,
+                blocks,
+                _fmt_num(h.get('preemptions'), '{:.0f}'),
+                kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')

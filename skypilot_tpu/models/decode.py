@@ -301,6 +301,183 @@ def forward_cached(params: Params, tokens: jax.Array,
                            k_scale=new_ks, v_scale=new_vs)
 
 
+def forward_paged(params: Params, tokens: jax.Array, pools,
+                  block_row: jax.Array, start: jax.Array,
+                  real_len: jax.Array, config: llama.LlamaConfig,
+                  block_size: int):
+    """One PREFILL CHUNK of one request, written directly into paged
+    KV-pool blocks (serve/kv_pool.py) — the paged engine's
+    copy-on-admit removal: no per-request staging cache, no
+    row-insert copy.
+
+    tokens [1, T] — positions [start, start + T) of the prompt, with
+    only the first ``real_len`` real (the rest pad the chunk to its
+    static bucket; their K/V writes are redirected to the scratch
+    block and their logits discarded). ``pools`` is the engine's
+    cache 4-tuple (k, v, k_scale, v_scale) with k/v
+    [L, num_blocks, block_size, Hkv, hd]; ``block_row`` [MB] int32 is
+    THIS request's block table. ``start``/``real_len`` are traced
+    scalars — one executable serves every chunk of every prompt at a
+    given bucket T.
+
+    Attention per layer: the chunk's rows are written first, then the
+    row's logical view is gathered from the pool and attended with
+    the causal window mask (``_masked_attention`` with
+    q_pos=start, kv_len=start+real_len) — chunk c sees every earlier
+    chunk's keys plus itself causally, so chunked prefill is
+    numerically the plain prefill.
+
+    Returns (logits [1, vocab] f32 at the chunk's LAST REAL position,
+    new pools). Only the final chunk's logits are meaningful (they
+    seed greedy decoding); earlier chunks' are computed into the same
+    cheap [1, 1, vocab] projection and ignored.
+
+    Layer math MIRRORS ``_layer_cached`` (and ``forward_cached``'s
+    scan) minus the cache layout — keep the four layer-body variants
+    in sync; the engine's token-for-token-equality tests against
+    ``greedy_generate`` are the drift alarm. int8 pools: within-chunk
+    attention reads the exact bf16 rows (spliced below), but a LATER
+    chunk reads earlier chunks' int8 round trip — exact equality with
+    the dense int8 path therefore holds for single-chunk prompts
+    (multi-chunk tracks closely; see the engine docstring caveat).
+    """
+    from skypilot_tpu.ops import attention as attention_ops
+    from skypilot_tpu.ops import decode_attention as da
+    from skypilot_tpu.serve import kv_pool as kv_pool_lib
+
+    k_pool, v_pool, k_scale_pool, v_scale_pool = pools
+    quantized = k_scale_pool is not None
+    l, nb, bs = k_pool.shape[:3]
+    assert bs == block_size, (bs, block_size)
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    _, t = tokens.shape
+
+    cparams = jax.tree.map(
+        lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
+        params)
+    positions = start + jnp.arange(t)
+    angles = llama._rope_frequencies(config, positions)
+    x = cparams['embed'][tokens]
+    if config.scale_embeddings:
+        import math
+        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
+
+    # Flat [NB * bs, ...] pool views; write/read index vectors are
+    # chunk-invariant across layers, computed once.
+    kp = k_pool.reshape(l, nb * bs, nkv, hd)
+    vp = v_pool.reshape(l, nb * bs, nkv, hd)
+    ksp = k_scale_pool.reshape(l, nb * bs, nkv) if quantized else None
+    vsp = v_scale_pool.reshape(l, nb * bs, nkv) if quantized else None
+    gw = kv_pool_lib.chunk_write_indices(block_row, start, real_len,
+                                         t, block_size)      # [T]
+    gr = kv_pool_lib.read_indices(block_row[None],
+                                  block_size)[0]             # [S_pad]
+
+    def body(xc, scanned):
+        if quantized:
+            lp, kc, vc, ks, vs = scanned
+        else:
+            lp, kc, vc = scanned
+            ks = vs = None
+        h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
+                            config.norm_offset)
+        q = _mm(h, lp['wq'])
+        k = _mm(h, lp['wk'])
+        v = _mm(h, lp['wv'])
+        if config.qkv_bias:
+            q = q + lp['bq']
+            k = k + lp['bk']
+            v = v + lp['bv']
+        q = q.reshape(1, t, nh, hd)
+        k = k.reshape(1, t, nkv, hd)
+        v = v.reshape(1, t, nkv, hd)
+        q = attention_ops.apply_rope(q, angles)
+        k = attention_ops.apply_rope(k, angles)
+        if quantized:
+            k_rows, ks_rows = _quantize_kv(k)
+            v_rows, vs_rows = _quantize_kv(v)
+        else:
+            k_rows, v_rows = k, v
+            ks_rows = vs_rows = None
+        # In-layer write exists only so this chunk's attention sees
+        # its own keys; the caller-visible pool update is the single
+        # merged scatter after the layer scan (same split as
+        # forward_cached — full-pool ys per layer would rewrite the
+        # whole pool every chunk).
+        kc = kc.at[gw].set(k_rows[0])
+        vc = vc.at[gw].set(v_rows[0])
+        if quantized:
+            ks = ks.at[gw].set(ks_rows[0])
+            vs = vs.at[gw].set(vs_rows[0])
+        kd = _dequant_kv(da.paged_gather(kc, gr[None]),
+                         None if ks is None
+                         else da.paged_gather(ks, gr[None]), k.dtype)
+        vd = _dequant_kv(da.paged_gather(vc, gr[None]),
+                         None if vs is None
+                         else da.paged_gather(vs, gr[None]), v.dtype)
+        if quantized:
+            # Attend the CURRENT chunk's exact bf16 rows, not their
+            # int8 round trip — mirrors the dense prefill contract
+            # ("quantization error only enters later decode steps",
+            # here: later chunks and decode). Splice the chunk back
+            # over its own logical positions in the gathered view.
+            col = jnp.arange(gr.shape[0])
+            rel = col - start
+            in_chunk = (rel >= 0) & (rel < t)
+            relc = jnp.clip(rel, 0, t - 1)
+            kd = jnp.where(in_chunk[None, :, None, None],
+                           k[0][relc][None], kd)
+            vd = jnp.where(in_chunk[None, :, None, None],
+                           v[0][relc][None], vd)
+        attn = _masked_attention(q, kd, vd, q_pos=start,
+                                 kv_len=start + real_len,
+                                 scale=hd ** -0.5)
+        xc = xc + _mm(attn.reshape(1, t, nh * hd), lp['wo'])
+        h = llama._rms_norm(xc, lp['mlp_norm'], config.norm_eps,
+                            config.norm_offset)
+        if config.n_experts:
+            moe_out, _ = llama._moe_mlp(config, h, lp)
+            xc = xc + moe_out
+        else:
+            gate = llama.mlp_act(config)(
+                _mm(h, lp['w_gate']).astype(jnp.float32)
+            ).astype(h.dtype)
+            up = _mm(h, lp['w_up'])
+            xc = xc + _mm(gate * up, lp['w_down'])
+        return xc, ((k_rows[0], v_rows[0], ks_rows[0], vs_rows[0])
+                    if quantized else (k_rows[0], v_rows[0]))
+
+    xs = ((cparams['layers'], kp, vp, ksp, vsp) if quantized
+          else (cparams['layers'], kp, vp))
+    x, rows = jax.lax.scan(body, x, xs)
+    # Persist the chunk's rows with ONE scatter into the (donated)
+    # flat pools.
+    kp = kp.at[:, gw].set(rows[0])
+    vp = vp.at[:, gw].set(rows[1])
+    if quantized:
+        ksp = ksp.at[:, gw].set(rows[2])
+        vsp = vsp.at[:, gw].set(rows[3])
+
+    # Project ONLY the chunk's last real position (start offsets make
+    # it real_len - 1 within the chunk) — a full [1, T, vocab] f32
+    # materialization is the admission cost this path deletes.
+    x_last = jnp.take(x, jnp.maximum(real_len - 1, 0)[None],
+                      axis=1)                              # [1, 1, D]
+    x_last = llama._rms_norm(x_last, cparams['final_norm'],
+                             config.norm_eps, config.norm_offset)
+    if config.tie_embeddings:
+        logits = (x_last @ llama.output_head(cparams, config)
+                  ).astype(jnp.float32)
+    else:
+        logits = _mm(x_last, cparams['lm_head']).astype(jnp.float32)
+    new_pools = (
+        kp.reshape(l, nb, bs, nkv, hd),
+        vp.reshape(l, nb, bs, nkv, hd),
+        ksp.reshape(l, nb, bs, nkv) if quantized else None,
+        vsp.reshape(l, nb, bs, nkv) if quantized else None)
+    return logits[:, 0], new_pools
+
+
 def decode_shardings(config: llama.LlamaConfig, mesh,
                      shard_batch: bool = True,
                      kv_int8: bool = False):

@@ -44,7 +44,7 @@ Both entry points fall back to dense jnp references off-TPU (CPU
 tests, virtual meshes) and are numerically tested against them.
 """
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -261,6 +261,62 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return _decode_attention_pallas(q, k, v, lengths, scale,
                                         _BLOCK_S)
     return _reference_decode_attention(q, k, v, lengths, scale)
+
+
+# ---------------------------------------------------------------------
+# Paged (block-table-indirected) decode attention
+# ---------------------------------------------------------------------
+
+
+def paged_gather(pool_flat: jax.Array,
+                 gather_idx: jax.Array) -> jax.Array:
+    """Gather rows' logical KV views out of a flattened pool:
+    pool_flat [num_blocks * block_size, ...] indexed by the
+    precomputed flat indices from ``kv_pool.read_indices``
+    ([B, S_pad] -> [B, S_pad, ...])."""
+    return jnp.take(pool_flat, gather_idx, axis=0)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array,
+                           block_tables: jax.Array,
+                           lengths: jax.Array, scale: float,
+                           block_size: int,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None
+                           ) -> jax.Array:
+    """Single-position decode attention over PAGED caches.
+
+    q [B, Hq, hd]; k_pool/v_pool are one layer's flattened block
+    pool [num_blocks * block_size, Hkv, hd] (int8 codes with
+    ``k_scale``/``v_scale`` [num_blocks * block_size, Hkv] when the
+    pool is quantized); block_tables [B, MB] int32 maps row b's
+    logical block i to a pool block; lengths [B] — row b attends its
+    first ``lengths[b]`` logical positions.
+
+    Gather-based: each row's blocks are gathered into the contiguous
+    [B, MB * block_size, Hkv, hd] view that ``decode_attention``
+    (length-aware Pallas on TPU, dense masked reference elsewhere)
+    already consumes — positions past ``lengths[b]`` gather
+    scratch/stale rows and are masked to -inf before the softmax, so
+    they contribute exactly 0 and the output is bit-identical to the
+    contiguous-cache path. The gather cost scales with the TABLE
+    WIDTH (the longest admissible request), not the pool allocation:
+    the pool holds many requests' blocks, but each row's view only
+    ever touches its own table.
+    """
+    from skypilot_tpu.serve import kv_pool as kv_pool_lib
+
+    gidx = kv_pool_lib.read_indices(block_tables, block_size)
+    kd = paged_gather(k_pool, gidx)              # [B, S_pad, Hkv, hd]
+    vd = paged_gather(v_pool, gidx)
+    if k_scale is not None:
+        dtype = q.dtype
+        kd = kd.astype(dtype) * paged_gather(
+            k_scale, gidx)[..., None].astype(dtype)
+        vd = vd.astype(dtype) * paged_gather(
+            v_scale, gidx)[..., None].astype(dtype)
+    return decode_attention(q, kd, vd, lengths, scale)
 
 
 # ---------------------------------------------------------------------

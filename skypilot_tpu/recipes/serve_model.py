@@ -39,9 +39,34 @@ def main():
                              'v5e)')
     parser.add_argument('--slots', type=int, default=0,
                         help='enable continuous batching with this '
-                             'many concurrent decode slots (greedy '
+                             'many concurrent decode rows (greedy '
                              'requests share one batch; sampling '
                              'requests fall back to the serial path)')
+    # Engine knobs default from the SKYTPU_ENGINE_* env stamps the
+    # replica manager injects from the service YAML's `engine:`
+    # section (SkyServiceSpec.engine_env) — explicit flags win.
+    parser.add_argument('--block-size', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_BLOCK_SIZE', '16')),
+                        help='paged-KV block granularity in tokens '
+                             '(service YAML: engine.block_size)')
+    parser.add_argument('--num-blocks', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_NUM_BLOCKS', '0')),
+                        help='KV pool size in blocks; 0 sizes the '
+                             'pool so every row reaches max_seq (no '
+                             'preemption). Smaller oversubscribes: '
+                             'admission bounds by actual usage and '
+                             'the engine preempts-and-requeues on '
+                             'exhaustion (engine.num_blocks)')
+    parser.add_argument('--max-batched-tokens', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_MAX_BATCHED_TOKENS',
+                            '2048')),
+                        help='per-iteration prefill token budget — '
+                             'bounds how much prompt work runs '
+                             'between decode dispatches '
+                             '(engine.max_num_batched_tokens)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -141,8 +166,11 @@ def main():
     engine = None
     if args.slots > 0:
         from skypilot_tpu.serve.batching import BatchingEngine
-        engine = BatchingEngine(params, config, slots=args.slots,
-                                kv_int8=args.kv_int8)
+        engine = BatchingEngine(
+            params, config, slots=args.slots, kv_int8=args.kv_int8,
+            block_size=args.block_size,
+            num_blocks=args.num_blocks or None,
+            max_num_batched_tokens=args.max_batched_tokens)
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the

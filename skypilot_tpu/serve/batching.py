@@ -1,36 +1,51 @@
-"""Continuous batching for serving (iteration-level scheduling).
+"""Continuous batching for serving (iteration-level scheduling) over
+a PAGED KV cache.
 
 The reference delegates serving to engines like vLLM/JetStream whose
-core trick is exactly this: concurrent requests share ONE decode
-batch, new requests are admitted into free slots between decode
-iterations, finished ones retire immediately — so throughput scales
-with batch size while each request sees near-single-stream latency.
-``recipes/serve_model`` without this serializes requests behind a
-lock.
+core tricks are exactly these: concurrent requests share ONE decode
+batch (new requests admitted between decode iterations, finished ones
+retired immediately), and KV storage is a pool of fixed-size blocks
+mapped per-request through block tables (PagedAttention) — so
+admission is bounded by a TOKEN budget (free blocks), not by whole
+free slots, and short requests never reserve long-request HBM.
 
 TPU-first design:
-- All shapes static: the engine owns a [L, B, S, Hkv, hd] KV cache
-  with B fixed "slots" and PER-ROW write positions; decode is one
-  jitted step for every batch composition (slot occupancy is data,
-  not shape).
+- All shapes static: the engine owns a block pool
+  ``[L, num_blocks, block_size, Hkv, hd]`` (serve/kv_pool.py) plus
+  per-request block-table rows ``[B, max_blocks]``; decode is one
+  jitted step for every batch/occupancy composition (block tables and
+  occupancy are data, not shape).
 - Decode runs ``steps_per_dispatch`` tokens per dispatch as a small
   ``lax.scan`` — admission happens between dispatches; the scan
   amortizes host->device dispatch latency (tens of ms through a
   tunneled device) without giving up iteration-level scheduling.
-- Prefill admits a request by running the PADDED prompt through the
-  plain batch-1 ``forward_cached`` (bucketed lengths bound compile
-  count) and copying its cache rows into the slot. Right-padding is
-  causally safe: junk positions sit ABOVE the slot's write pointer,
-  so they are overwritten by generated tokens before any mask can
-  admit them, and causality keeps them out of the real positions'
-  K/V entirely.
+- Prefill is CHUNKED and writes DIRECTLY into the request's allocated
+  blocks (``models/decode.forward_paged``): long prompts prefill in
+  fixed-size chunks interleaved with decode dispatches, so one 8k
+  prompt cannot stall every in-flight decode (the p99-TTFT lever),
+  and there is no staging cache or row-insert copy on admission.
+- Pool exhaustion PREEMPTS the youngest request (blocks freed, the
+  request requeued at the front; resume re-prefills prompt+generated,
+  which under greedy decoding reproduces the continuation exactly) —
+  never a deadlock, never an engine-wide failure. A request that can
+  never fit the pool fails alone with a typed
+  ``exceptions.KVPoolExhaustedError``.
 - Numerics contract: batched outputs EQUAL single-request greedy
-  decoding (tested token-for-token). MoE caveat: equality holds
-  while expert capacity does not bind — the engine's power-of-two
-  prompt padding enters the capacity denominator
-  (cap = ceil(k*T*cf/E)), so a low ``moe_capacity_factor`` can drop
-  different tokens than an unpadded prefill would.
+  decoding (tested token-for-token, bf16 and int8 KV; the paged
+  gather view is masked so recycled-block garbage contributes exactly
+  0). int8 caveat: equality vs the plain int8 path holds for prompts
+  within ONE prefill chunk — a later chunk attends earlier chunks'
+  int8-round-tripped keys where whole-prompt prefill attends exact
+  bf16 (``forward_paged`` restores only the CURRENT chunk's exact
+  rows), so multi-chunk int8 prompts track rather than equal the
+  dense path; quantization error still never enters within-chunk
+  attention. MoE caveat: equality holds while expert capacity does
+  not bind — the engine's power-of-two chunk padding enters the
+  capacity denominator (cap = ceil(k*T*cf/E)), so a low
+  ``moe_capacity_factor`` can drop different tokens than an unpadded
+  prefill would.
 """
+import collections
 import queue
 import threading
 import time
@@ -39,11 +54,13 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu import exceptions
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models.quant import matmul as _mm
+from skypilot_tpu.serve import kv_pool as kv_pool_lib
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -94,6 +111,10 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
     [B] bool — inactive rows still compute (static shapes) but their
     pos does not advance and their writes keep landing on the same
     parked cell, so they cannot corrupt anything.
+
+    This is the CONTIGUOUS-cache variant (one [S] slab per row) —
+    the engine itself runs ``decode_steps_paged``, its block-table-
+    indirected twin with identical numerics.
 
     Returns (out_tokens [B, num_steps], caches, new_pos).
     """
@@ -227,6 +248,144 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             (k_cache, v_cache, k_scale, v_scale), pos)
 
 
+def decode_steps_paged(params: Params, tokens: jax.Array,
+                       caches, block_tables: jax.Array,
+                       pos: jax.Array, active: jax.Array,
+                       config: llama.LlamaConfig,
+                       num_steps: int, block_size: int):
+    """Block-table-indirected twin of ``decode_steps_rows`` with
+    identical numerics: the per-row [S] slab is replaced by gathers
+    and scatters through ``block_tables`` [B, MB] into the shared
+    pool ``caches`` = (k, v, k_scale, v_scale) with k/v
+    [L, num_blocks, block_size, Hkv, hd] (int8 + bf16 scales
+    [L, num_blocks, block_size, Hkv] when quantized).
+
+    Attention per layer is the gather-based
+    ``ops.decode_attention.paged_decode_attention``: row b's logical
+    view is gathered out of the pool and masked to its own length,
+    so recycled-block garbage past the length contributes exactly 0.
+    Writes go through ``kv_pool.write_index`` — parked rows (inactive
+    lanes) and overrun positions land in the scratch block, never in
+    a block another request owns.
+
+    Returns (out_tokens [B, num_steps], caches, new_pos).
+    """
+    from skypilot_tpu.ops import decode_attention as da
+
+    k_pool, v_pool, k_scale, v_scale = caches
+    nl, nb, bs = k_pool.shape[:3]
+    assert bs == block_size, (bs, block_size)
+    cparams = jax.tree.map(
+        lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
+        params)
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    b = tokens.shape[0]
+    quantized = k_scale is not None  # static at trace
+
+    # Flat [NB * bs, ...] pool views — index math is 1-D flat-slot.
+    kp = k_pool.reshape(nl, nb * bs, nkv, hd)
+    vp = v_pool.reshape(nl, nb * bs, nkv, hd)
+    ksp = k_scale.reshape(nl, nb * bs, nkv) if quantized else None
+    vsp = v_scale.reshape(nl, nb * bs, nkv) if quantized else None
+
+    def one_token(carry, _):
+        tok, kp_all, vp_all, ks_all, vs_all, cur = carry
+        angles = llama._rope_frequencies(config, cur)   # [B, hd/2]
+        x = cparams['embed'][tok][:, None]              # [B, 1, D]
+        if config.scale_embeddings:
+            import math
+            x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
+        widx = kv_pool_lib.write_index(block_tables, cur,
+                                       block_size)      # [B]
+
+        def layer(carry_x, scanned):
+            xc, cur_ = carry_x
+            # None scale leaves pass through lax.scan as empty
+            # pytrees — one unpack serves both cache dtypes.
+            lp, kc, vc, ks, vs = scanned
+            h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
+                                config.norm_offset)
+            q = _mm(h, lp['wq'])
+            k = _mm(h, lp['wk'])
+            v = _mm(h, lp['wv'])
+            if config.qkv_bias:
+                q = q + lp['bq']
+                k = k + lp['bk']
+                v = v + lp['bv']
+            q = q.reshape(b, 1, nh, hd)
+            k = k.reshape(b, 1, nkv, hd)
+            v = v.reshape(b, 1, nkv, hd)
+            q = _rope_rows(q, angles)
+            k = _rope_rows(k, angles)
+            if ks is not None:
+                k_rows, ks_rows = decode._quantize_kv(k)
+                v_rows, vs_rows = decode._quantize_kv(v)
+            else:
+                k_rows, v_rows = k, v
+                ks_rows = vs_rows = None
+            # In-layer write exists ONLY so this step's attention
+            # sees the new row (the caller-visible pool update is the
+            # single merged scatter per token after the layer scan,
+            # same split as decode_steps_rows).
+            kc = kc.at[widx].set(k_rows[:, 0])
+            vc = vc.at[widx].set(v_rows[:, 0])
+            if ks is not None:
+                ks = ks.at[widx].set(ks_rows[:, 0])
+                vs = vs.at[widx].set(vs_rows[:, 0])
+            attn = da.paged_decode_attention(
+                q[:, 0], kc, vc, block_tables, cur_ + 1, hd ** -0.5,
+                block_size, k_scale=ks, v_scale=vs)[:, None]
+            xc = xc + _mm(attn.reshape(b, 1, nh * hd), lp['wo'])
+            h = llama._rms_norm(xc, lp['mlp_norm'], config.norm_eps,
+                                config.norm_offset)
+            if config.n_experts:
+                moe_out, _ = llama._moe_mlp(config, h, lp)
+                xc = xc + moe_out
+            else:
+                gate = llama.mlp_act(config)(
+                    _mm(h, lp['w_gate']).astype(jnp.float32)
+                ).astype(h.dtype)
+                up = _mm(h, lp['w_up'])
+                xc = xc + _mm(gate * up, lp['w_down'])
+            return (xc, cur_), (
+                k_rows[:, 0], v_rows[:, 0],
+                None if ks_rows is None else ks_rows[:, 0],
+                None if vs_rows is None else vs_rows[:, 0])
+
+        (x, _), rows = jax.lax.scan(
+            layer, (x, cur),
+            (cparams['layers'], kp_all, vp_all, ks_all, vs_all))
+        # Persist the new rows: one merged scatter per token into the
+        # carried (donated) flat pools.
+        kp_all = kp_all.at[:, widx].set(rows[0])
+        vp_all = vp_all.at[:, widx].set(rows[1])
+        if quantized:
+            ks_all = ks_all.at[:, widx].set(rows[2])
+            vs_all = vs_all.at[:, widx].set(rows[3])
+        x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
+                            config.norm_offset)
+        if config.tie_embeddings:
+            logits = (x @ llama.output_head(cparams, config))
+        else:
+            logits = _mm(x, cparams['lm_head'])
+        nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        # Inactive rows: hold the last token and do NOT advance, so
+        # their next (scratch-redirected) write stays parked.
+        nxt = jnp.where(active, nxt, tok)
+        new_cur = jnp.where(active, cur + 1, cur)
+        return (nxt, kp_all, vp_all, ks_all, vs_all, new_cur), nxt
+
+    (tok, kp, vp, ksp, vsp, pos), toks = jax.lax.scan(
+        one_token, (tokens, kp, vp, ksp, vsp, pos), None,
+        length=num_steps)
+    out_caches = (
+        kp.reshape(nl, nb, bs, nkv, hd),
+        vp.reshape(nl, nb, bs, nkv, hd),
+        ksp.reshape(nl, nb, bs, nkv) if quantized else None,
+        vsp.reshape(nl, nb, bs, nkv) if quantized else None)
+    return toks.swapaxes(0, 1), out_caches, pos
+
+
 # ---------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------
@@ -240,6 +399,13 @@ class _Request:
         self.eos_id = eos_id
         self.out: 'queue.Queue' = queue.Queue()
         self.submitted_at = time.time()
+        # Tokens already EMITTED to the client — preemption resume
+        # state: a requeued request re-prefills prompt + generated
+        # (greedy decoding reproduces the continuation exactly) and
+        # keeps emitting from where it left off.
+        self.generated: List[int] = []
+        self.admitted_once = False
+        self.preemptions = 0
         # Trace context captured at submit (the engine loop runs on
         # its own thread — contextvars don't cross it): queue-wait /
         # prefill / TTFT / decode-chunk spans are emitted under the
@@ -255,7 +421,7 @@ def _engine_metrics():
     return {
         'queue_wait': reg.histogram(
             'skytpu_batch_queue_wait_seconds',
-            'submit() to slot admission (prefill start).'),
+            'submit() to admission (first prefill chunk).'),
         'ttft': reg.histogram(
             'skytpu_batch_ttft_seconds',
             'submit() to first generated token.'),
@@ -271,119 +437,157 @@ def _engine_metrics():
             '(active rows * steps / wall time).'),
         'occupancy': reg.gauge(
             'skytpu_batch_slots_occupied',
-            'Decode slots currently holding a request.'),
+            'Decode rows currently holding a request.'),
         'slots': reg.gauge(
             'skytpu_batch_slots_total',
-            'Fixed decode slot count of the engine.'),
+            'Fixed decode row count of the engine.'),
         'kv_bytes': reg.gauge(
             'skytpu_batch_kv_cache_bytes',
-            'Resident KV-cache allocation of the engine (codes + '
-            'scales) — the HBM the slots pin whether or not they '
-            'hold requests.'),
+            'Resident KV block-pool allocation of the engine '
+            '(codes + scales) — the HBM the pool pins whether or '
+            'not its blocks are allocated.'),
         'kv_used': reg.gauge(
             'skytpu_batch_kv_cache_used_bytes',
-            'KV-cache bytes logically written by admitted requests '
-            '(occupied slots x their row positions) — the '
-            'fragmentation gap to skytpu_batch_kv_cache_bytes is '
-            'what the paged-KV roadmap item reclaims.'),
+            'Bytes of KV blocks currently allocated to admitted '
+            'requests — real block accounting (allocated blocks x '
+            'bytes/block), not a slot-occupancy estimate.'),
+        'kv_blocks_total': reg.gauge(
+            'skytpu_batch_kv_blocks_total',
+            'Allocatable KV blocks in the pool (excludes the '
+            'reserved scratch block).'),
+        'kv_blocks_used': reg.gauge(
+            'skytpu_batch_kv_blocks_used',
+            'KV blocks currently allocated to admitted requests.'),
+        'preemptions': reg.counter(
+            'skytpu_batch_preemptions_total',
+            'Requests preempted (blocks reclaimed, request '
+            'requeued) because the KV pool ran out of free blocks.'),
     }
 
 
 class BatchingEngine:
-    """Fixed-slot continuous batching around ``decode_steps_rows``.
+    """Paged-KV continuous batching around ``decode_steps_paged``.
 
     ``submit()`` returns a Queue yielding generated token ids (ints)
-    then ``None``. A background thread admits pending requests into
-    free slots (bucketed batch-1 prefill), steps the whole batch
-    ``steps_per_dispatch`` tokens per dispatch, and retires rows the
-    moment they hit their budget.
+    then ``None`` (a typed exception object precedes the ``None`` if
+    the request failed). A background thread admits pending requests
+    into free decode rows when the block pool has room, runs chunked
+    prefill interleaved with whole-batch decode dispatches
+    (``steps_per_dispatch`` tokens each), retires rows the moment
+    they hit their budget (freeing their blocks), and
+    preempts-and-requeues the youngest request when the pool runs
+    dry.
+
+    Knobs (service YAML ``service: engine:`` maps onto these):
+    - ``slots``: decode batch width (concurrent requests).
+    - ``block_size``: KV block granularity in tokens.
+    - ``num_blocks``: pool size; default sizes the pool so every row
+      can reach ``max_seq`` (no preemption unless oversubscribed).
+    - ``max_num_batched_tokens``: per-scheduler-iteration prefill
+      token budget — bounds how much prompt work can run between two
+      decode dispatches (the chunked-prefill interleaving lever).
+    - ``prefill_chunk``: max tokens per prefill dispatch.
     """
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
                  slots: int = 8, max_seq: Optional[int] = None,
                  steps_per_dispatch: int = 8,
-                 kv_int8: bool = False):
+                 kv_int8: bool = False,
+                 block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 max_num_batched_tokens: Optional[int] = 2048,
+                 prefill_chunk: int = 512):
         self.params = params
         self.config = config
         self.slots = slots
         self.max_seq = max_seq or config.max_seq_len
         from skypilot_tpu.ops import decode_attention as da
         if da._use_pallas():  # pylint: disable=protected-access
-            # Round the cache up to the decode kernel's chunk size so
-            # the length-aware attention path engages (the padding is
-            # never read: reads scale with row lengths).
+            # Round the per-request view up to the decode kernel's
+            # chunk size so the length-aware attention path engages
+            # on the gathered [B, MB * block_size] view (the padding
+            # is never read: reads scale with row lengths).
             blk = da._BLOCK_S  # pylint: disable=protected-access
             requested = self.max_seq
             self.max_seq = max(2 * blk,
                                -(-self.max_seq // blk) * blk)
             if self.max_seq != requested:
-                # The rounding multiplies every slot's resident KV
-                # HBM (L*slots*S rows); an engine sized to exactly
-                # fit at the requested max_seq can OOM purely from
-                # flipping SKYTPU_PALLAS_DECODE — make the change
-                # visible to operators sizing --slots against HBM.
                 logger.warning(
                     'SKYTPU_PALLAS_DECODE: max_seq %d rounded up to '
-                    '%d (decode-kernel chunk %d); KV cache grows '
-                    '%.0f%% — resize --slots if HBM is tight.',
-                    requested, self.max_seq, blk,
-                    100.0 * (self.max_seq / requested - 1.0))
+                    '%d (decode-kernel chunk %d); block tables grow '
+                    'accordingly — resize --slots/num_blocks if HBM '
+                    'is tight.', requested, self.max_seq, blk)
+        # max_seq must be block-aligned (the table maps whole
+        # blocks) — AND keep any Pallas rounding above intact: align
+        # to lcm(block_size, decode-kernel chunk) or the gathered
+        # [B, MB * block_size] view silently fails the kernel's
+        # divisibility guard and every dispatch falls back to the
+        # dense reference the operator opted out of.
+        align = block_size
+        if da._use_pallas():  # pylint: disable=protected-access
+            import math
+            blk = da._BLOCK_S  # pylint: disable=protected-access
+            align = block_size * blk // math.gcd(block_size, blk)
+        self.max_seq = -(-self.max_seq // align) * align
+        self.block_size = block_size
+        self.max_blocks_per_req = self.max_seq // block_size
+        if num_blocks is None:
+            # Default: capacity for every row to reach max_seq — the
+            # no-preemption regime matching the old fixed slabs (+1
+            # for the reserved scratch block). Oversubscribe by
+            # passing a smaller num_blocks: admission then bounds by
+            # actual usage and preemption handles the tail.
+            num_blocks = slots * self.max_blocks_per_req + 1
         self.steps = steps_per_dispatch
         self.kv_int8 = kv_int8
-        shape = (config.n_layers, slots, self.max_seq,
-                 config.n_kv_heads, config.head_dim)
-        if kv_int8:
-            self.caches = (jnp.zeros(shape, jnp.int8),
-                           jnp.zeros(shape, jnp.int8),
-                           jnp.zeros(shape[:-1], jnp.bfloat16),
-                           jnp.zeros(shape[:-1], jnp.bfloat16))
-        else:
-            self.caches = (jnp.zeros(shape, config.dtype),
-                           jnp.zeros(shape, config.dtype), None,
-                           None)
+        self.prefill_chunk = max(1, prefill_chunk)
+        self.max_batched_tokens = max_num_batched_tokens
+        self.pool = kv_pool_lib.KVBlockPool(config, num_blocks,
+                                            block_size,
+                                            kv_int8=kv_int8)
+        # The engine owns the device arrays (they are donated through
+        # every jitted step); the pool keeps only the allocator.
+        self.caches = self.pool.caches
+        self.pool.caches = None
+        self.block_tables = jnp.zeros(
+            (slots, self.max_blocks_per_req), jnp.int32)
         self.pos = jnp.zeros((slots,), jnp.int32)
         self.tokens = jnp.zeros((slots,), jnp.int32)
-        # Host-side slot bookkeeping.
+        # Host-side per-row bookkeeping.
         self.slot_req: List[Optional[_Request]] = [None] * slots
         self.slot_left = [0] * slots
-        self.pending: 'queue.Queue[_Request]' = queue.Queue()
+        self.slot_len = [0] * slots          # written prompt+generated
+        self.slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        self.slot_off = [0] * slots          # prompt tokens prefilled
+        self.slot_total = [0] * slots        # prompt length this pass
+        self.slot_seq = [0] * slots          # admission order
+        self._admit_seq = 0
+        self._prefill_t0: List[Optional[float]] = [None] * slots
+        self._prefill_chunks = [0] * slots
+        self.pending: 'collections.deque[_Request]' = \
+            collections.deque()
+        self._pending_lock = threading.Lock()
+        # Scheduler event log (bounded) — the chunked-prefill
+        # interleaving contract is asserted against this in tests.
+        self.events: 'collections.deque' = collections.deque(
+            maxlen=4096)
         self.wake = threading.Event()
         self._stop = False
-        self._step_fn = jax.jit(decode_steps_rows,
-                                static_argnums=(5, 6),
+        self._step_fn = jax.jit(decode_steps_paged,
+                                static_argnums=(6, 7, 8),
                                 donate_argnums=(2,))
-        self._prefill = jax.jit(decode.forward_cached,
-                                static_argnums=(3, 4, 5),
-                                donate_argnums=(2,))
-        self._insert = jax.jit(self._insert_impl,
-                               donate_argnums=(0,))
+        self._prefill_fn = jax.jit(decode.forward_paged,
+                                   static_argnums=(6, 7),
+                                   donate_argnums=(2,))
         self._metrics = _engine_metrics()
         self._metrics['slots'].set(slots)
-        self._cache_bytes = sum(
-            int(c.nbytes) for c in self.caches if c is not None)
-        self._bytes_per_row = self._cache_bytes / (slots *
-                                                   self.max_seq)
+        self._cache_bytes = self.pool.nbytes
         self._metrics['kv_bytes'].set(self._cache_bytes)
-        # Host-side written-length per slot (prompt + generated) for
-        # the used-bytes gauge — mirrors the device-side pos without
-        # a device_get in the hot loop.
-        self.slot_len = [0] * slots
+        self._metrics['kv_blocks_total'].set(self.pool.usable_blocks)
         from skypilot_tpu.utils import profiling as profiling_lib
         self._profiler = profiling_lib.StepProfiler('decode')
         self.thread = threading.Thread(target=self._loop, daemon=True)
         self.thread.start()
-
-    @staticmethod
-    def _insert_impl(caches, row, new):
-        """Copy a freshly prefilled request's cache (decode.KVCache,
-        batch 1) into slot ``row`` — codes AND scales when int8."""
-        kc, vc, ks, vs = caches
-        kc = kc.at[:, row].set(new.k[:, 0])
-        vc = vc.at[:, row].set(new.v[:, 0])
-        if ks is not None:
-            ks = ks.at[:, row].set(new.k_scale[:, 0])
-            vs = vs.at[:, row].set(new.v_scale[:, 0])
-        return kc, vc, ks, vs
 
     # -- client API -----------------------------------------------------
 
@@ -391,7 +595,9 @@ class BatchingEngine:
                eos_id: Optional[int] = None) -> 'queue.Queue':
         """Returns a Queue yielding generated ids then None. With
         ``eos_id``, the row retires the moment it emits that id
-        (the EOS itself is emitted, matching greedy_generate)."""
+        (the EOS itself is emitted, matching greedy_generate). A
+        request the pool can never hold yields a typed
+        ``KVPoolExhaustedError`` before its None."""
         max_new = min(max_new,
                       self.max_seq - len(prompt_ids) - 1)
         req = _Request(list(prompt_ids), max(0, max_new),
@@ -399,25 +605,42 @@ class BatchingEngine:
         if req.max_new == 0 or self._stop:
             req.out.put(None)
             return req.out
-        self.pending.put(req)
+        if self.pool.blocks_for(len(prompt_ids) + 1) > \
+                self.pool.usable_blocks:
+            # This prompt alone exceeds the whole pool: fail THIS
+            # request, typed, immediately — transient exhaustion is
+            # handled by preempt-and-requeue instead.
+            self._fail_request(
+                req, f'prompt of {len(prompt_ids)} tokens needs '
+                f'{self.pool.blocks_for(len(prompt_ids) + 1)} KV '
+                f'blocks but the pool has only '
+                f'{self.pool.usable_blocks} usable '
+                f'(block_size={self.block_size})')
+            return req.out
+        with self._pending_lock:
+            self.pending.append(req)
         self.wake.set()
         # close() may have stopped the loop between the _stop check
-        # above and the put — the exited loop will never drain this
-        # request, so sentinel it here (a double None from racing
-        # _drain_all is harmless: consumers stop at the first).
+        # above and the append — the exited loop will never drain
+        # this request, so sentinel it here (a double None from
+        # racing _drain_all is harmless: consumers stop at the
+        # first).
         if self._stop:
             req.out.put(None)
         return req.out
 
     def generate(self, prompt_ids: List[int], max_new: int,
                  eos_id: Optional[int] = None) -> List[int]:
-        """Blocking convenience: collect the full generation."""
+        """Blocking convenience: collect the full generation. Raises
+        the typed error if the request failed."""
         q = self.submit(prompt_ids, max_new, eos_id=eos_id)
         out: List[int] = []
         while True:
             tok = q.get()
             if tok is None:
                 return out
+            if isinstance(tok, BaseException):
+                raise tok
             out.append(tok)
 
     def close(self):
@@ -425,67 +648,360 @@ class BatchingEngine:
         self.wake.set()
         self.thread.join(timeout=10)
 
+    # -- scheduling helpers ---------------------------------------------
+
+    def _pop_pending(self) -> Optional[_Request]:
+        with self._pending_lock:
+            try:
+                return self.pending.popleft()
+            except IndexError:
+                return None
+
+    def _push_front(self, req: _Request) -> None:
+        with self._pending_lock:
+            self.pending.appendleft(req)
+
+    def _fail_request(self, req: _Request, msg: str) -> None:
+        """Typed per-request failure: the REQUEST fails; every other
+        in-flight request keeps decoding (never ``_fail_all``)."""
+        logger.warning('Batching engine failing request: %s', msg)
+        req.out.put(exceptions.KVPoolExhaustedError(msg))
+        req.out.put(None)
+
+    def _set_table_row(self, row: int) -> None:
+        blocks = self.slot_blocks[row]
+        padded = blocks + [kv_pool_lib.SCRATCH_BLOCK] * (
+            self.max_blocks_per_req - len(blocks))
+        self.block_tables = self.block_tables.at[row].set(
+            jnp.asarray(padded, jnp.int32))
+
+    def _release_row(self, row: int) -> None:
+        if self.slot_blocks[row]:
+            self.pool.free(self.slot_blocks[row])
+        self.slot_blocks[row] = []
+        self.slot_req[row] = None
+        self.slot_left[row] = 0
+        self._set_table_row(row)  # stale entries must not alias
+        #                           blocks recycled to other rows
+
+    def _retire(self, row: int) -> None:
+        self._release_row(row)
+
+    def _preempt(self, row: int) -> None:
+        """Reclaim the row's blocks and requeue its request at the
+        FRONT of the pending queue (it keeps its original submit
+        time, so it ages toward never-preempted oldest)."""
+        req = self.slot_req[row]
+        assert req is not None
+        req.preemptions += 1
+        self._metrics['preemptions'].inc()
+        self.events.append(('preempt', row, len(req.generated)))
+        logger.info(
+            'KV pool exhausted: preempting request in row %d '
+            '(%d blocks reclaimed, %d tokens generated so far; '
+            'resume recomputes from prompt+generated).',
+            row, len(self.slot_blocks[row]), len(req.generated))
+        self._release_row(row)
+        self._push_front(req)
+
+    def _pick_victim(self) -> Optional[int]:
+        """The YOUNGEST admitted row (latest original submit time;
+        admission order breaks ties). The oldest request is thereby
+        never preempted while any younger one exists — preempted
+        requests keep their submit time, so they age into that
+        protection and cannot starve."""
+        rows = [i for i in range(self.slots)
+                if self.slot_req[i] is not None]
+        if len(rows) <= 1:
+            return None
+        return max(rows, key=lambda i: (
+            self.slot_req[i].submitted_at, self.slot_seq[i]))
+
+    def _ensure_blocks(self, row: int, target_tokens: int) -> bool:
+        """Grow the row's allocation to cover ``target_tokens``
+        positions, preempting the youngest request on exhaustion.
+        Returns False if the row itself was preempted or failed."""
+        need = self.pool.blocks_for(target_tokens)
+        extra = need - len(self.slot_blocks[row])
+        if extra <= 0:
+            return True
+        while True:
+            got = self.pool.try_alloc(extra)
+            if got is not None:
+                self.slot_blocks[row].extend(got)
+                self._set_table_row(row)
+                return True
+            victim = self._pick_victim()
+            if victim is None:
+                # This row is the only admitted request and still
+                # cannot grow: the pool can never satisfy it.
+                req = self.slot_req[row]
+                self._release_row(row)
+                self._fail_request(
+                    req, f'request needs {need} KV blocks but the '
+                    f'pool has only {self.pool.usable_blocks} '
+                    f'usable (block_size={self.block_size})')
+                return False
+            self._preempt(victim)
+            if victim == row:
+                return False
+
     # -- engine loop ----------------------------------------------------
 
-    def _admit(self, req: _Request, row: int) -> None:
-        # One clock read for the metric observation AND the span end
-        # — the histogram and the trace must tell the same story.
-        t_admit = time.time()
-        self._metrics['queue_wait'].observe(
-            t_admit - req.submitted_at)
-        trace_lib.record_span('batch.queue_wait', req.submitted_at,
-                              t_admit, req.trace_ctx,
-                              attrs={'slot': row})
-        self._metrics['requests'].inc()
-        t0 = len(req.prompt_ids)
+    def _admit_pending(self) -> None:
+        """Token-budget admission: a request is admitted when a
+        decode row is free AND the pool has blocks for its whole
+        prompt (+1 for the first generated token) — free blocks, not
+        free slots, are the admission currency."""
+        for row in range(self.slots):
+            if self._stop:
+                return
+            if self.slot_req[row] is not None:
+                continue
+            req = self._pop_pending()
+            if req is None:
+                return
+            t0 = len(req.prompt_ids) + len(req.generated)
+            need = self.pool.blocks_for(t0 + 1)
+            if need > self.pool.usable_blocks:
+                # Can never fit (a preempted request that grew past a
+                # small pool): typed per-request failure.
+                self._fail_request(
+                    req, f'request of {t0} tokens needs {need} KV '
+                    f'blocks but the pool has only '
+                    f'{self.pool.usable_blocks} usable')
+                continue
+            blocks = self.pool.try_alloc(need)
+            if blocks is None:
+                # Not enough free blocks yet: wait for retirements
+                # (in-flight rows make progress every iteration, so
+                # this cannot deadlock).
+                self._push_front(req)
+                return
+            if not req.admitted_once:
+                # First admission only: a preempted request's
+                # re-admission delay is service disruption, not
+                # queueing — re-observing from the original submit
+                # time would count its own prefill/decode service as
+                # queue wait and poison the p99.
+                t_admit = time.time()
+                self._metrics['queue_wait'].observe(
+                    t_admit - req.submitted_at)
+                trace_lib.record_span('batch.queue_wait',
+                                      req.submitted_at, t_admit,
+                                      req.trace_ctx,
+                                      attrs={'slot': row})
+                req.admitted_once = True
+                self._metrics['requests'].inc()
+            self.slot_req[row] = req
+            self.slot_blocks[row] = blocks
+            self.slot_off[row] = 0
+            self.slot_total[row] = t0
+            self.slot_left[row] = 0
+            self.slot_len[row] = 0
+            self._prefill_t0[row] = None
+            self._prefill_chunks[row] = 0
+            self._admit_seq += 1
+            self.slot_seq[row] = self._admit_seq
+            self._set_table_row(row)
+            # Park the lane OUT OF RANGE until prefill finishes:
+            # decode dispatches treat the row as inactive but still
+            # write (static shapes), and write_index redirects
+            # past-capacity positions to the scratch block. Parking
+            # INSIDE the row's range would aim the parked write at
+            # table[0] — a real allocated block whose position 0 the
+            # first prefill chunk has already filled.
+            self.pos = self.pos.at[row].set(self.max_seq)
+
+    def _chunk_bucket(self, remaining: int) -> int:
+        """Static chunk length for a prefill dispatch: the smallest
+        power of two >= the real chunk, capped at ``prefill_chunk``
+        — compile count stays O(log prefill_chunk)."""
+        real = min(remaining, self.prefill_chunk)
         bucket = 1
-        while bucket < t0:
+        while bucket < real:
             bucket *= 2
-        bucket = min(bucket, self.max_seq - 1)
-        padded = req.prompt_ids + [0] * (bucket - t0)
-        prompt = jnp.asarray([padded], jnp.int32)
-        cache = decode.init_cache(self.config, 1,
-                                  max_seq=self.max_seq,
-                                  kv_int8=self.kv_int8)
-        # Exact-bucket prompts project only the last position through
-        # the LM head; padded ones need the full logits because the
-        # real last token sits at t0-1, not at the padded end (a
-        # [1, T, 128k-vocab] f32 materialization — the admission cost
-        # of a non-power-of-two prompt). Right-padding is causally
-        # safe — see module docstring.
-        last_only = (bucket == t0)
-        t_prefill = time.time()
-        logits, cache = self._prefill(self.params, prompt, cache,
-                                      self.config, last_only, True)
-        first = int(logits[0, -1 if last_only else t0 - 1].argmax(-1))
-        self.caches = self._insert(self.caches, row, cache)
-        self.pos = self.pos.at[row].set(t0)
-        self.tokens = self.tokens.at[row].set(first)
-        self.slot_req[row] = req
-        self.slot_left[row] = req.max_new - 1
-        self.slot_len[row] = t0
-        # The first token is produced by the prefill itself. The TTFT
-        # observation and the batch.first_token span end on the SAME
-        # clock read; batch.prefill covers prefill dispatch → slot
-        # insert (the int() above synchronizes, so this is real wall
-        # time).
+        return min(bucket, self.prefill_chunk)
+
+    def _run_prefill_chunks(self) -> bool:
+        """Run prefill chunks for admitted-but-unprefilled rows, in
+        admission order, within this iteration's token budget.
+        Chunks beyond the budget wait for the NEXT iteration — a
+        decode dispatch runs in between, which is exactly the
+        chunked-prefill interleaving contract."""
+        budget = self.max_batched_tokens or float('inf')
+        progressed = False
+        rows = sorted(
+            (i for i in range(self.slots)
+             if self.slot_req[i] is not None
+             and self.slot_off[i] < self.slot_total[i]),
+            key=lambda i: self.slot_seq[i])
+        for row in rows:
+            req = self.slot_req[row]
+            prompt = req.prompt_ids + req.generated
+            t0 = self.slot_total[row]
+            while budget > 0 and self.slot_off[row] < t0 and \
+                    not self._stop:
+                off = self.slot_off[row]
+                bucket = self._chunk_bucket(t0 - off)
+                real = min(t0 - off, bucket)
+                if self._prefill_t0[row] is None:
+                    self._prefill_t0[row] = time.time()
+                padded = prompt[off:off + real] + [0] * (bucket - real)
+                chunk_tokens = jnp.asarray([padded], jnp.int32)
+                logits, self.caches = self._prefill_fn(
+                    self.params, chunk_tokens, self.caches,
+                    self.block_tables[row],
+                    jnp.asarray(off, jnp.int32),
+                    jnp.asarray(real, jnp.int32),
+                    self.config, self.block_size)
+                self.slot_off[row] = off + real
+                self._prefill_chunks[row] += 1
+                budget -= bucket
+                progressed = True
+                self.events.append(
+                    ('prefill_chunk', row, off + real, t0))
+                if self.slot_off[row] >= t0:
+                    self._finish_prefill(row, logits)
+            if budget <= 0:
+                break
+        return progressed
+
+    def _finish_prefill(self, row: int, logits: jax.Array) -> None:
+        """Last prompt chunk done: its logits seed greedy decoding —
+        the first generated token comes from the prefill itself."""
+        req = self.slot_req[row]
+        t0 = self.slot_total[row]
+        first = int(jax.device_get(logits)[0].argmax())
+        # The int() above synchronizes, so these are real wall times.
         t_first = time.time()
-        trace_lib.record_span('batch.prefill', t_prefill, t_first,
+        resumed = bool(req.generated)
+        trace_lib.record_span('batch.prefill',
+                              self._prefill_t0[row], t_first,
                               req.trace_ctx,
                               attrs={'prompt_len': t0,
-                                     'bucket': bucket})
-        trace_lib.record_span('batch.first_token', req.submitted_at,
-                              t_first, req.trace_ctx)
-        self._metrics['ttft'].observe(t_first - req.submitted_at)
+                                     'chunks':
+                                         self._prefill_chunks[row]})
+        if not resumed:
+            trace_lib.record_span('batch.first_token',
+                                  req.submitted_at, t_first,
+                                  req.trace_ctx)
+            self._metrics['ttft'].observe(t_first - req.submitted_at)
+        self.pos = self.pos.at[row].set(t0)
+        self.tokens = self.tokens.at[row].set(first)
+        self.slot_len[row] = t0
         self._metrics['tokens'].inc()
         req.out.put(first)
+        req.generated.append(first)
+        self.slot_left[row] = req.max_new - len(req.generated)
         if self.slot_left[row] <= 0 or first == req.eos_id:
             req.out.put(None)
-            self.slot_req[row] = None
+            self._retire(row)
+
+    def _dispatch_decode(self) -> bool:
+        """One whole-batch decode dispatch over every row whose
+        prefill is complete."""
+        def decode_rows():
+            return [i for i in range(self.slots)
+                    if self.slot_req[i] is not None
+                    and self.slot_off[i] >= self.slot_total[i]]
+
+        n = self.steps
+        # Grow allocations for this dispatch's writes up front;
+        # exhaustion preempts the youngest request (possibly a row in
+        # this very list, which then simply sits the dispatch out).
+        for i in decode_rows():
+            if self.slot_req[i] is None:
+                # Preempted by an earlier row's growth in this very
+                # loop — it sits the dispatch out.
+                continue
+            emit = min(self.slot_left[i], n)
+            self._ensure_blocks(
+                i, min(self.slot_len[i] + emit, self.max_seq))
+        active_rows = decode_rows()
+        if not active_rows:
+            return False
+        # On-demand profiling hook: one "step" per decode dispatch
+        # (docs/observability.md, On-demand profiling).
+        self._profiler.on_step()
+        # Fixed dispatch length: a data-dependent n would compile one
+        # executable per distinct remaining-count (observed as
+        # multi-second stalls in the tail of a request wave). Rows
+        # that finish mid-dispatch just overrun harmlessly — their
+        # extra tokens are never emitted and their overrun writes are
+        # redirected to unallocated-table/scratch slots.
+        active = jnp.asarray(
+            [self.slot_req[i] is not None
+             and self.slot_off[i] >= self.slot_total[i]
+             and self.slot_left[i] > 0
+             for i in range(self.slots)], bool)
+        t_dispatch = time.perf_counter()
+        toks, self.caches, self.pos = self._step_fn(
+            self.params, self.tokens, self.caches,
+            self.block_tables, self.pos, active, self.config, n,
+            self.block_size)
+        self.tokens = toks[:, -1]
+        for i in active_rows:
+            if self.slot_left[i] > 0:
+                self.slot_len[i] = min(self.slot_len[i] + n,
+                                       self.max_seq)
+        host_toks = jax.device_get(toks)
+        dispatch_s = time.perf_counter() - t_dispatch
+        if dispatch_s > 0:
+            # device_get synchronizes, so this is real decode wall
+            # time for len(active_rows) * n tokens.
+            self._metrics['tok_s'].set(
+                len(active_rows) * n / dispatch_s)
+        self.events.append(('decode', len(active_rows)))
+        # Per-chunk decode spans: one `batch.decode` per traced
+        # request per dispatch, all sharing the dispatch's wall
+        # window — a request's TTFT decomposes as queue_wait +
+        # prefill + its decode chunks in the waterfall.
+        t_chunk_end = time.time()
+        t_chunk_start = t_chunk_end - dispatch_s
+        emitted = 0
+        for i in active_rows:
+            req = self.slot_req[i]
+            emit = min(self.slot_left[i], n)
+            done = False
+            row_emitted = 0
+            for t in host_toks[i][:emit]:
+                req.out.put(int(t))
+                req.generated.append(int(t))
+                emitted += 1
+                row_emitted += 1
+                self.slot_left[i] -= 1
+                if int(t) == req.eos_id:
+                    # EOS retires the row NOW; anything the device
+                    # computed past it in this dispatch is discarded
+                    # (the row's blocks are freed and its table row
+                    # cleared at retirement).
+                    done = True
+                    break
+            if row_emitted:
+                trace_lib.record_span(
+                    'batch.decode', t_chunk_start, t_chunk_end,
+                    req.trace_ctx,
+                    attrs={'tokens': row_emitted, 'slot': i})
+            if done or self.slot_left[i] <= 0:
+                req.out.put(None)
+                self._retire(i)
+        if emitted:
+            self._metrics['tokens'].inc(emitted)
+        return True
+
+    def _set_gauges(self) -> None:
+        self._metrics['occupancy'].set(sum(
+            1 for r in self.slot_req if r is not None))
+        self._metrics['kv_blocks_used'].set(self.pool.used_blocks)
+        self._metrics['kv_used'].set(
+            self.pool.used_blocks * self.pool.block_bytes)
 
     def _fail_all(self, exc: BaseException) -> None:
-        """Fail-stop: unblock every waiter — a silently dead loop
-        thread would hang all current AND future requests forever."""
+        """Fail-stop for ENGINE death (an unexpected loop exception):
+        unblock every waiter — a silently dead loop thread would hang
+        all current AND future requests forever. Pool exhaustion
+        never comes here: it preempts or fails the one request."""
         logger.error('Batching engine died: %r', exc)
         self._drain_all()
 
@@ -498,10 +1014,10 @@ class BatchingEngine:
                 req.out.put(None)
                 self.slot_req[i] = None
         while True:
-            try:
-                self.pending.get_nowait().out.put(None)
-            except queue.Empty:
+            req = self._pop_pending()
+            if req is None:
                 return
+            req.out.put(None)
 
     def _loop(self) -> None:
         try:
@@ -516,84 +1032,10 @@ class BatchingEngine:
 
     def _loop_inner(self) -> None:
         while not self._stop:
-            # Admit as many pending requests as there are free slots.
-            for row in range(self.slots):
-                if self.slot_req[row] is None:
-                    try:
-                        req = self.pending.get_nowait()
-                    except queue.Empty:
-                        break
-                    self._admit(req, row)
-            active_rows = [i for i, r in enumerate(self.slot_req)
-                           if r is not None]
-            self._metrics['occupancy'].set(len(active_rows))
-            self._metrics['kv_used'].set(self._bytes_per_row * sum(
-                self.slot_len[i] for i in active_rows))
-            if not active_rows:
+            self._admit_pending()
+            progressed = self._run_prefill_chunks()
+            ran = self._dispatch_decode()
+            self._set_gauges()
+            if not progressed and not ran:
                 self.wake.wait(timeout=0.5)
                 self.wake.clear()
-                continue
-            # On-demand profiling hook: one "step" per decode
-            # dispatch (docs/observability.md, On-demand profiling).
-            self._profiler.on_step()
-            # Fixed dispatch length: a data-dependent n would compile
-            # one executable per distinct remaining-count (observed as
-            # multi-second stalls in the tail of a request wave).
-            # Rows that finish mid-dispatch just overrun harmlessly —
-            # their extra tokens are never emitted and their cache
-            # writes sit above the slot's logical stream.
-            n = self.steps
-            active = jnp.asarray(
-                [r is not None and self.slot_left[i] > 0
-                 for i, r in enumerate(self.slot_req)], bool)
-            t_dispatch = time.perf_counter()
-            toks, self.caches, self.pos = \
-                self._step_fn(self.params, self.tokens, self.caches,
-                              self.pos, active,
-                              self.config, n)
-            self.tokens = toks[:, -1]
-            for i in active_rows:
-                if self.slot_left[i] > 0:
-                    self.slot_len[i] = min(self.slot_len[i] + n,
-                                           self.max_seq)
-            host_toks = jax.device_get(toks)
-            dispatch_s = time.perf_counter() - t_dispatch
-            if dispatch_s > 0:
-                # device_get synchronizes, so this is real decode
-                # wall time for len(active_rows) * n tokens.
-                self._metrics['tok_s'].set(
-                    len(active_rows) * n / dispatch_s)
-            # Per-chunk decode spans: one `batch.decode` per traced
-            # request per dispatch, all sharing the dispatch's wall
-            # window — a request's TTFT decomposes as queue_wait +
-            # prefill + its decode chunks in the waterfall.
-            t_chunk_end = time.time()
-            t_chunk_start = t_chunk_end - dispatch_s
-            emitted = 0
-            for i in active_rows:
-                req = self.slot_req[i]
-                emit = min(self.slot_left[i], n)
-                done = False
-                row_emitted = 0
-                for t in host_toks[i][:emit]:
-                    req.out.put(int(t))
-                    emitted += 1
-                    row_emitted += 1
-                    self.slot_left[i] -= 1
-                    if int(t) == req.eos_id:
-                        # EOS retires the row NOW; anything the
-                        # device computed past it in this dispatch is
-                        # discarded (the slot is fully rewritten at
-                        # reuse).
-                        done = True
-                        break
-                if row_emitted:
-                    trace_lib.record_span(
-                        'batch.decode', t_chunk_start, t_chunk_end,
-                        req.trace_ctx,
-                        attrs={'tokens': row_emitted, 'slot': i})
-                if done or self.slot_left[i] <= 0:
-                    req.out.put(None)
-                    self.slot_req[i] = None
-            if emitted:
-                self._metrics['tokens'].inc(emitted)

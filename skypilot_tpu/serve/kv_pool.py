@@ -1,0 +1,226 @@
+"""Paged KV-cache block pool for the continuous-batching engine.
+
+The fixed-slot engine pinned a full ``[L, B, max_seq, Hkv, hd]`` KV
+slab per decode slot — a request using 80 of 4608 positions still
+reserved all 4608, and admission was bounded by whole free slabs.
+``skytpu_batch_kv_cache_used_bytes`` documented exactly that
+fragmentation gap. This module is the PagedAttention/vLLM answer,
+TPU-native: KV storage is ONE pool of fixed-size blocks
+
+    k/v:    [L, num_blocks, block_size, Hkv, hd]
+    scales: [L, num_blocks, block_size, Hkv]      (int8 pool only)
+
+and each request holds a host-side list of block ids plus a device
+block-table row that maps its logical positions onto pool slots.
+Admission is then bounded by FREE BLOCKS (a token budget), not free
+slabs: short requests pack tightly, long ones grow block by block,
+and the engine preempts-and-requeues the youngest request instead of
+deadlocking when the pool runs dry.
+
+TPU-first design notes:
+- All shapes static: the pool, the per-request block tables
+  ``[B, max_blocks]`` and the gather/scatter index math below are
+  fixed-shape; occupancy is data.
+- Block 0 is a reserved SCRATCH block, never allocated: parked rows
+  (inactive decode lanes) and padded prefill positions direct their
+  writes there, so stale block-table entries can never corrupt a
+  block that has been recycled to another request.
+- The pool shards exactly like the dense cache did
+  (``decode_shardings``): KV-head axis over 'tp', everything else
+  replicated — blocks are shared across requests, so there is no
+  batch axis to shard. ``pool_shardings`` builds the NamedShardings
+  from the same rules→specs idiom as the training partitioner.
+"""
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.models import llama
+
+logger = tpu_logging.init_logger(__name__)
+
+# The reserved scratch block (see module docstring).
+SCRATCH_BLOCK = 0
+
+
+# ---------------------------------------------------------------------
+# Index math (pure, shape-static; used inside jitted steps)
+# ---------------------------------------------------------------------
+
+
+def read_indices(block_tables: jax.Array,
+                 block_size: int) -> jax.Array:
+    """Flat pool-slot indices for every logical position of every
+    row: block_tables [..., MB] int32 -> [..., MB * block_size].
+    Positions in unallocated tail blocks land in the scratch block —
+    callers mask them via their per-row lengths before softmax."""
+    offs = jnp.arange(block_size, dtype=jnp.int32)
+    flat = (block_tables[..., :, None] * block_size +
+            offs[None, :])
+    return flat.reshape(*block_tables.shape[:-1], -1)
+
+
+def write_index(block_tables: jax.Array, pos: jax.Array,
+                block_size: int) -> jax.Array:
+    """Flat pool-slot index for each row's next write:
+    block_tables [B, MB], pos [B] -> [B]. Positions at or past the
+    table's capacity are redirected to the scratch block (overrun
+    tokens of rows that finished mid-dispatch, parked lanes)."""
+    mb = block_tables.shape[-1]
+    blk = jnp.minimum(pos // block_size, mb - 1)
+    idx = (jnp.take_along_axis(block_tables, blk[:, None],
+                               axis=1)[:, 0] * block_size +
+           pos % block_size)
+    safe = (pos >= 0) & (pos < mb * block_size)
+    return jnp.where(safe, idx, SCRATCH_BLOCK * block_size)
+
+
+def chunk_write_indices(block_row: jax.Array, start: jax.Array,
+                        real_len: jax.Array, chunk: int,
+                        block_size: int) -> jax.Array:
+    """Flat pool-slot indices for a prefill chunk's ``chunk`` rows
+    written at positions [start, start+real_len): block_row [MB].
+    Padded positions (t >= real_len) go to the scratch block."""
+    t = jnp.arange(chunk, dtype=jnp.int32)
+    pos = start + t
+    mb = block_row.shape[0]
+    blk = jnp.minimum(pos // block_size, mb - 1)
+    idx = block_row[blk] * block_size + pos % block_size
+    valid = (t < real_len) & (pos < mb * block_size)
+    return jnp.where(valid, idx, SCRATCH_BLOCK * block_size)
+
+
+# ---------------------------------------------------------------------
+# Pool
+# ---------------------------------------------------------------------
+
+
+class KVBlockPool:
+    """Device KV block pool + host free-list allocator.
+
+    ``caches`` is the engine-facing tuple
+    ``(k, v, k_scale, v_scale)`` with k/v
+    ``[L, num_blocks, block_size, Hkv, hd]`` (int8 codes + bf16
+    scales ``[L, num_blocks, block_size, Hkv]`` when ``kv_int8``;
+    scales are None for a bf16 pool) — the same 4-tuple shape the
+    decode step functions carry, so the pool arrays are donated
+    through jit like the old slabs were.
+    """
+
+    def __init__(self, config: llama.LlamaConfig, num_blocks: int,
+                 block_size: int, kv_int8: bool = False,
+                 shardings=None):
+        if block_size < 1:
+            raise ValueError(f'block_size must be >= 1: {block_size}')
+        if num_blocks < 2:
+            # Block 0 is scratch; a pool with zero usable blocks can
+            # never admit anything.
+            raise ValueError(
+                f'num_blocks must be >= 2 (block 0 is reserved '
+                f'scratch): {num_blocks}')
+        self.config = config
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.kv_int8 = kv_int8
+        shape = (config.n_layers, num_blocks, block_size,
+                 config.n_kv_heads, config.head_dim)
+        if kv_int8:
+            caches = (jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(shape, jnp.int8),
+                      jnp.zeros(shape[:-1], jnp.bfloat16),
+                      jnp.zeros(shape[:-1], jnp.bfloat16))
+        else:
+            caches = (jnp.zeros(shape, config.dtype),
+                      jnp.zeros(shape, config.dtype), None, None)
+        if shardings is not None:
+            caches = tuple(
+                None if c is None else jax.device_put(c, s)
+                for c, s in zip(caches, shardings))
+        self.caches: Optional[Tuple] = caches
+        # Sized at init: the engine takes ownership of (and donates)
+        # the arrays, so live-array introspection is not an option.
+        self._nbytes = sum(int(c.nbytes) for c in caches
+                           if c is not None)
+        # LIFO free list (hot blocks stay cache/HBM-warm) + a
+        # membership set so free()'s double-free check stays O(1) at
+        # production pool sizes; block 0 (scratch) is never handed
+        # out.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._free_set = set(self._free)
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def usable_blocks(self) -> int:
+        """Allocatable blocks (total minus the scratch block)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    @property
+    def block_bytes(self) -> float:
+        """Resident bytes per block (codes + scales)."""
+        return self.nbytes / self.num_blocks
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` positions."""
+        return max(1, -(-tokens // self.block_size))
+
+    # -- allocation ----------------------------------------------------
+
+    def try_alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` blocks, or None (and no change) if fewer are
+        free — the caller decides between waiting and preempting."""
+        if n < 0:
+            raise ValueError(f'negative alloc: {n}')
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
+
+    def alloc(self, n: int) -> List[int]:
+        blocks = self.try_alloc(n)
+        if blocks is None:
+            raise exceptions.KVPoolExhaustedError(
+                f'KV pool exhausted: need {n} blocks, '
+                f'{len(self._free)} free of {self.usable_blocks} '
+                f'usable')
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f'freeing invalid block id {b}')
+            if b in self._free_set:
+                raise ValueError(f'double free of block {b}')
+        self._free.extend(blocks)
+        self._free_set.update(blocks)
+
+
+def pool_shardings(config: llama.LlamaConfig, mesh,
+                   kv_int8: bool = False):
+    """NamedShardings for the pool 4-tuple: KV-head axis over 'tp',
+    blocks replicated (pool blocks are shared across requests — only
+    the head axis has a natural shard dimension, exactly as in
+    ``decode.decode_shardings``)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    kv = NamedSharding(mesh, P(None, None, None, 'tp', None))
+    scale = NamedSharding(mesh, P(None, None, None, 'tp')) \
+        if kv_int8 else None
+    return (kv, kv, scale, scale)

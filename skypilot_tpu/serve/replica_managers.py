@@ -149,13 +149,19 @@ class ReplicaManager:
                         use_spot: Optional[bool] = None) -> None:
         cluster_name = self._cluster_name(replica_id)
         port = self._replica_port(replica_id)
+        # The launching VERSION's spec: a rolling update must not
+        # retro-tune old replicas' engines.
+        spec = self._version_specs.get(version, self.spec)
         task = Task(
             name=f'{self.service_name}-r{replica_id}',
             run=src_task.run,
             setup=src_task.setup,
             envs={**src_task.envs,
                   'SKYTPU_REPLICA_PORT': str(port),
-                  'SKYTPU_REPLICA_ID': str(replica_id)},
+                  'SKYTPU_REPLICA_ID': str(replica_id),
+                  # service: engine: knobs ride the same env contract
+                  # as the port (serve_model reads them as defaults).
+                  **spec.engine_env()},
             workdir=src_task.workdir,
             # A service YAML's mounts (e.g. a checkpoint bucket) must
             # reach every replica (reference: the replica task IS the

@@ -29,6 +29,9 @@ class SkyServiceSpec:
         tls_certfile: Optional[str] = None,
         slo_objective: Optional[float] = None,
         slo_window_seconds: float = 3600.0,
+        engine_block_size: Optional[int] = None,
+        engine_num_blocks: Optional[int] = None,
+        engine_max_num_batched_tokens: Optional[int] = None,
     ):
         if min_replicas < 0:
             raise exceptions.InvalidSpecError('min_replicas must be '
@@ -82,6 +85,28 @@ class SkyServiceSpec:
                 'slo.window_seconds must be > 0')
         self.slo_objective = slo_objective
         self.slo_window_seconds = float(slo_window_seconds)
+        # Paged-KV batching-engine knobs (serve/batching.py): the
+        # ``engine:`` YAML section. block_size is the KV block
+        # granularity in tokens; num_blocks sizes the pool (smaller
+        # than slots*max_seq/block_size oversubscribes — admission
+        # then bounds by actual usage and preemption covers the
+        # tail); max_num_batched_tokens is the per-iteration prefill
+        # token budget (the chunked-prefill interleaving lever).
+        if engine_block_size is not None and engine_block_size < 1:
+            raise exceptions.InvalidSpecError(
+                'engine.block_size must be >= 1')
+        if engine_num_blocks is not None and engine_num_blocks < 2:
+            raise exceptions.InvalidSpecError(
+                'engine.num_blocks must be >= 2 (block 0 is the '
+                'reserved scratch block)')
+        if engine_max_num_batched_tokens is not None and \
+                engine_max_num_batched_tokens < 1:
+            raise exceptions.InvalidSpecError(
+                'engine.max_num_batched_tokens must be >= 1')
+        self.engine_block_size = engine_block_size
+        self.engine_num_blocks = engine_num_blocks
+        self.engine_max_num_batched_tokens = \
+            engine_max_num_batched_tokens
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]
@@ -99,6 +124,7 @@ class SkyServiceSpec:
         port = config.pop('port', 8080)
         tls = dict(config.pop('tls', {}) or {})
         slo = dict(config.pop('slo', {}) or {})
+        engine = dict(config.pop('engine', {}) or {})
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown service fields: {sorted(config)}')
@@ -126,7 +152,28 @@ class SkyServiceSpec:
             tls_certfile=tls.get('certfile'),
             slo_objective=slo.get('objective'),
             slo_window_seconds=slo.get('window_seconds', 3600.0),
+            engine_block_size=engine.get('block_size'),
+            engine_num_blocks=engine.get('num_blocks'),
+            engine_max_num_batched_tokens=engine.get(
+                'max_num_batched_tokens'),
         )
+
+    def engine_env(self) -> Dict[str, str]:
+        """Env stamps carrying the ``engine:`` knobs to replica
+        processes (``replica_managers._launch_replica`` injects them;
+        ``recipes/serve_model`` reads them as its flag defaults) —
+        the same env-contract pattern as SKYTPU_REPLICA_PORT."""
+        env: Dict[str, str] = {}
+        if self.engine_block_size is not None:
+            env['SKYTPU_ENGINE_BLOCK_SIZE'] = \
+                str(self.engine_block_size)
+        if self.engine_num_blocks is not None:
+            env['SKYTPU_ENGINE_NUM_BLOCKS'] = \
+                str(self.engine_num_blocks)
+        if self.engine_max_num_batched_tokens is not None:
+            env['SKYTPU_ENGINE_MAX_BATCHED_TOKENS'] = \
+                str(self.engine_max_num_batched_tokens)
+        return env
 
     def to_yaml_config(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -158,4 +205,14 @@ class SkyServiceSpec:
         if self.slo_objective is not None:
             out['slo'] = {'objective': self.slo_objective,
                           'window_seconds': self.slo_window_seconds}
+        engine = {}
+        if self.engine_block_size is not None:
+            engine['block_size'] = self.engine_block_size
+        if self.engine_num_blocks is not None:
+            engine['num_blocks'] = self.engine_num_blocks
+        if self.engine_max_num_batched_tokens is not None:
+            engine['max_num_batched_tokens'] = \
+                self.engine_max_num_batched_tokens
+        if engine:
+            out['engine'] = engine
         return out

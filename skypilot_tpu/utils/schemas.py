@@ -130,6 +130,28 @@ SERVICE_SCHEMA = {
                 'certfile': {'type': 'string'},
             },
         },
+        'slo': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'objective': {'type': 'number',
+                              'exclusiveMinimum': 0,
+                              'exclusiveMaximum': 1},
+                'window_seconds': {'type': 'number',
+                                   'exclusiveMinimum': 0},
+            },
+        },
+        # Paged-KV batching-engine knobs (serve/batching.py).
+        'engine': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'block_size': {'type': 'integer', 'minimum': 1},
+                'num_blocks': {'type': 'integer', 'minimum': 2},
+                'max_num_batched_tokens': {'type': 'integer',
+                                           'minimum': 1},
+            },
+        },
     },
 }
 

@@ -661,6 +661,9 @@ def two_host_cluster(tmp_path, monkeypatch):
     reg.gauge('skytpu_batch_slots_total', '').set(8)
     reg.gauge('skytpu_batch_kv_cache_bytes', '').set(1 << 30)
     reg.gauge('skytpu_batch_kv_cache_used_bytes', '').set(1 << 29)
+    reg.gauge('skytpu_batch_kv_blocks_used', '').set(5)
+    reg.gauge('skytpu_batch_kv_blocks_total', '').set(16)
+    reg.counter('skytpu_batch_preemptions_total', '').inc(2)
     device_lib.sample_device_memory(
         [FakeDevice(used=2 << 30, limit=16 << 30, peak=3 << 30)],
         registry=reg)
@@ -693,11 +696,15 @@ class TestXskyTop:
         assert 'topfleet' in out
         assert out.count('127.0.0.1') >= 2
         # Column content: HBM, train tok/s, MFU, goodput, serve,
-        # slots/KV, breakers.
+        # block-pool utilization/KV, breakers.
         assert 'HBM' in out and '2.0GiB/16.0GiB' in out
         assert '12345' in out
         assert '42.0%' in out and '90.0%' in out
-        assert '777' in out and '3/8' in out
+        assert '777' in out
+        # Paged-KV block pool replaced the slot-occupancy-only view:
+        # used/total blocks + the preemption count.
+        assert 'BLOCKS' in out and '5/16' in out
+        assert 'PREEMPT' in out
         assert '512.0MiB/1.0GiB' in out
         # The fixture's own AgentClients register per-host breakers
         # too — assert presence + all-closed, not an exact count.

@@ -57,6 +57,47 @@ class TestServiceSpec:
         with pytest.raises(exceptions.InvalidSpecError):
             SkyServiceSpec(tls_keyfile='/tmp/k.pem')
 
+    def test_engine_knobs_round_trip(self):
+        """Paged-KV batching-engine knobs (`service: engine:`)."""
+        spec = SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'engine': {'block_size': 32, 'num_blocks': 512,
+                       'max_num_batched_tokens': 4096},
+        })
+        assert spec.engine_block_size == 32
+        assert spec.engine_num_blocks == 512
+        assert spec.engine_max_num_batched_tokens == 4096
+        spec2 = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert spec2.engine_block_size == 32
+        assert spec2.engine_num_blocks == 512
+        assert spec2.engine_max_num_batched_tokens == 4096
+        # Absent engine section stays absent through the round trip.
+        bare = SkyServiceSpec.from_yaml_config({})
+        assert bare.engine_block_size is None
+        assert 'engine' not in bare.to_yaml_config()
+
+    def test_engine_env_stamps(self):
+        """engine: knobs reach replicas as SKYTPU_ENGINE_* env (the
+        replica manager injects engine_env() into every replica
+        task; serve_model reads them as flag defaults)."""
+        spec = SkyServiceSpec.from_yaml_config({
+            'engine': {'block_size': 32, 'num_blocks': 512,
+                       'max_num_batched_tokens': 4096}})
+        assert spec.engine_env() == {
+            'SKYTPU_ENGINE_BLOCK_SIZE': '32',
+            'SKYTPU_ENGINE_NUM_BLOCKS': '512',
+            'SKYTPU_ENGINE_MAX_BATCHED_TOKENS': '4096',
+        }
+        assert SkyServiceSpec.from_yaml_config({}).engine_env() == {}
+
+    def test_engine_knob_validation(self):
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_block_size=0)
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_num_blocks=1)
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_max_num_batched_tokens=0)
+
     def test_fallback_round_trip(self):
         spec = SkyServiceSpec.from_yaml_config({
             'replica_policy': {'min_replicas': 2,
