@@ -945,6 +945,119 @@ def checkpoint_main() -> dict:
     }
 
 
+def elastic_main() -> dict:
+    """BENCH_MODE=elastic (or ``--bench elastic``): resize-restore
+    throughput of the elastic-resume re-partitioning path
+    (docs/checkpointing.md, Elastic resume).
+
+    Writes one committed checkpoint step whose leaves are split into
+    ``BENCH_ELASTIC_SAVED_SHARDS`` row-range shard files (the layout
+    an N-way fsdp mesh produces), then restores it as
+    ``BENCH_ELASTIC_TARGET_SHARDS`` windows through
+    ``format.assemble_region`` — the exact read path an 8->4 chip
+    elastic resume takes (each new window straddles saved shard
+    boundaries, so shards are sliced and re-packed, not just
+    renamed). Headline: resize-restore MB/s; detail carries the
+    classic full-leaf restore as the baseline.
+
+    Env: BENCH_ELASTIC_MB (payload, default 64),
+    BENCH_ELASTIC_LEAVES (default 8), BENCH_ELASTIC_SAVED_SHARDS
+    (default 8), BENCH_ELASTIC_TARGET_SHARDS (default 4)."""
+    import tempfile
+
+    import numpy as np
+
+    from skypilot_tpu.checkpoint import commit as commit_lib
+    from skypilot_tpu.checkpoint import format as format_lib
+
+    total_mb = float(os.environ.get('BENCH_ELASTIC_MB', '64'))
+    n_leaves = int(os.environ.get('BENCH_ELASTIC_LEAVES', '8'))
+    saved_shards = int(os.environ.get('BENCH_ELASTIC_SAVED_SHARDS',
+                                      '8'))
+    target_shards = int(os.environ.get('BENCH_ELASTIC_TARGET_SHARDS',
+                                       '4'))
+    cols = 1024
+    # Rows divisible by both shard counts so every window is exact.
+    rows_unit = saved_shards * target_shards
+    rows = max(rows_unit, int(total_mb * 1e6 / 4 / cols / n_leaves)
+               // rows_unit * rows_unit)
+    rng = np.random.default_rng(0)
+
+    with tempfile.TemporaryDirectory() as base:
+        tmp = os.path.join(base, commit_lib.tmp_dir_name(0))
+        os.makedirs(tmp)
+        leaves = {}
+        nbytes = 0
+        t0 = time.perf_counter()
+        for i in range(n_leaves):
+            arr = rng.standard_normal((rows, cols)).astype(np.float32)
+            entry = format_lib.leaf_entry(arr.dtype, arr.shape,
+                                          sharding=f'fsdp{saved_shards}')
+            step = rows // saved_shards
+            for j in range(saved_shards):
+                lo, hi = j * step, (j + 1) * step
+                fname = f'h0_{i:05d}_{j}.bin'
+                size, crc = format_lib.write_shard_file(
+                    tmp, fname, arr[lo:hi])
+                nbytes += size
+                entry['shards'].append({
+                    'file': fname,
+                    'index': [[lo, hi], [0, cols]],
+                    'nbytes': size,
+                    'checksum': crc,
+                })
+            leaves[f'params/w{i}'] = entry
+        format_lib.write_host_manifest(tmp, 0, leaves, 1)
+        format_lib.write_manifest(tmp, 0, leaves, 1,
+                                  device_count=saved_shards)
+        commit_lib.commit(base, 0)
+        t_save = time.perf_counter() - t0
+        step_dir = os.path.join(base, commit_lib.step_dir_name(0))
+        manifest = format_lib.read_manifest(step_dir)
+
+        # The resize restore: every target window of every leaf,
+        # assembled from only the saved shards that overlap it.
+        t0 = time.perf_counter()
+        resize_bytes = 0
+        step = rows // target_shards
+        for key, entry in manifest['leaves'].items():
+            for j in range(target_shards):
+                window = format_lib.assemble_region(
+                    step_dir, key, entry,
+                    [[j * step, (j + 1) * step], [0, cols]])
+                resize_bytes += window.nbytes
+        t_resize = time.perf_counter() - t0
+        assert resize_bytes == nbytes, (resize_bytes, nbytes)
+
+        # Baseline: the classic whole-leaf assembly (same bytes).
+        t0 = time.perf_counter()
+        for key, entry in manifest['leaves'].items():
+            format_lib.assemble_leaf(step_dir, key, entry)
+        t_full = time.perf_counter() - t0
+
+    resize_mbps = nbytes / 1e6 / t_resize
+    return {
+        'metric': 'elastic_resize_restore_mb_per_sec',
+        'value': round(resize_mbps, 2),
+        'unit': 'MB/s',
+        # First elastic measurement seeds the baseline.
+        'vs_baseline': 1.0,
+        'detail': {
+            'payload_mb': round(nbytes / 1e6, 2),
+            'leaves': n_leaves,
+            'saved_shards': saved_shards,
+            'target_shards': target_shards,
+            'save_s': round(t_save, 4),
+            'resize_restore_s': round(t_resize, 4),
+            'full_restore_s': round(t_full, 4),
+            'full_restore_mb_per_sec': round(nbytes / 1e6 / t_full, 2),
+            # >1 = the re-partitioning read path costs that much more
+            # than a same-mesh restore of the same bytes.
+            'resize_overhead_ratio': round(t_resize / t_full, 3),
+        },
+    }
+
+
 def launch_main() -> dict:
     """BENCH_MODE=launch: `launch` time-to-first-step on the local
     fake cloud (the un-measured half of BASELINE.json's north star —
@@ -1089,6 +1202,61 @@ def _is_backend_init_failure(exc: BaseException) -> bool:
     return any(marker in text for marker in _BACKEND_INIT_MARKERS)
 
 
+# ---------------------------------------------------------------------
+# Typed environment-failure exit (the BENCH_r05 class): a TPU-tunnel /
+# backend bring-up failure is a fact about the HARNESS, not the code
+# under test. It must exit with its own code and a row typed
+# `bench_env_error` — which benchmark_state refuses to record — so a
+# broken environment can never seed bench_runs history or read as a
+# perf datapoint. (The untyped `bench_error` row r05 emitted was
+# recorded by the round driver as if it were a measurement.)
+# ---------------------------------------------------------------------
+
+ENV_ERROR_EXIT_CODE = 4
+
+# Beyond backend-init: the tunnel/agent-connectivity class (the bench
+# drives real launches in launch mode) and the persistent-UNAVAILABLE
+# TPU runtime class. Deliberately SPECIFIC phrases, same reasoning as
+# _BACKEND_INIT_MARKERS: a broad 'timeout'/'connection' match would
+# reclassify a genuine code-under-test failure (a decode deadline, a
+# replica dropping a request) as a harness problem and hide it from
+# the bench history entirely — the inverse of the misleading-row bug
+# this typed exit exists to fix.
+_ENV_FAILURE_MARKERS = _BACKEND_INIT_MARKERS + (
+    'tpu backend setup/compile error',
+    'ssh tunnel',
+    'tpu-tunnel',
+    'connection refused',
+    'name or service not known',
+)
+
+
+def _is_env_failure(exc: BaseException) -> bool:
+    text = repr(exc).lower()
+    return any(marker in text for marker in _ENV_FAILURE_MARKERS)
+
+
+def _emit_env_error(exc: BaseException) -> 'int':
+    """Print the TYPED env-error row (never recorded: the metric is
+    in benchmark_state's ungated set) and return the distinct exit
+    code. value is null — there is no measurement to misread."""
+    print(json.dumps({
+        'metric': 'bench_env_error',
+        'value': None,
+        'unit': 'env_error',
+        'vs_baseline': None,
+        'detail': {
+            'error_class': 'environment',
+            'error': repr(exc)[:500],
+            'hint': 'TPU tunnel / backend bring-up failure — fix the '
+                    'harness and re-run; nothing was recorded in '
+                    'bench_runs',
+        },
+    }))
+    sys.stdout.flush()
+    return ENV_ERROR_EXIT_CODE
+
+
 def _reexec_on_cpu() -> None:
     """Re-exec this bench with JAX_PLATFORMS=cpu. A fresh process is
     required — jax has already bound the broken platform in this
@@ -1190,7 +1358,8 @@ if __name__ == '__main__':
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
-                     'serve_continuous', 'launch', 'checkpoint')
+                     'serve_continuous', 'launch', 'checkpoint',
+                     'elastic')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
                 print(f'usage: bench.py --bench {"|".join(known)}',
@@ -1199,6 +1368,8 @@ if __name__ == '__main__':
             mode = sys.argv[idx + 1]
         if mode == 'checkpoint':
             bench_result = checkpoint_main()
+        elif mode == 'elastic':
+            bench_result = elastic_main()
         elif mode == 'serve':
             bench_result = serve_main()
         elif mode == 'serve_batch':
@@ -1235,6 +1406,12 @@ if __name__ == '__main__':
             sys.stdout.flush()
             sys.exit(_record_and_gate(
                 out, '--assert-no-regress' in sys.argv))
+        if _is_env_failure(e):
+            # Environment (tunnel/backend) failure before any metric:
+            # typed row, distinct exit code, NOTHING recorded — the
+            # class that produced the bogus BENCH_r05 must not emit a
+            # row that reads as a measurement.
+            sys.exit(_emit_env_error(e))
         # The driver records the single JSON line; never die silently.
         print(json.dumps({
             'metric': 'bench_error',

@@ -157,8 +157,11 @@ def get_results(benchmark: str) -> List[Dict[str, Any]]:
 # req/s, MB/s, ...) is a throughput where bigger is better.
 _LOWER_IS_BETTER_UNITS = frozenset({'s', 'ms'})
 
-# Never gate on (or store as history) the error sentinel row.
-_UNGATED_METRICS = frozenset({'bench_error'})
+# Never gate on (or store as history) the error sentinel rows
+# (`bench_env_error` is the TYPED harness-failure row — bench.py exit
+# code 4; an env failure must never seed the history anything is
+# gated against).
+_UNGATED_METRICS = frozenset({'bench_error', 'bench_env_error'})
 
 
 def lower_is_better(unit: Optional[str]) -> bool:

@@ -32,6 +32,7 @@ Metrics (docs/observability.md): ``skytpu_ckpt_save_seconds``,
 ``skytpu_ckpt_bytes_total``, ``skytpu_ckpt_queue_depth``,
 ``skytpu_ckpt_saves_total{outcome}``,
 ``skytpu_ckpt_restores_total{outcome}``,
+``skytpu_ckpt_reshard_restores_total``,
 ``skytpu_ckpt_last_committed_step``.
 
 Fault site (docs/resilience.md): ``checkpoint.save`` — an injected
@@ -44,7 +45,8 @@ from skypilot_tpu.checkpoint.commit import (committed_steps,
                                             step_dir_name)
 from skypilot_tpu.checkpoint.format import (CheckpointError,
                                             CheckpointRestoreError)
-from skypilot_tpu.checkpoint.native import NativeCheckpointManager
+from skypilot_tpu.checkpoint.native import (NativeCheckpointManager,
+                                            saved_device_count)
 from skypilot_tpu.checkpoint.retention import apply_retention
 
 __all__ = [
@@ -55,5 +57,6 @@ __all__ = [
     'committed_steps',
     'gc_orphaned_tmp',
     'latest_committed_step',
+    'saved_device_count',
     'step_dir_name',
 ]

@@ -175,13 +175,21 @@ def merge_host_manifests(dirpath: str,
 
 def write_manifest(dirpath: str, step: int,
                    leaves: Dict[str, Any],
-                   process_count: int) -> None:
+                   process_count: int,
+                   device_count: Optional[int] = None) -> None:
     doc = {
         'format_version': FORMAT_VERSION,
         'step': int(step),
         'process_count': process_count,
         'leaves': leaves,
     }
+    if device_count is not None:
+        # The global device count the state was sharded over at save
+        # time: elastic resume (docs/checkpointing.md) compares it
+        # against the restoring mesh to detect a resize and rescale
+        # the global batch. Absent in pre-elastic checkpoints —
+        # readers must treat None as "unknown", never as 0.
+        doc['device_count'] = int(device_count)
     _write_json(os.path.join(dirpath, MANIFEST_NAME), doc)
 
 
@@ -198,26 +206,84 @@ def read_manifest(step_dir: str) -> Dict[str, Any]:
 def assemble_leaf(step_dir: str, key: str,
                   entry: Dict[str, Any]) -> np.ndarray:
     """Reconstruct one leaf's global array from its shard files."""
+    shape = tuple(entry['shape'])
+    return assemble_region(step_dir, key, entry, full_index(shape))
+
+
+def region_overlap(a: Sequence[Sequence[int]],
+                   b: Sequence[Sequence[int]]
+                   ) -> Optional[List[List[int]]]:
+    """Intersection of two global index windows (``[[start, stop],
+    ...]`` per dim), or None when they are disjoint."""
+    out = []
+    for (a_lo, a_hi), (b_lo, b_hi) in zip(a, b):
+        lo, hi = max(a_lo, b_lo), min(a_hi, b_hi)
+        if lo >= hi:
+            return None
+        out.append([lo, hi])
+    return out
+
+
+def assemble_region(step_dir: str, key: str, entry: Dict[str, Any],
+                    region: Sequence[Sequence[int]]) -> np.ndarray:
+    """Reconstruct one WINDOW of a leaf's global array from the shard
+    files that overlap it (``region`` is ``[[start, stop], ...]`` per
+    dim, global coordinates).
+
+    This is the re-partitioning primitive behind elastic resume
+    (docs/checkpointing.md, Elastic resume): a restore onto a
+    different mesh asks for each new shard's window and only the
+    saved shards intersecting it are read — no host ever
+    materializes leaves it does not own. ``region == full_index``
+    reduces to the classic whole-leaf assembly."""
     dtype = dtype_from_name(entry['dtype'])
     shape = tuple(entry['shape'])
     shards = entry['shards']
     if not shards:
         raise CheckpointRestoreError(f'leaf {key!r} has no shards')
-    if len(shards) == 1 and shards[0]['index'] == full_index(shape):
-        return read_shard_file(step_dir, shards[0], dtype, shape)
-    out = np.empty(shape, dtype=dtype)
+    region = [[int(lo), int(hi)] for lo, hi in region]
+    if len(region) != len(shape):
+        raise CheckpointRestoreError(
+            f'leaf {key!r}: region rank {len(region)} does not match '
+            f'leaf rank {len(shape)}')
+    for (lo, hi), dim in zip(region, shape):
+        if not 0 <= lo <= hi <= dim:
+            raise CheckpointRestoreError(
+                f'leaf {key!r}: region {region} outside global shape '
+                f'{list(shape)}')
+    region_shape = tuple(hi - lo for lo, hi in region)
+    # Fast path: one saved shard covers exactly the requested window
+    # (same-mesh restore, or a resize whose new partition lines up
+    # with an old shard boundary) — one read, no copy into a staging
+    # buffer.
+    for shard in shards:
+        if shard['index'] == region:
+            return read_shard_file(step_dir, shard, dtype,
+                                   region_shape)
+    out = np.empty(region_shape, dtype=dtype)
     covered = 0
     for shard in shards:
-        idx = tuple(slice(lo, hi) for lo, hi in shard['index'])
+        overlap = region_overlap(shard['index'], region)
+        if overlap is None:
+            continue
         shard_shape = tuple(hi - lo for lo, hi in shard['index'])
-        out[idx] = read_shard_file(step_dir, shard, dtype,
-                                   shard_shape)
-        covered += int(np.prod(shard_shape)) if shard_shape else 1
-    want = int(np.prod(shape)) if shape else 1
+        data = read_shard_file(step_dir, shard, dtype, shard_shape)
+        # Slice the overlap out of the shard, place it into the
+        # window — both in their own local coordinates.
+        src = tuple(slice(lo - s_lo, hi - s_lo)
+                    for (lo, hi), (s_lo, _)
+                    in zip(overlap, shard['index']))
+        dst = tuple(slice(lo - r_lo, hi - r_lo)
+                    for (lo, hi), (r_lo, _)
+                    in zip(overlap, region))
+        out[dst] = data[src]
+        covered += int(np.prod([hi - lo for lo, hi in overlap]))
+    want = int(np.prod(region_shape)) if region_shape else 1
     if covered < want:
         raise CheckpointRestoreError(
             f'leaf {key!r}: shards cover {covered} of {want} '
-            'elements (incomplete multi-host write?)')
+            f'elements of window {region} (incomplete multi-host '
+            'write?)')
     return out
 
 

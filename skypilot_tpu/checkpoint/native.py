@@ -53,6 +53,40 @@ def _tree_util():
     return jax.tree_util
 
 
+def _device_count_if_initialized() -> Optional[int]:
+    """``jax.device_count()`` ONLY when a backend is already live.
+    ``device_count`` initializes the platform as a side effect —
+    unacceptable from a process that is merely checkpointing host
+    arrays (backend bring-up can block on real-hardware probes)."""
+    try:
+        from jax._src import xla_bridge
+        if not xla_bridge.backends_are_initialized():
+            return None
+        import jax
+        return jax.device_count()
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def saved_device_count(lineage_dir: str) -> Optional[int]:
+    """Device count recorded in the latest COMMITTED checkpoint under
+    ``lineage_dir`` (jax-free manifest peek). None when there is no
+    committed step or the manifest predates elastic resume — callers
+    must treat that as "unknown", not as 0."""
+    lineage_dir = os.path.expanduser(lineage_dir)
+    step = commit_lib.latest_committed_step(lineage_dir)
+    if step is None:
+        return None
+    step_dir = os.path.join(lineage_dir,
+                            commit_lib.step_dir_name(step))
+    try:
+        manifest = format_lib.read_manifest(step_dir)
+    except CheckpointRestoreError:
+        return None
+    count = manifest.get('device_count')
+    return int(count) if count is not None else None
+
+
 class NativeCheckpointManager:
     """Dependency-free async sharded checkpointing (stdlib+numpy+jax).
 
@@ -82,6 +116,13 @@ class NativeCheckpointManager:
         self._nprocs = process_count
         self._metrics = writer_lib.ckpt_metrics()
         self._last_submitted: Optional[int] = None
+        # Global device count captured at snapshot time (rank 0
+        # writes it into the merged manifest) and details of the most
+        # recent restore (step, bytes read, whether the template's
+        # shardings differed from the saved ones — the elastic-resume
+        # signal; see restore()).
+        self._snapshot_device_count: Optional[int] = None
+        self.last_restore: Optional[Dict[str, Any]] = None
         # Torn writes from a crashed/preempted predecessor are swept
         # before the FIRST save (rank 0), not in __init__: a manager
         # constructed only to restore (a serve replica warm-starting
@@ -201,6 +242,14 @@ class NativeCheckpointManager:
             goodput_lib.note('restore', time.monotonic() - t0)
 
     def _restore_traced(self, step: int, state: Any) -> Any:
+        """Template-driven restore, re-sharding on the fly: each leaf
+        is placed with the TEMPLATE's sharding, and each device's
+        window is assembled from only the saved shard files that
+        overlap it (``format.assemble_region``). The saved and
+        restoring meshes therefore never need to match — an 8-chip
+        checkpoint restores onto a 4-chip mesh by re-partitioning the
+        saved shards against the new ``PartitionSpec`` tree (elastic
+        resume, docs/checkpointing.md)."""
         step_dir = os.path.join(self.path,
                                 commit_lib.step_dir_name(step))
         manifest = format_lib.read_manifest(step_dir)
@@ -209,21 +258,83 @@ class NativeCheckpointManager:
         flat, treedef = tree_util.tree_flatten_with_path(state)
         out = []
         missing = []
+        stats = {'bytes_read': 0, 'resharded': False}
         for path, leaf in flat:
             key = format_lib.key_str(path)
             entry = leaves.get(key)
             if entry is None:
                 missing.append(key)
                 continue
-            host = format_lib.assemble_leaf(step_dir, key, entry)
-            out.append(self._place_like(leaf, host))
+            out.append(self._place_leaf(step_dir, key, entry, leaf,
+                                        stats))
         if missing:
             raise CheckpointRestoreError(
                 f'checkpoint step {step} at {self.path} is missing '
                 f'{len(missing)} leaves of the restore template '
                 f'(first few: {missing[:5]}); was it saved from a '
                 'different model/optimizer configuration?')
-        return tree_util.tree_unflatten(treedef, out)
+        restored = tree_util.tree_unflatten(treedef, out)
+        device_count = _device_count_if_initialized()
+        self.last_restore = {
+            'step': step,
+            'bytes_read': stats['bytes_read'],
+            'resharded': stats['resharded'],
+            'saved_device_count': manifest.get('device_count'),
+            'device_count': device_count,
+        }
+        if stats['resharded']:
+            self._metrics['reshard_restores_total'].inc()
+            logger.info(
+                'checkpoint step %d restored RESHARDED onto the '
+                'current mesh (%s saved devices -> %s; %.1f MB read)',
+                step, manifest.get('device_count', '?'),
+                device_count, stats['bytes_read'] / 1e6)
+        return restored
+
+    def _place_leaf(self, step_dir: str, key: str,
+                    entry: Dict[str, Any], template_leaf: Any,
+                    stats: Dict[str, Any]) -> Any:
+        """Materialize one leaf against the template's placement.
+
+        Sharded template leaves are built shard-window by
+        shard-window (``make_array_from_callback`` asks for each
+        addressable window; only overlapping saved shards are read),
+        so a process restores only the bytes its devices own. Host
+        leaves assemble in full."""
+        shape = tuple(entry['shape'])
+        if hasattr(template_leaf, 'addressable_shards'):
+            import jax
+            sharding = template_leaf.sharding
+            saved_sharding = entry.get('sharding')
+            if saved_sharding is not None and \
+                    saved_sharding != str(sharding):
+                stats['resharded'] = True
+            # Cache per-window reads: replicated axes make jax ask
+            # for the SAME window once per device holding a replica.
+            window_cache: Dict[tuple, Any] = {}
+
+            def read_window(idx):
+                region = tuple(
+                    tuple(w) for w in format_lib.normalize_index(
+                        idx, shape))
+                cached = window_cache.get(region)
+                if cached is None:
+                    cached = format_lib.assemble_region(
+                        step_dir, key, entry,
+                        [list(w) for w in region])
+                    stats['bytes_read'] += cached.nbytes
+                    window_cache[region] = cached
+                return cached
+
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: read_window(idx))
+        host = format_lib.assemble_leaf(step_dir, key, entry)
+        stats['bytes_read'] += host.nbytes
+        if isinstance(template_leaf, np.ndarray):
+            return host
+        if host.shape == ():
+            return type(template_leaf)(host.item())
+        return host
 
     def restore_latest_raw(self, keys: Optional[Sequence[str]] = None
                            ) -> Optional[Any]:
@@ -280,6 +391,13 @@ class NativeCheckpointManager:
         donated/mutated freely."""
         tree_util = _tree_util()
         flat, _ = tree_util.tree_flatten_with_path(state)
+        # Recorded in the merged manifest so a restore onto a
+        # different mesh can tell it is a resize (elastic resume).
+        # None for host-only trees: device count is meaningless
+        # there, and asking jax for it would force BACKEND INIT in
+        # checkpoint-only processes that never touch a device (a
+        # hang on boxes whose TPU plugin probes real hardware).
+        self._snapshot_device_count = _device_count_if_initialized()
         payload = []
         for path, leaf in flat:
             key = format_lib.key_str(path)
@@ -306,18 +424,6 @@ class NativeCheckpointManager:
                     (key, entry,
                      [(format_lib.full_index(arr.shape), arr)]))
         return payload
-
-    def _place_like(self, template_leaf: Any, host: np.ndarray) -> Any:
-        import jax
-        if hasattr(template_leaf, 'addressable_shards'):
-            sharding = template_leaf.sharding
-            return jax.make_array_from_callback(
-                host.shape, sharding, lambda idx: host[idx])
-        if isinstance(template_leaf, np.ndarray):
-            return host
-        if host.shape == ():
-            return type(template_leaf)(host.item())
-        return host
 
     def _write_step(self, step: int, payload) -> Tuple[int, bool]:
         """Writer-thread body: shards -> host manifest -> barrier ->
@@ -352,7 +458,9 @@ class NativeCheckpointManager:
             return nbytes, False
         self._await_host_manifests(tmp, step)
         merged = format_lib.merge_host_manifests(tmp, self._nprocs)
-        format_lib.write_manifest(tmp, step, merged, self._nprocs)
+        format_lib.write_manifest(
+            tmp, step, merged, self._nprocs,
+            device_count=self._snapshot_device_count)
         kind = faults.fire('checkpoint.save')
         if kind == 'preempt':
             # Simulated crash between shard write and commit: leave

@@ -53,6 +53,10 @@ def ckpt_metrics():
         'restores_total': reg.counter(
             'skytpu_ckpt_restores_total',
             'Checkpoint restores, by outcome.', ('outcome',)),
+        'reshard_restores_total': reg.counter(
+            'skytpu_ckpt_reshard_restores_total',
+            'Restores that re-partitioned saved shards onto a '
+            'different sharding/mesh (elastic resume).'),
         'last_committed_step': reg.gauge(
             'skytpu_ckpt_last_committed_step',
             'Step of the most recently committed checkpoint.'),

@@ -1193,9 +1193,15 @@ def jobs_queue():
                             'RESUME@', 'CLUSTER'])
     for r in records:
         resume = r.get('resume_step')
+        mesh = r.get('resume_mesh')
+        # `step/new-mesh` when an elastic recovery resized the job
+        # (docs/resilience.md, Elastic resume); bare step otherwise.
+        if mesh:
+            cell = f'{"-" if resume is None else resume}/{mesh}'
+        else:
+            cell = '-' if resume is None else resume
         table.add_row([r['job_id'], r['name'], r['status'].value,
-                       r['recovery_count'],
-                       '-' if resume is None else resume,
+                       r['recovery_count'], cell,
                        r['task_cluster'] or '-'])
     click.echo(table.get_string() if records else 'No managed jobs.')
 

@@ -123,6 +123,24 @@ class CheckpointManager:
         8B fp32 TrainState that is ~64 GB of Adam state skipped."""
         return self._impl.restore_latest_raw(keys=keys)
 
+    @property
+    def last_restore(self) -> Optional[dict]:
+        """Details of the most recent restore (native engine only):
+        ``{step, bytes_read, resharded, saved_device_count,
+        device_count}``. ``resharded`` is the elastic-resume signal —
+        the template's shardings differed from the saved ones and the
+        shards were re-partitioned on read. None before any restore
+        (and always None on the orbax engine)."""
+        return getattr(self._impl, 'last_restore', None)
+
+    def saved_device_count(self) -> Optional[int]:
+        """Device count recorded by the latest committed save in this
+        manager's (task-namespaced) directory, or None when unknown.
+        Elastic training reads this BEFORE building its optimizer to
+        rescale the global batch by the device ratio."""
+        from skypilot_tpu import checkpoint as checkpoint_lib
+        return checkpoint_lib.saved_device_count(self.path)
+
     def wait(self) -> None:
         self._impl.wait()
 

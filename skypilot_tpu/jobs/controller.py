@@ -118,6 +118,24 @@ class JobsController:
         it would silently restart training from step 0)."""
         self._note_resume_point(task, task_idx)
         self._stamp_task_id(task, task_idx)
+        # Wall-clock stamp of WHEN the controller observed the
+        # failure: the relaunched task prices the dead time into the
+        # goodput `recovery_stall` bucket
+        # (goodput.note_recovery_stall_from_env) — the number the
+        # elastic step-down exists to shrink.
+        import time as time_mod
+        task.update_envs({
+            'SKYTPU_RECOVERY_DETECTED_AT': f'{time_mod.time():.3f}'})
+
+    def _record_recovery_shape(self, strategy) -> None:
+        """After a successful recover(): persist the shape verdict.
+        ``resized_to`` set = an elastic step-down landed (shown as
+        RESUME@step/new-mesh); None = the designed shape came back —
+        clear any stale resize from an earlier recovery."""
+        resized = getattr(strategy, 'resized_to', None)
+        jobs_state.set_resume_mesh(self.job_id, resized)
+        if resized is not None:
+            _count_recovery('resize')
 
     def _note_resume_point(self, task: Task, task_idx: int) -> None:
         """Surface "resuming at step N" in logs + managed-job state
@@ -354,6 +372,7 @@ class JobsController:
                 if job_id is None:
                     return jobs_state.ManagedJobStatus.\
                         FAILED_NO_RESOURCE
+                self._record_recovery_shape(strategy)
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RUNNING)
                 # Fresh cluster, fresh handle: re-point the watchdog.
@@ -421,6 +440,7 @@ class JobsController:
                 if job_id is None:
                     return jobs_state.ManagedJobStatus.\
                         FAILED_NO_RESOURCE
+                self._record_recovery_shape(strategy)
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RUNNING)
                 self._arm_watchdog(cluster_name)

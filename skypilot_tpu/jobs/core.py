@@ -201,10 +201,13 @@ def get(job_id: int) -> Optional[Dict[str, Any]]:
 
 
 def queue() -> List[Dict[str, Any]]:
-    """All managed jobs, newest first (controller-side truth)."""
+    """All managed jobs, newest first (controller-side truth). With
+    no controller cluster, fall back to the LOCAL managed-jobs DB —
+    the view a controller host itself (or an in-process controller,
+    e.g. tests) has; same fallback the dashboard uses."""
     handle = _get_controller_handle(must_exist=False)
     if handle is None:
-        return []
+        return jobs_state.get_jobs()
     out = _controller_rpc(handle, jobs_codegen.get_jobs(
         handle.head_runtime_dir), retry=True)
     import json

@@ -47,7 +47,7 @@ _PAGE = """<!doctype html>
 <div id="updated"></div>
 <table id="jobs"><thead><tr>
  <th>ID</th><th>Name</th><th>Status</th><th>Submitted</th>
- <th>Duration</th><th>Recoveries</th><th>Resume step</th>
+ <th>Duration</th><th>Recoveries</th><th>Resume@</th>
  <th>Cluster</th><th>Failure</th><th></th>
 </tr></thead><tbody></tbody></table>
 <script>
@@ -71,9 +71,13 @@ async function refresh() {
     const tr = document.createElement('tr');
     // textContent only — job names / failure reasons are user-
     // controlled strings; never interpolate them into HTML.
+    // `step/new-mesh` when an elastic recovery resized the job.
+    const resumeAt = j.resume_mesh
+        ? (j.resume_step == null ? '-' : j.resume_step) + '/' +
+          j.resume_mesh
+        : (j.resume_step == null ? '-' : j.resume_step);
     const cells = [j.job_id, j.name, j.status, fmtTs(j.submitted_at),
-                   fmtDur(j), j.recovery_count,
-                   j.resume_step == null ? '-' : j.resume_step,
+                   fmtDur(j), j.recovery_count, resumeAt,
                    j.task_cluster || '-', j.failure_reason || ''];
     for (let i = 0; i < cells.length; i++) {
       const td = document.createElement('td');

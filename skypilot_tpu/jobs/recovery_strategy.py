@@ -1,15 +1,27 @@
 """Recovery strategies for managed jobs (analog of
 ``sky/jobs/recovery_strategy.py``).
 
-Two strategies, same as the reference:
+Reference-parity strategies:
 - FAILOVER (``:388``): on preemption, retry the SAME region first
   (cheap if capacity returns), then widen.
 - EAGER_NEXT_REGION (``:471``, the default): terminate and
   immediately blocklist the preempted region — TPU spot preemptions
   cluster in time and space, so the next region is usually the faster
   path back to running.
+
+Beyond the reference:
+- NEXT_BEST_SHAPE (elastic resume, docs/resilience.md): prefer the
+  same shape within a bounded wait, then STEP DOWN through smaller
+  slice shapes (half the chips per rung), pricing each rung through
+  the optimizer. The relaunched task sees
+  ``SKYTPU_ELASTIC_RESIZED=<old>-><new>`` and re-plans its mesh for
+  the devices actually obtained; the checkpoint engine re-shards on
+  restore. A 2-slice job preempted down to 1 obtainable slice keeps
+  training instead of stalling until the old shape returns.
 """
-from typing import Optional, Set
+import os
+import re
+from typing import List, Optional, Set
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, execution
@@ -221,3 +233,187 @@ class NoRecoveryStrategy(StrategyExecutor):
     def recover(self, task, cluster_name, preempted_region):
         self.terminate_cluster(cluster_name)
         return None
+
+
+# ---------------------------------------------------------------------
+# Elastic recovery: NEXT_BEST_SHAPE (docs/resilience.md).
+# ---------------------------------------------------------------------
+
+# Bounded same-shape wait before stepping down: how many relaunch
+# attempts (with the usual jittered backoff between them) the strategy
+# spends trying to get the ORIGINAL shape back.
+SAME_SHAPE_ATTEMPTS_ENV = 'SKYTPU_ELASTIC_SAME_SHAPE_ATTEMPTS'
+DEFAULT_SAME_SHAPE_ATTEMPTS = 2
+
+# Env stamped on a task relaunched at a smaller shape; the training
+# side (recipes/finetune.py --elastic) logs it, and the checkpoint
+# restore re-shards regardless. Empty/absent = not resized.
+ELASTIC_RESIZED_ENV = 'SKYTPU_ELASTIC_RESIZED'
+
+_TPU_NAME_RE = re.compile(r'^tpu-(?P<gen>[a-z0-9]+)-(?P<size>\d+)$')
+
+
+def same_shape_attempts() -> int:
+    try:
+        return max(0, int(os.environ.get(
+            SAME_SHAPE_ATTEMPTS_ENV, str(DEFAULT_SAME_SHAPE_ATTEMPTS))))
+    except ValueError:
+        return DEFAULT_SAME_SHAPE_ATTEMPTS
+
+
+def _downsize_one(resources: Resources) -> Optional[Resources]:
+    """The next smaller certified shape of the same family, or None
+    when there is nothing smaller. TPU slices halve their size suffix
+    (cores for v2..v5p, chips for v5e/v6e — halving the suffix halves
+    chips either way) through the catalog's certified sizes; the
+    local fake provider halves ``num_hosts``."""
+    if resources.accelerator is not None:
+        m = _TPU_NAME_RE.match(resources.accelerator)
+        if m is None:
+            return None
+        from skypilot_tpu.catalog import tpu_catalog
+        size = int(m.group('size'))
+        while size > 1:
+            size //= 2
+            candidate = f'tpu-{m.group("gen")}-{size}'
+            try:
+                tpu_catalog.get_tpu_spec(candidate)
+            except (exceptions.InvalidSpecError,
+                    exceptions.ResourcesUnavailableError):
+                continue  # not a certified/cataloged size; halve on
+            return resources.copy(accelerators=candidate)
+        return None
+    extra = dict(getattr(resources, '_extra_config', None) or {})
+    num_hosts = int(extra.get('num_hosts', 1))
+    if num_hosts <= 1:
+        return None
+    smaller = resources.copy()
+    extra['num_hosts'] = num_hosts // 2
+    smaller._extra_config = extra  # pylint: disable=protected-access
+    return smaller
+
+
+def downsize_ladder(resources: Set[Resources]) -> List[Set[Resources]]:
+    """Ordered step-down rungs: each rung is the task's resource set
+    with every shape halved once more. Stops when nothing can shrink
+    further (a single host / the smallest certified slice)."""
+    rungs: List[Set[Resources]] = []
+    current = set(resources)
+    while True:
+        nxt = set()
+        for r in current:
+            smaller = _downsize_one(r)
+            if smaller is not None:
+                nxt.add(smaller)
+        if not nxt:
+            return rungs
+        rungs.append(nxt)
+        current = nxt
+
+
+def shape_desc(resources: Set[Resources]) -> str:
+    """Compact shape string for logs and the managed-jobs
+    ``resume_mesh`` column: the accelerator name (TPU), or
+    ``<n>xhost`` (local fake / controller-class VMs)."""
+    descs = set()
+    for r in resources:
+        if r.accelerator is not None:
+            descs.add(r.accelerator)
+            continue
+        extra = getattr(r, '_extra_config', None) or {}
+        descs.add(f'{int(extra.get("num_hosts", 1))}xhost')
+    return '|'.join(sorted(descs)) if descs else '?'
+
+
+@register('NEXT_BEST_SHAPE')
+class NextBestShapeStrategy(StrategyExecutor):
+    """Elastic recovery: same shape within a bounded wait, then step
+    down through smaller certified shapes, each rung priced by the
+    optimizer. ``resized_to`` carries the landed shape (None = the
+    original shape came back) — the controller records it as
+    ``RESUME@step/new-mesh`` in managed-job state."""
+
+    def __init__(self):
+        super().__init__()
+        self.resized_to: Optional[str] = None
+
+    def _price_rung(self, task: Task) -> None:
+        """Let the optimizer pin the cheapest feasible placement for
+        the current (downsized) resource set; an infeasible rung
+        keeps its full set and lets launch() report the failure."""
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu.dag import Dag
+        original = task.resources
+        with Dag() as dag:
+            dag.add(task)
+        try:
+            optimizer_lib.optimize(
+                dag, blocked_resources=self.blocked_resources,
+                quiet=True)
+            best = task.best_resources  # type: ignore[attr-defined]
+            task.set_resources({best})
+        except exceptions.ResourcesUnavailableError:
+            task.set_resources(original)
+
+    def recover(self, task, cluster_name, preempted_region):
+        self.resized_to = None
+        self.terminate_cluster(cluster_name)
+        # Blocklist the preempted region at REGION granularity with
+        # NO accelerator pin (the blocklist matcher requires an exact
+        # accelerator match, and the rungs below carry DOWNSIZED
+        # accelerator names): the pricing of every rung must steer
+        # clear of the region whose capacity just evaporated.
+        if preempted_region is not None and any(
+                r.accelerator is not None for r in task.resources):
+            self.blocked_resources.add(
+                Resources(region=preempted_region))
+        # Phase 1: the original shape, bounded wait. Cheap when the
+        # preemption is transient; the backoff between attempts is
+        # the "bounded wait" (LAUNCH_RETRY_POLICY's jittered ladder).
+        attempts = same_shape_attempts()
+        if attempts > 0:
+            job_id = self.launch(task, cluster_name,
+                                 max_retries=attempts)
+            if job_id is not None:
+                # Same shape re-acquired: clear any stale resize
+                # stamp from an earlier elastic recovery.
+                task.update_envs({ELASTIC_RESIZED_ENV: ''})
+                return job_id
+        # Phase 2: step down. Every rung is a full recovery attempt
+        # at a smaller shape; the first that launches wins.
+        original = task.resources
+        original_desc = shape_desc(original)
+        try:
+            for rung in downsize_ladder(original):
+                injected = faults.fire('recovery.resize')
+                if injected is not None:
+                    # Any injected kind fails THIS rung (the drill:
+                    # a shape that also cannot be obtained), driving
+                    # the step-down to the next smaller shape.
+                    logger.warning(
+                        '[fault:recovery.resize] injected %s; '
+                        'skipping shape %s', injected,
+                        shape_desc(rung))
+                    continue
+                task.set_resources(set(rung))
+                self._price_rung(task)
+                desc = shape_desc(task.resources)
+                task.update_envs({
+                    ELASTIC_RESIZED_ENV:
+                        f'{original_desc}->{desc}'})
+                job_id = self.launch(task, cluster_name,
+                                     max_retries=1)
+                if job_id is not None:
+                    self.resized_to = desc
+                    logger.warning(
+                        'Elastic recovery: %s resized %s -> %s '
+                        '(same shape unobtainable within %d '
+                        'attempts)', cluster_name, original_desc,
+                        desc, attempts)
+                    return job_id
+            return None
+        finally:
+            # The task keeps its ORIGINAL shape for future
+            # recoveries: the next preemption tries to scale back up
+            # to the designed shape before stepping down again.
+            task.set_resources(original)

@@ -77,6 +77,13 @@ def _create_tables(cursor, conn):
     # Tracing) — `xsky trace --job ID` resolves through this.
     db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
                                  'trace_id', 'TEXT')
+    # Migration for pre-elastic rows: the shape an elastic recovery
+    # (NEXT_BEST_SHAPE) resized the job onto, e.g. 'tpu-v5e-4' or
+    # '1xhost'. NULL = running at its designed shape. Surfaced with
+    # resume_step as `RESUME@step/new-mesh` in `xsky jobs queue` and
+    # the dashboard (docs/resilience.md, Elastic resume).
+    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
+                                 'resume_mesh', 'TEXT')
     # Terminal-state fence columns (docs/lifecycle.md): a terminal
     # status written by a reconciler that CONFIRMED the controller
     # dead is stamped fenced; writes that bounce off it are counted.
@@ -211,6 +218,15 @@ def set_resume_step(job_id: int, step: Optional[int]) -> None:
         (step, job_id))
 
 
+def set_resume_mesh(job_id: int, mesh: Optional[str]) -> None:
+    """Record the shape an elastic recovery resized the job onto
+    (``NEXT_BEST_SHAPE``; None clears it — the designed shape came
+    back). Shown as ``RESUME@step/new-mesh``."""
+    _db().execute_and_commit(
+        'UPDATE managed_jobs SET resume_mesh=? WHERE job_id=?',
+        (mesh, job_id))
+
+
 def set_trace_id(job_id: int, trace_id: Optional[str]) -> None:
     """Record the job's distributed-trace id (set once by the
     controller at startup; COALESCE keeps the FIRST submit's id if a
@@ -236,8 +252,8 @@ def get_job(job_id: int) -> Optional[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason, resume_step, trace_id FROM managed_jobs '
-        'WHERE job_id=?', (job_id,)).fetchone()
+        'failure_reason, resume_step, trace_id, resume_mesh '
+        'FROM managed_jobs WHERE job_id=?', (job_id,)).fetchone()
     return _to_record(row) if row else None
 
 
@@ -245,7 +261,7 @@ def _to_record(row) -> Dict[str, Any]:
     (job_id, name, status, submitted_at, started_at, ended_at,
      task_cluster, controller_cluster, controller_job_id,
      recovery_count, dag_yaml_path, failure_reason,
-     resume_step, trace_id) = row
+     resume_step, trace_id, resume_mesh) = row
     return {
         'job_id': job_id,
         'name': name,
@@ -261,6 +277,7 @@ def _to_record(row) -> Dict[str, Any]:
         'failure_reason': failure_reason,
         'resume_step': resume_step,
         'trace_id': trace_id,
+        'resume_mesh': resume_mesh,
     }
 
 
@@ -269,8 +286,8 @@ def get_jobs() -> List[Dict[str, Any]]:
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
-        'failure_reason, resume_step, trace_id FROM managed_jobs '
-        'ORDER BY job_id DESC').fetchall()
+        'failure_reason, resume_step, trace_id, resume_mesh '
+        'FROM managed_jobs ORDER BY job_id DESC').fetchall()
     return [_to_record(r) for r in rows]
 
 

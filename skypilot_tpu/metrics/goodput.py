@@ -41,6 +41,14 @@ BUCKETS = ('compute', 'compile', 'checkpoint_save', 'restore',
 # catalog peak FLOPs without plumbing it through every recipe flag.
 ENV_ACCELERATOR = 'SKYTPU_ACCELERATOR'
 
+# Wall-clock stamp (unix seconds) the jobs controller applies to a
+# recovery relaunch at the moment it OBSERVED the failure: the
+# relaunched training process calls note_recovery_stall_from_env() to
+# price the dead time between those two points into the
+# `recovery_stall` bucket. This is the number NEXT_BEST_SHAPE elastic
+# recovery exists to shrink (docs/resilience.md, Elastic resume).
+ENV_RECOVERY_DETECTED_AT = 'SKYTPU_RECOVERY_DETECTED_AT'
+
 
 def train_metrics(reg=None) -> Dict[str, object]:
     """The train-loop metric families, get-or-create (shared by
@@ -248,6 +256,29 @@ def note(bucket: str, seconds: float) -> None:
     blockingly interrupt a training loop (checkpoint submit/wait,
     restore, recovery stalls) are scattered across subsystems."""
     accountant().note(bucket, seconds)
+
+
+def note_recovery_stall_from_env() -> Optional[float]:
+    """Price a recovery relaunch's dead time into `recovery_stall`.
+
+    The jobs controller stamps ``SKYTPU_RECOVERY_DETECTED_AT`` (unix
+    wall clock — the only clock that survives the process boundary)
+    on every recovery relaunch; the restarted training process calls
+    this once at startup. Returns the stall seconds noted, or None
+    when the process is not a recovery relaunch. The env var is
+    consumed (popped) so a fork/exec inside the task cannot
+    double-count the same stall."""
+    raw = os.environ.pop(ENV_RECOVERY_DETECTED_AT, '')
+    if not raw:
+        return None
+    try:
+        detected_at = float(raw)
+    except ValueError:
+        return None
+    stall = max(0.0, time.time() - detected_at)
+    if stall > 0:
+        note('recovery_stall', stall)
+    return stall
 
 
 def reset_accountant() -> None:

@@ -10,6 +10,9 @@ from skypilot_tpu.parallel.mesh import (
     MeshConfig,
     make_mesh,
     auto_mesh_config,
+    describe_config,
+    replan_mesh_config,
+    rescale_global_batch,
 )
 from skypilot_tpu.parallel.train import (
     TrainState,
@@ -28,6 +31,7 @@ __all__ = [
     'TrainState',
     'auto_mesh_config',
     'build_train_step',
+    'describe_config',
     'distributed',
     'init_qlora_state',
     'init_train_state',
@@ -36,4 +40,6 @@ __all__ = [
     'make_mesh',
     'pipeline',
     'plan_train_state',
+    'replan_mesh_config',
+    'rescale_global_batch',
 ]

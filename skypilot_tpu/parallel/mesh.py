@@ -122,6 +122,74 @@ def make_mesh(config: Optional[MeshConfig] = None,
     return Mesh(arr, AXES)
 
 
+def describe_config(config: MeshConfig) -> str:
+    """Compact human string for a mesh plan, e.g. ``8c:dp2.fsdp4``
+    (device count, then every non-1 axis) — for log lines and
+    errors. (The managed-jobs ``resume_mesh`` string is a SLICE
+    shape, not a mesh plan; it comes from
+    ``jobs.recovery_strategy.shape_desc``.)"""
+    axes = '.'.join(f'{a}{getattr(config, a)}' for a in AXES
+                    if getattr(config, a) > 1)
+    return f'{config.num_devices}c:{axes}' if axes else \
+        f'{config.num_devices}c'
+
+
+def replan_mesh_config(config: MeshConfig,
+                       n_devices: int) -> MeshConfig:
+    """Re-plan a PINNED mesh config for a DIFFERENT device count
+    (elastic resume: the slices actually obtainable, e.g. 8 -> 4
+    chips). This is the library API for training loops that carry an
+    explicit ``MeshConfig``; loops that plan with
+    ``auto_mesh_config`` (``recipes/finetune.py``) re-plan implicitly
+    — auto planning already sizes the data axes from the devices
+    actually visible.
+
+    Model-parallel axes (pp/tp/sp/ep) are preserved — their degrees
+    are baked into kernel shapes and per-device weight shards — and
+    the data axes absorb the change: ``dp`` shrinks (or grows) first;
+    only when the remaining devices cannot sustain the old ``fsdp``
+    degree does ``fsdp`` shrink too. Keeping ``fsdp`` keeps
+    per-device weight+optimizer memory constant across the resize,
+    which is what makes the smaller mesh guaranteed to still fit.
+
+    Raises ``ValueError`` (typed — recovery treats it as "this shape
+    is not usable", not a crash) when the model axes do not divide
+    ``n_devices``.
+    """
+    model = config.pp * config.tp * config.sp * config.ep
+    if n_devices < 1 or n_devices % model != 0:
+        raise ValueError(
+            f'cannot re-plan mesh {describe_config(config)} for '
+            f'{n_devices} devices: model-parallel degree '
+            f'pp*tp*sp*ep={model} does not divide it')
+    data_total = n_devices // model
+    if data_total % config.fsdp == 0:
+        fsdp = config.fsdp
+    else:
+        # Largest divisor of data_total that is <= the old fsdp: keep
+        # as much weight sharding as the new device count sustains.
+        fsdp = max(d for d in range(1, min(config.fsdp,
+                                           data_total) + 1)
+                   if data_total % d == 0)
+    return MeshConfig(pp=config.pp, dp=data_total // fsdp, fsdp=fsdp,
+                      ep=config.ep, tp=config.tp, sp=config.sp)
+
+
+def rescale_global_batch(global_batch: int, old_config: MeshConfig,
+                         new_config: MeshConfig) -> int:
+    """Global batch for the re-planned mesh, holding the PER-DEVICE
+    batch constant (memory per chip and per-step numerics stay what
+    the job was tuned for; total throughput scales with the devices).
+    Result is a positive multiple of the new data-parallel degree."""
+    old_n = math.prod(getattr(old_config, a) for a in data_axes())
+    new_n = math.prod(getattr(new_config, a) for a in data_axes())
+    if global_batch % old_n != 0:
+        raise ValueError(
+            f'global batch {global_batch} not divisible by the old '
+            f'data-parallel degree {old_n}')
+    return (global_batch // old_n) * new_n
+
+
 def data_axes():
     """Mesh axes the batch dimension is sharded over (ep doubles as a
     data axis outside the expert computation — GShard layout)."""

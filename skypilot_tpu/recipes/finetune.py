@@ -55,10 +55,65 @@ def parse_args():
     p.add_argument('--checkpoint-dir',
                    default=os.environ.get('SKYTPU_CHECKPOINT_DIR'))
     p.add_argument('--checkpoint-interval', type=int, default=50)
+    # Elastic resume (docs/resilience.md): when the latest committed
+    # checkpoint was saved from a DIFFERENT device count (a
+    # NEXT_BEST_SHAPE recovery landed on a smaller slice), re-plan
+    # the mesh for the devices actually here (auto_mesh_config
+    # already does) and rescale the global batch to keep the
+    # per-device batch constant. The checkpoint engine re-shards the
+    # saved shards onto the new mesh on restore.
+    p.add_argument('--elastic', action='store_true', default=True)
+    p.add_argument('--no-elastic', dest='elastic',
+                   action='store_false')
+    p.add_argument('--elastic-scale-lr', action='store_true',
+                   help='scale the learning rate linearly with the '
+                        'device ratio on an elastic resize')
     p.add_argument('--param-dtype', default='bf16',
                    choices=['bf16', 'f32'])
     p.add_argument('--log-every', type=int, default=10)
     return p.parse_args()
+
+
+def _elastic_design(lineage_dir, n_now, global_batch):
+    """The job's DESIGNED shape reference: device count + global
+    batch of the FIRST launch, persisted as ``design.json`` in the
+    checkpoint lineage (atomic write; ignored by the step scanners).
+
+    Rescaling must reference the design, not the last checkpoint's
+    device count: ``--batch`` re-parses as the designed value on
+    every relaunch, so scaling it by now/saved would double the
+    per-device batch on a scale-back-up (8 -> 4 -> 8) and halve it
+    on consecutive step-downs. The first launch always runs at the
+    designed shape (NEXT_BEST_SHAPE only resizes recoveries), so
+    recording (devices, batch) when the file is absent on a
+    non-resized run captures the design exactly."""
+    import json
+
+    path = os.path.join(lineage_dir, 'design.json')
+    try:
+        with open(path, encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        pass
+    doc = {'device_count': n_now, 'global_batch': global_batch}
+    if os.environ.get('SKYTPU_ELASTIC_RESIZED'):
+        # Resized relaunch of a PRE-elastic lineage (no design file):
+        # the design is unknown — best effort is the last
+        # checkpoint's device count, and the guess is not persisted.
+        from skypilot_tpu import checkpoint as checkpoint_lib
+        saved = checkpoint_lib.saved_device_count(lineage_dir)
+        if saved:
+            doc['device_count'] = saved
+        return doc
+    try:
+        os.makedirs(lineage_dir, exist_ok=True)
+        tmp = f'{path}.{os.getpid()}'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # read-only mount: run with the in-memory design
+    return doc
 
 
 def data_iterator(args, vocab_size, rng):
@@ -111,6 +166,38 @@ def main():
               f'slices={num_slices} model={args.model} '
               f'params={config.num_params() / 1e9:.2f}B')
 
+    # Elastic resume: a checkpoint saved from more (or fewer) devices
+    # than are visible now means a resize happened between launches.
+    # Rescale the global batch by the device ratio BEFORE building
+    # the optimizer/iterator so per-device batch (and therefore HBM
+    # footprint and per-example numerics) stays what the job was
+    # tuned for; the restore below re-shards the saved state onto
+    # this mesh.
+    if args.elastic and args.checkpoint_dir:
+        import math as math_mod
+
+        from skypilot_tpu.data import checkpoint as ckpt_facade
+        design = _elastic_design(
+            ckpt_facade.task_checkpoint_dir(args.checkpoint_dir),
+            jax.device_count(), args.batch)
+        n_design = design['device_count']
+        n_now = jax.device_count()
+        if n_design and n_design != n_now:
+            data_n = math_mod.prod(
+                getattr(mesh_cfg, a) for a in mesh_lib.data_axes())
+            scaled = max(data_n,
+                         design['global_batch'] * n_now // n_design
+                         // data_n * data_n)
+            if jax.process_index() == 0:
+                resized = os.environ.get('SKYTPU_ELASTIC_RESIZED')
+                print(f'elastic resume: designed for {n_design} '
+                      f'chips, running on {n_now}'
+                      f'{f" ({resized})" if resized else ""}; '
+                      f'global batch {args.batch} -> {scaled}')
+            args.batch = scaled
+            if args.elastic_scale_lr:
+                args.lr = args.lr * n_now / n_design
+
     param_dtype = jnp.bfloat16 if args.param_dtype == 'bf16' \
         else jnp.float32
     optimizer = default_optimizer(learning_rate=args.lr)
@@ -141,7 +228,16 @@ def main():
             save_interval_steps=args.checkpoint_interval)
         state, start_step = ckpt.restore_or(state)
         if jax.process_index() == 0 and start_step:
-            print(f'resumed from checkpoint at step {start_step}')
+            info = ckpt.last_restore or {}
+            reshard = ' (resharded onto the current mesh)' \
+                if info.get('resharded') else ''
+            print(f'resumed from checkpoint at step {start_step}'
+                  f'{reshard}')
+    # Recovery relaunch: price the dead time since the controller
+    # observed the failure into the goodput `recovery_stall` bucket
+    # (no-op outside a managed-job recovery).
+    from skypilot_tpu.metrics import goodput as goodput_lib
+    goodput_lib.note_recovery_stall_from_env()
 
     callbacks.init(total_steps=args.steps)
     rng = np.random.default_rng(jax.process_index())

@@ -20,6 +20,10 @@ Sites (one per recovery path the paper cares about):
                       terminate.py) — an armed fault suppresses the
                       SIGTERM, simulating a SIGTERM-ignoring hung
                       daemon so the SIGKILL escalation is drilled
+    recovery.resize   the NEXT_BEST_SHAPE elastic step-down (jobs/
+                      recovery_strategy.py): any injected kind fails
+                      the CURRENT downsized-shape attempt, driving
+                      the strategy to the next smaller shape
 
 Activation:
   - programmatically: ``faults.arm('agent.health', 'error', 0.3)``
@@ -47,7 +51,7 @@ logger = tpu_logging.init_logger(__name__)
 
 SITES = ('agent.run', 'agent.health', 'provision.launch',
          'serve.probe', 'jobs.poll', 'checkpoint.save',
-         'lifecycle.kill')
+         'lifecycle.kill', 'recovery.resize')
 KINDS = ('error', 'timeout', 'preempt')
 
 ENV_VAR = 'SKYTPU_FAULTS'

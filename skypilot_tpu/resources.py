@@ -34,7 +34,8 @@ logger = tpu_logging.init_logger(__name__)
 
 _DEFAULT_DISK_SIZE_GB = 100
 DEFAULT_SPOT_RECOVERY = 'EAGER_NEXT_REGION'
-SPOT_RECOVERY_STRATEGIES = ('EAGER_NEXT_REGION', 'FAILOVER', 'NONE')
+SPOT_RECOVERY_STRATEGIES = ('EAGER_NEXT_REGION', 'FAILOVER',
+                            'NEXT_BEST_SHAPE', 'NONE')
 
 # Default TPU VM runtime (software) version per generation; analog of
 # the reference's ``gcp_catalog.get_default_runtime_version``.
@@ -459,10 +460,19 @@ class Resources:
         accel_args = config.pop('accelerator_args', None)
         if accel_args and known['runtime_version'] is None:
             known['runtime_version'] = accel_args.get('runtime_version')
+        # Provider-specific extras (the local fake's num_hosts /
+        # failure-injection knobs). Must round-trip through YAML: a
+        # managed job's DAG crosses a process boundary as YAML, and a
+        # 2-host local task that silently came back 1-host would
+        # invalidate every multi-host recovery drill.
+        extra = config.pop('extra_config', None)
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown resources fields: {sorted(config)}')
-        return cls(**known)
+        res = cls(**known)
+        if extra:
+            res._extra_config = dict(extra)
+        return res
 
     def to_yaml_config(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -498,6 +508,9 @@ class Resources:
                 'max_restarts_on_errors': self._max_restarts_on_errors,
             }
             out.pop('spot_recovery', None)
+        extra = getattr(self, '_extra_config', None)
+        if extra:
+            out['extra_config'] = dict(extra)
         return out
 
     def __repr__(self) -> str:

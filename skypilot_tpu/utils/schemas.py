@@ -53,6 +53,10 @@ _RESOURCES_FIELDS = {
                    }}],
     },
     'accelerator_args': {'type': ['object', 'null']},
+    # Provider-specific extras (the local fake's num_hosts /
+    # failure-injection knobs); round-trips so managed-job DAG YAML
+    # preserves multi-host local shapes.
+    'extra_config': {'type': ['object', 'null']},
 }
 
 RESOURCES_SCHEMA = {

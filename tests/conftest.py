@@ -166,8 +166,14 @@ def _reap_test_daemons(state_dir) -> None:
 def faults():
     """Deterministic fault injection (docs/resilience.md): arm with
     ``faults.arm(site, kind, rate, count)``; seeded RNG so outcomes
-    are reproducible. Reset around each test by ``_isolated_state``;
-    this fixture just hands the module out with a fixed seed."""
+    are reproducible. Registered sites (``faults_lib.SITES``, each
+    two-way grep-linted against docs/resilience.md — see
+    tests/test_resilience.py::TestFaultSiteContractLint):
+    ``agent.run``, ``agent.health``, ``provision.launch``,
+    ``serve.probe``, ``jobs.poll``, ``checkpoint.save``,
+    ``lifecycle.kill``, ``recovery.resize``. Reset around each test
+    by ``_isolated_state``; this fixture just hands the module out
+    with a fixed seed."""
     from skypilot_tpu.resilience import faults as faults_lib
     faults_lib.reset(seed=0)
     yield faults_lib

@@ -1,0 +1,228 @@
+"""Backward compatibility of on-disk state (ROADMAP item 5 down
+payment): OLD-schema state DBs — written by earlier releases, before
+the fencing / resume_step / trace_id / resume_mesh columns and before
+the provision_breadcrumbs table existed — must upgrade IN PLACE on
+first touch (the idempotent ``add_column_to_table`` migrations), or
+fail with a TYPED error on a corrupt file. Never a hang: every sqlite
+connection carries a bounded lock timeout, and every test here runs
+under a wall-clock budget assertion.
+"""
+import os
+import sqlite3
+import time
+
+import pytest
+
+from skypilot_tpu.jobs import state as jobs_state
+
+# Any schema upgrade or typed failure must land well inside this
+# (sqlite's lock timeout is 10 s; migrations are milliseconds).
+_BUDGET_SECONDS = 30.0
+
+
+def _columns(db_path: str, table: str) -> set:
+    conn = sqlite3.connect(db_path)
+    try:
+        return {r[1] for r in
+                conn.execute(f'PRAGMA table_info({table})')}
+    finally:
+        conn.close()
+
+
+def _state_db_dir() -> str:
+    return os.path.expanduser(os.environ['SKYTPU_STATE_DIR'])
+
+
+class TestManagedJobsDbMigrations:
+    """managed_jobs.db carries every migration generation this repo
+    has shipped: fencing (PR 5), resume_step (checkpoint resume),
+    trace_id (PR 6), resume_mesh (elastic resume). A DB from before
+    ALL of them must upgrade in place with its rows intact."""
+
+    # The ORIGINAL schema, verbatim from the pre-fencing release: no
+    # resume_step, no trace_id, no fence columns, no resume_mesh, no
+    # pending_teardowns table.
+    _ANCIENT_SCHEMA = """\
+        CREATE TABLE managed_jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        status TEXT,
+        submitted_at REAL,
+        started_at REAL,
+        ended_at REAL,
+        task_cluster TEXT,
+        controller_cluster TEXT,
+        controller_job_id INTEGER,
+        recovery_count INTEGER DEFAULT 0,
+        dag_yaml_path TEXT,
+        failure_reason TEXT)"""
+
+    def _write_ancient_db(self) -> str:
+        path = os.path.join(_state_db_dir(), 'managed_jobs.db')
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite3.connect(path)
+        conn.execute(self._ANCIENT_SCHEMA)
+        conn.execute(
+            'INSERT INTO managed_jobs (name, status, submitted_at, '
+            'dag_yaml_path, controller_cluster, recovery_count) '
+            "VALUES ('legacy', 'RUNNING', 1700000000.0, "
+            "'/tmp/d.yaml', 'ctrl', 3)")
+        conn.commit()
+        conn.close()
+        return path
+
+    def test_ancient_schema_upgrades_in_place(self):
+        t0 = time.monotonic()
+        path = self._write_ancient_db()
+        before = _columns(path, 'managed_jobs')
+        assert 'resume_step' not in before
+        assert 'trace_id' not in before
+        assert 'resume_mesh' not in before
+        assert 'status_fenced' not in before
+
+        # First touch through the current code runs the migrations.
+        rec = jobs_state.get_job(1)
+        assert rec is not None
+        assert rec['name'] == 'legacy'
+        assert rec['status'] == jobs_state.ManagedJobStatus.RUNNING
+        assert rec['recovery_count'] == 3
+        # New columns exist, read as None/defaults for legacy rows.
+        assert rec['resume_step'] is None
+        assert rec['trace_id'] is None
+        assert rec['resume_mesh'] is None
+        after = _columns(path, 'managed_jobs')
+        assert {'resume_step', 'trace_id', 'resume_mesh',
+                'status_fenced', 'status_epoch',
+                'status_writer_pid'} <= after
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_upgraded_db_fully_writable(self):
+        """The migrated row must accept every current write path:
+        fenced terminal status, resume point, resize bookkeeping."""
+        t0 = time.monotonic()
+        self._write_ancient_db()
+        jobs_state.set_resume_step(1, 42)
+        jobs_state.set_resume_mesh(1, 'tpu-v5e-4')
+        jobs_state.set_trace_id(1, 'abc123')
+        assert jobs_state.set_status(
+            1, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+            failure_reason='upgraded-write', fence=True)
+        rec = jobs_state.get_job(1)
+        assert rec['resume_step'] == 42
+        assert rec['resume_mesh'] == 'tpu-v5e-4'
+        assert rec['trace_id'] == 'abc123'
+        # The fence pins the verdict (terminal-is-final survives the
+        # migration).
+        jobs_state.set_status(1,
+                              jobs_state.ManagedJobStatus.SUCCEEDED)
+        assert jobs_state.get_job(1)['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_pre_elastic_schema_gains_resume_mesh(self):
+        """A DB from the release JUST before this one (has fencing /
+        resume_step / trace_id, lacks only resume_mesh)."""
+        path = os.path.join(_state_db_dir(), 'managed_jobs.db')
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite3.connect(path)
+        conn.execute(self._ANCIENT_SCHEMA)
+        for col, decl in (('resume_step', 'INTEGER'),
+                          ('trace_id', 'TEXT'),
+                          ('status_fenced', "INTEGER DEFAULT 0"),
+                          ('status_writer_pid', 'INTEGER'),
+                          ('status_epoch', "INTEGER DEFAULT 0")):
+            conn.execute(f'ALTER TABLE managed_jobs ADD COLUMN '
+                         f'{col} {decl}')
+        conn.execute(
+            'INSERT INTO managed_jobs (name, status, submitted_at, '
+            'dag_yaml_path, controller_cluster, resume_step) '
+            "VALUES ('prev', 'RUNNING', 1700000000.0, '/tmp/d.yaml',"
+            " 'ctrl', 7)")
+        conn.commit()
+        conn.close()
+        rec = jobs_state.get_job(1)
+        assert rec['resume_step'] == 7 and rec['resume_mesh'] is None
+        jobs_state.set_resume_mesh(1, '1xhost')
+        assert jobs_state.get_job(1)['resume_mesh'] == '1xhost'
+
+    def test_corrupt_db_fails_typed_never_hangs(self):
+        t0 = time.monotonic()
+        path = os.path.join(_state_db_dir(), 'managed_jobs.db')
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'wb') as f:
+            f.write(b'this is not a sqlite file, it is a teapot\n' *
+                    64)
+        with pytest.raises(sqlite3.DatabaseError):
+            jobs_state.get_job(1)
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+
+class TestGlobalStateDbMigrations:
+    """state.db (clusters): a pre-breadcrumbs DB gains the
+    provision_breadcrumbs table in place, rows intact."""
+
+    def test_pre_breadcrumbs_db_upgrades(self):
+        from skypilot_tpu import state as global_state
+        t0 = time.monotonic()
+        path = os.path.join(_state_db_dir(), 'state.db')
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite3.connect(path)
+        conn.execute("""\
+            CREATE TABLE clusters (
+            name TEXT PRIMARY KEY,
+            launched_at INTEGER,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            owner TEXT DEFAULT null,
+            metadata TEXT DEFAULT '{}',
+            cluster_hash TEXT DEFAULT null,
+            usage_intervals BLOB DEFAULT null)""")
+        conn.execute(
+            "INSERT INTO clusters (name, launched_at, status) "
+            "VALUES ('legacy-c', 1700000000, 'UP')")
+        conn.commit()
+        conn.close()
+        # First touch creates the missing tables around the old one.
+        assert global_state.get_provision_breadcrumb('nope') is None
+        cols = _columns(path, 'provision_breadcrumbs')
+        assert 'cluster_name_on_cloud' in cols
+        # Legacy cluster row survived the upgrade.
+        conn = sqlite3.connect(path)
+        rows = list(conn.execute('SELECT name FROM clusters'))
+        conn.close()
+        assert rows == [('legacy-c',)]
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+
+class TestServeStateDbMigrations:
+    """serve_state.db: a pre-fencing services table gains the fence
+    columns in place."""
+
+    def test_pre_fencing_services_upgrades(self):
+        from skypilot_tpu.serve import serve_state
+        path = serve_state._db_path()  # pylint: disable=protected-access
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite3.connect(path)
+        conn.execute("""\
+            CREATE TABLE services (
+            name TEXT PRIMARY KEY,
+            status TEXT,
+            created_at REAL,
+            spec_json TEXT,
+            endpoint TEXT,
+            controller_pid INTEGER)""")
+        conn.execute(
+            "INSERT INTO services (name, status, created_at) "
+            "VALUES ('legacy-svc', 'READY', 1700000000.0)")
+        conn.commit()
+        conn.close()
+        before = _columns(path, 'services')
+        assert 'status_fenced' not in before
+        svc = serve_state.get_service('legacy-svc')
+        assert svc is not None and svc['name'] == 'legacy-svc'
+        after = _columns(path, 'services')
+        assert {'status_fenced', 'status_epoch',
+                'status_writer_pid'} <= after

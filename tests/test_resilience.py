@@ -9,6 +9,7 @@ sleeper, breakers/watchdogs get fake clocks, and faults are seeded.
 import http.client
 import io
 import json
+import os
 import socket
 import threading
 import urllib.error
@@ -1292,3 +1293,66 @@ class TestNoSleepInRetryLoops:
         assert not violations, (
             'Hand-rolled retry sleeps found — route them through '
             'resilience.RetryPolicy:\n' + '\n'.join(violations))
+
+
+# ---------------------------------------------------------------------
+# Lint: the fault-site contract, both directions (same shape as the
+# metric-name lint in test_trace.py) — every site registered in
+# faults.SITES must be documented in docs/resilience.md's fault-site
+# table, and every site the table documents must be registered. A
+# fault site nobody can look up is undrillable; a documented site
+# nobody registered is a chaos drill that silently no-ops.
+# ---------------------------------------------------------------------
+
+
+class TestFaultSiteContractLint:
+
+    @staticmethod
+    def _doc_table_sites():
+        import re as re_mod
+
+        import skypilot_tpu
+        root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
+        text = open(os.path.join(root, 'docs', 'resilience.md'),
+                    encoding='utf-8').read()
+        # Scope to the fault-injection section so dotted code refs
+        # elsewhere in the doc (e.g. `replica_managers.probe_all`)
+        # cannot false-positive.
+        start = text.index('## Fault injection')
+        end = text.index('##', start + 2)
+        section = text[start:end]
+        sites = set()
+        site_re = re_mod.compile(r'^\|\s*`([a-z]+\.[a-z_]+)`')
+        for line in section.splitlines():
+            m = site_re.match(line.strip())
+            if m:
+                sites.add(m.group(1))
+        return sites
+
+    def test_all_registered_sites_documented(self):
+        from skypilot_tpu.resilience import faults as faults_lib
+        documented = self._doc_table_sites()
+        assert documented, ('lint found no fault-site table in '
+                            'docs/resilience.md — did the section '
+                            'format change?')
+        missing = sorted(set(faults_lib.SITES) - documented)
+        assert not missing, (
+            'fault sites registered in faults.SITES but missing from '
+            'the docs/resilience.md fault-site table: '
+            f'{missing}')
+
+    def test_all_documented_sites_registered(self):
+        from skypilot_tpu.resilience import faults as faults_lib
+        stale = sorted(self._doc_table_sites() -
+                       set(faults_lib.SITES))
+        assert not stale, (
+            'fault sites documented in docs/resilience.md but not '
+            f'registered in faults.SITES: {stale}')
+
+    def test_known_sites_are_seen(self):
+        """Meta-check against regex rot: the doc scan must see the
+        long-standing sites AND the elastic-resume site."""
+        sites = self._doc_table_sites()
+        for expected in ('provision.launch', 'checkpoint.save',
+                         'recovery.resize'):
+            assert expected in sites, expected
