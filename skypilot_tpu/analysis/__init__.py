@@ -1,0 +1,28 @@
+"""skylint: AST-based invariant checkers for this repository.
+
+Eleven PRs accreted a set of load-bearing, review-enforced contracts —
+fenced sqlite status writes, atomic write-then-rename into the state
+dir, trace/env stamps at every spawn boundary, no ``time.sleep`` in
+retry loops, stable span/metric/alert-rule/fault-site names, a
+documented ``SKYTPU_*`` env surface. This package turns them into
+machine-checked ones: a small stdlib-``ast`` checker framework
+(:mod:`~skypilot_tpu.analysis.core`) plus one checker per contract
+(:mod:`~skypilot_tpu.analysis.checkers`).
+
+Surfaces:
+
+- ``xsky lint [--rule ID] [--format text|json] [PATHS...]``
+- ``python -m skypilot_tpu.analysis [PATHS...]`` (exit 1 on findings)
+- ``tests/test_analysis.py`` runs the suite over ``skypilot_tpu/`` in
+  tier-1 and asserts zero findings.
+
+Suppression is explicit and audited: ``# skylint: disable=<rule> —
+<justification>`` on the finding line (or alone on the line above).
+A bare disable without a justification is itself a finding; see
+docs/static_analysis.md for the rule table and suppression policy.
+"""
+from skypilot_tpu.analysis.core import (Checker, FileContext, Finding,
+                                        RepoContext, all_rule_ids, run)
+
+__all__ = ['Checker', 'FileContext', 'Finding', 'RepoContext',
+           'all_rule_ids', 'run']

@@ -1,0 +1,46 @@
+"""``python -m skypilot_tpu.analysis [PATHS...]`` — CI entry point.
+
+Exits non-zero when the suite reports any unsuppressed finding (and
+on an empty scan — a gate that scanned nothing must not report
+clean), so a plain ``python -m skypilot_tpu.analysis`` is the whole
+CI gate. ``xsky lint`` is the human-facing wrapper; both share
+``core.run``/``core.render``/``core.default_paths``.
+"""
+import argparse
+import sys
+
+from skypilot_tpu.analysis import core
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.analysis',
+        description='skylint: AST-based invariant checkers '
+                    '(docs/static_analysis.md).')
+    parser.add_argument('paths', nargs='*', default=None,
+                        help='Files/directories to scan (default: '
+                             'the installed skypilot_tpu package).')
+    parser.add_argument('--rule', action='append', default=None,
+                        help='Run only this rule id (repeatable).')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='Print the registered rule ids and '
+                             'exit.')
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule, description in core.rule_listing():
+            print(f'{rule}: {description}')
+        return 0
+    try:
+        findings = core.run(args.paths or core.default_paths(),
+                            rules=args.rule)
+    except ValueError as e:  # unknown rule id / empty scan
+        print(f'error: {e}', file=sys.stderr)
+        return 2
+    print(core.render(findings, args.format))
+    return 1 if findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""The skylint checker suite: one module per invariant.
+
+``build_all()`` is the single registry — the CLI, the module entry
+point, and the tests all enumerate rules through it, and
+tests/test_analysis.py meta-checks that every rule here has a
+seeded-violation fixture and a docs/static_analysis.md row.
+"""
+from typing import List
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis.checkers import atomic_write
+from skypilot_tpu.analysis.checkers import blocking_jit
+from skypilot_tpu.analysis.checkers import env_contract
+from skypilot_tpu.analysis.checkers import naked_thread
+from skypilot_tpu.analysis.checkers import names
+from skypilot_tpu.analysis.checkers import sleep_retry
+from skypilot_tpu.analysis.checkers import spawn_stamp
+from skypilot_tpu.analysis.checkers import state_write
+
+
+def build_all() -> List['core.Checker']:
+    return [
+        state_write.StateWriteChecker(),
+        atomic_write.AtomicWriteChecker(),
+        sleep_retry.SleepInRetryChecker(),
+        spawn_stamp.SpawnStampChecker(),
+        env_contract.EnvContractChecker(),
+        blocking_jit.BlockingInJitChecker(),
+        naked_thread.NakedThreadChecker(),
+        names.SpanNameContractChecker(),
+        names.MetricNameContractChecker(),
+        names.AlertRuleContractChecker(),
+        names.FaultSiteContractChecker(),
+    ]

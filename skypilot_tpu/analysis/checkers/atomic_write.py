@@ -1,0 +1,202 @@
+"""non-atomic-write: state-dir files land via write-tmp-fsync-rename.
+
+The contract (docs/architecture.md, PR 3 onward): anything written
+under ``$SKYTPU_STATE_DIR`` must be published atomically — write to a
+``*.tmp`` sibling, then ``os.replace``/``os.rename`` — so a reader
+(or a crashed writer) never observes a torn file. The checker taints
+path expressions that derive from a state-dir read and flags
+truncating ``open(path, 'w')`` on them unless the idiom is present.
+
+Taint propagation is intra-function over simple assignments
+(``p = os.path.join(state_dir, ...)``), seeded by:
+
+- direct env reads of ``SKYTPU_STATE_DIR``;
+- calls to same-module functions whose body reads it (helper
+  indirection: ``_db_dir()`` / ``_history_dir()`` style);
+- calls to the repo's known cross-module state-dir path producers.
+
+Append mode is exempt (jsonl ring buffers / registries append under
+a lock — a torn LINE is skipped by their readers, a torn FILE is
+not possible); so are paths that are themselves the tmp side of the
+idiom, and functions that do rename somewhere in their body.
+"""
+import ast
+import re
+from typing import Dict, Iterable, Set
+
+from skypilot_tpu.analysis import core
+
+_ENV_READS = ('os.environ.get', 'os.getenv')
+_STATE_ENV = 'SKYTPU_STATE_DIR'
+# Cross-module producers of state-dir paths (qualified-name
+# suffixes): keep in sync with the state modules.
+_KNOWN_PRODUCERS = (
+    'state._db_dir', 'state._db_path',
+    'lifecycle.registry.registry_path',
+    'metrics.history.history_dir',
+)
+_TRUNCATE_MODES = {'w', 'wb', 'w+', 'wb+', 'w+b'}
+_TMP_HINT = re.compile(r'tmp', re.IGNORECASE)
+
+
+def _reads_state_env(ctx: 'core.FileContext', func: ast.AST) -> bool:
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            qual = ctx.call_name(node)
+            if qual in _ENV_READS and node.args:
+                if ctx.string_value(node.args[0]) == _STATE_ENV:
+                    return True
+        elif isinstance(node, ast.Subscript):
+            if ctx.qualname(node.value) == 'os.environ' and \
+                    ctx.string_value(node.slice) == _STATE_ENV:
+                return True
+    return False
+
+
+class AtomicWriteChecker(core.Checker):
+    rule = 'non-atomic-write'
+    description = ('Truncating open(..., "w") on a '
+                   '$SKYTPU_STATE_DIR-derived path without the '
+                   'write-tmp-fsync-rename idiom.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        producers = self._module_producers(ctx)
+        for func in ast.walk(ctx.tree):
+            if isinstance(func, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, func, producers)
+
+    def _module_producers(self, ctx: 'core.FileContext') -> Set[str]:
+        """Names of same-module functions whose body reads the state
+        dir (one fixpoint pass catches helper-of-helper)."""
+        funcs = {node.name: node for node in ast.walk(ctx.tree)
+                 if isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+        producers = {name for name, node in funcs.items()
+                     if _reads_state_env(ctx, node)}
+        changed = True
+        while changed:
+            changed = False
+            for name, node in funcs.items():
+                if name in producers:
+                    continue
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call):
+                        qual = ctx.call_name(call) or ''
+                        if qual in producers or \
+                                self._known_producer(qual):
+                            producers.add(name)
+                            changed = True
+                            break
+        return producers
+
+    @staticmethod
+    def _known_producer(qual: str) -> bool:
+        return any(qual.endswith(k) for k in _KNOWN_PRODUCERS)
+
+    def _check_function(self, ctx, func, producers
+                        ) -> Iterable['core.Finding']:
+        tainted = self._tainted_names(ctx, func, producers)
+        renames = [n for n in ast.walk(func)
+                   if isinstance(n, ast.Call)
+                   and (ctx.call_name(n) or '') in ('os.replace',
+                                                    'os.rename')
+                   and len(n.args) >= 2]
+        for call in ast.walk(func):
+            if not isinstance(call, ast.Call):
+                continue
+            if (ctx.call_name(call) or '') not in ('open',
+                                                   'builtins.open',
+                                                   'io.open'):
+                continue
+            mode = self._mode_of(ctx, call)
+            if mode not in _TRUNCATE_MODES:
+                continue
+            if not call.args:
+                continue
+            path_arg = call.args[0]
+            if not self._is_state_path(ctx, path_arg, tainted,
+                                       producers):
+                continue
+            if self._is_rename_source(ctx, path_arg, renames):
+                continue  # the tmp side: this write is renamed away
+            if _TMP_HINT.search(ctx.source_of(path_arg)):
+                continue  # tmp-named path (cosmetic-mismatch net)
+            yield core.Finding(
+                self.rule, ctx.rel, call.lineno, call.col_offset + 1,
+                'truncating write to state-dir path '
+                f'`{ctx.source_of(path_arg)}` without write-tmp → '
+                'fsync → os.replace — a reader (or a crash '
+                'mid-write) observes a torn file; publish '
+                'atomically like metrics/history.py')
+
+    @staticmethod
+    def _is_rename_source(ctx, path_arg, renames) -> bool:
+        """True when this exact path is the SOURCE of an
+        os.replace/os.rename in the function — i.e. the written file
+        is the tmp side, renamed away to publish. The waiver is tied
+        to the flagged path itself: one correctly-published file
+        must not excuse a torn write to a sibling, and a rename
+        LANDING on the path does not make its own truncating write
+        atomic."""
+        src_text = ctx.source_of(path_arg)
+        src_name = path_arg.id if isinstance(path_arg, ast.Name) \
+            else None
+        for rename in renames:
+            source = rename.args[0]
+            if ctx.source_of(source) == src_text:
+                return True
+            if src_name is not None and \
+                    isinstance(source, ast.Name) and \
+                    source.id == src_name:
+                return True
+        return False
+
+    def _tainted_names(self, ctx, func, producers) -> Set[str]:
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not self._expr_tainted(ctx, node.value, tainted,
+                                          producers):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and \
+                            target.id not in tainted:
+                        tainted.add(target.id)
+                        changed = True
+        return tainted
+
+    def _expr_tainted(self, ctx, expr, tainted, producers) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return True
+            if isinstance(node, ast.Call):
+                qual = ctx.call_name(node) or ''
+                if qual in producers or self._known_producer(qual):
+                    return True
+                if qual in _ENV_READS and node.args and \
+                        ctx.string_value(node.args[0]) == _STATE_ENV:
+                    return True
+            if isinstance(node, ast.Subscript) and \
+                    ctx.qualname(node.value) == 'os.environ' and \
+                    ctx.string_value(node.slice) == _STATE_ENV:
+                return True
+        return False
+
+    def _is_state_path(self, ctx, path_arg, tainted,
+                       producers) -> bool:
+        return self._expr_tainted(ctx, path_arg, tainted, producers)
+
+    @staticmethod
+    def _mode_of(ctx, call) -> str:
+        if len(call.args) >= 2:
+            return ctx.string_value(call.args[1]) or ''
+        for kw in call.keywords:
+            if kw.arg == 'mode':
+                return ctx.string_value(kw.value) or ''
+        return 'r'

@@ -1,0 +1,156 @@
+"""blocking-in-jit: no host I/O reachable inside compiled functions.
+
+Functions handed to ``jax.jit`` / ``shard_map`` in the compute
+modules (``ops/``, ``models/``, ``serve/batching.py``) execute inside
+a trace: host side effects either run once at trace time (silently
+wrong) or force a callback sync every step (silently slow — the
+goodput accountant books it as compute). File, socket, sqlite,
+subprocess and sleep calls must stay outside the jitted region.
+
+The checker finds jit roots three ways —
+
+- decorators: ``@jax.jit``, ``@functools.partial(jax.jit, ...)``,
+  ``@shard_map``-style;
+- call forms: ``jax.jit(fn)``, ``jax.jit(lambda: ...)``,
+  ``shard_map(fn, mesh=...)`` where ``fn`` is a local function or
+  lambda;
+
+— then walks the *same-module call graph* to a fixpoint, so a jitted
+function that calls a local helper that opens a file is still caught
+(the indirection regexes could never see).
+"""
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from skypilot_tpu.analysis import core
+
+_SCOPES = ('ops/', 'models/')
+_SCOPE_FILES = ('serve/batching.py',)
+_JIT_NAMES = ('jax.jit', 'jax.experimental.shard_map.shard_map')
+_JIT_SUFFIXES = ('.shard_map',)
+
+_BLOCKING_EXACT = {
+    'open', 'builtins.open', 'io.open', 'time.sleep',
+    'os.replace', 'os.rename', 'os.fsync', 'os.makedirs',
+    'os.remove', 'os.unlink', 'print',
+}
+_BLOCKING_PREFIXES = (
+    'sqlite3.', 'socket.', 'subprocess.', 'requests.', 'urllib.',
+    'http.client.', 'shutil.',
+)
+
+
+def _is_jit_ref(qual: str) -> bool:
+    return qual in _JIT_NAMES or \
+        any(qual.endswith(s) for s in _JIT_SUFFIXES) or \
+        qual == 'shard_map'
+
+
+def _is_blocking(qual: str) -> bool:
+    return qual in _BLOCKING_EXACT or \
+        any(qual.startswith(p) for p in _BLOCKING_PREFIXES)
+
+
+class BlockingInJitChecker(core.Checker):
+    rule = 'blocking-in-jit'
+    description = ('File/socket/sqlite/subprocess/sleep calls '
+                   'reachable (through same-module helpers) inside '
+                   'functions passed to jax.jit/shard_map in the '
+                   'compute modules.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        if not (any(ctx.rel.startswith(s) or f'/{s}' in ctx.rel
+                    for s in _SCOPES)
+                or any(ctx.rel.endswith(f) for f in _SCOPE_FILES)):
+            return
+        funcs: Dict[str, ast.AST] = {
+            node.name: node for node in ast.walk(ctx.tree)
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))}
+        roots = self._jit_roots(ctx, funcs)
+        if not roots:
+            return
+        # Same-module call graph: function name -> called local names.
+        graph: Dict[str, Set[str]] = {}
+        for name, node in funcs.items():
+            graph[name] = {
+                (ctx.call_name(c) or '')
+                for c in ast.walk(node) if isinstance(c, ast.Call)
+            } & set(funcs)
+        for root_node, via in roots:
+            yield from self._scan(ctx, root_node, via, funcs, graph)
+
+    def _jit_roots(self, ctx, funcs
+                   ) -> List[Tuple[ast.AST, str]]:
+        """(function-or-lambda node, description of the jit site)."""
+        roots: List[Tuple[ast.AST, str]] = []
+        seen: Set[int] = set()
+
+        def add(node, via):
+            if node is not None and id(node) not in seen:
+                seen.add(id(node))
+                roots.append((node, via))
+
+        for name, node in funcs.items():
+            for dec in node.decorator_list:
+                qual = ctx.qualname(dec)
+                if qual and _is_jit_ref(qual):
+                    add(node, f'@{qual} on {name}')
+                if isinstance(dec, ast.Call):
+                    dec_qual = ctx.call_name(dec) or ''
+                    if _is_jit_ref(dec_qual):
+                        add(node, f'@{dec_qual} on {name}')
+                    elif dec_qual.endswith('partial') and dec.args:
+                        inner = ctx.qualname(dec.args[0])
+                        if inner and _is_jit_ref(inner):
+                            add(node, f'@partial({inner}) on {name}')
+        for call in ctx.calls():
+            qual = ctx.call_name(call) or ''
+            if not _is_jit_ref(qual):
+                continue
+            if not call.args:
+                continue
+            target = call.args[0]
+            if isinstance(target, ast.Lambda):
+                add(target, f'lambda passed to {qual} at line '
+                            f'{call.lineno}')
+            elif isinstance(target, ast.Name) and \
+                    target.id in funcs:
+                add(funcs[target.id],
+                    f'{target.id} passed to {qual}')
+        return roots
+
+    def _scan(self, ctx, root, via, funcs, graph
+              ) -> Iterable['core.Finding']:
+        # Reachable same-module functions from this root.
+        frontier = [root]
+        if isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            reachable = self._closure(root.name, graph)
+            frontier += [funcs[n] for n in reachable
+                         if n in funcs and funcs[n] is not root]
+        for node in frontier:
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                qual = ctx.call_name(call) or ''
+                if _is_blocking(qual):
+                    yield core.Finding(
+                        self.rule, ctx.rel, call.lineno,
+                        call.col_offset + 1,
+                        f'blocking call {qual}() is reachable inside '
+                        f'a compiled function ({via}) — host I/O in '
+                        'a jit trace either runs once at trace time '
+                        'or syncs the device every step')
+
+    @staticmethod
+    def _closure(name: str, graph: Dict[str, Set[str]]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [name]
+        while stack:
+            cur = stack.pop()
+            for callee in graph.get(cur, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        return seen

@@ -1,0 +1,42 @@
+"""naked-thread: every Thread declares its lifecycle intent.
+
+The contract (docs/lifecycle.md): this repo runs daemons — skylets,
+controllers, agents — whose shutdown story is the lifecycle registry
+and the sweeper, not interpreter teardown luck. A
+``threading.Thread(...)`` without an explicit ``daemon=`` is a latent
+hang: the default (inherit-from-spawner, usually ``False``) keeps the
+process alive past ``main()`` on the exact code paths (crash
+handling, test teardown) nobody exercises until production.
+
+The rule is mechanical on purpose: **say what you mean**. Background
+loops pass ``daemon=True``; a deliberately non-daemon worker passes
+``daemon=False`` and is expected to be joined or registered with the
+lifecycle registry — flag that intent with an inline
+``# skylint: disable=naked-thread — <who joins it>`` if it must
+stay implicit.
+"""
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+
+
+class NakedThreadChecker(core.Checker):
+    rule = 'naked-thread'
+    description = ('threading.Thread(...) without an explicit '
+                   'daemon= keyword.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        for call in ctx.calls():
+            qual = ctx.call_name(call) or ''
+            if qual != 'threading.Thread':
+                continue
+            if any(kw.arg == 'daemon' for kw in call.keywords):
+                continue
+            yield core.Finding(
+                self.rule, ctx.rel, call.lineno, call.col_offset + 1,
+                'threading.Thread without explicit daemon= — the '
+                'inherited default keeps the process alive past '
+                'main() on crash paths; declare daemon=True for '
+                'background loops or daemon=False for joined '
+                'workers')

@@ -1,0 +1,123 @@
+"""sleep-in-retry: retry loops use resilience.RetryPolicy, not sleep.
+
+The contract (PR 2, docs/resilience.md): hand-rolled
+``time.sleep``-in-a-loop retries are banned outside ``resilience/``
+— RetryPolicy owns jitter, deadlines, and fault-site accounting, and
+a bare sleep loop is exactly what turns a transient agent blip into
+a deterministic 30-second stall.
+
+This is the old grep lint (tests/test_resilience.py) rebuilt with
+call-graph awareness: the grep saw ``time.sleep`` within a ±6-line
+window of 'retry'-ish words; the AST checker sees
+
+- the sleep call resolved through aliasing
+  (``from time import sleep as pause``);
+- a loop whose body calls a same-module helper that itself sleeps
+  (one level of indirection — the way real violations hid from the
+  grep in review passes on PRs 2/3);
+- retry evidence as *identifiers* in the loop or enclosing function
+  (``attempt``/``backoff``/``retry``/``retries``), not comment text.
+
+Legitimate liveness waits (port-wait on a process we just spawned)
+carry inline ``# skylint: disable=sleep-in-retry`` justifications.
+"""
+import ast
+from typing import Iterable, Set
+
+from skypilot_tpu.analysis import core
+
+_MARKERS = ('attempt', 'backoff', 'retry', 'retries')
+
+
+def _sleep_call(ctx: 'core.FileContext', node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and ctx.call_name(node) == 'time.sleep')
+
+
+def _identifiers(node: ast.AST) -> Iterable[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+        elif isinstance(sub, ast.arg):
+            yield sub.arg
+        elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield sub.name
+
+
+def _retryish(loop: ast.AST, func) -> bool:
+    """Retry evidence in the LOOP's own subtree (header + body), or
+    in the enclosing function's NAME. Deliberately not the whole
+    function body: an unrelated `get_retry_policy()` call elsewhere
+    in a function must not condemn its liveness poll loop."""
+    for ident in _identifiers(loop):
+        low = ident.lower()
+        if any(m in low for m in _MARKERS):
+            return True
+    if func is not None and \
+            any(m in func.name.lower() for m in _MARKERS):
+        return True
+    return False
+
+
+class SleepInRetryChecker(core.Checker):
+    rule = 'sleep-in-retry'
+    description = ('time.sleep inside a retry-shaped loop outside '
+                   'resilience/ (direct or via a same-module '
+                   'helper) — use resilience.RetryPolicy.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        if '/resilience/' in f'/{ctx.rel}':
+            return
+        sleeper_helpers = self._sleeping_helpers(ctx)
+        for node in ast.walk(ctx.tree):
+            direct = _sleep_call(ctx, node)
+            via_helper = self._calls_sleeper(ctx, node,
+                                             sleeper_helpers)
+            if not (direct or via_helper):
+                continue
+            loop = ctx.enclosing_loop(node)
+            if loop is None:
+                continue
+            func = ctx.enclosing_function(node)
+            if not _retryish(loop, func):
+                continue
+            how = 'time.sleep' if direct else (
+                f'{ctx.call_name(node)}() (a helper that sleeps)')
+            yield core.Finding(
+                self.rule, ctx.rel, node.lineno, node.col_offset + 1,
+                f'{how} inside a retry-shaped loop — hand-rolled '
+                'backoff stalls deterministically and skips fault '
+                'accounting; route through resilience.RetryPolicy')
+
+    @staticmethod
+    def _calls_sleeper(ctx: 'core.FileContext', node: ast.AST,
+                       helpers: Set[str]) -> bool:
+        """A call to a same-module sleeping helper: bare name, or a
+        self./cls. method (class-heavy controllers are the common
+        shape) — but NOT arbitrary receivers, whose same-named
+        methods may belong to another class entirely."""
+        if not isinstance(node, ast.Call):
+            return False
+        if (ctx.call_name(node) or '') in helpers:
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ('self', 'cls')
+                and node.func.attr in helpers)
+
+    @staticmethod
+    def _sleeping_helpers(ctx: 'core.FileContext') -> Set[str]:
+        """Same-module functions that call time.sleep directly and
+        unconditionally enough to matter (any direct call counts)."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if _sleep_call(ctx, sub):
+                        out.add(node.name)
+                        break
+        return out

@@ -1,0 +1,119 @@
+"""spawn-without-stamp: explicit spawn envs carry the trace contract.
+
+The contract (PR 6, docs/observability.md Tracing): the trace
+context propagates across process boundaries via the
+``SKYTPU_TRACE_CONTEXT`` env stamp. A spawn that passes NO ``env=``
+inherits the parent environment — the stamp flows for free. A spawn
+that builds a FRESH env dict and passes it severs the trace (and
+usually the whole SKYTPU_* contract) silently: the child's spans
+land in a brand-new trace and ``xsky trace`` shows a hole where the
+subprocess should be. That exact bug shipped twice before the stamp
+helpers existed.
+
+The rule: every ``subprocess.Popen`` / ``os.exec*`` / ``os.spawn*``
+call that passes ``env=`` must build that env from one of the
+sanctioned sources, observable in the enclosing function:
+
+- a copy of ``os.environ`` (``dict(os.environ)`` /
+  ``os.environ.copy()`` / ``{**os.environ}``) — stamp inherited;
+- the trace/env-contract stamping helpers
+  (``trace.context_env()``, ``_trace_env_from_header``,
+  ``env_contract.build_env``);
+- a function parameter (the CALLER owns the contract; the runtime's
+  run_with_log is the canonical pass-through);
+- an explicit ``SKYTPU_TRACE_CONTEXT`` key.
+
+Deliberate un-stamping (daemons that must NOT inherit a launch-time
+trace) stays visible: it copies os.environ then ``pop``\\ s the stamp,
+which this checker accepts — the pop is the documentation.
+"""
+import ast
+import re
+from typing import Iterable, Optional
+
+from skypilot_tpu.analysis import core
+
+_SPAWN_PREFIXES = ('os.exec', 'os.spawn', 'os.posix_spawn')
+_SPAWN_EXACT = ('subprocess.Popen',)
+# Textual evidence that an env expression descends from a sanctioned
+# source (checked over the source of the statements that build it).
+_EVIDENCE = re.compile(
+    r'os\.environ|context_env|trace_env|_trace_env_from_header'
+    r'|build_env|SKYTPU_TRACE_CONTEXT|ENV_CONTEXT|TRACE_CONTEXT_ENV')
+
+
+class SpawnStampChecker(core.Checker):
+    rule = 'spawn-without-stamp'
+    description = ('subprocess.Popen / os.exec* with a fresh env= '
+                   'that does not route through the trace/env-'
+                   'contract stamping helpers.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        for call in ctx.calls():
+            qual = ctx.call_name(call) or ''
+            if not (qual in _SPAWN_EXACT
+                    or any(qual.startswith(p)
+                           for p in _SPAWN_PREFIXES)):
+                continue
+            env_kw = self._env_kwarg(call)
+            if env_kw is None:
+                continue  # inherited env — the stamp flows
+            if isinstance(env_kw, ast.Constant) and \
+                    env_kw.value is None:
+                continue  # env=None inherits too (Popen contract)
+            if self._env_sanctioned(ctx, call, env_kw):
+                continue
+            yield core.Finding(
+                self.rule, ctx.rel, call.lineno, call.col_offset + 1,
+                f'{qual}(..., env=...) builds a fresh environment '
+                'without the trace/env stamp — the child process '
+                'drops out of its trace (and the SKYTPU_* env '
+                'contract); base it on dict(os.environ) or merge '
+                'trace.context_env() / env_contract.build_env()')
+
+    @staticmethod
+    def _env_kwarg(call: ast.Call) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == 'env':
+                return kw.value
+        return None
+
+    def _env_sanctioned(self, ctx, call, env_expr) -> bool:
+        # Direct evidence in the env expression itself.
+        if _EVIDENCE.search(ctx.source_of(env_expr)):
+            return True
+        func = ctx.enclosing_function(call)
+        if isinstance(env_expr, ast.Name):
+            name = env_expr.id
+            if func is not None:
+                # A parameter: the caller owns the stamp.
+                args = func.args
+                params = [a.arg for a in
+                          args.posonlyargs + args.args
+                          + args.kwonlyargs]
+                if name in params:
+                    return True
+                # Any statement that assigns to / mutates the env
+                # variable with sanctioned evidence.
+                for node in ast.walk(func):
+                    if self._touches_name(node, name) and \
+                            _EVIDENCE.search(ctx.source_of(node)):
+                        return True
+        return False
+
+    @staticmethod
+    def _touches_name(node: ast.AST, name: str) -> bool:
+        if isinstance(node, ast.Assign):
+            return any(isinstance(t, ast.Name) and t.id == name
+                       for t in node.targets)
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name):
+            # env.update(...), env.setdefault(...), env.pop(...)
+            return node.func.value.id == name and \
+                node.func.attr in ('update', 'setdefault', 'pop')
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name):
+            return node.value.id == name
+        return False

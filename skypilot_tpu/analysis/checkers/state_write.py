@@ -1,0 +1,93 @@
+"""unfenced-state-write: status columns go through the fencing path.
+
+The contract (docs/lifecycle.md, lifecycle/fencing.py): the
+``status`` column of the ``services`` and ``managed_jobs`` tables is
+written ONLY by the two state modules (``serve/serve_state.py``,
+``jobs/state.py``), and every status UPDATE there carries the fence
+stamp — epoch bump + writer pid from ``fencing.stamp_sets()`` and/or
+the ``status_fenced`` guard in the WHERE clause. A bare
+``UPDATE services SET status=...`` anywhere else is exactly the
+zombie-writer bug PR 5 fenced (a late graceful DOWN overwriting a
+reconciler's confirmed FAILED).
+
+Detection is on SQL string literals (f-strings flattened, so
+``f'... {stamp_sql} ...'`` is visible as a placeholder): an
+UPDATE/INSERT on either table whose write-set touches the bare
+``status`` column. Dynamic SET lists built at runtime are invisible
+to any static check — those live in the two allowed modules, whose
+functions are additionally required to call ``fencing.stamp_sets``.
+"""
+import ast
+import re
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+
+_ALLOWED = ('serve/serve_state.py', 'jobs/state.py')
+
+_UPDATE_RE = re.compile(
+    r'\bUPDATE\s+(services|managed_jobs)\b(.*?)(?:\bWHERE\b|$)',
+    re.IGNORECASE | re.DOTALL)
+_INSERT_RE = re.compile(
+    r'\bINSERT(?:\s+OR\s+\w+)?\s+INTO\s+(services|managed_jobs)\s*'
+    r'\(([^)]*)\)', re.IGNORECASE | re.DOTALL)
+# The bare column, not status_fenced/status_epoch/status_writer_pid.
+_STATUS_SET_RE = re.compile(r'(?<![A-Za-z0-9_])status\s*=')
+_STATUS_COL_RE = re.compile(r'(?<![A-Za-z0-9_])status(?![A-Za-z0-9_])')
+_FENCE_EVIDENCE_RE = re.compile(r'status_fenced|status_epoch')
+
+
+class StateWriteChecker(core.Checker):
+    rule = 'unfenced-state-write'
+    description = ('Direct UPDATE/INSERT on the services/managed_jobs '
+                   'status column outside the fencing-routed state '
+                   'modules (or without the fence stamp inside them).')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        allowed_file = any(ctx.rel.endswith(a) for a in _ALLOWED)
+        for node, text in ctx.sql_strings():
+            for m in _UPDATE_RE.finditer(text):
+                set_clause = m.group(2)
+                if not _STATUS_SET_RE.search(set_clause):
+                    continue
+                if not allowed_file:
+                    yield self._finding(ctx, node, m.group(1),
+                                        'UPDATE')
+                    continue
+                if not (_FENCE_EVIDENCE_RE.search(text)
+                        or self._calls_stamp_sets(ctx, node)):
+                    yield core.Finding(
+                        self.rule, ctx.rel, node.lineno,
+                        node.col_offset + 1,
+                        f'status UPDATE on {m.group(1)} without the '
+                        'terminal-state fence stamp — route the SET '
+                        'through fencing.stamp_sets() and keep the '
+                        'fence predicate in the WHERE clause '
+                        '(lifecycle/fencing.py)')
+            if not allowed_file:
+                for m in _INSERT_RE.finditer(text):
+                    if _STATUS_COL_RE.search(m.group(2)):
+                        yield self._finding(ctx, node, m.group(1),
+                                            'INSERT')
+
+    def _calls_stamp_sets(self, ctx: 'core.FileContext',
+                          node: ast.AST) -> bool:
+        func = ctx.enclosing_function(node)
+        if func is None:
+            return False
+        for call in ast.walk(func):
+            if isinstance(call, ast.Call):
+                qual = ctx.call_name(call)
+                if qual and qual.endswith('.stamp_sets'):
+                    return True
+        return False
+
+    def _finding(self, ctx, node, table, verb):
+        return core.Finding(
+            self.rule, ctx.rel, node.lineno, node.col_offset + 1,
+            f'direct {verb} on {table}.status outside the state '
+            f'modules {list(_ALLOWED)} — status transitions must go '
+            'through the fenced helpers (set_service_status / '
+            'set_status), or a zombie writer can overwrite a '
+            'confirmed death')

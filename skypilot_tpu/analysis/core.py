@@ -1,0 +1,588 @@
+"""skylint framework: file walking, parsed-AST contexts, findings.
+
+Everything here is stdlib ``ast`` — no third-party linter machinery.
+The design center is *seeing through indirection that regexes can't*:
+
+- every parsed file gets **parent links** (``ctx.parent(node)``) and an
+  **import-resolution scope** (``ctx.qualname(node)`` resolves
+  ``e.get(...)`` to ``os.environ.get`` through
+  ``from os import environ as e``);
+- checkers are small classes with a stable ``rule`` id; per-file logic
+  in ``check_file``, whole-repo logic (doc contracts, cross-file
+  registries) in ``check_repo``;
+- suppression is in-band and audited: ``# skylint: disable=<rule> —
+  <justification>`` on the finding's line or alone on the line above.
+  A disable without justification, or naming an unknown rule, is
+  itself a finding (rule ``suppression``) — the escape hatch cannot
+  rot silently.
+"""
+import ast
+import dataclasses
+import os
+import re
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence,
+                    Set, Tuple)
+
+_PARENT_ATTR = '_skylint_parent'
+
+SEVERITIES = ('error', 'warning')
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a location.
+
+    ``to_dict()`` is the stable JSON schema (``xsky lint --format
+    json``); tests pin its keys — extend, never rename.
+    """
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = 'error'
+
+    def location(self) -> str:
+        return f'{self.path}:{self.line}'
+
+    def to_dict(self) -> Dict[str, object]:
+        return {'rule': self.rule, 'path': self.path,
+                'line': self.line, 'col': self.col,
+                'severity': self.severity, 'message': self.message}
+
+    def render(self) -> str:
+        return (f'{self.path}:{self.line}:{self.col}: '
+                f'{self.severity}: [{self.rule}] {self.message}')
+
+
+class Checker:
+    """Base class: subclasses set ``rule``/``description`` and
+    override ``check_file`` and/or ``check_repo``."""
+
+    rule: str = ''
+    description: str = ''
+
+    def check_file(self, ctx: 'FileContext') -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, repo: 'RepoContext') -> Iterable[Finding]:
+        return ()
+
+
+# `# skylint: disable=<rule>[,<rule>...] [— justification]`
+_DISABLE_RE = re.compile(
+    r'#\s*skylint:\s*disable=([A-Za-z0-9_,-]+)\s*(.*)$')
+# The justification may be introduced by an em/en dash, hyphen(s), or
+# colon; what matters is that non-empty prose follows.
+_JUSTIFICATION_STRIP = re.compile(r'^[-—–:\s]+')
+
+SUPPRESSION_RULE = 'suppression'
+
+
+def _parse_suppressions(text: str, rel: str,
+                        known_rules: Set[str]
+                        ) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """Line -> set of disabled rules, plus findings for bad disables
+    (missing justification, unknown rule id). Directives are read
+    from real COMMENT tokens only — a ``# skylint: disable=`` shown
+    inside a docstring or string literal (documentation of the
+    syntax, generated snippets) is neither a directive nor a bad
+    one."""
+    table: Dict[int, Set[str]] = {}
+    bad: List[Finding] = []
+    import io
+    import tokenize
+    comments = []
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append(tok)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return table, bad  # unparsable file: reported elsewhere
+    for tok in comments:
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
+        justification = _JUSTIFICATION_STRIP.sub('', m.group(2)).strip()
+        col = tok.start[1] + m.start() + 1
+        if not justification:
+            bad.append(Finding(
+                SUPPRESSION_RULE, rel, lineno, col,
+                'skylint disable without a justification — every '
+                'suppression must say WHY the invariant does not '
+                "apply here ('# skylint: disable=<rule> — reason')"))
+            continue
+        unknown = sorted(r for r in rules if r not in known_rules)
+        if unknown:
+            bad.append(Finding(
+                SUPPRESSION_RULE, rel, lineno, col,
+                f'skylint disable names unknown rule(s) {unknown} '
+                '(typo? see docs/static_analysis.md for the rule '
+                'table)'))
+            rules -= set(unknown)
+        if rules:
+            table.setdefault(lineno, set()).update(rules)
+    return table, bad
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name; anchored at the ``skypilot_tpu`` package
+    when the file lives inside it, else the bare stem."""
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    stem = [p for p in parts if p]
+    if 'skypilot_tpu' in stem:
+        # Innermost occurrence: a checkout dir named skypilot_tpu
+        # must not shift every module name up a level.
+        idx = len(stem) - 1 - stem[::-1].index('skypilot_tpu')
+        stem = stem[idx:]
+    else:
+        stem = stem[-1:]
+    stem[-1] = stem[-1][:-3] if stem[-1].endswith('.py') else stem[-1]
+    if stem[-1] == '__init__':
+        stem = stem[:-1]
+    return '.'.join(stem)
+
+
+class FileContext:
+    """One parsed file: source, AST with parent links, import scope,
+    suppression table."""
+
+    def __init__(self, path: str, rel: str,
+                 known_rules: Optional[Set[str]] = None,
+                 text: Optional[str] = None):
+        self.path = path
+        self.rel = rel
+        if text is None:
+            with open(path, encoding='utf-8') as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.module = _module_name(path)
+        self.parse_error: Optional[Finding] = None
+        try:
+            self.tree: ast.Module = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = ast.Module(body=[], type_ignores=[])
+            self.parse_error = Finding(
+                'parse-error', rel, e.lineno or 1, (e.offset or 0) + 1,
+                f'file does not parse: {e.msg}')
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                setattr(child, _PARENT_ATTR, node)
+        self.imports = self._collect_imports()
+        self.suppressions, self.bad_suppressions = _parse_suppressions(
+            self.text, rel, known_rules or set())
+        # Module-level `NAME = 'literal str'` constants (used by e.g.
+        # the env-contract checker to resolve `environ.get(ENV_FOO)`).
+        self.str_constants: Dict[str, str] = {}
+        for stmt in self.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        self.str_constants[target.id] = stmt.value.value
+
+    def _collect_imports(self) -> Dict[str, str]:
+        table: Dict[str, str] = {}
+        pkg_parts = self.module.split('.')[:-1]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        table[alias.asname] = alias.name
+                    else:
+                        # `import a.b.c` binds `a` -> 'a'.
+                        table[alias.name.split('.')[0]] = \
+                            alias.name.split('.')[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base_parts = pkg_parts[:len(pkg_parts)
+                                           - (node.level - 1)]
+                    base = '.'.join(base_parts)
+                    if node.module:
+                        base = f'{base}.{node.module}' if base \
+                            else node.module
+                else:
+                    base = node.module or ''
+                for alias in node.names:
+                    if alias.name == '*':
+                        continue
+                    table[alias.asname or alias.name] = \
+                        f'{base}.{alias.name}' if base else alias.name
+        return table
+
+    # -- navigation ---------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, _PARENT_ATTR, None)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST
+                           ) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_loop(self, node: ast.AST) -> Optional[ast.AST]:
+        """Innermost for/while whose BODY contains ``node`` (stops at
+        the enclosing function boundary)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return anc
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+        return None
+
+    # -- resolution ---------------------------------------------------
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain, resolved through
+        this file's imports: with ``from os import environ as e``,
+        ``e.get`` resolves to ``os.environ.get``."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(self.imports.get(node.id, node.id))
+            return '.'.join(reversed(parts))
+        return None
+
+    def call_name(self, call: ast.Call) -> Optional[str]:
+        return self.qualname(call.func)
+
+    def calls(self) -> Iterator[ast.Call]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def string_value(self, node: ast.AST) -> Optional[str]:
+        """Literal string value of a node, resolving Names through
+        module-level constants and imported constants are left to the
+        repo pass (see RepoContext.resolve_constant)."""
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.str_constants.get(node.id)
+        return None
+
+    def joined_prefix(self, node: ast.AST) -> Optional[str]:
+        """For dynamically-built strings (f-strings, ``+``), the
+        constant LEADING text — lets checkers treat
+        ``f'SKYTPU_FLASH_BLOCK_{x}'`` as the family
+        ``SKYTPU_FLASH_BLOCK_*``."""
+        if isinstance(node, ast.JoinedStr) and node.values:
+            head = node.values[0]
+            if isinstance(head, ast.Constant) and \
+                    isinstance(head.value, str):
+                return head.value
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.Add):
+            return self.string_value(node.left) or \
+                self.joined_prefix(node.left)
+        return None
+
+    def sql_strings(self) -> Iterator[Tuple[ast.AST, str]]:
+        """(node, text) for every string literal, with f-string
+        placeholder parts flattened to ``{}`` — enough for SQL-shape
+        checks to see through ``f'UPDATE ... {stamp_sql} ...'``.
+        Docstrings / bare string statements are skipped (prose, not
+        executed SQL)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                # Skip constants that are part of a JoinedStr (the
+                # JoinedStr itself is yielded, flattened) and bare
+                # string expression statements (docstrings).
+                par = self.parent(node)
+                if isinstance(par, ast.JoinedStr) or \
+                        isinstance(par, ast.FormattedValue) or \
+                        isinstance(par, ast.Expr):
+                    continue
+                yield node, node.value
+            elif isinstance(node, ast.JoinedStr):
+                parts = []
+                for val in node.values:
+                    if isinstance(val, ast.Constant) and \
+                            isinstance(val.value, str):
+                        parts.append(val.value)
+                    else:
+                        parts.append('{}')
+                yield node, ''.join(parts)
+
+    def source_of(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.text, node) or ''
+
+
+class RepoContext:
+    """The whole scanned set: per-file contexts plus repo anchors
+    (package root, docs dir) and a repo-wide constant table."""
+
+    def __init__(self, files: List[FileContext],
+                 docs_dir: Optional[str] = None):
+        self.files = files
+        self.by_rel = {ctx.rel: ctx for ctx in files}
+        self._partial: Optional[bool] = None
+        self.package_root = self._find_package_root()
+        if docs_dir is None and self.package_root:
+            cand = os.path.join(os.path.dirname(self.package_root),
+                                'docs')
+            docs_dir = cand if os.path.isdir(cand) else None
+        self.docs_dir = docs_dir
+        # {qualified.CONST: value} for module-level string constants.
+        self.constants: Dict[str, str] = {}
+        for ctx in files:
+            for name, value in ctx.str_constants.items():
+                self.constants[f'{ctx.module}.{name}'] = value
+
+    def _find_package_root(self) -> Optional[str]:
+        # A CHECKOUT dir named skypilot_tpu (the default clone name)
+        # must not be mistaken for the package: try occurrences
+        # innermost-first and require the real package's anatomy.
+        for ctx in self.files:
+            parts = os.path.abspath(ctx.path).split(os.sep)
+            for idx in reversed([i for i, p in enumerate(parts)
+                                 if p == 'skypilot_tpu']):
+                cand = os.sep.join(parts[:idx + 1])
+                if os.path.isfile(os.path.join(cand,
+                                               '__init__.py')) and \
+                        os.path.isdir(os.path.join(cand,
+                                                   'analysis')):
+                    return cand
+        return None
+
+    @property
+    def partial_package_scan(self) -> bool:
+        """True when the scan covers only a SLICE of the
+        skypilot_tpu package (``xsky lint skypilot_tpu/serve``).
+        The documented⇒constructed contract directions are
+        whole-repo statements and must skip on partial scans, or
+        every doc row outside the slice reads as stale. Fixture
+        trees (no package root) are never partial."""
+        if self._partial is None:
+            if self.package_root is None:
+                self._partial = False
+            else:
+                scanned = {os.path.abspath(c.path)
+                           for c in self.files}
+                self._partial = False
+                for dirpath, dirnames, files in os.walk(
+                        self.package_root):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != '__pycache__']
+                    for fn in files:
+                        if fn.endswith('.py') and \
+                                os.path.join(dirpath, fn) \
+                                not in scanned:
+                            self._partial = True
+                            break
+                    if self._partial:
+                        break
+        return self._partial
+
+    def doc_path(self, name: str) -> Optional[str]:
+        if self.docs_dir is None:
+            return None
+        path = os.path.join(self.docs_dir, name)
+        return path if os.path.exists(path) else None
+
+    def resolve_constant(self, ctx: FileContext,
+                         node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute to a module-level string constant
+        across the scanned repo (e.g. ``goodput.ENV_ACCELERATOR``)."""
+        value = ctx.string_value(node)
+        if value is not None:
+            return value
+        qual = ctx.qualname(node)
+        if qual is None:
+            return None
+        if qual in self.constants:
+            return self.constants[qual]
+        # A bare Name imported from another module resolves through
+        # the import table to its defining module's constant.
+        return self.constants.get(f'{ctx.module}.{qual}')
+
+
+def _discover(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        path = os.path.abspath(os.path.expanduser(path))
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, files in os.walk(path):
+            dirnames[:] = [d for d in dirnames
+                           if d != '__pycache__']
+            for fn in sorted(files):
+                if fn.endswith('.py'):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def _rels_of(files: Sequence[str],
+             roots: Sequence[str]) -> List[str]:
+    """Display rel path per file, guaranteed UNIQUE across the scan:
+    by_rel keys suppressions to files, so two files collapsing to
+    the same rel would let a disable in one mask a violation in the
+    other. Colliding rels fall back to the unambiguous absolute
+    path."""
+    rels = [_rel_of(p, roots) for p in files]
+    counts: Dict[str, int] = {}
+    for rel in rels:
+        counts[rel] = counts.get(rel, 0) + 1
+    return [os.path.abspath(files[i]) if counts[rel] > 1 else rel
+            for i, rel in enumerate(rels)]
+
+
+def _rel_of(path: str, roots: Sequence[str]) -> str:
+    """Repo-relative display path: relative to the skypilot_tpu
+    package dir when inside it, else to the scan root."""
+    apath = os.path.abspath(path)
+    parts = apath.split(os.sep)
+    if 'skypilot_tpu' in parts:
+        idx = len(parts) - 1 - parts[::-1].index('skypilot_tpu')
+        return '/'.join(parts[idx + 1:])
+    for root in roots:
+        root = os.path.abspath(os.path.expanduser(root))
+        if apath.startswith(root + os.sep):
+            return apath[len(root) + 1:].replace(os.sep, '/')
+        if apath == root:
+            return os.path.basename(apath)
+    return apath
+
+
+def all_checkers() -> List[Checker]:
+    from skypilot_tpu.analysis import checkers as checkers_pkg
+    return checkers_pkg.build_all()
+
+
+def all_rule_ids() -> List[str]:
+    return sorted([c.rule for c in all_checkers()]
+                  + [SUPPRESSION_RULE])
+
+
+SUPPRESSION_DESCRIPTION = (
+    'Meta-rule: every "# skylint: disable=" carries a justification '
+    'and names a real rule id (always active).')
+
+
+def rule_listing() -> List[Tuple[str, str]]:
+    """(rule id, description) for every registered rule INCLUDING
+    the suppression meta-rule — the one enumeration both --list-rules
+    surfaces print, kept consistent with all_rule_ids() and the
+    docs/static_analysis.md table."""
+    rows = [(c.rule, c.description) for c in all_checkers()]
+    rows.append((SUPPRESSION_RULE, SUPPRESSION_DESCRIPTION))
+    return rows
+
+
+def default_paths() -> List[str]:
+    """The installed skypilot_tpu package dir — the default scan
+    target for both entry points (never cwd-relative: `python -m
+    skypilot_tpu.analysis` from any cwd must scan the real tree)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [pkg]
+
+
+def render(findings: Sequence[Finding], fmt: str = 'text') -> str:
+    """One renderer for both surfaces (xsky lint and python -m) —
+    'text' is line-per-finding plus a count, 'json' is the stable
+    finding schema."""
+    if fmt == 'json':
+        import json
+        return json.dumps([f.to_dict() for f in findings], indent=2)
+    lines = [f.render() for f in findings]
+    lines.append(f'{len(findings)} finding(s).')
+    return '\n'.join(lines)
+
+
+def load_repo(paths: Sequence[str],
+              docs_dir: Optional[str] = None) -> RepoContext:
+    """Parse ``paths`` into a RepoContext without running checkers —
+    the entry point for the test-side meta-checks that assert the
+    collectors still see known construction sites."""
+    known = {c.rule for c in all_checkers()} | {SUPPRESSION_RULE}
+    files = _discover(paths)
+    rels = _rels_of(files, paths)
+    ctxs = [FileContext(p, rel, known_rules=known)
+            for p, rel in zip(files, rels)]
+    return RepoContext(ctxs, docs_dir=docs_dir)
+
+
+def run(paths: Sequence[str],
+        rules: Optional[Sequence[str]] = None,
+        docs_dir: Optional[str] = None) -> List[Finding]:
+    """Run the suite; returns UNsuppressed findings sorted by
+    location. ``rules`` filters to a subset of rule ids (the
+    ``suppression`` meta-rule is always active)."""
+    checkers = all_checkers()
+    known = {c.rule for c in checkers} | {SUPPRESSION_RULE}
+    if rules is not None:
+        unknown = sorted(set(rules) - known)
+        if unknown:
+            raise ValueError(f'unknown rule id(s): {unknown}; known: '
+                             f'{sorted(known)}')
+        checkers = [c for c in checkers if c.rule in set(rules)]
+    files = _discover(paths)
+    if not files:
+        # A gate that scans nothing must not report clean — a wrong
+        # cwd or typo'd path would otherwise certify a tree it never
+        # saw.
+        raise ValueError('no Python files found under: '
+                         + ', '.join(paths))
+    rels = _rels_of(files, paths)
+    ctxs = [FileContext(p, rel, known_rules=known)
+            for p, rel in zip(files, rels)]
+    repo = RepoContext(ctxs, docs_dir=docs_dir)
+    findings: List[Finding] = []
+    for ctx in ctxs:
+        if ctx.parse_error is not None:
+            findings.append(ctx.parse_error)
+            continue
+        findings.extend(ctx.bad_suppressions)
+        for checker in checkers:
+            findings.extend(checker.check_file(ctx))
+    for checker in checkers:
+        findings.extend(checker.check_repo(repo))
+    out = []
+    for finding in findings:
+        if finding.rule != SUPPRESSION_RULE and \
+                _is_suppressed(finding, repo):
+            continue
+        out.append(finding)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def _is_suppressed(finding: Finding, repo: RepoContext) -> bool:
+    ctx = repo.by_rel.get(finding.path)
+    if ctx is None:
+        return False
+    for lineno in (finding.line, finding.line - 1):
+        rules = ctx.suppressions.get(lineno)
+        if rules and finding.rule in rules:
+            # A disable alone on the line above covers the next
+            # statement; a same-line disable covers its own line.
+            if lineno == finding.line or _comment_only_line(
+                    ctx, lineno):
+                return True
+    return False
+
+
+def _comment_only_line(ctx: FileContext, lineno: int) -> bool:
+    if 1 <= lineno <= len(ctx.lines):
+        return ctx.lines[lineno - 1].lstrip().startswith('#')
+    return False

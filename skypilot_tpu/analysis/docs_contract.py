@@ -1,0 +1,62 @@
+"""Shared doc-table parsing for the name-contract checkers.
+
+All four name contracts (span, metric, alert-rule, fault-site) and
+the env contract follow one shape: stable names constructed in code,
+a markdown table (or prose) in docs/ backticking each name, and a
+two-way check — constructed ⇒ documented, documented ⇒ constructed.
+This module is the single parser those checkers share, so a doc
+format change breaks them all loudly in one place instead of rotting
+four regexes independently.
+"""
+import os
+import re
+from typing import Optional, Set
+
+from skypilot_tpu.analysis import core
+
+
+def read_doc(repo: 'core.RepoContext', name: str) -> Optional[str]:
+    path = repo.doc_path(name)
+    if path is None:
+        return None
+    with open(path, encoding='utf-8') as f:
+        return f.read()
+
+
+def section(text: str, start_marker: str,
+            stop_prefixes: tuple = ('\n## ', '\n# ')) -> Optional[str]:
+    """The slice of ``text`` from ``start_marker`` to the next
+    heading at or above the marker's level."""
+    idx = text.find(start_marker)
+    if idx < 0:
+        return None
+    body = text[idx + len(start_marker):]
+    stops = [body.find(p) for p in stop_prefixes if body.find(p) >= 0]
+    return body[:min(stops)] if stops else body
+
+
+def backticked(text: str, pattern: str) -> Set[str]:
+    """Every \\`token\\` in ``text`` fully matching ``pattern``."""
+    rx = re.compile(pattern)
+    return {tok for tok in re.findall(r'`([^`\n]+)`', text)
+            if rx.fullmatch(tok)}
+
+
+def table_col0(text: str, pattern: str) -> Set[str]:
+    """First-column backticked tokens of markdown table rows
+    (``| `tok` | ...``) matching ``pattern``."""
+    rx = re.compile(pattern)
+    out = set()
+    row_re = re.compile(r'^\|\s*`([^`]+)`')
+    for line in text.splitlines():
+        m = row_re.match(line.strip())
+        if m and rx.fullmatch(m.group(1)):
+            out.add(m.group(1))
+    return out
+
+
+def missing_doc_finding(rule: str, doc_name: str) -> 'core.Finding':
+    return core.Finding(
+        rule, f'docs/{doc_name}', 1, 1,
+        f'docs/{doc_name} is missing (or has no recognizable '
+        f'contract table) — the {rule} contract cannot be checked')

@@ -121,7 +121,8 @@ def launch_benchmark(task: Task, candidates: List[Resources],
         t = threading.Thread(target=_run_one,
                              args=(task, result.candidate,
                                    result.cluster_name, result,
-                                   timeout))
+                                   timeout),
+                             daemon=True)
         threads.append(t)
         t.start()
     for t in threads:

@@ -1590,6 +1590,46 @@ def bench_delete(benchmark_name):
     click.echo(f'Deleted benchmark {benchmark_name!r}.')
 
 
+# ---------------------------------------------------------------------
+# skylint (docs/static_analysis.md): the repo's review-enforced
+# invariants as machine-checked AST rules.
+# ---------------------------------------------------------------------
+
+
+@cli.command(name='lint')
+@click.argument('paths', nargs=-1)
+@click.option('--rule', 'rules', multiple=True,
+              help='Run only this rule id (repeatable; see '
+                   '--list-rules).')
+@click.option('--format', 'fmt',
+              type=click.Choice(['text', 'json']), default='text')
+@click.option('--list-rules', is_flag=True,
+              help='Print the registered rule ids and exit.')
+def lint(paths, rules, fmt, list_rules):
+    """Run the skylint invariant checkers (AST-based; exit 1 on
+    findings).
+
+    PATHS defaults to the installed skypilot_tpu package. Suppress a
+    finding inline with `# skylint: disable=<rule> — <why>`; a
+    disable without a justification is itself a finding. Rule table:
+    docs/static_analysis.md.
+    """
+    from skypilot_tpu.analysis import core as analysis_core
+    if list_rules:
+        for rule, description in analysis_core.rule_listing():
+            click.echo(f'{rule}: {description}')
+        return
+    try:
+        findings = analysis_core.run(
+            list(paths) or analysis_core.default_paths(),
+            rules=list(rules) or None)
+    except ValueError as e:  # unknown --rule id / empty scan
+        raise exceptions.SkyTpuError(str(e)) from e
+    click.echo(analysis_core.render(findings, fmt))
+    if findings:
+        raise SystemExit(1)
+
+
 def main():
     try:
         cli()

@@ -43,11 +43,18 @@ def _status_path(cluster_name: str) -> str:
 
 
 def _write_status(cluster_name: str, **fields) -> None:
+    # Atomic publish (skylint: non-atomic-write): the jobs dashboard
+    # polls this file while the reaper runs — a torn JSON mid-dump
+    # would crash the poller.
     path = _status_path(cluster_name)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     fields['at'] = time.time()
-    with open(path, 'w', encoding='utf-8') as f:
+    tmp = f'{path}.tmp.{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(fields, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def main() -> int:

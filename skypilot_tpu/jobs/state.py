@@ -487,8 +487,15 @@ def request_cancel(job_id: int) -> None:
     set_status(job_id, ManagedJobStatus.CANCELLING)
     path = _signal_path(job_id)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, 'w', encoding='utf-8') as f:
+    # Atomic publish (skylint: non-atomic-write): the signal file
+    # must appear complete or not at all — the controller polls for
+    # it between recovery attempts.
+    tmp = f'{path}.tmp.{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump({'signal': 'cancel', 'at': time.time()}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def cancel_requested(job_id: int) -> bool:

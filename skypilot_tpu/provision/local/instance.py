@@ -46,9 +46,16 @@ def _load(cluster_name_on_cloud: str) -> Optional[Dict[str, Any]]:
 
 
 def _save(cluster_name_on_cloud: str, meta: Dict[str, Any]) -> None:
-    with open(_meta_path(cluster_name_on_cloud), 'w',
-              encoding='utf-8') as f:
+    # Atomic publish (skylint: non-atomic-write): _load runs in
+    # OTHER processes (skylet, reapers, parallel launches on the
+    # fake cloud) — a torn JSON would crash them mid-provision.
+    path = _meta_path(cluster_name_on_cloud)
+    tmp = f'{path}.tmp.{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
         json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _free_port() -> int:

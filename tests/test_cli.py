@@ -285,3 +285,57 @@ class TestCliGroups:
             assert dn.exit_code == 0, dn.output
         st = runner.invoke(cli.cli, ['serve', 'status'])
         assert 'clisvc' not in st.output
+
+
+class TestLintCli:
+    """`xsky lint` smoke (skylint, docs/static_analysis.md): the
+    human surface over python -m skypilot_tpu.analysis."""
+
+    def test_list_rules(self, runner):
+        result = runner.invoke(cli.cli, ['lint', '--list-rules'])
+        assert result.exit_code == 0, result.output
+        for rule in ('unfenced-state-write', 'env-contract',
+                     'naked-thread', 'span-name-contract'):
+            assert rule in result.output
+
+    def test_clean_fixture_exits_zero(self, runner, tmp_path):
+        (tmp_path / 'ok.py').write_text('X = 1\n')
+        result = runner.invoke(
+            cli.cli, ['lint', str(tmp_path), '--rule',
+                      'naked-thread'])
+        assert result.exit_code == 0, result.output
+        assert '0 finding(s)' in result.output
+
+    def test_violation_exits_nonzero_with_location(self, runner,
+                                                   tmp_path):
+        (tmp_path / 'bad.py').write_text(
+            'import threading\n'
+            't = threading.Thread(target=print)\n')
+        result = runner.invoke(
+            cli.cli, ['lint', str(tmp_path), '--rule',
+                      'naked-thread'])
+        assert result.exit_code == 1
+        assert 'bad.py:2' in result.output
+        assert 'naked-thread' in result.output
+
+    def test_json_format_is_parseable(self, runner, tmp_path):
+        import json as json_lib
+        (tmp_path / 'bad.py').write_text(
+            'import threading\n'
+            't = threading.Thread(target=print)\n')
+        result = runner.invoke(
+            cli.cli, ['lint', str(tmp_path), '--rule', 'naked-thread',
+                      '--format', 'json'])
+        assert result.exit_code == 1
+        payload = json_lib.loads(result.output)
+        assert payload[0]['rule'] == 'naked-thread'
+        assert set(payload[0]) == {'rule', 'path', 'line', 'col',
+                                   'severity', 'message'}
+
+    def test_unknown_rule_errors(self, runner, tmp_path):
+        (tmp_path / 'ok.py').write_text('X = 1\n')
+        result = runner.invoke(
+            cli.cli, ['lint', str(tmp_path), '--rule', 'bogus-rule'])
+        assert result.exit_code != 0
+        assert 'unknown rule' in (result.output or '') or \
+            isinstance(result.exception, Exception)

@@ -1250,49 +1250,55 @@ class TestChaosCli:
 
 
 class TestNoSleepInRetryLoops:
-
-    # Poll/wait loops allowed to sleep directly: liveness waits on
-    # the agent's own processes (not retry loops).
-    ALLOWLIST = {
-        'provision/local/instance.py',  # agent process port-wait
-        'runtime/agent.py',             # the agent's process wait
-    }
-    MARKERS = ('attempt', 'backoff', 'retry')
-    WINDOW = 6
+    """Hand-rolled retry sleeps are banned outside resilience/ —
+    migrated from the PR-2 grep lint (±6-line window of 'retry'-ish
+    words) to the skylint ``sleep-in-retry`` AST checker, which
+    resolves aliased imports and follows same-module helper calls
+    the regex could not see. The old per-file ALLOWLIST is gone: the
+    AST checker keys on retry-shaped *identifiers*, so the liveness
+    port-waits that needed allowlisting no longer false-positive."""
 
     def test_no_time_sleep_in_retry_context(self):
-        import os
-
         import skypilot_tpu
-        root = os.path.dirname(skypilot_tpu.__file__)
-        violations = []
-        for dirpath, _, files in os.walk(root):
-            if 'resilience' in dirpath or '__pycache__' in dirpath:
-                continue
-            for fn in files:
-                if not fn.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, root)
-                if rel in self.ALLOWLIST:
-                    continue
-                with open(path, encoding='utf-8') as f:
-                    lines = f.read().splitlines()
-                for i, line in enumerate(lines):
-                    if 'time.sleep(' not in line:
-                        continue
-                    lo = max(0, i - self.WINDOW)
-                    ctx = '\n'.join(
-                        lines[lo:i + self.WINDOW + 1]).lower()
-                    hits = [m for m in self.MARKERS if m in ctx]
-                    if hits:
-                        violations.append(
-                            f'{rel}:{i + 1} time.sleep in a '
-                            f'retry-ish context ({hits}): '
-                            f'{line.strip()}')
-        assert not violations, (
+        from skypilot_tpu import analysis as analysis_lib
+        findings = analysis_lib.run(
+            [os.path.dirname(skypilot_tpu.__file__)],
+            rules=['sleep-in-retry'])
+        assert not findings, (
             'Hand-rolled retry sleeps found — route them through '
-            'resilience.RetryPolicy:\n' + '\n'.join(violations))
+            'resilience.RetryPolicy:\n' +
+            '\n'.join(f.render() for f in findings))
+
+    def test_checker_fires_on_seeded_retry_sleep(self, tmp_path):
+        """Meta-check (the regex-rot guard, AST edition): the
+        checker must still FIRE on the canonical violation, or the
+        clean run above is vacuous."""
+        from skypilot_tpu import analysis as analysis_lib
+        (tmp_path / 'bad.py').write_text(
+            'import time\n'
+            'def fetch(do):\n'
+            '    for attempt in range(3):\n'
+            '        try:\n'
+            '            return do()\n'
+            '        except OSError:\n'
+            '            time.sleep(2 ** attempt)\n')
+        findings = analysis_lib.run([str(tmp_path)],
+                                    rules=['sleep-in-retry'])
+        assert any(f.rule == 'sleep-in-retry' for f in findings)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _fault_site_findings():
+    """One whole-package scan shared by both direction tests (the
+    lru_cache pattern test_trace.py's migrated lints use)."""
+    import skypilot_tpu
+    from skypilot_tpu import analysis as analysis_lib
+    return tuple(analysis_lib.run(
+        [os.path.dirname(skypilot_tpu.__file__)],
+        rules=['fault-site-contract']))
 
 
 # ---------------------------------------------------------------------
@@ -1301,58 +1307,49 @@ class TestNoSleepInRetryLoops:
 # faults.SITES must be documented in docs/resilience.md's fault-site
 # table, and every site the table documents must be registered. A
 # fault site nobody can look up is undrillable; a documented site
-# nobody registered is a chaos drill that silently no-ops.
+# nobody registered is a chaos drill that silently no-ops. Migrated
+# to the skylint ``fault-site-contract`` AST checker, which reads
+# SITES statically from resilience/faults.py.
 # ---------------------------------------------------------------------
 
 
 class TestFaultSiteContractLint:
 
     @staticmethod
-    def _doc_table_sites():
-        import re as re_mod
-
-        import skypilot_tpu
-        root = os.path.dirname(os.path.dirname(skypilot_tpu.__file__))
-        text = open(os.path.join(root, 'docs', 'resilience.md'),
-                    encoding='utf-8').read()
-        # Scope to the fault-injection section so dotted code refs
-        # elsewhere in the doc (e.g. `replica_managers.probe_all`)
-        # cannot false-positive.
-        start = text.index('## Fault injection')
-        end = text.index('##', start + 2)
-        section = text[start:end]
-        sites = set()
-        site_re = re_mod.compile(r'^\|\s*`([a-z]+\.[a-z_]+)`')
-        for line in section.splitlines():
-            m = site_re.match(line.strip())
-            if m:
-                sites.add(m.group(1))
-        return sites
+    def _findings():
+        return _fault_site_findings()
 
     def test_all_registered_sites_documented(self):
-        from skypilot_tpu.resilience import faults as faults_lib
-        documented = self._doc_table_sites()
-        assert documented, ('lint found no fault-site table in '
-                            'docs/resilience.md — did the section '
-                            'format change?')
-        missing = sorted(set(faults_lib.SITES) - documented)
-        assert not missing, (
+        code = [f for f in self._findings()
+                if not f.path.startswith('docs/')]
+        assert not code, (
             'fault sites registered in faults.SITES but missing from '
-            'the docs/resilience.md fault-site table: '
-            f'{missing}')
+            'the docs/resilience.md fault-site table:\n  ' +
+            '\n  '.join(f.render() for f in code))
 
     def test_all_documented_sites_registered(self):
-        from skypilot_tpu.resilience import faults as faults_lib
-        stale = sorted(self._doc_table_sites() -
-                       set(faults_lib.SITES))
-        assert not stale, (
+        docs = [f for f in self._findings()
+                if f.path.startswith('docs/')]
+        assert not docs, (
             'fault sites documented in docs/resilience.md but not '
-            f'registered in faults.SITES: {stale}')
+            'registered in faults.SITES:\n  ' +
+            '\n  '.join(f.render() for f in docs))
 
     def test_known_sites_are_seen(self):
-        """Meta-check against regex rot: the doc scan must see the
-        long-standing sites AND the elastic-resume site."""
-        sites = self._doc_table_sites()
+        """Meta-check against collector rot: the static SITES read
+        must agree with the runtime module AND include the
+        long-standing sites + the elastic-resume site."""
+        import skypilot_tpu
+        from skypilot_tpu.analysis import core as analysis_core
+        from skypilot_tpu.analysis.checkers import names as nc
+        from skypilot_tpu.resilience import faults as faults_lib
+        repo = analysis_core.load_repo(
+            [os.path.dirname(skypilot_tpu.__file__)])
+        sites = nc.collect_fault_sites(repo)
+        assert sites, 'checker found no SITES tuple in ' \
+                      'resilience/faults.py — did the registry move?'
+        assert set(sites) == set(faults_lib.SITES), (
+            'static SITES read disagrees with the runtime module')
         for expected in ('provision.launch', 'checkpoint.save',
                          'recovery.resize'):
             assert expected in sites, expected

@@ -20,7 +20,6 @@ Covers the PR-6 contract end to end:
 """
 import json
 import os
-import re
 import subprocess
 import sys
 import time
@@ -741,65 +740,79 @@ class TestManagedJobTraceId:
         assert jobs_state.get_job(7)['trace_id'] == 'fe' * 16
 
 
-SPAN_NAME_PATTERNS = (
-    re.compile(r"""(?:trace_lib|trace)\.span\(\s*\n?\s*'([^']+)'"""),
-    re.compile(r"""record_span\(\s*\n?\s*'([^']+)'"""),
-    re.compile(r"""emit_span\([^)]*?'([a-z0-9_.]+)'"""),
-    # The host agent's request-scoped helper (agent.py _span).
-    re.compile(r"""self\._span\('([^']+)'\)"""),
-)
+# ---------------------------------------------------------------------
+# Name-contract lints — migrated from grep regexes to the skylint AST
+# checkers (skypilot_tpu/analysis/, PR 12). The test-class entry
+# points and both-direction semantics are unchanged; the regex-rot
+# meta-checks became "the CHECKER still sees the long-standing
+# construction sites" (a collector rot now fails exactly like regex
+# rot did). docs/static_analysis.md has the rule table.
+# ---------------------------------------------------------------------
+
+import functools
+
+from skypilot_tpu import analysis as analysis_lib
+from skypilot_tpu.analysis import core as analysis_core
+from skypilot_tpu.analysis.checkers import names as name_checkers
+
+
+def _pkg_dir():
+    import skypilot_tpu
+    return os.path.dirname(skypilot_tpu.__file__)
+
+
+_CONTRACT_RULES = ('span-name-contract', 'metric-name-contract',
+                   'alert-rule-contract')
+
+
+@functools.lru_cache(maxsize=None)
+def _all_contract_findings():
+    """ONE whole-package scan for all three contract rules — each
+    analysis.run re-parses ~120 modules, so the per-rule tests slice
+    this instead of scanning three times."""
+    return tuple(analysis_lib.run([_pkg_dir()],
+                                  rules=list(_CONTRACT_RULES)))
+
+
+def _contract_findings(rule):
+    assert rule in _CONTRACT_RULES, rule
+    return tuple(f for f in _all_contract_findings()
+                 if f.rule == rule)
+
+
+@functools.lru_cache(maxsize=None)
+def _loaded_repo():
+    return analysis_core.load_repo([_pkg_dir()])
+
+
+def _split_directions(findings):
+    """(code-side, doc-side) findings: the forward direction anchors
+    at the construction site, the reverse at the docs file."""
+    code = [f for f in findings if not f.path.startswith('docs/')]
+    docs = [f for f in findings if f.path.startswith('docs/')]
+    return code, docs
 
 
 class TestSpanNameContractLint:
-    """Grep lint (style of the no-orbax and no-time.sleep lints):
-    every LITERAL span name emitted in-tree must appear in
+    """Every LITERAL span name emitted in-tree must appear in
     docs/observability.md's span-name contract table — span names are
-    stable API exactly like metric names."""
+    stable API exactly like metric names. (skylint rule
+    ``span-name-contract``.)"""
 
     def test_all_emitted_span_names_documented(self):
-        import skypilot_tpu
-        root = os.path.dirname(skypilot_tpu.__file__)
-        docs = open(os.path.join(os.path.dirname(root), 'docs',
-                                 'observability.md'),
-                    encoding='utf-8').read()
-        emitted = {}
-        for dirpath, _, files in os.walk(root):
-            if '__pycache__' in dirpath:
-                continue
-            for fn in files:
-                if not fn.endswith('.py'):
-                    continue
-                path = os.path.join(dirpath, fn)
-                text = open(path, encoding='utf-8').read()
-                for pat in SPAN_NAME_PATTERNS:
-                    for name in pat.findall(text):
-                        emitted.setdefault(name, path)
-        assert emitted, 'lint found no span emissions at all — ' \
-                        'did the emission API change?'
-        missing = [f'{name} (from {path})'
-                   for name, path in sorted(emitted.items())
-                   if f'`{name}`' not in docs]
-        assert not missing, (
+        findings = _contract_findings('span-name-contract')
+        assert not findings, (
             'span names emitted in-tree but missing from the '
             'docs/observability.md contract table:\n  ' +
-            '\n  '.join(missing))
+            '\n  '.join(f.render() for f in findings))
 
     def test_known_span_names_are_emitted(self):
-        """Meta-check that the lint's regexes actually see the core
-        emission sites (a regex rot here would make the lint
-        vacuous)."""
-        import skypilot_tpu
-        root = os.path.dirname(skypilot_tpu.__file__)
-        emitted = set()
-        for dirpath, _, files in os.walk(root):
-            if '__pycache__' in dirpath:
-                continue
-            for fn in files:
-                if fn.endswith('.py'):
-                    text = open(os.path.join(dirpath, fn),
-                                encoding='utf-8').read()
-                    for pat in SPAN_NAME_PATTERNS:
-                        emitted.update(pat.findall(text))
+        """Meta-check that the checker's collector actually sees the
+        core emission sites (a collector rot here would make the
+        lint vacuous — the old regex-rot guard, AST edition)."""
+        emitted = name_checkers.collect_span_names(_loaded_repo())
+        assert emitted, 'checker found no span emissions at all — ' \
+                        'did the emission API change?'
         for expected in ('launch', 'lb.request', 'lb.proxy',
                          'batch.queue_wait', 'batch.first_token',
                          'jobs.submit', 'jobs.recovery', 'ckpt.save',
@@ -808,95 +821,37 @@ class TestSpanNameContractLint:
             assert expected in emitted, expected
 
 
-# Metric-name construction sites (the general contract lint — the
-# span-name lint above, extended to the metric plane):
-#  - registry constructors: reg.counter('skytpu_...') / .gauge /
-#    .histogram (possibly with the name on the next line);
-#  - the agents' hand-rendered sample tuples:
-#    ('skytpu_x', 'gauge', ...) in agent.py _collect_samples and
-#    AppendMetric(&out, "skytpu_x", "gauge", ...) in host_agent.cc.
-METRIC_NAME_PATTERNS = (
-    re.compile(r"""\.(?:counter|gauge|histogram)\(\s*\n?\s*"""
-               r"""'(skytpu_[a-z0-9_]+)'"""),
-    re.compile(r"""\('(skytpu_[a-z0-9_]+)',\s*\n?\s*"""
-               r"""'(?:gauge|counter|histogram)'"""),
-    re.compile(r'''AppendMetric\(&out,\s*"(skytpu_[a-z0-9_]+)"'''),
-)
-
-_DOC_METRIC_TOKEN = re.compile(r'`(skytpu_[a-z0-9_]+)`')
-_FULL_METRIC_NAME = re.compile(r'skytpu_[a-z0-9_]+$')
-
-
-def _constructed_metric_names():
-    """{name: first path} for every metric-name literal constructed
-    in skypilot_tpu/ (py AND the C++ agent)."""
-    import skypilot_tpu
-    root = os.path.dirname(skypilot_tpu.__file__)
-    names = {}
-    for dirpath, _, files in os.walk(root):
-        if '__pycache__' in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(('.py', '.cc')):
-                continue
-            path = os.path.join(dirpath, fn)
-            text = open(path, encoding='utf-8').read()
-            for pat in METRIC_NAME_PATTERNS:
-                for name in pat.findall(text):
-                    names.setdefault(name, path)
-    return names
-
-
 class TestMetricNameContractLint:
     """Both directions of the metric-name contract
     (docs/observability.md): every metric constructed in-tree is
     documented, and every documented name exists in-tree — the
-    contract cannot silently drift either way."""
-
-    @staticmethod
-    def _docs_text():
-        import skypilot_tpu
-        root = os.path.dirname(os.path.dirname(
-            skypilot_tpu.__file__))
-        return open(os.path.join(root, 'docs', 'observability.md'),
-                    encoding='utf-8').read()
+    contract cannot silently drift either way. (skylint rule
+    ``metric-name-contract``.)"""
 
     def test_all_constructed_metric_names_documented(self):
-        docs = self._docs_text()
-        names = _constructed_metric_names()
-        assert names, 'lint found no metric constructions at all — '\
-                      'did the registry API change?'
-        missing = [f'{name} (from {path})'
-                   for name, path in sorted(names.items())
-                   if f'`{name}`' not in docs]
-        assert not missing, (
+        code, _ = _split_directions(
+            _contract_findings('metric-name-contract'))
+        assert not code, (
             'metric names constructed in-tree but missing from the '
             'docs/observability.md contract tables:\n  ' +
-            '\n  '.join(missing))
+            '\n  '.join(f.render() for f in code))
 
     def test_all_documented_metric_names_constructed(self):
-        """Reverse direction over the curated tables: every
-        backticked full `skytpu_*` token in the doc must be
-        constructed somewhere in-tree (tokens with globs/labels —
-        `skytpu_agent_*`, `skytpu_jobs{...}` — aren't full names and
-        are skipped by the fullmatch)."""
-        docs = self._docs_text()
-        constructed = set(_constructed_metric_names())
-        documented = {m for m in _DOC_METRIC_TOKEN.findall(docs)
-                      if _FULL_METRIC_NAME.fullmatch(m)}
-        assert documented, 'no documented metric names found — did '\
-                           'the docs table format change?'
-        stale = sorted(documented - constructed)
-        assert not stale, (
+        _, docs = _split_directions(
+            _contract_findings('metric-name-contract'))
+        assert not docs, (
             'metric names documented in docs/observability.md but '
             'constructed nowhere in skypilot_tpu/:\n  ' +
-            '\n  '.join(stale))
+            '\n  '.join(f.render() for f in docs))
 
     def test_known_metric_names_are_seen(self):
-        """Meta-check against regex rot: the lint must see at least
-        the long-standing core families from every construction
-        style (registry call, py agent tuple, C++ AppendMetric)."""
-        names = _constructed_metric_names()
+        """Meta-check against collector rot: the checker must see at
+        least the long-standing core families from every
+        construction style (registry call, py agent tuple, C++ agent
+        AppendMetric)."""
+        names = name_checkers.collect_metric_names(_loaded_repo())
+        assert names, 'checker found no metric constructions — did '\
+                      'the registry API change?'
         for expected in ('skytpu_train_step_seconds',       # registry
                          'skytpu_agent_uptime_seconds',     # py tuple
                          'skytpu_host_load5',               # py tuple
@@ -907,85 +862,47 @@ class TestMetricNameContractLint:
                          'skytpu_batch_kv_cache_bytes'):
             assert expected in names, expected
         # The C++ agent's names all shadow py-agent ones (same
-        # protocol), so check its pattern against the file directly.
+        # protocol), so check its scoped regex against the file
+        # directly — ast can't parse C++, the checker keeps this one
+        # fallback.
         import skypilot_tpu
         cc_path = os.path.join(os.path.dirname(skypilot_tpu.__file__),
                                'runtime', 'cpp', 'host_agent.cc')
-        cc_names = METRIC_NAME_PATTERNS[-1].findall(
+        cc_names = name_checkers.CC_METRIC_RE.findall(
             open(cc_path, encoding='utf-8').read())
         assert 'skytpu_agent_uptime_seconds' in cc_names, \
-            'lint no longer sees the C++ agent metrics'
-
-
-# Alert-rule ids are the third stable-name contract (after spans and
-# metrics): every `AlertRule(id='...')` constructed in-tree must be
-# backticked in docs/observability.md's Built-in rules table, and
-# every id documented there must be constructed.
-ALERT_RULE_ID_PATTERN = re.compile(
-    r"""AlertRule\(\s*\n?\s*id='([a-z0-9-]+)'""")
-_DOC_RULE_TOKEN = re.compile(r'`([a-z0-9]+(?:-[a-z0-9]+)+)`')
-
-
-def _constructed_rule_ids():
-    import skypilot_tpu
-    root = os.path.dirname(skypilot_tpu.__file__)
-    ids = {}
-    for dirpath, _, files in os.walk(root):
-        if '__pycache__' in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith('.py'):
-                continue
-            path = os.path.join(dirpath, fn)
-            for rule_id in ALERT_RULE_ID_PATTERN.findall(
-                    open(path, encoding='utf-8').read()):
-                ids.setdefault(rule_id, path)
-    return ids
+            'checker no longer sees the C++ agent metrics'
 
 
 class TestAlertRuleContractLint:
-
-    @staticmethod
-    def _rules_doc_section():
-        docs = TestMetricNameContractLint._docs_text()  # pylint: disable=protected-access
-        marker = '### Built-in rules'
-        assert marker in docs, \
-            'docs/observability.md lost its Built-in rules section'
-        section = docs.split(marker, 1)[1]
-        # The table ends at the next heading.
-        for stop in ('\n## ', '\n# '):
-            if stop in section:
-                section = section.split(stop, 1)[0]
-        return section
+    """Alert-rule ids are the third stable-name contract (after spans
+    and metrics): every ``AlertRule(id=...)`` constructed in-tree
+    must be in docs/observability.md's Built-in rules table and vice
+    versa. (skylint rule ``alert-rule-contract``.)"""
 
     def test_all_constructed_rule_ids_documented(self):
-        docs = TestMetricNameContractLint._docs_text()  # pylint: disable=protected-access
-        ids = _constructed_rule_ids()
-        assert ids, 'lint found no AlertRule constructions — did ' \
-                    'the rule API change?'
-        missing = [f'{rule_id} (from {path})'
-                   for rule_id, path in sorted(ids.items())
-                   if f'`{rule_id}`' not in docs]
-        assert not missing, (
+        code, _ = _split_directions(
+            _contract_findings('alert-rule-contract'))
+        assert not code, (
             'alert rule ids constructed in-tree but missing from '
-            'docs/observability.md:\n  ' + '\n  '.join(missing))
+            'docs/observability.md:\n  ' +
+            '\n  '.join(f.render() for f in code))
 
     def test_all_documented_rule_ids_constructed(self):
-        constructed = set(_constructed_rule_ids())
-        documented = set(
-            _DOC_RULE_TOKEN.findall(self._rules_doc_section()))
-        assert documented, 'no rule ids found in the Built-in ' \
-                           'rules table — did its format change?'
-        stale = sorted(documented - constructed)
-        assert not stale, (
+        _, docs = _split_directions(
+            _contract_findings('alert-rule-contract'))
+        assert not docs, (
             'rule ids documented in docs/observability.md but '
             'constructed nowhere in skypilot_tpu/:\n  ' +
-            '\n  '.join(stale))
+            '\n  '.join(f.render() for f in docs))
 
     def test_builtin_pack_matches_construction_lint(self):
         """Meta-check: the runtime's own enumeration of the built-in
-        pack agrees with the grep — regex rot on either side shows
-        up as a diff here."""
+        pack agrees with the AST collector — rot on either side
+        shows up as a diff here."""
         from skypilot_tpu.alerts import builtin
-        assert set(builtin.all_rule_ids()) == \
-            set(_constructed_rule_ids())
+        constructed = name_checkers.collect_alert_rule_ids(
+            _loaded_repo())
+        assert constructed, 'checker found no AlertRule ' \
+                            'constructions — did the rule API change?'
+        assert set(builtin.all_rule_ids()) == set(constructed)
