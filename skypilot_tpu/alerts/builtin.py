@@ -26,6 +26,14 @@ from typing import List, Optional
 from skypilot_tpu.alerts.rules import AlertRule
 
 
+# The serve-scope PAGE rules that drive control loops: autoscaler
+# alert pressure (serve/controller.py) and the rolling-upgrade gate
+# (serve/upgrade.py — a firing page auto-pauses a rollout and rolls
+# it back). One list so the two consumers can never drift.
+PAGE_RULE_IDS = ('lb-no-ready-replica', 'replica-5xx-rate',
+                 'slo-burn-rate')
+
+
 def _env_override(name: str) -> Optional[float]:
     raw = os.environ.get(name)
     if not raw:

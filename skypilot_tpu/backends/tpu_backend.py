@@ -229,12 +229,21 @@ class TpuBackend(Backend):
                 self._post_provision_runtime_setup(handle)
                 return
             # Pre-supervisor pod (no respawn loop): be honest
-            # instead of looping on a mismatch.
-            raise exceptions.NotSupportedError(
+            # instead of looping on a mismatch. Typed + concrete:
+            # name the per-host agent versions, the client's, and
+            # the exact recovery commands (version-skew contract,
+            # docs/upgrades.md).
+            skew = ', '.join(f'host{i}={v}' for i, v in stale)
+            raise exceptions.AgentVersionError(
                 f'Cluster {handle.cluster_name} runs agent protocol '
-                f'{stale} but this client needs '
-                f'{agent.AGENT_VERSION}; relaunch it '
-                f'(`xsky down {handle.cluster_name}` then launch).')
+                f'{skew} but this client speaks protocol '
+                f'{agent.AGENT_VERSION}, and the in-place agent '
+                f'upgrade is unavailable on this cluster. Recover '
+                f'with: `xsky down {handle.cluster_name}` then '
+                f'`xsky launch -c {handle.cluster_name} <task>`.',
+                host=handle.cluster_name,
+                agent_version=stale[0][1],
+                client_version=agent.AGENT_VERSION)
         logger.info('Cluster %s runtime version mismatch %s (client '
                     'wants %s); restarting runtime.',
                     handle.cluster_name, stale, agent.AGENT_VERSION)

@@ -1292,6 +1292,58 @@ def serve_update(service_name, entrypoint, env, accelerator,
     click.echo(f'Service {service_name} updating to v{version}.')
 
 
+@serve_group.command(name='upgrade')
+@click.argument('service_name')
+@click.option('--pause', 'op', flag_value='pause',
+              help='Pause the rolling upgrade (holds position; a '
+                   'mid-drain replica goes back into rotation).')
+@click.option('--resume', 'op', flag_value='resume',
+              help='Resume a paused rolling upgrade.')
+@click.option('--abort', 'op', flag_value='abort',
+              help='Abort: drain the already-upgraded replicas and '
+                   'roll them back to the prior version.')
+def serve_upgrade(service_name, op):
+    """Rolling-upgrade status/controls (docs/upgrades.md).
+
+    With no flag, shows the upgrade state machine: state, phase,
+    versions, per-replica progress, and the rollback reason +
+    exemplar trace when an alert rolled it back."""
+    from skypilot_tpu.serve import core as serve_core
+    if op:
+        serve_core.upgrade_control(service_name, op)
+        click.echo(f'Upgrade {op} requested for {service_name}; the '
+                   'controller acts on its next tick.')
+        return
+    rec = serve_core.upgrade_status(service_name)
+    if rec is None:
+        click.echo(f'Service {service_name}: no upgrade has run.')
+        return
+    click.echo(f'Service {service_name}: upgrade '
+               f'v{rec["from_version"]} -> v{rec["to_version"]} '
+               f'{rec["state"]}')
+    done = len(rec.get('upgraded') or [])
+    total = len(rec.get('replicas') or [])
+    click.echo(f'  progress: {done} promoted'
+               + (f' / {total} replicas' if total else ''))
+    if rec.get('phase'):
+        cursor = rec.get('current_replica')
+        if rec.get('phase') in ('PROBE', 'SOAK'):
+            cursor = rec.get('replacement_replica')
+        click.echo(f'  phase: {rec["phase"]}'
+                   + (f' (replica {cursor})'
+                      if cursor is not None else ''))
+    if rec.get('paused_reason'):
+        click.echo(f'  paused: {rec["paused_reason"]}')
+    if rec.get('rollback_reason'):
+        click.echo(f'  rollback: {rec["rollback_reason"]}'
+                   + (f' (exemplar trace '
+                      f'{rec["exemplar_trace_id"]})'
+                      if rec.get('exemplar_trace_id') else ''))
+    for rep in rec.get('replicas') or []:
+        click.echo(f'  replica {rep["replica_id"]}: '
+                   f'v{rep["version"]} {rep["status"]}')
+
+
 @serve_group.command(name='down')
 @click.argument('service_name')
 @click.option('--yes', '-y', is_flag=True)

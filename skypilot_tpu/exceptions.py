@@ -74,6 +74,25 @@ class NotSupportedError(SkyTpuError):
     """Feature combination is not supported."""
 
 
+class AgentVersionError(NotSupportedError):
+    """A cross-version (client ↔ host-agent) surface cannot be
+    served: the peer speaks an older protocol and the feature has no
+    fallback on that version. The version-skew contract
+    (docs/upgrades.md): every skewed call either completes, upgrades
+    the peer in place, or raises THIS — never a hang, never a bare
+    HTTP 404. Carries both versions so callers (and operators) see
+    exactly which side is stale, plus the concrete recovery command.
+    """
+
+    def __init__(self, message: str, host: Optional[str] = None,
+                 agent_version: Optional[str] = None,
+                 client_version: Optional[str] = None):
+        super().__init__(message)
+        self.host = host
+        self.agent_version = agent_version
+        self.client_version = client_version
+
+
 class CommandError(SkyTpuError):
     """A remote/local command failed.
 

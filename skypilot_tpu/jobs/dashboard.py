@@ -44,6 +44,7 @@ _PAGE = """<!doctype html>
 <body>
 <h2>Managed jobs</h2>
 <div id="alerts" class="ok"></div>
+<div id="upgrades" class="ok"></div>
 <div id="updated"></div>
 <table id="jobs"><thead><tr>
  <th>ID</th><th>Name</th><th>Status</th><th>Submitted</th>
@@ -118,10 +119,23 @@ async function refreshAlerts() {
     }
   } catch (e) { div.textContent = ''; }
 }
+async function refreshUpgrades() {
+  const div = document.getElementById('upgrades');
+  try {
+    const active = await (await fetch('/api/upgrades')).json();
+    if (active.length === 0) { div.textContent = ''; return; }
+    // textContent only — service names stay un-interpolated.
+    div.textContent = 'SERVE UPGRADES: ' + active.map(u =>
+        u.service_name + ' v' + u.from_version + '→v' +
+        u.to_version + ' ' + u.state).join(', ');
+  } catch (e) { div.textContent = ''; }
+}
 refresh();
 refreshAlerts();
+refreshUpgrades();
 setInterval(refresh, 5000);
 setInterval(refreshAlerts, 5000);
+setInterval(refreshUpgrades, 5000);
 </script>
 <p id="links"><a href="/metrics">metrics</a> — Prometheus text
 exposition of this queue (jobs by status; scrape-able)</p>
@@ -181,6 +195,30 @@ def _jobs_json() -> bytes:
     return json.dumps(records).encode()
 
 
+def _upgrades_json() -> bytes:
+    """Active (non-terminal) serve rolling-upgrade rows under this
+    state dir — the dashboard banner's feed (docs/upgrades.md)."""
+    out = []
+    try:
+        from skypilot_tpu.serve import serve_state
+        for svc in serve_state.get_services():
+            rec = serve_state.get_upgrade(svc['name'])
+            if rec is None or rec['state'].is_terminal():
+                continue
+            out.append({
+                'service_name': rec['service_name'],
+                'from_version': rec['from_version'],
+                'to_version': rec['to_version'],
+                'state': rec['state'].value,
+                'phase': (rec['phase'].value
+                          if rec['phase'] else None),
+                'upgraded': rec['upgraded'],
+            })
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return json.dumps(out).encode()
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = 'HTTP/1.1'
 
@@ -205,6 +243,8 @@ class _Handler(BaseHTTPRequestHandler):
             from skypilot_tpu import alerts as alerts_lib
             self._send(200,
                        json.dumps(alerts_lib.all_alerts()).encode())
+        elif path == '/api/upgrades':
+            self._send(200, _upgrades_json())
         elif path == '/metrics':
             self._send(200, _metrics_text().encode(),
                        'text/plain; version=0.0.4; charset=utf-8')

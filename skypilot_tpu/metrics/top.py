@@ -208,6 +208,41 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                 if a.get('service') == svc['name'] or
                 a.get('scope') == f'service-{svc["name"]}'),
         }
+        # Per-replica versions + rolling-upgrade position
+        # (docs/upgrades.md): 'v2' steady; 'v1→v2 ROLLING 1/3'
+        # mid-upgrade.
+        try:
+            replicas = serve_state.get_replicas(svc['name'])
+            row['replica_versions'] = sorted(
+                r['version'] for r in replicas)
+            upg = serve_state.get_upgrade(svc['name'])
+            if upg is not None and not upg['state'].is_terminal():
+                # Denominator = serving SLOTS, not transient record
+                # count (mid-cycle the replacement record coexists
+                # with the remaining old replicas): promoted + still
+                # on the wrong version + the in-flight cycle whose
+                # victim is already terminated.
+                done = len(upg['upgraded'])
+                target = (upg['from_version']
+                          if upg['state'] ==
+                          serve_state.UpgradeState.ROLLING_BACK
+                          else upg['to_version'])
+                live = [r for r in replicas
+                        if not r['status'].is_terminal()]
+                old = [r for r in live
+                       if r['version'] != target]
+                mid = 1 if (upg['phase'] is not None and
+                            upg['current_replica'] not in
+                            {r['replica_id'] for r in live}) else 0
+                row['upgrade'] = {
+                    'from_version': upg['from_version'],
+                    'to_version': upg['to_version'],
+                    'state': upg['state'].value,
+                    'done': done,
+                    'total': done + len(old) + mid,
+                }
+        except Exception:  # pylint: disable=broad-except
+            pass
         endpoint = svc.get('endpoint')
         if endpoint:
             try:
@@ -277,6 +312,19 @@ def _fmt_num(v: Optional[float], fmt: str = '{:.1f}') -> str:
 _BREAKER_STATES = {0: 'closed', 1: 'OPEN', 2: 'half-open'}
 
 
+def _fmt_version(service_row: Dict[str, Any]) -> str:
+    """'v2' steady; 'v1→v2 ROLLING 1/3' mid-upgrade; 'v1,v2' for a
+    mixed fleet with no active upgrade row."""
+    upg = service_row.get('upgrade')
+    if upg:
+        return (f'v{upg["from_version"]}→v{upg["to_version"]} '
+                f'{upg["state"]} {upg["done"]}/{upg["total"]}')
+    versions = sorted(set(service_row.get('replica_versions') or []))
+    if not versions:
+        return '-'
+    return ','.join(f'v{v}' for v in versions)
+
+
 def render(snap: Dict[str, Any]) -> str:
     from skypilot_tpu.utils import ux_utils
     out: List[str] = []
@@ -343,12 +391,13 @@ def render(snap: Dict[str, Any]) -> str:
     out.append(table.get_string() if rows else 'No clusters.')
 
     if snap['services']:
-        stable = ux_utils.Table(['SERVICE', 'STATUS', 'QPS',
-                                 'P50', 'P99', 'REQS', '5XX',
+        stable = ux_utils.Table(['SERVICE', 'STATUS', 'VERSION',
+                                 'QPS', 'P50', 'P99', 'REQS', '5XX',
                                  'ALERTS'])
         for s in snap['services']:
             stable.add_row([
                 s['name'], s['status'],
+                _fmt_version(s),
                 _fmt_num(s.get('qps'), '{:.2f}'),
                 _fmt_num(s.get('p50_s'), '{:.3f}s'),
                 _fmt_num(s.get('p99_s'), '{:.3f}s'),

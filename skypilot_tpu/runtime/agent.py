@@ -88,6 +88,32 @@ def served_version() -> str:
     version and mask genuinely stale clusters."""
     return os.environ.get('SKYTPU_AGENT_VERSION_OVERRIDE',
                           AGENT_VERSION)
+
+
+def served_version_num() -> int:
+    """The served version as an int, for feature gating: the FIRST
+    contiguous digit run ('3.1' → 3, 'v0-old' → 0) — concatenating
+    all digits would read '3.1' as 31 and silently enable newer
+    features than the pin. No digits at all reads as 0: an
+    unparseable pin asks for "very old", never silently current."""
+    digits = ''
+    for c in served_version():
+        if c.isdigit():
+            digits += c
+        elif digits:
+            break
+    return int(digits) if digits else 0
+
+
+def feature_enabled(min_version: int) -> bool:
+    """Protocol-emulation gate: under a pinned
+    SKYTPU_AGENT_VERSION_OVERRIDE the agent doesn't just ADVERTISE
+    the old version, it BEHAVES like it — endpoints newer than the
+    pin 404 and /status drops its long-poll — so the skew tier
+    (tests/test_compat.py) exercises the real old-agent/new-client
+    surface, not a version string. Unset override == current
+    version == everything enabled."""
+    return served_version_num() >= min_version
 DEFAULT_PORT = 8790
 TOKEN_HEADER = 'X-SkyTpu-Token'
 # Cap on /status?wait= long-polls (a handler thread is held for the
@@ -466,6 +492,9 @@ def metrics_text() -> str:
     no background sampler thread to leak)."""
     samples = _collect_samples()
     _append_history(samples)
+    # '4': textfile ingestion (compute-process series). A pre-v4
+    # emulation serves its own gauges only.
+    textfiles = _read_textfiles() if feature_enabled(4) else ''
     if os.environ.get('SKYTPU_DEBUG', '0') == '1':
         # Debug path: persist the Chrome trace on every scrape so it
         # is retrievable (via /read) from this long-lived process,
@@ -482,7 +511,7 @@ def metrics_text() -> str:
             lines.append(f'# HELP {name} {help_text}')
             lines.append(f'# TYPE {name} {kind}')
             lines.append(f'{name} {value!r}')
-        return '\n'.join(lines) + '\n' + _read_textfiles()
+        return '\n'.join(lines) + '\n' + textfiles
     reg = metrics_lib.registry()
     with _metrics_sync_lock:
         for name, kind, help_text, value in samples:
@@ -496,7 +525,7 @@ def metrics_text() -> str:
                     family.inc(delta)
             else:
                 reg.gauge(name, help_text).set(value)
-    return reg.render() + _read_textfiles()
+    return reg.render() + textfiles
 
 
 def _trace_env_from_header(header_value: Optional[str],
@@ -562,6 +591,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json({'ok': True, 'version': served_version(),
                         'agent': 'py'})
         elif parsed.path == '/metrics':
+            if not feature_enabled(3):  # '3': GET /metrics
+                self._json({'error': 'not found'}, 404)
+                return
             body = metrics_text().encode()
             self.send_response(200)
             self.send_header('Content-Type',
@@ -573,6 +605,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif parsed.path == '/status':
             proc_id = int(qs.get('proc_id', ['0'])[0])
             wait = float(qs.get('wait', ['0'])[0])
+            if not feature_enabled(2):  # '2': /status long-poll
+                wait = 0.0  # pre-v2 agents answered instantly
             self._json(_procs.status(proc_id, wait=wait))
         elif parsed.path == '/read':
             path = os.path.expanduser(qs.get('path', [''])[0])
@@ -645,6 +679,9 @@ class _Handler(BaseHTTPRequestHandler):
             # of any instrumented loop on this host get captured and
             # summarized (docs/observability.md, On-demand
             # profiling). Idempotent — re-arming overwrites.
+            if not feature_enabled(4):  # '4': POST /profile
+                self._json({'error': 'not found'}, 404)
+                return
             try:
                 steps = int(body.get('steps', 5))
             except (TypeError, ValueError):

@@ -242,9 +242,19 @@ class AgentClient:
     def metrics(self, timeout: Optional[float] = None) -> str:
         """The host's Prometheus text exposition (``GET /metrics``;
         the driver-side aggregator ``metrics/scrape.py`` merges these
-        across hosts)."""
-        return self._get('/metrics', raw=True,
-                         timeout=timeout).decode('utf-8', 'replace')
+        across hosts). A pre-v3 agent has no /metrics at all — its
+        404 surfaces TYPED (``AgentVersionError``, the version-skew
+        contract) instead of a bare HTTPError the scrape loop would
+        misread as a transient fault."""
+        try:
+            return self._get('/metrics', raw=True,
+                             timeout=timeout).decode('utf-8',
+                                                     'replace')
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            raise self._version_error('/metrics', min_version='3') \
+                from e
 
     def version(self) -> Optional[str]:
         """Agent protocol version, or None if unreachable."""
@@ -252,6 +262,24 @@ class AgentClient:
             return str(self.health().get('version'))
         except (urllib.error.URLError, OSError, ValueError):
             return None
+
+    def _version_error(self, path: str,
+                       min_version: str) -> exceptions.AgentVersionError:
+        """Build the typed skew error for an endpoint this agent's
+        protocol predates: name BOTH versions and the concrete
+        recovery (the reuse handshake upgrades the runtime in place
+        on the next launch/exec against the cluster)."""
+        from skypilot_tpu.runtime import agent as agent_mod
+        served = self.version() or 'unknown'
+        return exceptions.AgentVersionError(
+            f'agent {self._target} speaks protocol {served} but '
+            f'{path} needs >= {min_version} (this client is '
+            f'{agent_mod.AGENT_VERSION}). Reuse the cluster with '
+            f'`xsky launch`/`xsky exec` to trigger the runtime '
+            f'version handshake (restarts agents in place), or '
+            f'relaunch it.',
+            host=self._target, agent_version=served,
+            client_version=agent_mod.AGENT_VERSION)
 
     def is_healthy(self, fast: bool = False) -> bool:
         """``fast=True``: single un-retried, un-gated probe — the
@@ -319,15 +347,21 @@ class AgentClient:
 
         Fallback for agents predating protocol v4 (404): the trigger
         FILE is the real protocol, so write it directly through
-        ``/put`` into ``runtime_dir``'s profile dir."""
+        ``/put`` into ``runtime_dir``'s profile dir. When the
+        fallback ALSO misses (no runtime_dir to aim /put at), the
+        skew surfaces TYPED — ``AgentVersionError`` naming both
+        versions and the recovery — never a bare 404."""
         try:
             # Idempotent (re-arming overwrites one trigger file), so
             # transient-failure retries are safe.
             return self._post('/profile', {'steps': int(steps)},
                               retry=True)
         except urllib.error.HTTPError as e:
-            if e.code != 404 or not runtime_dir:
+            if e.code != 404:
                 raise
+            if not runtime_dir:
+                raise self._version_error('/profile',
+                                          min_version='4') from e
         directory = os.path.join(runtime_dir, 'profiles')
         payload = json.dumps({'steps': int(steps),
                               'requested_at': time.time()}).encode()

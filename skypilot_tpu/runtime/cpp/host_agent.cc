@@ -44,6 +44,37 @@ namespace {
 // Keep in lockstep with agent.py AGENT_VERSION.
 constexpr const char* kVersion = "4";
 
+// Protocol emulation (mirror of agent.py served_version /
+// feature_enabled): SKYTPU_AGENT_VERSION_OVERRIDE pins the version
+// this agent ADVERTISES and BEHAVES as — endpoints newer than the
+// pin 404 and /status drops its long-poll — so the skew tier
+// exercises a real old agent, not a relabeled current one. An
+// override with no digits reads as 0 ("very old"), never silently
+// current.
+std::string ServedVersion() {
+  const char* ov = std::getenv("SKYTPU_AGENT_VERSION_OVERRIDE");
+  if (ov != nullptr && ov[0] != '\0') return std::string(ov);
+  return std::string(kVersion);
+}
+
+int ServedVersionNum() {
+  // FIRST contiguous digit run ('3.1' -> 3, 'v0-old' -> 0) — see
+  // agent.py served_version_num for the fail-closed rationale.
+  std::string digits;
+  for (char c : ServedVersion()) {
+    if (c >= '0' && c <= '9') {
+      digits += c;
+    } else if (!digits.empty()) {
+      break;
+    }
+  }
+  return digits.empty() ? 0 : std::atoi(digits.c_str());
+}
+
+bool FeatureEnabled(int min_version) {
+  return ServedVersionNum() >= min_version;
+}
+
 // ---------------------------------------------------------------------
 // Minimal JSON: value = object | string | number | bool | null.
 // Supports exactly what the protocol uses (flat objects, one level of
@@ -843,7 +874,9 @@ std::string MetricsText() {
     fclose(f);
   }
   AppendHistory(out);  // agent gauges only — before the textfiles
-  AppendTextfiles(&out);
+  if (FeatureEnabled(4)) {  // '4': textfile ingestion
+    AppendTextfiles(&out);
+  }
   return out;
 }
 
@@ -862,9 +895,14 @@ void HandleConnection(int fd) {
   }
 
   if (req.method == "GET" && req.path == "/health") {
-    SendJson(fd, std::string("{\"ok\": true, \"version\": \"") + kVersion +
-                     "\", \"agent\": \"cpp\"}");
+    SendJson(fd, std::string("{\"ok\": true, \"version\": \"") +
+                     ServedVersion() + "\", \"agent\": \"cpp\"}");
   } else if (req.method == "GET" && req.path == "/metrics") {
+    if (!FeatureEnabled(3)) {  // '3': GET /metrics
+      SendJson(fd, "{\"error\": \"not found\"}", 404);
+      close(fd);
+      return;
+    }
     SendResponse(fd, 200, "text/plain; version=0.0.4; charset=utf-8",
                  MetricsText());
   } else if (req.method == "GET" && req.path == "/status") {
@@ -873,6 +911,7 @@ void HandleConnection(int fd) {
     // Same contract as the Python agent; capped at 30 s.
     double wait_s = std::atof(req.query["wait"].c_str());
     if (wait_s > 30.0) wait_s = 30.0;
+    if (!FeatureEnabled(2)) wait_s = 0.0;  // pre-v2: no long-poll
     bool known = false, running = false;
     int rc = -1;
     g_procs.Status(id, &known, &running, &rc);
@@ -983,6 +1022,11 @@ void HandleConnection(int fd) {
       // Arm on-demand profiling (mirror of agent.py /profile): the
       // trigger file is the protocol, so loops need no agent flavor
       // awareness.
+      if (!FeatureEnabled(4)) {  // '4': POST /profile
+        SendJson(fd, "{\"error\": \"not found\"}", 404);
+        close(fd);
+        return;
+      }
       int steps = 5;
       auto sit = body.obj.find("steps");
       if (sit != body.obj.end() && sit->second.type == JsonValue::kNumber) {

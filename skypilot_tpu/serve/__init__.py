@@ -2,7 +2,8 @@
 (analog of ``sky/serve/`` SkyServe)."""
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 from skypilot_tpu.serve.core import (down, status,
-                                     terminate_replica, up, update)
+                                     terminate_replica, up, update,
+                                     upgrade_control, upgrade_status)
 
 __all__ = ['SkyServiceSpec', 'down', 'status', 'terminate_replica',
-           'up', 'update']
+           'up', 'update', 'upgrade_control', 'upgrade_status']

@@ -170,6 +170,67 @@ else:
     return _wrap(runtime_dir, body)
 
 
+def get_upgrade(runtime_dir: str, name: str) -> str:
+    """Read the service's rolling-upgrade row (state machine
+    position, docs/upgrades.md). The controller cluster may run an
+    OLDER package that predates the upgrades table — the snippet
+    detects that (missing serve_state API) and prints a typed
+    'unsupported' marker instead of crashing with an AttributeError
+    the client would misread as infrastructure failure (version-skew
+    contract for the controller↔client codegen surface)."""
+    body = f'''
+if not hasattr(serve_state, 'get_upgrade'):
+    print('UPGRADE:unsupported')
+elif serve_state.get_service({name!r}) is None:
+    print('UPGRADE:no-such-service')
+else:
+    rec = serve_state.get_upgrade({name!r})
+    if rec is None:
+        print('UPGRADE:null')
+    else:
+        rec = dict(rec)
+        rec['state'] = rec['state'].value
+        rec['phase'] = rec['phase'].value if rec['phase'] else None
+        replicas = [
+            {{'replica_id': r['replica_id'],
+              'status': r['status'].value,
+              'version': r['version']}}
+            for r in serve_state.get_replicas({name!r})]
+        rec['replicas'] = replicas
+        print('UPGRADE:' + json.dumps(rec))
+'''
+    return _wrap(runtime_dir, body)
+
+
+def upgrade_control(runtime_dir: str, name: str, op: str) -> str:
+    """pause / resume / abort flags on the persisted upgrade row;
+    the controller acts on them on its next tick (same remote-flag
+    transport as ``request_down``)."""
+    assert op in ('pause', 'resume', 'abort'), op
+    fn = {'pause': 'request_upgrade_pause',
+          'resume': 'request_upgrade_resume',
+          'abort': 'request_upgrade_abort'}[op]
+    body = f'''
+if not hasattr(serve_state, {fn!r}):
+    print('UPGRADECTL:unsupported')
+elif serve_state.get_service({name!r}) is None:
+    print('UPGRADECTL:no-such-service')
+elif serve_state.{fn}({name!r}):
+    print('UPGRADECTL:ok')
+else:
+    rec = serve_state.get_upgrade({name!r})
+    if rec is not None and rec['state'] == \\
+            serve_state.UpgradeState.ROLLING_BACK:
+        # Refused BECAUSE it is rolling back (abort == roll back;
+        # pausing a rollback would strand the fleet mid-revert) —
+        # "no active upgrade" would be a lie here.
+        print('UPGRADECTL:rolling-back')
+    else:
+        print('UPGRADECTL:no-active-upgrade')
+'''
+    return _wrap(runtime_dir, body)
+
+
 def dump_replica_log(runtime_dir: str, name: str,
                      replica_id: int) -> str:
     """One-shot dump of a replica cluster's latest job log (base64) —

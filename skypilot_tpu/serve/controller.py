@@ -7,7 +7,7 @@ import json
 import os
 import threading
 import time
-from typing import Set
+from typing import Optional, Set
 
 from skypilot_tpu import alerts as alerts_lib
 from skypilot_tpu import metrics as metrics_lib
@@ -16,6 +16,7 @@ from skypilot_tpu.metrics import history as history_lib
 from skypilot_tpu.metrics import query as query_lib
 from skypilot_tpu.resilience import watchdog as watchdog_lib
 from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import upgrade as upgrade_lib
 from skypilot_tpu.serve.autoscalers import (AutoscalerDecisionOperator,
                                             make_autoscaler)
 from skypilot_tpu.serve.load_balancer import SkyServeLoadBalancer
@@ -33,9 +34,14 @@ CONTROLLER_SYNC_INTERVAL = float(
 class SkyServeController:
 
     def __init__(self, service_name: str, task: Task,
-                 lb_port: int):
+                 lb_port: int, task_yaml: Optional[str] = None):
         assert task.service is not None
         self.service_name = service_name
+        # The v1 task yaml path (when launched via serve.up): every
+        # version's yaml is recorded in service_versions so a
+        # rollback — possibly after a controller restart — can
+        # relaunch the PRIOR version, not just the newest.
+        self.task_yaml = task_yaml
         self.spec: SkyServiceSpec = task.service
         self.replica_manager = ReplicaManager(service_name, self.spec,
                                               task)
@@ -47,6 +53,11 @@ class SkyServeController:
         # Scale on the LB's MEASURED windowed QPS; the drained
         # timestamps below stay as the fallback signal.
         self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
+        # Every replica-removal path drops the endpoint's LB
+        # in-flight series (series-removal contract) — not just the
+        # upgrade machine's explicit drain path.
+        self.replica_manager.on_endpoint_removed = \
+            self.load_balancer.forget_endpoint
         self.version = 1
         self._stop = threading.Event()
         # Set by the watchdog to short-circuit the sync interval: a
@@ -74,10 +85,23 @@ class SkyServeController:
         # Replicas already demoted for the CURRENT firing episode —
         # one demote per episode, not one per tick.
         self._alert_demoted: Set[int] = set()
+        # Rolling-upgrade state machine (serve/upgrade.py): advanced
+        # one transition per control tick while an upgrade row is
+        # active; persisted in serve_state so a controller restart
+        # RESUMES a mid-flight upgrade instead of orphaning it.
+        self.upgrader = upgrade_lib.RollingUpgrader(
+            service_name, self.replica_manager, self.load_balancer,
+            self._alert_engine,
+            on_version_restored=self._on_version_restored)
+        self._upgrade_versions_checked = False
 
     def start(self) -> None:
         serve_state.set_service_status(self.service_name,
                                        ServiceStatus.REPLICA_INIT)
+        if self.task_yaml:
+            serve_state.add_service_version(self.service_name,
+                                            self.version,
+                                            self.task_yaml)
         self.load_balancer.start()
         # The client computes the authoritative endpoint from the
         # controller cluster's head IP (serve/core.py up); only fill
@@ -164,21 +188,118 @@ class SkyServeController:
         logger.info('Rolling update %s: v%d -> v%d',
                     self.service_name, self.version,
                     rec['target_version'])
+        serve_state.add_service_version(self.service_name,
+                                        rec['target_version'],
+                                        yaml_path)
         self.version = rec['target_version']
-        self.spec = new_task.service
         self.replica_manager.set_task(new_task, self.version)
-        # Carry scaling state across the update: a service scaled to
-        # N under load must come up with N new-version replicas, not
-        # collapse to min_replicas.
+        self._adopt_spec(new_task.service)
+
+    def _adopt_spec(self, spec: SkyServiceSpec) -> None:
+        """Adopt a version's spec as current: rebuild the autoscaler
+        (carrying the scaling state across — a service scaled to N
+        under load must not collapse to min_replicas) and the alert
+        rules (the version may declare a different SLO). Shared by
+        the update pickup and the rollback's re-adoption of the
+        prior version."""
+        self.spec = spec
         old_target = self.autoscaler.target_num_replicas
-        self.autoscaler = make_autoscaler(self.spec)
+        self.autoscaler = make_autoscaler(spec)
         self.autoscaler.set_qps_source(self.load_balancer.measured_qps)
-        # The new version may declare a different SLO.
-        self._alert_engine.rules = \
-            alerts_lib.builtin.serve_rules(self.spec)
         self.autoscaler.target_num_replicas = max(
-            min(old_target, self.spec.max_replicas
-                or old_target), self.spec.min_replicas)
+            min(old_target, spec.max_replicas or old_target),
+            spec.min_replicas)
+        self._alert_engine.rules = \
+            alerts_lib.builtin.serve_rules(spec)
+
+    # -- rolling upgrades (serve/upgrade.py, docs/upgrades.md) ----------
+
+    def _on_version_restored(self, version: int) -> bool:
+        """Rollback started: re-adopt the prior version as the
+        controller's current one — spec, replica-manager task,
+        autoscaler, alert rules, AND the service row's
+        target_version (else the next tick's update check would
+        immediately restart the upgrade the rollback is undoing).
+        Returns False when the version cannot be materialized (no
+        recorded yaml and no in-memory task) — the upgrader then
+        HALTS the rollback instead of relaunching the new version
+        relabeled as the old one (a 'ROLLED_BACK' fleet still
+        running the code that tripped the page would be a lie)."""
+        yaml_path = serve_state.get_service_version_yaml(
+            self.service_name, version)
+        task = None
+        if yaml_path and os.path.exists(yaml_path):
+            from skypilot_tpu.utils import common_utils
+            try:
+                task = Task.from_yaml_config(
+                    common_utils.read_yaml(yaml_path))
+            except Exception:  # pylint: disable=broad-except
+                # A torn/corrupt recorded yaml must take the same
+                # honest-PAUSE path as a missing one — raising here
+                # would loop the rollback attempt forever while the
+                # fleet keeps serving the version that paged.
+                logger.exception(
+                    'Rollback of %s: recorded yaml %s for v%d is '
+                    'unreadable.', self.service_name, yaml_path,
+                    version)
+                task = None
+        if task is None or task.service is None:
+            # Fall back to a task already registered in memory (the
+            # version this controller itself launched from).
+            task = self.replica_manager._version_tasks.get(version)  # pylint: disable=protected-access
+        if task is None or task.service is None:
+            logger.error(
+                'Rollback of %s: no recorded task yaml (and no '
+                'in-memory task) for v%d — cannot materialize the '
+                'prior version.', self.service_name, version)
+            return False
+        self.replica_manager.set_task(task, version)
+        self.version = version
+        serve_state.set_target_version(self.service_name, version,
+                                       yaml_path or '')
+        self._adopt_spec(task.service)
+        return True
+
+    def _ensure_upgrade_versions(self) -> None:
+        """Resume support: a restarted controller only knows its
+        startup task (v1) plus whatever _check_for_update adopted —
+        a mid-flight upgrade may need OTHER versions' tasks (the
+        rollback target, the probe spec of in-between replicas).
+        Register every version the active upgrade touches from the
+        persisted service_versions yamls. Also re-adopts the
+        rollback target as current when resuming a ROLLING_BACK row.
+        """
+        if self._upgrade_versions_checked:
+            return
+        self._upgrade_versions_checked = True
+        rec = serve_state.get_upgrade(self.service_name)
+        if rec is None or rec['state'].is_terminal():
+            return
+        from skypilot_tpu.utils import common_utils
+        for version in (rec['from_version'], rec['to_version']):
+            if version in self.replica_manager._version_tasks:  # pylint: disable=protected-access
+                continue
+            yaml_path = serve_state.get_service_version_yaml(
+                self.service_name, version)
+            if not yaml_path or not os.path.exists(yaml_path):
+                logger.warning(
+                    'Upgrade resume: no task yaml recorded for %s '
+                    'v%d.', self.service_name, version)
+                continue
+            task = Task.from_yaml_config(
+                common_utils.read_yaml(yaml_path))
+            if task.service is not None:
+                self.replica_manager.register_version(version, task)
+        if rec['state'] == serve_state.UpgradeState.ROLLING_BACK \
+                and self.version != rec['from_version']:
+            if not self._on_version_restored(rec['from_version']):
+                serve_state.update_upgrade(
+                    self.service_name,
+                    state=serve_state.UpgradeState.PAUSED,
+                    pause_requested=1,
+                    paused_reason=('rollback-unavailable: no '
+                                   'recorded task for '
+                                   f'v{rec["from_version"]}'))
 
     # -- alert-driven control -------------------------------------------
 
@@ -196,16 +317,14 @@ class SkyServeController:
                 self._alert_demoted.clear()
             # A page means users see errors: treat it as scale-up
             # pressure on top of the measured QPS (which undercounts
-            # demand the fleet is shedding).
-            pressure = bool(firing & {'slo-burn-rate',
-                                      'replica-5xx-rate',
-                                      'lb-no-ready-replica'})
+            # demand the fleet is shedding). The same PAGE_RULE_IDS
+            # set gates the rolling-upgrade machine.
+            pages = set(alerts_lib.builtin.PAGE_RULE_IDS)
+            pressure = bool(firing & pages)
             was = getattr(self.autoscaler, '_alert_pressure', False)
             self.autoscaler.set_alert_pressure(pressure)
             if pressure and not was:
-                rule = next(iter(sorted(
-                    firing & {'slo-burn-rate', 'replica-5xx-rate',
-                              'lb-no-ready-replica'})))
+                rule = next(iter(sorted(firing & pages)))
                 self._alert_engine.note_action(
                     rule, 'scale-up-pressure')
                 logger.warning(
@@ -263,41 +382,73 @@ class SkyServeController:
         self._sync_watchdog_targets(records)
         self._alert_tick(records)
         old_alive = [r for r in records
-                     if r['version'] < self.version and
+                     if r['version'] != self.version and
                      not r['status'].is_terminal() and
                      r['status'] != ReplicaStatus.SHUTTING_DOWN]
-        if old_alive:
-            # Keep feeding QPS to the autoscaler during the update
-            # (also bounds the LB's request-timestamp buffer).
+        upg = serve_state.get_upgrade(self.service_name)
+        upg_active = upg is not None and \
+            not upg['state'].is_terminal()
+        if upg_active or old_alive:
+            # Rolling upgrade (serve/upgrade.py): one replica at a
+            # time through drain → relaunch → re-probe → promote,
+            # alert-gated, persisted so a controller restart resumes
+            # mid-flight. Normal autoscaling is suspended while the
+            # machine runs (the fleet delta IS the upgrade); QPS
+            # keeps draining so the LB's timestamp buffer stays
+            # bounded and the autoscaler's window stays warm.
+            if not upg_active:
+                from_version = max(r['version'] for r in old_alive)
+                logger.info(
+                    'Starting rolling upgrade %s: v%d -> v%d '
+                    '(%d replica(s) to migrate).', self.service_name,
+                    from_version, self.version, len(old_alive))
+                serve_state.start_upgrade(self.service_name,
+                                          from_version, self.version)
+                upg = serve_state.get_upgrade(self.service_name)
+            self._ensure_upgrade_versions()
             self.autoscaler.collect_request_information(
                 self.load_balancer.drain_request_timestamps())
-            current = [r for r in records
-                       if r['version'] == self.version]
-            cur_ready = [r for r in current
-                         if r['status'] == ReplicaStatus.READY]
-            target = self.autoscaler.target_num_replicas
-            # New-version provisioning goes through the autoscaler's
-            # op planner so the fallback autoscalers' spot/on-demand
-            # mix survives the update (a bare scale_up(need) would
-            # bring the new version up all-default and churn once
-            # normal ticks resume — round-3 advisor finding).
-            for op in self.autoscaler.generate_ops(current):
-                if op.operator == AutoscalerDecisionOperator.SCALE_UP:
-                    self.replica_manager.scale_up(
-                        op.count, use_spot=op.use_spot)
-                elif op.operator == \
-                        AutoscalerDecisionOperator.SCALE_DOWN:
-                    # Mix rebalancing among NEW-version replicas only
-                    # (old-version drain is handled below).
-                    self.replica_manager.scale_down(op.replica_ids)
-            if len(cur_ready) >= target:
-                victims = [r['replica_id'] for r in old_alive]
-                logger.info('Rolling update: new version READY; '
-                            'draining old replicas %s', victims)
-                self.replica_manager.scale_down(victims)
+            # Losses are still repaired while the machine runs: a
+            # replica preempted mid-rollout (probe_all removed its
+            # record) would otherwise serve the whole upgrade short.
+            # The machine's own intentional hole — the window in
+            # RELAUNCH where the old replica is terminated and the
+            # replacement not yet recorded — is excluded so the
+            # repair never races the upgrade's own relaunch.
+            alive = [r for r in records
+                     if not r['status'].is_terminal() and
+                     r['status'] != ReplicaStatus.SHUTTING_DOWN]
+            hole = 0
+            if upg is not None and not upg.get('surge'):
+                if upg['phase'] == serve_state.UpgradePhase.RELAUNCH:
+                    # Old replica terminated, replacement not yet
+                    # recorded.
+                    hole = 1
+                elif upg['phase'] in (
+                        serve_state.UpgradePhase.PROBE,
+                        serve_state.UpgradePhase.SOAK):
+                    # A replacement that died in PROBE is the
+                    # MACHINE's to handle (scale-down + relaunch or
+                    # rollback on its very next step) — repairing it
+                    # here too would launch a spurious extra replica
+                    # at the version that just failed.
+                    rep = next(
+                        (r for r in records if r['replica_id'] ==
+                         upg['replacement_replica']), None)
+                    if rep is None or rep['status'].is_terminal():
+                        hole = 1
+            shortfall = self.autoscaler.target_num_replicas - \
+                (len(alive) + hole)
+            if shortfall > 0:
+                logger.warning(
+                    'Upgrade in progress but fleet is %d short '
+                    '(replica lost mid-rollout); replacing.',
+                    shortfall)
+                self.replica_manager.scale_up(shortfall)
+            self.upgrader.step(records, rec=upg)
             # LB keeps serving the union of READY replicas (old +
-            # new) throughout; normal autoscaling resumes once the
-            # old version is drained.
+            # new versions) throughout; normal autoscaling resumes
+            # once the machine reaches a terminal state.
             ready = [r for r in records
                      if r['status'] == ReplicaStatus.READY]
             serve_state.set_service_status(
@@ -383,7 +534,8 @@ def main():
         'serve_controller', port=args.lb_port,
         runtime_dir=os.environ.get('SKYTPU_STATE_DIR'))
     controller = SkyServeController(args.service_name, task,
-                                    args.lb_port)
+                                    args.lb_port,
+                                    task_yaml=args.task_yaml)
 
     import signal
 

@@ -327,6 +327,58 @@ def update(service_name: str, task: Task) -> int:
     return new_version
 
 
+def upgrade_status(service_name: str) -> Optional[Dict[str, Any]]:
+    """The service's rolling-upgrade state-machine row (None when no
+    upgrade has run). Typed under skew: a controller cluster running
+    a pre-upgrades package answers 'unsupported' and this raises
+    ``AgentVersionError`` naming the recovery — never a stack trace
+    out of the remote snippet."""
+    handle = _get_controller_handle()
+    out = _rpc(handle, serve_codegen.get_upgrade(
+        handle.head_runtime_dir, service_name), retry=True)
+    payload = _parse(out, 'UPGRADE')
+    if payload == 'unsupported':
+        raise exceptions.AgentVersionError(
+            f'The serve controller cluster predates rolling '
+            f'upgrades (no serve_state.get_upgrade); restart it '
+            f'with this client\'s package: `xsky serve down '
+            f'{service_name}` then `xsky serve up`.',
+            host=handle.cluster_name)
+    if payload == 'no-such-service':
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    if payload == 'null':
+        return None
+    return json.loads(payload)
+
+
+def upgrade_control(service_name: str, op: str) -> None:
+    """Pause/resume/abort the service's rolling upgrade (flags on
+    the persisted row; the controller acts on its next tick)."""
+    handle = _get_controller_handle()
+    out = _rpc(handle, serve_codegen.upgrade_control(
+        handle.head_runtime_dir, service_name, op))
+    result = _parse(out, 'UPGRADECTL')
+    if result == 'unsupported':
+        raise exceptions.AgentVersionError(
+            f'The serve controller cluster predates rolling '
+            f'upgrades; restart it with this client\'s package: '
+            f'`xsky serve down {service_name}` then `xsky serve '
+            f'up`.', host=handle.cluster_name)
+    if result == 'no-such-service':
+        raise exceptions.ClusterDoesNotExist(
+            f'Service {service_name!r} does not exist.')
+    if result == 'rolling-back':
+        raise exceptions.InvalidSpecError(
+            f'Service {service_name!r} is rolling back — the '
+            f'rollback runs to completion and cannot be {op}d '
+            f'(abort == roll back).')
+    if result == 'no-active-upgrade':
+        raise exceptions.InvalidSpecError(
+            f'Service {service_name!r} has no active upgrade to '
+            f'{op}.')
+
+
 def down(service_name: str, timeout: float = 120.0) -> None:
     """Tear a service down: flag the controller (it terminates its
     replicas + LB and exits), wait, then force-clean anything left.

@@ -153,6 +153,17 @@ class SkyServeLoadBalancer:
             'after a replica fault (labeled by the FAILED replica).',
             ('endpoint',))
         self._qps_window = metrics_lib.WindowedRate(QPS_WINDOW_SECONDS)
+        # Per-endpoint in-flight request counts — the DRAIN signal
+        # for rolling upgrades (docs/upgrades.md): a draining replica
+        # leaves the ready set (no NEW requests route to it) and the
+        # upgrade machine waits for this count to reach zero before
+        # terminating it, so in-flight generations always finish.
+        self._inflight: Dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
+        self._m_inflight = reg.gauge(
+            'skytpu_lb_inflight_requests',
+            'Requests currently in flight to a replica through the '
+            'LB (the rolling-upgrade drain signal).', ('endpoint',))
         # Recent ERROR request exemplars: (wall ts, trace_id). The
         # alert engine stamps the newest one onto a firing alert so
         # `xsky trace <id>` shows the exact request behind the page.
@@ -175,6 +186,43 @@ class SkyServeLoadBalancer:
         if time.time() - ts > max_age:
             return None
         return trace_id
+
+    def _inflight_start(self, endpoint: str) -> None:
+        with self._inflight_lock:
+            count = self._inflight.get(endpoint, 0) + 1
+            self._inflight[endpoint] = count
+            self._m_inflight.labels(endpoint).set(float(count))
+
+    def _inflight_end(self, endpoint: str) -> None:
+        with self._inflight_lock:
+            if endpoint not in self._inflight:
+                # forget_endpoint() already dropped this endpoint
+                # (replica terminated with the request still
+                # streaming): writing the gauge now would resurrect
+                # the removed series as a frozen corpse.
+                return
+            count = self._inflight[endpoint] - 1
+            if count <= 0:
+                del self._inflight[endpoint]
+                count = 0
+            else:
+                self._inflight[endpoint] = count
+            self._m_inflight.labels(endpoint).set(float(count))
+
+    def inflight_count(self, endpoint: str) -> int:
+        """Requests currently streaming through this LB to
+        ``endpoint``. Zero == drained (for an endpoint already out
+        of the ready set)."""
+        with self._inflight_lock:
+            return self._inflight.get(endpoint, 0)
+
+    def forget_endpoint(self, endpoint: str) -> None:
+        """Drop a terminated replica's in-flight series (the
+        registry's series-removal contract: a dead endpoint must not
+        keep exporting a frozen gauge)."""
+        with self._inflight_lock:
+            self._inflight.pop(endpoint, None)
+            self._m_inflight.remove(endpoint)
 
     def measured_qps(self) -> float:
         """MEASURED request rate over the trailing window — the
@@ -304,6 +352,7 @@ class SkyServeLoadBalancer:
                             trace_lib.format_traceparent(
                                 req_span.context))
                     lb.policy.on_request_start(current)
+                    lb._inflight_start(current)  # pylint: disable=protected-access
                     try:
                         try:
                             with urllib.request.urlopen(
@@ -444,6 +493,11 @@ class SkyServeLoadBalancer:
                                    'code': str(self._resp_status)
                                    if self._resp_status is not None
                                    else '502'})
+                        # In-flight bookkeeping LAST: a drained
+                        # replica's terminate waits on this count,
+                        # so the attempt's metrics/span must already
+                        # be recorded when it drops to zero.
+                        lb._inflight_end(current)  # pylint: disable=protected-access
 
             def _stream_response(self, resp) -> None:
                 """Chunk-by-chunk pass-through so token streaming

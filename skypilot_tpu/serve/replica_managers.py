@@ -20,7 +20,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from skypilot_tpu import core as core_lib
 from skypilot_tpu import exceptions, execution, state
@@ -63,9 +63,21 @@ class ReplicaManager:
         self.version = 1
         # Per-version specs: during a rolling update old replicas
         # must keep being probed with THEIR version's readiness
-        # path/timeouts, not the new one's.
+        # path/timeouts, not the new one's. Per-version TASKS so a
+        # rollback can relaunch replicas on the PRIOR version
+        # (scale_up(version=...) pins the launching version).
         self._version_specs = {1: spec}
-        self._next_replica_id = 1
+        self._version_tasks = {1: task}
+        # Seed the id allocator PAST every replica already in the
+        # DB: a restarted controller starting from 1 would hand a
+        # LIVE replica's id to the next scale_up/reserve call,
+        # overwriting its record and launching into its cluster name
+        # — corrupting exactly the fleet state the upgrade machine's
+        # crash-resume protects.
+        existing = serve_state.get_replicas(service_name)
+        self._next_replica_id = (
+            max(r['replica_id'] for r in existing) + 1
+            if existing else 1)
         self._lock = threading.Lock()
         self._launch_threads: Dict[int, threading.Thread] = {}
         # Consecutive probe outcome counters + watchdog suspicion
@@ -85,6 +97,14 @@ class ReplicaManager:
         self._m_ready = reg.gauge(
             'skytpu_serve_replicas_ready',
             'Replicas currently READY.')
+        # Hook for the endpoint's OTHER per-replica series (the
+        # LB's in-flight gauge): the controller points this at
+        # load_balancer.forget_endpoint so every replica-removal
+        # path — scale-down, preemption, failed-readiness teardown —
+        # drops the dead endpoint's series, not just the upgrade
+        # machine's.
+        self.on_endpoint_removed: Optional[Callable[[str],
+                                                    None]] = None
         # Local-provider port allocation: each replica gets its own
         # service port (one machine hosts all fake replicas).
         from skypilot_tpu import clouds
@@ -102,6 +122,16 @@ class ReplicaManager:
         self.spec = task.service
         self.version = version
         self._version_specs[version] = task.service
+        self._version_tasks[version] = task
+
+    def register_version(self, version: int, task: Task) -> None:
+        """Make an older version launchable/probe-able WITHOUT
+        switching the manager to it — the rollback path (and a
+        restarted controller resuming a mid-flight upgrade) needs
+        the prior version's task on hand."""
+        assert task.service is not None
+        self._version_specs[version] = task.service
+        self._version_tasks[version] = task
 
     # -- replica lifecycle ---------------------------------------------
 
@@ -113,21 +143,51 @@ class ReplicaManager:
             return self.spec.port + replica_id
         return self.spec.port
 
+    def reserve_replica_ids(self, n: int = 1) -> List[int]:
+        """Allocate replica ids WITHOUT launching. The upgrade
+        machine persists the reserved id as the cycle's replacement
+        BEFORE launching, making the launch exactly-once across
+        controller crashes: on resume, a replica record under the
+        persisted id means the launch already happened — no
+        adoption heuristic, no double-billed zombie."""
+        with self._lock:
+            ids = list(range(self._next_replica_id,
+                             self._next_replica_id + n))
+            self._next_replica_id += n
+        return ids
+
     def scale_up(self, n: int = 1,
-                 use_spot: Optional[bool] = None) -> List[int]:
+                 use_spot: Optional[bool] = None,
+                 version: Optional[int] = None,
+                 replica_ids: Optional[List[int]] = None
+                 ) -> List[int]:
         """Launch n replicas. ``use_spot`` pins the new replicas'
         spot-ness (the fallback autoscalers' per-op resource
         override, ref ``sky/serve/autoscalers.py:28``); None keeps
-        the task's own resources."""
-        ids = []
-        with self._lock:
-            for _ in range(n):
-                replica_id = self._next_replica_id
-                self._next_replica_id += 1
-                ids.append(replica_id)
+        the task's own resources. ``version`` pins the LAUNCHING
+        version (rolling-upgrade rollback relaunches the prior
+        version); None launches the manager's current one.
+        ``replica_ids`` launches under pre-reserved ids
+        (:meth:`reserve_replica_ids`) instead of allocating."""
+        if replica_ids is not None:
+            assert len(replica_ids) == n, (replica_ids, n)
+            ids = list(replica_ids)
+            with self._lock:
+                self._next_replica_id = max(self._next_replica_id,
+                                            max(ids) + 1)
+        else:
+            ids = []
+            with self._lock:
+                for _ in range(n):
+                    replica_id = self._next_replica_id
+                    self._next_replica_id += 1
+                    ids.append(replica_id)
         # Snapshot task/version NOW: an update arriving while a
         # launch thread runs must not relabel an old-version replica.
-        version, task = self.version, self.task
+        if version is None:
+            version, task = self.version, self.task
+        else:
+            task = self._version_tasks.get(version, self.task)
         spot_flag = use_spot if use_spot is not None else \
             any(r.use_spot for r in task.resources)
         for replica_id in ids:
@@ -223,6 +283,36 @@ class ReplicaManager:
         for rec in serve_state.get_replicas(self.service_name):
             self.scale_down([rec['replica_id']])
 
+    # -- draining (rolling upgrades, docs/upgrades.md) -----------------
+
+    def drain(self, replica_id: int) -> None:
+        """Cooperatively remove a replica from new-request routing:
+        DRAINING leaves the ready set (the LB fetches endpoints per
+        request, so the cutoff is immediate) while the replica
+        process keeps serving its in-flight requests. The upgrade
+        machine terminates it only once the LB's in-flight count for
+        its endpoint hits zero (or the drain grace expires)."""
+        rec = serve_state.get_replica(self.service_name, replica_id)
+        if rec is None or rec['status'].is_terminal():
+            return
+        logger.info('Replica %d draining (out of routing; in-flight '
+                    'requests finish).', replica_id)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.DRAINING)
+
+    def undrain(self, replica_id: int) -> None:
+        """Put a DRAINING replica back into rotation (upgrade
+        paused/aborted before its drain finished). It re-enters as
+        READY — it was serving a moment ago; the next failed probe
+        demotes it through the ordinary consecutive-threshold path."""
+        rec = serve_state.get_replica(self.service_name, replica_id)
+        if rec is None or rec['status'] != ReplicaStatus.DRAINING:
+            return
+        logger.info('Replica %d un-drained (back in routing).',
+                    replica_id)
+        serve_state.set_replica_status(self.service_name, replica_id,
+                                       ReplicaStatus.READY)
+
     # -- probing --------------------------------------------------------
 
     def mark_suspect(self, replica_id: int) -> None:
@@ -238,8 +328,17 @@ class ReplicaManager:
         self._suspect.discard(replica_id)
         # A scaled-away replica stops exporting its failure series
         # (the registry's series-removal contract — a dead replica's
-        # last count must not keep feeding the alert rules).
+        # last count must not keep feeding the alert rules). Same
+        # contract for the LB's per-endpoint in-flight gauge.
         self._m_probe_failures.remove(str(replica_id))
+        if self.on_endpoint_removed is not None:
+            rec = serve_state.get_replica(self.service_name,
+                                          replica_id)
+            if rec is not None and rec['endpoint']:
+                try:
+                    self.on_endpoint_removed(rec['endpoint'])
+                except Exception:  # pylint: disable=broad-except
+                    pass
 
     def probe(self, endpoint: str,
               spec: Optional[SkyServiceSpec] = None) -> bool:
@@ -270,7 +369,11 @@ class ReplicaManager:
         for rec in records:
             rid = rec['replica_id']
             if rec['status'] in (ReplicaStatus.PROVISIONING,
-                                 ReplicaStatus.SHUTTING_DOWN):
+                                 ReplicaStatus.SHUTTING_DOWN,
+                                 ReplicaStatus.DRAINING):
+                # DRAINING: the replica is leaving by design — a
+                # failed probe must not flap it to NOT_READY/FAILED
+                # mid-drain (it is already out of routing).
                 continue
             if rec['status'].is_terminal():
                 continue

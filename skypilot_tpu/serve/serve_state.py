@@ -1,5 +1,6 @@
 """Serve-side state DB (analog of ``sky/serve/serve_state.py``)."""
 import enum
+import json
 import time
 from typing import Any, Dict, List, Optional
 
@@ -14,6 +15,11 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'
     READY = 'READY'
     NOT_READY = 'NOT_READY'
+    # Cooperative drain (rolling upgrades, docs/upgrades.md): out of
+    # the LB's new-request routing, but the replica process keeps
+    # serving until its in-flight requests finish — the state that
+    # lets an upgrade shed zero requests.
+    DRAINING = 'DRAINING'
     FAILED = 'FAILED'
     PREEMPTED = 'PREEMPTED'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
@@ -30,6 +36,34 @@ class ServiceStatus(enum.Enum):
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     FAILED = 'FAILED'
     DOWN = 'DOWN'
+
+
+class UpgradeState(enum.Enum):
+    """Rolling-upgrade state machine states (docs/upgrades.md).
+
+    The per-replica loop (phase column) runs inside ROLLING /
+    ROLLING_BACK; PAUSED freezes it (operator `--pause`); the three
+    terminal states are kept for `xsky serve upgrade` status until
+    the next upgrade starts."""
+    ROLLING = 'ROLLING'
+    PAUSED = 'PAUSED'
+    ROLLING_BACK = 'ROLLING_BACK'
+    SUCCEEDED = 'SUCCEEDED'
+    ROLLED_BACK = 'ROLLED_BACK'
+
+    def is_terminal(self) -> bool:
+        return self in (UpgradeState.SUCCEEDED,
+                        UpgradeState.ROLLED_BACK)
+
+
+class UpgradePhase(enum.Enum):
+    """Per-replica step inside a rolling upgrade: drain the old
+    replica → relaunch on the target version → re-probe until READY
+    → soak behind the alert gate, then promote and move on."""
+    DRAIN = 'DRAIN'
+    RELAUNCH = 'RELAUNCH'
+    PROBE = 'PROBE'
+    SOAK = 'SOAK'
 
 
 def _db_path() -> str:
@@ -88,6 +122,38 @@ def _create_tables(cursor, conn):
             cursor.execute(stmt)
         except sqlite3.OperationalError:
             pass  # column already exists
+    # Rolling-upgrade tier (docs/upgrades.md): the upgrade state
+    # machine is PERSISTED so a controller restart resumes a
+    # half-upgraded fleet instead of orphaning it, and every
+    # version's task yaml is kept so a rollback can relaunch the
+    # PRIOR version, not just the newest.
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS service_versions (
+        service_name TEXT,
+        version INTEGER,
+        task_yaml TEXT,
+        created_at REAL,
+        PRIMARY KEY (service_name, version))""")
+    cursor.execute("""\
+        CREATE TABLE IF NOT EXISTS upgrades (
+        service_name TEXT PRIMARY KEY,
+        from_version INTEGER,
+        to_version INTEGER,
+        state TEXT,
+        phase TEXT,
+        current_replica INTEGER,
+        replacement_replica INTEGER,
+        upgraded_json TEXT DEFAULT '[]',
+        phase_started_at REAL,
+        started_at REAL,
+        updated_at REAL,
+        pause_requested INTEGER DEFAULT 0,
+        abort_requested INTEGER DEFAULT 0,
+        paused_reason TEXT,
+        rollback_reason TEXT,
+        exemplar_trace_id TEXT,
+        replacement_use_spot INTEGER,
+        surge INTEGER DEFAULT 0)""")
     from skypilot_tpu.lifecycle import fencing
     fencing.add_fence_columns(cursor, conn, 'services')
     conn.commit()
@@ -329,6 +395,10 @@ def remove_service(name: str) -> None:
                              (name,))
     _db().execute_and_commit(
         'DELETE FROM replicas WHERE service_name=?', (name,))
+    _db().execute_and_commit(
+        'DELETE FROM upgrades WHERE service_name=?', (name,))
+    _db().execute_and_commit(
+        'DELETE FROM service_versions WHERE service_name=?', (name,))
 
 
 def upsert_replica(service_name: str, replica_id: int,
@@ -415,3 +485,130 @@ def used_lb_ports() -> List[int]:
         'SELECT lb_port FROM services WHERE lb_port IS NOT NULL'
     ).fetchall()
     return [r[0] for r in rows]
+
+
+# -- rolling upgrades (docs/upgrades.md) -------------------------------
+
+
+def add_service_version(name: str, version: int,
+                        task_yaml: str) -> None:
+    """Record which task yaml a version ran — the rollback target.
+    Idempotent (a restarted controller re-records its versions)."""
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO service_versions '
+        '(service_name, version, task_yaml, created_at) '
+        'VALUES (?,?,?,?)', (name, version, task_yaml, time.time()))
+
+
+def get_service_version_yaml(name: str,
+                             version: int) -> Optional[str]:
+    row = _db().cursor.execute(
+        'SELECT task_yaml FROM service_versions WHERE '
+        'service_name=? AND version=?', (name, version)).fetchone()
+    return row[0] if row else None
+
+
+_UPGRADE_COLS = (
+    'service_name', 'from_version', 'to_version', 'state', 'phase',
+    'current_replica', 'replacement_replica', 'upgraded_json',
+    'phase_started_at', 'started_at', 'updated_at',
+    'pause_requested', 'abort_requested', 'paused_reason',
+    'rollback_reason', 'exemplar_trace_id', 'replacement_use_spot',
+    'surge')
+
+
+def start_upgrade(name: str, from_version: int,
+                  to_version: int) -> None:
+    """Open a fresh upgrade row (replacing any terminal previous
+    one); the controller's state machine advances it per tick."""
+    now = time.time()
+    _db().execute_and_commit(
+        'INSERT OR REPLACE INTO upgrades (service_name, '
+        'from_version, to_version, state, phase, current_replica, '
+        'replacement_replica, upgraded_json, phase_started_at, '
+        'started_at, updated_at, pause_requested, abort_requested) '
+        "VALUES (?,?,?,?,NULL,NULL,NULL,'[]',NULL,?,?,0,0)",
+        (name, from_version, to_version,
+         UpgradeState.ROLLING.value, now, now))
+
+
+def get_upgrade(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().cursor.execute(
+        f'SELECT {", ".join(_UPGRADE_COLS)} FROM upgrades '
+        'WHERE service_name=?', (name,)).fetchone()
+    if row is None:
+        return None
+    rec = dict(zip(_UPGRADE_COLS, row))
+    rec['state'] = UpgradeState(rec['state'])
+    rec['phase'] = (UpgradePhase(rec['phase'])
+                    if rec['phase'] else None)
+    rec['upgraded'] = json.loads(rec.pop('upgraded_json') or '[]')
+    rec['pause_requested'] = bool(rec['pause_requested'])
+    rec['abort_requested'] = bool(rec['abort_requested'])
+    if rec['replacement_use_spot'] is not None:
+        rec['replacement_use_spot'] = \
+            bool(rec['replacement_use_spot'])
+    rec['surge'] = bool(rec['surge'])
+    return rec
+
+
+def update_upgrade(name: str, **fields: Any) -> None:
+    """Merge-update the upgrade row (the state machine's persist
+    point — called on every phase/state transition so a controller
+    crash at ANY step resumes exactly where it stopped)."""
+    if 'upgraded' in fields:
+        fields['upgraded_json'] = json.dumps(
+            sorted(fields.pop('upgraded')))
+    if 'state' in fields and isinstance(fields['state'],
+                                        UpgradeState):
+        fields['state'] = fields['state'].value
+    if 'phase' in fields and isinstance(fields['phase'],
+                                        UpgradePhase):
+        fields['phase'] = fields['phase'].value
+    fields['updated_at'] = time.time()
+    cols = sorted(fields)
+    assert all(c in _UPGRADE_COLS for c in cols), cols
+    sets = ', '.join(f'{c}=?' for c in cols)
+    _db().execute_and_commit(
+        f'UPDATE upgrades SET {sets} WHERE service_name=?',
+        tuple(fields[c] for c in cols) + (name,))
+
+
+def request_upgrade_pause(name: str) -> bool:
+    db = _db()
+    db.execute_and_commit(
+        'UPDATE upgrades SET pause_requested=1 WHERE service_name=? '
+        'AND state IN (?,?)',
+        (name, UpgradeState.ROLLING.value,
+         UpgradeState.PAUSED.value))
+    return db.cursor.rowcount > 0
+
+
+def request_upgrade_resume(name: str) -> bool:
+    db = _db()
+    db.execute_and_commit(
+        'UPDATE upgrades SET pause_requested=0 WHERE service_name=? '
+        'AND state IN (?,?)',
+        (name, UpgradeState.ROLLING.value,
+         UpgradeState.PAUSED.value))
+    return db.cursor.rowcount > 0
+
+
+def request_upgrade_abort(name: str) -> bool:
+    """Abort == roll back: the machine drains the already-upgraded
+    replicas and relaunches them on the prior version. A
+    ROLLING_BACK upgrade is refused (already doing what abort asks —
+    accepting the flag would be a confirmed no-op the machine never
+    reads)."""
+    db = _db()
+    db.execute_and_commit(
+        'UPDATE upgrades SET abort_requested=1 WHERE service_name=? '
+        'AND state IN (?,?)',
+        (name, UpgradeState.ROLLING.value,
+         UpgradeState.PAUSED.value))
+    return db.cursor.rowcount > 0
+
+
+def clear_upgrade(name: str) -> None:
+    _db().execute_and_commit(
+        'DELETE FROM upgrades WHERE service_name=?', (name,))

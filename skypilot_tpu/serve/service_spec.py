@@ -32,6 +32,8 @@ class SkyServiceSpec:
         engine_block_size: Optional[int] = None,
         engine_num_blocks: Optional[int] = None,
         engine_max_num_batched_tokens: Optional[int] = None,
+        upgrade_drain_grace_seconds: Optional[float] = None,
+        upgrade_soak_seconds: Optional[float] = None,
     ):
         if min_replicas < 0:
             raise exceptions.InvalidSpecError('min_replicas must be '
@@ -107,6 +109,24 @@ class SkyServiceSpec:
         self.engine_num_blocks = engine_num_blocks
         self.engine_max_num_batched_tokens = \
             engine_max_num_batched_tokens
+        # Rolling-upgrade knobs (``upgrade:`` YAML section,
+        # docs/upgrades.md): per-service drain grace (how long
+        # in-flight requests get to finish before a draining replica
+        # is terminated anyway) and soak (how long each promoted
+        # replica serves behind the alert gate before the next one
+        # migrates). None falls back to the
+        # SKYTPU_SERVE_DRAIN_GRACE_SECONDS /
+        # SKYTPU_SERVE_UPGRADE_SOAK_SECONDS env defaults.
+        if upgrade_drain_grace_seconds is not None and \
+                upgrade_drain_grace_seconds < 0:
+            raise exceptions.InvalidSpecError(
+                'upgrade.drain_grace_seconds must be >= 0')
+        if upgrade_soak_seconds is not None and \
+                upgrade_soak_seconds < 0:
+            raise exceptions.InvalidSpecError(
+                'upgrade.soak_seconds must be >= 0')
+        self.upgrade_drain_grace_seconds = upgrade_drain_grace_seconds
+        self.upgrade_soak_seconds = upgrade_soak_seconds
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]
@@ -125,6 +145,7 @@ class SkyServiceSpec:
         tls = dict(config.pop('tls', {}) or {})
         slo = dict(config.pop('slo', {}) or {})
         engine = dict(config.pop('engine', {}) or {})
+        upgrade = dict(config.pop('upgrade', {}) or {})
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown service fields: {sorted(config)}')
@@ -156,6 +177,9 @@ class SkyServiceSpec:
             engine_num_blocks=engine.get('num_blocks'),
             engine_max_num_batched_tokens=engine.get(
                 'max_num_batched_tokens'),
+            upgrade_drain_grace_seconds=upgrade.get(
+                'drain_grace_seconds'),
+            upgrade_soak_seconds=upgrade.get('soak_seconds'),
         )
 
     def engine_env(self) -> Dict[str, str]:
@@ -215,4 +239,12 @@ class SkyServiceSpec:
                 self.engine_max_num_batched_tokens
         if engine:
             out['engine'] = engine
+        upgrade = {}
+        if self.upgrade_drain_grace_seconds is not None:
+            upgrade['drain_grace_seconds'] = \
+                self.upgrade_drain_grace_seconds
+        if self.upgrade_soak_seconds is not None:
+            upgrade['soak_seconds'] = self.upgrade_soak_seconds
+        if upgrade:
+            out['upgrade'] = upgrade
         return out

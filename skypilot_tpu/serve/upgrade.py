@@ -1,0 +1,557 @@
+"""Rolling replica upgrades for serve (docs/upgrades.md).
+
+The state machine the serve controller drives one step per control
+tick: replicas migrate to the target version ONE AT A TIME through
+
+    drain → relaunch-on-new-version → re-probe → soak/promote
+
+and every transition is persisted in ``serve_state`` (the
+``upgrades`` table) BEFORE it takes effect, so a controller crash at
+any step resumes exactly where it stopped instead of orphaning a
+half-upgraded fleet.
+
+Drain is cooperative: a DRAINING replica leaves the LB's ready set
+(no new requests route to it) while its in-flight requests finish —
+the machine terminates it only when the LB's per-endpoint in-flight
+count reaches zero, or after a bounded grace
+(``SKYTPU_SERVE_DRAIN_GRACE_SECONDS`` / the spec's
+``upgrade.drain_grace_seconds``). An upgrade therefore sheds zero
+requests.
+
+The whole loop is ALERT-GUARDED: on every step while ROLLING, the
+controller's alert engine is consulted; a firing page
+(``alerts.builtin.PAGE_RULE_IDS`` — slo-burn-rate, replica-5xx-rate,
+lb-no-ready-replica) auto-pauses the rollout and rolls the upgraded
+replicas back to the prior version, journaling the decision with the
+page's exemplar trace_id — `xsky trace <id>` shows the exact request
+behind the rollback. Rollback itself is NOT gated (it must not be
+blocked by the page it is fixing) and reuses the same per-replica
+machine with the direction reversed.
+
+Operator controls (``xsky serve upgrade NAME --pause/--resume/
+--abort``) are flags on the persisted row; the controller acts on
+them on its next tick — they work against a remote controller the
+same way ``serve down`` does.
+"""
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.alerts import builtin as alerts_builtin
+from skypilot_tpu.alerts import journal as journal_lib
+# One SKYTPU_* float-parsing behavior repo-wide (same helper the
+# metrics history bounds use).
+from skypilot_tpu.metrics.history import _env_float
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import (ReplicaStatus,
+                                            UpgradePhase,
+                                            UpgradeState)
+
+logger = tpu_logging.init_logger(__name__)
+
+# Bounded drain: in-flight requests get this long to finish before
+# the old replica is terminated anyway (a wedged client must not
+# stall the rollout forever).
+DEFAULT_DRAIN_GRACE_SECONDS = 120.0
+# Soak between promotions: how long a freshly-READY replacement
+# serves behind the alert gate before the machine moves to the next
+# replica (and before the final promotion marks the upgrade
+# SUCCEEDED) — the window in which a bad version's 5xx storm trips
+# the page and rolls back.
+DEFAULT_SOAK_SECONDS = 30.0
+
+
+def drain_grace_seconds(spec=None) -> float:
+    v = getattr(spec, 'upgrade_drain_grace_seconds', None) \
+        if spec is not None else None
+    if v is not None:
+        return float(v)
+    return _env_float('SKYTPU_SERVE_DRAIN_GRACE_SECONDS',
+                      DEFAULT_DRAIN_GRACE_SECONDS)
+
+
+def soak_seconds(spec=None) -> float:
+    v = getattr(spec, 'upgrade_soak_seconds', None) \
+        if spec is not None else None
+    if v is not None:
+        return float(v)
+    return _env_float('SKYTPU_SERVE_UPGRADE_SOAK_SECONDS',
+                      DEFAULT_SOAK_SECONDS)
+
+
+def probe_grace_seconds(spec=None) -> float:
+    """How long a relaunched replacement may take to turn READY
+    before the rollout declares it bad. Defaults to the spec's
+    readiness initial delay plus margin (provision + weight load)."""
+    env = os.environ.get('SKYTPU_SERVE_UPGRADE_PROBE_GRACE_SECONDS')
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    initial = float(getattr(spec, 'initial_delay_seconds', 300)
+                    or 300) if spec is not None else 300.0
+    return initial + 60.0
+
+
+class RollingUpgrader:
+    """Drives one service's persisted upgrade row.
+
+    Collaborators are injected so the machine is testable without a
+    cloud: the replica manager launches/drains/terminates, the load
+    balancer reports per-endpoint in-flight counts, the alert engine
+    supplies the page gate + exemplar trace ids, and
+    ``on_version_restored`` lets the controller re-adopt the prior
+    version when a rollback begins."""
+
+    def __init__(self, service_name: str, replica_manager,
+                 load_balancer, alert_engine,
+                 on_version_restored: Optional[
+                     Callable[[int], bool]] = None,
+                 clock: Callable[[], float] = time.time):
+        self.service_name = service_name
+        self.replica_manager = replica_manager
+        self.load_balancer = load_balancer
+        self.alert_engine = alert_engine
+        self.on_version_restored = on_version_restored
+        self._clock = clock
+
+    # -- queries --------------------------------------------------------
+
+    def record(self) -> Optional[Dict[str, Any]]:
+        return serve_state.get_upgrade(self.service_name)
+
+    def active(self) -> bool:
+        rec = self.record()
+        return rec is not None and not rec['state'].is_terminal()
+
+    # -- the per-tick step ----------------------------------------------
+
+    def step(self, records: List[Dict[str, Any]],
+             rec: Optional[Dict[str, Any]] = None) -> None:
+        """Advance the machine by (at most) one transition. Never
+        raises into the control tick. ``rec`` lets the caller pass
+        an already-fetched upgrade row (the controller reads it once
+        per tick)."""
+        try:
+            self._step(records, rec)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('upgrade step failed')
+
+    def _step(self, records: List[Dict[str, Any]],
+              rec: Optional[Dict[str, Any]] = None) -> None:
+        if rec is None:
+            rec = self.record()
+        if rec is None or rec['state'].is_terminal():
+            return
+        state = rec['state']
+        if state == UpgradeState.PAUSED:
+            if rec['abort_requested']:
+                self._begin_rollback(rec, reason='operator-abort')
+            elif not rec['pause_requested']:
+                logger.info('Upgrade %s resumed.', self.service_name)
+                updates: Dict[str, Any] = {
+                    'state': UpgradeState.ROLLING,
+                    'paused_reason': None}
+                if rec['phase'] is not None:
+                    # Time spent PAUSED must not count against the
+                    # in-phase timers: an hour-long pause in PROBE
+                    # would otherwise read as 'replacement stuck'
+                    # and roll back a healthy rollout on the resume
+                    # tick (and a pause in SOAK would skip the
+                    # alert-gate soak entirely).
+                    updates['phase_started_at'] = self._clock()
+                serve_state.update_upgrade(self.service_name,
+                                           **updates)
+            return
+        if state == UpgradeState.ROLLING:
+            if rec['abort_requested']:
+                self._begin_rollback(rec, reason='operator-abort')
+                return
+            if rec['pause_requested']:
+                self._pause(rec, reason='operator')
+                return
+            page = self._firing_page()
+            if page is not None:
+                # The page IS the decision: journal pause+rollback
+                # with its exemplar trace, then reverse course.
+                exemplar = self._page_exemplar(page)
+                self.alert_engine.note_action(
+                    page, 'upgrade-pause',
+                    from_version=rec['from_version'],
+                    to_version=rec['to_version'])
+                logger.warning(
+                    'Upgrade %s v%d->v%d: page alert %s firing — '
+                    'auto-pausing and rolling back (exemplar trace '
+                    '%s).', self.service_name, rec['from_version'],
+                    rec['to_version'], page, exemplar or '-')
+                self._begin_rollback(rec, reason=f'alert:{page}',
+                                     exemplar=exemplar, rule=page)
+                return
+            self._advance(rec, records, target=rec['to_version'],
+                          gated=True)
+            return
+        if state == UpgradeState.ROLLING_BACK:
+            self._advance(rec, records, target=rec['from_version'],
+                          gated=False)
+
+    # -- helpers --------------------------------------------------------
+
+    def _firing_page(self) -> Optional[str]:
+        firing = {a['rule'] for a in self.alert_engine.firing()}
+        pages = sorted(firing &
+                       set(alerts_builtin.PAGE_RULE_IDS))
+        return pages[0] if pages else None
+
+    def _page_exemplar(self, rule: str) -> Optional[str]:
+        entry = next((a for a in self.alert_engine.firing()
+                      if a['rule'] == rule), None)
+        return entry.get('exemplar_trace_id') if entry else None
+
+    def _spec(self):
+        return self.replica_manager.spec
+
+    def _pause(self, rec: Dict[str, Any], reason: str) -> None:
+        # A replica caught mid-drain goes back into rotation: PAUSED
+        # must hold the fleet steady, never leave a replica stranded
+        # out of routing. The cycle cursor (phase/current/
+        # replacement) is KEPT — resume re-enters the DRAIN phase,
+        # whose re-drain guard handles the undrained replica.
+        # Clearing it would orphan a surge cycle's already-launched
+        # READY replacement: a fresh cycle would launch a second one
+        # and finish the upgrade one replica over target.
+        if rec['phase'] == UpgradePhase.DRAIN and \
+                rec['current_replica'] is not None:
+            self.replica_manager.undrain(rec['current_replica'])
+        logger.info('Upgrade %s paused (%s).', self.service_name,
+                    reason)
+        serve_state.update_upgrade(self.service_name,
+                                   state=UpgradeState.PAUSED,
+                                   paused_reason=reason)
+
+    def _begin_rollback(self, rec: Dict[str, Any], reason: str,
+                        exemplar: Optional[str] = None,
+                        rule: Optional[str] = None) -> None:
+        """Reverse course: the same per-replica machine now migrates
+        every ``to_version`` replica back to ``from_version``."""
+        if self.on_version_restored is not None and \
+                not self.on_version_restored(rec['from_version']):
+            # The prior version cannot be materialized (no recorded
+            # task yaml): HALT honestly instead of relaunching the
+            # new version relabeled as the old one. pause_requested
+            # pins the PAUSED state until the operator intervenes
+            # (restore the yaml + --resume, or --abort... which
+            # needs the same yaml — so realistically: fix, resume).
+            logger.error(
+                'Upgrade %s: rollback to v%d requested (%s) but the '
+                'prior version cannot be materialized; PAUSING for '
+                'operator intervention.', self.service_name,
+                rec['from_version'], reason)
+            if rec['phase'] == UpgradePhase.DRAIN and \
+                    rec['current_replica'] is not None:
+                self.replica_manager.undrain(rec['current_replica'])
+            serve_state.update_upgrade(
+                self.service_name, state=UpgradeState.PAUSED,
+                pause_requested=1, abort_requested=0,
+                phase=None, current_replica=None,
+                replacement_replica=None, phase_started_at=None,
+                paused_reason=('rollback-unavailable: no recorded '
+                               f'task for v{rec["from_version"]} '
+                               f'({reason})'))
+            return
+        updates: Dict[str, Any] = {
+            'state': UpgradeState.ROLLING_BACK,
+            'rollback_reason': reason, 'paused_reason': None,
+            'abort_requested': 0, 'pause_requested': 0,
+        }
+        if exemplar:
+            updates['exemplar_trace_id'] = exemplar
+        phase = rec['phase']
+        if phase == UpgradePhase.DRAIN and \
+                rec['current_replica'] is not None:
+            # The old-version replica being drained is already on the
+            # rollback's TARGET version — put it back in rotation.
+            self.replica_manager.undrain(rec['current_replica'])
+            updates.update(phase=None, current_replica=None,
+                           replacement_replica=None,
+                           phase_started_at=None)
+        elif phase == UpgradePhase.RELAUNCH:
+            if rec['surge']:
+                # Surge ordering: the old replica is still alive and
+                # serving (drain comes last) — nothing to restore;
+                # any already-launched replacement becomes an
+                # ordinary rollback victim via version selection.
+                updates.update(phase=None, current_replica=None,
+                               replacement_replica=None,
+                               phase_started_at=None)
+            else:
+                # Old replica already terminated, replacement not
+                # yet launched: keep the RELAUNCH phase — with the
+                # direction reversed it relaunches on from_version,
+                # restoring the fleet size.
+                updates.update(phase_started_at=self._clock())
+        elif phase in (UpgradePhase.PROBE, UpgradePhase.SOAK):
+            # The replacement is a to_version replica: clear the
+            # per-replica cursor and let victim selection pick it up
+            # as an ordinary rollback target.
+            updates.update(phase=None, current_replica=None,
+                           replacement_replica=None,
+                           phase_started_at=None)
+        # NOTE: the successful on_version_restored call already
+        # happened in the guard above — the controller has adopted
+        # the prior version by the time the row flips to
+        # ROLLING_BACK.
+        serve_state.update_upgrade(self.service_name, **updates)
+        if rule is None:
+            journal_lib.append_event({
+                'kind': 'action', 'action': 'upgrade-rollback',
+                'rule': 'operator', 'scope':
+                    f'service-{self.service_name}',
+                'service': self.service_name, 'reason': reason,
+                'from_version': rec['from_version'],
+                'to_version': rec['to_version'],
+                'exemplar_trace_id': exemplar,
+                'ts': self._clock()})
+        else:
+            self.alert_engine.note_action(
+                rule, 'upgrade-rollback', reason=reason,
+                from_version=rec['from_version'],
+                to_version=rec['to_version'])
+
+    def _victim(self, records: List[Dict[str, Any]],
+                target: int) -> Optional[int]:
+        """Lowest-id replica still on the wrong version (skipping
+        anything already leaving)."""
+        for r in records:
+            if r['version'] == target:
+                continue
+            if r['status'].is_terminal() or r['status'] in (
+                    ReplicaStatus.SHUTTING_DOWN,):
+                continue
+            return r['replica_id']
+        return None
+
+    def _record_of(self, records: List[Dict[str, Any]],
+                   replica_id: Optional[int]
+                   ) -> Optional[Dict[str, Any]]:
+        if replica_id is None:
+            return None
+        return next((r for r in records
+                     if r['replica_id'] == replica_id), None)
+
+    def _advance(self, rec: Dict[str, Any],
+                 records: List[Dict[str, Any]], target: int,
+                 gated: bool) -> None:
+        now = self._clock()
+        phase = rec['phase']
+        spec = self._spec()
+
+        if phase is None:
+            victim = self._victim(records, target)
+            if victim is None:
+                self._finish(rec, gated)
+                return
+            victim_rec = self._record_of(records, victim)
+            # SURGE ordering when draining would empty the ready set
+            # (replicas=1, or a degraded fleet down to one READY):
+            # launch the replacement FIRST and drain the old replica
+            # only once the new one is READY — drain-first would
+            # 503 every request, and the resulting
+            # lb-no-ready-replica page would roll back every
+            # attempt, making a singleton service unupgradeable.
+            ready = [r for r in records
+                     if r['status'] == ReplicaStatus.READY]
+            surge = (len(ready) <= 1 and victim_rec is not None and
+                     victim_rec['status'] == ReplicaStatus.READY)
+            logger.info(
+                'Upgrade %s: replica %d -> v%d (%s).',
+                self.service_name, victim, target,
+                'surge: relaunch before drain' if surge
+                else 'drain starts')
+            serve_state.update_upgrade(
+                self.service_name,
+                phase=(UpgradePhase.RELAUNCH if surge
+                       else UpgradePhase.DRAIN),
+                current_replica=victim, replacement_replica=None,
+                surge=int(surge),
+                # The replacement inherits the victim's spot-ness:
+                # the fallback autoscalers' spot/on-demand mix must
+                # survive the rollout (an all-default relaunch would
+                # exit the upgrade all-spot and churn the fleet once
+                # normal ticks resume).
+                replacement_use_spot=(
+                    int(victim_rec['use_spot'])
+                    if victim_rec is not None else None),
+                phase_started_at=now)
+            if not surge:
+                self.replica_manager.drain(victim)
+            return
+
+        if phase == UpgradePhase.DRAIN:
+            current = self._record_of(records, rec['current_replica'])
+            if current is not None and \
+                    current['status'] not in (
+                        ReplicaStatus.DRAINING,
+                        ReplicaStatus.SHUTTING_DOWN) and \
+                    not current['status'].is_terminal():
+                # Crash landed between persisting DRAIN and the
+                # drain call: re-issue it (idempotent).
+                self.replica_manager.drain(rec['current_replica'])
+                return
+            endpoint = current['endpoint'] if current else None
+            inflight = (self.load_balancer.inflight_count(endpoint)
+                        if endpoint else 0)
+            overdue = (rec['phase_started_at'] is not None and
+                       now - rec['phase_started_at'] >
+                       drain_grace_seconds(spec))
+            if current is None or inflight == 0 or overdue:
+                if overdue and inflight:
+                    logger.warning(
+                        'Upgrade %s: replica %s drain grace expired '
+                        'with %d request(s) still in flight; '
+                        'terminating anyway.', self.service_name,
+                        rec['current_replica'], inflight)
+                if current is not None:
+                    # (scale_down's on_endpoint_removed hook drops
+                    # the endpoint's LB in-flight series — one
+                    # removal path, wired by the controller.)
+                    self.replica_manager.scale_down(
+                        [rec['current_replica']])
+                serve_state.update_upgrade(
+                    self.service_name,
+                    # Surge ordering already launched + probed the
+                    # replacement before this drain — go straight
+                    # to its soak.
+                    phase=(UpgradePhase.SOAK if rec['surge']
+                           else UpgradePhase.RELAUNCH),
+                    phase_started_at=now)
+            return
+
+        if phase == UpgradePhase.RELAUNCH:
+            # Exactly-once across crashes (no double-billing
+            # zombie): the replacement's replica id is reserved and
+            # PERSISTED before the launch, so a restarted controller
+            # finding a replica record under the persisted id knows
+            # the launch already happened — and finding none knows
+            # it safely hasn't.
+            new_id = rec['replacement_replica']
+            if new_id is None:
+                new_id = self.replica_manager.reserve_replica_ids(
+                    1)[0]
+                serve_state.update_upgrade(
+                    self.service_name, replacement_replica=new_id)
+            if serve_state.get_replica(self.service_name,
+                                       new_id) is None:
+                self.replica_manager.scale_up(
+                    1, version=target, replica_ids=[new_id],
+                    use_spot=rec['replacement_use_spot'])
+                logger.info('Upgrade %s: replacement replica %d '
+                            'launching at v%d.', self.service_name,
+                            new_id, target)
+            else:
+                logger.info(
+                    'Upgrade %s: replacement replica %d already '
+                    'launched (resume).', self.service_name, new_id)
+            serve_state.update_upgrade(
+                self.service_name, phase=UpgradePhase.PROBE,
+                phase_started_at=now)
+            return
+
+        if phase == UpgradePhase.PROBE:
+            rep = self._record_of(records,
+                                  rec['replacement_replica'])
+            failed = rep is None or rep['status'].is_terminal()
+            stuck = (rec['phase_started_at'] is not None and
+                     now - rec['phase_started_at'] >
+                     probe_grace_seconds(spec))
+            if rep is not None and \
+                    rep['status'] == ReplicaStatus.READY:
+                if rec['surge']:
+                    # Replacement is READY and serving: NOW the old
+                    # replica can drain without emptying the ready
+                    # set.
+                    serve_state.update_upgrade(
+                        self.service_name, phase=UpgradePhase.DRAIN,
+                        phase_started_at=now)
+                    self.replica_manager.drain(
+                        rec['current_replica'])
+                else:
+                    serve_state.update_upgrade(
+                        self.service_name, phase=UpgradePhase.SOAK,
+                        phase_started_at=now)
+                return
+            if failed or stuck:
+                if rep is not None:
+                    # Purge the bad/stuck replacement NOW — its
+                    # cluster must not keep billing under a rollout
+                    # that already gave up on it.
+                    self.replica_manager.scale_down(
+                        [rec['replacement_replica']])
+                if gated:
+                    reason = ('replacement-failed' if failed
+                              else 'replacement-probe-timeout')
+                    logger.warning(
+                        'Upgrade %s: replacement replica %s %s — '
+                        'rolling back.', self.service_name,
+                        rec['replacement_replica'], reason)
+                    self._begin_rollback(rec, reason=reason)
+                else:
+                    # Rollback must converge: relaunch the prior
+                    # version until it sticks.
+                    serve_state.update_upgrade(
+                        self.service_name,
+                        phase=UpgradePhase.RELAUNCH,
+                        replacement_replica=None,
+                        phase_started_at=now)
+            return
+
+        if phase == UpgradePhase.SOAK:
+            hold = soak_seconds(spec) if gated else 0.0
+            if rec['phase_started_at'] is not None and \
+                    now - rec['phase_started_at'] < hold:
+                return
+            promoted = rec['replacement_replica']
+            upgraded = set(rec['upgraded'])
+            if promoted is not None:
+                upgraded.add(promoted)
+            logger.info('Upgrade %s: replica %s promoted (%d done).',
+                        self.service_name, promoted, len(upgraded))
+            serve_state.update_upgrade(
+                self.service_name, phase=None, current_replica=None,
+                replacement_replica=None, phase_started_at=None,
+                upgraded=upgraded)
+
+    def _finish(self, rec: Dict[str, Any], gated: bool) -> None:
+        if gated:
+            logger.info('Upgrade %s: v%d -> v%d SUCCEEDED.',
+                        self.service_name, rec['from_version'],
+                        rec['to_version'])
+            serve_state.update_upgrade(
+                self.service_name, state=UpgradeState.SUCCEEDED,
+                phase=None, current_replica=None,
+                replacement_replica=None)
+            journal_lib.append_event({
+                'kind': 'action', 'action': 'upgrade-complete',
+                'scope': f'service-{self.service_name}',
+                'service': self.service_name,
+                'from_version': rec['from_version'],
+                'to_version': rec['to_version'],
+                'ts': self._clock()})
+        else:
+            logger.warning('Upgrade %s: rolled back to v%d (%s).',
+                           self.service_name, rec['from_version'],
+                           rec['rollback_reason'])
+            serve_state.update_upgrade(
+                self.service_name, state=UpgradeState.ROLLED_BACK,
+                phase=None, current_replica=None,
+                replacement_replica=None)
+            journal_lib.append_event({
+                'kind': 'action', 'action': 'upgrade-rolled-back',
+                'scope': f'service-{self.service_name}',
+                'service': self.service_name,
+                'reason': rec['rollback_reason'],
+                'from_version': rec['from_version'],
+                'to_version': rec['to_version'],
+                'exemplar_trace_id': rec['exemplar_trace_id'],
+                'ts': self._clock()})

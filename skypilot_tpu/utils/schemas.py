@@ -156,6 +156,17 @@ SERVICE_SCHEMA = {
                                            'minimum': 1},
             },
         },
+        # Rolling-upgrade knobs (serve/upgrade.py,
+        # docs/upgrades.md).
+        'upgrade': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'drain_grace_seconds': {'type': 'number',
+                                        'minimum': 0},
+                'soak_seconds': {'type': 'number', 'minimum': 0},
+            },
+        },
     },
 }
 

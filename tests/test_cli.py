@@ -268,6 +268,16 @@ class TestCliGroups:
             assert 'clisvc' in st.output
             st1 = runner.invoke(cli.cli, ['serve', 'status', 'clisvc'])
             assert st1.exit_code == 0
+            # Upgrade surface round-trips through the controller
+            # codegen RPC (docs/upgrades.md): no upgrade yet, and
+            # controls refuse when there is nothing to control.
+            up = runner.invoke(cli.cli, ['serve', 'upgrade',
+                                         'clisvc'])
+            assert up.exit_code == 0, up.output
+            assert 'no upgrade has run' in up.output
+            pz = runner.invoke(cli.cli, ['serve', 'upgrade',
+                                         'clisvc', '--pause'])
+            assert pz.exit_code != 0  # no active upgrade
             # Controller logs stream through the controller-cluster
             # job channel (--no-follow: the controller job runs
             # until the service goes down).

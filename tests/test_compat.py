@@ -1,19 +1,39 @@
-"""Backward compatibility of on-disk state (ROADMAP item 5 down
-payment): OLD-schema state DBs — written by earlier releases, before
-the fencing / resume_step / trace_id / resume_mesh columns and before
-the provision_breadcrumbs table existed — must upgrade IN PLACE on
-first touch (the idempotent ``add_column_to_table`` migrations), or
-fail with a TYPED error on a corrupt file. Never a hang: every sqlite
-connection carries a bounded lock timeout, and every test here runs
-under a wall-clock budget assertion.
+"""The version-skew compatibility tier (ROADMAP item 5,
+docs/upgrades.md).
+
+Two surfaces, one contract — every cross-version call **completes,
+upgrades in place, or fails typed; never hangs**:
+
+- **on-disk state**: OLD-schema state DBs — written by earlier
+  releases, before the fencing / resume_step / trace_id /
+  resume_mesh columns, the provision_breadcrumbs table, or the serve
+  upgrades tables existed — must upgrade IN PLACE on first touch
+  (the idempotent migrations), or fail with a TYPED error on a
+  corrupt file;
+- **agent RPCs**: a pinned ``SKYTPU_AGENT_VERSION_OVERRIDE`` makes a
+  REAL agent process behave as an old protocol version (old
+  endpoints only — the emulation gates behavior, not just the
+  advertised string), and every ``AgentClient`` RPC
+  (run/exec/status/metrics/profile) against it either completes,
+  falls back (profile → /put trigger), or raises
+  ``AgentVersionError`` naming both versions + the recovery command.
+
+Every test runs under a wall-clock budget assertion.
 """
+import json
 import os
+import socket
 import sqlite3
+import subprocess
 import time
 
 import pytest
 
+from skypilot_tpu import exceptions
 from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.runtime import agent as agent_mod
+from skypilot_tpu.runtime import agent_client
+from skypilot_tpu.runtime.agent_client import AgentClient
 
 # Any schema upgrade or typed failure must land well inside this
 # (sqlite's lock timeout is 10 s; migrations are milliseconds).
@@ -199,9 +219,10 @@ class TestGlobalStateDbMigrations:
 
 class TestServeStateDbMigrations:
     """serve_state.db: a pre-fencing services table gains the fence
-    columns in place."""
+    columns in place; a pre-rolling-upgrades DB gains the upgrades +
+    service_versions tables in place."""
 
-    def test_pre_fencing_services_upgrades(self):
+    def _write_legacy_db(self):
         from skypilot_tpu.serve import serve_state
         path = serve_state._db_path()  # pylint: disable=protected-access
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -219,6 +240,11 @@ class TestServeStateDbMigrations:
             "VALUES ('legacy-svc', 'READY', 1700000000.0)")
         conn.commit()
         conn.close()
+        return path
+
+    def test_pre_fencing_services_upgrades(self):
+        from skypilot_tpu.serve import serve_state
+        path = self._write_legacy_db()
         before = _columns(path, 'services')
         assert 'status_fenced' not in before
         svc = serve_state.get_service('legacy-svc')
@@ -226,3 +252,323 @@ class TestServeStateDbMigrations:
         after = _columns(path, 'services')
         assert {'status_fenced', 'status_epoch',
                 'status_writer_pid'} <= after
+
+    def test_pre_upgrades_db_gains_upgrade_tables(self):
+        """A serve DB from before the rolling-upgrade tier: first
+        touch creates the upgrades + service_versions tables and the
+        full upgrade-state API works against the migrated file, the
+        legacy service row intact."""
+        from skypilot_tpu.serve import serve_state
+        t0 = time.monotonic()
+        path = self._write_legacy_db()
+        # First touch migrates.
+        assert serve_state.get_upgrade('legacy-svc') is None
+        cols = _columns(path, 'upgrades')
+        assert {'service_name', 'from_version', 'to_version',
+                'state', 'phase', 'upgraded_json',
+                'exemplar_trace_id'} <= cols
+        assert 'task_yaml' in _columns(path, 'service_versions')
+        serve_state.start_upgrade('legacy-svc', 1, 2)
+        serve_state.add_service_version('legacy-svc', 2,
+                                        '/tmp/v2.yaml')
+        rec = serve_state.get_upgrade('legacy-svc')
+        assert rec['state'] == serve_state.UpgradeState.ROLLING
+        assert serve_state.get_service_version_yaml(
+            'legacy-svc', 2) == '/tmp/v2.yaml'
+        svc = serve_state.get_service('legacy-svc')
+        assert svc['status'] == serve_state.ServiceStatus.READY
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+
+# -- agent RPC version-skew tier ---------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _cpp_agent_available() -> bool:
+    return agent_client.resolve_agent_binary() is not None
+
+
+class _PinnedAgent:
+    """A REAL agent process pinned to an old protocol version via
+    SKYTPU_AGENT_VERSION_OVERRIDE — it ADVERTISES the pin on /health
+    and BEHAVES like it (endpoints newer than the pin 404, /status
+    drops its long-poll), so these tests exercise genuine
+    old-agent/new-client skew."""
+
+    def __init__(self, version, runtime_dir, impl='py'):
+        self.version = version
+        self.port = _free_port()
+        env = dict(os.environ)
+        env['SKYTPU_AGENT_VERSION_OVERRIDE'] = version
+        env['SKYTPU_RUNTIME_DIR'] = str(runtime_dir)
+        env.pop('SKYTPU_AGENT_TOKEN', None)
+        if impl == 'cpp':
+            cmd = [agent_client.resolve_agent_binary(),
+                   '--port', str(self.port)]
+        else:
+            cmd = ['python', '-m', 'skypilot_tpu.runtime.agent',
+                   '--port', str(self.port), '--host', '127.0.0.1']
+        self.proc = subprocess.Popen(cmd, env=env,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+        self.client = AgentClient('127.0.0.1', self.port)
+        self.client.wait_healthy(timeout=15)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+@pytest.fixture(params=['py', 'cpp'])
+def agent_impl(request):
+    if request.param == 'cpp' and not _cpp_agent_available():
+        pytest.skip('C++ agent not built')
+    return request.param
+
+
+class TestAgentVersionSkew:
+    """Old-agent/new-client over every AgentClient RPC: each call
+    completes, upgrades in place (profile's /put fallback), or fails
+    typed — never hangs (wall-clock budget on every path)."""
+
+    def test_v1_agent_full_rpc_surface(self, tmp_path, agent_impl):
+        t0 = time.monotonic()
+        with _PinnedAgent('1', tmp_path, agent_impl) as agent:
+            client = agent.client
+            assert client.version() == '1'
+            # v1 surface COMPLETES: run → status → kill → exec →
+            # read.
+            log = str(tmp_path / 'job.log')
+            proc_id = client.run('echo skew-ok', log)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                st = client.status(proc_id)
+                if not st['running']:
+                    break
+                time.sleep(0.1)
+            assert st['returncode'] == 0
+            assert b'skew-ok' in client.read_file(log)
+            out = client.exec('echo exec-ok')
+            assert out['returncode'] == 0
+            assert 'exec-ok' in out['output']
+            assert client.kill(proc_id)  # idempotent on dead proc
+            # status long-poll DEGRADES, never hangs: a pre-v2 agent
+            # ignores wait= and answers instantly.
+            t_poll = time.monotonic()
+            pid2 = client.run('sleep 30', str(tmp_path / 's.log'))
+            st = client.status(pid2, wait=8.0)
+            assert time.monotonic() - t_poll < 5.0, \
+                'pre-v2 /status held the long-poll'
+            assert st['running']
+            client.kill(pid2)
+            # /metrics predates v3: typed, names both versions + the
+            # recovery command.
+            with pytest.raises(exceptions.AgentVersionError) as ei:
+                client.metrics()
+            msg = str(ei.value)
+            assert '1' in msg and agent_mod.AGENT_VERSION in msg
+            assert 'xsky launch' in msg or 'relaunch' in msg
+            assert ei.value.agent_version == '1'
+            assert ei.value.client_version == agent_mod.AGENT_VERSION
+            # /profile predates v4: UPGRADES IN PLACE through the
+            # /put trigger-file fallback when the runtime dir is
+            # known...
+            out = client.profile(steps=3,
+                                 runtime_dir=str(tmp_path))
+            assert out['ok']
+            trigger = os.path.join(str(tmp_path), 'profiles',
+                                   'trigger.json')
+            assert os.path.exists(trigger)
+            with open(trigger, encoding='utf-8') as f:
+                assert json.load(f)['steps'] == 3
+            # ...and fails TYPED when the fallback also misses.
+            with pytest.raises(exceptions.AgentVersionError):
+                client.profile(steps=3)
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_v3_agent_metrics_without_textfiles(self, tmp_path,
+                                                agent_impl):
+        """v3 serves /metrics (own gauges) but predates textfile
+        ingestion and /profile: the scrape works, the compute series
+        stay absent, profile falls back to /put."""
+        t0 = time.monotonic()
+        metrics_dir = tmp_path / 'metrics.d'
+        metrics_dir.mkdir()
+        (metrics_dir / 'train.prom').write_text(
+            '# HELP skytpu_goodput_ratio g\n'
+            '# TYPE skytpu_goodput_ratio gauge\n'
+            'skytpu_goodput_ratio 0.9\n')
+        with _PinnedAgent('3', tmp_path, agent_impl) as agent:
+            client = agent.client
+            text = client.metrics()
+            assert 'skytpu_agent_uptime_seconds' in text
+            assert 'skytpu_goodput_ratio' not in text  # pre-v4
+            out = client.profile(steps=2,
+                                 runtime_dir=str(tmp_path))
+            assert out['ok']
+        # The CURRENT agent ingests the same textfile (the emulation
+        # gates behavior, not just the version string).
+        with _PinnedAgent(agent_mod.AGENT_VERSION, tmp_path,
+                          agent_impl) as agent:
+            assert 'skytpu_goodput_ratio' in agent.client.metrics()
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_new_agent_old_client_surface(self, tmp_path,
+                                          agent_impl):
+        """The inverse skew: an old client (speaking only the v1-era
+        endpoints, no wait=, no /metrics, no /profile) against the
+        CURRENT agent — every old call still completes (protocol
+        growth is strictly additive)."""
+        t0 = time.monotonic()
+        with _PinnedAgent(agent_mod.AGENT_VERSION, tmp_path,
+                          agent_impl) as agent:
+            client = agent.client
+            log = str(tmp_path / 'old.log')
+            proc_id = client.run('echo old-client-ok', log)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                st = client.status(proc_id)  # v1-style: no wait=
+                if not st['running']:
+                    break
+                time.sleep(0.1)
+            assert st['returncode'] == 0
+            assert b'old-client-ok' in client.read_file(log)
+            assert client.exec('true')['returncode'] == 0
+            assert client.kill(proc_id)
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_dotted_pin_parses_leading_version(self, monkeypatch):
+        """'3.1' must gate as v3 (first digit run) — concatenating
+        digits would read 31 and silently enable v4+ features,
+        exactly the relabeled-current-agent failure the emulation
+        exists to prevent."""
+        monkeypatch.setenv('SKYTPU_AGENT_VERSION_OVERRIDE', '3.1')
+        assert agent_mod.served_version_num() == 3
+        assert agent_mod.feature_enabled(3)
+        assert not agent_mod.feature_enabled(4)
+        monkeypatch.setenv('SKYTPU_AGENT_VERSION_OVERRIDE',
+                           'v2-patch9')
+        assert agent_mod.served_version_num() == 2
+
+    def test_unparseable_pin_reads_as_ancient(self, tmp_path):
+        """An override with no digits ('v-old') must emulate 'very
+        old', never silently current — fail-closed for skew drills."""
+        t0 = time.monotonic()
+        with _PinnedAgent('v-old', tmp_path) as agent:
+            assert agent.client.version() == 'v-old'
+            with pytest.raises(exceptions.AgentVersionError):
+                agent.client.metrics()
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+
+class TestHandshakeSkewError:
+    """The reuse-handshake mismatch error (tpu_backend) when no
+    in-place upgrade is possible: typed AgentVersionError naming
+    BOTH versions and the concrete recovery commands."""
+
+    def test_error_names_versions_and_recovery(self, monkeypatch):
+        from skypilot_tpu.backends.tpu_backend import TpuBackend
+        from skypilot_tpu.provision import instance_setup
+
+        class FakeClient:
+            def version(self):
+                return '2'
+
+        class FakeHandle:
+            cluster_name = 'skew-pod'
+            provider = 'kubernetes'
+            num_hosts = 1
+
+            def agent_client(self, i):
+                return FakeClient()
+
+        monkeypatch.setattr(instance_setup,
+                            'upgrade_agents_in_place',
+                            lambda handle: False)
+        with pytest.raises(exceptions.AgentVersionError) as ei:
+            TpuBackend()._ensure_runtime_version(FakeHandle())  # pylint: disable=protected-access
+        msg = str(ei.value)
+        assert 'host0=2' in msg
+        assert agent_mod.AGENT_VERSION in msg
+        assert 'xsky down skew-pod' in msg
+        assert 'xsky launch -c skew-pod' in msg
+        assert ei.value.client_version == agent_mod.AGENT_VERSION
+        # Still a NotSupportedError subclass: pre-existing handlers
+        # keep catching it.
+        assert isinstance(ei.value, exceptions.NotSupportedError)
+
+
+@pytest.mark.slow
+class TestBackwardCompatSmoke:
+    """The reference's backward_compatibility_tests.sh shape on the
+    local fake: launch a cluster whose runtime speaks version N-1,
+    'upgrade' the client (drop the pin), and exec / queue / logs /
+    down against the same cluster still work — the reuse handshake
+    restarts the runtime in place."""
+
+    def test_launch_old_upgrade_client_then_operate(
+            self, monkeypatch):
+        import io
+
+        from skypilot_tpu import core, execution
+        from skypilot_tpu.resources import Resources
+        from skypilot_tpu.runtime import job_lib
+        from skypilot_tpu.task import Task
+
+        def _task(run, name):
+            task = Task(name=name, run=run)
+            res = Resources(cloud='local')
+            res._extra_config = {'num_hosts': 1}  # pylint: disable=protected-access
+            task.set_resources(res)
+            return task
+
+        cluster = 'compat-smoke'
+        t0 = time.monotonic()
+        try:
+            # "Version N": the cluster's agents advertise (and
+            # behave as) the previous protocol.
+            monkeypatch.setenv('SKYTPU_FORCE_PYTHON_AGENT', '1')
+            monkeypatch.setenv('SKYTPU_AGENT_VERSION_OVERRIDE', '3')
+            job1, handle = execution.launch(
+                _task('echo old-version-job', 'old'), cluster,
+                detach_run=True, quiet_optimizer=True)
+            assert core.wait_for_job(cluster, job1, timeout=120) == \
+                job_lib.JobStatus.SUCCEEDED
+            assert handle.agent_client(0).version() == '3'
+            # The old runtime really is old: no textfile ingestion.
+            with pytest.raises(exceptions.AgentVersionError):
+                handle.agent_client(0).profile(steps=1)
+
+            # "Upgrade the client": drop the pin; the next launch
+            # against the SAME cluster handshakes + restarts the
+            # runtime, then exec/queue/logs/down all work.
+            monkeypatch.delenv('SKYTPU_AGENT_VERSION_OVERRIDE')
+            job2, handle2 = execution.launch(
+                _task('echo upgraded-client-job', 'new'), cluster,
+                detach_run=True, quiet_optimizer=True)
+            assert handle2.agent_client(0).version() == \
+                agent_mod.AGENT_VERSION
+            assert core.wait_for_job(cluster, job2, timeout=120) == \
+                job_lib.JobStatus.SUCCEEDED
+            queue = core.queue(cluster)
+            assert {j['job_id'] for j in queue} >= {job1, job2}
+            buf = io.StringIO()
+            core.tail_logs(cluster, job2, out=buf, follow=False)
+            assert 'upgraded-client-job' in buf.getvalue()
+        finally:
+            try:
+                core.down(cluster, purge=True)
+            except exceptions.SkyTpuError:
+                pass
+        assert time.monotonic() - t0 < 300.0

@@ -12,6 +12,7 @@ import json
 import os
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -998,6 +999,15 @@ class TestLoadBalancerFailover:
             assert counter.value == 1
             # Latency is attributed PER ATTEMPT: the dead replica
             # owns its burned attempt; the healthy one only its own.
+            # The handler thread's finally (which records the
+            # observation) can lag the client's read() return by a
+            # beat — poll briefly instead of racing it.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if lb._m_latency.labels(  # pylint: disable=protected-access
+                        endpoint=live_server).count == 1:
+                    break
+                time.sleep(0.02)
             assert lb._m_latency.labels(  # pylint: disable=protected-access
                 endpoint=dead).count == 1
             assert lb._m_latency.labels(  # pylint: disable=protected-access

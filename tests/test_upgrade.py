@@ -1,0 +1,841 @@
+"""Rolling replica upgrades (serve/upgrade.py, docs/upgrades.md).
+
+ISSUE 13 acceptance, on the local fake:
+
+- a 3-replica service under open-loop load completes a rolling
+  upgrade with ZERO failed/dropped requests (drain verified by
+  in-flight completion — replica handlers hold each request long
+  enough that a terminate-before-drain would visibly cut streams);
+- a deliberately bad new version (READY on its readiness path, 5xx
+  on traffic) trips the ``replica-5xx-rate`` page, auto-pauses the
+  rollout, and rolls back to the old version, with the decision
+  journaled with an exemplar trace_id;
+- a serve controller killed mid-upgrade resumes the persisted state
+  machine on restart: no replica stuck DRAINING, no double-billed
+  zombie replacement, fenced terminal writes still bounce.
+
+The harness runs the REAL controller + replica manager + LB
+in-process; only the cloud is fake — ``execution.launch`` starts a
+local HTTP server per replica and ``core.down`` stops it, so the
+full drain → relaunch → re-probe → promote machinery (including the
+launch threads and the serve DB) is exercised.
+"""
+import http.server
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+import yaml as yaml_lib
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.alerts import journal as journal_lib
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import upgrade as upgrade_lib
+from skypilot_tpu.serve.serve_state import (ReplicaStatus,
+                                            UpgradePhase,
+                                            UpgradeState)
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+from skypilot_tpu.task import Task
+
+from conftest import _ephemeral_port  # noqa: E402
+
+
+# -- fake cloud: one local HTTP server per replica ---------------------
+
+
+def _make_handler(body: str, fail_root: bool, delay: float):
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = 'HTTP/1.1'
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            if self.path.startswith('/healthz'):
+                payload = b'ok'
+                self.send_response(200)
+            elif fail_root:
+                payload = b'boom'
+                self.send_response(500)
+            else:
+                if delay:
+                    time.sleep(delay)
+                payload = body.encode()
+                self.send_response(200)
+            self.send_header('Content-Length', str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    return Handler
+
+
+class FakeFleet:
+    """Patches the replica manager's cloud surface: launch == start
+    a local HTTP server on the replica's port; down == stop it. The
+    serve control plane (state DB, probes, LB, upgrade machine) runs
+    for real."""
+
+    def __init__(self, monkeypatch, delay: float = 0.0):
+        self.delay = delay
+        self._servers = {}
+        self.launched = []  # every cluster_name ever launched
+        self._lock = threading.Lock()
+        from skypilot_tpu import core as core_lib
+        from skypilot_tpu import execution, state
+        monkeypatch.setattr(execution, 'launch', self._launch)
+        monkeypatch.setattr(state, 'get_cluster_from_name',
+                            self._get_cluster)
+        monkeypatch.setattr(core_lib, 'down', self._down)
+
+    def _launch(self, task, cluster_name, **_kwargs):
+        port = int(task.envs['SKYTPU_REPLICA_PORT'])
+        run = task.run or ''
+        handler = _make_handler(body=run,
+                                fail_root=run.endswith('bad'),
+                                delay=self.delay)
+        server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', port), handler)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        with self._lock:
+            self._servers[cluster_name] = server
+            self.launched.append(cluster_name)
+        return 1, None
+
+    def _get_cluster(self, name):
+        with self._lock:
+            if name not in self._servers:
+                return None
+        handle = types.SimpleNamespace(head_ip='127.0.0.1')
+        return {'name': name, 'handle': handle}
+
+    def _down(self, name, purge=False):  # pylint: disable=unused-argument
+        with self._lock:
+            server = self._servers.pop(name, None)
+        if server is None:
+            raise exceptions.ClusterDoesNotExist(name)
+        server.shutdown()
+        server.server_close()
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._servers)
+
+    def stop_all(self):
+        with self._lock:
+            servers = list(self._servers.values())
+            self._servers.clear()
+        for server in servers:
+            server.shutdown()
+            server.server_close()
+
+
+class OpenLoopLoad:
+    """Fixed-rate GETs against the LB; every outcome recorded — a
+    silently-dropped request MUST surface as a failure here."""
+
+    def __init__(self, url: str, interval: float = 0.05):
+        self.url = url
+        self.interval = interval
+        self.results = []  # (status, body) — status None == failure
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                with urllib.request.urlopen(self.url,
+                                            timeout=20) as resp:
+                    self.results.append(
+                        (resp.status,
+                         resp.read().decode('utf-8', 'replace')))
+            except urllib.error.HTTPError as e:
+                self.results.append((e.code, ''))
+            except OSError as e:
+                self.results.append((None, str(e)))
+            self._stop.wait(self.interval)
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
+def _mk_task(svc: str, version_tag: str, port: int,
+             spec: SkyServiceSpec) -> Task:
+    task = Task(name=svc, run=f'replica-{version_tag}')
+    task.set_resources(Resources(cloud='local'))
+    task.service = spec
+    return task
+
+
+def _free_port_block(span: int = 10) -> int:
+    """A base port where base+1..base+span are all currently free —
+    fake replica servers bind spec.port + replica_id, and replica
+    ids grow past the initial fleet as upgrades relaunch."""
+    import socket
+    for _ in range(50):
+        base = _ephemeral_port()
+        if base + span > 65535:
+            continue
+        try:
+            for off in range(1, span + 1):
+                with socket.socket() as s:
+                    s.bind(('127.0.0.1', base + off))
+        except OSError:
+            continue
+        return base
+    raise RuntimeError('no free port block found')
+
+
+def _spec(port: int, replicas: int = 3,
+          readiness: str = '/healthz') -> SkyServiceSpec:
+    return SkyServiceSpec(
+        readiness_path=readiness, initial_delay_seconds=600,
+        readiness_timeout_seconds=2, min_replicas=replicas,
+        port=port)
+
+
+def _write_task_yaml(tmp_path, name: str, task: Task) -> str:
+    path = tmp_path / f'{name}.yaml'
+    path.write_text(yaml_lib.safe_dump(task.to_yaml_config(),
+                                       sort_keys=False))
+    return str(path)
+
+
+def _build_controller(monkeypatch, svc, task, v1_yaml):
+    from skypilot_tpu.serve import controller as controller_mod
+    ctrl = controller_mod.SkyServeController(
+        svc, task, lb_port=_ephemeral_port(), task_yaml=v1_yaml)
+    serve_state.add_service_version(svc, 1, v1_yaml)
+    serve_state.set_service_endpoint(
+        svc, f'http://127.0.0.1:{ctrl.load_balancer.port}')
+    ctrl.load_balancer.start()
+    return ctrl
+
+
+def _tick_until(ctrl, cond, timeout=60.0, dt=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ctrl.run_once()
+        if cond():
+            return True
+        time.sleep(dt)
+    return False
+
+
+def _ready(svc):
+    return [r for r in serve_state.get_replicas(svc)
+            if r['status'] == ReplicaStatus.READY]
+
+
+def _bring_up(monkeypatch, tmp_path, svc, replicas=3, delay=0.0,
+              soak='0.3', drain_grace='10'):
+    """Fresh 3-replica v1 service with an in-process controller."""
+    monkeypatch.setenv('SKYTPU_SERVE_UPGRADE_SOAK_SECONDS', soak)
+    monkeypatch.setenv('SKYTPU_SERVE_DRAIN_GRACE_SECONDS',
+                       drain_grace)
+    fleet = FakeFleet(monkeypatch, delay=delay)
+    port = _free_port_block()
+    spec = _spec(port, replicas=replicas)
+    task = _mk_task(svc, 'v1', port, spec)
+    v1_yaml = _write_task_yaml(tmp_path, 'v1', task)
+    serve_state.add_service(svc,
+                            json.dumps(spec.to_yaml_config()),
+                            lb_port=_ephemeral_port())
+    ctrl = _build_controller(monkeypatch, svc, task, v1_yaml)
+    assert _tick_until(ctrl,
+                       lambda: len(_ready(svc)) >= replicas,
+                       timeout=60), serve_state.get_replicas(svc)
+    return fleet, ctrl, port, spec
+
+
+def _request_update(tmp_path, svc, tag, port, spec):
+    task = _mk_task(svc, tag, port, spec)
+    yaml_path = _write_task_yaml(tmp_path, tag, task)
+    serve_state.set_target_version(svc, 2, yaml_path)
+    return task
+
+
+class TestUpgradeStateStore:
+
+    def test_row_round_trip_and_flags(self):
+        serve_state.start_upgrade('svc', 1, 2)
+        rec = serve_state.get_upgrade('svc')
+        assert rec['state'] == UpgradeState.ROLLING
+        assert rec['from_version'] == 1 and rec['to_version'] == 2
+        assert rec['phase'] is None and rec['upgraded'] == []
+        serve_state.update_upgrade(
+            'svc', phase=UpgradePhase.DRAIN, current_replica=2,
+            upgraded={5, 4})
+        rec = serve_state.get_upgrade('svc')
+        assert rec['phase'] == UpgradePhase.DRAIN
+        assert rec['current_replica'] == 2
+        assert rec['upgraded'] == [4, 5]
+        assert serve_state.request_upgrade_pause('svc')
+        assert serve_state.get_upgrade('svc')['pause_requested']
+        assert serve_state.request_upgrade_resume('svc')
+        assert not serve_state.get_upgrade('svc')['pause_requested']
+        assert serve_state.request_upgrade_abort('svc')
+        serve_state.update_upgrade('svc',
+                                   state=UpgradeState.SUCCEEDED)
+        # Terminal rows refuse pause/abort (nothing to control).
+        assert not serve_state.request_upgrade_pause('svc')
+        assert not serve_state.request_upgrade_abort('svc')
+        assert serve_state.get_upgrade('nope') is None
+        serve_state.clear_upgrade('svc')
+        assert serve_state.get_upgrade('svc') is None
+
+    def test_knob_resolution(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SERVE_DRAIN_GRACE_SECONDS', '7')
+        monkeypatch.setenv('SKYTPU_SERVE_UPGRADE_SOAK_SECONDS',
+                           '11')
+        assert upgrade_lib.drain_grace_seconds(None) == 7.0
+        assert upgrade_lib.soak_seconds(None) == 11.0
+        # The service spec's upgrade: section wins over env.
+        spec = SkyServiceSpec(upgrade_drain_grace_seconds=3,
+                              upgrade_soak_seconds=4)
+        assert upgrade_lib.drain_grace_seconds(spec) == 3.0
+        assert upgrade_lib.soak_seconds(spec) == 4.0
+        monkeypatch.setenv(
+            'SKYTPU_SERVE_UPGRADE_PROBE_GRACE_SECONDS', '9')
+        assert upgrade_lib.probe_grace_seconds(spec) == 9.0
+
+
+class TestRollingUpgradeEndToEnd:
+
+    def test_zero_dropped_requests(self, monkeypatch, tmp_path):
+        """Acceptance: 3 replicas under open-loop load, v1 -> v2,
+        zero failed/dropped requests, one replica migrating at a
+        time, drained in-flight requests completing."""
+        svc = 'upgsvc'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, delay=0.15)
+        min_ready_seen = [3]
+        try:
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            lb_url = f'http://127.0.0.1:{ctrl.load_balancer.port}/'
+            with OpenLoopLoad(lb_url, interval=0.05) as load:
+
+                def done():
+                    ready = _ready(svc)
+                    min_ready_seen[0] = min(min_ready_seen[0],
+                                            len(ready))
+                    rec = serve_state.get_upgrade(svc)
+                    return (rec is not None and
+                            rec['state'] == UpgradeState.SUCCEEDED)
+
+                assert _tick_until(ctrl, done, timeout=120), (
+                    serve_state.get_upgrade(svc),
+                    serve_state.get_replicas(svc))
+                # A few more requests against the finished fleet.
+                time.sleep(0.5)
+            # ZERO dropped/failed requests through the whole
+            # rollout (drain verified by in-flight completion — a
+            # cut stream surfaces as status None).
+            failures = [r for r in load.results
+                        if r[0] != 200]
+            assert not failures, failures[:5]
+            assert len(load.results) > 15  # real sustained load
+            # The endpoint cut over: early requests served v1, late
+            # ones v2.
+            bodies = [b for _, b in load.results]
+            assert bodies[0] == 'replica-v1'
+            assert bodies[-1] == 'replica-v2'
+            # One replica at a time: the fleet never lost more than
+            # one replica's capacity.
+            assert min_ready_seen[0] >= 2, min_ready_seen
+            replicas = serve_state.get_replicas(svc)
+            assert len(replicas) == 3
+            assert all(r['version'] == 2 and
+                       r['status'] == ReplicaStatus.READY
+                       for r in replicas), replicas
+            # No replica left draining, no zombie servers.
+            assert fleet.live_count() == 3
+            assert len(fleet.launched) == 6  # 3 v1 + 3 v2, no extra
+            # The completion is journaled.
+            actions = [e for e in journal_lib.read_events()
+                       if e.get('action') == 'upgrade-complete']
+            assert actions and actions[-1]['to_version'] == 2
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+    def test_bad_version_pages_pauses_and_rolls_back(
+            self, monkeypatch, tmp_path):
+        """Acceptance: the new version goes READY (its readiness
+        path is fine) but 5xxes traffic; the replica-5xx-rate page
+        fires mid-soak, auto-pauses the rollout, and rolls every
+        upgraded replica back to v1 — journaled with an exemplar
+        trace_id."""
+        monkeypatch.setenv('SKYTPU_ALERTS_FOR_SECONDS', '0.4')
+        monkeypatch.setenv('SKYTPU_ALERTS_WINDOW_SECONDS', '10')
+        svc = 'badsvc'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, soak='30')
+        # Round-robin so the bad replica is GUARANTEED its share of
+        # the open-loop load (least-load's deterministic tie-break
+        # would route a serial load to one lexicographic endpoint).
+        from skypilot_tpu.serve.load_balancer import RoundRobinPolicy
+        ctrl.load_balancer.policy = RoundRobinPolicy()
+        try:
+            _request_update(tmp_path, svc, 'bad', port, spec)
+            lb_url = f'http://127.0.0.1:{ctrl.load_balancer.port}/'
+            with OpenLoopLoad(lb_url, interval=0.04) as load:
+
+                def rolled_back():
+                    rec = serve_state.get_upgrade(svc)
+                    return (rec is not None and rec['state'] ==
+                            UpgradeState.ROLLED_BACK)
+
+                assert _tick_until(ctrl, rolled_back,
+                                   timeout=120), (
+                    serve_state.get_upgrade(svc),
+                    serve_state.get_replicas(svc))
+            # 5xx answers DID reach clients (that's what paged)...
+            assert any(code == 500 for code, _ in load.results)
+            # ...and the fleet is back on v1, fully READY.
+            replicas = serve_state.get_replicas(svc)
+            assert len(replicas) == 3
+            assert all(r['version'] == 1 and
+                       r['status'] == ReplicaStatus.READY
+                       for r in replicas), replicas
+            rec = serve_state.get_upgrade(svc)
+            assert rec['rollback_reason'] == \
+                'alert:replica-5xx-rate'
+            # The decision is journaled WITH the page's exemplar
+            # trace — `xsky trace <id>` shows the offending request.
+            events = journal_lib.read_events()
+            rollbacks = [e for e in events
+                         if e.get('action') == 'upgrade-rollback']
+            pauses = [e for e in events
+                      if e.get('action') == 'upgrade-pause']
+            assert rollbacks and pauses
+            assert rollbacks[-1]['rule'] == 'replica-5xx-rate'
+            exemplar = rollbacks[-1].get('exemplar_trace_id')
+            assert exemplar and len(exemplar) == 32
+            done = [e for e in events
+                    if e.get('action') == 'upgrade-rolled-back']
+            assert done and done[-1]['reason'] == \
+                'alert:replica-5xx-rate'
+            # Post-rollback the endpoint serves v1 again.
+            with urllib.request.urlopen(lb_url, timeout=10) as resp:
+                assert resp.read() == b'replica-v1'
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestMidUpgradeCrashRecovery:
+
+    def _crash_and_resume(self, monkeypatch, tmp_path, svc,
+                          crash_when):
+        """Drive controller #1 into the given phase, kill it, build
+        controller #2 and assert the persisted machine resumes and
+        completes without zombies."""
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, delay=0.0, soak='0.2')
+        task_v1 = ctrl.replica_manager._version_tasks[1]  # pylint: disable=protected-access
+        v1_yaml = ctrl.task_yaml
+        _request_update(tmp_path, svc, 'v2', port, spec)
+
+        assert _tick_until(
+            ctrl, lambda: crash_when(serve_state.get_upgrade(svc)),
+            timeout=60), serve_state.get_upgrade(svc)
+        # "Crash": the controller object is abandoned mid-machine —
+        # its LB (and all in-flight drain accounting) dies with it.
+        ctrl.load_balancer.stop()
+        crash_rec = serve_state.get_upgrade(svc)
+
+        ctrl2 = _build_controller(monkeypatch, svc, task_v1,
+                                  v1_yaml)
+        try:
+            assert _tick_until(
+                ctrl2,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('state') == UpgradeState.SUCCEEDED,
+                timeout=90), (crash_rec,
+                              serve_state.get_upgrade(svc),
+                              serve_state.get_replicas(svc))
+            replicas = serve_state.get_replicas(svc)
+            # Resumed, not restarted: every replica migrated, none
+            # stuck DRAINING, and the fleet is exactly 3 live
+            # servers — a forgotten half-launched replacement would
+            # show up as a 4th (double-billing zombie).
+            assert len(replicas) == 3
+            assert all(r['version'] == 2 and
+                       r['status'] == ReplicaStatus.READY
+                       for r in replicas), replicas
+            assert fleet.live_count() == 3
+            assert len(fleet.launched) == 6, fleet.launched
+            # Fenced terminal writes still bounce after the
+            # migrated schema ran the whole machine.
+            assert serve_state.set_service_status(
+                svc, serve_state.ServiceStatus.FAILED, fence=True)
+            assert not serve_state.set_service_status(
+                svc, serve_state.ServiceStatus.READY)
+            assert serve_state.get_service(svc)['status'] == \
+                serve_state.ServiceStatus.FAILED
+        finally:
+            ctrl2.load_balancer.stop()
+            fleet.stop_all()
+
+    def test_crash_between_drain_and_promote(self, monkeypatch,
+                                             tmp_path):
+        """Killed in PROBE: the old replica is gone, the
+        replacement is launched but not yet promoted. The restarted
+        controller must adopt the in-flight replacement instead of
+        launching a second one."""
+        self._crash_and_resume(
+            monkeypatch, tmp_path, 'crashsvc',
+            crash_when=lambda rec: (
+                rec is not None and
+                rec['phase'] == UpgradePhase.PROBE))
+
+    def test_crash_while_draining(self, monkeypatch, tmp_path):
+        """Killed in DRAIN: the replica is persisted DRAINING. The
+        restarted controller re-enters the drain (the dead LB's
+        in-flight count is vacuously zero) and the machine runs to
+        completion — no replica stranded out of routing."""
+        self._crash_and_resume(
+            monkeypatch, tmp_path, 'drainsvc',
+            crash_when=lambda rec: (
+                rec is not None and
+                rec['phase'] == UpgradePhase.DRAIN))
+
+
+class TestOperatorControls:
+
+    def test_pause_resume_abort(self, monkeypatch, tmp_path):
+        svc = 'ctlsvc'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, soak='30')
+        try:
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            # Run until the first replacement is promoted-ish
+            # (SOAK), then pause.
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('phase') == UpgradePhase.SOAK,
+                timeout=60)
+            assert serve_state.request_upgrade_pause(svc)
+            ctrl.run_once()
+            rec = serve_state.get_upgrade(svc)
+            assert rec['state'] == UpgradeState.PAUSED
+            # Paused holds position: further ticks change nothing.
+            before = serve_state.get_replicas(svc)
+            ctrl.run_once()
+            assert serve_state.get_replicas(svc) == before
+            # No replica stranded DRAINING while paused.
+            assert not any(
+                r['status'] == ReplicaStatus.DRAINING
+                for r in before)
+            # Resume, then abort: the machine rolls back to v1.
+            assert serve_state.request_upgrade_resume(svc)
+            ctrl.run_once()
+            resumed = serve_state.get_upgrade(svc)
+            assert resumed['state'] == UpgradeState.ROLLING
+            # A resumed upgrade is no longer "paused" — stale
+            # paused_reason would mislead `xsky serve upgrade`.
+            assert resumed['paused_reason'] is None
+            assert serve_state.request_upgrade_abort(svc)
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('state') ==
+                UpgradeState.ROLLED_BACK,
+                timeout=90), serve_state.get_upgrade(svc)
+            replicas = serve_state.get_replicas(svc)
+            assert all(r['version'] == 1 and
+                       r['status'] == ReplicaStatus.READY
+                       for r in replicas), replicas
+            assert serve_state.get_upgrade(svc)[
+                'rollback_reason'] == 'operator-abort'
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestMidRolloutLossRepair:
+
+    def test_preempted_replica_replaced_during_upgrade(
+            self, monkeypatch, tmp_path):
+        """A replica lost mid-rollout (cloud preemption — its
+        cluster vanishes) must be replaced WHILE the upgrade runs:
+        the machine suspends ordinary autoscaling, so the controller
+        repairs the shortfall itself; without it the fleet would
+        serve the whole rollout short."""
+        svc = 'losssvc'
+        fleet, ctrl, _port, _spec = _bring_up(
+            monkeypatch, tmp_path, svc, soak='30')
+        try:
+            _request_update(tmp_path, svc, 'v2', port=_port,
+                            spec=_spec)
+            # Run until the first replacement soaks (long soak holds
+            # the machine there).
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('phase') == UpgradePhase.SOAK,
+                timeout=60)
+            # Preempt a not-yet-migrated v1 replica at the provider.
+            victim = next(
+                r for r in serve_state.get_replicas(svc)
+                if r['version'] == 1 and
+                r['status'] == ReplicaStatus.READY)
+            fleet._down(victim['cluster_name'])  # pylint: disable=protected-access
+            # The controller notices (PREEMPTED) and replaces it
+            # mid-upgrade.
+            assert _tick_until(
+                ctrl,
+                lambda: len([
+                    r for r in serve_state.get_replicas(svc)
+                    if not r['status'].is_terminal()]) >= 3,
+                timeout=30), serve_state.get_replicas(svc)
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestSingletonSurgeUpgrade:
+
+    def test_one_replica_service_upgrades_without_outage(
+            self, monkeypatch, tmp_path):
+        """replicas=1 under load: drain-first would empty the ready
+        set (503s → lb-no-ready-replica page → rollback loop —
+        unupgradeable). The machine must SURGE: launch the
+        replacement first, drain the old replica only once the new
+        one serves. Zero failed requests."""
+        svc = 'singleton'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, replicas=1, delay=0.05)
+        try:
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            lb_url = f'http://127.0.0.1:{ctrl.load_balancer.port}/'
+            with OpenLoopLoad(lb_url, interval=0.05) as load:
+                assert _tick_until(
+                    ctrl,
+                    lambda: (serve_state.get_upgrade(svc) or
+                             {}).get('state') ==
+                    UpgradeState.SUCCEEDED,
+                    timeout=120), (serve_state.get_upgrade(svc),
+                                   serve_state.get_replicas(svc))
+                time.sleep(0.3)
+            failures = [r for r in load.results if r[0] != 200]
+            assert not failures, failures[:5]
+            bodies = [b for _, b in load.results]
+            assert bodies[-1] == 'replica-v2'
+            rec = serve_state.get_upgrade(svc)
+            assert rec['surge'] is True  # the ordering that ran
+            replicas = serve_state.get_replicas(svc)
+            assert len(replicas) == 1
+            assert replicas[0]['version'] == 2
+            assert replicas[0]['status'] == ReplicaStatus.READY
+            assert fleet.live_count() == 1
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestReplicaIdAllocatorSurvivesRestart:
+
+    def test_fresh_manager_seeds_past_live_replicas(
+            self, monkeypatch, tmp_path):
+        """A restarted controller's ReplicaManager must never hand a
+        LIVE replica's id to scale_up/reserve — that would overwrite
+        its record and launch into its cluster name."""
+        svc = 'idsvc'
+        fleet, ctrl, _port, _spec = _bring_up(
+            monkeypatch, tmp_path, svc, replicas=2)
+        try:
+            from skypilot_tpu.serve.replica_managers import \
+                ReplicaManager
+            fresh = ReplicaManager(
+                svc, ctrl.spec,
+                ctrl.replica_manager._version_tasks[1])  # pylint: disable=protected-access
+            reserved = fresh.reserve_replica_ids(1)[0]
+            live_ids = {r['replica_id']
+                        for r in serve_state.get_replicas(svc)}
+            assert reserved not in live_ids, (reserved, live_ids)
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestPauseDuringSurgeDrain:
+
+    def test_pause_keeps_cycle_and_replacement(self, monkeypatch,
+                                               tmp_path):
+        """Pausing while a surge cycle drains the old replica must
+        keep the cycle cursor: a fresh cycle on resume would launch
+        a SECOND replacement and finish one replica over target."""
+        svc = 'surgepause'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, replicas=1, soak='30')
+        try:
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            # Surge: DRAIN comes after the replacement is READY.
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('phase') == UpgradePhase.DRAIN,
+                timeout=60)
+            rec = serve_state.get_upgrade(svc)
+            assert rec['surge'] is True
+            replacement = rec['replacement_replica']
+            assert serve_state.request_upgrade_pause(svc)
+            ctrl.run_once()
+            paused = serve_state.get_upgrade(svc)
+            assert paused['state'] == UpgradeState.PAUSED
+            # Cursor retained; old replica back in rotation.
+            assert paused['phase'] == UpgradePhase.DRAIN
+            assert paused['replacement_replica'] == replacement
+            assert not any(
+                r['status'] == ReplicaStatus.DRAINING
+                for r in serve_state.get_replicas(svc))
+            # Resume: the SAME cycle finishes — exactly one replica
+            # at v2, no orphaned extra replacement.
+            assert serve_state.request_upgrade_resume(svc)
+            monkeypatch.setenv('SKYTPU_SERVE_UPGRADE_SOAK_SECONDS',
+                               '0.2')
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('state') == UpgradeState.SUCCEEDED,
+                timeout=60), serve_state.get_upgrade(svc)
+            replicas = serve_state.get_replicas(svc)
+            assert len(replicas) == 1
+            assert replicas[0]['replica_id'] == replacement
+            assert replicas[0]['version'] == 2
+            assert fleet.live_count() == 1
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestSpotMixPreserved:
+
+    def test_replacement_inherits_victim_spotness(
+            self, monkeypatch, tmp_path):
+        """A rollout must not churn the fallback autoscalers'
+        spot/on-demand mix: each replacement inherits the replaced
+        replica's spot-ness (persisted in the upgrade row, so it
+        survives a controller crash between drain and relaunch)."""
+        svc = 'spotsvc'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc)
+        try:
+            # Mark replica 2 as the fleet's spot member.
+            serve_state.upsert_replica(
+                svc, 2, f'{svc}-replica-2', ReplicaStatus.READY,
+                version=1, use_spot=True)
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('state') == UpgradeState.SUCCEEDED,
+                timeout=120)
+            replicas = serve_state.get_replicas(svc)
+            assert len(replicas) == 3
+            spot = [r for r in replicas if r['use_spot']]
+            assert len(spot) == 1, replicas
+            assert all(r['version'] == 2 for r in replicas)
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestRollbackUnavailable:
+
+    def test_missing_prior_version_pauses_honestly(
+            self, monkeypatch, tmp_path):
+        """An abort whose rollback target cannot be materialized (no
+        recorded yaml, no in-memory task) must PAUSE for the
+        operator — never relaunch the new version relabeled as the
+        old one and report ROLLED_BACK."""
+        svc = 'noyamlsvc'
+        fleet, ctrl, port, spec = _bring_up(
+            monkeypatch, tmp_path, svc, soak='30')
+        try:
+            _request_update(tmp_path, svc, 'v2', port, spec)
+            assert _tick_until(
+                ctrl,
+                lambda: (serve_state.get_upgrade(svc) or
+                         {}).get('phase') == UpgradePhase.SOAK,
+                timeout=60)
+            # Simulate a controller that lost the v1 task: wipe both
+            # the recorded yaml and the in-memory registration.
+            serve_state._db().execute_and_commit(  # pylint: disable=protected-access
+                'DELETE FROM service_versions WHERE service_name=?',
+                (svc,))
+            ctrl.replica_manager._version_tasks.pop(1, None)  # pylint: disable=protected-access
+            assert serve_state.request_upgrade_abort(svc)
+            ctrl.run_once()
+            rec = serve_state.get_upgrade(svc)
+            assert rec['state'] == UpgradeState.PAUSED
+            assert 'rollback-unavailable' in rec['paused_reason']
+            # Pinned: further ticks hold (pause_requested set).
+            ctrl.run_once()
+            assert serve_state.get_upgrade(svc)['state'] == \
+                UpgradeState.PAUSED
+            # No replica left stranded out of routing.
+            assert not any(
+                r['status'] == ReplicaStatus.DRAINING
+                for r in serve_state.get_replicas(svc))
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
+
+
+class TestDrainSemantics:
+
+    def test_lb_inflight_counts_and_forget(self):
+        from skypilot_tpu.serve.load_balancer import \
+            SkyServeLoadBalancer
+        lb = SkyServeLoadBalancer(_ephemeral_port(), lambda: [])
+        lb._inflight_start('http://r1')  # pylint: disable=protected-access
+        lb._inflight_start('http://r1')  # pylint: disable=protected-access
+        assert lb.inflight_count('http://r1') == 2
+        lb._inflight_end('http://r1')  # pylint: disable=protected-access
+        assert lb.inflight_count('http://r1') == 1
+        lb._inflight_end('http://r1')  # pylint: disable=protected-access
+        assert lb.inflight_count('http://r1') == 0
+        lb._inflight_start('http://r2')  # pylint: disable=protected-access
+        lb.forget_endpoint('http://r2')
+        assert lb.inflight_count('http://r2') == 0
+
+    def test_draining_replica_leaves_ready_set(self, monkeypatch,
+                                               tmp_path):
+        svc = 'drainset'
+        fleet, ctrl, _port, _spec = _bring_up(
+            monkeypatch, tmp_path, svc, replicas=2)
+        try:
+            endpoints = set(ctrl.replica_manager.ready_endpoints())
+            assert len(endpoints) == 2
+            ctrl.replica_manager.drain(1)
+            rec = serve_state.get_replica(svc, 1)
+            assert rec['status'] == ReplicaStatus.DRAINING
+            after = set(ctrl.replica_manager.ready_endpoints())
+            assert len(after) == 1
+            assert rec['endpoint'] not in after
+            # Probes skip it (a drain must not flap it to FAILED).
+            ctrl.run_once()
+            assert serve_state.get_replica(svc, 1)['status'] == \
+                ReplicaStatus.DRAINING
+            ctrl.replica_manager.undrain(1)
+            assert serve_state.get_replica(svc, 1)['status'] == \
+                ReplicaStatus.READY
+        finally:
+            ctrl.load_balancer.stop()
+            fleet.stop_all()
