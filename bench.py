@@ -245,13 +245,17 @@ def serve_batch_main() -> dict:
 
 
 def _open_loop_load(engine, prompts, gen: int,
-                    interarrival_s: float) -> dict:
+                    interarrival_s: float,
+                    collect_tokens: bool = False) -> dict:
     """Drive an OPEN-LOOP request schedule at the engine: request i
     is submitted at t0 + i * interarrival regardless of completions
     (closed-loop drivers hide queueing collapse — an overloaded
     server slows the load down). Returns tokens/s over the makespan
     and client-side TTFT stats measured from each request's
-    SCHEDULED arrival (so admission queueing counts)."""
+    SCHEDULED arrival (so admission queueing counts).
+    ``collect_tokens`` additionally returns every request's token
+    ids (``token_outputs``) so two arms over the same prompts can be
+    compared for exactness — not just counted."""
     import threading
 
     n = len(prompts)
@@ -259,9 +263,11 @@ def _open_loop_load(engine, prompts, gen: int,
     counts = [0] * n
     done_at = [0.0] * n
     errors = [None] * n
+    token_outputs = [None] * n
 
     def collect(i, q, sched):
         first = True
+        toks = [] if collect_tokens else None
         while True:
             tok = q.get()
             if tok is None:
@@ -276,6 +282,9 @@ def _open_loop_load(engine, prompts, gen: int,
                 ttfts[i] = time.perf_counter() - sched
                 first = False
             counts[i] += 1
+            if toks is not None:
+                toks.append(int(tok))
+        token_outputs[i] = toks
         done_at[i] = time.perf_counter()
 
     threads = []
@@ -313,6 +322,8 @@ def _open_loop_load(engine, prompts, gen: int,
         'p50_ttft_ms': round(ttft_ms[len(ttft_ms) // 2], 1),
         'p99_ttft_ms': round(p99, 1),
         'max_ttft_ms': round(ttft_ms[-1], 1),
+        **({'token_outputs': token_outputs}
+           if collect_tokens else {}),
     }
 
 
@@ -377,9 +388,17 @@ def serve_continuous_main() -> dict:
         for i in range(requests)]
 
     def run_arm(name, **engine_kwargs):
+        # Caching OFF in BOTH arms: this mode isolates admission
+        # granularity + prefill scheduling at equal KV HBM. The
+        # warmup request shares a prefix with request 0, so caching
+        # would smuggle a (one-sided) prefix hit — and its one-time
+        # COW/suffix-bucket compiles — into the timed window;
+        # `--bench serve_prefix` is the mode that measures caching.
         engine = BatchingEngine(params, config, max_seq=max_seq,
                                 steps_per_dispatch=4,
-                                kv_int8=kv_int8, **engine_kwargs)
+                                kv_int8=kv_int8,
+                                prefix_caching=False,
+                                **engine_kwargs)
         try:
             # Warm both prompt-shape compile paths before timing.
             engine.generate(prompts[0][:short_len], 2)
@@ -429,6 +448,163 @@ def serve_continuous_main() -> dict:
             'static': static,
             'tokens_per_sec_speedup': round(speedup, 3),
             'p99_ttft_speedup': round(ttft_ratio, 3),
+        },
+    }
+
+
+def serve_prefix_main() -> dict:
+    """BENCH_MODE=serve_prefix (``--bench serve_prefix``): automatic
+    prefix caching under the traffic shape production fleets actually
+    see — chat/RAG/few-shot requests sharing a long system-prompt
+    prefix with short distinct suffixes. Two arms of the SAME paged
+    engine at equal KV HBM and identical knobs, differing ONLY in
+    ``prefix_caching``: the warm arm matches each shared prompt's
+    hash chain and prefills just the suffix; the cold arm re-prefills
+    every token. Headline is the warm arm's p99 TTFT (ms, lower is
+    better for the regression gate); ``vs_baseline`` is cold/warm
+    (>1 = caching wins). Greedy outputs are asserted token-for-token
+    identical between the arms before timing — caching must be free
+    of correctness cost, not just fast.
+
+    Env: BENCH_SP_MODEL (default tiny — the CPU proxy),
+    BENCH_SP_REQUESTS, BENCH_SP_SHARED_FRAC (fraction of requests
+    sharing the prefix, default 0.6), BENCH_SP_PREFIX /
+    BENCH_SP_SUFFIX (token lengths), BENCH_SP_GEN, BENCH_SP_RATE
+    (open-loop req/s), BENCH_KV_INT8.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_SP_MODEL', 'tiny')
+    requests = int(os.environ.get('BENCH_SP_REQUESTS', '32'))
+    shared_frac = float(os.environ.get('BENCH_SP_SHARED_FRAC', '0.6'))
+    prefix_len = int(os.environ.get('BENCH_SP_PREFIX', '240'))
+    suffix_len = int(os.environ.get('BENCH_SP_SUFFIX', '16'))
+    gen = int(os.environ.get('BENCH_SP_GEN', '32'))
+    rate = float(os.environ.get('BENCH_SP_RATE', '100'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    prompt_len = prefix_len + suffix_len
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+    rows = int(os.environ.get('BENCH_SP_ROWS', '4'))
+
+    config = llama.get_config(model_name)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    shared_prefix = rng.integers(
+        1, config.vocab_size, size=prefix_len).tolist()
+
+    def rand(n):
+        return rng.integers(1, config.vocab_size, size=n).tolist()
+
+    # Deterministic shared/distinct interleave at the requested
+    # fraction (error-diffusion, so the mix is even over time, not
+    # front-loaded).
+    prompts = []
+    acc = 0.0
+    n_shared = 0
+    for _ in range(requests):
+        acc += shared_frac
+        if acc >= 1.0:
+            acc -= 1.0
+            prompts.append(shared_prefix + rand(suffix_len))
+            n_shared += 1
+        else:
+            prompts.append(rand(prompt_len))
+
+    def build_arm(prefix_caching):
+        # Equal KV HBM both arms: the default no-oversubscription
+        # pool (every row can reach max_seq). The cache lives in
+        # refcount-0 blocks of the SAME pool — no extra HBM.
+        return BatchingEngine(
+            params, config, slots=rows, max_seq=max_seq,
+            steps_per_dispatch=4, kv_int8=kv_int8, block_size=block,
+            prefill_chunk=64, max_num_batched_tokens=64,
+            prefix_caching=prefix_caching)
+
+    # Warmup probes shared by both arms: a shared-prefix pair (the
+    # second one HITS in the warm arm) plus a distinct prompt — this
+    # warms every compile path the timed load will take (full-prompt
+    # buckets, suffix buckets after a hit, the COW copy).
+    warm_probes = [shared_prefix + rand(suffix_len),
+                   shared_prefix + rand(suffix_len), rand(16)]
+
+    def run_arm(name, prefix_caching):
+        engine = build_arm(prefix_caching)
+        try:
+            for p in warm_probes:
+                engine.generate(p, 8)
+            out = _open_loop_load(engine, prompts, gen, 1.0 / rate,
+                                  collect_tokens=True)
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    cold = run_arm('cold_prefill', False)
+    warm = run_arm('warm_cache', True)
+    # Token-for-token exactness over the ENTIRE timed load, not a
+    # probe sample: both arms ran the same prompts, so caching may
+    # only change WHEN prefill work happened — never what came out
+    # (a concurrency/eviction bug that corrupts outputs mid-load
+    # must fail the bench, not ride a fast row into bench_runs).
+    # bf16 KV only: with int8 KV a position's numerics depend on
+    # its prefill CHUNK boundary (a later chunk attends earlier
+    # chunks' int8-round-tripped keys; the current chunk's rows are
+    # exact bf16), and a cache hit legitimately shifts those
+    # boundaries — the warm arm's suffix attends the prefix through
+    # int8 where the cold arm's same-chunk tail did not, so a
+    # near-tied greedy argmax can flip on a numerics artifact, not
+    # a cache bug.
+    cold_toks = cold.pop('token_outputs')
+    warm_toks = warm.pop('token_outputs')
+    if not kv_int8:
+        for i, (want, got) in enumerate(zip(cold_toks, warm_toks)):
+            if want != got:
+                raise RuntimeError(
+                    f'prefix-cache output diverged on timed request '
+                    f'{i}: {got} != {want}')
+
+    ttft_ratio = warm['p99_ttft_ms'] / max(cold['p99_ttft_ms'], 1e-9)
+    return {
+        'metric': f'{model_name}_serve_prefix_p99_ttft_ms',
+        'value': warm['p99_ttft_ms'],
+        'unit': 'ms',
+        # vs_baseline: cold-arm p99 TTFT over warm-arm (>1 = the
+        # cache wins; acceptance wants >= 2).
+        'vs_baseline': round(1.0 / max(ttft_ratio, 1e-9), 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'requests': requests,
+            'shared_fraction': round(n_shared / requests, 3),
+            'prefix_len': prefix_len,
+            'suffix_len': suffix_len,
+            'generated_per_request': gen,
+            'arrival_rate_req_s': rate,
+            'max_seq': max_seq,
+            # int8: the exactness assert is SKIPPED (a cache hit
+            # shifts the suffix's prefill-chunk boundary, so the
+            # engine's multi-chunk int8 caveat applies across the
+            # hit boundary — a near-tied argmax may flip on a
+            # numerics artifact, not a cache bug).
+            'outputs_token_exact': (True if not kv_int8
+                                    else 'skipped-int8-chunk-caveat'),
+            'warm': warm,
+            'cold': cold,
+            'p99_ttft_speedup': round(1.0 / max(ttft_ratio, 1e-9),
+                                      3),
+            'tokens_per_sec_speedup': round(
+                warm['tokens_per_sec'] /
+                max(cold['tokens_per_sec'], 1e-9), 3),
         },
     }
 
@@ -1358,8 +1534,8 @@ if __name__ == '__main__':
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
-                     'serve_continuous', 'launch', 'checkpoint',
-                     'elastic')
+                     'serve_continuous', 'serve_prefix', 'launch',
+                     'checkpoint', 'elastic')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
                 print(f'usage: bench.py --bench {"|".join(known)}',
@@ -1376,6 +1552,8 @@ if __name__ == '__main__':
             bench_result = serve_batch_main()
         elif mode == 'serve_continuous':
             bench_result = serve_continuous_main()
+        elif mode == 'serve_prefix':
+            bench_result = serve_prefix_main()
         elif mode == 'launch':
             bench_result = launch_main()
         else:

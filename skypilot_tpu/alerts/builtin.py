@@ -127,6 +127,25 @@ def fleet_rules() -> List[AlertRule]:
                     'batching engine is preempting requests '
                     '(recomputed on resume: latency, not '
                     'correctness). Size num_blocks / shed load.'),
+        # Fleet pack for the same plumbing reason as the two rules
+        # above: the hit-ratio gauge is exported by replica worker
+        # processes and reaches history via textfile bridge → host
+        # agent → cluster scrapes. The gauge is LAZY — an engine
+        # with caching off (or no traffic) exports nothing, so this
+        # rule stays silent unless caching is on and running.
+        AlertRule(
+            id='prefix-hit-ratio-low', kind='threshold',
+            metric='skytpu_batch_prefix_hit_ratio',
+            threshold=0.02, resolve_threshold=0.05, op='<',
+            aggregate='max',  # the BEST replica's ratio: if even it
+                              # never hits, the cache is dead weight
+            window=900.0, for_seconds=600.0,
+            summary='Prefix caching is enabled but essentially '
+                    'nothing hits — shared-prefix traffic is being '
+                    'scattered (LB policy not prefix_affinity?) or '
+                    'the workload is genuinely unshared (turn '
+                    'engine.prefix_caching off to reclaim the '
+                    'bookkeeping).'),
         AlertRule(
             id='agent-scrape-stale', kind='absent',
             metric='skytpu_agent_uptime_seconds',

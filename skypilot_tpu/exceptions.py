@@ -140,6 +140,18 @@ class KVPoolExhaustedError(SkyTpuError):
     never fail unrelated in-flight requests."""
 
 
+class KVBlockError(SkyTpuError, ValueError):
+    """Invalid paged-KV block-pool operation.
+
+    Raised on refcount-invariant violations: double free (releasing a
+    block whose refcount is already zero), freeing the reserved
+    scratch block or an out-of-range id, pinning a block that is
+    neither cached nor referenced, or registering cached content on a
+    block the caller does not hold a reference to. Subclasses
+    ValueError so pre-refcount callers that caught ValueError keep
+    working."""
+
+
 class StorageError(SkyTpuError):
     """Storage (bucket) operation failed."""
 

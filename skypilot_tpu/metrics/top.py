@@ -137,6 +137,12 @@ def _host_rows(families) -> List[Dict[str, Any]]:
         combine='sum')
     put('skytpu_batch_preemptions_total', 'preemptions',
         combine='sum')
+    # Prefix-cache hit rate (serve/kv_pool.py): blocks reused vs
+    # freshly prefilled — the PREFIX-HIT% column.
+    put('skytpu_batch_prefix_hits_total', 'prefix_hits',
+        combine='sum')
+    put('skytpu_batch_prefix_misses_total', 'prefix_misses',
+        combine='sum')
     return [dict(row, host=host)
             for host, row in sorted(hosts.items())]
 
@@ -259,6 +265,15 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                 row['requests'] = sum(counts.values())
                 row['errors'] = sum(v for k, v in counts.items()
                                     if k.startswith('5'))
+                # Aggregate block-hit-rate across endpoints (the
+                # LB's prefix counters, fed by replica response
+                # headers) — None until any replica reports.
+                hits = sum(s.value for s in _samples(
+                    fams, 'skytpu_lb_prefix_block_hits_total'))
+                misses = sum(s.value for s in _samples(
+                    fams, 'skytpu_lb_prefix_block_misses_total'))
+                if hits + misses > 0:
+                    row['prefix_hit_ratio'] = hits / (hits + misses)
             except Exception as e:  # pylint: disable=broad-except
                 row['error'] = str(e)
         services.append(row)
@@ -334,8 +349,8 @@ def render(snap: Dict[str, Any]) -> str:
 
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
-                            'SERVE TOK/S', 'BLOCKS', 'PREEMPT', 'KV',
-                            'ALERTS'])
+                            'SERVE TOK/S', 'BLOCKS', 'PREEMPT',
+                            'PREFIX-HIT%', 'KV', 'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
         alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
@@ -345,7 +360,7 @@ def render(snap: Dict[str, Any]) -> str:
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
                            '-', '-', '-', '-', '-', '-', '-', '-',
-                           '-', alerts_cell])
+                           '-', '-', alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -376,6 +391,13 @@ def render(snap: Dict[str, Any]) -> str:
             if h.get('kv_bytes'):
                 kv = (f'{_fmt_bytes(h.get("kv_used", 0))}/'
                       f'{_fmt_bytes(h["kv_bytes"])}')
+            # Prefix-cache hit rate: blocks reused / blocks needed.
+            prefix = '-'
+            denom = (h.get('prefix_hits', 0.0) +
+                     h.get('prefix_misses', 0.0))
+            if denom:
+                prefix = _fmt_ratio(h.get('prefix_hits', 0.0) /
+                                    denom)
             table.add_row([
                 cluster['name'], h['host'], load, mem,
                 _fmt_num(h.get('procs'), '{:.0f}'), hbm,
@@ -385,7 +407,7 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
                 blocks,
                 _fmt_num(h.get('preemptions'), '{:.0f}'),
-                kv, alerts_cell,
+                prefix, kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')
@@ -393,7 +415,7 @@ def render(snap: Dict[str, Any]) -> str:
     if snap['services']:
         stable = ux_utils.Table(['SERVICE', 'STATUS', 'VERSION',
                                  'QPS', 'P50', 'P99', 'REQS', '5XX',
-                                 'ALERTS'])
+                                 'HIT%', 'ALERTS'])
         for s in snap['services']:
             stable.add_row([
                 s['name'], s['status'],
@@ -403,6 +425,7 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(s.get('p99_s'), '{:.3f}s'),
                 _fmt_num(s.get('requests'), '{:.0f}'),
                 _fmt_num(s.get('errors'), '{:.0f}'),
+                _fmt_ratio(s.get('prefix_hit_ratio')),
                 str(s.get('alerts_firing', 0) or '-'),
             ])
         out.append('')

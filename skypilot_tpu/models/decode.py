@@ -325,7 +325,13 @@ def forward_paged(params: Params, tokens: jax.Array, pools,
     the causal window mask (``_masked_attention`` with
     q_pos=start, kv_len=start+real_len) — chunk c sees every earlier
     chunk's keys plus itself causally, so chunked prefill is
-    numerically the plain prefill.
+    numerically the plain prefill. The same contract carries the
+    engine's PREFIX-CACHE suffix prefill: when admission reuses
+    cached blocks for the leading ``start`` tokens (the block table
+    points at pinned shared blocks), the first chunk simply begins
+    at that offset and the gather reads the cached K/V exactly as if
+    this request had prefilled it — no cache-aware branch exists in
+    the model code at all.
 
     Returns (logits [1, vocab] f32 at the chunk's LAST REAL position,
     new pools). Only the final chunk's logits are meaningful (they

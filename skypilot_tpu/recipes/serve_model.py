@@ -67,6 +67,15 @@ def main():
                              'bounds how much prompt work runs '
                              'between decode dispatches '
                              '(engine.max_num_batched_tokens)')
+    parser.add_argument('--prefix-caching', choices=['on', 'off'],
+                        default=('on' if os.environ.get(
+                            'SKYTPU_ENGINE_PREFIX_CACHING', '1')
+                            not in ('0', 'off', 'false') else 'off'),
+                        help='automatic prefix caching on the paged '
+                             'KV pool: repeat prompt prefixes skip '
+                             'their prefill (token-exact under '
+                             'greedy decoding; engine.prefix_caching '
+                             'in the service YAML)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -170,7 +179,8 @@ def main():
             params, config, slots=args.slots, kv_int8=args.kv_int8,
             block_size=args.block_size,
             num_blocks=args.num_blocks or None,
-            max_num_batched_tokens=args.max_batched_tokens)
+            max_num_batched_tokens=args.max_batched_tokens,
+            prefix_caching=args.prefix_caching == 'on')
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the
@@ -251,13 +261,42 @@ def main():
         def log_message(self, fmt, *largs):
             pass
 
-        def _json(self, obj, code=200):
+        def _json(self, obj, code=200, extra_headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header('Content-Type', 'application/json')
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _engine_error(self, err):
+            """Answer a typed engine failure as an HTTP error
+            instead of raising through the handler (which tears the
+            connection down mid-handshake). 413 ONLY for the
+            pool-can-never-hold-this-prompt case — a client-shaped
+            error that must not trip the LB's replica-5xx-rate
+            page; anything else (engine death pushed onto every
+            queue by _fail_all) IS a replica fault and answers 500
+            so the 5xx alert sees it."""
+            from skypilot_tpu import exceptions
+            code = 413 if isinstance(
+                err, exceptions.KVPoolExhaustedError) else 500
+            self._json({'error': str(err)}, code)
+
+        @staticmethod
+        def _prefix_headers(req):
+            """Per-request prefix-cache accounting as response
+            headers — the LB folds these into its per-endpoint
+            block-hit-rate (serve/load_balancer.py)."""
+            from skypilot_tpu.serve import prefix_hash
+            return {
+                prefix_hash.PREFIX_HITS_HEADER:
+                    str(req.prefix_hit_blocks),
+                prefix_hash.PREFIX_MISSES_HEADER:
+                    str(req.prefix_miss_blocks),
+            }
 
         def do_GET(self):  # noqa: N802
             if self.path == '/':
@@ -288,6 +327,12 @@ def main():
                 eos_id = body.get('eos_id')
                 if eos_id is not None:
                     eos_id = int(eos_id)
+                # Fair-share QoS key: the engine splits its prefill
+                # token budget across tenants by weighted deficit
+                # round-robin.
+                tenant = body.get('tenant')
+                if tenant is not None:
+                    tenant = str(tenant)
             except (ValueError, KeyError, TypeError) as e:
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
@@ -304,22 +349,50 @@ def main():
                                           'max_new': max_new}):
                 self._generate_response(prompt_ids, max_new,
                                         temperature, top_p, seed,
-                                        eos_id, stream)
+                                        eos_id, stream, tenant)
 
         def _generate_response(self, prompt_ids, max_new, temperature,
-                               top_p, seed, eos_id, stream):
-            if stream and engine is not None and temperature is None \
-                    and top_p is None:
+                               top_p, seed, eos_id, stream,
+                               tenant=None):
+            use_engine = (engine is not None and temperature is None
+                          and top_p is None)
+            if stream and use_engine:
                 # SSE: tokens leave as the engine produces them (per
                 # decode dispatch), so client TTFT is prefill-bound,
                 # not completion-bound. The serve LB passes chunked
                 # bodies through unbuffered (load_balancer.py
                 # _stream_response), end to end.
-                q = engine.submit(prompt_ids, max_new, eos_id=eos_id)
+                import queue as queue_mod
+                req = engine.submit_request(prompt_ids, max_new,
+                                            eos_id=eos_id,
+                                            tenant=tenant)
+                q = req.out
+                # Hold the status line for the FIRST queue item:
+                # admission (which fills the prefix-cache stats the
+                # headers carry) strictly precedes the first token,
+                # so in the common case this costs no TTFT — and a
+                # typed failure can be answered as a real HTTP error
+                # instead of a 200 event stream. BOUNDED wait: under
+                # a queueing collapse the first token can take
+                # longer than the LB's 120 s upstream timeout, and
+                # the status line must never be what times out —
+                # past the bound, send headers without the stats and
+                # stream as before.
+                _pending = object()
+                try:
+                    first = q.get(timeout=90)
+                except queue_mod.Empty:
+                    first = _pending
+                if isinstance(first, BaseException):
+                    self._engine_error(first)
+                    return
                 self.send_response(200)
                 self.send_header('Content-Type', 'text/event-stream')
                 self.send_header('Cache-Control', 'no-cache')
                 self.send_header('Transfer-Encoding', 'chunked')
+                if first is not _pending:
+                    for k, v in self._prefix_headers(req).items():
+                        self.send_header(k, v)
                 self.end_headers()
 
                 def chunk(data: bytes):
@@ -328,12 +401,26 @@ def main():
                     self.wfile.flush()
 
                 try:
+                    tok = q.get() if first is _pending else first
                     while True:
-                        tok = q.get()
                         if tok is None:
                             chunk(b'data: [DONE]\n\n')
                             break
+                        if isinstance(tok, BaseException):
+                            # Mid-stream typed failure: the 200 is
+                            # gone — surface it as an SSE error
+                            # event, then end the stream. One-line
+                            # payload: a newline in the message
+                            # (XLA errors are multi-line) would
+                            # terminate the SSE event early and
+                            # leak the tail as bogus data lines.
+                            msg = ' '.join(str(tok).split())
+                            chunk(f'event: error\ndata: '
+                                  f'{msg}\n\n'.encode())
+                            tok = q.get()
+                            continue
                         chunk(f'data: {tok}\n\n'.encode())
+                        tok = q.get()
                     self.wfile.write(b'0\r\n\r\n')
                     self.wfile.flush()
                 except OSError:
@@ -349,21 +436,43 @@ def main():
                     except queue_mod.Empty:
                         pass
                 return
+            if use_engine:
+                req = engine.submit_request(prompt_ids, max_new,
+                                            eos_id=eos_id,
+                                            tenant=tenant)
+                out = []
+                err = None
+                while True:
+                    tok = req.out.get()
+                    if tok is None:
+                        break
+                    if isinstance(tok, BaseException):
+                        err = tok
+                        continue
+                    out.append(tok)
+                if err is not None:
+                    self._engine_error(err)
+                    return
+                self._json({'output_ids': out},
+                           extra_headers=self._prefix_headers(req))
+                return
             out = generate(prompt_ids, max_new, temperature=temperature,
                            top_p=top_p, seed=seed, eos_id=eos_id)
             if stream:
-                # No engine (or sampling): stream-compatible response
-                # with the whole generation as one event burst.
-                self.send_response(200)
-                self.send_header('Content-Type', 'text/event-stream')
-                payload = b''.join(f'data: {t}\n\n'.encode()
-                                   for t in out) + b'data: [DONE]\n\n'
-                self.send_header('Content-Length',
-                                 str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                self._stream_burst(out)
                 return
             self._json({'output_ids': out})
+
+        def _stream_burst(self, out):
+            # No engine (or sampling): stream-compatible response
+            # with the whole generation as one event burst.
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            payload = b''.join(f'data: {t}\n\n'.encode()
+                               for t in out) + b'data: [DONE]\n\n'
+            self.send_header('Content-Length', str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
 
     # Warm every decode variant's compile before declaring readiness
     # (greedy, sampled, sampled+nucleus) — the first request would

@@ -30,6 +30,17 @@ TPU-first design:
   never a deadlock, never an engine-wide failure. A request that can
   never fit the pool fails alone with a typed
   ``exceptions.KVPoolExhaustedError``.
+- AUTOMATIC PREFIX CACHING (default on): admission matches the
+  prompt's block hash chain against refcounted cached blocks, pins
+  hits and prefills only the suffix (copy-on-write past the first
+  divergent token mid-block); completed prompts register their full
+  blocks. Cached content is exactly what re-prefilling would write,
+  so greedy outputs stay token-for-token identical (bf16 KV; under
+  int8 KV a hit shifts the suffix's prefill-chunk boundary, so the
+  int8 chunk caveat below applies across the hit boundary too) — a
+  preempted request's resume also re-admits through the matcher,
+  collapsing its re-prefill to ~the tokens generated since
+  preemption.
 - Numerics contract: batched outputs EQUAL single-request greedy
   decoding (tested token-for-token, bf16 and int8 KV; the paged
   gather view is masked so recycled-block garbage contributes exactly
@@ -66,6 +77,11 @@ logger = tpu_logging.init_logger(__name__)
 
 Params = Dict[str, Any]
 _NEG_INF = -1e30
+
+# Trailing window for the exported prefix hit-rate gauge — matches
+# the prefix-hit-ratio-low alert rule's evaluation window, so a
+# regression is visible to the rule within one window.
+PREFIX_RATIO_WINDOW_SECONDS = 900.0
 
 
 # ---------------------------------------------------------------------
@@ -393,10 +409,28 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
 
 class _Request:
     def __init__(self, prompt_ids: List[int], max_new: int,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 tenant: Optional[str] = None):
         self.prompt_ids = prompt_ids
         self.max_new = max_new
         self.eos_id = eos_id
+        # Fair-share QoS key (None = the default tenant): the
+        # admission loop splits the per-iteration prefill token
+        # budget by weighted deficit round-robin over this field.
+        self.tenant = tenant
+        # Prefix-cache accounting, filled at admission (cumulative
+        # across re-admissions after preemption): whole KV blocks
+        # reused from the cache vs freshly prefilled. serve_model
+        # surfaces these as X-Skytpu-Prefix-* response headers, which
+        # the LB rolls into its per-endpoint block-hit-rate.
+        self.prefix_hit_blocks = 0
+        self.prefix_miss_blocks = 0
+        # Admission-time hash chain, stashed so _register_prefix
+        # does not recompute it at prefill finish (an 8k prompt is
+        # ~500 sha256 calls — once per admission is enough on the
+        # single-threaded engine loop).
+        self.chain_hashes: List[bytes] = []
+        self.chain_t0 = -1
         self.out: 'queue.Queue' = queue.Queue()
         self.submitted_at = time.time()
         # Tokens already EMITTED to the client — preemption resume
@@ -462,6 +496,24 @@ def _engine_metrics():
             'skytpu_batch_preemptions_total',
             'Requests preempted (blocks reclaimed, request '
             'requeued) because the KV pool ran out of free blocks.'),
+        'kv_cached': reg.gauge(
+            'skytpu_batch_kv_cache_cached_bytes',
+            'Bytes of refcount-0 prefix-cache blocks — RECLAIMABLE '
+            'capacity holding reusable KV content. A pool reading '
+            'full on kv_cache_bytes but mostly cached here is '
+            'healthy, not exhausted.'),
+        'prefix_hits': reg.counter(
+            'skytpu_batch_prefix_hits_total',
+            'KV blocks reused from the prefix cache at admission '
+            '(prefill skipped for their tokens).'),
+        'prefix_misses': reg.counter(
+            'skytpu_batch_prefix_misses_total',
+            'KV blocks freshly allocated and prefilled at admission '
+            '(no cache hit).'),
+        'prefix_cached_blocks': reg.gauge(
+            'skytpu_batch_prefix_cached_blocks',
+            'Refcount-0 blocks currently holding registered '
+            '(reusable) prefix-cache content.'),
     }
 
 
@@ -486,7 +538,15 @@ class BatchingEngine:
     - ``max_num_batched_tokens``: per-scheduler-iteration prefill
       token budget — bounds how much prompt work can run between two
       decode dispatches (the chunked-prefill interleaving lever).
+      With multiple tenants the budget splits by weighted deficit
+      round-robin over the request ``tenant`` field.
     - ``prefill_chunk``: max tokens per prefill dispatch.
+    - ``prefix_caching``: automatic block-granular prefix caching
+      (default on): admission matches the prompt's hash chain,
+      reuses hit blocks and prefills only the suffix — token-exact
+      under greedy decoding (kv_pool.py module docstring).
+    - ``tenant_weights``: optional per-tenant weights for the
+      fair-share budget split (absent tenants weigh 1.0).
     """
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
@@ -496,7 +556,9 @@ class BatchingEngine:
                  block_size: int = 16,
                  num_blocks: Optional[int] = None,
                  max_num_batched_tokens: Optional[int] = 2048,
-                 prefill_chunk: int = 512):
+                 prefill_chunk: int = 512,
+                 prefix_caching: bool = True,
+                 tenant_weights: Optional[Dict[str, float]] = None):
         self.params = params
         self.config = config
         self.slots = slots
@@ -542,6 +604,30 @@ class BatchingEngine:
         self.kv_int8 = kv_int8
         self.prefill_chunk = max(1, prefill_chunk)
         self.max_batched_tokens = max_num_batched_tokens
+        # Automatic prefix caching (kv_pool.py module docstring):
+        # admission matches the prompt's hash chain, pins hit blocks
+        # and prefills only the suffix; completed full prompt blocks
+        # register into the cache. Exact under greedy decoding —
+        # cached K/V is precisely what re-prefilling the same prefix
+        # would write.
+        self.prefix_caching = prefix_caching
+        # Per-tenant weighted deficit round-robin over the prefill
+        # token budget (fair-share QoS): deficits accrue a weighted
+        # share of max_num_batched_tokens per scheduler iteration.
+        self.tenant_weights = dict(tenant_weights or {})
+        self._tenant_deficit: Dict[str, float] = {}
+        self._tenant_rr = 0
+        # Trailing-window hit-rate state (engine-local cumulatives —
+        # the counter FAMILIES are process-global and shared across
+        # engines): snapshots of (ts, hits, misses), ~1/s, pruned to
+        # PREFIX_RATIO_WINDOW_SECONDS. The exported ratio gauge is a
+        # WINDOWED rate, so a warm replica whose hits collapse (LB
+        # policy misconfigured away from affinity) trips the
+        # prefix-hit-ratio-low alert within the window instead of
+        # being averaged away by days of cumulative history.
+        self._prefix_hits_local = 0
+        self._prefix_misses_local = 0
+        self._prefix_window: 'collections.deque' = collections.deque()
         self.pool = kv_pool_lib.KVBlockPool(config, num_blocks,
                                             block_size,
                                             kv_int8=kv_int8)
@@ -573,13 +659,31 @@ class BatchingEngine:
             maxlen=4096)
         self.wake = threading.Event()
         self._stop = False
+        # Set on engine DEATH (never on clean close): submits after
+        # the loop died get this pushed ahead of their sentinel.
+        self._death_exc: Optional[BaseException] = None
         self._step_fn = jax.jit(decode_steps_paged,
                                 static_argnums=(6, 7, 8),
                                 donate_argnums=(2,))
         self._prefill_fn = jax.jit(decode.forward_paged,
                                    static_argnums=(6, 7),
                                    donate_argnums=(2,))
+        # COW primitive: duplicate a cached block before diverging
+        # writes (src/dst traced — one executable for every copy).
+        self._copy_fn = jax.jit(kv_pool_lib.copy_pool_block,
+                                donate_argnums=(0,))
+        if self.prefix_caching:
+            # Prewarm the copy executable (scratch onto itself is a
+            # no-op) so the FIRST partial-block hit in production
+            # does not pay the compile inside a request's TTFT.
+            scratch = jnp.asarray(kv_pool_lib.SCRATCH_BLOCK,
+                                  jnp.int32)
+            self.caches = self._copy_fn(self.caches, scratch,
+                                        scratch)
         self._metrics = _engine_metrics()
+        # Lazily created on first real traffic (MFU-gauge precedent):
+        # an engine with caching off must not export a fake 0 ratio.
+        self._hit_ratio_gauge = None
         self._metrics['slots'].set(slots)
         self._cache_bytes = self.pool.nbytes
         self._metrics['kv_bytes'].set(self._cache_bytes)
@@ -592,19 +696,38 @@ class BatchingEngine:
     # -- client API -----------------------------------------------------
 
     def submit(self, prompt_ids: List[int], max_new: int,
-               eos_id: Optional[int] = None) -> 'queue.Queue':
+               eos_id: Optional[int] = None,
+               tenant: Optional[str] = None) -> 'queue.Queue':
         """Returns a Queue yielding generated ids then None. With
         ``eos_id``, the row retires the moment it emits that id
         (the EOS itself is emitted, matching greedy_generate). A
         request the pool can never hold yields a typed
         ``KVPoolExhaustedError`` before its None."""
+        return self.submit_request(prompt_ids, max_new,
+                                   eos_id=eos_id, tenant=tenant).out
+
+    def submit_request(self, prompt_ids: List[int], max_new: int,
+                       eos_id: Optional[int] = None,
+                       tenant: Optional[str] = None) -> _Request:
+        """``submit`` returning the request object itself: ``.out``
+        is the token queue, and after admission (i.e. by the first
+        token) ``.prefix_hit_blocks``/``.prefix_miss_blocks`` carry
+        the prefix-cache accounting serve_model exports as response
+        headers."""
         max_new = min(max_new,
                       self.max_seq - len(prompt_ids) - 1)
         req = _Request(list(prompt_ids), max(0, max_new),
-                       eos_id=eos_id)
+                       eos_id=eos_id, tenant=tenant)
         if req.max_new == 0 or self._stop:
+            # A DEAD engine (not a clean close / zero-budget
+            # request) fails post-death submits typed: serve_model
+            # answers the exception 500, which the replica-5xx-rate
+            # page needs — a bare sentinel would read as a clean
+            # empty 200 from a replica that can never serve again.
+            if self._stop and self._death_exc is not None:
+                req.out.put(self._death_exc)
             req.out.put(None)
-            return req.out
+            return req
         if self.pool.blocks_for(len(prompt_ids) + 1) > \
                 self.pool.usable_blocks:
             # This prompt alone exceeds the whole pool: fail THIS
@@ -616,24 +739,28 @@ class BatchingEngine:
                 f'blocks but the pool has only '
                 f'{self.pool.usable_blocks} usable '
                 f'(block_size={self.block_size})')
-            return req.out
+            return req
         with self._pending_lock:
             self.pending.append(req)
         self.wake.set()
-        # close() may have stopped the loop between the _stop check
-        # above and the append — the exited loop will never drain
-        # this request, so sentinel it here (a double None from
-        # racing _drain_all is harmless: consumers stop at the
-        # first).
+        # close()/death may have stopped the loop between the _stop
+        # check above and the append — the exited loop will never
+        # drain this request, so sentinel it here (a double None
+        # from racing _drain_all is harmless: consumers stop at the
+        # first; same typed-death rule as the early return above).
         if self._stop:
+            if self._death_exc is not None:
+                req.out.put(self._death_exc)
             req.out.put(None)
-        return req.out
+        return req
 
     def generate(self, prompt_ids: List[int], max_new: int,
-                 eos_id: Optional[int] = None) -> List[int]:
+                 eos_id: Optional[int] = None,
+                 tenant: Optional[str] = None) -> List[int]:
         """Blocking convenience: collect the full generation. Raises
         the typed error if the request failed."""
-        q = self.submit(prompt_ids, max_new, eos_id=eos_id)
+        q = self.submit(prompt_ids, max_new, eos_id=eos_id,
+                        tenant=tenant)
         out: List[int] = []
         while True:
             tok = q.get()
@@ -677,7 +804,12 @@ class BatchingEngine:
 
     def _release_row(self, row: int) -> None:
         if self.slot_blocks[row]:
-            self.pool.free(self.slot_blocks[row])
+            # One decrement per held block — shared (pinned) prefix
+            # blocks stay alive for their other holders. DEEPEST
+            # first: released chains enter the cached LRU leaf-first,
+            # so eviction peels chains from the tail instead of
+            # orphaning descendants by evicting their parent.
+            self.pool.free(list(reversed(self.slot_blocks[row])))
         self.slot_blocks[row] = []
         self.slot_req[row] = None
         self.slot_left[row] = 0
@@ -748,11 +880,66 @@ class BatchingEngine:
 
     # -- engine loop ----------------------------------------------------
 
+    def _match_prefix(self, req: _Request, tokens_all: List[int],
+                      t0: int):
+        """Prefix-cache lookup for an admission: returns
+        (pinned_blocks, cow, cached_tokens) where ``pinned_blocks``
+        are the full-block chain hits (already pinned) and ``cow``
+        is an optional (src_block, shared_tokens) partial hit past
+        them. Reuse is capped at t0 - 1 tokens: the LAST prompt
+        token is always recomputed so its logits seed decoding.
+        The computed chain is stashed on the request for
+        ``_register_prefix`` to reuse."""
+        if not self.prefix_caching or t0 < 2:
+            return [], None, 0
+        if req.chain_t0 == t0 and req.chain_hashes:
+            # Re-admission of a request requeued by
+            # _unwind_admission (pool momentarily full): the token
+            # stream is unchanged, so the stashed chain is still
+            # valid — don't re-hash the whole prompt on every
+            # scheduler iteration while waiting for blocks. A
+            # preemption resume has grown ``generated`` (t0
+            # changed) and recomputes.
+            hashes = req.chain_hashes
+        else:
+            hashes = kv_pool_lib.chain_hashes(tokens_all,
+                                              self.block_size)
+            req.chain_hashes = hashes
+            req.chain_t0 = t0
+        matched = self.pool.match(hashes)
+        max_reuse_blocks = (t0 - 1) // self.block_size
+        matched = matched[:max_reuse_blocks]
+        cached_tokens = len(matched) * self.block_size
+        parent = hashes[len(matched) - 1] if matched \
+            else kv_pool_lib.ROOT_HASH
+        cow = None
+        rest = tokens_all[cached_tokens:
+                          min(cached_tokens + self.block_size,
+                              t0 - 1)]
+        if rest:
+            cow = self.pool.partial_match(parent, rest)
+        if matched:
+            self.pool.pin(matched)
+        return matched, cow, cached_tokens
+
+    def _unwind_admission(self, req: _Request,
+                          blocks: List[int]) -> None:
+        """Admission could not complete (pool momentarily full):
+        release whatever was pinned/allocated — exactly once — and
+        requeue the request at the front to retry after
+        retirements free capacity."""
+        if blocks:
+            self.pool.free(list(reversed(blocks)))
+        self._push_front(req)
+
     def _admit_pending(self) -> None:
         """Token-budget admission: a request is admitted when a
         decode row is free AND the pool has blocks for its whole
         prompt (+1 for the first generated token) — free blocks, not
-        free slots, are the admission currency."""
+        free slots, are the admission currency. With prefix caching,
+        the prompt's hash chain is matched first: hit blocks are
+        PINNED (refcount++) and only the suffix past them is
+        prefilled — repeat prefixes skip their prefill entirely."""
         for row in range(self.slots):
             if self._stop:
                 return
@@ -761,7 +948,8 @@ class BatchingEngine:
             req = self._pop_pending()
             if req is None:
                 return
-            t0 = len(req.prompt_ids) + len(req.generated)
+            tokens_all = req.prompt_ids + req.generated
+            t0 = len(tokens_all)
             need = self.pool.blocks_for(t0 + 1)
             if need > self.pool.usable_blocks:
                 # Can never fit (a preempted request that grew past a
@@ -771,13 +959,50 @@ class BatchingEngine:
                     f'blocks but the pool has only '
                     f'{self.pool.usable_blocks} usable')
                 continue
-            blocks = self.pool.try_alloc(need)
-            if blocks is None:
+            matched, cow, cached_tokens = self._match_prefix(
+                req, tokens_all, t0)
+            blocks = list(matched)
+            if cow is not None:
+                # Copy-on-write: duplicate the partially-matching
+                # cached block into a private one; prefill resumes at
+                # the first divergent token, overwriting the rest.
+                src, shared = cow
+                self.pool.pin([src])     # eviction-proof during copy
+                got = self.pool.try_alloc(1)
+                if got is None:
+                    self.pool.free([src])
+                    self._unwind_admission(req, blocks)
+                    return
+                self.caches = self._copy_fn(
+                    self.caches, jnp.asarray(src, jnp.int32),
+                    jnp.asarray(got[0], jnp.int32))
+                self.pool.free([src])
+                blocks.append(got[0])
+                cached_tokens += shared
+            extra = need - len(blocks)
+            got = self.pool.try_alloc(extra) if extra > 0 else []
+            if got is None:
                 # Not enough free blocks yet: wait for retirements
                 # (in-flight rows make progress every iteration, so
                 # this cannot deadlock).
-                self._push_front(req)
+                self._unwind_admission(req, blocks)
                 return
+            blocks.extend(got)
+            if self.prefix_caching:
+                # Accounting over PROMPT blocks only — the +1 block
+                # reserved for the first generated token is never
+                # prefilled, so counting it as a miss would cap a
+                # fully-cached short prompt at 50%. A COW partial
+                # hit still counts as a miss (the block is copied
+                # and partially re-prefilled).
+                hit = len(matched)
+                miss = max(0, self.pool.blocks_for(t0) - hit)
+                self._metrics['prefix_hits'].inc(hit)
+                self._metrics['prefix_misses'].inc(miss)
+                self._prefix_hits_local += hit
+                self._prefix_misses_local += miss
+                req.prefix_hit_blocks += hit
+                req.prefix_miss_blocks += miss
             if not req.admitted_once:
                 # First admission only: a preempted request's
                 # re-admission delay is service disruption, not
@@ -795,7 +1020,9 @@ class BatchingEngine:
                 self._metrics['requests'].inc()
             self.slot_req[row] = req
             self.slot_blocks[row] = blocks
-            self.slot_off[row] = 0
+            # Cache-hit tokens are ALREADY in the row's blocks —
+            # prefill starts at the suffix (the whole TTFT win).
+            self.slot_off[row] = cached_tokens
             self.slot_total[row] = t0
             self.slot_left[row] = 0
             self.slot_len[row] = 0
@@ -804,6 +1031,7 @@ class BatchingEngine:
             self._admit_seq += 1
             self.slot_seq[row] = self._admit_seq
             self._set_table_row(row)
+            self.events.append(('admit', row, cached_tokens, t0))
             # Park the lane OUT OF RANGE until prefill finishes:
             # decode dispatches treat the row as inactive but still
             # write (static shapes), and write_index redirects
@@ -823,55 +1051,170 @@ class BatchingEngine:
             bucket *= 2
         return min(bucket, self.prefill_chunk)
 
+    def _tenant_weight(self, tenant: str) -> float:
+        w = self.tenant_weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    def _run_prefill_row(self, row: int) -> int:
+        """One prefill chunk for ``row``; returns the bucket tokens
+        charged (0 if the row has nothing left)."""
+        req = self.slot_req[row]
+        t0 = self.slot_total[row]
+        off = self.slot_off[row]
+        if off >= t0:
+            return 0
+        bucket = self._chunk_bucket(t0 - off)
+        real = min(t0 - off, bucket)
+        if self._prefill_t0[row] is None:
+            self._prefill_t0[row] = time.time()
+        # Slice the chunk straight out of prompt_ids/generated
+        # (the logical prompt is their concatenation, and generated
+        # is static while this row prefills) — concatenating the
+        # whole prompt per chunk would copy O(prompt) on the engine
+        # loop for every chunk of a long prompt.
+        n_p = len(req.prompt_ids)
+        if off + real <= n_p:
+            chunk = req.prompt_ids[off:off + real]
+        elif off >= n_p:
+            chunk = req.generated[off - n_p:off - n_p + real]
+        else:
+            chunk = (req.prompt_ids[off:] +
+                     req.generated[:off + real - n_p])
+        padded = chunk + [0] * (bucket - real)
+        chunk_tokens = jnp.asarray([padded], jnp.int32)
+        logits, self.caches = self._prefill_fn(
+            self.params, chunk_tokens, self.caches,
+            self.block_tables[row],
+            jnp.asarray(off, jnp.int32),
+            jnp.asarray(real, jnp.int32),
+            self.config, self.block_size)
+        self.slot_off[row] = off + real
+        self._prefill_chunks[row] += 1
+        self.events.append(('prefill_chunk', row, off + real, t0))
+        if self.slot_off[row] >= t0:
+            self._finish_prefill(row, logits)
+        return bucket
+
     def _run_prefill_chunks(self) -> bool:
-        """Run prefill chunks for admitted-but-unprefilled rows, in
-        admission order, within this iteration's token budget.
-        Chunks beyond the budget wait for the NEXT iteration — a
-        decode dispatch runs in between, which is exactly the
-        chunked-prefill interleaving contract."""
+        """Run prefill chunks for admitted-but-unprefilled rows
+        within this iteration's token budget. Chunks beyond the
+        budget wait for the NEXT iteration — a decode dispatch runs
+        in between, which is exactly the chunked-prefill
+        interleaving contract.
+
+        The budget is split across TENANTS by weighted deficit
+        round-robin (fair-share QoS): each tenant with pending
+        prefill accrues a weighted share of the budget per
+        iteration and spends it in admission order; unspent deficit
+        carries over, so one tenant's long prompts cannot starve
+        another's TTFT. A second, deficit-blind pass keeps the
+        scheduler work-conserving (leftover budget is never idled
+        while any prefill is pending, and the free capacity is not
+        charged against future shares)."""
         budget = self.max_batched_tokens or float('inf')
-        progressed = False
         rows = sorted(
             (i for i in range(self.slots)
              if self.slot_req[i] is not None
              and self.slot_off[i] < self.slot_total[i]),
             key=lambda i: self.slot_seq[i])
-        for row in rows:
-            req = self.slot_req[row]
-            prompt = req.prompt_ids + req.generated
-            t0 = self.slot_total[row]
-            while budget > 0 and self.slot_off[row] < t0 and \
-                    not self._stop:
-                off = self.slot_off[row]
-                bucket = self._chunk_bucket(t0 - off)
-                real = min(t0 - off, bucket)
-                if self._prefill_t0[row] is None:
-                    self._prefill_t0[row] = time.time()
-                padded = prompt[off:off + real] + [0] * (bucket - real)
-                chunk_tokens = jnp.asarray([padded], jnp.int32)
-                logits, self.caches = self._prefill_fn(
-                    self.params, chunk_tokens, self.caches,
-                    self.block_tables[row],
-                    jnp.asarray(off, jnp.int32),
-                    jnp.asarray(real, jnp.int32),
-                    self.config, self.block_size)
-                self.slot_off[row] = off + real
-                self._prefill_chunks[row] += 1
-                budget -= bucket
-                progressed = True
-                self.events.append(
-                    ('prefill_chunk', row, off + real, t0))
-                if self.slot_off[row] >= t0:
-                    self._finish_prefill(row, logits)
-            if budget <= 0:
+        if not rows:
+            return False
+        by_tenant: Dict[str, List[int]] = {}
+        for i in rows:
+            by_tenant.setdefault(self.slot_req[i].tenant or '',
+                                 []).append(i)
+        tenants = sorted(by_tenant)
+        metered = budget != float('inf')
+        if metered:
+            total_w = sum(self._tenant_weight(t) for t in tenants)
+            for t in tenants:
+                quantum = budget * self._tenant_weight(t) / total_w
+                # Cap banked credit at two full budgets so a
+                # long-idle-then-bursty tenant cannot monopolize one
+                # iteration with accumulated deficit.
+                self._tenant_deficit[t] = min(
+                    self._tenant_deficit.get(t, 0.0) + quantum,
+                    2.0 * budget)
+            # A tenant with nothing pending banks no credit.
+            for t in list(self._tenant_deficit):
+                if t not in by_tenant:
+                    del self._tenant_deficit[t]
+        # Rotate the service order so equal-deficit tenants take
+        # turns going first.
+        start = self._tenant_rr % len(tenants)
+        self._tenant_rr += 1
+        order = tenants[start:] + tenants[:start]
+        spent = 0.0
+        ran_any = False
+        for deficit_blind in (False, True):
+            for t in order:
+                for row in by_tenant[t]:
+                    while (self.slot_req[row] is not None
+                           and self.slot_off[row] <
+                           self.slot_total[row]
+                           and not self._stop):
+                        if spent >= budget:
+                            return ran_any
+                        if metered and not deficit_blind:
+                            bucket = self._chunk_bucket(
+                                self.slot_total[row] -
+                                self.slot_off[row])
+                            if self._tenant_deficit.get(t, 0.0) \
+                                    < bucket and ran_any:
+                                # Deficit exhausted: this tenant
+                                # waits (credit carries over) while
+                                # others run. The very first chunk
+                                # of an iteration may overdraft so
+                                # a budget smaller than one chunk
+                                # still makes progress.
+                                break
+                        charged = self._run_prefill_row(row)
+                        if charged <= 0:
+                            break
+                        spent += charged
+                        if metered and not deficit_blind:
+                            self._tenant_deficit[t] = \
+                                self._tenant_deficit.get(t, 0.0) \
+                                - charged
+                        ran_any = True
+            if not metered:
                 break
-        return progressed
+        return ran_any
+
+    def _register_prefix(self, row: int) -> None:
+        """Publish the row's FULL prompt blocks into the prefix
+        cache: each complete block's content now equals its chain
+        hash's token block, so future prompts sharing the prefix can
+        pin them. The trailing partial block (still written by
+        decode) is never registered — registered blocks are
+        immutable from here on (all later writes land past t0)."""
+        if not self.prefix_caching:
+            return
+        req = self.slot_req[row]
+        t0 = self.slot_total[row]
+        tokens_all = (req.prompt_ids + req.generated)[:t0]
+        if req.chain_t0 == t0 and req.chain_hashes:
+            # Reuse the admission-time chain (same tokens: generated
+            # does not grow between admission and prefill finish).
+            hashes = req.chain_hashes
+        else:
+            hashes = kv_pool_lib.chain_hashes(tokens_all,
+                                              self.block_size)
+        blocks = self.slot_blocks[row]
+        parent = kv_pool_lib.ROOT_HASH
+        for i, h in enumerate(hashes):
+            self.pool.register(
+                blocks[i], h, parent,
+                tokens_all[i * self.block_size:
+                           (i + 1) * self.block_size])
+            parent = h
 
     def _finish_prefill(self, row: int, logits: jax.Array) -> None:
         """Last prompt chunk done: its logits seed greedy decoding —
         the first generated token comes from the prefill itself."""
         req = self.slot_req[row]
         t0 = self.slot_total[row]
+        self._register_prefix(row)
         first = int(jax.device_get(logits)[0].argmax())
         # The int() above synchronizes, so these are real wall times.
         t_first = time.time()
@@ -993,30 +1336,103 @@ class BatchingEngine:
     def _set_gauges(self) -> None:
         self._metrics['occupancy'].set(sum(
             1 for r in self.slot_req if r is not None))
+        # used = REFERENCED blocks only; cached (refcount-0,
+        # reclaimable) bytes are split out so a full-looking pool
+        # that is mostly reusable cache reads as healthy
+        # (docs/observability.md).
         self._metrics['kv_blocks_used'].set(self.pool.used_blocks)
         self._metrics['kv_used'].set(
             self.pool.used_blocks * self.pool.block_bytes)
+        self._metrics['kv_cached'].set(
+            self.pool.cached_blocks * self.pool.block_bytes)
+        self._metrics['prefix_cached_blocks'].set(
+            self.pool.cached_blocks)
+        if self.prefix_caching:
+            now = time.time()
+            win = self._prefix_window
+            if not win or now - win[-1][0] >= 1.0:
+                win.append((now, self._prefix_hits_local,
+                            self._prefix_misses_local))
+            horizon = now - PREFIX_RATIO_WINDOW_SECONDS
+            while len(win) > 1 and win[1][0] <= horizon:
+                win.popleft()
+            d_hits = self._prefix_hits_local - win[0][1]
+            d_total = d_hits + (self._prefix_misses_local -
+                                win[0][2])
+            if d_total <= 0 and self._hit_ratio_gauge is not None:
+                # No admissions in the whole trailing window: DROP
+                # the series rather than re-export the last value
+                # forever — a frozen low ratio on an idle replica
+                # would keep prefix-hit-ratio-low firing with no
+                # traffic behind it (absent data correctly no-fires
+                # threshold rules). One unregister per idle
+                # transition; traffic re-creates it lazily.
+                metrics_lib.registry().unregister(
+                    'skytpu_batch_prefix_hit_ratio')
+                self._hit_ratio_gauge = None
+            if d_total > 0:
+                # Re-resolve via get-or-create on EVERY write (a
+                # dict lookup): the family is process-global, and a
+                # sibling engine's idle sweep may have unregistered
+                # it — a cached reference would keep set()ing a
+                # detached object while the series silently vanished
+                # from /metrics. Still lazy: only a caching engine
+                # with traffic in-window exports a ratio (no fake
+                # 0%). The series is UNLABELED and therefore
+                # last-writer-wins: it assumes the production
+                # layout of one engine per replica process
+                # (serve_model builds exactly one) — two engines
+                # with live traffic in one process would flap it.
+                # Sibling engines only arise in tests, where at
+                # most one has in-window traffic at a time.
+                self._hit_ratio_gauge = \
+                    metrics_lib.registry().gauge(
+                        'skytpu_batch_prefix_hit_ratio',
+                        'Fraction of prompt KV blocks served '
+                        'from the prefix cache at admission '
+                        'over the trailing window (a windowed '
+                        'rate, not a since-boot cumulative — '
+                        'the prefix-hit-ratio-low alert needs '
+                        'regressions visible within its '
+                        'window).')
+                self._hit_ratio_gauge.set(d_hits / d_total)
 
     def _fail_all(self, exc: BaseException) -> None:
         """Fail-stop for ENGINE death (an unexpected loop exception):
         unblock every waiter — a silently dead loop thread would hang
-        all current AND future requests forever. Pool exhaustion
-        never comes here: it preempts or fails the one request."""
+        all current AND future requests forever — and push the FATAL
+        exception ahead of each sentinel, so clients see a failure
+        (serve_model answers it 500, which the replica-5xx-rate page
+        needs to notice a dead engine) instead of a silently
+        truncated 200. Pool exhaustion never comes here: it preempts
+        or fails the one request."""
         logger.error('Batching engine died: %r', exc)
-        self._drain_all()
+        self._drain_all(exc=exc)
 
-    def _drain_all(self) -> None:
+    def _drain_all(self, exc: Optional[BaseException] = None) -> None:
         """Put the None sentinel on every active slot queue and every
-        still-pending request so no waiter blocks past loop exit."""
+        still-pending request so no waiter blocks past loop exit.
+        ``exc`` (engine death only — a clean close() drains without
+        it) precedes each sentinel as the typed failure. The death
+        exception is also stashed so requests submitted AFTER the
+        drain fail typed too (submit_request) — a dead replica must
+        answer 500, not a clean-looking empty 200, or the
+        replica-5xx-rate page never notices it."""
+        if exc is not None:
+            self._death_exc = exc
         self._stop = True
         for i, req in enumerate(self.slot_req):
             if req is not None:
+                if exc is not None:
+                    req.out.put(exc)
                 req.out.put(None)
                 self.slot_req[i] = None
         while True:
             req = self._pop_pending()
             if req is None:
                 return
+            if exc is not None:
+                req.out.put(exc)
             req.out.put(None)
 
     def _loop(self) -> None:

@@ -15,6 +15,7 @@ from skypilot_tpu import tpu_logging
 from skypilot_tpu.metrics import history as history_lib
 from skypilot_tpu.metrics import query as query_lib
 from skypilot_tpu.resilience import watchdog as watchdog_lib
+from skypilot_tpu.serve import load_balancer as load_balancer_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import upgrade as upgrade_lib
 from skypilot_tpu.serve.autoscalers import (AutoscalerDecisionOperator,
@@ -48,6 +49,12 @@ class SkyServeController:
         self.autoscaler = make_autoscaler(self.spec)
         self.load_balancer = SkyServeLoadBalancer(
             lb_port, self.replica_manager.ready_endpoints,
+            # KV-aware routing when the spec asks for it
+            # (load_balancing_policy: prefix_affinity): repeat
+            # prompts land on the replica whose prefix cache already
+            # holds their blocks.
+            policy=load_balancer_lib.make_policy(
+                self.spec.load_balancing_policy),
             tls_keyfile=self.spec.tls_keyfile,
             tls_certfile=self.spec.tls_certfile)
         # Scale on the LB's MEASURED windowed QPS; the drained
@@ -202,6 +209,17 @@ class SkyServeController:
         rules (the version may declare a different SLO). Shared by
         the update pickup and the rollback's re-adoption of the
         prior version."""
+        if spec.load_balancing_policy != \
+                self.spec.load_balancing_policy:
+            # Swap the routing policy in place (atomic reference
+            # write). In-flight requests' end callbacks land on the
+            # NEW policy, so it inherits the old one's in-flight
+            # counts — a loaded fleet must not read as idle to the
+            # fresh policy.
+            new_policy = load_balancer_lib.make_policy(
+                spec.load_balancing_policy)
+            new_policy.carry_state_from(self.load_balancer.policy)
+            self.load_balancer.policy = new_policy
         self.spec = spec
         old_target = self.autoscaler.target_num_replicas
         self.autoscaler = make_autoscaler(spec)

@@ -30,8 +30,32 @@ TPU-first design notes:
   replicated — blocks are shared across requests, so there is no
   batch axis to shard. ``pool_shardings`` builds the NamedShardings
   from the same rules→specs idiom as the training partitioner.
+
+Automatic prefix caching (the vLLM/SGLang radix-reuse lineage, block
+granular): blocks are REFCOUNTED, and a full block whose content is a
+complete token block of some prompt can be REGISTERED under its
+chain hash (``serve/prefix_hash.py`` — the hash commits to the whole
+token prefix, so hash equality == reuse-safe KV equality). The
+free list becomes two tiers:
+
+- ``_free``: refcount-0 UNREGISTERED blocks (content meaningless) —
+  handed out first;
+- ``_cached``: refcount-0 REGISTERED blocks in LRU order — their
+  content is intact and matchable, and they are evicted (oldest
+  first, hash unregistered) only when ``_free`` runs dry. A cached
+  block is reclaimable capacity, never corrupted-in-place: eviction
+  happens only through the allocator, and every table-referenced
+  block holds a reference.
+
+Admission matches an incoming prompt's hash chain
+(``match``/``pin``), pins the hit blocks (refcount++), and prefills
+only the suffix; ``free`` only ever decrements. Shared blocks are
+immutable by construction — only FULL blocks are registered, and a
+request's writes land strictly past its reused prefix — so the
+SCRATCH invariant and the write-index math above are unchanged.
 """
-from typing import List, Optional, Tuple
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,11 +63,22 @@ import jax.numpy as jnp
 from skypilot_tpu import exceptions
 from skypilot_tpu import tpu_logging
 from skypilot_tpu.models import llama
+from skypilot_tpu.serve import prefix_hash
 
 logger = tpu_logging.init_logger(__name__)
 
 # The reserved scratch block (see module docstring).
 SCRATCH_BLOCK = 0
+
+# Partial-match (COW) index bound: at most this many registered
+# children per chain parent are kept discoverable for partial-block
+# matching. A hot shared prefix accumulates one divergent child per
+# completed suffix — without the cap, every admission under that
+# prefix would scan an unbounded sibling list inside the
+# single-threaded engine loop. Blocks past the cap still register
+# for EXACT full-chain matching (the common win); they just aren't
+# COW candidates.
+MAX_PARTIAL_CHILDREN = 64
 
 
 # ---------------------------------------------------------------------
@@ -144,12 +179,27 @@ class KVBlockPool:
         # the arrays, so live-array introspection is not an option.
         self._nbytes = sum(int(c.nbytes) for c in caches
                            if c is not None)
-        # LIFO free list (hot blocks stay cache/HBM-warm) + a
-        # membership set so free()'s double-free check stays O(1) at
-        # production pool sizes; block 0 (scratch) is never handed
-        # out.
+        # LIFO free list (hot blocks stay cache/HBM-warm); block 0
+        # (scratch) is never handed out. Double-free detection moved
+        # to the refcount table below — a block with no reference is
+        # simply not freeable.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._free_set = set(self._free)
+        # Prefix cache (module docstring): refcounts for allocated
+        # blocks, LRU over refcount-0 registered blocks, and the
+        # hash-chain registry. ``_hash_meta`` keeps (parent, tokens)
+        # per registered hash so partial-block matches (copy-on-write
+        # at the first divergent token) can compare token prefixes,
+        # and ``_by_parent`` indexes registered children per chain
+        # parent for that lookup.
+        self._refcount: Dict[int, int] = {}
+        self._cached: 'collections.OrderedDict[int, bytes]' = \
+            collections.OrderedDict()   # block -> hash, oldest first
+        self._hash_to_block: Dict[bytes, int] = {}
+        self._block_hash: Dict[int, bytes] = {}
+        self._hash_meta: Dict[bytes, Tuple[bytes, Tuple[int, ...]]] = {}
+        self._by_parent: Dict[bytes, List[bytes]] = {}
+        self.evictions = 0      # cached blocks reclaimed by alloc
+
 
     # -- capacity ------------------------------------------------------
 
@@ -160,11 +210,21 @@ class KVBlockPool:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """RECLAIMABLE blocks: truly free plus refcount-0 cached.
+        Cached blocks are capacity — admission may take them (evicting
+        their content) — so exhaustion means free + cached == 0."""
+        return len(self._free) + len(self._cached)
 
     @property
     def used_blocks(self) -> int:
-        return self.usable_blocks - len(self._free)
+        """Blocks currently REFERENCED by admitted requests (cached
+        refcount-0 blocks are free_blocks, not used)."""
+        return self.usable_blocks - self.free_blocks
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 blocks holding registered (reusable) content."""
+        return len(self._cached)
 
     @property
     def nbytes(self) -> int:
@@ -182,14 +242,26 @@ class KVBlockPool:
     # -- allocation ----------------------------------------------------
 
     def try_alloc(self, n: int) -> Optional[List[int]]:
-        """Pop ``n`` blocks, or None (and no change) if fewer are
-        free — the caller decides between waiting and preempting."""
+        """Pop ``n`` blocks (refcount 1 each), or None (and no
+        change) if fewer are reclaimable — the caller decides between
+        waiting and preempting. Truly-free blocks are taken first;
+        only then are LRU cached blocks evicted (content
+        unregistered), so resident cache survives as long as real
+        free capacity lasts."""
         if n < 0:
-            raise ValueError(f'negative alloc: {n}')
-        if n > len(self._free):
+            raise exceptions.KVBlockError(f'negative alloc: {n}')
+        if n > self.free_blocks:
             return None
-        out = [self._free.pop() for _ in range(n)]
-        self._free_set.difference_update(out)
+        out: List[int] = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                b, h = self._cached.popitem(last=False)  # LRU oldest
+                self._unregister(b, h)
+                self.evictions += 1
+            self._refcount[b] = 1
+            out.append(b)
         return out
 
     def alloc(self, n: int) -> List[int]:
@@ -197,18 +269,179 @@ class KVBlockPool:
         if blocks is None:
             raise exceptions.KVPoolExhaustedError(
                 f'KV pool exhausted: need {n} blocks, '
-                f'{len(self._free)} free of {self.usable_blocks} '
-                f'usable')
+                f'{self.free_blocks} reclaimable of '
+                f'{self.usable_blocks} usable')
         return blocks
 
     def free(self, blocks: List[int]) -> None:
+        """Release one reference per block. At refcount 0 a
+        registered block parks in the cached LRU (content intact,
+        reclaimable); an unregistered one returns to the free list.
+        Releasing a block that holds no reference — double free, or
+        a block another request still exclusively owns never being
+        yours to free — is a typed ``KVBlockError``, checked for the
+        WHOLE batch before any state changes (atomic)."""
         for b in blocks:
             if not 0 < b < self.num_blocks:
-                raise ValueError(f'freeing invalid block id {b}')
-            if b in self._free_set:
-                raise ValueError(f'double free of block {b}')
-        self._free.extend(blocks)
-        self._free_set.update(blocks)
+                raise exceptions.KVBlockError(
+                    f'freeing invalid block id {b}')
+            if self._refcount.get(b, 0) < 1:
+                raise exceptions.KVBlockError(
+                    f'double free of block {b} (refcount 0)')
+        counts: Dict[int, int] = {}
+        for b in blocks:
+            counts[b] = counts.get(b, 0) + 1
+        for b, k in counts.items():
+            if self._refcount[b] < k:
+                raise exceptions.KVBlockError(
+                    f'freeing block {b} {k} times with refcount '
+                    f'{self._refcount[b]}')
+        for b in blocks:
+            rc = self._refcount[b] - 1
+            if rc > 0:
+                self._refcount[b] = rc
+                continue
+            del self._refcount[b]
+            h = self._block_hash.get(b)
+            if h is not None:
+                # Most-recent end of the LRU. Callers release a
+                # request's chain DEEPEST-FIRST (reversed) so parents
+                # end up younger than children and eviction peels
+                # chains from the leaves — evicting a parent first
+                # would strand its still-cached descendants
+                # (unmatchable until their own LRU turn).
+                self._cached[b] = h
+            else:
+                self._free.append(b)
+
+    # -- prefix cache ---------------------------------------------------
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest registered prefix of the chain: block ids for
+        ``hashes[0..k)`` where every link resolves to a live block
+        (cached or referenced). Does NOT pin — callers pin before the
+        next alloc can evict."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def partial_match(self, parent: bytes,
+                      tokens: Sequence[int]
+                      ) -> Optional[Tuple[int, int]]:
+        """Best partial-block hit past the full-block chain: among
+        registered blocks whose chain parent is ``parent``, the one
+        sharing the longest leading token run with ``tokens``.
+        Returns (block_id, shared_tokens) or None. This is the
+        copy-on-write seed — the caller copies the block and
+        recomputes from the first divergent token."""
+        best: Optional[Tuple[int, int]] = None
+        for h in self._by_parent.get(parent, ()):
+            b = self._hash_to_block.get(h)
+            if b is None:
+                continue
+            _, cached_tokens = self._hash_meta[h]
+            d = 0
+            for a, c in zip(tokens, cached_tokens):
+                if a != c:
+                    break
+                d += 1
+            if d > 0 and (best is None or d > best[1]):
+                best = (b, d)
+        return best
+
+    def pin(self, blocks: Sequence[int]) -> None:
+        """Take a reference on matched blocks: a cached block leaves
+        the LRU (refcount 1); an already-referenced block is shared
+        (refcount++). Pinning a block that is neither — freed or
+        evicted since the match — is a typed error, so a stale match
+        can never alias recycled content."""
+        for b in blocks:
+            if b in self._cached:
+                continue
+            if self._refcount.get(b, 0) < 1:
+                raise exceptions.KVBlockError(
+                    f'pin of unallocated block {b} (stale match?)')
+        for b in blocks:
+            if b in self._cached:
+                del self._cached[b]
+                self._refcount[b] = 1
+            else:
+                self._refcount[b] += 1
+
+    def register(self, block: int, block_hash: bytes, parent: bytes,
+                 tokens: Sequence[int]) -> bool:
+        """Record that ``block`` holds the FULL token block
+        ``tokens`` at chain position ``block_hash`` (parent =
+        preceding link). First writer wins: if the hash is already
+        registered (a concurrent identical prompt prefilled its own
+        copy) the existing block stays canonical and this one simply
+        remains unregistered (it returns to the plain free list on
+        release). Only a current reference holder may register —
+        content of an unreferenced block is not the caller's to
+        describe."""
+        if self._refcount.get(block, 0) < 1:
+            raise exceptions.KVBlockError(
+                f'register of unreferenced block {block}')
+        if block_hash in self._hash_to_block:
+            return False
+        if block in self._block_hash:
+            # Re-registration under a new chain (COW reuse of an
+            # already-registered block id cannot happen — new blocks
+            # come unregistered from alloc — but keep the invariant
+            # explicit).
+            return False
+        self._hash_to_block[block_hash] = block
+        self._block_hash[block] = block_hash
+        self._hash_meta[block_hash] = (parent, tuple(
+            int(t) for t in tokens))
+        siblings = self._by_parent.setdefault(parent, [])
+        if len(siblings) < MAX_PARTIAL_CHILDREN:
+            # Bounded COW-candidate index (MAX_PARTIAL_CHILDREN):
+            # beyond the cap the block is still exact-matchable via
+            # the chain, just not a partial-match seed.
+            siblings.append(block_hash)
+        return True
+
+    def _unregister(self, block: int, block_hash: bytes) -> None:
+        del self._hash_to_block[block_hash]
+        del self._block_hash[block]
+        parent, _ = self._hash_meta.pop(block_hash)
+        siblings = self._by_parent.get(parent)
+        if siblings is not None:
+            try:
+                siblings.remove(block_hash)
+            except ValueError:
+                pass
+            if not siblings:
+                del self._by_parent[parent]
+
+
+def copy_pool_block(caches, src: jax.Array, dst: jax.Array):
+    """Copy one block's content ``src`` -> ``dst`` across every
+    layer of the pool 4-tuple — the COPY-ON-WRITE primitive: a
+    partial-block prefix hit duplicates the cached block into a
+    private one, then prefill overwrites from the first divergent
+    token. ``src``/``dst`` are traced int32 scalars, so one jitted
+    executable (caches donated) serves every copy."""
+    k, v, ks, vs = caches
+    k = k.at[:, dst].set(k[:, src])
+    v = v.at[:, dst].set(v[:, src])
+    if ks is not None:
+        ks = ks.at[:, dst].set(ks[:, src])
+        vs = vs.at[:, dst].set(vs[:, src])
+    return (k, v, ks, vs)
+
+
+# Re-exported for engine convenience (serve/prefix_hash.py is the
+# canonical, jax-free home — the LB's affinity policy imports it
+# directly).
+ROOT_HASH = prefix_hash.ROOT
+chain_hashes = prefix_hash.chain_hashes
+block_content_hash = prefix_hash.block_hash
 
 
 def pool_shardings(config: llama.LlamaConfig, mesh,

@@ -2,8 +2,14 @@
 (analog of ``sky/serve/load_balancer.py`` — FastAPI there; stdlib
 ThreadingHTTPServer here since this tree vendors no web framework).
 
-Policies (``sky/serve/load_balancing_policies.py``): round-robin and
-least-load (default).
+Policies (``sky/serve/load_balancing_policies.py``): round-robin,
+least-load (default), and KV-aware ``prefix_affinity`` — rendezvous
+(highest-random-weight) hashing of the request's leading token-block
+hashes (``serve/prefix_hash.py``, the same chain the replicas' prefix
+caches are keyed by), so repeat traffic with a shared prompt prefix
+lands on the replica that already holds its KV blocks; keyless or
+short requests fall back to least-load, and an overloaded affinity
+target spills to least-load rather than hot-spotting.
 
 Observability: every proxied request is recorded in the process
 metrics registry (per-endpoint counts, errors, latency histograms —
@@ -12,6 +18,8 @@ serves its own ``GET /metrics`` (reserved path, never proxied) and
 ``measured_qps()`` feeds the autoscaler the MEASURED load.
 """
 import collections
+import hashlib
+import json
 import threading
 import time
 import urllib.error
@@ -23,6 +31,7 @@ from typing import Callable, Dict, List, Optional
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.serve import prefix_hash
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -34,10 +43,59 @@ QPS_WINDOW_SECONDS = 60.0
 # total attempts so a fully-dark fleet still fails fast.
 MAX_PROXY_ATTEMPTS = 3
 
+# Routing-key derivation for PrefixAffinityPolicy: hash the first
+# ROUTING_PREFIX_BLOCKS routing blocks of ROUTING_BLOCK_TOKENS
+# prompt tokens each. The granularity is deliberately FIXED (not the
+# engine's block_size, which the LB does not know): affinity needs
+# consistency — same leading tokens, same key — not exact engine
+# block alignment. Prompts shorter than one routing block get no key
+# (nothing worth concentrating) and fall back to least-load.
+ROUTING_BLOCK_TOKENS = 32
+ROUTING_PREFIX_BLOCKS = 4
+
+# Replica response headers carrying the engine's per-request
+# prefix-cache accounting; defined in serve/prefix_hash.py (the
+# shared no-deps module) so replicas don't import this module for
+# them — re-exported here for the LB-side consumers.
+PREFIX_HITS_HEADER = prefix_hash.PREFIX_HITS_HEADER
+PREFIX_MISSES_HEADER = prefix_hash.PREFIX_MISSES_HEADER
+
+
+def request_prefix_key(body: Optional[bytes]) -> Optional[bytes]:
+    """Routing key for a /generate-style JSON body: the chain hash
+    of the prompt's leading complete routing blocks (capped at
+    ROUTING_PREFIX_BLOCKS). None for non-JSON bodies, missing or
+    too-short prompts — those route by least-load."""
+    if not body:
+        return None
+    try:
+        ids = json.loads(body).get('prompt_ids')
+    except (ValueError, AttributeError):
+        return None
+    if not isinstance(ids, list):
+        return None
+    n_blocks = min(len(ids) // ROUTING_BLOCK_TOKENS,
+                   ROUTING_PREFIX_BLOCKS)
+    if n_blocks == 0:
+        return None
+    try:
+        chain = prefix_hash.chain_hashes(
+            ids[:n_blocks * ROUTING_BLOCK_TOKENS],
+            ROUTING_BLOCK_TOKENS)
+    except (TypeError, ValueError):
+        return None
+    return chain[-1]
+
 
 class LoadBalancingPolicy:
 
-    def select(self, endpoints: List[str]) -> Optional[str]:
+    # Whether the LB should parse request bodies into a routing key
+    # for this policy (costs a JSON parse per POST on the proxy
+    # path — only affinity policies opt in).
+    needs_request_key = False
+
+    def select(self, endpoints: List[str],
+               key: Optional[bytes] = None) -> Optional[str]:
         raise NotImplementedError
 
     def on_request_start(self, endpoint: str) -> None:
@@ -46,6 +104,12 @@ class LoadBalancingPolicy:
     def on_request_end(self, endpoint: str) -> None:
         pass
 
+    def carry_state_from(self, old: 'LoadBalancingPolicy') -> None:
+        """Adopt whatever live state survives a hot-swap from
+        ``old`` (controller spec update changing the policy). No-op
+        by default; load-tracking policies carry in-flight counts so
+        the fresh policy doesn't see a loaded fleet as idle."""
+
 
 class RoundRobinPolicy(LoadBalancingPolicy):
 
@@ -53,7 +117,7 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         self._idx = 0
         self._lock = threading.Lock()
 
-    def select(self, endpoints):
+    def select(self, endpoints, key=None):
         if not endpoints:
             return None
         with self._lock:
@@ -77,18 +141,40 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         self._inflight: Dict[str, int] = {}
         self._lock = threading.Lock()
 
-    def select(self, endpoints):
+    def carry_state_from(self, old):
+        """Inherit the old policy's in-flight counts across a
+        hot-swap: without this, 100 live requests on one replica
+        read as load 0 to the fresh policy and new traffic
+        stampedes it (the in-flight requests' on_request_end lands
+        on THIS policy after the swap, so the carried counts drain
+        correctly; non-load-tracking predecessors have nothing to
+        carry)."""
+        if not isinstance(old, LeastLoadPolicy):
+            return
+        with old._lock:
+            snapshot = dict(old._inflight)
+        with self._lock:
+            self._inflight.update(snapshot)
+
+    def select(self, endpoints, key=None):
         if not endpoints:
             return None
         with self._lock:
-            ready = set(endpoints)
-            for stale in [e for e in self._inflight
-                          if e not in ready]:
-                del self._inflight[stale]
-            # (count, endpoint) key: least-loaded, ties broken
-            # lexicographically — one pass, no sort on the hot path.
-            return min(endpoints,
-                       key=lambda e: (self._inflight.get(e, 0), e))
+            self._prune(endpoints)
+            return self._least_loaded(endpoints)
+
+    def _prune(self, endpoints) -> None:
+        """Drop in-flight counts for endpoints that left the ready
+        set (call with the lock held)."""
+        ready = set(endpoints)
+        for stale in [e for e in self._inflight if e not in ready]:
+            del self._inflight[stale]
+
+    def _least_loaded(self, endpoints) -> str:
+        # (count, endpoint) key: least-loaded, ties broken
+        # lexicographically — one pass, no sort on the hot path.
+        return min(endpoints,
+                   key=lambda e: (self._inflight.get(e, 0), e))
 
     def on_request_start(self, endpoint):
         with self._lock:
@@ -107,6 +193,84 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 del self._inflight[endpoint]
             else:
                 self._inflight[endpoint] = count - 1
+
+
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """KV-aware routing: consistent-hash requests by their leading
+    token-block hashes so a repeated prompt prefix keeps landing on
+    the replica whose prefix cache already holds its blocks.
+
+    Rendezvous (highest-random-weight) hashing: the target is
+    ``argmax over endpoints of H(key || endpoint)`` — stateless,
+    deterministic, and minimally disruptive under churn (removing a
+    replica remaps only the keys it owned; adding one steals exactly
+    its fair share). Two guards keep it load-safe:
+
+    - keyless requests (GETs, prompts under one routing block,
+      non-JSON bodies) route least-load — cold/unshared traffic
+      spreads instead of hashing;
+    - a hot prefix cannot melt its owner: when the affinity target's
+      in-flight count exceeds ``imbalance_factor`` x the least-loaded
+      replica's (past ``min_spill_inflight``), the request spills to
+      least-load. A spilled request pays one cold prefill there and
+      seeds a second copy of the prefix — exactly the overflow
+      behavior wanted for a viral prompt.
+    """
+
+    needs_request_key = True
+
+    def __init__(self, imbalance_factor: float = 2.0,
+                 min_spill_inflight: int = 8):
+        super().__init__()
+        self.imbalance_factor = imbalance_factor
+        self.min_spill_inflight = min_spill_inflight
+
+    @staticmethod
+    def _score(key: bytes, endpoint: str) -> int:
+        digest = hashlib.sha256(key + b'|' +
+                                endpoint.encode()).digest()
+        return int.from_bytes(digest[:8], 'big')
+
+    def select(self, endpoints, key=None):
+        if not endpoints:
+            return None
+        with self._lock:
+            self._prune(endpoints)
+            least = self._least_loaded(endpoints)
+            if key is None:
+                return least
+            target = max(endpoints,
+                         key=lambda e: (self._score(key, e), e))
+            t_load = self._inflight.get(target, 0)
+            l_load = self._inflight.get(least, 0)
+            if t_load >= self.min_spill_inflight and \
+                    t_load >= self.imbalance_factor * (l_load + 1):
+                return least
+            return target
+
+
+_POLICIES = {
+    'least_load': LeastLoadPolicy,
+    'round_robin': RoundRobinPolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
+}
+
+# The canonical policy-name set: service_spec validation reads this,
+# and the YAML schema's regex is test-asserted against it.
+POLICY_NAMES = tuple(sorted(_POLICIES))
+
+
+def make_policy(name: Optional[str]) -> LoadBalancingPolicy:
+    """Policy from its YAML name (``service:
+    load_balancing_policy:``); None -> the least-load default."""
+    if name is None:
+        return LeastLoadPolicy()
+    try:
+        return _POLICIES[name]()
+    except KeyError:
+        raise ValueError(
+            f'unknown load_balancing_policy {name!r}; choose from '
+            f'{sorted(_POLICIES)}') from None
 
 
 class SkyServeLoadBalancer:
@@ -164,11 +328,113 @@ class SkyServeLoadBalancer:
             'skytpu_lb_inflight_requests',
             'Requests currently in flight to a replica through the '
             'LB (the rolling-upgrade drain signal).', ('endpoint',))
+        # Per-endpoint prefix-cache block accounting, fed by the
+        # replicas' X-Skytpu-Prefix-* response headers: the
+        # block-hit-rate surface `xsky top` and the
+        # prefix-hit-ratio-low alert consume.
+        self._m_prefix_hits = reg.counter(
+            'skytpu_lb_prefix_block_hits_total',
+            'KV blocks served from the replica prefix cache, by '
+            'endpoint (from replica response headers).',
+            ('endpoint',))
+        self._m_prefix_misses = reg.counter(
+            'skytpu_lb_prefix_block_misses_total',
+            'KV blocks freshly prefilled at the replica, by '
+            'endpoint (from replica response headers).',
+            ('endpoint',))
+        self._m_prefix_ratio = reg.gauge(
+            'skytpu_lb_prefix_hit_ratio',
+            'Cumulative per-endpoint block-hit-rate '
+            '(hits / (hits + misses)).', ('endpoint',))
+        self._prefix_totals: Dict[str, List[int]] = {}
+        self._prefix_lock = threading.Lock()
+        # Bumped by forget_endpoint under _prefix_lock: lets the
+        # first-response create path in _note_prefix detect a forget
+        # that interleaved between its (lock-free) ready-set check
+        # and the insert, instead of resurrecting the just-removed
+        # series (seqlock-style validation, see _note_prefix).
+        self._prefix_forget_gen = 0
         # Recent ERROR request exemplars: (wall ts, trace_id). The
         # alert engine stamps the newest one onto a firing alert so
         # `xsky trace <id>` shows the exact request behind the page.
         self._error_exemplars: collections.deque = \
             collections.deque(maxlen=16)
+
+    def _note_prefix(self, endpoint: str, headers) -> None:
+        """Fold a replica response's prefix-cache headers into the
+        per-endpoint hit-rate exposition (absent headers — health
+        probes, non-engine replicas — are a no-op)."""
+        if headers is None:
+            return
+        raw_h = headers.get(PREFIX_HITS_HEADER)
+        raw_m = headers.get(PREFIX_MISSES_HEADER)
+        if raw_h is None and raw_m is None:
+            return
+        try:
+            hits = int(raw_h or 0)
+            misses = int(raw_m or 0)
+        except ValueError:
+            return
+        if hits < 0 or misses < 0:
+            return
+        if self._record_prefix(endpoint, hits, misses,
+                               create=False):
+            return
+        # First response from this endpoint: admit it only if it is
+        # (still) ready. The ready-set read stays OUTSIDE
+        # _prefix_lock — the injected callable may take
+        # controller-side locks whose holders call forget_endpoint,
+        # and nesting would invert the lock order — and off the
+        # known-endpoint hot path, which never pays for it. Because
+        # the check is lock-free, a forget can interleave between it
+        # and the insert; the generation counter detects that
+        # (insert refused, loop re-checks readiness — the forgotten
+        # endpoint is gone from the ready set by then). Forgets are
+        # rare controller events, so the loop terminates promptly.
+        while True:
+            with self._prefix_lock:
+                gen = self._prefix_forget_gen
+            if endpoint not in set(self.get_ready_endpoints()):
+                # Endpoint already forgotten (replica drained/
+                # terminated while this request was still
+                # streaming): recording now would resurrect the
+                # removed ratio series as a frozen corpse — the
+                # same class of bug _inflight_end guards against
+                # (series-removal contract).
+                return
+            if self._record_prefix(endpoint, hits, misses,
+                                   create=True, only_if_gen=gen):
+                return
+
+    def _record_prefix(self, endpoint: str, hits: int, misses: int,
+                       create: bool,
+                       only_if_gen: Optional[int] = None) -> bool:
+        """Fold one response's hit/miss counts into the endpoint's
+        totals + series, atomically with forget_endpoint (same
+        lock): a concurrent forget can't be resurrected by a
+        straggling record. Returns False when the endpoint has no
+        totals entry and ``create`` is off, or when ``only_if_gen``
+        no longer matches the forget generation (a forget ran since
+        the caller's readiness check — re-validate before
+        inserting)."""
+        with self._prefix_lock:
+            if not create and endpoint not in self._prefix_totals:
+                return False
+            if only_if_gen is not None and \
+                    only_if_gen != self._prefix_forget_gen:
+                return False
+            totals = self._prefix_totals.setdefault(endpoint, [0, 0])
+            totals[0] += hits
+            totals[1] += misses
+            if hits:
+                self._m_prefix_hits.labels(endpoint).inc(hits)
+            if misses:
+                self._m_prefix_misses.labels(endpoint).inc(misses)
+            denom = totals[0] + totals[1]
+            if denom:
+                self._m_prefix_ratio.labels(endpoint).set(
+                    totals[0] / denom)
+            return True
 
     def _note_error_exemplar(self, span) -> None:
         ctx = getattr(span, 'context', None)
@@ -223,6 +489,10 @@ class SkyServeLoadBalancer:
         with self._inflight_lock:
             self._inflight.pop(endpoint, None)
             self._m_inflight.remove(endpoint)
+        with self._prefix_lock:
+            self._prefix_forget_gen += 1
+            self._prefix_totals.pop(endpoint, None)
+            self._m_prefix_ratio.remove(endpoint)
 
     def measured_qps(self) -> float:
         """MEASURED request rate over the trailing window — the
@@ -305,7 +575,15 @@ class SkyServeLoadBalancer:
                 def wall_at(mono: float) -> float:
                     return t_start_wall + (mono - t_start_mono)
 
-                endpoint = lb.policy.select(lb.get_ready_endpoints())
+                # Body FIRST: the affinity policy derives its
+                # routing key from the request's leading prompt
+                # tokens, so selection needs the payload in hand.
+                length = int(self.headers.get('Content-Length', '0'))
+                data = self.rfile.read(length) if length else None
+                key = request_prefix_key(data) \
+                    if lb.policy.needs_request_key else None
+                endpoint = lb.policy.select(lb.get_ready_endpoints(),
+                                            key=key)
                 if endpoint is None:
                     lb._m_no_replica.inc()  # pylint: disable=protected-access
                     req_span.set_attr('code', '503')
@@ -318,8 +596,6 @@ class SkyServeLoadBalancer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                length = int(self.headers.get('Content-Length', '0'))
-                data = self.rfile.read(length) if length else None
                 self._headers_sent = False
                 self._resp_status: Optional[int] = None
                 tried = set()
@@ -357,6 +633,19 @@ class SkyServeLoadBalancer:
                         try:
                             with urllib.request.urlopen(
                                     req, timeout=120) as resp:
+                                # Fold prefix-cache headers BEFORE
+                                # relaying the body: the stats are
+                                # complete once the replica's
+                                # headers arrive, and accounting
+                                # here is strictly ordered before
+                                # the client sees any byte — a
+                                # caller reading the hit-rate right
+                                # after its response returns sees
+                                # this request included (and a
+                                # client hanging up mid-stream
+                                # can't lose the record).
+                                lb._note_prefix(  # pylint: disable=protected-access
+                                    current, resp.headers)
                                 self._stream_response(resp)
                         except urllib.error.HTTPError as he:
                             # A replica's own 4xx/5xx is a
@@ -441,7 +730,8 @@ class SkyServeLoadBalancer:
                                     lb.get_ready_endpoints()
                                     if ep not in tried
                                 ]
-                                alt = (lb.policy.select(remaining)
+                                alt = (lb.policy.select(remaining,
+                                                        key=key)
                                        if remaining else None)
                                 if alt is not None:
                                     lb._m_failover.labels(  # pylint: disable=protected-access

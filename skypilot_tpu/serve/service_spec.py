@@ -32,6 +32,8 @@ class SkyServiceSpec:
         engine_block_size: Optional[int] = None,
         engine_num_blocks: Optional[int] = None,
         engine_max_num_batched_tokens: Optional[int] = None,
+        engine_prefix_caching: Optional[bool] = None,
+        load_balancing_policy: Optional[str] = None,
         upgrade_drain_grace_seconds: Optional[float] = None,
         upgrade_soak_seconds: Optional[float] = None,
     ):
@@ -109,6 +111,30 @@ class SkyServiceSpec:
         self.engine_num_blocks = engine_num_blocks
         self.engine_max_num_batched_tokens = \
             engine_max_num_batched_tokens
+        # engine.prefix_caching (on|off — YAML booleans): automatic
+        # block-granular prefix caching in the paged engine
+        # (serve/kv_pool.py). None keeps the engine default (on).
+        if engine_prefix_caching is not None and \
+                not isinstance(engine_prefix_caching, bool):
+            raise exceptions.InvalidSpecError(
+                'engine.prefix_caching must be a boolean (on|off)')
+        self.engine_prefix_caching = engine_prefix_caching
+        # LB policy knob (serve/load_balancer.py): least_load
+        # (default), round_robin, or the KV-aware prefix_affinity
+        # that concentrates repeat prefixes where their cached
+        # blocks live. Validated against the policy registry itself
+        # (lazy import: keep the LB module off the plain task-parse
+        # path) so the knob and the implementations cannot drift;
+        # the YAML schema's regex is lint-checked against the same
+        # registry in tests.
+        if load_balancing_policy is not None:
+            from skypilot_tpu.serve import load_balancer as lb_lib
+            if load_balancing_policy not in lb_lib.POLICY_NAMES:
+                raise exceptions.InvalidSpecError(
+                    'load_balancing_policy must be one of '
+                    f'{sorted(lb_lib.POLICY_NAMES)}: '
+                    f'{load_balancing_policy!r}')
+        self.load_balancing_policy = load_balancing_policy
         # Rolling-upgrade knobs (``upgrade:`` YAML section,
         # docs/upgrades.md): per-service drain grace (how long
         # in-flight requests get to finish before a draining replica
@@ -146,6 +172,7 @@ class SkyServiceSpec:
         slo = dict(config.pop('slo', {}) or {})
         engine = dict(config.pop('engine', {}) or {})
         upgrade = dict(config.pop('upgrade', {}) or {})
+        lb_policy = config.pop('load_balancing_policy', None)
         if config:
             raise exceptions.InvalidSpecError(
                 f'Unknown service fields: {sorted(config)}')
@@ -177,6 +204,8 @@ class SkyServiceSpec:
             engine_num_blocks=engine.get('num_blocks'),
             engine_max_num_batched_tokens=engine.get(
                 'max_num_batched_tokens'),
+            engine_prefix_caching=engine.get('prefix_caching'),
+            load_balancing_policy=lb_policy,
             upgrade_drain_grace_seconds=upgrade.get(
                 'drain_grace_seconds'),
             upgrade_soak_seconds=upgrade.get('soak_seconds'),
@@ -197,6 +226,9 @@ class SkyServiceSpec:
         if self.engine_max_num_batched_tokens is not None:
             env['SKYTPU_ENGINE_MAX_BATCHED_TOKENS'] = \
                 str(self.engine_max_num_batched_tokens)
+        if self.engine_prefix_caching is not None:
+            env['SKYTPU_ENGINE_PREFIX_CACHING'] = \
+                '1' if self.engine_prefix_caching else '0'
         return env
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -237,8 +269,12 @@ class SkyServiceSpec:
         if self.engine_max_num_batched_tokens is not None:
             engine['max_num_batched_tokens'] = \
                 self.engine_max_num_batched_tokens
+        if self.engine_prefix_caching is not None:
+            engine['prefix_caching'] = self.engine_prefix_caching
         if engine:
             out['engine'] = engine
+        if self.load_balancing_policy is not None:
+            out['load_balancing_policy'] = self.load_balancing_policy
         upgrade = {}
         if self.upgrade_drain_grace_seconds is not None:
             upgrade['drain_grace_seconds'] = \

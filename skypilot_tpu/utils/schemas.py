@@ -154,7 +154,15 @@ SERVICE_SCHEMA = {
                 'num_blocks': {'type': 'integer', 'minimum': 2},
                 'max_num_batched_tokens': {'type': 'integer',
                                            'minimum': 1},
+                # Automatic prefix caching (serve/kv_pool.py);
+                # YAML on|off parses to a boolean.
+                'prefix_caching': {'type': 'boolean'},
             },
+        },
+        # KV-aware routing knob (serve/load_balancer.py).
+        'load_balancing_policy': {
+            'type': 'string',
+            'pattern': '^(least_load|round_robin|prefix_affinity)$',
         },
         # Rolling-upgrade knobs (serve/upgrade.py,
         # docs/upgrades.md).
