@@ -262,6 +262,7 @@ def _open_loop_load(engine, prompts, gen: int,
     ttfts = [None] * n
     counts = [0] * n
     done_at = [0.0] * n
+    first_at = [0.0] * n
     errors = [None] * n
     token_outputs = [None] * n
 
@@ -279,7 +280,8 @@ def _open_loop_load(engine, prompts, gen: int,
                 errors[i] = tok
                 continue
             if first:
-                ttfts[i] = time.perf_counter() - sched
+                first_at[i] = time.perf_counter()
+                ttfts[i] = first_at[i] - sched
                 first = False
             counts[i] += 1
             if toks is not None:
@@ -311,9 +313,26 @@ def _open_loop_load(engine, prompts, gen: int,
             f'({failed[:3]}), '
             f'{sum(1 for d in done_at if not d)} unfinished')
     makespan = max(done_at) - t0
+
+    def pctl(sorted_ms, q):
+        # ceil-based index: at the bench's small sample sizes the
+        # old floor form reported values BELOW the median as "p99"
+        # (n=2 -> the minimum).
+        if not sorted_ms:
+            return float('nan')
+        import math
+        return sorted_ms[min(len(sorted_ms) - 1,
+                             max(0, math.ceil(q * len(sorted_ms))
+                                 - 1))]
+
     ttft_ms = sorted(t * 1000.0 for t in ttfts if t is not None)
-    p99 = ttft_ms[max(0, int(len(ttft_ms) * 0.99) - 1)] \
-        if ttft_ms else float('nan')
+    p99 = pctl(ttft_ms, 0.99)
+    # Per-request TPOT (decode pacing after the first token) — the
+    # secondary metric for decode-speed arms like serve_spec.
+    tpot_ms = sorted(
+        (done_at[i] - first_at[i]) * 1000.0 / (counts[i] - 1)
+        for i in range(n) if counts[i] > 1 and first_at[i])
+    p99_tpot = pctl(tpot_ms, 0.99)
     return {
         'tokens': sum(counts),
         'tokens_per_sec': round(sum(counts) / makespan, 2),
@@ -322,6 +341,7 @@ def _open_loop_load(engine, prompts, gen: int,
         'p50_ttft_ms': round(ttft_ms[len(ttft_ms) // 2], 1),
         'p99_ttft_ms': round(p99, 1),
         'max_ttft_ms': round(ttft_ms[-1], 1),
+        'p99_tpot_ms': round(p99_tpot, 2),
         **({'token_outputs': token_outputs}
            if collect_tokens else {}),
     }
@@ -605,6 +625,207 @@ def serve_prefix_main() -> dict:
             'tokens_per_sec_speedup': round(
                 warm['tokens_per_sec'] /
                 max(cold['tokens_per_sec'], 1e-9), 3),
+        },
+    }
+
+
+def serve_spec_main() -> dict:
+    """BENCH_MODE=serve_spec (``--bench serve_spec``): speculative
+    decoding (self-speculative n-gram drafting + batched multi-token
+    verify, serve/batching.py) on a REPEAT-HEAVY open-loop load —
+    the summarization/extraction traffic shape where prompt lookup
+    shines, because the generation keeps re-emitting n-grams it has
+    already produced. Two arms of the SAME paged engine at equal KV
+    HBM and identical knobs, differing ONLY in ``speculative``;
+    headline is spec-on ``out_tok/s`` at small batch (decode is the
+    bandwidth-/dispatch-bound phase speculation attacks), p99 TPOT
+    secondary; ``vs_baseline`` is spec-on/spec-off (>1 = speculation
+    wins, acceptance wants >= 1.5). Greedy outputs are asserted
+    token-for-token identical between the arms before timing (bf16
+    KV; under int8 the engine's multi-chunk quantization caveat can
+    shift near-tied argmaxes, so the assert is recorded as skipped).
+
+    A second ADVERSARIAL pair runs the same engines over low-repeat
+    (full-vocab random) prompts where drafts cannot match: the
+    adaptive per-request draft length must converge to plain decode,
+    holding spec-on within a few percent of spec-off
+    (``detail.adversarial``).
+
+    CPU-proxy note: a random-init model does not "summarize", so the
+    repeat-heavy shape is induced by a small vocab (greedy decode
+    enters repetition loops — exactly the regime where the n-gram
+    drafter's acceptance is high) and a seed whose outputs measure
+    ~0.95 one-token lookup-predictability. Acceptance/accept-rate is
+    recorded in detail; on real chips point BENCH_SS_MODEL at a real
+    model and drive a summarization corpus instead.
+
+    Env: BENCH_SS_MODEL (default tiny), BENCH_SS_VOCAB (proxy vocab
+    restriction, 0 = model default), BENCH_SS_REQUESTS,
+    BENCH_SS_PROMPT / BENCH_SS_PERIOD (repeat-heavy prompt shape),
+    BENCH_SS_GEN, BENCH_SS_DRAFT_K, BENCH_SS_ROWS, BENCH_SS_RATE
+    (open-loop req/s), BENCH_SS_SEED, BENCH_KV_INT8.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_SS_MODEL', 'tiny')
+    vocab = int(os.environ.get('BENCH_SS_VOCAB', '16'))
+    requests = int(os.environ.get('BENCH_SS_REQUESTS', '4'))
+    prompt_len = int(os.environ.get('BENCH_SS_PROMPT', '48'))
+    period = int(os.environ.get('BENCH_SS_PERIOD', '12'))
+    gen = int(os.environ.get('BENCH_SS_GEN', '512'))
+    draft_k = int(os.environ.get('BENCH_SS_DRAFT_K', '24'))
+    rows = int(os.environ.get('BENCH_SS_ROWS', '2'))
+    rate = float(os.environ.get('BENCH_SS_RATE', '100'))
+    seed = int(os.environ.get('BENCH_SS_SEED', '10'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+
+    config = llama.get_config(model_name)
+    if vocab:
+        config = dataclasses.replace(config, vocab_size=vocab)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    # Repeat-heavy prompts: a short random pattern tiled to the
+    # prompt length (the few-shot/extraction shape); the restricted
+    # vocab keeps the greedy CONTINUATION repetitive too.
+    prompts = []
+    for _ in range(requests):
+        pat = rng.integers(1, config.vocab_size,
+                           size=period).tolist()
+        prompts.append((pat * (-(-prompt_len // period)))
+                       [:prompt_len])
+    # Adversarial arm: low-repeat prompts over the model's FULL
+    # vocab (no induced loops) — drafts whiff, adaptive k must
+    # bound the overhead.
+    adv_config = llama.get_config(model_name)
+    adv_params = llama.init_params(adv_config, jax.random.PRNGKey(0),
+                                   dtype=jnp.bfloat16)
+    # Short enough that a random-init model's greedy output has not
+    # yet drifted into its repetition attractors — past ~100 tokens
+    # even full-vocab output grows lookup-able n-grams and the
+    # "adversarial" arm stops being adversarial.
+    adv_gen = int(os.environ.get('BENCH_SS_ADV_GEN', '64'))
+    adv_prompts = [
+        rng.integers(1, adv_config.vocab_size,
+                     size=prompt_len).tolist()
+        for _ in range(2 * requests)]
+
+    def run_arm(cfg, prm, load, load_gen, speculative, name):
+        # Equal KV HBM both arms (the default no-oversubscription
+        # pool); ONLY the speculative knob differs. Prefix caching
+        # off in both: the repeat-heavy prompts would smuggle
+        # one-sided COW/suffix compiles into the timed window —
+        # `--bench serve_prefix` measures caching.
+        engine = BatchingEngine(
+            prm, cfg, slots=rows, max_seq=max_seq,
+            steps_per_dispatch=8, kv_int8=kv_int8, block_size=block,
+            prefill_chunk=64, max_num_batched_tokens=512,
+            prefix_caching=False, speculative=speculative,
+            draft_k=draft_k)
+        try:
+            engine.generate(load[0], 4)   # warm the prompt bucket
+            # Snapshot the engine-local cumulatives so the warmup's
+            # speculation is not credited to the timed window (and
+            # the totals cannot be silently truncated by the
+            # bounded events deque on very long runs).
+            p0 = engine._spec_proposed_local  # pylint: disable=protected-access
+            a0 = engine._spec_accepted_local  # pylint: disable=protected-access
+            nver0 = sum(1 for e in list(engine.events)
+                        if e[0] == 'verify')
+            out = _open_loop_load(engine, load, load_gen,
+                                  1.0 / rate, collect_tokens=True)
+            proposed = engine._spec_proposed_local - p0  # pylint: disable=protected-access
+            accepted = engine._spec_accepted_local - a0  # pylint: disable=protected-access
+            out['verify_dispatches'] = max(
+                0, sum(1 for e in list(engine.events)
+                       if e[0] == 'verify') - nver0)
+            out['drafts_proposed'] = proposed
+            out['drafts_accepted'] = accepted
+            out['accept_rate'] = round(
+                accepted / proposed, 3) if proposed else None
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    spec_off = run_arm(config, params, prompts, gen, False,
+                       'spec_off')
+    spec_on = run_arm(config, params, prompts, gen, True, 'spec_on')
+    adv_off = run_arm(adv_config, adv_params, adv_prompts, adv_gen,
+                      False, 'adversarial_spec_off')
+    adv_on = run_arm(adv_config, adv_params, adv_prompts, adv_gen,
+                     True, 'adversarial_spec_on')
+
+    # Token-for-token exactness over the ENTIRE timed load in both
+    # pairs (speculation may only change WHEN forwards ran, never
+    # what came out). bf16 only: int8 KV argmax near-ties can flip
+    # across the verify/decode boundary the same way they do across
+    # prefill-chunk boundaries (engine docstring caveat).
+    pairs = [(spec_off, spec_on, 'repeat-heavy'),
+             (adv_off, adv_on, 'adversarial')]
+    for off_arm, on_arm, label in pairs:
+        off_toks = off_arm.pop('token_outputs')
+        on_toks = on_arm.pop('token_outputs')
+        if not kv_int8:
+            for i, (want, got) in enumerate(zip(off_toks, on_toks)):
+                if want != got:
+                    raise RuntimeError(
+                        f'speculative output diverged on {label} '
+                        f'request {i}: {got} != {want}')
+
+    speedup = (spec_on['tokens_per_sec'] /
+               max(spec_off['tokens_per_sec'], 1e-9))
+    adv_ratio = (adv_on['tokens_per_sec'] /
+                 max(adv_off['tokens_per_sec'], 1e-9))
+    return {
+        'metric': f'{model_name}_serve_spec_out_tok_s',
+        'value': spec_on['tokens_per_sec'],
+        'unit': 'tokens/s',
+        # vs_baseline: spec-on/spec-off out_tok/s on the
+        # repeat-heavy load (>1 = speculation wins; acceptance
+        # wants >= 1.5).
+        'vs_baseline': round(speedup, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'proxy_vocab': vocab or adv_config.vocab_size,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'requests': requests,
+            'prompt_len': prompt_len,
+            'pattern_period': period,
+            'generated_per_request': gen,
+            'draft_k': draft_k,
+            'decode_rows': rows,
+            'arrival_rate_req_s': rate,
+            'seed': seed,
+            'max_seq': max_seq,
+            'outputs_token_exact': (
+                True if not kv_int8
+                else 'skipped-int8-chunk-caveat'),
+            'spec_on': spec_on,
+            'spec_off': spec_off,
+            'out_tok_s_speedup': round(speedup, 3),
+            'p99_tpot_speedup': round(
+                spec_off['p99_tpot_ms'] /
+                max(spec_on['p99_tpot_ms'], 1e-9), 3),
+            'adversarial': {
+                'spec_on': adv_on,
+                'spec_off': adv_off,
+                # >= ~0.95 proves the adaptive controller bounds
+                # the overhead on traffic drafting cannot help.
+                'out_tok_s_ratio': round(adv_ratio, 3),
+            },
         },
     }
 
@@ -1534,8 +1755,9 @@ if __name__ == '__main__':
             # `python bench.py --bench checkpoint` == BENCH_MODE=...
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
-                     'serve_continuous', 'serve_prefix', 'launch',
-                     'checkpoint', 'elastic')
+                     'serve_continuous', 'serve_prefix',
+                     'serve_spec', 'launch', 'checkpoint',
+                     'elastic')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
                 print(f'usage: bench.py --bench {"|".join(known)}',
@@ -1554,6 +1776,8 @@ if __name__ == '__main__':
             bench_result = serve_continuous_main()
         elif mode == 'serve_prefix':
             bench_result = serve_prefix_main()
+        elif mode == 'serve_spec':
+            bench_result = serve_spec_main()
         elif mode == 'launch':
             bench_result = launch_main()
         else:

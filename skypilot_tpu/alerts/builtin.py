@@ -146,6 +146,28 @@ def fleet_rules() -> List[AlertRule]:
                     'the workload is genuinely unshared (turn '
                     'engine.prefix_caching off to reclaim the '
                     'bookkeeping).'),
+        # Same fleet-pack plumbing and laziness rationale as the
+        # prefix-hit-ratio rule above: the windowed accept-rate
+        # gauge is exported by replica worker processes (only while
+        # speculation is on AND drafts were proposed in-window), so
+        # the rule is silent for spec-off or idle fleets. Page-free:
+        # a collapsed accept rate costs some throughput (the
+        # adaptive controller already bounds the overhead), it never
+        # threatens correctness or availability.
+        AlertRule(
+            id='spec-accept-rate-low', kind='threshold',
+            metric='skytpu_batch_spec_accept_ratio',
+            threshold=0.1, resolve_threshold=0.2, op='<',
+            aggregate='max',  # the BEST replica's rate: if even it
+                              # rejects everything, drafting is dead
+                              # weight
+            window=900.0, for_seconds=600.0,
+            summary='Speculative decoding is enabled but drafts are '
+                    'almost never accepted — the traffic has no '
+                    'lookup-able repetition (the adaptive controller '
+                    'is already bounding the overhead; consider '
+                    'engine.speculative off or a smaller '
+                    'engine.draft_k).'),
         AlertRule(
             id='agent-scrape-stale', kind='absent',
             metric='skytpu_agent_uptime_seconds',

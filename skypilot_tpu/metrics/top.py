@@ -143,6 +143,12 @@ def _host_rows(families) -> List[Dict[str, Any]]:
         combine='sum')
     put('skytpu_batch_prefix_misses_total', 'prefix_misses',
         combine='sum')
+    # Speculative decoding (serve/batching.py): drafts accepted vs
+    # proposed — the SPEC-ACC% column next to PREFIX-HIT%.
+    put('skytpu_batch_spec_proposed_total', 'spec_proposed',
+        combine='sum')
+    put('skytpu_batch_spec_accepted_total', 'spec_accepted',
+        combine='sum')
     return [dict(row, host=host)
             for host, row in sorted(hosts.items())]
 
@@ -350,7 +356,8 @@ def render(snap: Dict[str, Any]) -> str:
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
                             'SERVE TOK/S', 'BLOCKS', 'PREEMPT',
-                            'PREFIX-HIT%', 'KV', 'ALERTS'])
+                            'PREFIX-HIT%', 'SPEC-ACC%', 'KV',
+                            'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
         alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
@@ -360,7 +367,7 @@ def render(snap: Dict[str, Any]) -> str:
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
                            '-', '-', '-', '-', '-', '-', '-', '-',
-                           '-', '-', alerts_cell])
+                           '-', '-', '-', alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -398,6 +405,11 @@ def render(snap: Dict[str, Any]) -> str:
             if denom:
                 prefix = _fmt_ratio(h.get('prefix_hits', 0.0) /
                                     denom)
+            # Speculative accept rate: drafts accepted / proposed.
+            spec = '-'
+            if h.get('spec_proposed'):
+                spec = _fmt_ratio(h.get('spec_accepted', 0.0) /
+                                  h['spec_proposed'])
             table.add_row([
                 cluster['name'], h['host'], load, mem,
                 _fmt_num(h.get('procs'), '{:.0f}'), hbm,
@@ -407,7 +419,7 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
                 blocks,
                 _fmt_num(h.get('preemptions'), '{:.0f}'),
-                prefix, kv, alerts_cell,
+                prefix, spec, kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')

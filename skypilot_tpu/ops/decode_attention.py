@@ -319,6 +319,68 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     return decode_attention(q, kd, vd, lengths, scale)
 
 
+def _reference_verify_attention(q, k, v, lengths, scale):
+    """q [B, W, Hq, hd]; k/v [B, S, Hkv, hd]; lengths [B] — query
+    position j of row b attends keys [0, lengths[b] + j). This is
+    ``_reference_decode_attention`` widened for speculative VERIFY:
+    the W query positions of a row are the base token plus its
+    drafted continuation, so the mask is the single-position length
+    mask plus an intra-draft causal stagger (+j per query). The
+    contraction pattern per (row, position) is identical to the
+    single-position path, so a verify over the TRUE next tokens
+    reproduces plain decode's logits."""
+    b, w, hq, hd = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    groups = hq // hkv
+    qg = q.reshape(b, w, hkv, groups, hd)
+    logits = jnp.einsum('bwhgd,bshd->bwhgs', qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    span = lengths[:, None] + jnp.arange(w)[None, :]      # [B, W]
+    mask = (jnp.arange(s)[None, None, :] <
+            span[:, :, None])                             # [B, W, S]
+    logits = jnp.where(mask[:, :, None, None, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bwhgs,bshd->bwhgd', probs.astype(v.dtype), v)
+    return out.reshape(b, w, hq, hd)
+
+
+def paged_verify_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array,
+                           block_tables: jax.Array,
+                           lengths: jax.Array, scale: float,
+                           block_size: int,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None
+                           ) -> jax.Array:
+    """Multi-position decode attention over PAGED caches — the
+    speculative-decoding VERIFY widening of
+    ``paged_decode_attention``: q carries W positions per row (the
+    row's current token plus its drafted continuation, KV already
+    written into the row's blocks), and query j of row b attends its
+    first ``lengths[b] + j`` logical positions (intra-draft causal).
+
+    q [B, W, Hq, hd]; k_pool/v_pool one layer's flattened pool
+    [num_blocks * block_size, Hkv, hd] (+ int8 scales); block_tables
+    [B, MB]; lengths [B] is the BASE length (the j=0 query's valid
+    prefix, self included). Reuses the exact gather/mask math of the
+    single-position path: positions past a query's span gather
+    scratch/stale rows and are masked to -inf, so rejected-draft
+    garbage and recycled blocks contribute exactly 0.
+    """
+    from skypilot_tpu.serve import kv_pool as kv_pool_lib
+
+    gidx = kv_pool_lib.read_indices(block_tables, block_size)
+    kd = paged_gather(k_pool, gidx)              # [B, S_pad, Hkv, hd]
+    vd = paged_gather(v_pool, gidx)
+    if k_scale is not None:
+        dtype = q.dtype
+        kd = kd.astype(dtype) * paged_gather(
+            k_scale, gidx)[..., None].astype(dtype)
+        vd = vd.astype(dtype) * paged_gather(
+            v_scale, gidx)[..., None].astype(dtype)
+    return _reference_verify_attention(q, kd, vd, lengths, scale)
+
+
 # ---------------------------------------------------------------------
 # Pallas per-row cache write
 # ---------------------------------------------------------------------

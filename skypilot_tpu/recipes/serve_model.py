@@ -76,6 +76,22 @@ def main():
                              'their prefill (token-exact under '
                              'greedy decoding; engine.prefix_caching '
                              'in the service YAML)')
+    parser.add_argument('--speculative', choices=['on', 'off'],
+                        default=('on' if os.environ.get(
+                            'SKYTPU_ENGINE_SPECULATIVE', '1')
+                            not in ('0', 'off', 'false') else 'off'),
+                        help='speculative decoding on the paged '
+                             'engine: self-speculative n-gram '
+                             'drafting + batched multi-token verify '
+                             '(token-exact under greedy decoding; '
+                             'engine.speculative in the service '
+                             'YAML)')
+    parser.add_argument('--draft-k', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_DRAFT_K', '8')),
+                        help='max drafted tokens per row per verify '
+                             'dispatch (engine.draft_k; 0 disables '
+                             'speculation)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -180,7 +196,9 @@ def main():
             block_size=args.block_size,
             num_blocks=args.num_blocks or None,
             max_num_batched_tokens=args.max_batched_tokens,
-            prefix_caching=args.prefix_caching == 'on')
+            prefix_caching=args.prefix_caching == 'on',
+            speculative=args.speculative == 'on',
+            draft_k=args.draft_k)
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the

@@ -83,6 +83,58 @@ _NEG_INF = -1e30
 # regression is visible to the rule within one window.
 PREFIX_RATIO_WINDOW_SECONDS = 900.0
 
+# Trailing window for the exported speculative accept-rate gauge —
+# matches the spec-accept-rate-low alert rule's window for the same
+# reason as the prefix-ratio window above.
+SPEC_RATIO_WINDOW_SECONDS = 900.0
+
+# Self-speculative n-gram drafting (prompt lookup): longest suffix
+# n-gram tried first down to a bigram minimum (unigram anchors
+# propose near-noise and poison the acceptance window), and the
+# history scan is bounded so an 8k prompt cannot turn every
+# proposal into an O(prompt) walk on the single-threaded engine
+# loop.
+SPEC_MAX_NGRAM = 6
+SPEC_MIN_NGRAM = 2
+SPEC_MATCH_WINDOW = 1024
+
+# Adaptive per-request draft length: trailing acceptance window size
+# (verify rounds), the shrink/grow thresholds, and how many emitted
+# tokens a collapsed (k=0) request waits before re-probing with a
+# short draft — adversarial (low-repeat) traffic converges to
+# plain decode with only this counter as overhead. While OTHER rows
+# keep a verify dispatch alive anyway, collapsed rows re-probe for
+# free inside it (their ride-along lanes exist either way); the
+# cooldown gates only the case where the probe itself would force a
+# verify dispatch.
+SPEC_WINDOW_ROUNDS = 8
+SPEC_SHRINK_BELOW = 0.4
+SPEC_COLLAPSE_BELOW = 0.15
+SPEC_GROW_ABOVE = 0.8
+SPEC_REPROBE_TOKENS = 16
+# Re-probe cooldowns back off exponentially (doubling per failed
+# probe, capped at 2**SPEC_BACKOFF_MAX_EXP * SPEC_REPROBE_TOKENS)
+# so a genuinely low-repeat request's total probing overhead is a
+# vanishing fraction of its stream, while a regime change is still
+# caught within a few hundred tokens.
+SPEC_BACKOFF_MAX_EXP = 4
+SPEC_PROBE_K = 2
+# Probe-mode proposals (a collapsed or nearly-collapsed request
+# testing the water, k <= SPEC_PROBE_K) demand a LONG n-gram match:
+# repetitive streams produce one instantly, while low-repeat text
+# essentially never does — so re-entry into speculation is
+# immediate exactly when it will pay, and an adversarial stream's
+# probes stop costing verify dispatches at all. A request with no
+# verify history yet gets a milder (trigram) bar: it has no failure
+# evidence against it, but a first full-k draft on bigram evidence
+# alone whiffs too often to be worth a dispatch.
+SPEC_PROBE_MIN_NGRAM = 4
+SPEC_FIRST_MIN_NGRAM = 3
+# A verify dispatch must carry at least this many drafted tokens:
+# below it, displacing the multi-step decode scan cannot pay for
+# itself and the batch takes the plain path instead.
+SPEC_MIN_DISPATCH_TOKENS = 4
+
 
 # ---------------------------------------------------------------------
 # Per-row decode primitives
@@ -403,6 +455,282 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
 
 
 # ---------------------------------------------------------------------
+# Speculative decoding: n-gram drafting + batched multi-token verify
+# ---------------------------------------------------------------------
+
+
+def propose_ngram_draft(tokens: List[int], k: int,
+                        max_ngram: int = SPEC_MAX_NGRAM,
+                        min_ngram: int = SPEC_MIN_NGRAM,
+                        window: int = SPEC_MATCH_WINDOW) -> List[int]:
+    """Self-speculative prompt-lookup drafting: find the most recent
+    EARLIER occurrence of the longest n-gram ending at the current
+    suffix of ``tokens`` (the request's own prompt + generated
+    stream) and propose up to ``k`` tokens that followed it
+    historically. No second model: summarization/extraction-shaped
+    traffic — and greedy decode's own repetition — make the
+    continuation of a repeated n-gram an excellent draft. The scan
+    is bounded to the trailing ``window`` tokens so proposal cost
+    cannot grow with prompt length. Returns [] when nothing matches
+    (not a rejection — the row just decodes plainly)."""
+    if k <= 0 or len(tokens) < 2:
+        return []
+    import array
+    lo = max(0, len(tokens) - window)
+    hist = list(tokens[lo:])
+    # SEQUENTIAL drafting: each drafted token re-anchors the n-gram
+    # lookup on the suffix INCLUDING the tokens drafted so far, so
+    # the draft can hop between historical sources mid-run (a
+    # single k-token continuation copy breaks at the first source
+    # divergence — measured ~0.5 acceptance where the re-anchoring
+    # predictor measures 0.9+ on the same stream). The history is
+    # a flat int32 byte string searched with C-speed
+    # ``bytearray.rfind`` (a Python scan here would cost ~100s of
+    # µs per row per dispatch — exactly the adversarial overhead
+    # the adaptive controller is supposed to bound); the most
+    # recent earlier occurrence wins, since recent context predicts
+    # the continuation best.
+    buf = bytearray(array.array('i', hist).tobytes())
+    item = array.array('i', [0]).itemsize
+    out: List[int] = []
+    for _ in range(k):
+        n_hist = len(hist)
+        nxt = None
+        for n in range(min(max_ngram, n_hist - 1),
+                       min_ngram - 1, -1):
+            pat = array.array('i', hist[-n:]).tobytes()
+            # The match must END at or before the last-but-one
+            # token (an occurrence strictly earlier than the
+            # suffix itself, with a token after it to propose).
+            idx = buf.rfind(pat, 0, (n_hist - 1) * item)
+            while idx != -1 and idx % item:
+                # Byte-level hits straddling item boundaries are
+                # not token matches — keep searching earlier.
+                idx = buf.rfind(pat, 0, idx + len(pat) - 1)
+            if idx != -1:
+                nxt = hist[idx // item + n]
+                break
+        if nxt is None:
+            break
+        out.append(nxt)
+        hist.append(nxt)
+        buf += array.array('i', [nxt]).tobytes()
+    return out
+
+
+def greedy_accept(tokens: jax.Array, preds: jax.Array,
+                  n_real: jax.Array) -> jax.Array:
+    """THE acceptance rule — the engine's single implementation
+    (lint-enforced: tests forbid draft-vs-argmax comparisons
+    anywhere else, so the exactness suite certifies every
+    acceptance decision the engine can make). Greedy speculation
+    accepts draft tokens while each equals the verify forward's
+    argmax at its position: ``preds[b, j]`` is the model's greedy
+    next token AFTER verify-input position j (``tokens`` [B, W] =
+    base token + drafts + pad), so draft ``tokens[b, j+1]`` is
+    correct iff it equals ``preds[b, j]``, and the accepted count
+    is the length of the leading all-correct run over the row's
+    ``n_real[b] - 1`` real draft lanes. Runs traced inside
+    ``verify_step_paged`` (the commit arithmetic stays on device —
+    no host round-trip decides an acceptance), works identically on
+    host int arrays in tests. The emission is ``preds[b, 0..a]`` —
+    exactly the a+1 tokens plain greedy decode would have produced
+    one forward at a time."""
+    w = tokens.shape[1]
+    ok = (tokens[:, 1:] == preds[:, :-1])
+    is_draft = (jnp.arange(w - 1)[None, :] <
+                (n_real - 1)[:, None])
+    lead = jnp.cumprod((ok & is_draft).astype(jnp.int32), axis=1)
+    return lead.sum(axis=1).astype(jnp.int32)      # [B] accepted
+
+
+def update_spec_k(cur_k: int, window, draft_k: int) -> int:
+    """Adaptive per-request draft length from a trailing
+    acceptance-rate window of (proposed, accepted) verify rounds:
+    shrink (halve, to 0) while the trailing rate sits under
+    ``SPEC_SHRINK_BELOW``, grow (double, capped at ``draft_k``)
+    above ``SPEC_GROW_ABOVE`` — adversarial low-repeat traffic
+    converges to plain decode, repeat-heavy traffic rides the full
+    draft length."""
+    proposed = sum(p for p, _ in window)
+    if proposed <= 0:
+        return cur_k
+    rate = sum(a for _, a in window) / proposed
+    if proposed >= 8 and rate < SPEC_COLLAPSE_BELOW:
+        # Near-nothing accepted over real evidence: collapse to
+        # plain decode NOW instead of halving down — every
+        # intermediate verify would emit ~1 token for a whole
+        # dispatch.
+        return 0
+    if rate < SPEC_SHRINK_BELOW:
+        return cur_k // 2
+    if rate > SPEC_GROW_ABOVE and cur_k < draft_k:
+        return min(draft_k, max(1, cur_k * 2))
+    return cur_k
+
+
+def _rope_verify(x: jax.Array, angles: jax.Array) -> jax.Array:
+    """Rotate-half RoPE for a verify window: x [B, W, H, D],
+    angles [B, W, D/2] (each row's W positions at their own
+    offsets)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
+
+
+def verify_step_paged(params: Params, tokens: jax.Array,
+                      caches, block_tables: jax.Array,
+                      pos: jax.Array, n_real: jax.Array,
+                      config: llama.LlamaConfig,
+                      width: int, block_size: int):
+    """Batched multi-token VERIFY forward — the speculative twin of
+    ``decode_steps_paged``: instead of scanning ``num_steps`` single
+    tokens, ONE forward carries ``width`` = draft_k + 1 query
+    positions per row (the row's current token at ``pos[b]`` plus
+    its drafted continuation), so one weight read amortizes over up
+    to width accepted-and-emitted tokens — the bandwidth-bound
+    decode fix.
+
+    tokens [B, W] (row b's positions pos[b]..pos[b]+W-1, only the
+    first n_real[b] real — padded lanes write scratch and their
+    outputs are ignored); caches/block_tables as in
+    ``decode_steps_paged``. Drafted K/V is written into the row's
+    blocks UP FRONT (in-layer for same-forward visibility, one
+    merged scatter per layer stack after, same split as the decode
+    twin); a rejection later simply rolls the host-side ``pos`` back
+    so the stale rows are never attended again — no block copying,
+    no scatter-undo (the length-masked paged attention makes
+    abandoning them free). Attention is
+    ``ops.decode_attention.paged_verify_attention`` with the
+    intra-draft causal mask (query j attends [0, pos+j]).
+
+    Returns (preds [B, W] int32, accepted [B] int32, new_pos [B],
+    new_tokens [B], caches): ``preds[b, j]`` is the greedy next
+    token after position pos[b]+j; ``accepted`` is
+    ``greedy_accept``'s per-row count (the ONE acceptance
+    implementation, traced here so the pos/tokens commit costs no
+    extra host round-trips); ``new_pos``/``new_tokens`` carry the
+    committed frontier — pos advances by accepted+1 for live rows
+    (the ROLLBACK: rejected positions simply stay past the new
+    frontier) and parked rows (n_real 0) are untouched.
+    """
+    from skypilot_tpu.ops import decode_attention as da
+
+    k_pool, v_pool, k_scale, v_scale = caches
+    nl, nb, bs = k_pool.shape[:3]
+    assert bs == block_size, (bs, block_size)
+    cparams = jax.tree.map(
+        lambda p: p if p.dtype == jnp.int8 else p.astype(config.dtype),
+        params)
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    b = tokens.shape[0]
+    quantized = k_scale is not None  # static at trace
+
+    kp = k_pool.reshape(nl, nb * bs, nkv, hd)
+    vp = v_pool.reshape(nl, nb * bs, nkv, hd)
+    ksp = k_scale.reshape(nl, nb * bs, nkv) if quantized else None
+    vsp = v_scale.reshape(nl, nb * bs, nkv) if quantized else None
+
+    positions = pos[:, None] + jnp.arange(width,
+                                          dtype=jnp.int32)[None, :]
+    angles = llama._rope_frequencies(
+        config, positions.reshape(-1)).reshape(b, width, -1)
+    x = cparams['embed'][tokens]                   # [B, W, D]
+    if config.scale_embeddings:
+        import math
+        x = x * jnp.asarray(math.sqrt(config.dim), x.dtype)
+    widx = kv_pool_lib.verify_write_indices(
+        block_tables, pos, n_real, width, block_size)  # [B, W]
+    wflat = widx.reshape(-1)
+
+    def layer(xc, scanned):
+        lp, kc, vc, ks, vs = scanned
+        h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
+                            config.norm_offset)
+        q = _mm(h, lp['wq'])
+        k = _mm(h, lp['wk'])
+        v = _mm(h, lp['wv'])
+        if config.qkv_bias:
+            q = q + lp['bq']
+            k = k + lp['bk']
+            v = v + lp['bv']
+        q = q.reshape(b, width, nh, hd)
+        k = k.reshape(b, width, nkv, hd)
+        v = v.reshape(b, width, nkv, hd)
+        q = _rope_verify(q, angles)
+        k = _rope_verify(k, angles)
+        if ks is not None:
+            k_rows, ks_rows = decode._quantize_kv(k)
+            v_rows, vs_rows = decode._quantize_kv(v)
+        else:
+            k_rows, v_rows = k, v
+            ks_rows = vs_rows = None
+        # In-layer write exists ONLY so this forward's attention
+        # sees the whole draft window causally (the caller-visible
+        # pool update is the merged scatter after the layer scan —
+        # same split as the decode twin). Padded lanes collide
+        # harmlessly on the scratch slot.
+        kc = kc.at[wflat].set(k_rows.reshape(b * width, nkv, hd))
+        vc = vc.at[wflat].set(v_rows.reshape(b * width, nkv, hd))
+        if ks is not None:
+            ks = ks.at[wflat].set(ks_rows.reshape(b * width, nkv))
+            vs = vs.at[wflat].set(vs_rows.reshape(b * width, nkv))
+        attn = da.paged_verify_attention(
+            q, kc, vc, block_tables, pos + 1, hd ** -0.5,
+            block_size, k_scale=ks, v_scale=vs)       # [B, W, Hq, hd]
+        xc = xc + _mm(attn.reshape(b, width, nh * hd), lp['wo'])
+        h = llama._rms_norm(xc, lp['mlp_norm'], config.norm_eps,
+                            config.norm_offset)
+        if config.n_experts:
+            moe_out, _ = llama._moe_mlp(config, h, lp)
+            xc = xc + moe_out
+        else:
+            gate = llama.mlp_act(config)(
+                _mm(h, lp['w_gate']).astype(jnp.float32)
+            ).astype(h.dtype)
+            up = _mm(h, lp['w_up'])
+            xc = xc + _mm(gate * up, lp['w_down'])
+        return xc, (
+            k_rows.reshape(b * width, nkv, hd),
+            v_rows.reshape(b * width, nkv, hd),
+            None if ks_rows is None
+            else ks_rows.reshape(b * width, nkv),
+            None if vs_rows is None
+            else vs_rows.reshape(b * width, nkv))
+
+    x, rows = jax.lax.scan(
+        layer, x, (cparams['layers'], kp, vp, ksp, vsp))
+    kp = kp.at[:, wflat].set(rows[0])
+    vp = vp.at[:, wflat].set(rows[1])
+    if quantized:
+        ksp = ksp.at[:, wflat].set(rows[2])
+        vsp = vsp.at[:, wflat].set(rows[3])
+    x = llama._rms_norm(x, cparams['final_norm'], config.norm_eps,
+                        config.norm_offset)
+    if config.tie_embeddings:
+        logits = (x @ llama.output_head(cparams, config))
+    else:
+        logits = _mm(x, cparams['lm_head'])
+    preds = logits.argmax(-1).astype(jnp.int32)       # [B, W]
+    accepted = greedy_accept(tokens, preds, n_real)   # [B]
+    live = n_real > 0
+    new_pos = jnp.where(live, pos + accepted + 1, pos)
+    new_tok = jnp.where(
+        live,
+        jnp.take_along_axis(preds, accepted[:, None], axis=1)[:, 0],
+        tokens[:, 0])
+    out_caches = (
+        kp.reshape(nl, nb, bs, nkv, hd),
+        vp.reshape(nl, nb, bs, nkv, hd),
+        ksp.reshape(nl, nb, bs, nkv) if quantized else None,
+        vsp.reshape(nl, nb, bs, nkv) if quantized else None)
+    return preds, accepted, new_pos, new_tok, out_caches
+
+
+# ---------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------
 
@@ -431,6 +759,19 @@ class _Request:
         # single-threaded engine loop).
         self.chain_hashes: List[bytes] = []
         self.chain_t0 = -1
+        # Speculative-decoding state (engine-managed): current draft
+        # length (None until admission seeds it from the engine's
+        # draft_k), trailing (proposed, accepted) verify window the
+        # adaptive controller reads, and the emitted-token cooldown
+        # before a collapsed (k=0) request re-probes. ONLY emitted
+        # (accepted) tokens ever enter ``generated`` — drafted
+        # tokens live in the dispatch alone, so preemption resume
+        # and prefix registration hash exactly what the client saw.
+        self.spec_k: Optional[int] = None
+        self.spec_window: 'collections.deque' = collections.deque(
+            maxlen=SPEC_WINDOW_ROUNDS)
+        self.spec_cooldown = 0
+        self.spec_fail_streak = 0
         self.out: 'queue.Queue' = queue.Queue()
         self.submitted_at = time.time()
         # Tokens already EMITTED to the client — preemption resume
@@ -514,6 +855,20 @@ def _engine_metrics():
             'skytpu_batch_prefix_cached_blocks',
             'Refcount-0 blocks currently holding registered '
             '(reusable) prefix-cache content.'),
+        'spec_proposed': reg.counter(
+            'skytpu_batch_spec_proposed_total',
+            'Draft tokens proposed by the self-speculative n-gram '
+            'drafter and carried into a verify dispatch.'),
+        'spec_accepted': reg.counter(
+            'skytpu_batch_spec_accepted_total',
+            'Proposed draft tokens accepted by greedy verification '
+            '(each accepted draft is one decode forward the engine '
+            'did not have to run).'),
+        'spec_tokens_per_forward': reg.gauge(
+            'skytpu_batch_spec_tokens_per_forward',
+            'Tokens emitted per row by the latest verify dispatch '
+            '(accepted drafts + the bonus token; 1.0 == plain '
+            'decode, draft_k+1 == full acceptance).'),
     }
 
 
@@ -545,6 +900,16 @@ class BatchingEngine:
       (default on): admission matches the prompt's hash chain,
       reuses hit blocks and prefills only the suffix — token-exact
       under greedy decoding (kv_pool.py module docstring).
+    - ``speculative``: self-speculative n-gram decoding (default
+      on): rows with a prompt-lookup draft verify draft_k+1 tokens
+      in ONE forward (``verify_step_paged``); greedy acceptance
+      keeps outputs token-for-token equal to plain decode, and an
+      adaptive per-request controller collapses the draft length to
+      0 on low-repeat traffic (the batch then takes the plain scan
+      path). A verify row costs draft+1 of the per-iteration token
+      budget, so speculation degrades before it can starve prefill.
+    - ``draft_k``: max drafted tokens per row per verify (the
+      static verify width is draft_k + 1).
     - ``tenant_weights``: optional per-tenant weights for the
       fair-share budget split (absent tenants weigh 1.0).
     """
@@ -558,6 +923,8 @@ class BatchingEngine:
                  max_num_batched_tokens: Optional[int] = 2048,
                  prefill_chunk: int = 512,
                  prefix_caching: bool = True,
+                 speculative: bool = True,
+                 draft_k: int = 8,
                  tenant_weights: Optional[Dict[str, float]] = None):
         self.params = params
         self.config = config
@@ -611,6 +978,23 @@ class BatchingEngine:
         # cached K/V is precisely what re-prefilling the same prefix
         # would write.
         self.prefix_caching = prefix_caching
+        # Speculative decoding (module docstring + the functions
+        # above): drafting/acceptance are host-side; the device-side
+        # verify width is STATIC at draft_k + 1 (shorter drafts pad
+        # to scratch), so speculation adds exactly one executable.
+        self.speculative = speculative and draft_k > 0
+        self.draft_k = max(0, draft_k)
+        # Engine-local cumulatives + trailing window for the
+        # windowed accept-rate gauge (same shape as the prefix
+        # hit-ratio window below).
+        self._spec_proposed_local = 0
+        self._spec_accepted_local = 0
+        self._spec_window: 'collections.deque' = collections.deque()
+        self._spec_ratio_gauge = None
+        # Prefill tokens spent in the CURRENT scheduler iteration —
+        # the verify dispatch budgets its draft grants against the
+        # remainder (a verify row costs drafted+1 budget tokens).
+        self._prefill_spent_iter = 0
         # Per-tenant weighted deficit round-robin over the prefill
         # token budget (fair-share QoS): deficits accrue a weighted
         # share of max_num_batched_tokens per scheduler iteration.
@@ -665,6 +1049,9 @@ class BatchingEngine:
         self._step_fn = jax.jit(decode_steps_paged,
                                 static_argnums=(6, 7, 8),
                                 donate_argnums=(2,))
+        self._verify_fn = jax.jit(verify_step_paged,
+                                  static_argnums=(6, 7, 8),
+                                  donate_argnums=(2,))
         self._prefill_fn = jax.jit(decode.forward_paged,
                                    static_argnums=(6, 7),
                                    donate_argnums=(2,))
@@ -680,6 +1067,19 @@ class BatchingEngine:
                                   jnp.int32)
             self.caches = self._copy_fn(self.caches, scratch,
                                         scratch)
+        if self.speculative:
+            # Prewarm the verify executable (n_real 0 everywhere:
+            # every write lands in scratch, outputs discarded) — the
+            # first live draft must not pay the compile inside a
+            # request's decode window (same rationale as the COW
+            # prewarm above; the verify width is static, so this is
+            # THE executable).
+            *_, self.caches = self._verify_fn(
+                self.params,
+                jnp.zeros((slots, self.draft_k + 1), jnp.int32),
+                self.caches, self.block_tables, self.pos,
+                jnp.zeros((slots,), jnp.int32), self.config,
+                self.draft_k + 1, self.block_size)
         self._metrics = _engine_metrics()
         # Lazily created on first real traffic (MFU-gauge precedent):
         # an engine with caching off must not export a fake 0 ratio.
@@ -1112,6 +1512,7 @@ class BatchingEngine:
         while any prefill is pending, and the free capacity is not
         charged against future shares)."""
         budget = self.max_batched_tokens or float('inf')
+        self._prefill_spent_iter = 0
         rows = sorted(
             (i for i in range(self.slots)
              if self.slot_req[i] is not None
@@ -1172,6 +1573,7 @@ class BatchingEngine:
                         if charged <= 0:
                             break
                         spent += charged
+                        self._prefill_spent_iter = int(spent)
                         if metered and not deficit_blind:
                             self._tenant_deficit[t] = \
                                 self._tenant_deficit.get(t, 0.0) \
@@ -1241,29 +1643,167 @@ class BatchingEngine:
             req.out.put(None)
             self._retire(row)
 
+    def _spec_k_for(self, req: _Request) -> int:
+        """Current draft length for a request (adaptive controller
+        state), seeding new requests at the engine draft_k and
+        re-probing collapsed ones with a 1-token draft once their
+        emitted-token cooldown expires."""
+        if req.spec_k is None:
+            req.spec_k = self.draft_k
+        if req.spec_k == 0 and req.spec_cooldown <= 0:
+            req.spec_k = 1
+            req.spec_window.clear()
+        return req.spec_k
+
+    def _collect_drafts(self, rows: List[int]) -> Dict[int, List[int]]:
+        """Propose n-gram drafts for this dispatch's decode rows
+        under what remains of the per-iteration token budget: every
+        row costs its 1 base token unconditionally (plain decode was
+        never budget-gated), drafts are granted oldest-first from
+        the remainder after prefill spending — a verify row costs
+        drafted+1 budget tokens, so speculation degrades gracefully
+        under load instead of starving prefill."""
+        if not rows:
+            return {}
+        if self.max_batched_tokens is None:
+            left = float('inf')
+        else:
+            left = (self.max_batched_tokens -
+                    self._prefill_spent_iter - len(rows))
+        def row_cap(row: int, k: int) -> int:
+            cap = min(k, self.slot_left[row] - 1,
+                      self.max_seq - self.slot_len[row] - 2)
+            if left != float('inf'):
+                cap = min(cap, int(left))
+            return cap
+
+        def draft_stream(req: _Request) -> List[int]:
+            # Only the trailing match window ever matters — build
+            # just that, not the full prompt+generated concat (an
+            # 8k prompt would otherwise be copied per row per
+            # dispatch on the engine loop, the exact O(prompt) walk
+            # SPEC_MATCH_WINDOW exists to bound).
+            tail = req.generated[-SPEC_MATCH_WINDOW:]
+            short = SPEC_MATCH_WINDOW - len(tail)
+            if short > 0 and req.prompt_ids:
+                tail = req.prompt_ids[-short:] + tail
+            return tail
+
+        drafts: Dict[int, List[int]] = {}
+        min_k = self.draft_k
+        for row in sorted(rows, key=lambda i: self.slot_seq[i]):
+            if left <= 0:
+                break
+            req = self.slot_req[row]
+            k = self._spec_k_for(req)
+            cap = row_cap(row, k)
+            if cap <= 0:
+                continue
+            # Evidence bars: nearly-collapsed requests re-probe on
+            # a 4-gram only (their window says drafting loses);
+            # first-ever proposals need a trigram (no evidence
+            # either way — a repetitive stream produces one within
+            # a few tokens, low-repeat text essentially never);
+            # established speculators draft on the default bar.
+            if k <= SPEC_PROBE_K:
+                bar = SPEC_PROBE_MIN_NGRAM
+            elif not req.spec_window:
+                bar = SPEC_FIRST_MIN_NGRAM
+            else:
+                bar = SPEC_MIN_NGRAM
+            d = propose_ngram_draft(draft_stream(req), cap,
+                                    min_ngram=bar)
+            if d:
+                drafts[row] = d
+                left -= len(d)
+                min_k = min(min_k, req.spec_k)
+        # Low-value gate: a verify carrying almost no drafted tokens
+        # cannot pay for displacing the multi-step decode scan. The
+        # threshold relaxes to the smallest drafting row's k so a
+        # cooldown re-probe (k=1) is never gated out of existence —
+        # it is already rate-limited by the cooldown itself.
+        if drafts and sum(map(len, drafts.values())) < \
+                min(SPEC_MIN_DISPATCH_TOKENS, min_k):
+            return {}
+        if drafts:
+            # Ride-along probes: the verify dispatch is happening
+            # anyway and its lanes are as wide for every row, so
+            # collapsed (cooldown) rows re-probe for free inside it
+            # instead of waiting out their cooldown at 1 emitted
+            # token per dispatch.
+            for row in rows:
+                req = self.slot_req[row]
+                if row in drafts or req.spec_k != 0 or left <= 0:
+                    continue
+                cap = row_cap(row, SPEC_PROBE_K)
+                if cap <= 0:
+                    continue
+                d = propose_ngram_draft(
+                    draft_stream(req), cap,
+                    min_ngram=SPEC_PROBE_MIN_NGRAM)
+                if d:
+                    drafts[row] = d
+                    left -= len(d)
+        return drafts
+
+    def _trim_blocks(self, row: int) -> None:
+        """Free the row's whole blocks past its committed frontier
+        (keeping coverage for the next write position): a rejected
+        draft can leave blocks holding nothing but abandoned rows —
+        they are reclaimable pool capacity, not this request's to
+        sit on. Trimmed blocks are always this row's own fresh
+        allocations (pinned prefix-cache hits cover the prompt
+        PREFIX, strictly inside the committed frontier), and the
+        table row is re-padded to scratch so the stale entries can
+        never alias a recycled block."""
+        keep = self.pool.blocks_for(min(self.slot_len[row] + 1,
+                                        self.max_seq))
+        extra = self.slot_blocks[row][keep:]
+        if not extra:
+            return
+        self.pool.free(list(reversed(extra)))
+        del self.slot_blocks[row][keep:]
+        self._set_table_row(row)
+
     def _dispatch_decode(self) -> bool:
-        """One whole-batch decode dispatch over every row whose
-        prefill is complete."""
+        """One whole-batch dispatch over every row whose prefill is
+        complete: a VERIFY dispatch (``verify_step_paged``, width
+        draft_k+1) when any row carries a live n-gram draft, the
+        plain ``steps_per_dispatch`` decode scan otherwise — mixed
+        batches verify and 1-token-decode in the same forward
+        (draft-less rows just pad their lanes to scratch)."""
         def decode_rows():
             return [i for i in range(self.slots)
                     if self.slot_req[i] is not None
                     and self.slot_off[i] >= self.slot_total[i]]
 
+        drafts = self._collect_drafts(decode_rows()) \
+            if self.speculative else {}
         n = self.steps
         # Grow allocations for this dispatch's writes up front;
         # exhaustion preempts the youngest request (possibly a row in
-        # this very list, which then simply sits the dispatch out).
+        # this very list, which then simply sits the dispatch out —
+        # a preempted row's draft dies with it).
         for i in decode_rows():
             if self.slot_req[i] is None:
                 # Preempted by an earlier row's growth in this very
                 # loop — it sits the dispatch out.
                 continue
-            emit = min(self.slot_left[i], n)
+            # Plain decode writes min(slot_left, n) positions past
+            # slot_len; a verify row writes its base token + draft
+            # (draft length is pre-capped at slot_left - 1).
+            need = min(self.slot_left[i], n)
+            if i in drafts:
+                need = max(need, len(drafts[i]) + 1)
             self._ensure_blocks(
-                i, min(self.slot_len[i] + emit, self.max_seq))
+                i, min(self.slot_len[i] + need, self.max_seq))
         active_rows = decode_rows()
         if not active_rows:
             return False
+        drafts = {i: d for i, d in drafts.items()
+                  if self.slot_req[i] is not None}
+        if drafts:
+            return self._run_verify_dispatch(active_rows, drafts)
         # On-demand profiling hook: one "step" per decode dispatch
         # (docs/observability.md, On-demand profiling).
         self._profiler.on_step()
@@ -1304,31 +1844,146 @@ class BatchingEngine:
         t_chunk_start = t_chunk_end - dispatch_s
         emitted = 0
         for i in active_rows:
+            emitted += self._emit_tokens(i, host_toks[i][:n],
+                                         t_chunk_start, t_chunk_end)
+        if emitted:
+            self._metrics['tokens'].inc(emitted)
+        return True
+
+    def _emit_tokens(self, row: int, toks, t_start: float,
+                     t_end: float) -> int:
+        """Shared emission tail for decode AND verify dispatches:
+        push tokens to the client in order until EOS or the
+        request's budget (EOS retires the row NOW — anything the
+        device computed past it in this dispatch is discarded with
+        the row's blocks/table at retirement), record the
+        per-request ``batch.decode`` span, tick the speculation
+        re-probe cooldown, and retire the row when done. Returns
+        the number of tokens emitted."""
+        req = self.slot_req[row]
+        done = False
+        row_emitted = 0
+        for t in toks:
+            if self.slot_left[row] <= 0:
+                break
+            req.out.put(int(t))
+            req.generated.append(int(t))
+            row_emitted += 1
+            self.slot_left[row] -= 1
+            if int(t) == req.eos_id:
+                done = True
+                break
+        if row_emitted:
+            trace_lib.record_span(
+                'batch.decode', t_start, t_end, req.trace_ctx,
+                attrs={'tokens': row_emitted, 'slot': row})
+        # Collapsed-speculation rows re-probe after a cooldown of
+        # emitted tokens (_spec_k_for).
+        req.spec_cooldown = max(0, req.spec_cooldown - row_emitted)
+        if done or self.slot_left[row] <= 0:
+            req.out.put(None)
+            self._retire(row)
+        return row_emitted
+
+    def _run_verify_dispatch(self, active_rows: List[int],
+                             drafts: Dict[int, List[int]]) -> bool:
+        """One speculative VERIFY dispatch: every decode-ready row
+        rides the same ``verify_step_paged`` forward — rows with a
+        draft verify draft+1 positions, draft-less rows decode their
+        1 base token (their padded lanes write scratch). Drafted K/V
+        went into the rows' blocks up front; a rejection at draft
+        position a simply rolls the row's ``pos`` forward by only
+        a+1 (the accepted span), so the abandoned rows are never
+        attended again, and whole blocks past the committed frontier
+        are returned to the pool (``_trim_blocks``). Emission is
+        ``preds[0..a]`` — exactly what plain greedy decode would
+        have produced, one forward at a time."""
+        w = self.draft_k + 1
+        toks = [[0] * w for _ in range(self.slots)]
+        n_real = [0] * self.slots
+        for i in active_rows:
             req = self.slot_req[i]
-            emit = min(self.slot_left[i], n)
-            done = False
-            row_emitted = 0
-            for t in host_toks[i][:emit]:
-                req.out.put(int(t))
-                req.generated.append(int(t))
-                emitted += 1
-                row_emitted += 1
-                self.slot_left[i] -= 1
-                if int(t) == req.eos_id:
-                    # EOS retires the row NOW; anything the device
-                    # computed past it in this dispatch is discarded
-                    # (the row's blocks are freed and its table row
-                    # cleared at retirement).
-                    done = True
-                    break
-            if row_emitted:
-                trace_lib.record_span(
-                    'batch.decode', t_chunk_start, t_chunk_end,
-                    req.trace_ctx,
-                    attrs={'tokens': row_emitted, 'slot': i})
-            if done or self.slot_left[i] <= 0:
-                req.out.put(None)
-                self._retire(i)
+            d = drafts.get(i, ())
+            # generated[-1] is the row's current input token — the
+            # host mirror of self.tokens[i] (every emission path
+            # appends it before the next dispatch).
+            toks[i][0] = req.generated[-1]
+            toks[i][1:1 + len(d)] = d
+            n_real[i] = 1 + len(d)
+        self._profiler.on_step()
+        t_dispatch = time.perf_counter()
+        preds, accepted, self.pos, self.tokens, self.caches = \
+            self._verify_fn(
+                self.params, jnp.asarray(toks, jnp.int32),
+                self.caches, self.block_tables, self.pos,
+                jnp.asarray(n_real, jnp.int32), self.config, w,
+                self.block_size)
+        host_preds, host_acc = jax.device_get((preds, accepted))
+        dispatch_s = time.perf_counter() - t_dispatch
+        t_chunk_end = time.time()
+        t_chunk_start = t_chunk_end - dispatch_s
+        emitted = 0
+        proposed_total = 0
+        accepted_total = 0
+        for i in active_rows:
+            req = self.slot_req[i]
+            d = drafts.get(i, [])
+            preds_i = host_preds[i]
+            a = int(host_acc[i])
+            if d:
+                proposed_total += len(d)
+                accepted_total += a
+                req.spec_window.append((len(d), a))
+                new_k = update_spec_k(req.spec_k, req.spec_window,
+                                      self.draft_k)
+                if new_k != req.spec_k:
+                    grew = new_k > req.spec_k
+                    req.spec_k = new_k
+                    if new_k == 0:
+                        # Backed-off cooldown: repeated failed
+                        # probes stretch the next one out
+                        # exponentially, so adversarial traffic's
+                        # probing overhead vanishes relative to
+                        # its stream length.
+                        req.spec_cooldown = (
+                            SPEC_REPROBE_TOKENS *
+                            (2 ** min(req.spec_fail_streak,
+                                      SPEC_BACKOFF_MAX_EXP)))
+                        req.spec_fail_streak += 1
+                        req.spec_window.clear()
+                    elif grew and new_k >= 2:
+                        # A probe caught a regime change: the
+                        # request speculates again — forget the
+                        # backoff.
+                        req.spec_fail_streak = 0
+            # Committed KV: the base token + a accepted drafts. The
+            # device already advanced pos/tokens by exactly this —
+            # the rollback IS that arithmetic: rejected positions
+            # sit past the new frontier, never attended
+            # (length-masked attention), never emitted, never in
+            # ``generated``.
+            self.slot_len[i] = min(self.slot_len[i] + a + 1,
+                                   self.max_seq)
+            emitted += self._emit_tokens(i, preds_i[:a + 1],
+                                         t_chunk_start, t_chunk_end)
+            if self.slot_req[i] is not None and a < len(d):
+                self._trim_blocks(i)
+        if dispatch_s > 0:
+            self._metrics['tok_s'].set(emitted / dispatch_s)
+        if proposed_total:
+            self._metrics['spec_proposed'].inc(proposed_total)
+            self._spec_proposed_local += proposed_total
+        if accepted_total:
+            self._metrics['spec_accepted'].inc(accepted_total)
+        self._spec_accepted_local += accepted_total
+        self._metrics['spec_tokens_per_forward'].set(
+            emitted / max(1, len(active_rows)))
+        # 'decode' first for the interleaving contract (a verify IS
+        # this iteration's decode dispatch); 'verify' carries the
+        # speculation accounting the spec tests assert.
+        self.events.append(('decode', len(active_rows)))
+        self.events.append(('verify', len(drafts), proposed_total,
+                            accepted_total))
         if emitted:
             self._metrics['tokens'].inc(emitted)
         return True
@@ -1396,6 +2051,42 @@ class BatchingEngine:
                         'regressions visible within its '
                         'window).')
                 self._hit_ratio_gauge.set(d_hits / d_total)
+        if self.speculative:
+            # Trailing-window speculative accept rate — the same
+            # windowed-rate / lazy-register / idle-unregister
+            # contract as the prefix hit ratio above (the
+            # spec-accept-rate-low rule must see a collapse within
+            # one window, and an idle or spec-off replica must not
+            # export a frozen ratio that keeps it firing).
+            now = time.time()
+            win = self._spec_window
+            if not win or now - win[-1][0] >= 1.0:
+                win.append((now, self._spec_proposed_local,
+                            self._spec_accepted_local))
+            horizon = now - SPEC_RATIO_WINDOW_SECONDS
+            while len(win) > 1 and win[1][0] <= horizon:
+                win.popleft()
+            d_prop = self._spec_proposed_local - win[0][1]
+            d_acc = self._spec_accepted_local - win[0][2]
+            if d_prop <= 0 and self._spec_ratio_gauge is not None:
+                metrics_lib.registry().unregister(
+                    'skytpu_batch_spec_accept_ratio')
+                self._spec_ratio_gauge = None
+            if d_prop > 0:
+                # Get-or-create on every write (sibling-engine idle
+                # sweeps may unregister the process-global family);
+                # unlabeled, one-engine-per-process assumption as
+                # the prefix ratio documents.
+                self._spec_ratio_gauge = \
+                    metrics_lib.registry().gauge(
+                        'skytpu_batch_spec_accept_ratio',
+                        'Accepted/proposed draft tokens over the '
+                        'trailing window (a windowed rate — the '
+                        'spec-accept-rate-low alert needs '
+                        'collapses visible within its window). '
+                        'LAZY: only exported by a speculative '
+                        'engine that proposed drafts in-window.')
+                self._spec_ratio_gauge.set(d_acc / d_prop)
 
     def _fail_all(self, exc: BaseException) -> None:
         """Fail-stop for ENGINE death (an unexpected loop exception):

@@ -113,6 +113,28 @@ def write_index(block_tables: jax.Array, pos: jax.Array,
     return jnp.where(safe, idx, SCRATCH_BLOCK * block_size)
 
 
+def verify_write_indices(block_tables: jax.Array, pos: jax.Array,
+                         n_real: jax.Array, width: int,
+                         block_size: int) -> jax.Array:
+    """Flat pool-slot indices for a speculative VERIFY dispatch:
+    row b writes ``width`` consecutive positions starting at
+    ``pos[b]`` (its current token plus drafted continuation), of
+    which only the first ``n_real[b]`` are real. Padded draft lanes
+    (j >= n_real[b]), parked rows (n_real 0) and positions past the
+    table capacity all redirect to the scratch block — a rejected or
+    padded draft can never touch a block another request owns.
+    block_tables [B, MB], pos/n_real [B] -> [B, width]."""
+    t = jnp.arange(width, dtype=jnp.int32)
+    p = pos[:, None] + t[None, :]                        # [B, W]
+    mb = block_tables.shape[-1]
+    blk = jnp.minimum(jnp.maximum(p, 0) // block_size, mb - 1)
+    idx = (jnp.take_along_axis(block_tables, blk, axis=1) *
+           block_size + jnp.maximum(p, 0) % block_size)
+    valid = ((t[None, :] < n_real[:, None]) & (p >= 0) &
+             (p < mb * block_size))
+    return jnp.where(valid, idx, SCRATCH_BLOCK * block_size)
+
+
 def chunk_write_indices(block_row: jax.Array, start: jax.Array,
                         real_len: jax.Array, chunk: int,
                         block_size: int) -> jax.Array:

@@ -33,6 +33,8 @@ class SkyServiceSpec:
         engine_num_blocks: Optional[int] = None,
         engine_max_num_batched_tokens: Optional[int] = None,
         engine_prefix_caching: Optional[bool] = None,
+        engine_speculative: Optional[bool] = None,
+        engine_draft_k: Optional[int] = None,
         load_balancing_policy: Optional[str] = None,
         upgrade_drain_grace_seconds: Optional[float] = None,
         upgrade_soak_seconds: Optional[float] = None,
@@ -119,6 +121,25 @@ class SkyServiceSpec:
             raise exceptions.InvalidSpecError(
                 'engine.prefix_caching must be a boolean (on|off)')
         self.engine_prefix_caching = engine_prefix_caching
+        # engine.speculative (on|off) / engine.draft_k: speculative
+        # decoding on the paged engine (serve/batching.py) —
+        # self-speculative n-gram drafting with batched multi-token
+        # verify; greedy outputs stay token-for-token identical, so
+        # this is a latency/throughput knob, never a quality one.
+        # None keeps the engine defaults (on, k=8); draft_k 0 is
+        # equivalent to off.
+        if engine_speculative is not None and \
+                not isinstance(engine_speculative, bool):
+            raise exceptions.InvalidSpecError(
+                'engine.speculative must be a boolean (on|off)')
+        if engine_draft_k is not None and (
+                not isinstance(engine_draft_k, int) or
+                isinstance(engine_draft_k, bool) or
+                engine_draft_k < 0):
+            raise exceptions.InvalidSpecError(
+                'engine.draft_k must be an integer >= 0')
+        self.engine_speculative = engine_speculative
+        self.engine_draft_k = engine_draft_k
         # LB policy knob (serve/load_balancer.py): least_load
         # (default), round_robin, or the KV-aware prefix_affinity
         # that concentrates repeat prefixes where their cached
@@ -205,6 +226,8 @@ class SkyServiceSpec:
             engine_max_num_batched_tokens=engine.get(
                 'max_num_batched_tokens'),
             engine_prefix_caching=engine.get('prefix_caching'),
+            engine_speculative=engine.get('speculative'),
+            engine_draft_k=engine.get('draft_k'),
             load_balancing_policy=lb_policy,
             upgrade_drain_grace_seconds=upgrade.get(
                 'drain_grace_seconds'),
@@ -229,6 +252,11 @@ class SkyServiceSpec:
         if self.engine_prefix_caching is not None:
             env['SKYTPU_ENGINE_PREFIX_CACHING'] = \
                 '1' if self.engine_prefix_caching else '0'
+        if self.engine_speculative is not None:
+            env['SKYTPU_ENGINE_SPECULATIVE'] = \
+                '1' if self.engine_speculative else '0'
+        if self.engine_draft_k is not None:
+            env['SKYTPU_ENGINE_DRAFT_K'] = str(self.engine_draft_k)
         return env
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -271,6 +299,10 @@ class SkyServiceSpec:
                 self.engine_max_num_batched_tokens
         if self.engine_prefix_caching is not None:
             engine['prefix_caching'] = self.engine_prefix_caching
+        if self.engine_speculative is not None:
+            engine['speculative'] = self.engine_speculative
+        if self.engine_draft_k is not None:
+            engine['draft_k'] = self.engine_draft_k
         if engine:
             out['engine'] = engine
         if self.load_balancing_policy is not None:

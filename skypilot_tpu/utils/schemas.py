@@ -157,6 +157,11 @@ SERVICE_SCHEMA = {
                 # Automatic prefix caching (serve/kv_pool.py);
                 # YAML on|off parses to a boolean.
                 'prefix_caching': {'type': 'boolean'},
+                # Speculative decoding (serve/batching.py):
+                # self-speculative n-gram drafting + batched
+                # multi-token verify; draft_k 0 == off.
+                'speculative': {'type': 'boolean'},
+                'draft_k': {'type': 'integer', 'minimum': 0},
             },
         },
         # KV-aware routing knob (serve/load_balancer.py).

@@ -1,0 +1,726 @@
+"""Speculative decoding on the paged engine: n-gram drafting,
+batched multi-token verify, greedy acceptance, pos rollback,
+adaptive draft length, budget accounting, and the
+prefix-cache x speculation interaction (serve/batching.py
+verify_step_paged / propose_ngram_draft / greedy_accept,
+ops/decode_attention.paged_verify_attention,
+serve/kv_pool.verify_write_indices).
+
+The non-negotiable contract everywhere: spec-on == spec-off ==
+single-stream greedy, token for token."""
+import dataclasses
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.models import decode, llama
+from skypilot_tpu.serve import batching, kv_pool
+from skypilot_tpu.serve.batching import (BatchingEngine,
+                                         greedy_accept,
+                                         propose_ngram_draft,
+                                         update_spec_k)
+
+
+@pytest.fixture(scope='module')
+def setup():
+    config = llama.get_config('tiny')
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+@pytest.fixture(scope='module')
+def loopy_setup():
+    """A vocab-restricted tiny config: greedy decode enters
+    repetition loops quickly, which is the regime where n-gram
+    drafting actually fires and accepts (full-vocab random-init
+    output is too chaotic to draft against)."""
+    config = dataclasses.replace(llama.get_config('tiny'),
+                                 vocab_size=16)
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def _reference(params, config, prompt_ids, max_new, max_seq=64,
+               kv_int8=False):
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    out = decode.greedy_generate(params, prompt, config,
+                                 max_new_tokens=max_new,
+                                 max_seq=max_seq, kv_int8=kv_int8)
+    return [int(t) for t in out[0]]
+
+
+def _drain(q, timeout=120):
+    toks = []
+    while True:
+        t = q.get(timeout=timeout)
+        if t is None:
+            return toks
+        assert not isinstance(t, BaseException), t
+        toks.append(t)
+
+
+# ---------------------------------------------------------------------
+# Drafting + acceptance units
+# ---------------------------------------------------------------------
+
+
+class TestProposer:
+
+    def test_sequential_lookup_follows_history(self):
+        # Suffix re-anchors after each drafted token: a period-4
+        # stream drafts its own loop for as long as asked.
+        toks = [1, 2, 3, 4] * 4
+        assert propose_ngram_draft(toks, 6) == [1, 2, 3, 4, 1, 2]
+
+    def test_no_match_no_draft(self):
+        assert propose_ngram_draft([5, 6, 7, 8, 9], 4) == []
+        assert propose_ngram_draft([1], 4) == []
+        assert propose_ngram_draft([1, 2, 3, 1, 2], 0) == []
+
+    def test_match_window_bounds_the_scan(self):
+        # The only occurrence of the suffix bigram sits outside the
+        # scan window: no proposal (and no O(prompt) walk).
+        toks = [7, 9] + list(range(20, 520)) + [7, 9]
+        assert propose_ngram_draft(toks, 4, window=64) == []
+        assert propose_ngram_draft(toks, 4, window=10_000) != []
+
+    def test_min_ngram_is_an_evidence_bar(self):
+        # Bigram repeats but no 4-gram repeats: the probe-mode bar
+        # (min_ngram=4) rejects what the default bar accepts.
+        toks = [1, 2, 9, 1, 2, 8, 1, 2]
+        assert propose_ngram_draft(toks, 3, min_ngram=2) != []
+        assert propose_ngram_draft(toks, 3, min_ngram=4) == []
+
+
+class TestGreedyAccept:
+
+    def _accept(self, toks, preds, n_real):
+        out = greedy_accept(jnp.asarray(toks, jnp.int32),
+                            jnp.asarray(preds, jnp.int32),
+                            jnp.asarray(n_real, jnp.int32))
+        return [int(a) for a in out]
+
+    def test_leading_run_semantics(self):
+        # Row 0: drafts [5, 6, 7] all confirmed; row 1: first draft
+        # wrong; row 2: second wrong (5 ok, then 9 != 6).
+        toks = [[1, 5, 6, 7], [1, 9, 6, 7], [1, 5, 9, 7]]
+        preds = [[5, 6, 7, 2], [5, 6, 7, 2], [5, 6, 7, 2]]
+        assert self._accept(toks, preds, [4, 4, 4]) == [3, 0, 1]
+
+    def test_padded_lanes_never_accept(self):
+        # n_real masks the pad: a padded lane that happens to equal
+        # the pred must not count.
+        toks = [[1, 5, 6, 7]]
+        preds = [[5, 6, 7, 2]]
+        assert self._accept(toks, preds, [2]) == [1]
+        assert self._accept(toks, preds, [1]) == [0]   # no drafts
+        assert self._accept(toks, preds, [0]) == [0]   # parked row
+
+
+class TestAdaptiveController:
+
+    def test_shrink_collapse_grow(self):
+        win = [(4, 1)]   # rate 0.25, thin evidence (< 8): halve
+        assert update_spec_k(8, win, 8) == 4
+        win = [(8, 0), (8, 1)]  # rate ~0.06 over >= 8: collapse
+        assert update_spec_k(8, win, 8) == 0
+        win = [(8, 1)]   # rate 0.125 over exactly 8: collapse
+        assert update_spec_k(8, win, 8) == 0
+        win = [(4, 4), (4, 4)]  # rate 1.0: grow, capped
+        assert update_spec_k(4, win, 8) == 8
+        assert update_spec_k(8, win, 8) == 8
+        # Recovery from a collapsed probe: 0 -> 1.
+        assert update_spec_k(0, [(1, 1), (2, 2), (2, 2), (4, 4)],
+                             8) == 1
+        # Mid rates hold.
+        assert update_spec_k(4, [(8, 5)], 8) == 4
+        assert update_spec_k(4, [], 8) == 4
+
+
+# ---------------------------------------------------------------------
+# Verify-forward numerics (function level)
+# ---------------------------------------------------------------------
+
+
+class TestVerifyStepPaged:
+
+    def _pool_from_prefill(self, setup):
+        """Two prompts prefilled contiguously into a paged pool
+        (the decode-twin test's construction)."""
+        config, params = setup
+        prompts = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]],
+                              jnp.int32)
+        cache = decode.init_cache(config, 2, max_seq=32)
+        logits, cache = decode.forward_cached(params, prompts,
+                                              cache, config, True)
+        first = logits[:, -1].argmax(-1).astype(jnp.int32)
+        bs, nb, nl = 8, 9, config.n_layers
+        k_pool = jnp.zeros((nl, nb, bs, config.n_kv_heads,
+                            config.head_dim), cache.k.dtype)
+        v_pool = jnp.zeros_like(k_pool)
+        tables = []
+        for b in range(2):
+            blocks = [1 + b * 4 + i for i in range(4)]
+            tables.append(blocks)
+            rk = cache.k[:, b].reshape(nl, 4, bs, config.n_kv_heads,
+                                       config.head_dim)
+            rv = cache.v[:, b].reshape(nl, 4, bs, config.n_kv_heads,
+                                       config.head_dim)
+            for i, blk in enumerate(blocks):
+                k_pool = k_pool.at[:, blk].set(rk[:, i])
+                v_pool = v_pool.at[:, blk].set(rv[:, i])
+        return (first, (k_pool, v_pool, None, None),
+                jnp.asarray(tables, jnp.int32),
+                jnp.asarray([4, 4], jnp.int32))
+
+    def test_true_drafts_fully_accepted_and_match_plain(self, setup):
+        config, params = setup
+        first, pools, tables, pos = self._pool_from_prefill(setup)
+        active = jnp.asarray([True, True])
+        want, _, _ = batching.decode_steps_paged(
+            params, first, pools, tables, pos, active, config, 5, 8)
+        want = np.asarray(want)                       # [2, 5]
+        # Drafts = the TRUE continuation: everything accepts and the
+        # committed state equals 4 plain decode steps.
+        w = 4
+        toks = jnp.concatenate([first[:, None],
+                                jnp.asarray(want[:, :3])], axis=1)
+        preds, accepted, new_pos, new_tok, _ = \
+            batching.verify_step_paged(
+                params, toks.astype(jnp.int32), pools, tables, pos,
+                jnp.asarray([w, w], jnp.int32), config, w, 8)
+        np.testing.assert_array_equal(np.asarray(accepted), [3, 3])
+        np.testing.assert_array_equal(np.asarray(preds),
+                                      want[:, :4])
+        np.testing.assert_array_equal(np.asarray(new_pos), [8, 8])
+        np.testing.assert_array_equal(np.asarray(new_tok),
+                                      want[:, 3])
+
+    def test_mid_draft_rejection_rolls_back_by_length(self, setup):
+        config, params = setup
+        first, pools, tables, pos = self._pool_from_prefill(setup)
+        active = jnp.asarray([True, True])
+        want, _, _ = batching.decode_steps_paged(
+            params, first, pools, tables, pos, active, config, 5, 8)
+        want = np.asarray(want)
+        # Corrupt row 0's second draft; row 1 keeps the truth.
+        draft = want[:, :3].copy()
+        draft[0, 1] = (draft[0, 1] + 1) % config.vocab_size
+        toks = jnp.concatenate([first[:, None],
+                                jnp.asarray(draft)], axis=1)
+        preds, accepted, new_pos, new_tok, _ = \
+            batching.verify_step_paged(
+                params, toks.astype(jnp.int32), pools, tables, pos,
+                jnp.asarray([4, 4], jnp.int32), config, 4, 8)
+        np.testing.assert_array_equal(np.asarray(accepted), [1, 3])
+        # Emissions up to the rejection are still the true tokens
+        # (the rejected lane only poisons KV PAST the rollback
+        # point, which new_pos excludes).
+        np.testing.assert_array_equal(np.asarray(preds)[0, :2],
+                                      want[0, :2])
+        np.testing.assert_array_equal(np.asarray(new_pos), [6, 8])
+        assert int(new_tok[0]) == int(want[0, 1])
+
+    def test_verify_write_indices_scratch_redirects(self):
+        bt = jnp.asarray([[3, 1], [2, 5]], jnp.int32)
+        got = kv_pool.verify_write_indices(
+            bt, jnp.asarray([5, 2], jnp.int32),
+            jnp.asarray([2, 1], jnp.int32), width=3, block_size=4)
+        # Row 0: positions 5, 6 real (block 1 offsets 1, 2), lane 2
+        # padded -> scratch. Row 1: position 2 real (block 2 off 2),
+        # lanes 1-2 padded -> scratch.
+        np.testing.assert_array_equal(
+            np.asarray(got), [[4 + 1, 4 + 2, 0], [8 + 2, 0, 0]])
+        # Parked row (n_real 0, pos at capacity): all scratch.
+        parked = kv_pool.verify_write_indices(
+            bt, jnp.asarray([8, 0], jnp.int32),
+            jnp.asarray([0, 0], jnp.int32), width=3, block_size=4)
+        np.testing.assert_array_equal(np.asarray(parked),
+                                      np.zeros((2, 3)))
+
+
+# ---------------------------------------------------------------------
+# Engine exactness: spec-on == spec-off == single-stream greedy
+# ---------------------------------------------------------------------
+
+
+class TestEngineExactness:
+
+    def test_repeat_heavy_is_exact_with_live_verifies(
+            self, loopy_setup):
+        """Loop-heavy decode: verifies must actually fire (some with
+        partial acceptance — the mid-block rejection path) and the
+        output must equal single-stream greedy token for token."""
+        config, params = loopy_setup
+        prompt = ([3, 9, 4, 1] * 5)[:18]
+        want = _reference(params, config, prompt, 40, max_seq=96)
+        engine = BatchingEngine(params, config, slots=2, max_seq=96,
+                                steps_per_dispatch=3, block_size=8,
+                                prefill_chunk=8,
+                                max_num_batched_tokens=64,
+                                draft_k=8)
+        try:
+            got = engine.generate(prompt, 40)
+            assert got == want, (got, want)
+            ver = [e for e in engine.events if e[0] == 'verify']
+            assert ver, 'no verify dispatch fired on a loop-heavy ' \
+                        'stream'
+            assert any(e[3] > 0 for e in ver), 'nothing accepted'
+            assert any(0 < e[3] < e[2] for e in ver) or \
+                any(e[3] == 0 for e in ver), \
+                'no rejection was exercised'
+        finally:
+            engine.close()
+
+    def test_spec_on_equals_spec_off_enginewide(self, loopy_setup):
+        config, params = loopy_setup
+        rng = np.random.default_rng(3)
+        cases = []
+        for i in range(6):
+            pat = [int(x) for x in
+                   rng.integers(1, config.vocab_size, size=5)]
+            cases.append(((pat * 6)[:12 + i], int(rng.integers(8,
+                                                               30))))
+
+        def run(spec):
+            eng = BatchingEngine(params, config, slots=3,
+                                 max_seq=96, steps_per_dispatch=4,
+                                 block_size=8, prefill_chunk=16,
+                                 max_num_batched_tokens=64,
+                                 speculative=spec, draft_k=8)
+            try:
+                qs = [eng.submit(p, m) for p, m in cases]
+                return [_drain(q) for q in qs]
+            finally:
+                eng.close()
+
+        off, on = run(False), run(True)
+        assert on == off, (on, off)
+        for (prompt, m), toks in zip(cases, on):
+            assert toks == _reference(params, config, prompt, m,
+                                      max_seq=96)
+
+    def test_int8_spec_on_matches_int8_plain(self, loopy_setup):
+        config, params = loopy_setup
+        prompt = [1, 2, 3, 1, 2, 3, 1, 2, 3]
+        engine = BatchingEngine(params, config, slots=2, max_seq=64,
+                                steps_per_dispatch=2, kv_int8=True,
+                                draft_k=8)
+        try:
+            got = engine.generate(prompt, 12)
+            assert got == _reference(params, config, prompt, 12,
+                                     kv_int8=True)
+        finally:
+            engine.close()
+
+    def test_adaptive_k_collapses_on_whiffing_drafts(self, setup,
+                                                     monkeypatch):
+        """Force the drafter to propose garbage: every verify
+        rejects, the controller hard-collapses k to 0 with
+        backed-off re-probes (the request converges to plain
+        decode), and the output is UNCHANGED — wrong drafts can
+        cost throughput, never correctness."""
+        config, params = setup
+
+        def bad_drafts(tokens, k, **_kwargs):
+            # Wrong on purpose: propose a constant the greedy
+            # stream essentially never produces twice in a row.
+            return [(tokens[-1] + 1) % config.vocab_size] * k
+
+        monkeypatch.setattr(batching, 'propose_ngram_draft',
+                            bad_drafts)
+        prompt = [(i * 7) % 250 + 1 for i in range(12)]
+        want = _reference(params, config, prompt, 40, max_seq=96)
+        engine = BatchingEngine(params, config, slots=2, max_seq=96,
+                                steps_per_dispatch=4, block_size=8,
+                                draft_k=8)
+        try:
+            req = engine.submit_request(prompt, 40)
+            got = _drain(req.out)
+            assert got == want, (got, want)
+            ver = [e for e in engine.events if e[0] == 'verify']
+            assert ver, 'forced drafts never reached a verify'
+            assert req.spec_k == 0, (req.spec_k, ver)
+            assert req.spec_fail_streak >= 1
+            # Converged: verifies are a handful of probes, not one
+            # per dispatch.
+            decodes = [e for e in engine.events
+                       if e[0] == 'decode']
+            assert len(ver) < len(decodes) / 2, (ver, decodes)
+        finally:
+            engine.close()
+
+    def test_preempt_with_live_drafts_no_leaks(self, loopy_setup):
+        """Pool pressure preempts rows that are actively
+        speculating: blocks (incl. drafted-then-rejected tails) are
+        reclaimed, resume re-prefills, outputs stay exact and the
+        pool ends with zero leaked blocks."""
+        config, params = loopy_setup
+        engine = BatchingEngine(params, config, slots=3, max_seq=64,
+                                steps_per_dispatch=4, block_size=8,
+                                num_blocks=7, draft_k=8)
+        try:
+            cases = [([1, 2, 3, 4] * 3, 12), ([6, 7, 8, 6, 7, 8],
+                                              12),
+                     ([2, 4, 2, 4, 2], 12)]
+            queues = [engine.submit(p, m) for p, m in cases]
+            for (prompt, m), q in zip(cases, queues):
+                assert _drain(q) == _reference(params, config,
+                                               prompt, m), prompt
+            ev = list(engine.events)
+            assert any(e[0] == 'preempt' for e in ev), ev
+            assert any(e[0] == 'verify' for e in ev), ev
+            deadline = time.time() + 10
+            while engine.pool.free_blocks != \
+                    engine.pool.usable_blocks and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+            assert engine.pool.free_blocks == \
+                engine.pool.usable_blocks, 'leaked KV blocks'
+            assert all(not b for b in engine.slot_blocks)
+        finally:
+            engine.close()
+
+    def test_interleaving_under_tight_budget_stays_exact(
+            self, loopy_setup):
+        """Mixed verify/decode/prefill under a small token budget:
+        a long prompt prefills chunk by chunk while a speculating
+        request decodes — both outputs exact, chunks interleaved
+        with decode dispatches."""
+        config, params = loopy_setup
+        engine = BatchingEngine(params, config, slots=2,
+                                max_seq=128, steps_per_dispatch=2,
+                                block_size=8, prefill_chunk=8,
+                                max_num_batched_tokens=8, draft_k=8)
+        try:
+            q_short = engine.submit([1, 2, 3, 1, 2, 3], 24)
+            first_short = q_short.get(timeout=120)
+            long_prompt = [(i * 3) % (config.vocab_size - 1) + 1
+                           for i in range(40)]
+            q_long = engine.submit(long_prompt, 4)
+            short = [first_short] + _drain(q_short)
+            long = _drain(q_long)
+            assert short == _reference(params, config,
+                                       [1, 2, 3, 1, 2, 3], 24,
+                                       max_seq=128)
+            assert long == _reference(params, config, long_prompt,
+                                      4, max_seq=128)
+            events = list(engine.events)
+            chunk_idx = [i for i, e in enumerate(events)
+                         if e[0] == 'prefill_chunk' and e[3] == 40]
+            assert len(chunk_idx) == 5, events
+            between = [e for i, e in enumerate(events)
+                       if e[0] == 'decode'
+                       and chunk_idx[0] < i < chunk_idx[-1]]
+            assert between, events
+        finally:
+            engine.close()
+
+    def test_tiny_budget_suppresses_drafts(self, loopy_setup):
+        """A verify row costs drafted+1 budget tokens: with the
+        iteration budget barely covering the base tokens, drafts
+        are never granted and the engine stays on the plain path
+        (speculation degrades before starving prefill)."""
+        config, params = loopy_setup
+        engine = BatchingEngine(params, config, slots=2, max_seq=64,
+                                steps_per_dispatch=2, block_size=8,
+                                max_num_batched_tokens=2, draft_k=8)
+        try:
+            prompt = [1, 2, 3, 4] * 3
+            got = engine.generate(prompt, 16)
+            assert got == _reference(params, config, prompt, 16)
+            assert not [e for e in engine.events
+                        if e[0] == 'verify'], list(engine.events)
+        finally:
+            engine.close()
+
+
+# ---------------------------------------------------------------------
+# Prefix cache x speculation (the regression the ISSUE names)
+# ---------------------------------------------------------------------
+
+
+class TestSpecPrefixInteraction:
+
+    def test_rejected_drafts_never_enter_registered_chains(
+            self, loopy_setup):
+        """A verify rollback must not leave drafted tokens inside
+        any block `_register_prefix` later hashes: every registered
+        chain hash must be derivable from EMITTED tokens only —
+        including after preempt-and-resume re-registration at block
+        boundaries — and must equal the chain a plain-decode engine
+        registers for the same workload."""
+        config, params = loopy_setup
+        cases = [([1, 2, 3, 4] * 3, 14), ([6, 7, 8, 6, 7, 8], 14),
+                 ([2, 4, 2, 4, 2], 14)]
+
+        def run(spec):
+            eng = BatchingEngine(params, config, slots=3,
+                                 max_seq=64, steps_per_dispatch=4,
+                                 block_size=8, num_blocks=9,
+                                 prefix_caching=True,
+                                 speculative=spec, draft_k=8)
+            try:
+                qs = [eng.submit(p, m) for p, m in cases]
+                outs = [_drain(q) for q in qs]
+                # Wait for the scheduler to settle retirements.
+                deadline = time.time() + 10
+                while eng.pool.free_blocks != \
+                        eng.pool.usable_blocks and \
+                        time.time() < deadline:
+                    time.sleep(0.05)
+                hashes = set(eng.pool._hash_to_block)  # pylint: disable=protected-access
+                meta = dict(eng.pool._hash_meta)  # pylint: disable=protected-access
+                return outs, hashes, meta
+            finally:
+                eng.close()
+
+        outs_on, hashes_on, meta_on = run(True)
+        outs_off, hashes_off, _ = run(False)
+        assert outs_on == outs_off
+        # Identical emitted streams must register IDENTICAL chains:
+        # a drafted-but-rejected token leaking into a hashed block
+        # would diverge the chains.
+        assert hashes_on == hashes_off
+        ver_some = False
+        for (prompt, _), out in zip(cases, outs_on):
+            stream = prompt + out
+            want = kv_pool.chain_hashes(stream, 8)
+            for i, h in enumerate(want):
+                if h in meta_on:
+                    _, toks = meta_on[h]
+                    assert list(toks) == stream[i * 8:(i + 1) * 8]
+                    ver_some = True
+        assert ver_some, 'no registered chain overlapped a request'
+
+    def test_resubmit_after_speculative_run_hits_cache_exact(
+            self, loopy_setup):
+        """Blocks registered by a speculating request must be
+        REUSABLE: an identical resubmit pins them (prefix hit) and
+        still produces the exact greedy stream."""
+        config, params = loopy_setup
+        prompt = ([5, 11, 2, 9] * 5)[:18]
+        engine = BatchingEngine(params, config, slots=2, max_seq=96,
+                                steps_per_dispatch=3, block_size=8,
+                                prefix_caching=True, draft_k=8)
+        try:
+            want = _reference(params, config, prompt, 20,
+                              max_seq=96)
+            assert engine.generate(prompt, 20) == want
+            req = engine.submit_request(prompt, 20)
+            assert _drain(req.out) == want
+            assert req.prefix_hit_blocks >= 1
+        finally:
+            engine.close()
+
+
+# ---------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------
+
+
+class TestSpecMetrics:
+
+    def test_counters_and_ratio_window(self, loopy_setup,
+                                       monkeypatch):
+        from skypilot_tpu import metrics as metrics_lib
+        monkeypatch.setattr(batching, 'SPEC_RATIO_WINDOW_SECONDS',
+                            2.0)
+        config, params = loopy_setup
+        engine = BatchingEngine(params, config, slots=2, max_seq=96,
+                                steps_per_dispatch=3, block_size=8,
+                                draft_k=8)
+        try:
+            m = engine._metrics  # pylint: disable=protected-access
+            p0 = m['spec_proposed'].value
+            a0 = m['spec_accepted'].value
+            engine.generate(([3, 9, 4, 1] * 5)[:18], 40)
+            assert m['spec_proposed'].value > p0
+            assert m['spec_accepted'].value > a0
+            assert m['spec_tokens_per_forward'].value >= 1.0
+
+            def gauge_present():
+                return any(
+                    f.name == 'skytpu_batch_spec_accept_ratio'
+                    for f in metrics_lib.registry().families())
+
+            # The windowed ratio gauge is exported while drafts are
+            # in-window...
+            deadline = time.time() + 10
+            while not gauge_present() and time.time() < deadline:
+                time.sleep(0.1)
+            assert gauge_present()
+            # ...and DROPS once the trailing window empties (the
+            # spec-accept-rate-low rule must see absent data, not a
+            # frozen ratio).
+            deadline = time.time() + 15
+            while gauge_present() and time.time() < deadline:
+                time.sleep(0.2)
+            assert not gauge_present()
+        finally:
+            engine.close()
+
+
+# ---------------------------------------------------------------------
+# Lint: ONE acceptance implementation
+# ---------------------------------------------------------------------
+
+
+class TestAcceptanceLint:
+    """The greedy acceptance rule must have exactly ONE
+    implementation — ``batching.greedy_accept``, the function the
+    exactness suite certifies. Any other draft-vs-argmax comparison
+    in the serving stack is a second acceptance path the tests do
+    not cover (the slab-allocation lint's shape, applied to
+    acceptance logic)."""
+
+    def _py_files(self):
+        import skypilot_tpu
+        root = os.path.dirname(skypilot_tpu.__file__)
+        for dirpath, _, files in os.walk(root):
+            if '__pycache__' in dirpath:
+                continue
+            for fn in files:
+                if fn.endswith('.py'):
+                    yield os.path.join(dirpath, fn)
+
+    def test_single_greedy_accept_definition(self):
+        defs = []
+        for path in self._py_files():
+            text = open(path, encoding='utf-8').read()
+            for m in re.finditer(r'^\s*def greedy_accept\(', text,
+                                 re.M):
+                defs.append(path)
+        assert len(defs) == 1 and \
+            defs[0].endswith(os.path.join('serve', 'batching.py')), \
+            defs
+
+    def test_no_draft_argmax_comparison_outside_the_function(self):
+        """No line outside serve/batching.py may compare drafted
+        tokens against verify predictions (the ``preds``/``draft``
+        comparison idiom), and batching.py itself must route the
+        engine's acceptance through greedy_accept."""
+        offenders = []
+        for path in self._py_files():
+            if path.endswith(os.path.join('serve', 'batching.py')):
+                continue
+            for i, line in enumerate(
+                    open(path, encoding='utf-8'), 1):
+                stripped = line.split('#', 1)[0]
+                if re.search(r'draft\w*\s*[!=]=|[!=]=\s*draft\w*',
+                             stripped) or \
+                        (re.search(r'\bpreds?\b', stripped) and
+                         re.search(r'[!=]=', stripped)):
+                    offenders.append(f'{path}:{i}')
+        assert not offenders, (
+            'draft-acceptance comparison outside '
+            'batching.greedy_accept: ' + ', '.join(offenders))
+        text = open(next(p for p in self._py_files()
+                         if p.endswith(os.path.join(
+                             'serve', 'batching.py'))),
+                    encoding='utf-8').read()
+        assert 'greedy_accept(tokens, preds, n_real)' in text
+
+
+# ---------------------------------------------------------------------
+# Knob plumbing
+# ---------------------------------------------------------------------
+
+
+class TestSpecKnobs:
+
+    def test_spec_round_trip_and_env(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        spec = SkyServiceSpec.from_yaml_config({
+            'engine': {'speculative': False, 'draft_k': 4},
+        })
+        assert spec.engine_speculative is False
+        assert spec.engine_draft_k == 4
+        out = spec.to_yaml_config()
+        assert out['engine'] == {'speculative': False, 'draft_k': 4}
+        again = SkyServiceSpec.from_yaml_config(out)
+        assert again.engine_speculative is False
+        assert again.engine_draft_k == 4
+        env = again.engine_env()
+        assert env['SKYTPU_ENGINE_SPECULATIVE'] == '0'
+        assert env['SKYTPU_ENGINE_DRAFT_K'] == '4'
+        bare = SkyServiceSpec.from_yaml_config({})
+        assert bare.engine_speculative is None
+        assert bare.engine_draft_k is None
+        assert 'SKYTPU_ENGINE_SPECULATIVE' not in bare.engine_env()
+
+    def test_validation(self):
+        from skypilot_tpu.serve.service_spec import SkyServiceSpec
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_speculative='yes')
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_draft_k=-1)
+        with pytest.raises(exceptions.InvalidSpecError):
+            SkyServiceSpec(engine_draft_k=True)
+
+    def test_schema_fields(self):
+        from skypilot_tpu.utils import schemas
+        props = schemas.SERVICE_SCHEMA['properties']['engine'][
+            'properties']
+        assert props['speculative'] == {'type': 'boolean'}
+        assert props['draft_k'] == {'type': 'integer', 'minimum': 0}
+
+
+# ---------------------------------------------------------------------
+# Acceptance bench (slow): repeat-heavy spec-on vs spec-off
+# ---------------------------------------------------------------------
+
+
+class TestServeSpecBench:
+
+    @pytest.mark.slow
+    def test_spec_on_wins_repeat_heavy_and_bounds_adversarial(
+            self, tmp_path, monkeypatch):
+        """The acceptance bench: >= 1.5x out_tok/s at small batch on
+        the repeat-heavy CPU-proxy load with token-exact outputs;
+        adversarial load converges to plain decode (a handful of
+        verify dispatches at most) and stays near parity; the row
+        lands in bench_runs and survives --assert-no-regress."""
+        import importlib.util
+        import skypilot_tpu
+        root = os.path.dirname(os.path.dirname(
+            skypilot_tpu.__file__))
+        spec = importlib.util.spec_from_file_location(
+            'bench', os.path.join(root, 'bench.py'))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        monkeypatch.setenv('SKYTPU_STATE_DIR', str(tmp_path))
+        result = bench.serve_spec_main()
+        detail = result['detail']
+        if result['vs_baseline'] < 1.5 or \
+                detail['adversarial']['out_tok_s_ratio'] < 0.85:
+            # One retry: an open-loop wall-clock bench on a busy CI
+            # box sees scheduling noise (typical margins observed:
+            # 1.65-2.0x headline, 0.88-1.02 adversarial).
+            result = bench.serve_spec_main()
+            detail = result['detail']
+        assert result['unit'] == 'tokens/s'
+        assert result['vs_baseline'] >= 1.5, detail
+        assert detail['outputs_token_exact'] is True
+        assert detail['spec_on']['accept_rate'] > 0.5, detail
+        adv = detail['adversarial']
+        # The wall-clock ratio is noise-bounded on a ~100ms window;
+        # the verify-dispatch cap below is the mechanical proof of
+        # convergence.
+        assert adv['out_tok_s_ratio'] >= 0.85, adv
+        # Convergence is mechanical, not statistical: the adaptive
+        # controller shuts speculation down after a handful of
+        # whiffed dispatches across the whole adversarial load.
+        assert adv['spec_on']['verify_dispatches'] <= 8, adv
+        from skypilot_tpu.benchmark import benchmark_state
+        run_id = benchmark_state.record_bench_run(result)
+        assert run_id is not None
+        assert not benchmark_state.check_regression(result)
+        rows = benchmark_state.bench_diff()
+        assert any(r['metric'] == result['metric'] for r in rows)
