@@ -219,6 +219,16 @@ def fleet_rules() -> List[AlertRule]:
                               # pages even among idle neighbors
             window=300.0, for_seconds=120.0,
             summary='Device HBM above 92% of capacity — OOM risk.'),
+        AlertRule(
+            id='state-watch-lagging', kind='threshold',
+            metric='skytpu_state_watch_lag_seconds',
+            threshold=5.0, resolve_threshold=1.0, op='>',
+            aggregate='max',  # the worst watcher's lag
+            window=300.0, for_seconds=120.0,
+            summary='Control-plane journal watchers are observing '
+                    'events seconds after append — tailer-driven '
+                    'controllers are degrading toward poll cadence '
+                    '(docs/state.md watch semantics).'),
     ]
     return _apply_overrides(rules)
 

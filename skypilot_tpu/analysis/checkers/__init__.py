@@ -13,6 +13,7 @@ from skypilot_tpu.analysis.checkers import blocking_jit
 from skypilot_tpu.analysis.checkers import env_contract
 from skypilot_tpu.analysis.checkers import naked_thread
 from skypilot_tpu.analysis.checkers import names
+from skypilot_tpu.analysis.checkers import raw_sqlite
 from skypilot_tpu.analysis.checkers import sleep_retry
 from skypilot_tpu.analysis.checkers import spawn_stamp
 from skypilot_tpu.analysis.checkers import state_write
@@ -21,6 +22,7 @@ from skypilot_tpu.analysis.checkers import state_write
 def build_all() -> List['core.Checker']:
     return [
         state_write.StateWriteChecker(),
+        raw_sqlite.RawSqliteChecker(),
         atomic_write.AtomicWriteChecker(),
         sleep_retry.SleepInRetryChecker(),
         spawn_stamp.SpawnStampChecker(),

@@ -1,0 +1,81 @@
+"""raw-sqlite-outside-state-engine: one door into sqlite.
+
+The contract (docs/state.md): control-plane state lives in the
+event-sourced engine (``skypilot_tpu/state/``), which is also the ONE
+place sqlite tuning (WAL, busy_timeout) is decided. A raw
+``sqlite3.connect`` or ``db_utils.SQLiteConn`` anywhere else is a
+fourth parallel store in the making — untuned (the historical
+"database is locked" class), unjournaled (its transitions invisible
+to watchers), and unfenced. Host-local non-control-plane DBs go
+through ``state.engine.open_db`` (runtime/job_lib.py is the model).
+
+Flagged: ``import sqlite3`` / ``from sqlite3 import`` and calls to
+``db_utils.SQLiteConn`` / ``db_utils.safe_cursor``. Allowlisted: the
+engine package itself, ``utils/db_utils.py`` (defines the
+primitives), ``benchmark/benchmark_state.py`` and
+``runtime/autostop_lib.py`` (host-local stores predating the engine,
+kept off the control plane deliberately).
+"""
+import ast
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+
+# The engine package: any file under a top-level ``state/`` dir.
+_ENGINE_DIR_MARKER = 'state/'
+_ALLOWED = (
+    'utils/db_utils.py',
+    'benchmark/benchmark_state.py',
+    'runtime/autostop_lib.py',
+)
+_RAW_CALLS = ('db_utils.SQLiteConn', 'db_utils.safe_cursor')
+
+
+def _exempt(rel: str) -> bool:
+    rel = rel.replace('\\', '/')
+    if any(rel.endswith(a) for a in _ALLOWED):
+        return True
+    # skypilot_tpu/state/… (scan rooted at the package dir yields
+    # 'state/engine.py'; repo-rooted scans yield the full prefix).
+    # jobs/state.py and serve/serve_state.py are files, not a
+    # ``state/`` directory, so they stay in scope.
+    return f'/{_ENGINE_DIR_MARKER}' in f'/{rel}'
+
+
+class RawSqliteChecker(core.Checker):
+    rule = 'raw-sqlite-outside-state-engine'
+    description = ('Raw sqlite3 / db_utils.SQLiteConn use outside '
+                   'the skypilot_tpu/state/ engine — control-plane '
+                   'state goes through the event-sourced store, '
+                   'host-local DBs through state.engine.open_db.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        if _exempt(ctx.rel):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split('.')[0] == 'sqlite3':
+                        yield self._finding(
+                            ctx, node, 'import sqlite3')
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and \
+                        node.module.split('.')[0] == 'sqlite3':
+                    yield self._finding(
+                        ctx, node, f'from {node.module} import ...')
+        for call in ctx.calls():
+            qual = ctx.call_name(call)
+            if qual and (qual.startswith('sqlite3.') or any(
+                    qual.endswith(r) for r in _RAW_CALLS)):
+                yield self._finding(ctx, call, f'{qual}(...)')
+
+    def _finding(self, ctx, node, what):
+        return core.Finding(
+            self.rule, ctx.rel, node.lineno, node.col_offset + 1,
+            f'{what} outside skypilot_tpu/state/ — control-plane '
+            'state must go through the event-sourced engine '
+            '(state.engine.get / record / status_write); a '
+            'host-local non-control-plane DB opens via '
+            'state.engine.open_db so WAL/busy_timeout tuning stays '
+            'in one place (docs/state.md)')

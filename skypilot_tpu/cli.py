@@ -603,7 +603,17 @@ def alerts_cmd(clusters, watch, interval, show_history, limit):
             return
         click.echo('\x1b[2J\x1b[H' + text)
         try:
-            time.sleep(interval)
+            # Journal tailer (docs/state.md): re-render IMMEDIATELY
+            # when any control-plane event lands (job failed, service
+            # down, upgrade advanced) instead of waiting out the full
+            # interval; the interval stays as the poll fallback and as
+            # the refresh cadence for purely metric-driven changes.
+            try:
+                from skypilot_tpu.state import engine as state_engine
+                eng = state_engine.get()
+                eng.wait_event(eng.last_seq(), timeout=interval)
+            except Exception:  # pylint: disable=broad-except
+                time.sleep(interval)
         except KeyboardInterrupt:
             return
 

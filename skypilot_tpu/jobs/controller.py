@@ -62,6 +62,10 @@ class JobsController:
         # starts as soon as the preemption is observable.
         self._wake = threading.Event()
         self._watchdog: Optional[watchdog_lib.HealthWatchdog] = None
+        # Journal tailer (docs/state.md): set while run() is active;
+        # wakes the poll loop on cross-process events for this job.
+        self._tail_stop = threading.Event()
+        self._tail_thread: Optional[threading.Thread] = None
         # Monotonic launch counter: every (re)launch of any task gets
         # a distinct SKYTPU_TASK_ID suffix, while the stripped prefix
         # (the checkpoint LINEAGE, data/checkpoint.py
@@ -247,6 +251,44 @@ class JobsController:
             self._watchdog.stop()
             self._watchdog = None
 
+    # -- journal tailer -------------------------------------------------
+
+    def _start_tailer(self) -> None:
+        """Tail this job's journal scope (docs/state.md) and wake the
+        poll loop on any event written by ANOTHER process — a cancel
+        request (`job.cancel_requested`) is acted on within watch
+        latency instead of up to a full poll gap. The gap'd poll in
+        `_poll_until_terminal` stays as the degraded fallback: a dead
+        tailer thread costs latency, never correctness. Own-pid
+        events are filtered — the controller writes this scope on
+        every transition and would otherwise wake itself in a hot
+        loop."""
+        from skypilot_tpu.state import engine as state_engine
+
+        def _tail():
+            try:
+                eng = state_engine.get()
+                for ev in eng.watch(
+                        scope=jobs_state.job_scope(self.job_id),
+                        stop=self._tail_stop):
+                    if ev['writer_pid'] != os.getpid():
+                        self._wake.set()
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    'journal tailer died; job %d degrades to poll '
+                    'cadence', self.job_id, exc_info=True)
+
+        self._tail_thread = threading.Thread(
+            target=_tail, name=f'jobs-{self.job_id}-tailer',
+            daemon=True)
+        self._tail_thread.start()
+
+    def _stop_tailer(self) -> None:
+        self._tail_stop.set()
+        if self._tail_thread is not None:
+            self._tail_thread.join(timeout=2.0)
+            self._tail_thread = None
+
     # -- main loop ------------------------------------------------------
 
     def run(self) -> jobs_state.ManagedJobStatus:
@@ -262,6 +304,11 @@ class JobsController:
                 jobs_state.set_trace_id(self.job_id,
                                         ctl_span.context.trace_id)
             try:
+                self._start_tailer()
+            except Exception:  # pylint: disable=broad-except
+                logger.warning('journal tailer unavailable; poll '
+                               'fallback only', exc_info=True)
+            try:
                 final = self._run_all_tasks()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception('controller crashed')
@@ -273,6 +320,8 @@ class JobsController:
                 ctl_span.attrs.setdefault('error', repr(e)[:200])
             else:
                 jobs_state.set_status(self.job_id, final)
+            finally:
+                self._stop_tailer()
             # The root span's status must tell the same story as the
             # job row (every other instrumented path marks ERROR on
             # failure).

@@ -202,9 +202,10 @@ def get(job_id: int) -> Optional[Dict[str, Any]]:
 
 def queue() -> List[Dict[str, Any]]:
     """All managed jobs, newest first (controller-side truth). With
-    no controller cluster, fall back to the LOCAL managed-jobs DB —
-    the view a controller host itself (or an in-process controller,
-    e.g. tests) has; same fallback the dashboard uses."""
+    no controller cluster, fall back to the LOCAL control-plane
+    engine (jobs_state reads through skypilot_tpu/state/) — the view
+    a controller host itself (or an in-process controller, e.g.
+    tests) has; same fallback the dashboard uses."""
     handle = _get_controller_handle(must_exist=False)
     if handle is None:
         return jobs_state.get_jobs()

@@ -57,6 +57,26 @@ def _write_status(cluster_name: str, **fields) -> None:
     os.replace(tmp, path)
 
 
+def _backoff_or_finished(cluster_name: str, delay: float) -> None:
+    """Backoff between attempts as a journal wait, not a blind sleep
+    (docs/state.md): if a CONCURRENT drainer (skylet tick, RPC
+    prelude) retires this cluster's pending_teardowns row while we
+    back off, the `teardown.finished` event ends the wait early and
+    the next loop iteration's already-gone check exits cleanly. Falls
+    back to the policy sleep if the engine is unusable — the backoff
+    bound is identical either way."""
+    try:
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.state import engine as state_engine
+        eng = state_engine.get()
+        eng.wait_event(
+            eng.last_seq(),
+            scope=jobs_state.teardown_scope(cluster_name),
+            timeout=delay, etypes=('teardown.finished',))
+    except Exception:  # pylint: disable=broad-except
+        _reap_policy().sleep(delay)
+
+
 def main() -> int:
     cluster_name = sys.argv[1]
     from skypilot_tpu import exceptions, state
@@ -91,7 +111,8 @@ def main() -> int:
         except (exceptions.SkyTpuError, OSError) as e:
             last_err = e
             jobs_state.note_teardown_attempt(cluster_name, repr(e))
-            _reap_policy().sleep(_reap_policy().delay_for(attempt))
+            _backoff_or_finished(cluster_name,
+                                 _reap_policy().delay_for(attempt))
     # Give up on THIS process, not on the teardown: the pending row
     # stays, and the next reconcile/skylet event spawns a new reaper.
     _write_status(cluster_name, state='retrying', error=repr(last_err))

@@ -1,7 +1,15 @@
-"""Managed-jobs state DB (analog of ``sky/jobs/state.py``).
+"""Managed-jobs state (analog of ``sky/jobs/state.py``), event-sourced
+on the unified control-plane engine (docs/state.md).
 
 Lives under the controller's state dir. Status machine mirrors the
-reference (``ManagedJobStatus``, ``sky/jobs/state.py:186``).
+reference (``ManagedJobStatus``, ``sky/jobs/state.py:186``). Every
+transition appends a journal event (scope ``job/<id>`` /
+``teardown/<cluster>``) in the same transaction as the materialized
+row, so the jobs controller tails its own job's scope instead of
+polling, and a reaper can observe another drainer finishing a
+teardown. Terminal-state fencing is enforced by
+``engine.status_write`` (fencing is an engine property, not UPDATE
+boilerplate here).
 """
 import enum
 import json
@@ -9,13 +17,15 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
-from skypilot_tpu.utils import db_utils
+from skypilot_tpu.state import engine as state_engine
 
 
-def _db_path() -> str:
-    base = os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
-    return os.path.join(base, 'managed_jobs.db')
+def _state_dir() -> str:
+    return state_engine.state_dir()
+
+
+def _eng() -> state_engine.StateEngine:
+    return state_engine.get()
 
 
 class ManagedJobStatus(enum.Enum):
@@ -51,86 +61,34 @@ _TERMINAL = {
 }
 
 
-def _create_tables(cursor, conn):
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS managed_jobs (
-        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
-        name TEXT,
-        status TEXT,
-        submitted_at REAL,
-        started_at REAL,
-        ended_at REAL,
-        task_cluster TEXT,
-        controller_cluster TEXT,
-        controller_job_id INTEGER,
-        recovery_count INTEGER DEFAULT 0,
-        dag_yaml_path TEXT,
-        failure_reason TEXT)""")
-    # Migration for pre-checkpoint rows: the latest COMMITTED native
-    # checkpoint step observed for this job — recovery resumes here,
-    # and the queue/dashboard show "resuming at step N" instead of a
-    # silent fresh start.
-    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
-                                 'resume_step', 'INTEGER')
-    # Migration for pre-tracing rows: the distributed-trace id of the
-    # job's submit→launch→recovery tree (docs/observability.md,
-    # Tracing) — `xsky trace --job ID` resolves through this.
-    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
-                                 'trace_id', 'TEXT')
-    # Migration for pre-elastic rows: the shape an elastic recovery
-    # (NEXT_BEST_SHAPE) resized the job onto, e.g. 'tpu-v5e-4' or
-    # '1xhost'. NULL = running at its designed shape. Surfaced with
-    # resume_step as `RESUME@step/new-mesh` in `xsky jobs queue` and
-    # the dashboard (docs/resilience.md, Elastic resume).
-    db_utils.add_column_to_table(cursor, conn, 'managed_jobs',
-                                 'resume_mesh', 'TEXT')
-    # Terminal-state fence columns (docs/lifecycle.md): a terminal
-    # status written by a reconciler that CONFIRMED the controller
-    # dead is stamped fenced; writes that bounce off it are counted.
-    from skypilot_tpu.lifecycle import fencing
-    fencing.add_fence_columns(cursor, conn, 'managed_jobs')
-    # Durable teardown queue: clusters that lost their owner (dead
-    # controller) and must be reclaimed. Rows survive process death —
-    # every reconcile AND the controller skylet event drain them until
-    # the cluster is verifiably gone (fixes the round-4 fire-and-forget
-    # reaper: one lost Popen used to mean a TPU slice billing forever).
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS pending_teardowns (
-        cluster_name TEXT PRIMARY KEY,
-        job_id INTEGER,
-        enqueued_at REAL,
-        attempts INTEGER DEFAULT 0,
-        last_attempt_at REAL DEFAULT 0,
-        last_error TEXT)""")
-    conn.commit()
+def job_scope(job_id: int) -> str:
+    """Journal scope for one managed job — what the jobs controller's
+    tailer watches."""
+    return f'job/{job_id}'
 
 
-_conns: Dict[str, db_utils.SQLiteConn] = {}
-
-
-def _db() -> db_utils.SQLiteConn:
-    path = _db_path()
-    conn = _conns.get(path)
-    if conn is None or conn.db_path != path:
-        conn = db_utils.SQLiteConn(path, _create_tables)
-        _conns[path] = conn
-    return conn
+def teardown_scope(cluster_name: str) -> str:
+    """Journal scope for one pending teardown — what a reaper watches
+    to notice another drainer finishing first."""
+    return f'teardown/{cluster_name}'
 
 
 def add_job(name: str, dag_yaml_path: str,
             controller_cluster: str) -> int:
-    db = _db()
-    try:
-        db.cursor.execute(
+    out: Dict[str, int] = {}
+
+    def _mutate(cur):
+        cur.execute(
             'INSERT INTO managed_jobs (name, status, submitted_at, '
             'dag_yaml_path, controller_cluster) VALUES (?,?,?,?,?)',
             (name, ManagedJobStatus.PENDING.value, time.time(),
              dag_yaml_path, controller_cluster))
-        job_id = db.cursor.lastrowid
-    finally:
-        db.conn.commit()
-    assert job_id is not None
-    return int(job_id)
+        assert cur.lastrowid is not None
+        out['id'] = cur.lastrowid
+
+    _eng().record(lambda: job_scope(out['id']), 'job.submitted',
+                  lambda: {'name': name}, mutate=_mutate)
+    return int(out['id'])
 
 
 def ensure_job(job_id: int, name: str, dag_yaml_path: str,
@@ -140,12 +98,15 @@ def ensure_job(job_id: int, name: str, dag_yaml_path: str,
     same contract as the reference). Called both by the client right
     after submission (for PENDING visibility) and by the controller
     process at startup (whichever wins, the other is a no-op)."""
-    _db().execute_and_commit(
-        'INSERT OR IGNORE INTO managed_jobs (job_id, name, status, '
-        'submitted_at, dag_yaml_path, controller_cluster) '
-        'VALUES (?,?,?,?,?,?)',
-        (job_id, name, ManagedJobStatus.PENDING.value, time.time(),
-         dag_yaml_path, controller_cluster))
+    _eng().record(
+        job_scope(job_id), 'job.submitted', {'name': name},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR IGNORE INTO managed_jobs (job_id, name, '
+            'status, submitted_at, dag_yaml_path, controller_cluster) '
+            'VALUES (?,?,?,?,?,?)',
+            (job_id, name, ManagedJobStatus.PENDING.value, time.time(),
+             dag_yaml_path, controller_cluster)).rowcount,
+        gate=True)
 
 
 def set_status(job_id: int, status: ManagedJobStatus,
@@ -157,104 +118,114 @@ def set_status(job_id: int, status: ManagedJobStatus,
     AFTER the controller's death was confirmed (the kill ladder ran):
     the row is stamped fenced, pinning the verdict against any
     straggler write. Ordinary terminal-is-final stays enforced IN the
-    UPDATE predicate (atomic — a read-then-write guard would race the
-    very late-writer it exists to block): a job already terminal
-    cannot be resurrected by an orphaned controller child.
+    UPDATE predicate (``engine.status_write`` — atomic; a
+    read-then-write guard would race the very late-writer it exists
+    to block): a job already terminal cannot be resurrected by an
+    orphaned controller child.
     """
-    from skypilot_tpu.lifecycle import fencing
-    db = _db()
     now = time.time()
-    stamp_sql, stamp_params = fencing.stamp_sets()
-    sets = ['status=?', stamp_sql]
-    params: List[Any] = [status.value] + stamp_params
+    extra_sets: List[str] = []
+    extra_params: List[Any] = []
     if status == ManagedJobStatus.RUNNING:
-        sets.append('started_at=COALESCE(started_at, ?)')
-        params.append(now)
+        extra_sets.append('started_at=COALESCE(started_at, ?)')
+        extra_params.append(now)
     if status.is_terminal():
-        sets.append('ended_at=?')
-        params.append(now)
-    if fence:
-        assert status.is_terminal(), (
-            f'fenced writes are terminal-only, got {status}')
-        sets.append('status_fenced=1')
+        extra_sets.append('ended_at=?')
+        extra_params.append(now)
     if failure_reason is not None:
-        sets.append('failure_reason=?')
-        params.append(failure_reason)
-    params.append(job_id)
+        extra_sets.append('failure_reason=?')
+        extra_params.append(failure_reason)
     terminal_values = tuple(s.value for s in _TERMINAL)
     placeholders = ','.join('?' for _ in terminal_values)
-    db.execute_and_commit(
-        f'UPDATE managed_jobs SET {", ".join(sets)} '
-        f'WHERE job_id=? AND status NOT IN ({placeholders})',
-        tuple(params) + terminal_values)
-    applied = db.cursor.rowcount > 0
-    if not applied:
-        row = db.cursor.execute(
-            'SELECT status_fenced FROM managed_jobs WHERE job_id=?',
-            (job_id,)).fetchone()
-        if row and row[0]:
-            fencing.note_refused('managed_jobs', str(job_id),
-                                 status.value)
-    return applied
+    payload = None
+    if failure_reason is not None:
+        payload = {'failure_reason': failure_reason[:500]}
+    # Terminal-is-final applies to fenced writes too: the FIRST
+    # terminal verdict wins, fenced or not.
+    return _eng().status_write(
+        table='managed_jobs', key_col='job_id', key=job_id,
+        scope=job_scope(job_id), etype='job.status',
+        status=status.value, terminal=terminal_values, fence=fence,
+        extra_sets=extra_sets, extra_set_params=extra_params,
+        extra_where=f'AND status NOT IN ({placeholders})',
+        extra_where_params=terminal_values, payload=payload)
 
 
 def set_task_cluster(job_id: int, cluster: str) -> None:
-    _db().execute_and_commit(
-        'UPDATE managed_jobs SET task_cluster=? WHERE job_id=?',
-        (cluster, job_id))
+    _eng().record(
+        job_scope(job_id), 'job.task_cluster', {'cluster': cluster},
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET task_cluster=? WHERE job_id=?',
+            (cluster, job_id)).rowcount,
+        gate=True)
 
 
 def set_controller_job(job_id: int, controller_job_id: int) -> None:
-    _db().execute_and_commit(
-        'UPDATE managed_jobs SET controller_job_id=? WHERE job_id=?',
-        (controller_job_id, job_id))
+    _eng().record(
+        job_scope(job_id), 'job.controller_job',
+        {'controller_job_id': controller_job_id},
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET controller_job_id=? '
+            'WHERE job_id=?', (controller_job_id, job_id)).rowcount,
+        gate=True)
 
 
 def set_resume_step(job_id: int, step: Optional[int]) -> None:
     """Record the latest committed checkpoint step for the job (the
     step a recovery will resume from; None = no checkpoint seen)."""
-    _db().execute_and_commit(
-        'UPDATE managed_jobs SET resume_step=? WHERE job_id=?',
-        (step, job_id))
+    _eng().record(
+        job_scope(job_id), 'job.resume_step', {'step': step},
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET resume_step=? WHERE job_id=?',
+            (step, job_id)).rowcount,
+        gate=True)
 
 
 def set_resume_mesh(job_id: int, mesh: Optional[str]) -> None:
     """Record the shape an elastic recovery resized the job onto
     (``NEXT_BEST_SHAPE``; None clears it — the designed shape came
     back). Shown as ``RESUME@step/new-mesh``."""
-    _db().execute_and_commit(
-        'UPDATE managed_jobs SET resume_mesh=? WHERE job_id=?',
-        (mesh, job_id))
+    _eng().record(
+        job_scope(job_id), 'job.resume_mesh', {'mesh': mesh},
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET resume_mesh=? WHERE job_id=?',
+            (mesh, job_id)).rowcount,
+        gate=True)
 
 
 def set_trace_id(job_id: int, trace_id: Optional[str]) -> None:
     """Record the job's distributed-trace id (set once by the
     controller at startup; COALESCE keeps the FIRST submit's id if a
     restarted controller re-registers)."""
-    _db().execute_and_commit(
-        'UPDATE managed_jobs SET trace_id=COALESCE(trace_id, ?) '
-        'WHERE job_id=?', (trace_id, job_id))
+    _eng().record(
+        job_scope(job_id), 'job.trace_id', {'trace_id': trace_id},
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET trace_id=COALESCE(trace_id, ?) '
+            'WHERE job_id=?', (trace_id, job_id)).rowcount,
+        gate=True)
 
 
 def bump_recovery(job_id: int) -> int:
-    db = _db()
-    db.execute_and_commit(
-        'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
-        'WHERE job_id=?', (job_id,))
-    row = db.cursor.execute(
+    _eng().record(
+        job_scope(job_id), 'job.recovery', None,
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+            'WHERE job_id=?', (job_id,)).rowcount,
+        gate=True)
+    row = _eng().query(
         'SELECT recovery_count FROM managed_jobs WHERE job_id=?',
-        (job_id,)).fetchone()
-    return int(row[0])
+        (job_id,))
+    return int(row[0][0])
 
 
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:
-    row = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
         'failure_reason, resume_step, trace_id, resume_mesh '
-        'FROM managed_jobs WHERE job_id=?', (job_id,)).fetchone()
-    return _to_record(row) if row else None
+        'FROM managed_jobs WHERE job_id=?', (job_id,))
+    return _to_record(rows[0]) if rows else None
 
 
 def _to_record(row) -> Dict[str, Any]:
@@ -282,12 +253,12 @@ def _to_record(row) -> Dict[str, Any]:
 
 
 def get_jobs() -> List[Dict[str, Any]]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT job_id, name, status, submitted_at, started_at, '
         'ended_at, task_cluster, controller_cluster, '
         'controller_job_id, recovery_count, dag_yaml_path, '
         'failure_reason, resume_step, trace_id, resume_mesh '
-        'FROM managed_jobs ORDER BY job_id DESC').fetchall()
+        'FROM managed_jobs ORDER BY job_id DESC')
     return [_to_record(r) for r in rows]
 
 
@@ -347,20 +318,25 @@ def reconcile_dead_controllers() -> List[int]:
 
 
 def enqueue_teardown(cluster_name: str, job_id: int) -> None:
-    """Persist 'this cluster must be reclaimed' in the jobs DB. The
-    row outlives any single reaper process and is only removed once
-    the cluster is verifiably gone (``drain_pending_teardowns``)."""
-    _db().execute_and_commit(
-        'INSERT OR IGNORE INTO pending_teardowns '
-        '(cluster_name, job_id, enqueued_at) VALUES (?,?,?)',
-        (cluster_name, job_id, time.time()))
+    """Persist 'this cluster must be reclaimed' in the control-plane
+    store. The row outlives any single reaper process and is only
+    removed once the cluster is verifiably gone
+    (``drain_pending_teardowns``)."""
+    _eng().record(
+        teardown_scope(cluster_name), 'teardown.enqueued',
+        {'job_id': job_id},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR IGNORE INTO pending_teardowns '
+            '(cluster_name, job_id, enqueued_at) VALUES (?,?,?)',
+            (cluster_name, job_id, time.time())).rowcount,
+        gate=True)
 
 
 def pending_teardowns() -> List[Dict[str, Any]]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT cluster_name, job_id, enqueued_at, attempts, '
         'last_attempt_at, last_error FROM pending_teardowns '
-        'ORDER BY enqueued_at').fetchall()
+        'ORDER BY enqueued_at')
     return [{
         'cluster_name': r[0],
         'job_id': r[1],
@@ -375,17 +351,27 @@ def note_teardown_attempt(cluster_name: str,
                           error: Optional[str]) -> None:
     # COALESCE: a reaper SPAWN (error=None) must not wipe the
     # previous failed attempt's diagnostic from the row.
-    _db().execute_and_commit(
-        'UPDATE pending_teardowns SET attempts=attempts+1, '
-        'last_attempt_at=?, last_error=COALESCE(?, last_error) '
-        'WHERE cluster_name=?',
-        (time.time(), error, cluster_name))
+    _eng().record(
+        teardown_scope(cluster_name), 'teardown.attempt',
+        {'error': (error or '')[:500] or None},
+        mutate=lambda cur: cur.execute(
+            'UPDATE pending_teardowns SET attempts=attempts+1, '
+            'last_attempt_at=?, last_error=COALESCE(?, last_error) '
+            'WHERE cluster_name=?',
+            (time.time(), error, cluster_name)).rowcount,
+        gate=True)
 
 
 def finish_teardown(cluster_name: str) -> None:
-    _db().execute_and_commit(
-        'DELETE FROM pending_teardowns WHERE cluster_name=?',
-        (cluster_name,))
+    # Gated on the DELETE applying: only the drainer that actually
+    # retired the row journals 'teardown.finished' — the event a
+    # concurrently-retrying reaper tails to exit early.
+    _eng().record(
+        teardown_scope(cluster_name), 'teardown.finished', None,
+        mutate=lambda cur: cur.execute(
+            'DELETE FROM pending_teardowns WHERE cluster_name=?',
+            (cluster_name,)).rowcount,
+        gate=True)
 
 
 def drain_pending_teardowns(block: bool = False,
@@ -415,7 +401,7 @@ def drain_pending_teardowns(block: bool = False,
     # straggling reaper): double-down on one cluster is safe but
     # wasteful, and the lock keeps attempt accounting sane.
     lock = filelock.FileLock(
-        os.path.join(os.path.dirname(_db_path()), '.teardown.lock'))
+        os.path.join(_state_dir(), '.teardown.lock'))
     try:
         lock.acquire(timeout=30.0 if block else 0.0)
     except filelock.Timeout:
@@ -496,6 +482,12 @@ def request_cancel(job_id: int) -> None:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    # Journal the request AFTER the signal file is visible: a
+    # controller tailer woken by this event must find the file (the
+    # CANCELLING status event above can race the file write; the
+    # poll fallback would still catch that, this one cannot miss).
+    _eng().record(job_scope(job_id), 'job.cancel_requested',
+                  {'at': time.time()})
 
 
 def cancel_requested(job_id: int) -> bool:
@@ -510,5 +502,5 @@ def clear_cancel(job_id: int) -> None:
 
 
 def _signal_path(job_id: int) -> str:
-    base = os.path.dirname(_db_path())
-    return os.path.join(base, 'signals', f'managed-job-{job_id}')
+    return os.path.join(_state_dir(), 'signals',
+                        f'managed-job-{job_id}')

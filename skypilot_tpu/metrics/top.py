@@ -490,6 +490,14 @@ def run(cluster_names: Optional[List[str]] = None,
         # ANSI clear + home — same trick every `top` uses.
         echo('\x1b[2J\x1b[H' + text)
         try:
-            time.sleep(interval)
+            # Journal tailer (docs/state.md): redraw as soon as any
+            # control-plane event lands; the interval remains both
+            # the metric-refresh cadence and the poll fallback.
+            try:
+                from skypilot_tpu.state import engine as state_engine
+                eng = state_engine.get()
+                eng.wait_event(eng.last_seq(), timeout=interval)
+            except Exception:  # pylint: disable=broad-except
+                time.sleep(interval)
         except KeyboardInterrupt:
             return

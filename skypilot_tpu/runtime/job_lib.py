@@ -91,7 +91,11 @@ def _db() -> db_utils.SQLiteConn:
     path = _db_path()
     conn = _conns.get(path)
     if conn is None or conn.db_path != path:
-        conn = db_utils.SQLiteConn(path, _create_tables)
+        # Host-local per-cluster store, NOT the control plane — but
+        # opened through the engine so WAL/busy_timeout tuning lives
+        # in exactly one place (state/engine.py apply_pragmas).
+        from skypilot_tpu.state import engine as state_engine
+        conn = state_engine.open_db(path, _create_tables)
         _conns[path] = conn
     return conn
 

@@ -121,6 +121,7 @@ class SkyServeController:
                 f'{scheme}://127.0.0.1:{self.load_balancer.port}')
         if watchdog_lib.enabled():
             self.watchdog.start()
+        self._start_tailer()
         # Initial provisioning is the first tick's generate_ops
         # (shortfall from zero replicas) — an eager scale_up here
         # would bypass the fallback autoscalers' spot/on-demand mix
@@ -130,6 +131,38 @@ class SkyServeController:
     def stop(self) -> None:
         self._stop.set()
         self._tick_now.set()
+
+    # -- journal tailer -------------------------------------------------
+
+    def _start_tailer(self) -> None:
+        """Tail this service's journal scope (docs/state.md) and pull
+        the next control tick forward when ANOTHER process writes an
+        event — `serve down`'s down_requested, `serve update`'s
+        target_version, and `serve upgrade --pause/--resume/--abort`
+        flags are acted on within watch latency instead of up to a
+        full sync interval. The interval'd `_tick_now.wait` in _loop
+        stays as the degraded fallback. Own-pid events are filtered:
+        this controller journals replica/status writes on every tick
+        and would otherwise wake itself in a hot loop."""
+        from skypilot_tpu.state import engine as state_engine
+
+        def _tail():
+            try:
+                eng = state_engine.get()
+                for ev in eng.watch(
+                        scope=serve_state.service_scope(
+                            self.service_name),
+                        stop=self._stop):
+                    if ev['writer_pid'] != os.getpid():
+                        self._tick_now.set()
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    'journal tailer died; service %s degrades to '
+                    'tick cadence', self.service_name, exc_info=True)
+
+        threading.Thread(
+            target=_tail, name=f'serve-{self.service_name}-tailer',
+            daemon=True).start()
 
     # -- watchdog -------------------------------------------------------
 

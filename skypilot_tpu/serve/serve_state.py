@@ -1,12 +1,21 @@
-"""Serve-side state DB (analog of ``sky/serve/serve_state.py``)."""
+"""Serve-side state (analog of ``sky/serve/serve_state.py``),
+event-sourced on the unified control-plane engine (docs/state.md).
+
+Every service/replica/version/upgrade transition appends a journal
+event on scope ``service/<name>`` in the same transaction as the
+materialized row, so the serve controller's tick tails its own
+service's scope (waking immediately on ``down_requested`` /
+``target_version`` / upgrade flags from other processes) instead of
+pure interval polling. Terminal-state fencing is enforced by
+``engine.status_write``.
+"""
 import enum
 import json
+import os
 import time
 from typing import Any, Dict, List, Optional
 
-import os
-
-from skypilot_tpu.utils import db_utils
+from skypilot_tpu.state import engine as state_engine
 
 
 class ReplicaStatus(enum.Enum):
@@ -66,118 +75,26 @@ class UpgradePhase(enum.Enum):
     SOAK = 'SOAK'
 
 
-def _db_path() -> str:
-    base = os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
-    return os.path.join(base, 'serve.db')
+def _eng() -> state_engine.StateEngine:
+    return state_engine.get()
 
 
-def _create_tables(cursor, conn):
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS services (
-        name TEXT PRIMARY KEY,
-        status TEXT,
-        created_at REAL,
-        spec_json TEXT,
-        endpoint TEXT,
-        controller_pid INTEGER)""")
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS replicas (
-        service_name TEXT,
-        replica_id INTEGER,
-        cluster_name TEXT,
-        status TEXT,
-        endpoint TEXT,
-        launched_at REAL,
-        version INTEGER DEFAULT 1,
-        PRIMARY KEY (service_name, replica_id))""")
-    # Rolling-update + controller-cluster columns (migrations for
-    # older DBs).
-    import sqlite3
-    for stmt in (
-            'ALTER TABLE services ADD COLUMN '
-            'target_version INTEGER DEFAULT 1',
-            'ALTER TABLE services ADD COLUMN target_task_yaml TEXT',
-            'ALTER TABLE replicas ADD COLUMN version INTEGER '
-            'DEFAULT 1',
-            'ALTER TABLE services ADD COLUMN lb_port INTEGER',
-            'ALTER TABLE services ADD COLUMN down_requested INTEGER '
-            'DEFAULT 0',
-            'ALTER TABLE services ADD COLUMN controller_cluster TEXT',
-            'ALTER TABLE services ADD COLUMN '
-            'controller_job_id INTEGER',
-            'ALTER TABLE replicas ADD COLUMN use_spot INTEGER '
-            'DEFAULT 0',
-            # Reconcile grace: when the controller job went terminal
-            # but the controller PROCESS is still alive (a graceful
-            # shutdown in flight), stamp the first observation here
-            # and only escalate after the grace elapses.
-            'ALTER TABLE services ADD COLUMN suspect_since REAL',
-            # /proc starttime of controller_pid: pid+start_time is
-            # the process IDENTITY the kill ladder verifies — a bare
-            # pid check would confirm (or kill) a recycled pid.
-            'ALTER TABLE services ADD COLUMN '
-            'controller_pid_start REAL'):
-        try:
-            cursor.execute(stmt)
-        except sqlite3.OperationalError:
-            pass  # column already exists
-    # Rolling-upgrade tier (docs/upgrades.md): the upgrade state
-    # machine is PERSISTED so a controller restart resumes a
-    # half-upgraded fleet instead of orphaning it, and every
-    # version's task yaml is kept so a rollback can relaunch the
-    # PRIOR version, not just the newest.
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS service_versions (
-        service_name TEXT,
-        version INTEGER,
-        task_yaml TEXT,
-        created_at REAL,
-        PRIMARY KEY (service_name, version))""")
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS upgrades (
-        service_name TEXT PRIMARY KEY,
-        from_version INTEGER,
-        to_version INTEGER,
-        state TEXT,
-        phase TEXT,
-        current_replica INTEGER,
-        replacement_replica INTEGER,
-        upgraded_json TEXT DEFAULT '[]',
-        phase_started_at REAL,
-        started_at REAL,
-        updated_at REAL,
-        pause_requested INTEGER DEFAULT 0,
-        abort_requested INTEGER DEFAULT 0,
-        paused_reason TEXT,
-        rollback_reason TEXT,
-        exemplar_trace_id TEXT,
-        replacement_use_spot INTEGER,
-        surge INTEGER DEFAULT 0)""")
-    from skypilot_tpu.lifecycle import fencing
-    fencing.add_fence_columns(cursor, conn, 'services')
-    conn.commit()
-
-
-_conns: Dict[str, db_utils.SQLiteConn] = {}
-
-
-def _db() -> db_utils.SQLiteConn:
-    path = _db_path()
-    conn = _conns.get(path)
-    if conn is None or conn.db_path != path:
-        conn = db_utils.SQLiteConn(path, _create_tables)
-        _conns[path] = conn
-    return conn
+def service_scope(name: str) -> str:
+    """Journal scope for one service — what the serve controller's
+    tailer watches (replica/version/upgrade events included)."""
+    return f'service/{name}'
 
 
 def add_service(name: str, spec_json: str,
                 lb_port: Optional[int] = None) -> None:
-    _db().execute_and_commit(
-        'INSERT OR REPLACE INTO services (name, status, created_at, '
-        'spec_json, lb_port, down_requested) VALUES (?,?,?,?,?,0)',
-        (name, ServiceStatus.CONTROLLER_INIT.value, time.time(),
-         spec_json, lb_port))
+    _eng().record(
+        service_scope(name), 'service.added', {'lb_port': lb_port},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR REPLACE INTO services (name, status, '
+            'created_at, spec_json, lb_port, down_requested) '
+            'VALUES (?,?,?,?,?,0)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, time.time(),
+             spec_json, lb_port)))
 
 
 def set_service_status(name: str, status: ServiceStatus,
@@ -190,20 +107,18 @@ def set_service_status(name: str, status: ServiceStatus,
     overwritten by ordinary writes — the zombie controller's late
     graceful DOWN must not resurrect (or sanitize) a death a
     reconciler already recorded. Both guards live in the UPDATE's
-    WHERE clause (atomic; a read-then-write check would race the
-    very late-writer it blocks):
+    WHERE clause via ``engine.status_write`` (atomic; a
+    read-then-write check would race the very late-writer it blocks):
 
     - FAILED is sticky except toward a *fenced* DOWN (the unfenced
       graceful DOWN is exactly the zombie write);
     - a fenced terminal row accepts no unfenced write at all.
     """
-    from skypilot_tpu.lifecycle import fencing
-    db = _db()
-    stamp_sql, stamp_params = fencing.stamp_sets()
+    terminal = (ServiceStatus.FAILED.value, ServiceStatus.DOWN.value)
+    extra_sets: List[str] = []
+    extra_where = ''
+    extra_where_params: List[Any] = []
     if fence:
-        assert status in (ServiceStatus.FAILED, ServiceStatus.DOWN), (
-            'fenced writes are for confirmed-death terminal states, '
-            f'got {status}')
         # A fenced FAILED never overwrites a completed DOWN: a
         # controller the ladder SIGTERMed may finish its graceful
         # shutdown (and write DOWN) inside the term_wait before the
@@ -212,65 +127,53 @@ def set_service_status(name: str, status: ServiceStatus,
         # an unfixable crash. A fenced DOWN may still overwrite
         # FAILED (`serve down` force-clean after its own
         # confirmation).
-        guard = ('' if status == ServiceStatus.DOWN
-                 else ' AND status != ?')
-        guard_params = ([] if status == ServiceStatus.DOWN
-                        else [ServiceStatus.DOWN.value])
-        db.execute_and_commit(
-            f'UPDATE services SET status=?, status_fenced=1, '
-            f'suspect_since=NULL, {stamp_sql} WHERE name=?{guard}',
-            tuple([status.value] + stamp_params + [name] +
-                  guard_params))
-        return db.cursor.rowcount > 0
-    terminal = (ServiceStatus.FAILED.value, ServiceStatus.DOWN.value)
-    if status == ServiceStatus.DOWN:
-        db.execute_and_commit(
-            f'UPDATE services SET status=?, {stamp_sql} '
-            f'WHERE name=? AND NOT (COALESCE(status_fenced,0)=1 '
-            f'AND status IN (?,?))',
-            tuple([status.value] + stamp_params + [name] +
-                  list(terminal)))
-    else:
-        db.execute_and_commit(
-            f'UPDATE services SET status=?, {stamp_sql} '
-            f'WHERE name=? AND status != ? AND NOT '
-            f'(COALESCE(status_fenced,0)=1 AND status IN (?,?))',
-            tuple([status.value] + stamp_params +
-                  [name, ServiceStatus.FAILED.value] +
-                  list(terminal)))
-    applied = db.cursor.rowcount > 0
-    if not applied:
-        row = db.cursor.execute(
-            'SELECT status_fenced FROM services WHERE name=?',
-            (name,)).fetchone()
-        if row and row[0]:
-            fencing.note_refused('services', name, status.value)
-    return applied
+        extra_sets.append('suspect_since=NULL')
+        if status != ServiceStatus.DOWN:
+            extra_where = 'AND status != ?'
+            extra_where_params = [ServiceStatus.DOWN.value]
+    elif status != ServiceStatus.DOWN:
+        # FAILED is sticky against any unfenced write.
+        extra_where = 'AND status != ?'
+        extra_where_params = [ServiceStatus.FAILED.value]
+    return _eng().status_write(
+        table='services', key_col='name', key=name,
+        scope=service_scope(name), etype='service.status',
+        status=status.value, terminal=terminal, fence=fence,
+        extra_sets=extra_sets, extra_where=extra_where,
+        extra_where_params=extra_where_params)
 
 
 def set_service_endpoint(name: str, endpoint: str) -> None:
-    _db().execute_and_commit(
-        'UPDATE services SET endpoint=? WHERE name=?',
-        (endpoint, name))
+    _eng().record(
+        service_scope(name), 'service.endpoint',
+        {'endpoint': endpoint},
+        mutate=lambda cur: cur.execute(
+            'UPDATE services SET endpoint=? WHERE name=?',
+            (endpoint, name)).rowcount,
+        gate=True)
 
 
 def set_service_controller_pid(name: str, pid: int) -> None:
     from skypilot_tpu.lifecycle import terminate
-    _db().execute_and_commit(
-        'UPDATE services SET controller_pid=?, '
-        'controller_pid_start=? WHERE name=?',
-        (pid, terminate.proc_start_time(pid), name))
+    _eng().record(
+        service_scope(name), 'service.controller_pid', {'pid': pid},
+        mutate=lambda cur: cur.execute(
+            'UPDATE services SET controller_pid=?, '
+            'controller_pid_start=? WHERE name=?',
+            (pid, terminate.proc_start_time(pid), name)).rowcount,
+        gate=True)
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
-    row = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT name, status, created_at, spec_json, endpoint, '
         'controller_pid, target_version, target_task_yaml, lb_port, '
         'down_requested, controller_cluster, controller_job_id, '
         'controller_pid_start '
-        'FROM services WHERE name=?', (name,)).fetchone()
-    if row is None:
+        'FROM services WHERE name=?', (name,))
+    if not rows:
         return None
+    row = rows[0]
     return {
         'name': row[0],
         'status': ServiceStatus(row[1]),
@@ -304,14 +207,15 @@ CONTROLLER_TERM_WAIT_SECONDS = float(
 
 
 def _get_suspect_since(name: str) -> Optional[float]:
-    row = _db().cursor.execute(
-        'SELECT suspect_since FROM services WHERE name=?',
-        (name,)).fetchone()
-    return row[0] if row else None
+    rows = _eng().query(
+        'SELECT suspect_since FROM services WHERE name=?', (name,))
+    return rows[0][0] if rows else None
 
 
 def _set_suspect_since(name: str, at: Optional[float]) -> None:
-    _db().execute_and_commit(
+    # Operational bookkeeping, not a state transition: suspect
+    # stamps flip on every reconcile pass and would spam the journal.
+    _eng().execute(
         'UPDATE services SET suspect_since=? WHERE name=?',
         (at, name))
 
@@ -386,19 +290,22 @@ def reconcile_dead_controllers() -> List[str]:
 
 
 def get_services() -> List[Dict[str, Any]]:
-    rows = _db().cursor.execute('SELECT name FROM services').fetchall()
+    rows = _eng().query('SELECT name FROM services')
     return [get_service(r[0]) for r in rows]
 
 
 def remove_service(name: str) -> None:
-    _db().execute_and_commit('DELETE FROM services WHERE name=?',
-                             (name,))
-    _db().execute_and_commit(
-        'DELETE FROM replicas WHERE service_name=?', (name,))
-    _db().execute_and_commit(
-        'DELETE FROM upgrades WHERE service_name=?', (name,))
-    _db().execute_and_commit(
-        'DELETE FROM service_versions WHERE service_name=?', (name,))
+    def _mutate(cur):
+        cur.execute('DELETE FROM services WHERE name=?', (name,))
+        cur.execute('DELETE FROM replicas WHERE service_name=?',
+                    (name,))
+        cur.execute('DELETE FROM upgrades WHERE service_name=?',
+                    (name,))
+        cur.execute('DELETE FROM service_versions WHERE '
+                    'service_name=?', (name,))
+
+    _eng().record(service_scope(name), 'service.removed', None,
+                  mutate=_mutate)
 
 
 def upsert_replica(service_name: str, replica_id: int,
@@ -406,31 +313,40 @@ def upsert_replica(service_name: str, replica_id: int,
                    endpoint: Optional[str] = None,
                    version: int = 1,
                    use_spot: bool = False) -> None:
-    _db().execute_and_commit(
-        'INSERT INTO replicas (service_name, replica_id, '
-        'cluster_name, status, endpoint, launched_at, version, '
-        'use_spot) VALUES (?,?,?,?,?,?,?,?) '
-        'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
-        'cluster_name=excluded.cluster_name, status=excluded.status, '
-        'endpoint=COALESCE(excluded.endpoint, replicas.endpoint), '
-        'version=excluded.version, use_spot=excluded.use_spot',
-        (service_name, replica_id, cluster_name, status.value,
-         endpoint, time.time(), version, int(use_spot)))
+    _eng().record(
+        service_scope(service_name), 'replica.upserted',
+        {'replica_id': replica_id, 'status': status.value,
+         'version': version},
+        mutate=lambda cur: cur.execute(
+            'INSERT INTO replicas (service_name, replica_id, '
+            'cluster_name, status, endpoint, launched_at, version, '
+            'use_spot) VALUES (?,?,?,?,?,?,?,?) '
+            'ON CONFLICT(service_name, replica_id) DO UPDATE SET '
+            'cluster_name=excluded.cluster_name, '
+            'status=excluded.status, '
+            'endpoint=COALESCE(excluded.endpoint, replicas.endpoint), '
+            'version=excluded.version, use_spot=excluded.use_spot',
+            (service_name, replica_id, cluster_name, status.value,
+             endpoint, time.time(), version, int(use_spot))))
 
 
 def set_replica_status(service_name: str, replica_id: int,
                        status: ReplicaStatus) -> None:
-    _db().execute_and_commit(
-        'UPDATE replicas SET status=? WHERE service_name=? AND '
-        'replica_id=?', (status.value, service_name, replica_id))
+    _eng().record(
+        service_scope(service_name), 'replica.status',
+        {'replica_id': replica_id, 'status': status.value},
+        mutate=lambda cur: cur.execute(
+            'UPDATE replicas SET status=? WHERE service_name=? AND '
+            'replica_id=?',
+            (status.value, service_name, replica_id)).rowcount,
+        gate=True)
 
 
 def get_replicas(service_name: str) -> List[Dict[str, Any]]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT replica_id, cluster_name, status, endpoint, '
         'launched_at, version, use_spot FROM replicas '
-        'WHERE service_name=? ORDER BY replica_id',
-        (service_name,)).fetchall()
+        'WHERE service_name=? ORDER BY replica_id', (service_name,))
     return [{
         'replica_id': r[0],
         'cluster_name': r[1],
@@ -449,41 +365,60 @@ def get_replica(service_name: str,
 
 
 def remove_replica(service_name: str, replica_id: int) -> None:
-    _db().execute_and_commit(
-        'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
-        (service_name, replica_id))
+    _eng().record(
+        service_scope(service_name), 'replica.removed',
+        {'replica_id': replica_id},
+        mutate=lambda cur: cur.execute(
+            'DELETE FROM replicas WHERE service_name=? AND '
+            'replica_id=?', (service_name, replica_id)).rowcount,
+        gate=True)
 
 
 def set_target_version(name: str, version: int,
                        task_yaml: str) -> None:
     """Request a rolling update: the controller picks this up on its
-    next tick (reference ``sky/serve/core.py:362`` update)."""
-    _db().execute_and_commit(
-        'UPDATE services SET target_version=?, target_task_yaml=? '
-        'WHERE name=?', (version, task_yaml, name))
+    next tick (reference ``sky/serve/core.py:362`` update) — or
+    immediately, via its journal tailer on this event."""
+    _eng().record(
+        service_scope(name), 'service.target_version',
+        {'version': version},
+        mutate=lambda cur: cur.execute(
+            'UPDATE services SET target_version=?, target_task_yaml=? '
+            'WHERE name=?', (version, task_yaml, name)).rowcount,
+        gate=True)
 
 
 def request_down(name: str) -> None:
     """Ask the (possibly remote) controller to tear the service down;
-    it acts on the flag on its next tick. Replaces client-side
-    process kills — the controller is a cluster job, not a child of
-    the client (reference: serve teardown is a controller-side
-    operation, ``sky/serve/serve_utils.py`` terminate_services)."""
-    _db().execute_and_commit(
-        'UPDATE services SET down_requested=1 WHERE name=?', (name,))
+    it acts on the flag on its next tick — woken early by this event's
+    journal tailer. Replaces client-side process kills — the
+    controller is a cluster job, not a child of the client (reference:
+    serve teardown is a controller-side operation,
+    ``sky/serve/serve_utils.py`` terminate_services)."""
+    _eng().record(
+        service_scope(name), 'service.down_requested', None,
+        mutate=lambda cur: cur.execute(
+            'UPDATE services SET down_requested=1 WHERE name=?',
+            (name,)).rowcount,
+        gate=True)
 
 
 def set_controller_job(name: str, controller_cluster: str,
                        controller_job_id: Optional[int]) -> None:
-    _db().execute_and_commit(
-        'UPDATE services SET controller_cluster=?, controller_job_id=? '
-        'WHERE name=?', (controller_cluster, controller_job_id, name))
+    _eng().record(
+        service_scope(name), 'service.controller_job',
+        {'controller_cluster': controller_cluster,
+         'controller_job_id': controller_job_id},
+        mutate=lambda cur: cur.execute(
+            'UPDATE services SET controller_cluster=?, '
+            'controller_job_id=? WHERE name=?',
+            (controller_cluster, controller_job_id, name)).rowcount,
+        gate=True)
 
 
 def used_lb_ports() -> List[int]:
-    rows = _db().cursor.execute(
-        'SELECT lb_port FROM services WHERE lb_port IS NOT NULL'
-    ).fetchall()
+    rows = _eng().query(
+        'SELECT lb_port FROM services WHERE lb_port IS NOT NULL')
     return [r[0] for r in rows]
 
 
@@ -494,18 +429,21 @@ def add_service_version(name: str, version: int,
                         task_yaml: str) -> None:
     """Record which task yaml a version ran — the rollback target.
     Idempotent (a restarted controller re-records its versions)."""
-    _db().execute_and_commit(
-        'INSERT OR REPLACE INTO service_versions '
-        '(service_name, version, task_yaml, created_at) '
-        'VALUES (?,?,?,?)', (name, version, task_yaml, time.time()))
+    _eng().record(
+        service_scope(name), 'version.added', {'version': version},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR REPLACE INTO service_versions '
+            '(service_name, version, task_yaml, created_at) '
+            'VALUES (?,?,?,?)',
+            (name, version, task_yaml, time.time())))
 
 
 def get_service_version_yaml(name: str,
                              version: int) -> Optional[str]:
-    row = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT task_yaml FROM service_versions WHERE '
-        'service_name=? AND version=?', (name, version)).fetchone()
-    return row[0] if row else None
+        'service_name=? AND version=?', (name, version))
+    return rows[0][0] if rows else None
 
 
 _UPGRADE_COLS = (
@@ -522,23 +460,27 @@ def start_upgrade(name: str, from_version: int,
     """Open a fresh upgrade row (replacing any terminal previous
     one); the controller's state machine advances it per tick."""
     now = time.time()
-    _db().execute_and_commit(
-        'INSERT OR REPLACE INTO upgrades (service_name, '
-        'from_version, to_version, state, phase, current_replica, '
-        'replacement_replica, upgraded_json, phase_started_at, '
-        'started_at, updated_at, pause_requested, abort_requested) '
-        "VALUES (?,?,?,?,NULL,NULL,NULL,'[]',NULL,?,?,0,0)",
-        (name, from_version, to_version,
-         UpgradeState.ROLLING.value, now, now))
+    _eng().record(
+        service_scope(name), 'upgrade.started',
+        {'from_version': from_version, 'to_version': to_version},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR REPLACE INTO upgrades (service_name, '
+            'from_version, to_version, state, phase, '
+            'current_replica, replacement_replica, upgraded_json, '
+            'phase_started_at, started_at, updated_at, '
+            'pause_requested, abort_requested) '
+            "VALUES (?,?,?,?,NULL,NULL,NULL,'[]',NULL,?,?,0,0)",
+            (name, from_version, to_version,
+             UpgradeState.ROLLING.value, now, now)))
 
 
 def get_upgrade(name: str) -> Optional[Dict[str, Any]]:
-    row = _db().cursor.execute(
+    rows = _eng().query(
         f'SELECT {", ".join(_UPGRADE_COLS)} FROM upgrades '
-        'WHERE service_name=?', (name,)).fetchone()
-    if row is None:
+        'WHERE service_name=?', (name,))
+    if not rows:
         return None
-    rec = dict(zip(_UPGRADE_COLS, row))
+    rec = dict(zip(_UPGRADE_COLS, rows[0]))
     rec['state'] = UpgradeState(rec['state'])
     rec['phase'] = (UpgradePhase(rec['phase'])
                     if rec['phase'] else None)
@@ -569,29 +511,38 @@ def update_upgrade(name: str, **fields: Any) -> None:
     cols = sorted(fields)
     assert all(c in _UPGRADE_COLS for c in cols), cols
     sets = ', '.join(f'{c}=?' for c in cols)
-    _db().execute_and_commit(
-        f'UPDATE upgrades SET {sets} WHERE service_name=?',
-        tuple(fields[c] for c in cols) + (name,))
+    payload = {k: fields[k] for k in ('state', 'phase')
+               if k in fields}
+    _eng().record(
+        service_scope(name), 'upgrade.updated', payload,
+        mutate=lambda cur: cur.execute(
+            f'UPDATE upgrades SET {sets} WHERE service_name=?',
+            tuple(fields[c] for c in cols) + (name,)).rowcount,
+        gate=True)
 
 
 def request_upgrade_pause(name: str) -> bool:
-    db = _db()
-    db.execute_and_commit(
-        'UPDATE upgrades SET pause_requested=1 WHERE service_name=? '
-        'AND state IN (?,?)',
-        (name, UpgradeState.ROLLING.value,
-         UpgradeState.PAUSED.value))
-    return db.cursor.rowcount > 0
+    seq = _eng().record(
+        service_scope(name), 'upgrade.pause_requested', None,
+        mutate=lambda cur: cur.execute(
+            'UPDATE upgrades SET pause_requested=1 WHERE '
+            'service_name=? AND state IN (?,?)',
+            (name, UpgradeState.ROLLING.value,
+             UpgradeState.PAUSED.value)).rowcount,
+        gate=True)
+    return seq is not None
 
 
 def request_upgrade_resume(name: str) -> bool:
-    db = _db()
-    db.execute_and_commit(
-        'UPDATE upgrades SET pause_requested=0 WHERE service_name=? '
-        'AND state IN (?,?)',
-        (name, UpgradeState.ROLLING.value,
-         UpgradeState.PAUSED.value))
-    return db.cursor.rowcount > 0
+    seq = _eng().record(
+        service_scope(name), 'upgrade.resume_requested', None,
+        mutate=lambda cur: cur.execute(
+            'UPDATE upgrades SET pause_requested=0 WHERE '
+            'service_name=? AND state IN (?,?)',
+            (name, UpgradeState.ROLLING.value,
+             UpgradeState.PAUSED.value)).rowcount,
+        gate=True)
+    return seq is not None
 
 
 def request_upgrade_abort(name: str) -> bool:
@@ -600,15 +551,21 @@ def request_upgrade_abort(name: str) -> bool:
     ROLLING_BACK upgrade is refused (already doing what abort asks —
     accepting the flag would be a confirmed no-op the machine never
     reads)."""
-    db = _db()
-    db.execute_and_commit(
-        'UPDATE upgrades SET abort_requested=1 WHERE service_name=? '
-        'AND state IN (?,?)',
-        (name, UpgradeState.ROLLING.value,
-         UpgradeState.PAUSED.value))
-    return db.cursor.rowcount > 0
+    seq = _eng().record(
+        service_scope(name), 'upgrade.abort_requested', None,
+        mutate=lambda cur: cur.execute(
+            'UPDATE upgrades SET abort_requested=1 WHERE '
+            'service_name=? AND state IN (?,?)',
+            (name, UpgradeState.ROLLING.value,
+             UpgradeState.PAUSED.value)).rowcount,
+        gate=True)
+    return seq is not None
 
 
 def clear_upgrade(name: str) -> None:
-    _db().execute_and_commit(
-        'DELETE FROM upgrades WHERE service_name=?', (name,))
+    _eng().record(
+        service_scope(name), 'upgrade.cleared', None,
+        mutate=lambda cur: cur.execute(
+            'DELETE FROM upgrades WHERE service_name=?',
+            (name,)).rowcount,
+        gate=True)

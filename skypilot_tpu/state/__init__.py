@@ -1,9 +1,12 @@
-"""Client-side global state (analog of ``sky/global_user_state.py``).
+"""Client-side global state (analog of ``sky/global_user_state.py``),
+event-sourced on the unified control-plane engine (docs/state.md).
 
-sqlite at ``~/.skypilot_tpu/state.db`` (override dir with
-``SKYTPU_STATE_DIR`` — tests point it at a tmpdir): clusters table
-(pickled handle, status, autostop, launch time, usage intervals for the
-cost report), storage table, enabled-clouds cache.
+The public API is unchanged from the pre-engine ``state.py``; rows
+now live in the shared ``control_plane.db`` (``SKYTPU_STATE_DIR`` —
+tests point it at a tmpdir) and every transition appends a journal
+event (scope ``cluster/<name>`` / ``storage/<name>``) in the same
+transaction, so ``xsky top`` and the alert watcher tail changes
+instead of re-scanning.
 """
 import json
 import os
@@ -12,17 +15,16 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import status_lib
+from skypilot_tpu.state import engine
 from skypilot_tpu.utils import common_utils
-from skypilot_tpu.utils import db_utils
 
 
 def _db_dir() -> str:
-    return os.path.expanduser(
-        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+    return engine.state_dir()
 
 
-def _db_path() -> str:
-    return os.path.join(_db_dir(), 'state.db')
+def _eng() -> engine.StateEngine:
+    return engine.get()
 
 
 def cluster_lock(cluster_name: str):
@@ -38,66 +40,6 @@ def cluster_lock(cluster_name: str):
         os.path.join(lock_dir, f'cluster.{cluster_name}.lock'))
 
 
-def _create_tables(cursor, conn):
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS clusters (
-        name TEXT PRIMARY KEY,
-        launched_at INTEGER,
-        handle BLOB,
-        last_use TEXT,
-        status TEXT,
-        autostop INTEGER DEFAULT -1,
-        to_down INTEGER DEFAULT 0,
-        owner TEXT DEFAULT null,
-        metadata TEXT DEFAULT '{}',
-        cluster_hash TEXT DEFAULT null,
-        usage_intervals BLOB DEFAULT null)""")
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS cluster_history (
-        cluster_hash TEXT PRIMARY KEY,
-        name TEXT,
-        num_nodes INTEGER,
-        requested_resources BLOB,
-        launched_resources BLOB,
-        usage_intervals BLOB)""")
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS storage (
-        name TEXT PRIMARY KEY,
-        launched_at INTEGER,
-        handle BLOB,
-        last_use TEXT,
-        status TEXT)""")
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS config (
-        key TEXT PRIMARY KEY, value TEXT)""")
-    # Provision-in-flight breadcrumbs: written BEFORE each provider
-    # create attempt, cleared once the cluster row exists (or the
-    # failed attempt's cleanup ran). A process killed mid-provision
-    # leaves provider resources with NO cluster row — the breadcrumb
-    # is the only pointer a reclaimer (jobs/state.reclaim_cluster)
-    # has for terminating them.
-    cursor.execute("""\
-        CREATE TABLE IF NOT EXISTS provision_breadcrumbs (
-        cluster_name TEXT PRIMARY KEY,
-        cluster_name_on_cloud TEXT,
-        provider TEXT,
-        region TEXT,
-        started_at REAL)""")
-    conn.commit()
-
-
-_conn_cache: Dict[str, db_utils.SQLiteConn] = {}
-
-
-def _db() -> db_utils.SQLiteConn:
-    path = _db_path()
-    conn = _conn_cache.get(path)
-    if conn is None or conn.db_path != path:
-        conn = db_utils.SQLiteConn(path, _create_tables)
-        _conn_cache[path] = conn
-    return conn
-
-
 # -- clusters ----------------------------------------------------------
 
 
@@ -108,7 +50,6 @@ def add_or_update_cluster(cluster_name: str,
                           is_launch: bool = True) -> None:
     """Record/refresh a cluster (reference
     ``sky/global_user_state.py:148``)."""
-    db = _db()
     status = status_lib.ClusterStatus.UP if ready \
         else status_lib.ClusterStatus.INIT
     now = int(time.time())
@@ -119,24 +60,30 @@ def add_or_update_cluster(cluster_name: str,
     if is_launch and (not usage_intervals or
                       usage_intervals[-1][1] is not None):
         usage_intervals.append((now, None))
-    db.execute_and_commit(
-        """INSERT INTO clusters
-           (name, launched_at, handle, last_use, status, autostop,
-            to_down, metadata, cluster_hash, usage_intervals)
-           VALUES (?,?,?,?,?,
-             COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),
-             COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),
-             COALESCE((SELECT metadata FROM clusters WHERE name=?),'{}'),
-             ?, ?)
-           ON CONFLICT(name) DO UPDATE SET
-             launched_at=excluded.launched_at, handle=excluded.handle,
-             last_use=excluded.last_use, status=excluded.status,
-             cluster_hash=excluded.cluster_hash,
-             usage_intervals=excluded.usage_intervals""",
-        (cluster_name, now, handle_blob,
-         common_utils.get_pretty_entrypoint(), status.value,
-         cluster_name, cluster_name, cluster_name, cluster_hash,
-         pickle.dumps(usage_intervals)))
+
+    def _mutate(cur):
+        cur.execute(
+            """INSERT INTO clusters
+               (name, launched_at, handle, last_use, status, autostop,
+                to_down, metadata, cluster_hash, usage_intervals)
+               VALUES (?,?,?,?,?,
+                 COALESCE((SELECT autostop FROM clusters WHERE name=?), -1),
+                 COALESCE((SELECT to_down FROM clusters WHERE name=?), 0),
+                 COALESCE((SELECT metadata FROM clusters WHERE name=?),'{}'),
+                 ?, ?)
+               ON CONFLICT(name) DO UPDATE SET
+                 launched_at=excluded.launched_at, handle=excluded.handle,
+                 last_use=excluded.last_use, status=excluded.status,
+                 cluster_hash=excluded.cluster_hash,
+                 usage_intervals=excluded.usage_intervals""",
+            (cluster_name, now, handle_blob,
+             common_utils.get_pretty_entrypoint(), status.value,
+             cluster_name, cluster_name, cluster_name, cluster_hash,
+             pickle.dumps(usage_intervals)))
+
+    _eng().record(f'cluster/{cluster_name}', 'cluster.upserted',
+                  {'status': status.value, 'is_launch': is_launch},
+                  mutate=_mutate)
     if is_launch:
         _record_cluster_history(cluster_name, cluster_hash,
                                 cluster_handle, requested_resources,
@@ -145,10 +92,9 @@ def add_or_update_cluster(cluster_name: str,
 
 def _record_cluster_history(name, cluster_hash, handle,
                             requested_resources, usage_intervals):
-    db = _db()
     num_nodes = getattr(handle, 'num_hosts', None)
     launched = getattr(handle, 'launched_resources', None)
-    db.execute_and_commit(
+    _eng().execute(
         """INSERT OR REPLACE INTO cluster_history
            (cluster_hash, name, num_nodes, requested_resources,
             launched_resources, usage_intervals) VALUES (?,?,?,?,?,?)""",
@@ -159,13 +105,18 @@ def _record_cluster_history(name, cluster_hash, handle,
 
 def update_cluster_status(cluster_name: str,
                           status: status_lib.ClusterStatus) -> None:
-    _db().execute_and_commit(
-        'UPDATE clusters SET status=? WHERE name=?',
-        (status.value, cluster_name))
+    _eng().record(
+        f'cluster/{cluster_name}', 'cluster.status',
+        {'status': status.value},
+        mutate=lambda cur: cur.execute(
+            'UPDATE clusters SET status=? WHERE name=?',
+            (status.value, cluster_name)).rowcount,
+        gate=True)
 
 
 def update_last_use(cluster_name: str) -> None:
-    _db().execute_and_commit(
+    # Bookkeeping, not a state transition — no journal event.
+    _eng().execute(
         'UPDATE clusters SET last_use=? WHERE name=?',
         (common_utils.get_pretty_entrypoint(), cluster_name))
 
@@ -173,7 +124,6 @@ def update_last_use(cluster_name: str) -> None:
 def remove_cluster(cluster_name: str, terminate: bool) -> None:
     """On stop: keep record with STOPPED; on terminate: close the usage
     interval, persist history, drop the row."""
-    db = _db()
     cluster_hash = _get_hash_for_existing_cluster(cluster_name)
     now = int(time.time())
     # Close the open usage interval on BOTH stop and terminate so the
@@ -186,12 +136,22 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
             intervals[-1] = (intervals[-1][0], now)
             _set_cluster_usage_intervals(cluster_hash, intervals)
     if terminate:
-        db.execute_and_commit('DELETE FROM clusters WHERE name=?',
-                              (cluster_name,))
+        _eng().record(
+            f'cluster/{cluster_name}', 'cluster.removed',
+            {'terminate': True},
+            mutate=lambda cur: cur.execute(
+                'DELETE FROM clusters WHERE name=?',
+                (cluster_name,)).rowcount,
+            gate=True)
     else:
-        db.execute_and_commit(
-            'UPDATE clusters SET status=? WHERE name=?',
-            (status_lib.ClusterStatus.STOPPED.value, cluster_name))
+        _eng().record(
+            f'cluster/{cluster_name}', 'cluster.status',
+            {'status': status_lib.ClusterStatus.STOPPED.value},
+            mutate=lambda cur: cur.execute(
+                'UPDATE clusters SET status=? WHERE name=?',
+                (status_lib.ClusterStatus.STOPPED.value,
+                 cluster_name)).rowcount,
+            gate=True)
 
 
 # -- provision breadcrumbs --------------------------------------------
@@ -200,22 +160,26 @@ def remove_cluster(cluster_name: str, terminate: bool) -> None:
 def set_provision_breadcrumb(cluster_name: str,
                              cluster_name_on_cloud: str,
                              provider: str, region: str) -> None:
-    _db().execute_and_commit(
-        'INSERT OR REPLACE INTO provision_breadcrumbs '
-        '(cluster_name, cluster_name_on_cloud, provider, region, '
-        'started_at) VALUES (?,?,?,?,?)',
-        (cluster_name, cluster_name_on_cloud, provider, region,
-         time.time()))
+    _eng().record(
+        f'cluster/{cluster_name}', 'cluster.breadcrumb_set',
+        {'provider': provider, 'region': region},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR REPLACE INTO provision_breadcrumbs '
+            '(cluster_name, cluster_name_on_cloud, provider, region, '
+            'started_at) VALUES (?,?,?,?,?)',
+            (cluster_name, cluster_name_on_cloud, provider, region,
+             time.time())))
 
 
 def get_provision_breadcrumb(
         cluster_name: str) -> Optional[Dict[str, Any]]:
-    row = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT cluster_name, cluster_name_on_cloud, provider, '
         'region, started_at FROM provision_breadcrumbs '
-        'WHERE cluster_name=?', (cluster_name,)).fetchone()
-    if row is None:
+        'WHERE cluster_name=?', (cluster_name,))
+    if not rows:
         return None
+    row = rows[0]
     return {
         'cluster_name': row[0],
         'cluster_name_on_cloud': row[1],
@@ -226,18 +190,20 @@ def get_provision_breadcrumb(
 
 
 def clear_provision_breadcrumb(cluster_name: str) -> None:
-    _db().execute_and_commit(
-        'DELETE FROM provision_breadcrumbs WHERE cluster_name=?',
-        (cluster_name,))
+    _eng().record(
+        f'cluster/{cluster_name}', 'cluster.breadcrumb_cleared', None,
+        mutate=lambda cur: cur.execute(
+            'DELETE FROM provision_breadcrumbs WHERE cluster_name=?',
+            (cluster_name,)).rowcount,
+        gate=True)
 
 
 def get_cluster_from_name(
         cluster_name: str) -> Optional[Dict[str, Any]]:
-    db = _db()
-    rows = db.cursor.execute(
+    rows = _eng().query(
         'SELECT name, launched_at, handle, last_use, status, autostop, '
         'to_down, metadata, cluster_hash, usage_intervals FROM clusters '
-        'WHERE name=?', (cluster_name,)).fetchall()
+        'WHERE name=?', (cluster_name,))
     for row in rows:
         return _cluster_record_from_row(row)
     return None
@@ -262,25 +228,28 @@ def _cluster_record_from_row(row) -> Dict[str, Any]:
 
 
 def get_clusters() -> List[Dict[str, Any]]:
-    db = _db()
-    rows = db.cursor.execute(
+    rows = _eng().query(
         'SELECT name, launched_at, handle, last_use, status, autostop, '
         'to_down, metadata, cluster_hash, usage_intervals FROM clusters '
-        'ORDER BY launched_at DESC').fetchall()
+        'ORDER BY launched_at DESC')
     return [_cluster_record_from_row(r) for r in rows]
 
 
 def set_cluster_autostop_value(cluster_name: str, idle_minutes: int,
                                to_down: bool) -> None:
-    _db().execute_and_commit(
-        'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
-        (idle_minutes, int(to_down), cluster_name))
+    _eng().record(
+        f'cluster/{cluster_name}', 'cluster.autostop',
+        {'idle_minutes': idle_minutes, 'to_down': to_down},
+        mutate=lambda cur: cur.execute(
+            'UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+            (idle_minutes, int(to_down), cluster_name)).rowcount,
+        gate=True)
 
 
 def get_cluster_names_start_with(starts_with: str) -> List[str]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT name FROM clusters WHERE name LIKE ?',
-        (f'{starts_with}%',)).fetchall()
+        (f'{starts_with}%',))
     return [r[0] for r in rows]
 
 
@@ -288,9 +257,9 @@ def get_cluster_names_start_with(starts_with: str) -> List[str]:
 
 
 def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT cluster_hash FROM clusters WHERE name=?',
-        (cluster_name,)).fetchall()
+        (cluster_name,))
     for (h,) in rows:
         return h
     return None
@@ -299,9 +268,9 @@ def _get_hash_for_existing_cluster(cluster_name: str) -> Optional[str]:
 def _get_cluster_usage_intervals(cluster_hash: Optional[str]):
     if cluster_hash is None:
         return None
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT usage_intervals FROM cluster_history WHERE '
-        'cluster_hash=?', (cluster_hash,)).fetchall()
+        'cluster_hash=?', (cluster_hash,))
     for (blob,) in rows:
         if blob is None:
             return None
@@ -310,10 +279,10 @@ def _get_cluster_usage_intervals(cluster_hash: Optional[str]):
 
 
 def _set_cluster_usage_intervals(cluster_hash: str, intervals) -> None:
-    _db().execute_and_commit(
+    _eng().execute(
         'UPDATE cluster_history SET usage_intervals=? WHERE '
         'cluster_hash=?', (pickle.dumps(intervals), cluster_hash))
-    _db().execute_and_commit(
+    _eng().execute(
         'UPDATE clusters SET usage_intervals=? WHERE cluster_hash=?',
         (pickle.dumps(intervals), cluster_hash))
 
@@ -331,11 +300,11 @@ def get_cluster_duration_seconds(cluster_hash: str) -> int:
 def get_clusters_from_history() -> List[Dict[str, Any]]:
     """For ``cost-report`` (reference
     ``sky/global_user_state.py:664``)."""
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT ch.cluster_hash, ch.name, ch.num_nodes, '
         'ch.launched_resources, ch.usage_intervals, c.status '
         'FROM cluster_history ch LEFT JOIN clusters c '
-        'ON ch.cluster_hash = c.cluster_hash').fetchall()
+        'ON ch.cluster_hash = c.cluster_hash')
     out = []
     for (cluster_hash, name, num_nodes, launched, intervals,
          status) in rows:
@@ -355,30 +324,38 @@ def get_clusters_from_history() -> List[Dict[str, Any]]:
 
 def add_or_update_storage(storage_name: str, storage_handle: Any,
                           storage_status: str) -> None:
-    _db().execute_and_commit(
-        'INSERT OR REPLACE INTO storage '
-        '(name, launched_at, handle, last_use, status) '
-        'VALUES (?,?,?,?,?)',
-        (storage_name, int(time.time()), pickle.dumps(storage_handle),
-         common_utils.get_pretty_entrypoint(), storage_status))
+    _eng().record(
+        f'storage/{storage_name}', 'storage.upserted',
+        {'status': storage_status},
+        mutate=lambda cur: cur.execute(
+            'INSERT OR REPLACE INTO storage '
+            '(name, launched_at, handle, last_use, status) '
+            'VALUES (?,?,?,?,?)',
+            (storage_name, int(time.time()),
+             pickle.dumps(storage_handle),
+             common_utils.get_pretty_entrypoint(), storage_status)))
 
 
 def remove_storage(storage_name: str) -> None:
-    _db().execute_and_commit('DELETE FROM storage WHERE name=?',
-                             (storage_name,))
+    _eng().record(
+        f'storage/{storage_name}', 'storage.removed', None,
+        mutate=lambda cur: cur.execute(
+            'DELETE FROM storage WHERE name=?',
+            (storage_name,)).rowcount,
+        gate=True)
 
 
 def get_storage_names_start_with(starts_with: str) -> List[str]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT name FROM storage WHERE name LIKE ?',
-        (f'{starts_with}%',)).fetchall()
+        (f'{starts_with}%',))
     return [r[0] for r in rows]
 
 
 def get_storage() -> List[Dict[str, Any]]:
-    rows = _db().cursor.execute(
+    rows = _eng().query(
         'SELECT name, launched_at, handle, last_use, status '
-        'FROM storage').fetchall()
+        'FROM storage')
     return [{
         'name': name,
         'launched_at': launched_at,
@@ -392,14 +369,14 @@ def get_storage() -> List[Dict[str, Any]]:
 
 
 def get_enabled_clouds() -> List[str]:
-    rows = _db().cursor.execute(
-        "SELECT value FROM config WHERE key='enabled_clouds'").fetchall()
+    rows = _eng().query(
+        "SELECT value FROM config WHERE key='enabled_clouds'")
     for (value,) in rows:
         return json.loads(value)
     return []
 
 
 def set_enabled_clouds(clouds: List[str]) -> None:
-    _db().execute_and_commit(
+    _eng().execute(
         'INSERT OR REPLACE INTO config (key, value) VALUES (?,?)',
         ('enabled_clouds', json.dumps(clouds)))

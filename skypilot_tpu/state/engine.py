@@ -1,0 +1,727 @@
+"""The event-sourced control-plane store (docs/state.md).
+
+One WAL-mode sqlite file (``control_plane.db`` under
+``SKYTPU_STATE_DIR``) replaces the three parallel ad-hoc DBs
+(``state.db``, ``managed_jobs.db``, ``serve.db``). Two layers live in
+the same file and are written in the SAME transaction:
+
+- ``events`` — the append-only journal, source of truth for every
+  state transition: monotonic ``seq``, wall + monotonic timestamps,
+  ``scope`` (``job/7``, ``service/x``, ``cluster/c``, ...), ``type``
+  (``job.status``, ``service.down_requested``, ...), JSON payload and
+  the writer's pid/epoch. Nothing updates or deletes journal rows
+  except retention (:meth:`StateEngine.compact`).
+- materialized current-state tables (``clusters``, ``managed_jobs``,
+  ``services``, ...) — maintained transactionally with each append so
+  reads stay one indexed SELECT; the legacy store modules keep their
+  exact public APIs on top of these tables.
+
+Watchers replace pollers: :meth:`StateEngine.watch` tails the journal
+by seq cursor (cross-process — any writer process is visible, with
+bounded latency from a short re-poll), and in-process appends notify
+the condition variable so same-process watchers wake immediately.
+Consumers keep their old poll as a degraded fallback — a dead tailer
+thread degrades to poll cadence, never to a hang.
+
+Terminal-state fencing is a property THIS module enforces
+(:meth:`StateEngine.status_write`), not per-store UPDATE boilerplate:
+every status write carries the ``fencing.stamp_sets()`` epoch/pid
+stamp, unfenced writes always carry the
+``NOT (status_fenced AND terminal)`` predicate IN the UPDATE's WHERE
+clause, and fenced writes are refused unless the new status is
+terminal. Refusals still feed ``fencing.note_refused``.
+
+Legacy DBs migrate in place on first open (rows copied by column
+intersection, so any historical schema vintage imports); the legacy
+files are left behind untouched for version-skewed readers.
+
+This module is also the ONE place sqlite tuning lives
+(:func:`apply_pragmas`): WAL + busy_timeout were previously set
+inconsistently (or not at all) by ``db_utils`` callers. Raw sqlite
+use outside ``skypilot_tpu/state/`` is forbidden by the
+``raw-sqlite-outside-state-engine`` skylint rule; host-local runtime
+DBs go through :func:`open_db`.
+"""
+import contextlib
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import (Any, Callable, Dict, Iterator, List, Optional,
+                    Sequence, Tuple, Union)
+
+from skypilot_tpu import tpu_logging
+from skypilot_tpu.utils import db_utils
+
+logger = tpu_logging.init_logger(__name__)
+
+DB_FILENAME = 'control_plane.db'
+
+# Journal retention: compaction keeps the newest N events. Watchers
+# are cursor-based; one that falls behind the retained window simply
+# re-reads materialized state and re-tails from the head (the journal
+# is a change FEED, not an archive — docs/state.md).
+_JOURNAL_RETAIN_DEFAULT = 20000
+# Compaction cadence: check every N appends per process (a full
+# DELETE scan per append would dominate write cost).
+_COMPACT_EVERY = 128
+# Bounded-latency re-poll for cross-process watchers: an append from
+# ANOTHER process is observed within this many seconds even though no
+# in-process condition fires.
+_WATCH_POLL_DEFAULT = 0.5
+
+_LEGACY_FILES = ('state.db', 'managed_jobs.db', 'serve.db')
+
+
+def state_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYTPU_STATE_DIR', '~/.skypilot_tpu'))
+
+
+def db_path() -> str:
+    return os.path.join(state_dir(), DB_FILENAME)
+
+
+def apply_pragmas(conn: sqlite3.Connection) -> None:
+    """The single place sqlite tuning is decided (WAL so readers never
+    block the writer; busy_timeout so a briefly-contended write waits
+    instead of raising ``database is locked``; NORMAL sync is durable
+    enough under WAL for a store whose source of truth survives
+    process crash, not host crash)."""
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute('PRAGMA busy_timeout=10000')
+    conn.execute('PRAGMA synchronous=NORMAL')
+
+
+def open_db(path: str, create_table: Callable) -> db_utils.SQLiteConn:
+    """Open a host-local sqlite DB OUTSIDE the control plane (the
+    runtime per-cluster job table) with the same tuned pragmas. This
+    is the sanctioned door for non-control-plane sqlite — the
+    ``raw-sqlite-outside-state-engine`` rule forbids opening raw
+    connections anywhere else."""
+
+    def _create(cursor, conn):
+        apply_pragmas(conn)
+        create_table(cursor, conn)
+
+    return db_utils.SQLiteConn(path, _create)
+
+
+# Every CREATE is IF NOT EXISTS and runs per connection; fence
+# columns (lifecycle/fencing.py) are part of the canonical schema,
+# not a migration.
+_SCHEMA = (
+    """CREATE TABLE IF NOT EXISTS events (
+        seq INTEGER PRIMARY KEY AUTOINCREMENT,
+        ts REAL NOT NULL,
+        mono REAL NOT NULL,
+        scope TEXT NOT NULL,
+        type TEXT NOT NULL,
+        payload TEXT NOT NULL DEFAULT '{}',
+        writer_pid INTEGER,
+        writer_epoch INTEGER)""",
+    'CREATE INDEX IF NOT EXISTS idx_events_scope ON events (scope, seq)',
+    """CREATE TABLE IF NOT EXISTS meta (
+        key TEXT PRIMARY KEY,
+        value TEXT)""",
+    # -- global user state (state/__init__.py) --
+    """CREATE TABLE IF NOT EXISTS clusters (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT,
+        autostop INTEGER DEFAULT -1,
+        to_down INTEGER DEFAULT 0,
+        owner TEXT DEFAULT null,
+        metadata TEXT DEFAULT '{}',
+        cluster_hash TEXT DEFAULT null,
+        usage_intervals BLOB DEFAULT null)""",
+    """CREATE TABLE IF NOT EXISTS cluster_history (
+        cluster_hash TEXT PRIMARY KEY,
+        name TEXT,
+        num_nodes INTEGER,
+        requested_resources BLOB,
+        launched_resources BLOB,
+        usage_intervals BLOB)""",
+    """CREATE TABLE IF NOT EXISTS storage (
+        name TEXT PRIMARY KEY,
+        launched_at INTEGER,
+        handle BLOB,
+        last_use TEXT,
+        status TEXT)""",
+    """CREATE TABLE IF NOT EXISTS config (
+        key TEXT PRIMARY KEY, value TEXT)""",
+    """CREATE TABLE IF NOT EXISTS provision_breadcrumbs (
+        cluster_name TEXT PRIMARY KEY,
+        cluster_name_on_cloud TEXT,
+        provider TEXT,
+        region TEXT,
+        started_at REAL)""",
+    # -- managed jobs (jobs/state.py) --
+    """CREATE TABLE IF NOT EXISTS managed_jobs (
+        job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+        name TEXT,
+        status TEXT,
+        submitted_at REAL,
+        started_at REAL,
+        ended_at REAL,
+        task_cluster TEXT,
+        controller_cluster TEXT,
+        controller_job_id INTEGER,
+        recovery_count INTEGER DEFAULT 0,
+        dag_yaml_path TEXT,
+        failure_reason TEXT,
+        resume_step INTEGER,
+        trace_id TEXT,
+        resume_mesh TEXT,
+        status_fenced INTEGER DEFAULT 0,
+        status_writer_pid INTEGER,
+        status_epoch INTEGER DEFAULT 0)""",
+    """CREATE TABLE IF NOT EXISTS pending_teardowns (
+        cluster_name TEXT PRIMARY KEY,
+        job_id INTEGER,
+        enqueued_at REAL,
+        attempts INTEGER DEFAULT 0,
+        last_attempt_at REAL DEFAULT 0,
+        last_error TEXT)""",
+    # -- serve (serve/serve_state.py) --
+    """CREATE TABLE IF NOT EXISTS services (
+        name TEXT PRIMARY KEY,
+        status TEXT,
+        created_at REAL,
+        spec_json TEXT,
+        endpoint TEXT,
+        controller_pid INTEGER,
+        target_version INTEGER DEFAULT 1,
+        target_task_yaml TEXT,
+        lb_port INTEGER,
+        down_requested INTEGER DEFAULT 0,
+        controller_cluster TEXT,
+        controller_job_id INTEGER,
+        suspect_since REAL,
+        controller_pid_start REAL,
+        status_fenced INTEGER DEFAULT 0,
+        status_writer_pid INTEGER,
+        status_epoch INTEGER DEFAULT 0)""",
+    """CREATE TABLE IF NOT EXISTS replicas (
+        service_name TEXT,
+        replica_id INTEGER,
+        cluster_name TEXT,
+        status TEXT,
+        endpoint TEXT,
+        launched_at REAL,
+        version INTEGER DEFAULT 1,
+        use_spot INTEGER DEFAULT 0,
+        PRIMARY KEY (service_name, replica_id))""",
+    """CREATE TABLE IF NOT EXISTS service_versions (
+        service_name TEXT,
+        version INTEGER,
+        task_yaml TEXT,
+        created_at REAL,
+        PRIMARY KEY (service_name, version))""",
+    """CREATE TABLE IF NOT EXISTS upgrades (
+        service_name TEXT PRIMARY KEY,
+        from_version INTEGER,
+        to_version INTEGER,
+        state TEXT,
+        phase TEXT,
+        current_replica INTEGER,
+        replacement_replica INTEGER,
+        upgraded_json TEXT DEFAULT '[]',
+        phase_started_at REAL,
+        started_at REAL,
+        updated_at REAL,
+        pause_requested INTEGER DEFAULT 0,
+        abort_requested INTEGER DEFAULT 0,
+        paused_reason TEXT,
+        rollback_reason TEXT,
+        exemplar_trace_id TEXT,
+        replacement_use_spot INTEGER,
+        surge INTEGER DEFAULT 0)""",
+)
+
+# Which unified tables each legacy file feeds (import is by column
+# intersection, so every historical schema vintage — pre-fencing,
+# pre-elastic, pre-upgrade — imports without per-vintage code).
+_LEGACY_TABLES = {
+    'state.db': ('clusters', 'cluster_history', 'storage', 'config',
+                 'provision_breadcrumbs'),
+    'managed_jobs.db': ('managed_jobs', 'pending_teardowns'),
+    'serve.db': ('services', 'replicas', 'service_versions',
+                 'upgrades'),
+}
+
+
+class StateEngine:
+    """One control-plane DB: journal + materialized tables + watch."""
+
+    def __init__(self, path: str):
+        self.path = os.path.expanduser(path)
+        self._local = threading.local()
+        self._cond = threading.Condition()
+        self._notified_seq = 0
+        self._subscribers: List[Callable[[Dict[str, Any]], None]] = []
+        self._append_count = 0
+        # Writer epoch: distinguishes this process-open from a
+        # recycled pid in the journal's writer identity.
+        self._epoch = int(time.time())
+        # Connect (and thereby create schema + import legacy rows)
+        # EAGERLY so a corrupt store fails typed at get(), not at an
+        # arbitrary later read.
+        self._conn()
+
+    # -- connections / transactions -----------------------------------
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, 'conn', None)
+        if conn is None:
+            dirname = os.path.dirname(self.path)
+            if dirname:
+                os.makedirs(dirname, exist_ok=True)
+            # isolation_level=None: autocommit, with explicit BEGIN
+            # IMMEDIATE in transaction() — python's implicit deferred
+            # transactions would deadlock-by-surprise under WAL.
+            conn = sqlite3.connect(self.path, timeout=30,
+                                   isolation_level=None)
+            apply_pragmas(conn)
+            for stmt in _SCHEMA:
+                conn.execute(stmt)
+            self._local.conn = conn
+            self._import_legacy(conn)
+        return conn
+
+    @contextlib.contextmanager
+    def transaction(self) -> Iterator[sqlite3.Cursor]:
+        """BEGIN IMMEDIATE → yield cursor → commit (rollback on
+        error). The journal append and its materialized mutation
+        always share one of these."""
+        conn = self._conn()
+        cur = conn.cursor()
+        cur.execute('BEGIN IMMEDIATE')
+        try:
+            yield cur
+        except BaseException:
+            conn.rollback()
+            raise
+        else:
+            conn.commit()
+        finally:
+            cur.close()
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> List[tuple]:
+        cur = self._conn().execute(sql, params)
+        try:
+            return cur.fetchall()
+        finally:
+            cur.close()
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        """Non-journaled write for operational bookkeeping that is
+        not a state transition (suspect timestamps, last_use, usage
+        intervals). State transitions go through record()/
+        status_write() so the journal stays the source of truth."""
+        with self.transaction() as cur:
+            cur.execute(sql, params)
+            return cur.rowcount
+
+    # -- the journal ---------------------------------------------------
+
+    def record(self,
+               scope: Union[str, Callable[[], str]],
+               etype: str,
+               payload: Union[None, Dict[str, Any],
+                              Callable[[], Dict[str, Any]]] = None,
+               mutate: Optional[Callable[[sqlite3.Cursor], Any]] = None,
+               gate: bool = False) -> Optional[int]:
+        """Apply a state transition: run ``mutate`` against the
+        materialized tables and append the journal event in ONE
+        transaction. With ``gate=True`` the event is appended only if
+        ``mutate`` returns truthy (e.g. an UPDATE's rowcount) — a
+        write that matched nothing is not a transition. ``scope`` /
+        ``payload`` may be callables, resolved after ``mutate`` (for
+        ids the mutation itself generates). Returns the event seq, or
+        None when gated out."""
+        applied = True
+        seq = None
+        event = None
+        with self.transaction() as cur:
+            if mutate is not None:
+                result = mutate(cur)
+                if gate:
+                    applied = bool(result)
+            if applied:
+                seq, event = self._append(cur, scope, etype, payload)
+        if applied:
+            self._after_append(event)
+        return seq
+
+    def _append(self, cur: sqlite3.Cursor,
+                scope: Union[str, Callable[[], str]], etype: str,
+                payload) -> Tuple[int, Dict[str, Any]]:
+        if callable(scope):
+            scope = scope()
+        if callable(payload):
+            payload = payload()
+        now, mono = time.time(), time.monotonic()
+        cur.execute(
+            'INSERT INTO events (ts, mono, scope, type, payload, '
+            'writer_pid, writer_epoch) VALUES (?,?,?,?,?,?,?)',
+            (now, mono, scope, etype, json.dumps(payload or {}),
+             os.getpid(), self._epoch))
+        seq = cur.lastrowid
+        assert seq is not None
+        return seq, {
+            'seq': seq, 'ts': now, 'mono': mono, 'scope': scope,
+            'type': etype, 'payload': payload or {},
+            'writer_pid': os.getpid(), 'writer_epoch': self._epoch,
+        }
+
+    def _after_append(self, event: Dict[str, Any]) -> None:
+        with self._cond:
+            self._notified_seq = max(self._notified_seq, event['seq'])
+            self._cond.notify_all()
+        for fn in list(self._subscribers):
+            try:
+                fn(event)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('state subscriber failed for %s',
+                                 event['type'])
+        try:
+            _events_counter(event['type']).inc()
+            _journal_seq_gauge().set(float(event['seq']))
+        except Exception:  # pylint: disable=broad-except
+            pass
+        self._append_count += 1
+        if self._append_count % _COMPACT_EVERY == 0:
+            try:
+                self.compact()
+            except sqlite3.Error:
+                logger.warning('journal compaction failed; retrying '
+                               'on a later append', exc_info=True)
+
+    def compact(self, retain: Optional[int] = None) -> int:
+        """Retention: drop journal rows older than the newest
+        ``retain`` (``SKYTPU_STATE_JOURNAL_RETAIN``). Bounded journal
+        growth is a stress-tier invariant
+        (tests/stress/test_control_plane.py)."""
+        if retain is None:
+            retain = int(os.environ.get('SKYTPU_STATE_JOURNAL_RETAIN',
+                                        str(_JOURNAL_RETAIN_DEFAULT)))
+        with self.transaction() as cur:
+            cur.execute(
+                'DELETE FROM events WHERE seq <= '
+                '(SELECT COALESCE(MAX(seq),0) FROM events) - ?',
+                (int(retain),))
+            return cur.rowcount
+
+    def last_seq(self) -> int:
+        return int(self.query(
+            'SELECT COALESCE(MAX(seq),0) FROM events')[0][0])
+
+    def events_after(self, after_seq: int, scope: Optional[str] = None,
+                     limit: int = 1000) -> List[Dict[str, Any]]:
+        sql = ('SELECT seq, ts, mono, scope, type, payload, '
+               'writer_pid, writer_epoch FROM events WHERE seq > ?')
+        params: List[Any] = [after_seq]
+        if scope is not None:
+            sql += ' AND scope = ?'
+            params.append(scope)
+        sql += ' ORDER BY seq LIMIT ?'
+        params.append(limit)
+        out = []
+        for (seq, ts, mono, sc, etype, payload, wpid,
+             wepoch) in self.query(sql, params):
+            try:
+                decoded = json.loads(payload or '{}')
+            except ValueError:
+                decoded = {}
+            out.append({
+                'seq': seq, 'ts': ts, 'mono': mono, 'scope': sc,
+                'type': etype, 'payload': decoded,
+                'writer_pid': wpid, 'writer_epoch': wepoch,
+            })
+        return out
+
+    # -- watch / subscribe ---------------------------------------------
+
+    def watch(self, scope: Optional[str] = None,
+              from_seq: Optional[int] = None,
+              poll_interval: Optional[float] = None,
+              stop: Optional[threading.Event] = None,
+              timeout: Optional[float] = None
+              ) -> Iterator[Dict[str, Any]]:
+        """Tail the journal: yield events with ``seq > from_seq``
+        (default: only events AFTER the call), matching ``scope``
+        exactly when given. In-process appends wake the generator
+        immediately; appends from other processes are observed within
+        ``poll_interval`` seconds (the bounded-latency re-poll).
+        Returns when ``stop`` is set or ``timeout`` elapses. Watchers
+        that fall behind journal retention miss compacted events —
+        re-read materialized state and re-tail from last_seq()."""
+        if poll_interval is None:
+            poll_interval = float(os.environ.get(
+                'SKYTPU_STATE_WATCH_POLL_SECONDS',
+                str(_WATCH_POLL_DEFAULT)))
+        cursor = self.last_seq() if from_seq is None else from_seq
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            if stop is not None and stop.is_set():
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            events = self.events_after(cursor, scope=scope)
+            if events:
+                for ev in events:
+                    cursor = ev['seq']
+                    try:
+                        _watch_lag_gauge().set(
+                            max(0.0, time.time() - ev['ts']))
+                    except Exception:  # pylint: disable=broad-except
+                        pass
+                    yield ev
+                continue
+            wait = poll_interval
+            if deadline is not None:
+                wait = min(wait, max(0.0, deadline - time.monotonic()))
+            with self._cond:
+                # An in-process append between events_after() and
+                # here would otherwise sleep a full poll_interval.
+                if self._notified_seq <= cursor:
+                    self._cond.wait(wait)
+
+    def wait_event(self, from_seq: int, scope: Optional[str] = None,
+                   timeout: float = 1.0,
+                   etypes: Optional[Sequence[str]] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """Block up to ``timeout`` for the next matching event (the
+        one-shot form of watch(), for poll loops that want 'sleep
+        interval OR wake on change')."""
+        for ev in self.watch(scope=scope, from_seq=from_seq,
+                             timeout=timeout):
+            if etypes is None or ev['type'] in etypes:
+                return ev
+        return None
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]
+                  ) -> Callable[[], None]:
+        """In-process callback on every append from THIS process
+        (cross-process visibility needs watch()). Returns an
+        unsubscribe callable. Callbacks run on the writer's thread
+        after commit — keep them tiny (set an Event)."""
+        self._subscribers.append(fn)
+
+        def _unsubscribe():
+            try:
+                self._subscribers.remove(fn)
+            except ValueError:
+                pass
+
+        return _unsubscribe
+
+    # -- fencing as an engine property ---------------------------------
+
+    def status_write(self, *, table: str, key_col: str, key: Any,
+                     scope: str, etype: str, status: str,
+                     terminal: Sequence[str], fence: bool = False,
+                     extra_sets: Sequence[str] = (),
+                     extra_set_params: Sequence[Any] = (),
+                     extra_where: str = '',
+                     extra_where_params: Sequence[Any] = (),
+                     payload: Optional[Dict[str, Any]] = None) -> bool:
+        """THE status-transition path (docs/lifecycle.md): stamps
+        epoch+writer pid on every applied write, enforces the
+        terminal-state fence IN the UPDATE's WHERE clause (atomic — a
+        read-then-write guard would race the late writer it exists to
+        block), appends the journal event only when the write
+        applied, and books refusals via ``fencing.note_refused``.
+
+        ``fence=True`` is reserved for reconcilers that CONFIRMED the
+        owner's death: the status must be terminal, the row is
+        stamped ``status_fenced=1``, and the core fence predicate is
+        dropped (a confirmed verdict may overwrite; callers pass any
+        store-specific guard via ``extra_where``). Unfenced writes
+        ALWAYS carry ``NOT (status_fenced AND status IN terminal)``.
+        Returns True iff the write applied."""
+        from skypilot_tpu.lifecycle import fencing
+        terminal = tuple(terminal)
+        stamp_sql, stamp_params = fencing.stamp_sets()
+        sets = ['status=?', stamp_sql] + list(extra_sets)
+        params: List[Any] = [status] + stamp_params + \
+            list(extra_set_params)
+        where = f'{key_col}=?'
+        wparams: List[Any] = [key]
+        placeholders = ','.join('?' for _ in terminal)
+        if fence:
+            assert status in terminal, (
+                f'fenced writes are terminal-only, got {status!r} '
+                f'(terminal: {terminal})')
+            sets.append('status_fenced=1')
+        else:
+            where += (' AND NOT (COALESCE(status_fenced,0)=1 AND '
+                      f'status IN ({placeholders}))')
+            wparams.extend(terminal)
+        if extra_where:
+            where += f' {extra_where}'
+            wparams.extend(extra_where_params)
+        applied = False
+        event = None
+        with self.transaction() as cur:
+            cur.execute(
+                f'UPDATE {table} SET {", ".join(sets)} WHERE {where}',
+                tuple(params) + tuple(wparams))
+            applied = cur.rowcount > 0
+            if applied:
+                body = dict(payload or {})
+                body['status'] = status
+                body['fenced'] = bool(fence)
+                _, event = self._append(cur, scope, etype, body)
+        if applied:
+            assert event is not None
+            self._after_append(event)
+        else:
+            row = self.query(
+                f'SELECT status_fenced FROM {table} WHERE {key_col}=?',
+                (key,))
+            if row and row[0][0]:
+                fencing.note_refused(table, str(key), status)
+        return applied
+
+    # -- legacy import -------------------------------------------------
+
+    def _import_legacy(self, conn: sqlite3.Connection) -> None:
+        """Migrate the three pre-engine DB files (same dir) in place
+        on first open: copy rows by column intersection into the
+        unified tables, mark the import in ``meta``, journal it. The
+        legacy files stay on disk untouched — a version-skewed
+        process may still be reading them (docs/migration.md).
+        Corrupt legacy stores raise ``sqlite3.DatabaseError`` (typed,
+        fast — no busy-wait applies to a malformed file)."""
+        base = os.path.dirname(self.path)
+        for fname in _LEGACY_FILES:
+            legacy_path = os.path.join(base, fname)
+            if not os.path.exists(legacy_path):
+                continue
+            marker = f'imported:{fname}'
+            cur = conn.cursor()
+            cur.execute('BEGIN IMMEDIATE')
+            try:
+                done = cur.execute(
+                    'SELECT value FROM meta WHERE key=?',
+                    (marker,)).fetchone()
+                if done is not None:
+                    conn.rollback()
+                    continue
+                src = sqlite3.connect(legacy_path, timeout=10)
+                try:
+                    copied = 0
+                    for table in _LEGACY_TABLES[fname]:
+                        copied += self._copy_table(cur, src, table)
+                finally:
+                    src.close()
+                cur.execute(
+                    'INSERT OR REPLACE INTO meta (key, value) '
+                    'VALUES (?,?)', (marker, str(time.time())))
+                _, event = self._append(
+                    cur, 'engine', 'engine.migrated',
+                    {'file': fname, 'rows': copied})
+            except BaseException:
+                conn.rollback()
+                raise
+            else:
+                conn.commit()
+            finally:
+                cur.close()
+            logger.info('migrated legacy %s into %s (%d rows)',
+                        fname, DB_FILENAME, copied)
+            self._after_append(event)
+            try:
+                _migrations_counter().inc()
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+    @staticmethod
+    def _copy_table(cur: sqlite3.Cursor, src: sqlite3.Connection,
+                    table: str) -> int:
+        """INSERT OR IGNORE every legacy row, intersecting columns:
+        ancient schemas (pre-fencing, pre-elastic) lack columns the
+        unified schema has — those take the schema defaults; columns
+        an old file has that we dropped are skipped."""
+        try:
+            src_cols = [r[1] for r in src.execute(
+                f'PRAGMA table_info({table})')]
+        except sqlite3.DatabaseError:
+            raise
+        if not src_cols:
+            return 0  # legacy file predates this table
+        dst_cols = [r[1] for r in cur.execute(
+            f'PRAGMA table_info({table})')]
+        cols = [c for c in src_cols if c in dst_cols]
+        if not cols:
+            return 0
+        col_list = ', '.join(cols)
+        placeholders = ','.join('?' for _ in cols)
+        rows = src.execute(
+            f'SELECT {col_list} FROM {table}').fetchall()
+        for row in rows:
+            cur.execute(
+                f'INSERT OR IGNORE INTO {table} ({col_list}) '
+                f'VALUES ({placeholders})', row)
+        return len(rows)
+
+
+# -- the per-path engine registry --------------------------------------
+
+_engines: Dict[str, StateEngine] = {}
+_engines_lock = threading.Lock()
+
+
+def get(path: Optional[str] = None) -> StateEngine:
+    """The engine for ``SKYTPU_STATE_DIR`` (re-resolved per call —
+    tests repoint the env var per test), or an explicit path."""
+    resolved = os.path.abspath(os.path.expanduser(path or db_path()))
+    with _engines_lock:
+        eng = _engines.get(resolved)
+    if eng is None:
+        eng = StateEngine(resolved)
+        with _engines_lock:
+            # Lost race: keep the first instance (it owns the
+            # condition variable in-process watchers wait on).
+            eng = _engines.setdefault(resolved, eng)
+    return eng
+
+
+# -- metrics (docs/observability.md, Control-plane store) ---------------
+
+
+def _events_counter(etype: str):
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().counter(
+        'skytpu_state_events_total',
+        'Journal events appended to the control-plane store, by '
+        'event type.', ('type',)).labels(type=etype)
+
+
+def _journal_seq_gauge():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().gauge(
+        'skytpu_state_journal_seq',
+        'Highest journal sequence number appended by this process.')
+
+
+def _watch_lag_gauge():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().gauge(
+        'skytpu_state_watch_lag_seconds',
+        'Append-to-observe latency of the most recent journal event '
+        'delivered to a watcher in this process.')
+
+
+def _migrations_counter():
+    from skypilot_tpu import metrics as metrics_lib
+    return metrics_lib.registry().counter(
+        'skytpu_state_migrations_total',
+        'Legacy control-plane DB files migrated into the unified '
+        'engine.')

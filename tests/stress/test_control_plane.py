@@ -1,0 +1,234 @@
+"""Control-plane engine under contention (docs/state.md; run with
+``pytest tests/stress --stress``).
+
+The unified store's whole pitch is that three DBs sharing one
+WAL-mode file with one tuning spot beats three ad-hoc sqlite files —
+so this tier drives it the way a busy controller box does: hundreds
+of managed jobs churned from many threads while services, replicas
+and a rolling upgrade step concurrently, with journal tailers
+reading the whole time. Invariants:
+
+- zero ``database is locked`` errors (the busy_timeout + BEGIN
+  IMMEDIATE discipline actually holds under contention);
+- materialized state consistent afterwards (every job reached its
+  terminal status exactly once; fenced verdicts stuck);
+- the journal stays BOUNDED (retention compaction keeps up with the
+  append rate — an unbounded journal is a disk leak with a delay);
+- no daemon growth (this tier spawns none; the matcher proves it).
+"""
+import sqlite3
+import threading
+import time
+
+import pytest
+
+pytestmark = [pytest.mark.stress, pytest.mark.slow]
+
+# test_churn.py is the harness of record for stress-tier process
+# accounting; its matcher is deliberately importable (pytest maps
+# this directory to the ``stress`` package).
+from stress.test_churn import _daemon_pids  # noqa: E402  pylint: disable=wrong-import-position
+
+_THREADS = 10
+_JOBS_PER_THREAD = 25  # 250 jobs total — past the 200-job floor
+_SERVICES = 5
+_JOURNAL_RETAIN = 500
+
+
+def _run_threads(workers):
+    """Start, join, and surface the FIRST exception from any worker
+    (a swallowed thread crash would pass the test vacuously)."""
+    errors = []
+
+    def _wrap(fn):
+        def _inner():
+            try:
+                fn()
+            except BaseException as exc:  # pylint: disable=broad-except
+                errors.append(exc)
+        return _inner
+
+    threads = [threading.Thread(target=_wrap(fn), daemon=True)
+               for fn in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    alive = [t for t in threads if t.is_alive()]
+    assert not alive, f'{len(alive)} worker thread(s) hung'
+    locked = [e for e in errors
+              if isinstance(e, sqlite3.OperationalError)
+              and 'locked' in str(e)]
+    assert not locked, (
+        f'{len(locked)} "database is locked" under contention: '
+        f'{locked[0]}')
+    if errors:
+        raise errors[0]
+
+
+class TestControlPlaneUnderContention:
+
+    def test_250_jobs_from_10_threads(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_STATE_JOURNAL_RETAIN',
+                           str(_JOURNAL_RETAIN))
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.state import engine
+
+        before_daemons = _daemon_pids()
+        eng = engine.get()
+        t0 = time.monotonic()
+
+        observed = []
+        tail_stop = threading.Event()
+
+        def _tailer():
+            # A live change-feed consumer riding along the churn —
+            # exactly what the jobs controller / `xsky top` do.
+            for ev in eng.watch(poll_interval=0.05, stop=tail_stop):
+                observed.append(ev['seq'])
+
+        tail_thread = threading.Thread(target=_tailer, daemon=True)
+        tail_thread.start()
+
+        fenced_ids = []
+        fenced_lock = threading.Lock()
+
+        def _job_churn(worker):
+            for j in range(_JOBS_PER_THREAD):
+                job_id = jobs_state.add_job(
+                    f'stress-{worker}-{j}', '/tmp/dag.yaml', 'ctrl')
+                jobs_state.set_task_cluster(job_id, f'c{worker}')
+                jobs_state.set_status(
+                    job_id, jobs_state.ManagedJobStatus.STARTING)
+                jobs_state.set_status(
+                    job_id, jobs_state.ManagedJobStatus.RUNNING)
+                jobs_state.set_resume_step(job_id, j)
+                if j % 5 == 0:
+                    jobs_state.bump_recovery(job_id)
+                if j % 7 == 0:
+                    # A reconciler's confirmed-death verdict...
+                    assert jobs_state.set_status(
+                        job_id,
+                        jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                        failure_reason='stress fence', fence=True)
+                    # ...that the zombie's write must bounce off,
+                    # even mid-contention.
+                    assert not jobs_state.set_status(
+                        job_id,
+                        jobs_state.ManagedJobStatus.SUCCEEDED)
+                    with fenced_lock:
+                        fenced_ids.append(job_id)
+                else:
+                    assert jobs_state.set_status(
+                        job_id,
+                        jobs_state.ManagedJobStatus.SUCCEEDED)
+
+        done = threading.Event()
+
+        def _reader_until_done():
+            # Concurrent full-table reads (dashboard/queue traffic).
+            while not done.is_set():
+                jobs_state.get_nonterminal_jobs()
+                time.sleep(0.01)
+
+        reader = threading.Thread(target=_reader_until_done,
+                                  daemon=True)
+        reader.start()
+        try:
+            _run_threads([
+                (lambda w=w: _job_churn(w)) for w in range(_THREADS)])
+        finally:
+            done.set()
+            reader.join(timeout=30)
+            tail_stop.set()
+            tail_thread.join(timeout=30)
+        assert not tail_thread.is_alive()
+
+        # Every job landed terminal; fenced verdicts stuck.
+        jobs = jobs_state.get_jobs()
+        assert len(jobs) == _THREADS * _JOBS_PER_THREAD
+        assert all(j['status'].is_terminal() for j in jobs)
+        for job_id in fenced_ids:
+            assert jobs_state.get_job(job_id)['status'] == \
+                jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+
+        # The tailer really tailed (monotonic seqs, saw the churn).
+        assert observed == sorted(observed)
+        assert len(observed) > _THREADS * _JOBS_PER_THREAD
+
+        # Bounded journal: ~1500+ appends happened, retention held.
+        count = eng.query('SELECT COUNT(*) FROM events')[0][0]
+        assert count <= _JOURNAL_RETAIN + engine._COMPACT_EVERY, (  # pylint: disable=protected-access
+            f'journal grew to {count} rows despite retain='
+            f'{_JOURNAL_RETAIN}')
+        assert eng.last_seq() > _THREADS * _JOBS_PER_THREAD
+
+        assert _daemon_pids() == before_daemons
+        assert time.monotonic() - t0 < 240
+
+    def test_services_with_concurrent_rolling_upgrade(self,
+                                                      monkeypatch):
+        monkeypatch.setenv('SKYTPU_STATE_JOURNAL_RETAIN',
+                           str(_JOURNAL_RETAIN))
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.state import engine
+
+        before_daemons = _daemon_pids()
+        eng = engine.get()
+        for i in range(_SERVICES):
+            serve_state.add_service(f'svc{i}', '{}', lb_port=30000 + i)
+
+        def _service_churn(i):
+            name = f'svc{i}'
+            serve_state.set_service_status(
+                name, serve_state.ServiceStatus.READY)
+            for rid in range(1, 11):
+                serve_state.upsert_replica(
+                    name, rid, f'{name}-r{rid}',
+                    serve_state.ReplicaStatus.PROVISIONING)
+                serve_state.set_replica_status(
+                    name, rid, serve_state.ReplicaStatus.READY)
+            for rid in range(6, 11):
+                serve_state.remove_replica(name, rid)
+
+        def _upgrade_churn():
+            # A rolling upgrade stepping against svc0 while every
+            # service (svc0 included) churns replicas: the PR-13
+            # state machine's writes must interleave cleanly.
+            name = 'svc0'
+            serve_state.add_service_version(name, 2, '/tmp/v2.yaml')
+            serve_state.start_upgrade(name, 1, 2)
+            for rid in range(1, 6):
+                serve_state.update_upgrade(
+                    name, phase=serve_state.UpgradePhase.DRAIN.value,
+                    current_replica=rid)
+                serve_state.update_upgrade(
+                    name,
+                    phase=serve_state.UpgradePhase.RELAUNCH.value,
+                    replacement_replica=100 + rid)
+            assert serve_state.request_upgrade_pause(name)
+            assert serve_state.request_upgrade_resume(name)
+            serve_state.update_upgrade(
+                name, state=serve_state.UpgradeState.SUCCEEDED.value)
+            serve_state.set_target_version(name, 2, '/tmp/v2.yaml')
+
+        _run_threads(
+            [(lambda i=i: _service_churn(i))
+             for i in range(_SERVICES)] + [_upgrade_churn])
+
+        # Consistent end state.
+        for i in range(_SERVICES):
+            svc = serve_state.get_service(f'svc{i}')
+            assert svc['status'] == serve_state.ServiceStatus.READY
+            replicas = serve_state.get_replicas(f'svc{i}')
+            assert len(replicas) == 5
+            assert all(
+                r['status'] == serve_state.ReplicaStatus.READY
+                for r in replicas)
+        upgrade = serve_state.get_upgrade('svc0')
+        assert upgrade['state'] == serve_state.UpgradeState.SUCCEEDED
+        assert serve_state.get_service('svc0')['target_version'] == 2
+
+        count = eng.query('SELECT COUNT(*) FROM events')[0][0]
+        assert count <= _JOURNAL_RETAIN + engine._COMPACT_EVERY  # pylint: disable=protected-access
+        assert _daemon_pids() == before_daemons

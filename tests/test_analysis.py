@@ -111,6 +111,16 @@ FIXTURES = {
                     ('DOWN', name))
         ''',
     }, None),
+    'raw-sqlite-outside-state-engine': ({
+        'rogue_store.py': '''
+            import sqlite3
+            from skypilot_tpu.utils import db_utils
+
+            def open_store(path):
+                conn = sqlite3.connect(path, timeout=5)
+                return db_utils.SQLiteConn(path, lambda c, k: None)
+        ''',
+    }, None),
     'non-atomic-write': ({
         'torn.py': '''
             import json, os

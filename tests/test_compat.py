@@ -4,12 +4,13 @@ docs/upgrades.md).
 Two surfaces, one contract — every cross-version call **completes,
 upgrades in place, or fails typed; never hangs**:
 
-- **on-disk state**: OLD-schema state DBs — written by earlier
-  releases, before the fencing / resume_step / trace_id /
-  resume_mesh columns, the provision_breadcrumbs table, or the serve
-  upgrades tables existed — must upgrade IN PLACE on first touch
-  (the idempotent migrations), or fail with a TYPED error on a
-  corrupt file;
+- **on-disk state**: OLD-schema state DBs — the pre-engine
+  ``state.db`` / ``managed_jobs.db`` / ``serve.db`` files, from any
+  historical vintage (pre-fencing, pre-elastic, pre-upgrade-tables)
+  — must import into the unified control-plane engine
+  (docs/state.md) on first touch with every row intact, fenced rows
+  still fenced, and the legacy file LEFT ON DISK untouched; a
+  corrupt file fails with a TYPED error;
 - **agent RPCs**: a pinned ``SKYTPU_AGENT_VERSION_OVERRIDE`` makes a
   REAL agent process behave as an old protocol version (old
   endpoints only — the emulation gates behavior, not just the
@@ -53,11 +54,17 @@ def _state_db_dir() -> str:
     return os.path.expanduser(os.environ['SKYTPU_STATE_DIR'])
 
 
+def _file_snapshot(path: str) -> bytes:
+    with open(path, 'rb') as f:
+        return f.read()
+
+
 class TestManagedJobsDbMigrations:
-    """managed_jobs.db carries every migration generation this repo
-    has shipped: fencing (PR 5), resume_step (checkpoint resume),
-    trace_id (PR 6), resume_mesh (elastic resume). A DB from before
-    ALL of them must upgrade in place with its rows intact."""
+    """managed_jobs.db carries every schema generation this repo has
+    shipped: pre-fencing, pre-resume_step/trace_id, pre-elastic. Any
+    vintage must import into the unified engine on first touch with
+    its rows intact — and the legacy file stays on disk untouched
+    (a version-skewed process may still be reading it)."""
 
     # The ORIGINAL schema, verbatim from the pre-fencing release: no
     # resume_step, no trace_id, no fence columns, no resume_mesh, no
@@ -91,33 +98,35 @@ class TestManagedJobsDbMigrations:
         conn.close()
         return path
 
-    def test_ancient_schema_upgrades_in_place(self):
+    def test_ancient_schema_imports_into_engine(self):
         t0 = time.monotonic()
         path = self._write_ancient_db()
-        before = _columns(path, 'managed_jobs')
-        assert 'resume_step' not in before
-        assert 'trace_id' not in before
-        assert 'resume_mesh' not in before
-        assert 'status_fenced' not in before
+        before = _file_snapshot(path)
 
-        # First touch through the current code runs the migrations.
+        # First touch through the current code imports the file.
         rec = jobs_state.get_job(1)
         assert rec is not None
         assert rec['name'] == 'legacy'
         assert rec['status'] == jobs_state.ManagedJobStatus.RUNNING
         assert rec['recovery_count'] == 3
-        # New columns exist, read as None/defaults for legacy rows.
+        # Columns the ancient vintage lacks read as None/defaults.
         assert rec['resume_step'] is None
         assert rec['trace_id'] is None
         assert rec['resume_mesh'] is None
-        after = _columns(path, 'managed_jobs')
-        assert {'resume_step', 'trace_id', 'resume_mesh',
-                'status_fenced', 'status_epoch',
-                'status_writer_pid'} <= after
+        # The legacy file is byte-identical — imported, not rewritten
+        # (docs/state.md migration story).
+        assert _file_snapshot(path) == before
+        assert 'status_fenced' not in _columns(path, 'managed_jobs')
+        # The import is journaled.
+        from skypilot_tpu.state import engine
+        migrated = [e for e in engine.get().events_after(0, scope='engine')
+                    if e['type'] == 'engine.migrated']
+        assert 'managed_jobs.db' in \
+            {e['payload']['file'] for e in migrated}
         assert time.monotonic() - t0 < _BUDGET_SECONDS
 
-    def test_upgraded_db_fully_writable(self):
-        """The migrated row must accept every current write path:
+    def test_imported_row_fully_writable(self):
+        """The imported row must accept every current write path:
         fenced terminal status, resume point, resize bookkeeping."""
         t0 = time.monotonic()
         self._write_ancient_db()
@@ -139,31 +148,54 @@ class TestManagedJobsDbMigrations:
             jobs_state.ManagedJobStatus.FAILED_CONTROLLER
         assert time.monotonic() - t0 < _BUDGET_SECONDS
 
-    def test_pre_elastic_schema_gains_resume_mesh(self):
-        """A DB from the release JUST before this one (has fencing /
-        resume_step / trace_id, lacks only resume_mesh)."""
+    def test_fenced_legacy_row_still_refuses_unfenced_writes(self):
+        """A row fenced terminal BEFORE the import (written by a
+        pre-engine reconciler that confirmed a death) keeps its
+        fence after: the verdict survives the storage migration."""
         path = os.path.join(_state_db_dir(), 'managed_jobs.db')
         os.makedirs(os.path.dirname(path), exist_ok=True)
         conn = sqlite3.connect(path)
         conn.execute(self._ANCIENT_SCHEMA)
         for col, decl in (('resume_step', 'INTEGER'),
                           ('trace_id', 'TEXT'),
-                          ('status_fenced', "INTEGER DEFAULT 0"),
+                          ('status_fenced', 'INTEGER DEFAULT 0'),
                           ('status_writer_pid', 'INTEGER'),
-                          ('status_epoch', "INTEGER DEFAULT 0")):
+                          ('status_epoch', 'INTEGER DEFAULT 0')):
             conn.execute(f'ALTER TABLE managed_jobs ADD COLUMN '
                          f'{col} {decl}')
         conn.execute(
-            'INSERT INTO managed_jobs (name, status, submitted_at, '
-            'dag_yaml_path, controller_cluster, resume_step) '
-            "VALUES ('prev', 'RUNNING', 1700000000.0, '/tmp/d.yaml',"
-            " 'ctrl', 7)")
+            'INSERT INTO managed_jobs (name, status, status_fenced, '
+            'status_epoch, failure_reason) '
+            "VALUES ('fenced', 'FAILED_CONTROLLER', 1, 5, 'zombie')")
         conn.commit()
         conn.close()
         rec = jobs_state.get_job(1)
-        assert rec['resume_step'] == 7 and rec['resume_mesh'] is None
-        jobs_state.set_resume_mesh(1, '1xhost')
-        assert jobs_state.get_job(1)['resume_mesh'] == '1xhost'
+        assert rec['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        # The zombie's late graceful write still bounces.
+        assert not jobs_state.set_status(
+            1, jobs_state.ManagedJobStatus.SUCCEEDED)
+        assert jobs_state.get_job(1)['status'] == \
+            jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+        # For managed jobs terminal-is-final is absolute: even a
+        # fenced writer cannot rewrite history (the jobs store's own
+        # guard, on top of the engine fence).
+        assert not jobs_state.set_status(
+            1, jobs_state.ManagedJobStatus.CANCELLED, fence=True)
+
+    def test_engine_rows_win_over_reimport(self):
+        """The import runs once (meta marker): later engine writes
+        are not clobbered by the legacy file on a fresh open."""
+        from skypilot_tpu.state import engine
+        self._write_ancient_db()
+        assert jobs_state.get_job(1) is not None  # triggers import
+        jobs_state.set_resume_step(1, 99)
+        # A second engine instance on the same file (what a new
+        # process is) must see the engine row, not re-import.
+        eng2 = engine.StateEngine(
+            os.path.join(_state_db_dir(), engine.DB_FILENAME))
+        assert eng2.query('SELECT resume_step FROM managed_jobs '
+                          'WHERE job_id=1')[0][0] == 99
 
     def test_corrupt_db_fails_typed_never_hangs(self):
         t0 = time.monotonic()
@@ -178,10 +210,11 @@ class TestManagedJobsDbMigrations:
 
 
 class TestGlobalStateDbMigrations:
-    """state.db (clusters): a pre-breadcrumbs DB gains the
-    provision_breadcrumbs table in place, rows intact."""
+    """state.db (clusters): a pre-breadcrumbs, pre-engine DB imports
+    into the unified engine, rows intact, file untouched."""
 
-    def test_pre_breadcrumbs_db_upgrades(self):
+    def test_pre_breadcrumbs_db_imports(self):
+        import pickle
         from skypilot_tpu import state as global_state
         t0 = time.monotonic()
         path = os.path.join(_state_db_dir(), 'state.db')
@@ -201,15 +234,21 @@ class TestGlobalStateDbMigrations:
             cluster_hash TEXT DEFAULT null,
             usage_intervals BLOB DEFAULT null)""")
         conn.execute(
-            "INSERT INTO clusters (name, launched_at, status) "
-            "VALUES ('legacy-c', 1700000000, 'UP')")
+            'INSERT INTO clusters (name, launched_at, handle, status) '
+            "VALUES ('legacy-c', 1700000000, ?, 'UP')",
+            (pickle.dumps('legacy-handle'),))
         conn.commit()
         conn.close()
-        # First touch creates the missing tables around the old one.
+        before = _file_snapshot(path)
+        # First touch: breadcrumbs API works (the table exists in the
+        # engine) and the legacy cluster row came along.
         assert global_state.get_provision_breadcrumb('nope') is None
-        cols = _columns(path, 'provision_breadcrumbs')
-        assert 'cluster_name_on_cloud' in cols
-        # Legacy cluster row survived the upgrade.
+        rec = global_state.get_cluster_from_name('legacy-c')
+        assert rec is not None
+        assert rec['handle'] == 'legacy-handle'
+        assert rec['status'].value == 'UP'
+        # Legacy file untouched; legacy row still readable there.
+        assert _file_snapshot(path) == before
         conn = sqlite3.connect(path)
         rows = list(conn.execute('SELECT name FROM clusters'))
         conn.close()
@@ -218,13 +257,12 @@ class TestGlobalStateDbMigrations:
 
 
 class TestServeStateDbMigrations:
-    """serve_state.db: a pre-fencing services table gains the fence
-    columns in place; a pre-rolling-upgrades DB gains the upgrades +
-    service_versions tables in place."""
+    """serve.db: a pre-fencing, pre-rolling-upgrades services table
+    imports into the unified engine; the full current API (fencing,
+    upgrade state machine) works against the imported rows."""
 
     def _write_legacy_db(self):
-        from skypilot_tpu.serve import serve_state
-        path = serve_state._db_path()  # pylint: disable=protected-access
+        path = os.path.join(_state_db_dir(), 'serve.db')
         os.makedirs(os.path.dirname(path), exist_ok=True)
         conn = sqlite3.connect(path)
         conn.execute("""\
@@ -242,32 +280,33 @@ class TestServeStateDbMigrations:
         conn.close()
         return path
 
-    def test_pre_fencing_services_upgrades(self):
+    def test_pre_fencing_services_imports(self):
         from skypilot_tpu.serve import serve_state
         path = self._write_legacy_db()
-        before = _columns(path, 'services')
-        assert 'status_fenced' not in before
+        before = _file_snapshot(path)
         svc = serve_state.get_service('legacy-svc')
         assert svc is not None and svc['name'] == 'legacy-svc'
-        after = _columns(path, 'services')
-        assert {'status_fenced', 'status_epoch',
-                'status_writer_pid'} <= after
+        assert svc['status'] == serve_state.ServiceStatus.READY
+        assert _file_snapshot(path) == before
+        assert 'status_fenced' not in _columns(path, 'services')
+        # Fencing works on the imported row (the engine's columns).
+        assert serve_state.set_service_status(
+            'legacy-svc', serve_state.ServiceStatus.FAILED,
+            fence=True)
+        assert not serve_state.set_service_status(
+            'legacy-svc', serve_state.ServiceStatus.DOWN)
+        assert serve_state.get_service('legacy-svc')['status'] == \
+            serve_state.ServiceStatus.FAILED
 
-    def test_pre_upgrades_db_gains_upgrade_tables(self):
-        """A serve DB from before the rolling-upgrade tier: first
-        touch creates the upgrades + service_versions tables and the
-        full upgrade-state API works against the migrated file, the
-        legacy service row intact."""
+    def test_pre_upgrades_db_gains_upgrade_api(self):
+        """A serve DB from before the rolling-upgrade tier: the full
+        upgrade-state API works against the imported service, the
+        legacy row intact."""
         from skypilot_tpu.serve import serve_state
         t0 = time.monotonic()
-        path = self._write_legacy_db()
-        # First touch migrates.
+        self._write_legacy_db()
+        # First touch imports.
         assert serve_state.get_upgrade('legacy-svc') is None
-        cols = _columns(path, 'upgrades')
-        assert {'service_name', 'from_version', 'to_version',
-                'state', 'phase', 'upgraded_json',
-                'exemplar_trace_id'} <= cols
-        assert 'task_yaml' in _columns(path, 'service_versions')
         serve_state.start_upgrade('legacy-svc', 1, 2)
         serve_state.add_service_version('legacy-svc', 2,
                                         '/tmp/v2.yaml')
@@ -277,6 +316,17 @@ class TestServeStateDbMigrations:
             'legacy-svc', 2) == '/tmp/v2.yaml'
         svc = serve_state.get_service('legacy-svc')
         assert svc['status'] == serve_state.ServiceStatus.READY
+        assert time.monotonic() - t0 < _BUDGET_SECONDS
+
+    def test_corrupt_serve_db_fails_typed(self):
+        from skypilot_tpu.serve import serve_state
+        t0 = time.monotonic()
+        path = os.path.join(_state_db_dir(), 'serve.db')
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, 'wb') as f:
+            f.write(b'not sqlite\n' * 64)
+        with pytest.raises(sqlite3.DatabaseError):
+            serve_state.get_service('legacy-svc')
         assert time.monotonic() - t0 < _BUDGET_SECONDS
 
 

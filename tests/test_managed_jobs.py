@@ -839,7 +839,7 @@ class TestControllerDeathReconciliation:
         assert len(spawned) == 1
         # After the interval elapses, a lost reaper is replaced.
         jobs_state.note_teardown_attempt('tpu-victim', None)
-        jobs_state._db().execute_and_commit(
+        jobs_state._eng().execute(  # pylint: disable=protected-access
             'UPDATE pending_teardowns SET last_attempt_at=? '
             'WHERE cluster_name=?', (time.time() - 60, 'tpu-victim'))
         jobs_state.drain_pending_teardowns(spawn_min_interval=30.0)

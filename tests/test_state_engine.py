@@ -1,0 +1,317 @@
+"""Unit tier for the event-sourced control-plane engine
+(skypilot_tpu/state/engine.py, docs/state.md): journal ordering and
+gating, watch/subscribe wakeup, engine-enforced fencing, retention,
+and the legacy-file import. Cross-store behavior (jobs/serve on the
+engine) lives in test_managed_jobs.py / test_serve.py; migration of
+the three ancient schemas in test_compat.py; concurrency in
+tests/stress/test_control_plane.py."""
+import os
+import sqlite3
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.state import engine
+
+
+def _eng():
+    # The autouse _isolated_state fixture points SKYTPU_STATE_DIR at
+    # a fresh tmp dir per test; get() re-resolves it per call.
+    return engine.get()
+
+
+# ---------------------------------------------------------------- journal
+
+
+def test_journal_appends_are_ordered_and_scoped():
+    eng = _eng()
+    base = eng.last_seq()
+    s1 = eng.record('job/1', 'job.submitted', {'name': 'a'})
+    s2 = eng.record('job/2', 'job.submitted', {'name': 'b'})
+    s3 = eng.record('job/1', 'job.status', {'status': 'RUNNING'})
+    assert base < s1 < s2 < s3
+
+    all_events = eng.events_after(base)
+    assert [e['seq'] for e in all_events] == [s1, s2, s3]
+    assert all(e['writer_pid'] == os.getpid() for e in all_events)
+
+    scoped = eng.events_after(base, scope='job/1')
+    assert [e['type'] for e in scoped] == ['job.submitted', 'job.status']
+    assert scoped[1]['payload'] == {'status': 'RUNNING'}
+
+
+def test_mutation_and_event_share_one_transaction():
+    eng = _eng()
+    base = eng.last_seq()
+
+    def _boom(cur):
+        cur.execute(
+            "INSERT INTO managed_jobs (name, status) VALUES ('x','y')")
+        raise RuntimeError('mid-transaction crash')
+
+    with pytest.raises(RuntimeError):
+        eng.record('job/1', 'job.submitted', mutate=_boom)
+    # Rollback took BOTH the row and any would-be event with it.
+    assert eng.last_seq() == base
+    assert eng.query('SELECT COUNT(*) FROM managed_jobs')[0][0] == 0
+
+
+def test_gated_record_appends_only_on_applied_mutation():
+    eng = _eng()
+    base = eng.last_seq()
+    seq = eng.record(
+        'job/99', 'job.status',
+        mutate=lambda cur: cur.execute(
+            'UPDATE managed_jobs SET status=? WHERE job_id=?',
+            ('RUNNING', 99)).rowcount,
+        gate=True)
+    assert seq is None  # matched nothing -> not a transition
+    assert eng.last_seq() == base
+    assert eng.events_after(base) == []
+
+
+def test_callable_scope_resolves_after_mutate():
+    eng = _eng()
+    ids = {}
+
+    def _insert(cur):
+        cur.execute(
+            "INSERT INTO managed_jobs (name, status) VALUES ('j','PENDING')")
+        ids['job_id'] = cur.lastrowid
+        return 1
+
+    eng.record(lambda: f"job/{ids['job_id']}", 'job.submitted',
+               lambda: {'job_id': ids['job_id']}, mutate=_insert,
+               gate=True)
+    ev = eng.events_after(0, scope=f"job/{ids['job_id']}")
+    assert len(ev) == 1
+    assert ev[0]['payload']['job_id'] == ids['job_id']
+
+
+def test_compaction_bounds_the_journal():
+    eng = _eng()
+    for i in range(50):
+        eng.record('cluster/c', 'cluster.status', {'i': i})
+    head = eng.last_seq()
+    dropped = eng.compact(retain=10)
+    assert dropped >= 40
+    rows = eng.query('SELECT MIN(seq), MAX(seq), COUNT(*) FROM events')
+    lo, hi, count = rows[0]
+    assert hi == head  # the head never moves
+    assert count <= 10
+    assert lo > head - 11
+    # A tailer whose cursor fell off retention just re-tails: no error.
+    assert eng.events_after(0)[0]['seq'] == lo
+
+
+def test_compaction_runs_automatically(monkeypatch):
+    monkeypatch.setenv('SKYTPU_STATE_JOURNAL_RETAIN', '16')
+    eng = _eng()
+    # Cross the every-128-appends checkpoint.
+    for i in range(2 * engine._COMPACT_EVERY + 1):  # pylint: disable=protected-access
+        eng.record('cluster/c', 'cluster.status', {'i': i})
+    assert eng.query('SELECT COUNT(*) FROM events')[0][0] <= \
+        16 + engine._COMPACT_EVERY  # pylint: disable=protected-access
+
+
+# ------------------------------------------------------- watch / subscribe
+
+
+def test_wait_event_sees_append_from_another_thread():
+    eng = _eng()
+    cursor = eng.last_seq()
+
+    def _writer():
+        time.sleep(0.05)
+        eng.record('job/7', 'job.cancel_requested', {})
+
+    thread = threading.Thread(target=_writer, daemon=True)
+    start = time.monotonic()
+    thread.start()
+    ev = eng.wait_event(cursor, scope='job/7', timeout=5.0)
+    elapsed = time.monotonic() - start
+    thread.join()
+    assert ev is not None and ev['type'] == 'job.cancel_requested'
+    # In-process appends wake the condition variable immediately —
+    # no full poll_interval sleep.
+    assert elapsed < 2.0
+
+
+def test_wait_event_timeout_and_etype_filter():
+    eng = _eng()
+    cursor = eng.last_seq()
+    assert eng.wait_event(cursor, timeout=0.05) is None
+    eng.record('teardown/c', 'teardown.attempt', {})
+    eng.record('teardown/c', 'teardown.finished', {})
+    ev = eng.wait_event(cursor, scope='teardown/c', timeout=1.0,
+                        etypes=('teardown.finished',))
+    assert ev is not None and ev['type'] == 'teardown.finished'
+
+
+def test_watch_stop_event_terminates_generator():
+    eng = _eng()
+    stop = threading.Event()
+    got = []
+
+    def _tail():
+        for ev in eng.watch(scope='svc-scope', poll_interval=0.05,
+                            stop=stop):
+            got.append(ev['type'])
+
+    thread = threading.Thread(target=_tail, daemon=True)
+    thread.start()
+    time.sleep(0.1)
+    eng.record('svc-scope', 'service.status', {'status': 'READY'})
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    assert got == ['service.status']
+
+
+def test_subscribe_and_unsubscribe():
+    eng = _eng()
+    seen = []
+    unsub = eng.subscribe(lambda ev: seen.append(ev['type']))
+    eng.record('cluster/c', 'cluster.upserted', {})
+    assert seen == ['cluster.upserted']
+    unsub()
+    eng.record('cluster/c', 'cluster.removed', {})
+    assert seen == ['cluster.upserted']
+
+
+def test_cross_process_watch_via_second_engine_instance(tmp_path):
+    """Two engine instances on the same file (what two processes
+    are): the watcher sees the other writer's append within the
+    bounded re-poll, and writer identity distinguishes them."""
+    path = str(tmp_path / 'shared.db')
+    writer = engine.StateEngine(path)
+    watcher = engine.StateEngine(path)
+    cursor = watcher.last_seq()
+    result = {}
+
+    def _wait():
+        result['ev'] = watcher.wait_event(cursor, scope='job/1',
+                                          timeout=5.0)
+
+    thread = threading.Thread(target=_wait, daemon=True)
+    thread.start()
+    time.sleep(0.05)
+    writer.record('job/1', 'job.status', {'status': 'RUNNING'})
+    thread.join(timeout=10.0)
+    ev = result.get('ev')
+    assert ev is not None and ev['payload']['status'] == 'RUNNING'
+    assert ev['writer_pid'] == os.getpid()  # same pid here, but set
+
+
+# ------------------------------------------------------------- fencing
+
+
+_TERMINAL = ('SUCCEEDED', 'FAILED', 'CANCELLED')
+
+
+def _seed_job(eng, status='RUNNING'):
+    with eng.transaction() as cur:
+        cur.execute(
+            'INSERT INTO managed_jobs (name, status) VALUES (?,?)',
+            ('fence-me', status))
+        return cur.lastrowid
+
+
+def _write(eng, job_id, status, fence=False):
+    return eng.status_write(
+        table='managed_jobs', key_col='job_id', key=job_id,
+        scope=f'job/{job_id}', etype='job.status', status=status,
+        terminal=_TERMINAL, fence=fence)
+
+
+def test_fenced_terminal_refuses_unfenced_overwrite():
+    eng = _eng()
+    job_id = _seed_job(eng)
+    assert _write(eng, job_id, 'FAILED', fence=True)
+    base = eng.last_seq()
+    # The zombie's late graceful write bounces AND journals nothing.
+    assert not _write(eng, job_id, 'SUCCEEDED')
+    assert eng.query('SELECT status, status_fenced FROM managed_jobs '
+                     'WHERE job_id=?', (job_id,))[0] == ('FAILED', 1)
+    assert eng.events_after(base) == []
+    # Another confirmed-death writer may still overwrite.
+    assert _write(eng, job_id, 'CANCELLED', fence=True)
+
+
+def test_unfenced_writes_flow_and_stamp():
+    eng = _eng()
+    job_id = _seed_job(eng)
+    assert _write(eng, job_id, 'SUCCEEDED')  # unfenced terminal: fine
+    row = eng.query(
+        'SELECT status, status_fenced, status_writer_pid, status_epoch '
+        'FROM managed_jobs WHERE job_id=?', (job_id,))[0]
+    assert row[0] == 'SUCCEEDED'
+    assert row[1] == 0
+    assert row[2] == os.getpid()
+    assert row[3] >= 1
+    ev = eng.events_after(0, scope=f'job/{job_id}')[-1]
+    assert ev['payload'] == {'status': 'SUCCEEDED', 'fenced': False}
+
+
+def test_fence_requires_terminal_status():
+    eng = _eng()
+    job_id = _seed_job(eng)
+    with pytest.raises(AssertionError):
+        _write(eng, job_id, 'RUNNING', fence=True)
+
+
+# -------------------------------------------------------- legacy import
+
+
+def test_legacy_file_imports_once_and_stays_on_disk(tmp_path):
+    legacy = str(tmp_path / 'managed_jobs.db')
+    src = sqlite3.connect(legacy)
+    # An ancient vintage: no fence/elastic columns at all.
+    src.execute('CREATE TABLE managed_jobs ('
+                'job_id INTEGER PRIMARY KEY, name TEXT, status TEXT)')
+    src.execute("INSERT INTO managed_jobs VALUES (7, 'old', 'RUNNING')")
+    src.commit()
+    src.close()
+
+    eng = engine.StateEngine(str(tmp_path / engine.DB_FILENAME))
+    row = eng.query('SELECT name, status, status_fenced FROM '
+                    'managed_jobs WHERE job_id=7')[0]
+    assert row == ('old', 'RUNNING', 0)  # missing cols take defaults
+    migrated = [e for e in eng.events_after(0, scope='engine')
+                if e['type'] == 'engine.migrated']
+    assert [e['payload']['file'] for e in migrated] == ['managed_jobs.db']
+    assert os.path.exists(legacy)  # left in place, untouched
+
+    # A second open on the same file must not re-import (the meta
+    # marker): mutate the engine row, reopen, row wins over legacy.
+    eng.execute('UPDATE managed_jobs SET status=? WHERE job_id=7',
+                ('SUCCEEDED',))
+    eng2 = engine.StateEngine(str(tmp_path / engine.DB_FILENAME))
+    assert eng2.query('SELECT status FROM managed_jobs '
+                      'WHERE job_id=7')[0][0] == 'SUCCEEDED'
+    assert len([e for e in eng2.events_after(0, scope='engine')
+                if e['type'] == 'engine.migrated']) == 1
+
+
+def test_corrupt_legacy_file_fails_typed(tmp_path):
+    with open(tmp_path / 'serve.db', 'wb') as f:
+        f.write(b'this is not a sqlite file' * 64)
+    with pytest.raises(sqlite3.DatabaseError):
+        engine.StateEngine(str(tmp_path / engine.DB_FILENAME))
+
+
+# ----------------------------------------------------------- open_db
+
+
+def test_open_db_applies_shared_tuning(tmp_path):
+    conn = engine.open_db(str(tmp_path / 'aux.db'),
+                          lambda cur, c: cur.execute(
+                              'CREATE TABLE IF NOT EXISTS t (x)'))
+    cur = conn.conn.cursor()
+    assert cur.execute('PRAGMA journal_mode').fetchone()[0] == 'wal'
+    assert cur.execute('PRAGMA busy_timeout').fetchone()[0] == 10000
+    cur.close()

@@ -776,7 +776,7 @@ class TestRollbackUnavailable:
                 timeout=60)
             # Simulate a controller that lost the v1 task: wipe both
             # the recorded yaml and the in-memory registration.
-            serve_state._db().execute_and_commit(  # pylint: disable=protected-access
+            serve_state._eng().execute(  # pylint: disable=protected-access
                 'DELETE FROM service_versions WHERE service_name=?',
                 (svc,))
             ctrl.replica_manager._version_tasks.pop(1, None)  # pylint: disable=protected-access
