@@ -830,6 +830,230 @@ def serve_spec_main() -> dict:
     }
 
 
+def _open_loop_overload(engine, prompts, gen: int,
+                        interarrival_s: float,
+                        timeout_s=None) -> dict:
+    """Overload-tolerant open-loop driver: like
+    :func:`_open_loop_load` but typed refusals are OUTCOMES, not
+    bench failures — every request is classified into exactly one of
+    completed / shed (429) / deadline (504), and only an untyped
+    error or a hung collector fails the bench. TTFT stats cover
+    COMPLETED requests only (a shed request's "latency" is its
+    Retry-After, not a TTFT)."""
+    import threading
+
+    from skypilot_tpu import exceptions
+
+    n = len(prompts)
+    ttfts = [None] * n
+    counts = [0] * n
+    outcome = [None] * n
+    done_at = [0.0] * n
+
+    def collect(i, q, sched):
+        first = True
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            if isinstance(tok, BaseException):
+                if isinstance(tok, exceptions.EngineOverloadedError):
+                    outcome[i] = 'shed'
+                elif isinstance(tok,
+                                exceptions.DeadlineExceededError):
+                    outcome[i] = 'deadline'
+                else:
+                    outcome[i] = f'error:{tok!r}'[:120]
+                continue
+            if first:
+                ttfts[i] = time.perf_counter() - sched
+                first = False
+            counts[i] += 1
+        if outcome[i] is None:
+            outcome[i] = 'completed' if counts[i] else 'empty'
+        done_at[i] = time.perf_counter()
+
+    threads = []
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(prompts):
+        sched = t0 + i * interarrival_s
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        deadline = (time.time() + timeout_s
+                    if timeout_s is not None else None)
+        q = engine.submit(prompt, gen, deadline=deadline)
+        th = threading.Thread(target=collect, args=(i, q, sched),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=600)
+    untyped = [o for o in outcome
+               if o is None or o.startswith('error:') or o == 'empty']
+    if untyped or not all(done_at):
+        raise RuntimeError(
+            'overload load lost requests — every request must end '
+            f'typed: {untyped[:3]}, '
+            f'{sum(1 for d in done_at if not d)} unfinished')
+    makespan = max(done_at) - t0
+
+    def pctl(sorted_ms, q):
+        if not sorted_ms:
+            return float('nan')
+        import math
+        return sorted_ms[min(len(sorted_ms) - 1,
+                             max(0, math.ceil(q * len(sorted_ms))
+                                 - 1))]
+
+    ttft_ms = sorted(t * 1000.0 for t in ttfts if t is not None)
+    completed = sum(1 for o in outcome if o == 'completed')
+    return {
+        'requests': n,
+        'completed': completed,
+        'shed': sum(1 for o in outcome if o == 'shed'),
+        'deadline_exceeded': sum(1 for o in outcome
+                                 if o == 'deadline'),
+        'tokens': sum(counts),
+        'makespan_s': round(makespan, 2),
+        'goodput_req_s': round(completed / makespan, 3),
+        'p50_ttft_ms': round(
+            ttft_ms[len(ttft_ms) // 2], 1) if ttft_ms else None,
+        'p99_ttft_ms': round(pctl(ttft_ms, 0.99), 1)
+        if ttft_ms else None,
+        'max_ttft_ms': round(ttft_ms[-1], 1) if ttft_ms else None,
+    }
+
+
+def serve_overload_main() -> dict:
+    """BENCH_MODE=serve_overload (``--bench serve_overload``):
+    bounded admission + end-to-end deadlines under an open-loop load
+    at ~3× the engine's measured capacity — the overload-control
+    comparison (docs/resilience.md, Overload control).
+
+    Both arms run the SAME engine configuration and the SAME
+    arrival schedule; only the overload knobs differ. The shed-off
+    arm is the unprotected regime: every request queues unboundedly
+    and eventually completes, so late arrivals inherit the whole
+    backlog's latency (queueing collapse — p99 TTFT grows with the
+    run length). The shed-on arm bounds the pending queue and stamps
+    a deadline: excess load is refused typed (429) in O(ms) at
+    submit, admitted requests either finish inside their budget or
+    are reaped typed (504) with their KV blocks reclaimed — so the
+    requests the engine DOES serve keep an uncongested-shaped TTFT.
+    The headline metric is the shed-on arm's completed-request p99
+    TTFT; vs_baseline is shed-off p99 / shed-on p99 (>1 = shedding
+    keeps admitted latency down under the identical overload).
+
+    Env: BENCH_OV_MODEL (default tiny — the CPU proxy),
+    BENCH_OV_REQUESTS, BENCH_OV_PROMPT, BENCH_OV_GEN,
+    BENCH_OV_ROWS, BENCH_OV_OVERDRIVE (arrival-rate multiple of
+    measured capacity, default 3), BENCH_OV_MAX_QUEUED,
+    BENCH_OV_TIMEOUT_S.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_OV_MODEL', 'tiny')
+    requests = int(os.environ.get('BENCH_OV_REQUESTS', '36'))
+    prompt_len = int(os.environ.get('BENCH_OV_PROMPT', '32'))
+    gen = int(os.environ.get('BENCH_OV_GEN', '24'))
+    rows = int(os.environ.get('BENCH_OV_ROWS', '2'))
+    overdrive = float(os.environ.get('BENCH_OV_OVERDRIVE', '3'))
+    max_queued = int(os.environ.get('BENCH_OV_MAX_QUEUED', '4'))
+    block = 16
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+
+    config = llama.get_config(model_name)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size,
+                            size=prompt_len).tolist()
+               for _ in range(requests)]
+
+    engine_kwargs = dict(slots=rows, block_size=block,
+                         num_blocks=rows * (max_seq // block) + 1,
+                         max_seq=max_seq, steps_per_dispatch=4,
+                         prefill_chunk=64,
+                         max_num_batched_tokens=64,
+                         prefix_caching=False,
+                         speculative=False)
+
+    # Calibrate capacity on a throwaway engine (also warms the
+    # compile cache for both arms): serve `rows` concurrent
+    # requests closed-loop, take the per-request service time.
+    cal = BatchingEngine(params, config, **engine_kwargs)
+    try:
+        cal.generate(prompts[0], 2)  # compile
+        t0 = time.perf_counter()
+        qs = [cal.submit(p, gen) for p in prompts[:rows]]
+        for q in qs:
+            while q.get() is not None:
+                pass
+        cal_s = time.perf_counter() - t0
+    finally:
+        cal.close()
+    capacity_req_s = rows / max(cal_s, 1e-6)
+    interarrival = 1.0 / (overdrive * capacity_req_s)
+    # A deadline every admitted request can make uncongested, but
+    # that queueing collapse must blow through: ~3 service times.
+    timeout_s = max(3.0 * cal_s, 2.0)
+
+    def run_arm(name, **overload_kwargs):
+        engine = BatchingEngine(params, config, **engine_kwargs,
+                                **overload_kwargs)
+        try:
+            engine.generate(prompts[0], 2)  # warm this engine
+            out = _open_loop_overload(
+                engine, prompts, gen, interarrival,
+                timeout_s=overload_kwargs.get('default_timeout_s'))
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    shed_off = run_arm('shed_off')
+    shed_on = run_arm('shed_on', max_queued_requests=max_queued,
+                      default_timeout_s=timeout_s)
+
+    ttft_ratio = ((shed_off['p99_ttft_ms'] or 0.0) /
+                  max(shed_on['p99_ttft_ms'] or float('inf'), 1e-9))
+    return {
+        'metric': f'{model_name}_serve_overload_p99_ttft_ms',
+        'value': shed_on['p99_ttft_ms'],
+        'unit': 'ms',
+        # vs_baseline: unprotected p99 / protected p99 under the
+        # same 3× overload (>1 = shedding keeps admitted latency
+        # uncongested-shaped).
+        'vs_baseline': round(ttft_ratio, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'requests': requests,
+            'prompt_len': prompt_len,
+            'generated_per_request': gen,
+            'decode_rows': rows,
+            'capacity_req_s': round(capacity_req_s, 3),
+            'overdrive': overdrive,
+            'arrival_rate_req_s': round(
+                overdrive * capacity_req_s, 3),
+            'max_queued_requests': max_queued,
+            'timeout_s': round(timeout_s, 2),
+            'max_seq': max_seq,
+            'shed_on': shed_on,
+            'shed_off': shed_off,
+            'p99_ttft_ratio_off_over_on': round(ttft_ratio, 3),
+        },
+    }
+
+
 def main() -> dict:
     import jax
     import jax.numpy as jnp
@@ -1756,8 +1980,8 @@ if __name__ == '__main__':
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
                      'serve_continuous', 'serve_prefix',
-                     'serve_spec', 'launch', 'checkpoint',
-                     'elastic')
+                     'serve_spec', 'serve_overload', 'launch',
+                     'checkpoint', 'elastic')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
                 print(f'usage: bench.py --bench {"|".join(known)}',
@@ -1778,6 +2002,8 @@ if __name__ == '__main__':
             bench_result = serve_prefix_main()
         elif mode == 'serve_spec':
             bench_result = serve_spec_main()
+        elif mode == 'serve_overload':
+            bench_result = serve_overload_main()
         elif mode == 'launch':
             bench_result = launch_main()
         else:

@@ -71,7 +71,14 @@ def serve_rules(spec=None) -> List[AlertRule]:
         AlertRule(
             id='replica-5xx-rate', kind='rate',
             metric='skytpu_lb_requests_total',
-            labels={'code': ('prefix', '5')},
+            # 504 is excluded: a deadline miss is the CLIENT's
+            # budget expiring (overload control answering 504 by
+            # contract), not a replica fault — shedding under
+            # overload must not page as if replicas were dying.
+            # deadline-miss-rate-high (fleet pack) covers sustained
+            # 504s at ticket severity; slo-burn-rate still counts
+            # them (missed deadlines DO burn error budget).
+            labels={'code': ('prefix_except', '5', ('504',))},
             threshold=0.1, op='>', window=300.0, for_seconds=60.0,
             severity='page',
             summary='Replicas are answering 5xx through the LB.'),
@@ -168,6 +175,32 @@ def fleet_rules() -> List[AlertRule]:
                     'is already bounding the overhead; consider '
                     'engine.speculative off or a smaller '
                     'engine.draft_k).'),
+        # Overload-control pair (docs/resilience.md, Overload
+        # control). Fleet pack for the same plumbing reason as
+        # kv-pool-exhausted: the shed/deadline counters are recorded
+        # by replica worker processes and reach history via the
+        # textfile bridge → host agent → cluster-scope scrapes.
+        # Ticket severity, deliberately NOT pages: shedding and
+        # deadline 504s are the overload controller doing its job —
+        # sustained rates mean "add replicas / raise limits", not
+        # "wake someone up" (availability collapse still pages via
+        # lb-no-ready-replica and slo-burn-rate).
+        AlertRule(
+            id='load-shed-rate-high', kind='rate',
+            metric='skytpu_batch_shed_total',
+            threshold=0.5, op='>', window=300.0, for_seconds=120.0,
+            summary='The batching engine is shedding load (429s) at '
+                    'a sustained rate — the pending queue keeps '
+                    'hitting overload.max_queued_requests/tokens. '
+                    'Scale out or raise the bounds.'),
+        AlertRule(
+            id='deadline-miss-rate-high', kind='rate',
+            metric='skytpu_batch_deadline_exceeded_total',
+            threshold=0.5, op='>', window=300.0, for_seconds=120.0,
+            summary='Admitted requests keep blowing their '
+                    'end-to-end deadlines (504s) — the engine is '
+                    'too slow for the offered load or the timeout '
+                    'budgets are too tight.'),
         AlertRule(
             id='agent-scrape-stale', kind='absent',
             metric='skytpu_agent_uptime_seconds',

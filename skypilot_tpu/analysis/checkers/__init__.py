@@ -17,6 +17,7 @@ from skypilot_tpu.analysis.checkers import raw_sqlite
 from skypilot_tpu.analysis.checkers import sleep_retry
 from skypilot_tpu.analysis.checkers import spawn_stamp
 from skypilot_tpu.analysis.checkers import state_write
+from skypilot_tpu.analysis.checkers import urlopen_timeout
 
 
 def build_all() -> List['core.Checker']:
@@ -33,4 +34,5 @@ def build_all() -> List['core.Checker']:
         names.MetricNameContractChecker(),
         names.AlertRuleContractChecker(),
         names.FaultSiteContractChecker(),
+        urlopen_timeout.UrlopenWithoutTimeoutChecker(),
     ]

@@ -1,0 +1,40 @@
+"""urlopen-without-timeout: every urlopen declares a timeout.
+
+The contract (docs/resilience.md, Overload control): this repo's
+control and data planes talk HTTP to peers that CAN hang — a stalled
+replica, a half-dead agent, a blackholed LB. ``urllib.request.urlopen``
+without ``timeout=`` inherits the global socket default (usually
+None = block forever), so one dark peer freezes the calling thread —
+probe loops stop probing, the LB stops proxying, deadlines stop
+mattering. Every call must pass an explicit ``timeout=`` (a computed
+remaining-deadline budget, a knob, or a literal); the value being
+dynamic is fine, its PRESENCE is the invariant.
+"""
+from typing import Iterable
+
+from skypilot_tpu.analysis import core
+
+
+class UrlopenWithoutTimeoutChecker(core.Checker):
+    rule = 'urlopen-without-timeout'
+    description = ('urllib.request.urlopen(...) without an explicit '
+                   'timeout= keyword.')
+
+    def check_file(self, ctx: 'core.FileContext'
+                   ) -> Iterable['core.Finding']:
+        for call in ctx.calls():
+            qual = ctx.call_name(call) or ''
+            if qual not in ('urllib.request.urlopen',
+                            'urlopen'):
+                continue
+            if any(kw.arg == 'timeout' for kw in call.keywords):
+                continue
+            # A positional timeout (3rd arg: url, data, timeout) also
+            # satisfies the contract, though keyword form is the idiom.
+            if len(call.args) >= 3:
+                continue
+            yield core.Finding(
+                self.rule, ctx.rel, call.lineno, call.col_offset + 1,
+                'urlopen without explicit timeout= — inherits the '
+                'global socket default (block forever); pass the '
+                'remaining deadline budget or a bounded knob')

@@ -140,6 +140,33 @@ class KVPoolExhaustedError(SkyTpuError):
     never fail unrelated in-flight requests."""
 
 
+class DeadlineExceededError(SkyTpuError):
+    """A serve request ran past its end-to-end deadline.
+
+    Raised to the submitting client (via its token queue) when the
+    batching engine observes, at an iteration boundary or at
+    admission, that the request's stamped deadline has passed. The
+    HTTP surface maps this to 504 — the budget was the CLIENT's, so
+    timing out is the client-visible contract, not a replica fault.
+    The request's KV blocks are released through the same reclaim
+    path as preemption before the error is delivered."""
+
+
+class EngineOverloadedError(SkyTpuError):
+    """The batching engine's bounded pending queue refused a request.
+
+    Raised at ``submit()`` time when admission would exceed
+    ``overload.max_queued_requests`` / ``max_queued_tokens``. Typed
+    refusal (HTTP 429) beats silent unbounded queueing: the caller
+    learns IMMEDIATELY and can retry elsewhere. ``retry_after_s``
+    estimates when queue space frees up, derived from the engine's
+    recent drain rate (0 when the engine has no history yet)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class KVBlockError(SkyTpuError, ValueError):
     """Invalid paged-KV block-pool operation.
 

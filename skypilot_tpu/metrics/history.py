@@ -82,9 +82,12 @@ def _safe_scope(scope: str) -> str:
 
 def labels_match(sample_labels: Sequence[Tuple[str, str]],
                  want: Optional[Dict[str, Any]]) -> bool:
-    """Subset match. A wanted value may be an exact string or
+    """Subset match. A wanted value may be an exact string,
     ``('prefix', p)`` — how the 5xx rules select ``code`` label
-    values ``5..`` without a regex engine."""
+    values ``5..`` without a regex engine — or
+    ``('prefix_except', p, (v, ...))``: prefix match minus an
+    explicit exclusion list, how replica-5xx-rate counts 5xx codes
+    while skipping the overload plane's client-shaped 504s."""
     if not want:
         return True
     have = dict(sample_labels)
@@ -93,9 +96,15 @@ def labels_match(sample_labels: Sequence[Tuple[str, str]],
         if got is None:
             return False
         if isinstance(expect, (tuple, list)):
-            if len(expect) != 2 or expect[0] != 'prefix':
-                return False
-            if not got.startswith(str(expect[1])):
+            if len(expect) == 2 and expect[0] == 'prefix':
+                if not got.startswith(str(expect[1])):
+                    return False
+            elif len(expect) == 3 and expect[0] == 'prefix_except':
+                if not got.startswith(str(expect[1])):
+                    return False
+                if got in tuple(str(v) for v in expect[2]):
+                    return False
+            else:
                 return False
         elif got != str(expect):
             return False

@@ -55,6 +55,22 @@ def _max_value(families, name: str) -> Optional[float]:
     return max(vals) if vals else None
 
 
+# Previous shed-counter observations, {service: (t, cumulative)} —
+# the SHED/s column is a delta rate between redraws of this process's
+# `xsky top` loop (first observation shows 0.0, not a bogus
+# since-boot average).
+_shed_prev: Dict[str, Tuple[float, float]] = {}
+
+
+def _shed_rate(service: str, total: float) -> float:
+    now = time.time()
+    prev = _shed_prev.get(service)
+    _shed_prev[service] = (now, total)
+    if prev is None or now <= prev[0] or total < prev[1]:
+        return 0.0
+    return (total - prev[1]) / (now - prev[0])
+
+
 # -- snapshot ----------------------------------------------------------
 
 
@@ -280,6 +296,20 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                     fams, 'skytpu_lb_prefix_block_misses_total'))
                 if hits + misses > 0:
                     row['prefix_hit_ratio'] = hits / (hits + misses)
+                # Overload-control columns (docs/resilience.md):
+                # queue depth (the engine's pending-queue gauges)
+                # and shed rate. Present when the scrape carries
+                # the batch registry (single-process serves and
+                # textfile-bridged exports); '-' otherwise.
+                row['queued_requests'] = _max_value(
+                    fams, 'skytpu_batch_queued_requests')
+                row['queued_tokens'] = _max_value(
+                    fams, 'skytpu_batch_queued_tokens')
+                shed = _samples(fams, 'skytpu_batch_shed_total')
+                if shed:
+                    row['shed_per_s'] = _shed_rate(
+                        svc['name'],
+                        sum(s.value for s in shed))
             except Exception as e:  # pylint: disable=broad-except
                 row['error'] = str(e)
         services.append(row)
@@ -427,8 +457,16 @@ def render(snap: Dict[str, Any]) -> str:
     if snap['services']:
         stable = ux_utils.Table(['SERVICE', 'STATUS', 'VERSION',
                                  'QPS', 'P50', 'P99', 'REQS', '5XX',
-                                 'HIT%', 'ALERTS'])
+                                 'QUEUE', 'SHED/s', 'HIT%',
+                                 'ALERTS'])
         for s in snap['services']:
+            # Queue depth: 'reqs(tokens)' when the engine's
+            # pending-queue gauges are visible in the scrape.
+            queue = '-'
+            if s.get('queued_requests') is not None:
+                queue = f'{s["queued_requests"]:.0f}'
+                if s.get('queued_tokens') is not None:
+                    queue += f'({s["queued_tokens"]:.0f}t)'
             stable.add_row([
                 s['name'], s['status'],
                 _fmt_version(s),
@@ -437,6 +475,8 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(s.get('p99_s'), '{:.3f}s'),
                 _fmt_num(s.get('requests'), '{:.0f}'),
                 _fmt_num(s.get('errors'), '{:.0f}'),
+                queue,
+                _fmt_num(s.get('shed_per_s'), '{:.2f}'),
                 _fmt_ratio(s.get('prefix_hit_ratio')),
                 str(s.get('alerts_firing', 0) or '-'),
             ])

@@ -92,6 +92,34 @@ def main():
                         help='max drafted tokens per row per verify '
                              'dispatch (engine.draft_k; 0 disables '
                              'speculation)')
+    # Overload-control knobs (service YAML `overload:` section,
+    # stamped as SKYTPU_ENGINE_OVERLOAD_* by the replica manager):
+    # 0 = unbounded/none, the pre-overload-control behavior.
+    parser.add_argument('--max-queued-requests', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_'
+                            'REQUESTS', '0')),
+                        help='bounded admission: refuse (429) past '
+                             'this many queued requests '
+                             '(overload.max_queued_requests; 0 = '
+                             'unbounded)')
+    parser.add_argument('--max-queued-tokens', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_'
+                            'TOKENS', '0')),
+                        help='bounded admission: refuse (429) past '
+                             'this many queued prompt tokens '
+                             '(overload.max_queued_tokens; 0 = '
+                             'unbounded)')
+    parser.add_argument('--default-timeout-s', type=float,
+                        default=float(os.environ.get(
+                            'SKYTPU_ENGINE_OVERLOAD_DEFAULT_'
+                            'TIMEOUT_S', '0')),
+                        help='deadline stamped on requests that '
+                             'carry none; expired requests abort '
+                             'typed with 504 '
+                             '(overload.default_timeout_s; 0 = no '
+                             'default deadline)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -198,7 +226,10 @@ def main():
             max_num_batched_tokens=args.max_batched_tokens,
             prefix_caching=args.prefix_caching == 'on',
             speculative=args.speculative == 'on',
-            draft_k=args.draft_k)
+            draft_k=args.draft_k,
+            max_queued_requests=args.max_queued_requests or None,
+            max_queued_tokens=args.max_queued_tokens or None,
+            default_timeout_s=args.default_timeout_s or None)
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the
@@ -292,13 +323,26 @@ def main():
         def _engine_error(self, err):
             """Answer a typed engine failure as an HTTP error
             instead of raising through the handler (which tears the
-            connection down mid-handshake). 413 ONLY for the
-            pool-can-never-hold-this-prompt case — a client-shaped
-            error that must not trip the LB's replica-5xx-rate
-            page; anything else (engine death pushed onto every
-            queue by _fail_all) IS a replica fault and answers 500
-            so the 5xx alert sees it."""
+            connection down mid-handshake). Client-shaped refusals
+            map to non-5xx codes so they never trip the LB's
+            replica-5xx-rate page: 413 for the pool-can-never-hold-
+            this-prompt case, 429 (+ Retry-After from the engine's
+            drain-rate estimate) for bounded-admission shedding,
+            504 for an expired end-to-end deadline. Anything else
+            (engine death pushed onto every queue by _fail_all) IS
+            a replica fault and answers 500 so the 5xx alert sees
+            it."""
             from skypilot_tpu import exceptions
+            if isinstance(err, exceptions.EngineOverloadedError):
+                retry_after = max(1, int(round(
+                    getattr(err, 'retry_after_s', 1.0))))
+                self._json({'error': str(err)}, 429,
+                           extra_headers={'Retry-After':
+                                          str(retry_after)})
+                return
+            if isinstance(err, exceptions.DeadlineExceededError):
+                self._json({'error': str(err)}, 504)
+                return
             code = 413 if isinstance(
                 err, exceptions.KVPoolExhaustedError) else 500
             self._json({'error': str(err)}, code)
@@ -351,9 +395,32 @@ def main():
                 tenant = body.get('tenant')
                 if tenant is not None:
                     tenant = str(tenant)
+                # Priority class (overload control): shedding takes
+                # batch first, preemption takes lowest-priority-
+                # youngest, prefill weights interactive ahead.
+                priority = str(body.get('priority', 'interactive'))
+                from skypilot_tpu.serve import batching as b_lib
+                if priority not in b_lib.PRIORITIES:
+                    raise ValueError(
+                        f'priority must be one of '
+                        f'{b_lib.PRIORITIES}, got {priority!r}')
             except (ValueError, KeyError, TypeError) as e:
                 self._json({'error': f'bad request: {e}'}, 400)
                 return
+            # End-to-end deadline: the X-Skytpu-Deadline header (the
+            # LB's remaining-budget stamp, already decremented for
+            # the proxy hop) wins over the body's timeout_s — both
+            # are seconds-from-now, re-anchored on THIS process's
+            # clock so LB and replica clocks never need to agree.
+            from skypilot_tpu.serve import overload as overload_lib
+            import time as time_mod
+            budget_s = overload_lib.parse_timeout_s(
+                self.headers.get(overload_lib.DEADLINE_HEADER))
+            if budget_s is None:
+                budget_s = overload_lib.parse_timeout_s(
+                    body.get('timeout_s'))
+            deadline = (time_mod.time() + budget_s
+                        if budget_s is not None else None)
             stream = bool(body.get('stream'))
             # Adopt the LB's traceparent hop (attach(None) is a
             # barrier: an untraced request must not inherit this
@@ -367,11 +434,13 @@ def main():
                                           'max_new': max_new}):
                 self._generate_response(prompt_ids, max_new,
                                         temperature, top_p, seed,
-                                        eos_id, stream, tenant)
+                                        eos_id, stream, tenant,
+                                        deadline, priority)
 
         def _generate_response(self, prompt_ids, max_new, temperature,
                                top_p, seed, eos_id, stream,
-                               tenant=None):
+                               tenant=None, deadline=None,
+                               priority='interactive'):
             use_engine = (engine is not None and temperature is None
                           and top_p is None)
             if stream and use_engine:
@@ -383,7 +452,9 @@ def main():
                 import queue as queue_mod
                 req = engine.submit_request(prompt_ids, max_new,
                                             eos_id=eos_id,
-                                            tenant=tenant)
+                                            tenant=tenant,
+                                            deadline=deadline,
+                                            priority=priority)
                 q = req.out
                 # Hold the status line for the FIRST queue item:
                 # admission (which fills the prefix-cache stats the
@@ -442,14 +513,18 @@ def main():
                     self.wfile.write(b'0\r\n\r\n')
                     self.wfile.flush()
                 except OSError:
-                    # Client went away mid-stream: drain the queue so
-                    # the engine's row retires normally. Bounded
-                    # get()s — the sentinel may already have been
-                    # consumed above, and a bare get() would then
-                    # block this handler thread forever.
-                    import queue as queue_mod
+                    # Client went away mid-stream: CANCEL the
+                    # request — the engine frees its KV blocks at
+                    # the next iteration boundary (the same reclaim
+                    # path as preemption) instead of burning decode
+                    # until max_tokens for nobody — then drain the
+                    # queue so this handler thread unblocks on the
+                    # sentinel. Bounded get()s: the sentinel may
+                    # already have been consumed above, and a bare
+                    # get() would then block forever.
+                    engine.cancel(req.id)
                     try:
-                        while q.get(timeout=120) is not None:
+                        while q.get(timeout=30) is not None:
                             pass
                     except queue_mod.Empty:
                         pass
@@ -457,7 +532,9 @@ def main():
             if use_engine:
                 req = engine.submit_request(prompt_ids, max_new,
                                             eos_id=eos_id,
-                                            tenant=tenant)
+                                            tenant=tenant,
+                                            deadline=deadline,
+                                            priority=priority)
                 out = []
                 err = None
                 while True:

@@ -24,6 +24,12 @@ Sites (one per recovery path the paper cares about):
                       recovery_strategy.py): any injected kind fails
                       the CURRENT downsized-shape attempt, driving
                       the strategy to the next smaller shape
+    serve.stall       the batching-engine loop iteration (serve/
+                      batching.py): any injected kind sleeps the
+                      loop for SKYTPU_SERVE_STALL_SECONDS before it
+                      runs — a slow-decode brownout that drills
+                      deadline enforcement and load shedding
+                      without killing the engine
 
 Activation:
   - programmatically: ``faults.arm('agent.health', 'error', 0.3)``
@@ -51,7 +57,7 @@ logger = tpu_logging.init_logger(__name__)
 
 SITES = ('agent.run', 'agent.health', 'provision.launch',
          'serve.probe', 'jobs.poll', 'checkpoint.save',
-         'lifecycle.kill', 'recovery.resize')
+         'lifecycle.kill', 'recovery.resize', 'serve.stall')
 KINDS = ('error', 'timeout', 'preempt')
 
 ENV_VAR = 'SKYTPU_FAULTS'

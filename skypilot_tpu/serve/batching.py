@@ -57,6 +57,8 @@ TPU-first design:
   prefill would.
 """
 import collections
+import itertools
+import os
 import queue
 import threading
 import time
@@ -71,6 +73,7 @@ from skypilot_tpu import tpu_logging
 from skypilot_tpu import trace as trace_lib
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models.quant import matmul as _mm
+from skypilot_tpu.resilience import faults as faults_lib
 from skypilot_tpu.serve import kv_pool as kv_pool_lib
 
 logger = tpu_logging.init_logger(__name__)
@@ -735,10 +738,23 @@ def verify_step_paged(params: Params, tokens: jax.Array,
 # ---------------------------------------------------------------------
 
 
+# Priority classes layered on the tenant DRR (overload control):
+# shedding takes batch first, pool-exhaustion preemption takes the
+# lowest-priority-youngest row, and the prefill budget weights
+# interactive classes ahead of batch ones (docs/resilience.md,
+# Overload control).
+PRIORITIES = ('interactive', 'batch')
+PRIORITY_PREFILL_WEIGHTS = {'interactive': 4.0, 'batch': 1.0}
+
+_REQ_SEQ = itertools.count(1)
+
+
 class _Request:
     def __init__(self, prompt_ids: List[int], max_new: int,
                  eos_id: Optional[int] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 deadline: Optional[float] = None,
+                 priority: str = 'interactive'):
         self.prompt_ids = prompt_ids
         self.max_new = max_new
         self.eos_id = eos_id
@@ -746,6 +762,16 @@ class _Request:
         # admission loop splits the per-iteration prefill token
         # budget by weighted deficit round-robin over this field.
         self.tenant = tenant
+        # Overload-control state: ``id`` is the handle
+        # ``BatchingEngine.cancel`` takes (serve_model holds it
+        # across the streaming response), ``deadline`` is an
+        # ABSOLUTE epoch second (None = no deadline) enforced at
+        # admission and between decode iterations, ``priority``
+        # picks the shed/preempt/prefill class.
+        self.id = next(_REQ_SEQ)
+        self.deadline = deadline
+        self.priority = priority
+        self.cancelled = False
         # Prefix-cache accounting, filled at admission (cumulative
         # across re-admissions after preemption): whole KV blocks
         # reused from the cache vs freshly prefilled. serve_model
@@ -869,6 +895,38 @@ def _engine_metrics():
             'Tokens emitted per row by the latest verify dispatch '
             '(accepted drafts + the bonus token; 1.0 == plain '
             'decode, draft_k+1 == full acceptance).'),
+        'shed': reg.counter(
+            'skytpu_batch_shed_total',
+            'Requests refused typed at submit() by bounded '
+            'admission, by reason: which overload knob tripped '
+            '(max_queued_requests / max_queued_tokens) or '
+            'priority_evict (a queued batch request shed to make '
+            'room for an arriving interactive one).',
+            ('reason',)),
+        'cancelled': reg.counter(
+            'skytpu_batch_cancelled_total',
+            'Requests cancelled by the client (broken connection) '
+            '— their KV blocks reclaimed at the next iteration '
+            'boundary through the preemption release path.'),
+        'deadline_exceeded': reg.counter(
+            'skytpu_batch_deadline_exceeded_total',
+            'Requests aborted typed because their end-to-end '
+            'deadline expired at admission or between decode '
+            'iterations (serve_model answers 504).'),
+        'loop_hang': reg.counter(
+            'skytpu_batch_loop_hang_total',
+            'close() observed the engine loop thread still alive '
+            'after its join timeout — a wedged dispatch is holding '
+            'the loop (likely a hung device call).'),
+        'queued_requests': reg.gauge(
+            'skytpu_batch_queued_requests',
+            'Requests waiting in the pending (pre-admission) '
+            'queue.'),
+        'queued_tokens': reg.gauge(
+            'skytpu_batch_queued_tokens',
+            'Prompt + resume tokens held by the pending queue — '
+            'the currency of the max_queued_tokens admission '
+            'bound.'),
     }
 
 
@@ -912,6 +970,17 @@ class BatchingEngine:
       static verify width is draft_k + 1).
     - ``tenant_weights``: optional per-tenant weights for the
       fair-share budget split (absent tenants weigh 1.0).
+    - ``max_queued_requests`` / ``max_queued_tokens``: bounded
+      admission (service YAML ``service: overload:``): past either
+      bound ``submit()`` refuses with a typed
+      ``EngineOverloadedError`` carrying a drain-rate Retry-After
+      (None = unbounded, the pre-overload-control behavior). An
+      arriving interactive request sheds a queued batch request
+      instead of being refused itself.
+    - ``default_timeout_s``: deadline stamped on requests that
+      carry none (None = no default). Expired requests abort typed
+      (``DeadlineExceededError``) at admission or between decode
+      iterations, blocks reclaimed.
     """
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
@@ -925,7 +994,10 @@ class BatchingEngine:
                  prefix_caching: bool = True,
                  speculative: bool = True,
                  draft_k: int = 8,
-                 tenant_weights: Optional[Dict[str, float]] = None):
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 max_queued_requests: Optional[int] = None,
+                 max_queued_tokens: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None):
         self.params = params
         self.config = config
         self.slots = slots
@@ -1037,6 +1109,20 @@ class BatchingEngine:
         self.pending: 'collections.deque[_Request]' = \
             collections.deque()
         self._pending_lock = threading.Lock()
+        # Overload control (docs/resilience.md, Overload control):
+        # bounded admission + default deadline. _queued_tokens
+        # mirrors the pending queue's token content (updated under
+        # _pending_lock wherever the deque mutates); _admit_times
+        # feeds the drain-rate Retry-After estimate; _cancel_ids
+        # holds ids handed to cancel() until the loop's sweep acts
+        # on them at the next iteration boundary.
+        self.max_queued_requests = max_queued_requests
+        self.max_queued_tokens = max_queued_tokens
+        self.default_timeout_s = default_timeout_s
+        self._queued_tokens = 0
+        self._admit_times: 'collections.deque' = collections.deque(
+            maxlen=256)
+        self._cancel_ids: set = set()
         # Scheduler event log (bounded) — the chunked-prefill
         # interleaving contract is asserted against this in tests.
         self.events: 'collections.deque' = collections.deque(
@@ -1097,27 +1183,54 @@ class BatchingEngine:
 
     def submit(self, prompt_ids: List[int], max_new: int,
                eos_id: Optional[int] = None,
-               tenant: Optional[str] = None) -> 'queue.Queue':
+               tenant: Optional[str] = None,
+               deadline: Optional[float] = None,
+               priority: str = 'interactive') -> 'queue.Queue':
         """Returns a Queue yielding generated ids then None. With
         ``eos_id``, the row retires the moment it emits that id
         (the EOS itself is emitted, matching greedy_generate). A
         request the pool can never hold yields a typed
-        ``KVPoolExhaustedError`` before its None."""
+        ``KVPoolExhaustedError`` before its None; a refused
+        (bounded-admission) request a typed ``EngineOverloadedError``
+        and an expired one a typed ``DeadlineExceededError``."""
         return self.submit_request(prompt_ids, max_new,
-                                   eos_id=eos_id, tenant=tenant).out
+                                   eos_id=eos_id, tenant=tenant,
+                                   deadline=deadline,
+                                   priority=priority).out
 
     def submit_request(self, prompt_ids: List[int], max_new: int,
                        eos_id: Optional[int] = None,
-                       tenant: Optional[str] = None) -> _Request:
+                       tenant: Optional[str] = None,
+                       deadline: Optional[float] = None,
+                       priority: str = 'interactive') -> _Request:
         """``submit`` returning the request object itself: ``.out``
-        is the token queue, and after admission (i.e. by the first
-        token) ``.prefix_hit_blocks``/``.prefix_miss_blocks`` carry
-        the prefix-cache accounting serve_model exports as response
-        headers."""
+        is the token queue, ``.id`` is the handle ``cancel()``
+        takes, and after admission (i.e. by the first token)
+        ``.prefix_hit_blocks``/``.prefix_miss_blocks`` carry the
+        prefix-cache accounting serve_model exports as response
+        headers. ``deadline`` is an absolute epoch second (None
+        falls back to the engine's ``default_timeout_s``)."""
+        if priority not in PRIORITIES:
+            raise ValueError(f'priority must be one of {PRIORITIES},'
+                             f' got {priority!r}')
+        if deadline is None and self.default_timeout_s is not None:
+            deadline = time.time() + self.default_timeout_s
         max_new = min(max_new,
                       self.max_seq - len(prompt_ids) - 1)
         req = _Request(list(prompt_ids), max(0, max_new),
-                       eos_id=eos_id, tenant=tenant)
+                       eos_id=eos_id, tenant=tenant,
+                       deadline=deadline, priority=priority)
+        if req.deadline is not None and time.time() >= req.deadline:
+            # Already past its deadline at submit: refusing NOW is
+            # strictly better than queueing work whose answer nobody
+            # is waiting for (the admission-time deadline check,
+            # taken at its earliest possible point).
+            self._metrics['deadline_exceeded'].inc()
+            self._fail_request(
+                req, 'deadline expired before admission',
+                exc=exceptions.DeadlineExceededError(
+                    'deadline expired before admission'))
+            return req
         if req.max_new == 0 or self._stop:
             # A DEAD engine (not a clean close / zero-budget
             # request) fails post-death submits typed: serve_model
@@ -1140,8 +1253,40 @@ class BatchingEngine:
                 f'{self.pool.usable_blocks} usable '
                 f'(block_size={self.block_size})')
             return req
+        cost = len(req.prompt_ids)
+        victim = None
         with self._pending_lock:
-            self.pending.append(req)
+            reason = self._shed_reason(cost)
+            if reason is not None and req.priority == 'interactive':
+                # Shedding takes batch first: an arriving
+                # interactive request evicts the YOUNGEST queued
+                # batch request rather than being refused itself.
+                victim = self._evict_queued_batch()
+                if victim is not None:
+                    reason = None
+            if reason is not None:
+                retry_after = self._retry_after_locked()
+            else:
+                self.pending.append(req)
+                self._queued_tokens += cost
+        if victim is not None:
+            self._metrics['shed'].labels(
+                reason='priority_evict').inc()
+            self._fail_request(
+                victim, 'shed from the pending queue to admit an '
+                'interactive request',
+                exc=exceptions.EngineOverloadedError(
+                    'shed from the pending queue to admit an '
+                    'interactive request',
+                    retry_after_s=self._retry_after()))
+        if reason is not None:
+            self._metrics['shed'].labels(reason=reason).inc()
+            self._fail_request(
+                req, f'pending queue full ({reason})',
+                exc=exceptions.EngineOverloadedError(
+                    f'pending queue full ({reason})',
+                    retry_after_s=retry_after))
+            return req
         self.wake.set()
         # close()/death may have stopped the loop between the _stop
         # check above and the append — the exited loop will never
@@ -1170,29 +1315,116 @@ class BatchingEngine:
                 raise tok
             out.append(tok)
 
+    def cancel(self, request_id) -> None:
+        """Tear down an in-flight or queued request: its KV blocks
+        are freed at the next iteration boundary through the exact
+        reclaim path preemption uses, and its token queue gets the
+        None sentinel so any residual reader unblocks. Accepts the
+        ``_Request`` from ``submit_request`` or its ``.id``.
+        Cancelling an unknown or already-finished request is a
+        no-op — the client is gone either way."""
+        if isinstance(request_id, _Request):
+            request_id.cancelled = True
+        else:
+            with self._pending_lock:
+                self._cancel_ids.add(request_id)
+        self.wake.set()
+
     def close(self):
         self._stop = True
         self.wake.set()
         self.thread.join(timeout=10)
+        if self.thread.is_alive():
+            # A wedged dispatch (hung device call) is holding the
+            # loop past the join timeout: returning silently would
+            # hide a live thread still mutating engine state. Count
+            # + log so operators see it (satellite of ISSUE 17).
+            self._metrics['loop_hang'].inc()
+            logger.error(
+                'Batching engine loop thread still alive after '
+                'close() join timeout — a dispatch is likely '
+                'wedged; the daemon thread dies with the process.')
 
     # -- scheduling helpers ---------------------------------------------
+
+    @staticmethod
+    def _queue_cost(req: _Request) -> int:
+        """Tokens this PENDING request will prefill when admitted —
+        prompt plus any resume (preempted-and-requeued) tokens; the
+        currency of the max_queued_tokens bound. Stable while the
+        request sits in the queue (``generated`` only grows while
+        admitted), so append/pop accounting stays symmetric."""
+        return len(req.prompt_ids) + len(req.generated)
 
     def _pop_pending(self) -> Optional[_Request]:
         with self._pending_lock:
             try:
-                return self.pending.popleft()
+                req = self.pending.popleft()
             except IndexError:
                 return None
+            self._queued_tokens -= self._queue_cost(req)
+            return req
 
     def _push_front(self, req: _Request) -> None:
         with self._pending_lock:
             self.pending.appendleft(req)
+            self._queued_tokens += self._queue_cost(req)
 
-    def _fail_request(self, req: _Request, msg: str) -> None:
+    def _shed_reason(self, cost: int) -> Optional[str]:
+        """Which admission bound a ``cost``-token arrival would
+        trip (None = admit). Caller holds ``_pending_lock``. An
+        empty queue always admits regardless of the token bound —
+        one oversized request must degrade to FIFO progress, not a
+        permanent typed refusal (the DRR budget has the same
+        first-chunk overdraft rule)."""
+        n_q = len(self.pending)
+        if self.max_queued_requests is not None \
+                and n_q >= self.max_queued_requests:
+            return 'max_queued_requests'
+        if self.max_queued_tokens is not None and n_q > 0 \
+                and self._queued_tokens + cost > \
+                self.max_queued_tokens:
+            return 'max_queued_tokens'
+        return None
+
+    def _evict_queued_batch(self) -> Optional[_Request]:
+        """Remove and return the YOUNGEST queued batch-priority
+        request (None if the queue holds only interactive ones).
+        Caller holds ``_pending_lock``."""
+        for idx in range(len(self.pending) - 1, -1, -1):
+            cand = self.pending[idx]
+            if cand.priority == 'batch':
+                del self.pending[idx]
+                self._queued_tokens -= self._queue_cost(cand)
+                return cand
+        return None
+
+    def _retry_after_locked(self) -> float:
+        """Retry-After estimate from the recent admission drain
+        rate: queue depth / admissions-per-second over the trailing
+        30 s, clamped to [1, 60]. Caller holds ``_pending_lock``."""
+        now = time.time()
+        times = [t for t in self._admit_times if t > now - 30.0]
+        if len(times) >= 2 and now > times[0]:
+            rate = len(times) / (now - times[0])
+            est = (len(self.pending) + 1) / max(rate, 1e-6)
+        else:
+            est = 1.0
+        return min(60.0, max(1.0, est))
+
+    def _retry_after(self) -> float:
+        with self._pending_lock:
+            return self._retry_after_locked()
+
+    def _fail_request(self, req: _Request, msg: str,
+                      exc: Optional[BaseException] = None) -> None:
         """Typed per-request failure: the REQUEST fails; every other
-        in-flight request keeps decoding (never ``_fail_all``)."""
+        in-flight request keeps decoding (never ``_fail_all``).
+        ``exc`` overrides the default ``KVPoolExhaustedError``
+        (deadline / overload refusals carry their own types)."""
         logger.warning('Batching engine failing request: %s', msg)
-        req.out.put(exceptions.KVPoolExhaustedError(msg))
+        req.out.put(exc if exc is not None
+                    else exceptions.KVPoolExhaustedError(msg))
         req.out.put(None)
 
     def _set_table_row(self, row: int) -> None:
@@ -1237,16 +1469,20 @@ class BatchingEngine:
         self._push_front(req)
 
     def _pick_victim(self) -> Optional[int]:
-        """The YOUNGEST admitted row (latest original submit time;
-        admission order breaks ties). The oldest request is thereby
-        never preempted while any younger one exists — preempted
-        requests keep their submit time, so they age into that
-        protection and cannot starve."""
+        """The LOWEST-PRIORITY-YOUNGEST admitted row: every batch-
+        class row is preempted before any interactive one, and
+        within a class the youngest goes first (latest original
+        submit time; admission order breaks ties). The oldest
+        request of the highest admitted class is thereby never
+        preempted while any other row exists — preempted requests
+        keep their submit time, so they age into that protection
+        and cannot starve."""
         rows = [i for i in range(self.slots)
                 if self.slot_req[i] is not None]
         if len(rows) <= 1:
             return None
         return max(rows, key=lambda i: (
+            PRIORITIES.index(self.slot_req[i].priority),
             self.slot_req[i].submitted_at, self.slot_seq[i]))
 
     def _ensure_blocks(self, row: int, target_tokens: int) -> bool:
@@ -1348,6 +1584,24 @@ class BatchingEngine:
             req = self._pop_pending()
             if req is None:
                 return
+            if req.cancelled:
+                # Client gone before admission: sentinel only (no
+                # typed error — nobody is reading) and never touch
+                # the pool.
+                self._metrics['cancelled'].inc()
+                req.out.put(None)
+                continue
+            if req.deadline is not None and \
+                    time.time() >= req.deadline:
+                # Cannot start before its deadline: refuse typed
+                # NOW instead of burning prefill on an answer the
+                # client has already given up on.
+                self._metrics['deadline_exceeded'].inc()
+                self._fail_request(
+                    req, 'deadline expired before admission',
+                    exc=exceptions.DeadlineExceededError(
+                        'deadline expired before admission'))
+                continue
             tokens_all = req.prompt_ids + req.generated
             t0 = len(tokens_all)
             need = self.pool.blocks_for(t0 + 1)
@@ -1418,6 +1672,9 @@ class BatchingEngine:
                                       attrs={'slot': row})
                 req.admitted_once = True
                 self._metrics['requests'].inc()
+            # Drain-rate sample for the Retry-After estimate: every
+            # admission (including re-admissions) moves the queue.
+            self._admit_times.append(time.time())
             self.slot_req[row] = req
             self.slot_blocks[row] = blocks
             # Cache-hit tokens are ALREADY in the row's blocks —
@@ -1454,6 +1711,14 @@ class BatchingEngine:
     def _tenant_weight(self, tenant: str) -> float:
         w = self.tenant_weights.get(tenant, 1.0)
         return w if w > 0 else 1.0
+
+    def _class_weight(self, key: tuple) -> float:
+        """Weight of a ``(tenant, priority)`` DRR class: the
+        tenant's configured fair-share weight times the priority
+        prefill weight (interactive ahead of batch)."""
+        tenant, priority = key
+        return (self._tenant_weight(tenant) *
+                PRIORITY_PREFILL_WEIGHTS.get(priority, 1.0))
 
     def _run_prefill_row(self, row: int) -> int:
         """One prefill chunk for ``row``; returns the bucket tokens
@@ -1520,16 +1785,27 @@ class BatchingEngine:
             key=lambda i: self.slot_seq[i])
         if not rows:
             return False
-        by_tenant: Dict[str, List[int]] = {}
+        # The DRR class is (tenant, priority): priorities weight
+        # the split WITHIN the existing tenant fair-share machinery
+        # (PRIORITY_PREFILL_WEIGHTS puts interactive prefill ahead
+        # of batch), instead of bolting a second scheduler on top.
+        by_tenant: Dict[tuple, List[int]] = {}
         for i in rows:
-            by_tenant.setdefault(self.slot_req[i].tenant or '',
-                                 []).append(i)
-        tenants = sorted(by_tenant)
+            req_i = self.slot_req[i]
+            by_tenant.setdefault(
+                (req_i.tenant or '', req_i.priority),
+                []).append(i)
+        # Interactive classes ahead of batch ones for the same
+        # tenant; the rotation below still round-robins fairly
+        # across iterations.
+        tenants = sorted(by_tenant,
+                         key=lambda k: (k[0],
+                                        PRIORITIES.index(k[1])))
         metered = budget != float('inf')
         if metered:
-            total_w = sum(self._tenant_weight(t) for t in tenants)
+            total_w = sum(self._class_weight(t) for t in tenants)
             for t in tenants:
-                quantum = budget * self._tenant_weight(t) / total_w
+                quantum = budget * self._class_weight(t) / total_w
                 # Cap banked credit at two full budgets so a
                 # long-idle-then-bursty tenant cannot monopolize one
                 # iteration with accumulated deficit.
@@ -1988,9 +2264,79 @@ class BatchingEngine:
             self._metrics['tokens'].inc(emitted)
         return True
 
+    def _sweep_overload(self) -> None:
+        """Iteration-boundary enforcement of cancellation and
+        deadlines: a cancelled row frees its KV blocks through the
+        EXACT reclaim path preemption uses (``_release_row``) and
+        gets its sentinel; an expired row additionally gets the
+        typed ``DeadlineExceededError`` serve_model maps to 504.
+        The pending queue is swept under the same rules so queued
+        requests cannot outlive their client or their deadline."""
+        now = time.time()
+        cancel_ids = ()
+        if self._cancel_ids:
+            with self._pending_lock:
+                cancel_ids, self._cancel_ids = self._cancel_ids, \
+                    set()
+        for row in range(self.slots):
+            req = self.slot_req[row]
+            if req is None:
+                continue
+            if req.id in cancel_ids:
+                req.cancelled = True
+            if req.cancelled:
+                self.events.append(('cancel', row,
+                                    len(req.generated)))
+                self._metrics['cancelled'].inc()
+                self._release_row(row)
+                req.out.put(None)
+            elif req.deadline is not None and now >= req.deadline:
+                self.events.append(('deadline', row,
+                                    len(req.generated)))
+                self._metrics['deadline_exceeded'].inc()
+                self._release_row(row)
+                self._fail_request(
+                    req, 'deadline expired mid-decode',
+                    exc=exceptions.DeadlineExceededError(
+                        'deadline expired after '
+                        f'{len(req.generated)} generated tokens'))
+        dropped: List[_Request] = []
+        with self._pending_lock:
+            if self.pending:
+                kept: 'collections.deque[_Request]' = \
+                    collections.deque()
+                for req in self.pending:
+                    if req.id in cancel_ids:
+                        req.cancelled = True
+                    if req.cancelled or (
+                            req.deadline is not None
+                            and now >= req.deadline):
+                        dropped.append(req)
+                    else:
+                        kept.append(req)
+                if dropped:
+                    self.pending = kept
+                    self._queued_tokens = sum(
+                        self._queue_cost(r) for r in kept)
+        for req in dropped:
+            if req.cancelled:
+                self._metrics['cancelled'].inc()
+                req.out.put(None)
+            else:
+                self._metrics['deadline_exceeded'].inc()
+                self._fail_request(
+                    req, 'deadline expired while queued',
+                    exc=exceptions.DeadlineExceededError(
+                        'deadline expired while queued'))
+
     def _set_gauges(self) -> None:
         self._metrics['occupancy'].set(sum(
             1 for r in self.slot_req if r is not None))
+        with self._pending_lock:
+            queued_reqs = len(self.pending)
+            queued_toks = self._queued_tokens
+        self._metrics['queued_requests'].set(queued_reqs)
+        self._metrics['queued_tokens'].set(queued_toks)
         # used = REFERENCED blocks only; cached (refcount-0,
         # reclaimable) bytes are split out so a full-looking pool
         # that is mostly reusable cache reads as healthy
@@ -2139,6 +2485,15 @@ class BatchingEngine:
 
     def _loop_inner(self) -> None:
         while not self._stop:
+            if faults_lib.fire('serve.stall'):
+                # Chaos drill (docs/resilience.md): stall the
+                # scheduler iteration regardless of armed kind so
+                # in-flight deadlines can be driven to expiry
+                # deterministically — the sweep right below must
+                # then abort them typed and reclaim their blocks.
+                time.sleep(float(os.environ.get(
+                    'SKYTPU_SERVE_STALL_SECONDS', '1.0')))
+            self._sweep_overload()
             self._admit_pending()
             progressed = self._run_prefill_chunks()
             ran = self._dispatch_decode()

@@ -56,7 +56,10 @@ class SkyServeController:
             policy=load_balancer_lib.make_policy(
                 self.spec.load_balancing_policy),
             tls_keyfile=self.spec.tls_keyfile,
-            tls_certfile=self.spec.tls_certfile)
+            tls_certfile=self.spec.tls_certfile,
+            default_timeout_s=getattr(self.spec,
+                                      'overload_default_timeout_s',
+                                      None))
         # Scale on the LB's MEASURED windowed QPS; the drained
         # timestamps below stay as the fallback signal.
         self.autoscaler.set_qps_source(self.load_balancer.measured_qps)

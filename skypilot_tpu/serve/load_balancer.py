@@ -20,6 +20,7 @@ serves its own ``GET /metrics`` (reserved path, never proxied) and
 import collections
 import hashlib
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -31,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 from skypilot_tpu import metrics as metrics_lib
 from skypilot_tpu import tpu_logging
 from skypilot_tpu import trace as trace_lib
+from skypilot_tpu.serve import overload as overload_lib
 from skypilot_tpu.serve import prefix_hash
 
 logger = tpu_logging.init_logger(__name__)
@@ -281,7 +283,8 @@ class SkyServeLoadBalancer:
                  get_ready_endpoints: Callable[[], List[str]],
                  policy: Optional[LoadBalancingPolicy] = None,
                  tls_keyfile: Optional[str] = None,
-                 tls_certfile: Optional[str] = None):
+                 tls_certfile: Optional[str] = None,
+                 default_timeout_s: Optional[float] = None):
         self.port = port
         self.get_ready_endpoints = get_ready_endpoints
         self.policy = policy or LeastLoadPolicy()
@@ -289,6 +292,14 @@ class SkyServeLoadBalancer:
         # plain HTTP (reference sky/serve/service_spec.py:31 tls).
         self.tls_keyfile = tls_keyfile
         self.tls_certfile = tls_certfile
+        # Overload control (docs/resilience.md): deadline stamped on
+        # requests that carry none (service spec
+        # overload.default_timeout_s), and the upstream read timeout
+        # used when a request has NO deadline at all — previously a
+        # hardcoded 120 s.
+        self.default_timeout_s = default_timeout_s
+        self.upstream_timeout = float(os.environ.get(
+            'SKYTPU_LB_UPSTREAM_TIMEOUT_SECONDS', '120'))
         self.request_timestamps: List[float] = []
         self._ts_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
@@ -316,6 +327,13 @@ class SkyServeLoadBalancer:
             'Idempotent requests retried on an alternate replica '
             'after a replica fault (labeled by the FAILED replica).',
             ('endpoint',))
+        self._m_deadline_refused = reg.counter(
+            'skytpu_lb_deadline_refused_total',
+            'Requests answered 504 AT the LB because their '
+            'end-to-end deadline expired before any replica could '
+            'answer (never proxied / never retried) — client-'
+            'shaped, so deliberately outside the per-endpoint '
+            'request series the replica-5xx-rate page matches.')
         self._qps_window = metrics_lib.WindowedRate(QPS_WINDOW_SECONDS)
         # Per-endpoint in-flight request counts — the DRAIN signal
         # for rolling upgrades (docs/upgrades.md): a draining replica
@@ -598,8 +616,55 @@ class SkyServeLoadBalancer:
                     return
                 self._headers_sent = False
                 self._resp_status: Optional[int] = None
+                # End-to-end deadline, stamped AT THE LB from (in
+                # precedence order) the client's X-Skytpu-Deadline
+                # header, the JSON body's timeout_s field, or the
+                # service spec's overload.default_timeout_s —
+                # measured from request arrival on the monotonic
+                # clock. None = no deadline: the upstream hop then
+                # uses the SKYTPU_LB_UPSTREAM_TIMEOUT_SECONDS
+                # fallback.
+                budget_s = overload_lib.parse_timeout_s(
+                    self.headers.get(overload_lib.DEADLINE_HEADER))
+                if budget_s is None and data:
+                    try:
+                        budget_s = overload_lib.parse_timeout_s(
+                            json.loads(data).get('timeout_s'))
+                    except (ValueError, AttributeError):
+                        budget_s = None
+                if budget_s is None:
+                    budget_s = lb.default_timeout_s
+                deadline_mono = (t_start_mono + budget_s
+                                 if budget_s is not None else None)
                 tried = set()
                 while True:
+                    remaining = None
+                    if deadline_mono is not None:
+                        remaining = deadline_mono - time.monotonic()
+                        if remaining <= 0:
+                            # Expired before any replica answered
+                            # (brownout queueing or failover burn):
+                            # refuse 504 NOW instead of proxying
+                            # work nobody is waiting for. Dedicated
+                            # counter, not the per-endpoint request
+                            # series — client-shaped, and the
+                            # replica-5xx-rate page must not blame
+                            # a replica that never saw it.
+                            lb._m_deadline_refused.inc()  # pylint: disable=protected-access
+                            req_span.set_attr('code', '504')
+                            req_span.status = 'ERROR'
+                            lb._note_error_exemplar(req_span)  # pylint: disable=protected-access
+                            body = (b'Deadline exceeded before a '
+                                    b'replica could answer.')
+                            try:
+                                self.send_response(504)
+                                self.send_header('Content-Length',
+                                                 str(len(body)))
+                                self.end_headers()
+                                self.wfile.write(body)
+                            except OSError:
+                                pass  # client already gone
+                            return
                     # `current` pins this attempt's replica for the
                     # in-flight + latency accounting below;
                     # `endpoint` is reassigned on failover.
@@ -611,8 +676,18 @@ class SkyServeLoadBalancer:
                     for k, v in self.headers.items():
                         if k.lower() not in self._HOP_BY_HOP and \
                                 k.lower() != \
-                                trace_lib.TRACEPARENT_HEADER:
+                                trace_lib.TRACEPARENT_HEADER and \
+                                k.lower() != \
+                                overload_lib.DEADLINE_HEADER.lower():
                             req.add_header(k, v)
+                    if remaining is not None:
+                        # Decrement across the hop: forward the
+                        # REMAINING budget (seconds), re-anchored by
+                        # the replica on its own clock — absolute
+                        # deadlines would need LB/replica clock
+                        # agreement.
+                        req.add_header(overload_lib.DEADLINE_HEADER,
+                                       f'{remaining:.3f}')
                     # LB→replica hop: the replica adopts the request
                     # span's context (the client's own traceparent,
                     # if any, was already absorbed as lb.request's
@@ -632,7 +707,11 @@ class SkyServeLoadBalancer:
                     try:
                         try:
                             with urllib.request.urlopen(
-                                    req, timeout=120) as resp:
+                                    req,
+                                    timeout=(remaining
+                                             if remaining is not None
+                                             else lb.upstream_timeout)
+                            ) as resp:
                                 # Fold prefix-cache headers BEFORE
                                 # relaying the body: the stats are
                                 # complete once the replica's
@@ -721,18 +800,26 @@ class SkyServeLoadBalancer:
                             # yet: fail over to an alternate
                             # READY replica instead of surfacing
                             # one replica's death to the client.
+                            # ...and never when the request's
+                            # deadline already expired: the retry
+                            # would burn replica capacity on an
+                            # answer the client stopped waiting
+                            # for — surface the failure now.
                             if method == 'GET' and \
                                     len(tried) + 1 < \
-                                    MAX_PROXY_ATTEMPTS:
+                                    MAX_PROXY_ATTEMPTS and \
+                                    (deadline_mono is None or
+                                     time.monotonic() <
+                                     deadline_mono):
                                 tried.add(current)
-                                remaining = [
+                                candidates = [
                                     ep for ep in
                                     lb.get_ready_endpoints()
                                     if ep not in tried
                                 ]
-                                alt = (lb.policy.select(remaining,
+                                alt = (lb.policy.select(candidates,
                                                         key=key)
-                                       if remaining else None)
+                                       if candidates else None)
                                 if alt is not None:
                                     lb._m_failover.labels(  # pylint: disable=protected-access
                                         endpoint=current).inc()

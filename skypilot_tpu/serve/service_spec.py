@@ -38,6 +38,9 @@ class SkyServiceSpec:
         load_balancing_policy: Optional[str] = None,
         upgrade_drain_grace_seconds: Optional[float] = None,
         upgrade_soak_seconds: Optional[float] = None,
+        overload_default_timeout_s: Optional[float] = None,
+        overload_max_queued_requests: Optional[int] = None,
+        overload_max_queued_tokens: Optional[int] = None,
     ):
         if min_replicas < 0:
             raise exceptions.InvalidSpecError('min_replicas must be '
@@ -174,6 +177,36 @@ class SkyServiceSpec:
                 'upgrade.soak_seconds must be >= 0')
         self.upgrade_drain_grace_seconds = upgrade_drain_grace_seconds
         self.upgrade_soak_seconds = upgrade_soak_seconds
+        # Overload-control knobs (``overload:`` YAML section,
+        # docs/resilience.md Overload control):
+        # default_timeout_s is the end-to-end deadline stamped at the
+        # LB for requests that bring none of their own;
+        # max_queued_requests / max_queued_tokens bound the batching
+        # engine's pending queue — past either, submit() refuses
+        # typed (429 + Retry-After) instead of queueing unboundedly.
+        # None everywhere = today's behavior (no deadline, unbounded
+        # queue).
+        if overload_default_timeout_s is not None and \
+                overload_default_timeout_s <= 0:
+            raise exceptions.InvalidSpecError(
+                'overload.default_timeout_s must be > 0')
+        if overload_max_queued_requests is not None and (
+                not isinstance(overload_max_queued_requests, int) or
+                isinstance(overload_max_queued_requests, bool) or
+                overload_max_queued_requests < 1):
+            raise exceptions.InvalidSpecError(
+                'overload.max_queued_requests must be an integer '
+                '>= 1')
+        if overload_max_queued_tokens is not None and (
+                not isinstance(overload_max_queued_tokens, int) or
+                isinstance(overload_max_queued_tokens, bool) or
+                overload_max_queued_tokens < 1):
+            raise exceptions.InvalidSpecError(
+                'overload.max_queued_tokens must be an integer >= 1')
+        self.overload_default_timeout_s = overload_default_timeout_s
+        self.overload_max_queued_requests = \
+            overload_max_queued_requests
+        self.overload_max_queued_tokens = overload_max_queued_tokens
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]
@@ -193,6 +226,7 @@ class SkyServiceSpec:
         slo = dict(config.pop('slo', {}) or {})
         engine = dict(config.pop('engine', {}) or {})
         upgrade = dict(config.pop('upgrade', {}) or {})
+        overload = dict(config.pop('overload', {}) or {})
         lb_policy = config.pop('load_balancing_policy', None)
         if config:
             raise exceptions.InvalidSpecError(
@@ -232,6 +266,12 @@ class SkyServiceSpec:
             upgrade_drain_grace_seconds=upgrade.get(
                 'drain_grace_seconds'),
             upgrade_soak_seconds=upgrade.get('soak_seconds'),
+            overload_default_timeout_s=overload.get(
+                'default_timeout_s'),
+            overload_max_queued_requests=overload.get(
+                'max_queued_requests'),
+            overload_max_queued_tokens=overload.get(
+                'max_queued_tokens'),
         )
 
     def engine_env(self) -> Dict[str, str]:
@@ -257,6 +297,15 @@ class SkyServiceSpec:
                 '1' if self.engine_speculative else '0'
         if self.engine_draft_k is not None:
             env['SKYTPU_ENGINE_DRAFT_K'] = str(self.engine_draft_k)
+        if self.overload_max_queued_requests is not None:
+            env['SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_REQUESTS'] = \
+                str(self.overload_max_queued_requests)
+        if self.overload_max_queued_tokens is not None:
+            env['SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_TOKENS'] = \
+                str(self.overload_max_queued_tokens)
+        if self.overload_default_timeout_s is not None:
+            env['SKYTPU_ENGINE_OVERLOAD_DEFAULT_TIMEOUT_S'] = \
+                str(self.overload_default_timeout_s)
         return env
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -315,4 +364,16 @@ class SkyServiceSpec:
             upgrade['soak_seconds'] = self.upgrade_soak_seconds
         if upgrade:
             out['upgrade'] = upgrade
+        overload = {}
+        if self.overload_default_timeout_s is not None:
+            overload['default_timeout_s'] = \
+                self.overload_default_timeout_s
+        if self.overload_max_queued_requests is not None:
+            overload['max_queued_requests'] = \
+                self.overload_max_queued_requests
+        if self.overload_max_queued_tokens is not None:
+            overload['max_queued_tokens'] = \
+                self.overload_max_queued_tokens
+        if overload:
+            out['overload'] = overload
         return out

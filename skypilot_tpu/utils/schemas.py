@@ -180,6 +180,21 @@ SERVICE_SCHEMA = {
                 'soak_seconds': {'type': 'number', 'minimum': 0},
             },
         },
+        # Overload-control knobs (serve/batching.py admission +
+        # serve/load_balancer.py deadlines, docs/resilience.md
+        # Overload control).
+        'overload': {
+            'type': 'object',
+            'additionalProperties': False,
+            'properties': {
+                'default_timeout_s': {'type': 'number',
+                                      'exclusiveMinimum': 0},
+                'max_queued_requests': {'type': 'integer',
+                                        'minimum': 1},
+                'max_queued_tokens': {'type': 'integer',
+                                      'minimum': 1},
+            },
+        },
     },
 }
 

@@ -171,7 +171,8 @@ def faults():
     tests/test_resilience.py::TestFaultSiteContractLint):
     ``agent.run``, ``agent.health``, ``provision.launch``,
     ``serve.probe``, ``jobs.poll``, ``checkpoint.save``,
-    ``lifecycle.kill``, ``recovery.resize``. Reset around each test
+    ``lifecycle.kill``, ``recovery.resize``, ``serve.stall``.
+    Reset around each test
     by ``_isolated_state``; this fixture just hands the module out
     with a fixed seed."""
     from skypilot_tpu.resilience import faults as faults_lib

@@ -208,6 +208,14 @@ FIXTURES = {
     }, {'resilience.md':
         '# res\n## Fault injection\n| `real.site` | x |\n'
         '| `ghost.site` | x |\n\n## end\n'}),
+    'urlopen-without-timeout': ({
+        'client.py': '''
+            import urllib.request
+            def fetch(url):
+                with urllib.request.urlopen(url) as resp:
+                    return resp.read()
+        ''',
+    }, None),
     'suppression': ({
         'bare.py': '''
             import threading

@@ -1,0 +1,459 @@
+"""Overload control at the batching engine (serve/batching.py;
+docs/resilience.md, Overload control): end-to-end deadlines refuse
+and reap typed, cancellation frees KV at the next iteration
+boundary, bounded admission sheds typed 429s with a Retry-After
+estimate, and priority classes steer both shedding and
+pool-exhaustion preemption at batch-class requests first."""
+import time
+
+import jax
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.models import decode, llama
+from skypilot_tpu.serve import batching
+
+
+@pytest.fixture(scope='module')
+def setup():
+    config = llama.get_config('tiny')
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def _reference(params, config, prompt_ids, max_new):
+    import jax.numpy as jnp
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    out = decode.greedy_generate(params, prompt, config,
+                                 max_new_tokens=max_new, max_seq=64)
+    return [int(t) for t in out[0]]
+
+
+def _drain(q, timeout=120):
+    toks, err = [], None
+    while True:
+        t = q.get(timeout=timeout)
+        if t is None:
+            break
+        if isinstance(t, BaseException):
+            err = t
+            continue
+        toks.append(t)
+    return toks, err
+
+
+def _occupy_rows(engine, n, gen=56):
+    """Fill all ``n`` decode rows with long-running requests and
+    wait until they are admitted (pending empty), so later submits
+    QUEUE instead of admitting — the deterministic way to exercise
+    the bounded pending queue."""
+    qs = [engine.submit([90 + i, 91 + i], gen) for i in range(n)]
+    deadline = time.time() + 30
+    while engine.pending and time.time() < deadline:
+        time.sleep(0.005)
+    assert not engine.pending, 'row-fillers never admitted'
+    return qs
+
+
+class TestDeadlines:
+
+    def test_pre_expired_deadline_refused_typed(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            before = engine._metrics['deadline_exceeded'].value
+            q = engine.submit([1, 2, 3], 4,
+                              deadline=time.time() - 1.0)
+            toks, err = _drain(q, timeout=10)
+            assert toks == []
+            assert isinstance(err, exceptions.DeadlineExceededError)
+            assert engine._metrics['deadline_exceeded'].value == \
+                before + 1
+            # The engine is untouched: the refused request never
+            # held a row or blocks.
+            assert engine.pool.used_blocks == 0
+            assert engine.generate([5, 6], 4) == _reference(
+                params, config, [5, 6], 4)
+        finally:
+            engine.close()
+
+    def test_default_timeout_stamps_deadline(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2,
+                                         default_timeout_s=0.0001)
+        try:
+            # No explicit deadline: the engine default (0.1 ms) is
+            # stamped and expires almost immediately. Depending on
+            # loop timing it is refused at admission or reaped
+            # mid-decode — either way the stream must end typed
+            # long before a 60-token generation completes.
+            q = engine.submit([1, 2, 3], 60)
+            toks, err = _drain(q, timeout=30)
+            assert isinstance(err, exceptions.DeadlineExceededError)
+            assert len(toks) < 60
+        finally:
+            engine.close()
+
+    def test_mid_decode_expiry_reclaims_blocks(self, setup, faults,
+                                               monkeypatch):
+        """A stalled engine loop (the serve.stall brownout) blows an
+        admitted request's deadline; the sweep must fail it typed,
+        reclaim its blocks, and leave the engine serving."""
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            monkeypatch.setenv('SKYTPU_SERVE_STALL_SECONDS', '0.3')
+            q = engine.submit([1, 2, 3], 60,
+                              deadline=time.time() + 0.2)
+            faults.arm('serve.stall', 'timeout', 1.0)
+            toks, err = _drain(q, timeout=30)
+            assert isinstance(err, exceptions.DeadlineExceededError)
+            faults.reset(seed=0)
+            # Zero-leak: every block the dead request held is back.
+            deadline_wait = time.time() + 10
+            while engine.pool.used_blocks and \
+                    time.time() < deadline_wait:
+                time.sleep(0.02)
+            assert engine.pool.used_blocks == 0
+            assert engine._metrics['deadline_exceeded'].value >= 1
+            # The engine survived the drill.
+            assert engine.generate([5, 6], 4) == _reference(
+                params, config, [5, 6], 4)
+        finally:
+            faults.reset(seed=0)
+            engine.close()
+
+
+class TestCancellation:
+
+    def test_cancel_frees_blocks_and_keeps_neighbors_exact(
+            self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            want = _reference(params, config, [9, 8, 7], 24)
+            req = engine.submit_request([1, 2, 3], 60)
+            survivor_q = engine.submit([9, 8, 7], 24)
+            # Let the victim start decoding, then cancel it.
+            first = req.out.get(timeout=60)
+            assert not isinstance(first, BaseException)
+            engine.cancel(req.id)
+            toks, err = _drain(req.out, timeout=30)
+            assert err is None  # cancel is silent: sentinel only
+            assert len(toks) < 59  # it did NOT run to completion
+            # The survivor is token-exact despite the mid-flight
+            # cancel next to it.
+            out, err2 = _drain(survivor_q, timeout=120)
+            assert err2 is None
+            assert out == want
+            assert engine._metrics['cancelled'].value >= 1
+            # Zero-leak after both rows retire.
+            deadline_wait = time.time() + 10
+            while engine.pool.used_blocks and \
+                    time.time() < deadline_wait:
+                time.sleep(0.02)
+            assert engine.pool.used_blocks == 0
+        finally:
+            engine.close()
+
+    def test_cancel_queued_request_never_admits(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            fillers = _occupy_rows(engine, 2)
+            req = engine.submit_request([1, 2, 3], 8)
+            engine.cancel(req.id)
+            toks, err = _drain(req.out, timeout=30)
+            assert toks == [] and err is None
+            assert engine._metrics['cancelled'].value >= 1
+            for q in fillers:
+                _drain(q)
+        finally:
+            engine.close()
+
+
+class TestBoundedAdmission:
+
+    def test_queue_bound_sheds_typed_with_retry_after(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2,
+                                         max_queued_requests=2)
+        try:
+            fillers = _occupy_rows(engine, 2)
+            held = [engine.submit_request([i + 1, i + 2], 4)
+                    for i in range(2)]
+            shed = engine.submit_request([7, 8], 4)
+            toks, err = _drain(shed.out, timeout=10)
+            assert toks == []
+            assert isinstance(err, exceptions.EngineOverloadedError)
+            assert err.retry_after_s >= 1.0
+            assert engine._metrics['shed'].labels(
+                reason='max_queued_requests').value >= 1
+            # The queued requests drain token-exact once rows free.
+            for i, req in enumerate(held):
+                out, err2 = _drain(req.out, timeout=120)
+                assert err2 is None
+                assert out == _reference(params, config,
+                                         [i + 1, i + 2], 4)
+            for q in fillers:
+                _drain(q)
+        finally:
+            engine.close()
+
+    def test_token_bound_admits_into_empty_queue(self, setup):
+        """One oversized request must degrade to FIFO (admit when
+        the queue is empty), never be refused forever; a SECOND
+        queued request trips the token bound."""
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2,
+                                         max_queued_tokens=4)
+        try:
+            fillers = _occupy_rows(engine, 2)
+            big = engine.submit_request([1] * 16, 2)  # 16 > 4: queued
+            shed = engine.submit_request([2, 3], 2)
+            toks, err = _drain(shed.out, timeout=10)
+            assert toks == []
+            assert isinstance(err, exceptions.EngineOverloadedError)
+            assert engine._metrics['shed'].labels(
+                reason='max_queued_tokens').value >= 1
+            out, err2 = _drain(big.out, timeout=120)
+            assert err2 is None and len(out) == 2
+            for q in fillers:
+                _drain(q)
+        finally:
+            engine.close()
+
+
+class TestPriorities:
+
+    def test_invalid_priority_rejected(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            with pytest.raises(ValueError):
+                engine.submit([1, 2], 2, priority='best-effort')
+        finally:
+            engine.close()
+
+    def test_interactive_arrival_evicts_queued_batch(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2,
+                                         max_queued_requests=2)
+        try:
+            fillers = _occupy_rows(engine, 2)
+            batch_reqs = [
+                engine.submit_request([i + 1, i + 2], 4,
+                                      priority='batch')
+                for i in range(2)]
+            inter = engine.submit_request([7, 8], 4,
+                                          priority='interactive')
+            # The YOUNGEST queued batch request was evicted typed...
+            toks, err = _drain(batch_reqs[1].out, timeout=10)
+            assert toks == []
+            assert isinstance(err, exceptions.EngineOverloadedError)
+            assert engine._metrics['shed'].labels(
+                reason='priority_evict').value >= 1
+            # ...and the interactive one took its place.
+            out, err2 = _drain(inter.out, timeout=120)
+            assert err2 is None
+            assert out == _reference(params, config, [7, 8], 4)
+            out0, err0 = _drain(batch_reqs[0].out, timeout=120)
+            assert err0 is None
+            assert out0 == _reference(params, config, [1, 2], 4)
+            for q in fillers:
+                _drain(q)
+        finally:
+            engine.close()
+
+    def test_interactive_sheds_when_no_batch_queued(self, setup):
+        """An interactive arrival with no queued batch victim is
+        shed like anyone else — priority is not an unbounded
+        bypass."""
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2,
+                                         max_queued_requests=1)
+        try:
+            fillers = _occupy_rows(engine, 2)
+            engine.submit_request([1, 2], 4, priority='interactive')
+            shed = engine.submit_request([3, 4], 4,
+                                         priority='interactive')
+            toks, err = _drain(shed.out, timeout=10)
+            assert toks == []
+            assert isinstance(err, exceptions.EngineOverloadedError)
+            for q in fillers:
+                _drain(q)
+        finally:
+            engine.close()
+
+    def test_pool_preemption_completes_both_classes_exact(
+            self, setup):
+        """Pool-exhaustion preemption under mixed priorities:
+        whoever gets bumped (the batch row, per lowest-priority-
+        youngest) is requeued and recomputed — BOTH requests end
+        token-exact."""
+        config, params = setup
+        # A pool with room for ~3 blocks of 16 at max_seq 48: two
+        # growing rows collide mid-decode.
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=48, block_size=16,
+                                         num_blocks=4,
+                                         steps_per_dispatch=2,
+                                         prefix_caching=False,
+                                         speculative=False)
+        try:
+            import jax.numpy as jnp
+            want_b = [int(t) for t in decode.greedy_generate(
+                params, jnp.asarray([[1] * 14], jnp.int32), config,
+                max_new_tokens=24, max_seq=48)[0]]
+            want_i = [int(t) for t in decode.greedy_generate(
+                params, jnp.asarray([[2] * 14], jnp.int32), config,
+                max_new_tokens=24, max_seq=48)[0]]
+            batch_q = engine.submit([1] * 14, 24, priority='batch')
+            inter_q = engine.submit([2] * 14, 24,
+                                    priority='interactive')
+            out_b, err_b = _drain(batch_q, timeout=120)
+            out_i, err_i = _drain(inter_q, timeout=120)
+            assert err_i is None and err_b is None
+            assert out_i == want_i
+            assert out_b == want_b
+        finally:
+            engine.close()
+
+
+class TestStallDrillAlertWalk:
+    """The `serve.stall` chaos drill end to end: a browned-out
+    engine loop blows admitted deadlines typed (504 path), reclaims
+    their blocks, stays alive — and the resulting
+    `skytpu_batch_deadline_exceeded_total` increase walks the
+    fleet `deadline-miss-rate-high` rule pending→firing→resolved,
+    visible in `xsky alerts`."""
+
+    @pytest.mark.slow
+    def test_drill_drives_deadline_alert_walk(self, setup, faults,
+                                              monkeypatch):
+        from skypilot_tpu import metrics as metrics_lib
+        from skypilot_tpu.alerts import builtin as builtin_rules
+        from skypilot_tpu.alerts import engine as alert_engine_lib
+        from skypilot_tpu.metrics.exposition import parse_text
+        from skypilot_tpu.metrics.history import HistoryStore
+
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+        try:
+            pre = metrics_lib.render_text(metrics_lib.registry())
+            monkeypatch.setenv('SKYTPU_SERVE_STALL_SECONDS', '0.2')
+            faults.arm('serve.stall', 'timeout', 1.0)
+            qs = [engine.submit([i + 1, i + 2], 40,
+                                deadline=time.time() + 0.15)
+                  for i in range(2)]
+            for q in qs:
+                _, err = _drain(q, timeout=60)
+                assert isinstance(err,
+                                  exceptions.DeadlineExceededError)
+            faults.reset(seed=0)
+            # Blocks reclaimed, engine alive after the drill.
+            wait = time.time() + 10
+            while engine.pool.used_blocks and time.time() < wait:
+                time.sleep(0.02)
+            assert engine.pool.used_blocks == 0
+            assert engine.generate([5, 6], 4) == _reference(
+                params, config, [5, 6], 4)
+            # Push the counter past the rule threshold (> 0.5/s
+            # over its 300 s window needs > 150 misses) with cheap
+            # pre-expired refusals — the same counter, same typed
+            # error, no decode work.
+            for _ in range(170):
+                q = engine.submit([1, 2], 2,
+                                  deadline=time.time() - 1.0)
+                _, err = _drain(q, timeout=10)
+                assert isinstance(err,
+                                  exceptions.DeadlineExceededError)
+            post = metrics_lib.render_text(metrics_lib.registry())
+        finally:
+            faults.reset(seed=0)
+            engine.close()
+
+        # Alert walk over the REAL counter values the drill
+        # produced, on a synthetic clock (the rule needs 120 s of
+        # sustained rate — nobody waits that in a test).
+        t0 = time.time()
+        clock = {'t': t0}
+        store = HistoryStore('drill-overload')
+        rules = [r for r in builtin_rules.fleet_rules()
+                 if r.id == 'deadline-miss-rate-high']
+        assert rules, 'deadline-miss-rate-high left the fleet pack'
+        alert_engine = alert_engine_lib.AlertEngine(
+            store, rules, scope='drill-overload',
+            clock=lambda: clock['t'])
+        store.append(parse_text(pre), now=t0)
+        assert alert_engine.tick() == []
+        clock['t'] = t0 + 10
+        store.append(parse_text(post), now=clock['t'])
+        assert [e['state'] for e in alert_engine.tick()] == \
+            ['pending']
+        clock['t'] = t0 + 140  # past the 120 s hold
+        store.append(parse_text(post), now=clock['t'])
+        assert [e['state'] for e in alert_engine.tick()] == \
+            ['firing']
+        # The persisted firing state is what `xsky alerts` renders.
+        from click.testing import CliRunner
+        from skypilot_tpu import cli
+        result = CliRunner().invoke(cli.cli, ['alerts'])
+        assert result.exit_code == 0, result.output
+        assert 'deadline-miss-rate-high' in result.output
+        assert 'FIRING' in result.output
+        # Counter flat + old points age out of the window: resolved.
+        clock['t'] = t0 + 600
+        store.append(parse_text(post), now=clock['t'])
+        clock['t'] = t0 + 620
+        store.append(parse_text(post), now=clock['t'])
+        assert [e['state'] for e in alert_engine.tick()] == \
+            ['resolved']
+
+
+class TestCloseHang:
+
+    def test_wedged_loop_counts_and_logs(self, setup):
+        config, params = setup
+        engine = batching.BatchingEngine(params, config, slots=2,
+                                         max_seq=64,
+                                         steps_per_dispatch=2)
+
+        class _Wedged:
+            def join(self, timeout=None):
+                pass
+
+            def is_alive(self):
+                return True
+
+        before = engine._metrics['loop_hang'].value
+        real = engine.thread
+        engine.thread = _Wedged()
+        try:
+            engine.close()
+            assert engine._metrics['loop_hang'].value == before + 1
+        finally:
+            engine.thread = real
+            engine.close()
