@@ -246,7 +246,8 @@ def serve_batch_main() -> dict:
 
 def _open_loop_load(engine, prompts, gen: int,
                     interarrival_s: float,
-                    collect_tokens: bool = False) -> dict:
+                    collect_tokens: bool = False,
+                    adapters=None) -> dict:
     """Drive an OPEN-LOOP request schedule at the engine: request i
     is submitted at t0 + i * interarrival regardless of completions
     (closed-loop drivers hide queueing collapse — an overloaded
@@ -255,7 +256,9 @@ def _open_loop_load(engine, prompts, gen: int,
     SCHEDULED arrival (so admission queueing counts).
     ``collect_tokens`` additionally returns every request's token
     ids (``token_outputs``) so two arms over the same prompts can be
-    compared for exactness — not just counted."""
+    compared for exactness — not just counted. ``adapters`` is an
+    optional per-request LoRA adapter-id list (None entries = base
+    model) passed straight through to ``engine.submit``."""
     import threading
 
     n = len(prompts)
@@ -296,7 +299,8 @@ def _open_loop_load(engine, prompts, gen: int,
         now = time.perf_counter()
         if sched > now:
             time.sleep(sched - now)
-        q = engine.submit(prompt, gen)
+        q = engine.submit(prompt, gen,
+                          adapter=adapters[i] if adapters else None)
         th = threading.Thread(target=collect, args=(i, q, sched),
                               daemon=True)
         th.start()
@@ -825,6 +829,220 @@ def serve_spec_main() -> dict:
                 # >= ~0.95 proves the adaptive controller bounds
                 # the overhead on traffic drafting cannot help.
                 'out_tok_s_ratio': round(adv_ratio, 3),
+            },
+        },
+    }
+
+
+def serve_multilora_main() -> dict:
+    """BENCH_MODE=serve_multilora (``--bench serve_multilora``):
+    multi-tenant LoRA multiplexing (serve/adapters/) — N adapters
+    mixed freely within the decode batch vs a single-adapter
+    baseline on the SAME engine config at equal KV HBM. The stacked
+    per-row gather must make adapter DIVERSITY nearly free: headline
+    is the mixed arm's ``out_tok/s``, ``vs_baseline`` is
+    mixed/single (acceptance wants >= 0.9, i.e. within 10%). Before
+    timing, the mixed-batch outputs are asserted token-for-token
+    identical to each adapter's requests run ALONE on the same
+    engine — the subsystem's exactness contract (skipped under int8
+    KV, same chunk-caveat as serve_spec). A third, untimed phase
+    measures COLD-load admission: a fresh engine with no preload and
+    capacity < N serves one request per adapter, so every request
+    waits on an async host->device load (and the LRU must evict to
+    make room); p99 TTFT of that phase is the cold-load bar
+    (``detail.cold.p99_ttft_ms``).
+
+    Env: BENCH_ML_MODEL (default tiny), BENCH_ML_VOCAB,
+    BENCH_ML_ADAPTERS (N, default 8), BENCH_ML_RANK (even adapters;
+    odd ones get 2x, exercising rank bucketing), BENCH_ML_REQUESTS
+    (per adapter), BENCH_ML_PROMPT, BENCH_ML_GEN, BENCH_ML_ROWS,
+    BENCH_ML_RATE (open-loop req/s), BENCH_ML_SEED, BENCH_KV_INT8.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.checkpoint.native import NativeCheckpointManager
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.adapters import AdapterRegistry
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_ML_MODEL', 'tiny')
+    vocab = int(os.environ.get('BENCH_ML_VOCAB', '97'))
+    n_adapters = int(os.environ.get('BENCH_ML_ADAPTERS', '8'))
+    base_rank = int(os.environ.get('BENCH_ML_RANK', '4'))
+    per_adapter = int(os.environ.get('BENCH_ML_REQUESTS', '2'))
+    prompt_len = int(os.environ.get('BENCH_ML_PROMPT', '32'))
+    gen = int(os.environ.get('BENCH_ML_GEN', '48'))
+    rows = int(os.environ.get('BENCH_ML_ROWS', '8'))
+    rate = float(os.environ.get('BENCH_ML_RATE', '100'))
+    seed = int(os.environ.get('BENCH_ML_SEED', '0'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+
+    config = llama.get_config(model_name)
+    if vocab:
+        config = dataclasses.replace(config, vocab_size=vocab)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+    wq = params['layers']['wq']
+    wv = params['layers']['wv']
+    if isinstance(wq, dict):
+        wq, wv = wq['q'], wv['q']
+    num_layers, dim = int(wq.shape[0]), int(wq.shape[1])
+    q_out, v_out = int(wq.shape[2]), int(wv.shape[2])
+
+    rng = np.random.default_rng(seed)
+    adapter_dir = tempfile.mkdtemp(prefix='bench_multilora_')
+    adapter_ids = [f'tenant-{i}' for i in range(n_adapters)]
+    for i, aid in enumerate(adapter_ids):
+        # Odd tenants double the rank: the bench exercises the
+        # rank-bucket zero-padding path, not just one shape.
+        rank = base_rank * (2 if i % 2 else 1)
+        factors = {}
+        for name, out in (('wq', q_out), ('wv', v_out)):
+            factors[f'{name}_a'] = rng.standard_normal(
+                (num_layers, dim, rank)).astype(np.float32) * 0.02
+            factors[f'{name}_b'] = rng.standard_normal(
+                (num_layers, rank, out)).astype(np.float32) * 0.02
+        mgr = NativeCheckpointManager(
+            os.path.join(adapter_dir, aid), process_index=0,
+            process_count=1)
+        mgr.save(1, {'lora': factors})
+        mgr.wait()
+    registry = AdapterRegistry(base_dir=adapter_dir)
+
+    n_requests = n_adapters * per_adapter
+    prompts = [rng.integers(1, config.vocab_size,
+                            size=prompt_len).tolist()
+               for _ in range(n_requests)]
+    # Round-robin assignment: every dispatch mixes adapters.
+    mixed = [adapter_ids[i % n_adapters] for i in range(n_requests)]
+    single = [adapter_ids[0]] * n_requests
+
+    def make_engine(capacity, preload):
+        # Identical knobs both arms — same KV pool, same
+        # executables; ONLY the per-request adapter list differs.
+        return BatchingEngine(
+            params, config, slots=rows, max_seq=max_seq,
+            steps_per_dispatch=8, kv_int8=kv_int8, block_size=block,
+            prefill_chunk=64, max_num_batched_tokens=512,
+            adapter_registry=registry, adapter_capacity=capacity,
+            adapter_preload=preload)
+
+    warm_prompt = rng.integers(1, config.vocab_size,
+                               size=prompt_len).tolist()
+
+    def warm(engine, adapter=None):
+        # Pay prefill-bucket/decode/verify compiles OUTSIDE the
+        # timed window (a disjoint prompt, so no cache smuggling);
+        # the adapter args are traced, so one warm run covers every
+        # resident-set state.
+        q = engine.submit(warm_prompt, 4, adapter=adapter)
+        while True:
+            tok = q.get()
+            if tok is None:
+                break
+            if isinstance(tok, BaseException):
+                raise tok
+
+    try:
+        # -- exactness: mixed batch == each adapter alone ----------
+        engine = make_engine(n_adapters, adapter_ids)
+        try:
+            warm(engine, adapter_ids[0])
+            mixed_out = _open_loop_load(engine, prompts, gen,
+                                        1.0 / rate,
+                                        collect_tokens=True,
+                                        adapters=mixed)
+            for i, prompt in enumerate(prompts):
+                alone = []
+                q = engine.submit(prompt, gen, adapter=mixed[i])
+                while True:
+                    tok = q.get()
+                    if tok is None:
+                        break
+                    if isinstance(tok, BaseException):
+                        raise tok
+                    alone.append(int(tok))
+                if not kv_int8 and \
+                        alone != mixed_out['token_outputs'][i]:
+                    raise RuntimeError(
+                        f'mixed-adapter output diverged from solo '
+                        f'on request {i} ({mixed[i]}): '
+                        f'{mixed_out["token_outputs"][i]} != '
+                        f'{alone}')
+        finally:
+            engine.close()
+        mixed_out.pop('token_outputs')
+        mixed_out['arm'] = 'mixed'
+
+        # -- timed single-adapter baseline, equal KV HBM -----------
+        engine = make_engine(n_adapters, [adapter_ids[0]])
+        try:
+            warm(engine, adapter_ids[0])
+            base_out = _open_loop_load(engine, prompts, gen,
+                                       1.0 / rate, adapters=single)
+        finally:
+            engine.close()
+        base_out['arm'] = 'single_adapter'
+
+        # -- cold-load admission: no preload, forced eviction ------
+        cold_capacity = max(2, n_adapters // 2)
+        engine = make_engine(cold_capacity, None)
+        try:
+            # Base-model warm only: the compiles are paid, but every
+            # adapter load in the timed phase is genuinely cold.
+            warm(engine)
+            cold_out = _open_loop_load(
+                engine, prompts[:n_adapters], gen, 1.0 / rate,
+                adapters=adapter_ids)
+        finally:
+            engine.close()
+        cold_out['arm'] = 'cold'
+    finally:
+        shutil.rmtree(adapter_dir, ignore_errors=True)
+
+    ratio = (mixed_out['tokens_per_sec'] /
+             max(base_out['tokens_per_sec'], 1e-9))
+    return {
+        'metric': f'{model_name}_serve_multilora_out_tok_s',
+        'value': mixed_out['tokens_per_sec'],
+        'unit': 'tokens/s',
+        # vs_baseline: mixed/single out_tok/s (>= 0.9 = adapter
+        # diversity costs under 10%).
+        'vs_baseline': round(ratio, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'proxy_vocab': vocab or config.vocab_size,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'adapters': n_adapters,
+            'ranks': sorted({base_rank * (2 if i % 2 else 1)
+                             for i in range(n_adapters)}),
+            'requests': n_requests,
+            'prompt_len': prompt_len,
+            'generated_per_request': gen,
+            'decode_rows': rows,
+            'arrival_rate_req_s': rate,
+            'seed': seed,
+            'max_seq': max_seq,
+            'outputs_token_exact': (
+                True if not kv_int8
+                else 'skipped-int8-chunk-caveat'),
+            'mixed': mixed_out,
+            'single_adapter': base_out,
+            'out_tok_s_ratio': round(ratio, 3),
+            'cold': {
+                'capacity': cold_capacity,
+                'p99_ttft_ms': cold_out['p99_ttft_ms'],
+                **cold_out,
             },
         },
     }
@@ -1980,7 +2198,8 @@ if __name__ == '__main__':
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
                      'serve_continuous', 'serve_prefix',
-                     'serve_spec', 'serve_overload', 'launch',
+                     'serve_spec', 'serve_multilora',
+                     'serve_overload', 'launch',
                      'checkpoint', 'elastic')
             if idx + 1 >= len(sys.argv) or \
                     sys.argv[idx + 1] not in known:
@@ -2002,6 +2221,8 @@ if __name__ == '__main__':
             bench_result = serve_prefix_main()
         elif mode == 'serve_spec':
             bench_result = serve_spec_main()
+        elif mode == 'serve_multilora':
+            bench_result = serve_multilora_main()
         elif mode == 'serve_overload':
             bench_result = serve_overload_main()
         elif mode == 'launch':

@@ -201,6 +201,23 @@ def fleet_rules() -> List[AlertRule]:
                     'end-to-end deadlines (504s) — the engine is '
                     'too slow for the offered load or the timeout '
                     'budgets are too tight.'),
+        # Multi-tenant LoRA (serve/adapters/): a sustained eviction
+        # rate means the device-resident adapter set keeps churning
+        # — the live adapter working set is larger than
+        # engine.adapters.capacity, so requests keep paying cold
+        # loads for adapters that were just evicted (TTFT tail
+        # inflation, host-storage read amplification). Raise
+        # capacity or route the long tail elsewhere.
+        AlertRule(
+            id='adapter-thrash', kind='rate',
+            metric='skytpu_batch_adapter_evictions_total',
+            threshold=0.2, op='>', window=300.0, for_seconds=120.0,
+            summary='The engine keeps evicting resident LoRA '
+                    'adapters to admit others — the adapter working '
+                    'set exceeds engine.adapters.capacity and '
+                    'requests keep paying repeat cold loads. Raise '
+                    'capacity or split the adapter mix across '
+                    'services.'),
         AlertRule(
             id='agent-scrape-stale', kind='absent',
             metric='skytpu_agent_uptime_seconds',

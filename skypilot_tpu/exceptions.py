@@ -167,6 +167,37 @@ class EngineOverloadedError(SkyTpuError):
         self.retry_after_s = retry_after_s
 
 
+class AdapterError(SkyTpuError):
+    """Base for adapter-serving (multi-tenant LoRA) failures —
+    serve/adapters/. Subclasses are the typed refusals the HTTP
+    surface maps to status codes; transient conditions (resident set
+    momentarily full of pinned adapters, cold load in flight) are
+    never errors — they hold the request in the pending queue."""
+
+
+class AdapterNotFoundError(AdapterError):
+    """A request named an adapter id the registry cannot resolve —
+    no lineage dir, or a dir with no committed checkpoint. Raised at
+    ``submit()`` time so the caller learns before queueing; the HTTP
+    surface maps this to 404 (the id is client-supplied)."""
+
+
+class AdapterCapacityError(AdapterError):
+    """An adapter can NEVER be served by this engine: the engine has
+    no adapter support (capacity 0), or the adapter's rank exceeds
+    the engine's rank bucket (the stacked device buffers are sized
+    once, at engine construction). Permanent for this engine config,
+    so a typed refusal (HTTP 413) — unlike a full-but-drainable
+    resident set, which is transient queueing, not an error."""
+
+
+class AdapterManifestError(AdapterError):
+    """An adapter checkpoint's manifest is unusable: missing the
+    ``lora/*`` leaves, inconsistent A/B shapes, or an unreadable
+    manifest. Registry-side validation — raised when the adapter is
+    registered or first resolved, never from the decode path."""
+
+
 class KVBlockError(SkyTpuError, ValueError):
     """Invalid paged-KV block-pool operation.
 

@@ -165,6 +165,12 @@ def _host_rows(families) -> List[Dict[str, Any]]:
         combine='sum')
     put('skytpu_batch_spec_accepted_total', 'spec_accepted',
         combine='sum')
+    # Multi-tenant LoRA multiplexing (serve/adapters/): device-
+    # resident adapters vs slot capacity — the ADAPTERS column.
+    put('skytpu_batch_adapters_resident', 'adapters_resident',
+        combine='sum')
+    put('skytpu_batch_adapters_capacity', 'adapters_capacity',
+        combine='sum')
     return [dict(row, host=host)
             for host, row in sorted(hosts.items())]
 
@@ -296,6 +302,18 @@ def snapshot(cluster_names: Optional[List[str]] = None,
                     fams, 'skytpu_lb_prefix_block_misses_total'))
                 if hits + misses > 0:
                     row['prefix_hit_ratio'] = hits / (hits + misses)
+                # Adapter warm-hit rate across endpoints (the LB's
+                # adapter counters, fed by replica response
+                # headers): requests served by a resident adapter
+                # vs those that waited on a cold load — None until
+                # any adapter-tagged request completes.
+                a_hits = sum(s.value for s in _samples(
+                    fams, 'skytpu_lb_adapter_hits_total'))
+                a_loads = sum(s.value for s in _samples(
+                    fams, 'skytpu_lb_adapter_loads_total'))
+                if a_hits + a_loads > 0:
+                    row['adapter_hit_ratio'] = (
+                        a_hits / (a_hits + a_loads))
                 # Overload-control columns (docs/resilience.md):
                 # queue depth (the engine's pending-queue gauges)
                 # and shed rate. Present when the scrape carries
@@ -386,8 +404,8 @@ def render(snap: Dict[str, Any]) -> str:
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
                             'SERVE TOK/S', 'BLOCKS', 'PREEMPT',
-                            'PREFIX-HIT%', 'SPEC-ACC%', 'KV',
-                            'ALERTS'])
+                            'PREFIX-HIT%', 'SPEC-ACC%', 'ADAPTERS',
+                            'KV', 'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
         alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
@@ -397,7 +415,7 @@ def render(snap: Dict[str, Any]) -> str:
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
                            '-', '-', '-', '-', '-', '-', '-', '-',
-                           '-', '-', '-', alerts_cell])
+                           '-', '-', '-', '-', alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -440,6 +458,13 @@ def render(snap: Dict[str, Any]) -> str:
             if h.get('spec_proposed'):
                 spec = _fmt_ratio(h.get('spec_accepted', 0.0) /
                                   h['spec_proposed'])
+            # LoRA resident set: resident/capacity; '-' for engines
+            # serving no adapters (the gauges are only registered
+            # when multiplexing is on).
+            adapters = '-'
+            if h.get('adapters_capacity'):
+                adapters = (f'{h.get("adapters_resident", 0):.0f}/'
+                            f'{h["adapters_capacity"]:.0f}')
             table.add_row([
                 cluster['name'], h['host'], load, mem,
                 _fmt_num(h.get('procs'), '{:.0f}'), hbm,
@@ -449,7 +474,7 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
                 blocks,
                 _fmt_num(h.get('preemptions'), '{:.0f}'),
-                prefix, spec, kv, alerts_cell,
+                prefix, spec, adapters, kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')
@@ -458,7 +483,7 @@ def render(snap: Dict[str, Any]) -> str:
         stable = ux_utils.Table(['SERVICE', 'STATUS', 'VERSION',
                                  'QPS', 'P50', 'P99', 'REQS', '5XX',
                                  'QUEUE', 'SHED/s', 'HIT%',
-                                 'ALERTS'])
+                                 'ADPT-HIT%', 'ALERTS'])
         for s in snap['services']:
             # Queue depth: 'reqs(tokens)' when the engine's
             # pending-queue gauges are visible in the scrape.
@@ -478,6 +503,7 @@ def render(snap: Dict[str, Any]) -> str:
                 queue,
                 _fmt_num(s.get('shed_per_s'), '{:.2f}'),
                 _fmt_ratio(s.get('prefix_hit_ratio')),
+                _fmt_ratio(s.get('adapter_hit_ratio')),
                 str(s.get('alerts_firing', 0) or '-'),
             ])
         out.append('')

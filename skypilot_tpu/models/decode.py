@@ -301,10 +301,30 @@ def forward_cached(params: Params, tokens: jax.Array,
                            k_scale=new_ks, v_scale=new_vs)
 
 
+def lora_gather_delta(h: jax.Array, a_slots: jax.Array,
+                      b_slots: jax.Array,
+                      adapter_idx: jax.Array) -> jax.Array:
+    """Per-row LoRA delta for mixed-adapter batches (the
+    S-LoRA/Punica gather, serve/adapters/): row ``b`` picks ITS
+    adapter's stacked factors by slot index and applies
+    ``(h @ A) @ B`` — one einsum pair serves every adapter in the
+    batch. ``h`` [B, T, d]; ``a_slots`` [C+1, d, R]; ``b_slots``
+    [C+1, R, out]; ``adapter_idx`` [B] int32, 0 = the reserved
+    all-zeros slot so base-model rows get a delta of exactly 0.
+    float32 accumulation, cast by the caller. Per-row math only — a
+    row's output is independent of its batch-mates, which is the
+    mixed-vs-alone exactness contract the adapter tests assert."""
+    a = a_slots[adapter_idx]                        # [B, d, R]
+    bm = b_slots[adapter_idx]                       # [B, R, out]
+    hf = h.astype(jnp.float32)
+    mid = jnp.einsum('btd,bdr->btr', hf, a)
+    return jnp.einsum('btr,bro->bto', mid, bm)
+
+
 def forward_paged(params: Params, tokens: jax.Array, pools,
                   block_row: jax.Array, start: jax.Array,
                   real_len: jax.Array, config: llama.LlamaConfig,
-                  block_size: int):
+                  block_size: int, adapters=None, adapter_idx=None):
     """One PREFILL CHUNK of one request, written directly into paged
     KV-pool blocks (serve/kv_pool.py) — the paged engine's
     copy-on-admit removal: no per-request staging cache, no
@@ -381,15 +401,26 @@ def forward_paged(params: Params, tokens: jax.Array, pools,
 
     def body(xc, scanned):
         if quantized:
-            lp, kc, vc, ks, vs = scanned
+            lp, kc, vc, ks, vs, ad = scanned
         else:
-            lp, kc, vc = scanned
+            lp, kc, vc, ad = scanned
             ks = vs = None
         h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
                             config.norm_offset)
         q = _mm(h, lp['wq'])
         k = _mm(h, lp['wk'])
         v = _mm(h, lp['wv'])
+        if ad is not None:
+            # Adapter attach mirrors the engine's decode/verify
+            # twins exactly (same helper, same q/v points) — prefill
+            # under adapter X must write the SAME KV the decode math
+            # implies, or prefix-cache hits would change outputs.
+            q = q + lora_gather_delta(
+                h, ad['wq_a'], ad['wq_b'],
+                adapter_idx).astype(q.dtype)
+            v = v + lora_gather_delta(
+                h, ad['wv_a'], ad['wv_b'],
+                adapter_idx).astype(v.dtype)
         if config.qkv_bias:
             q = q + lp['bq']
             k = k + lp['bk']
@@ -453,8 +484,8 @@ def forward_paged(params: Params, tokens: jax.Array, pools,
         return xc, ((k_rows[0], v_rows[0], ks_rows[0], vs_rows[0])
                     if quantized else (k_rows[0], v_rows[0]))
 
-    xs = ((cparams['layers'], kp, vp, ksp, vsp) if quantized
-          else (cparams['layers'], kp, vp))
+    xs = ((cparams['layers'], kp, vp, ksp, vsp, adapters) if quantized
+          else (cparams['layers'], kp, vp, adapters))
     x, rows = jax.lax.scan(body, x, xs)
     # Persist the chunk's rows with ONE scatter into the (donated)
     # flat pools.

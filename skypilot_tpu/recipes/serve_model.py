@@ -120,6 +120,32 @@ def main():
                              'typed with 504 '
                              '(overload.default_timeout_s; 0 = no '
                              'default deadline)')
+    # Multi-tenant LoRA multiplexing (serve/adapters/): one base
+    # model + per-tenant adapters sharing the batched engine. The
+    # service YAML's `engine.adapters:` section stamps these as
+    # SKYTPU_ENGINE_ADAPTER_* (SkyServiceSpec.engine_env).
+    parser.add_argument('--adapter-dir',
+                        default=os.environ.get(
+                            'SKYTPU_ENGINE_ADAPTER_DIR', ''),
+                        help='adapter registry base dir: every '
+                             'subdirectory holding a committed LoRA '
+                             'checkpoint is a servable adapter named '
+                             'by the subdirectory '
+                             '(engine.adapters.dir)')
+    parser.add_argument('--adapter-capacity', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_ADAPTER_CAPACITY', '0')),
+                        help='device-resident adapter slots (LRU '
+                             'with in-flight pinning; 0 disables '
+                             'adapter serving; '
+                             'engine.adapters.capacity)')
+    parser.add_argument('--preload-adapters',
+                        default=os.environ.get(
+                            'SKYTPU_ENGINE_ADAPTER_PRELOAD', ''),
+                        help='comma-separated adapter ids to load '
+                             'before readiness — their first '
+                             'requests pay no cold load '
+                             '(engine.adapters.preload)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -219,6 +245,15 @@ def main():
     engine = None
     if args.slots > 0:
         from skypilot_tpu.serve.batching import BatchingEngine
+        adapter_registry = None
+        if args.adapter_dir and args.adapter_capacity > 0:
+            from skypilot_tpu.serve.adapters import AdapterRegistry
+            adapter_registry = AdapterRegistry(
+                base_dir=args.adapter_dir)
+        preload = [a for a in
+                   (s.strip() for s in
+                    args.preload_adapters.split(','))
+                   if a] if args.preload_adapters else None
         engine = BatchingEngine(
             params, config, slots=args.slots, kv_int8=args.kv_int8,
             block_size=args.block_size,
@@ -229,7 +264,10 @@ def main():
             draft_k=args.draft_k,
             max_queued_requests=args.max_queued_requests or None,
             max_queued_tokens=args.max_queued_tokens or None,
-            default_timeout_s=args.default_timeout_s or None)
+            default_timeout_s=args.default_timeout_s or None,
+            adapter_registry=adapter_registry,
+            adapter_capacity=args.adapter_capacity,
+            adapter_preload=preload)
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the
@@ -333,6 +371,18 @@ def main():
             a replica fault and answers 500 so the 5xx alert sees
             it."""
             from skypilot_tpu import exceptions
+            if isinstance(err, exceptions.AdapterNotFoundError):
+                # Client named an adapter this replica cannot
+                # resolve: their error, not a replica fault.
+                self._json({'error': str(err)}, 404)
+                return
+            if isinstance(err, exceptions.AdapterCapacityError):
+                # This engine can NEVER serve the adapter (no
+                # adapter subsystem, or rank over the gather
+                # bucket) — same never-fits shape as the
+                # prompt-exceeds-pool 413.
+                self._json({'error': str(err)}, 413)
+                return
             if isinstance(err, exceptions.EngineOverloadedError):
                 retry_after = max(1, int(round(
                     getattr(err, 'retry_after_s', 1.0))))
@@ -353,12 +403,24 @@ def main():
             headers — the LB folds these into its per-endpoint
             block-hit-rate (serve/load_balancer.py)."""
             from skypilot_tpu.serve import prefix_hash
-            return {
+            headers = {
                 prefix_hash.PREFIX_HITS_HEADER:
                     str(req.prefix_hit_blocks),
                 prefix_hash.PREFIX_MISSES_HEADER:
                     str(req.prefix_miss_blocks),
             }
+            if req.adapter is not None:
+                # Adapter residency accounting: hit = the adapter
+                # was device-resident at admission; load = this
+                # request waited on a cold load. The LB folds these
+                # into its per-endpoint adapter hit rate, which its
+                # affinity policy is trying to maximize.
+                hit = req.adapter_hit is True
+                headers[prefix_hash.ADAPTER_HITS_HEADER] = \
+                    str(int(hit))
+                headers[prefix_hash.ADAPTER_LOADS_HEADER] = \
+                    str(int(not hit))
+            return headers
 
         def do_GET(self):  # noqa: N802
             if self.path == '/':
@@ -395,6 +457,12 @@ def main():
                 tenant = body.get('tenant')
                 if tenant is not None:
                     tenant = str(tenant)
+                # LoRA adapter to decode under (None = base model);
+                # resolved/validated by the engine, which answers
+                # unknown ids 404 and never-fits adapters 413.
+                adapter = body.get('adapter')
+                if adapter is not None:
+                    adapter = str(adapter)
                 # Priority class (overload control): shedding takes
                 # batch first, preemption takes lowest-priority-
                 # youngest, prefill weights interactive ahead.
@@ -435,14 +503,23 @@ def main():
                 self._generate_response(prompt_ids, max_new,
                                         temperature, top_p, seed,
                                         eos_id, stream, tenant,
-                                        deadline, priority)
+                                        deadline, priority, adapter)
 
         def _generate_response(self, prompt_ids, max_new, temperature,
                                top_p, seed, eos_id, stream,
                                tenant=None, deadline=None,
-                               priority='interactive'):
+                               priority='interactive', adapter=None):
             use_engine = (engine is not None and temperature is None
                           and top_p is None)
+            if adapter is not None and not use_engine:
+                # Adapter decode lives on the batched engine's
+                # gather path only — the serial/sampling path has
+                # no adapter math.
+                self._json({'error': 'adapter requests require the '
+                            'batching engine (--slots > 0) and '
+                            'greedy decoding (no temperature/'
+                            'top_p)'}, 400)
+                return
             if stream and use_engine:
                 # SSE: tokens leave as the engine produces them (per
                 # decode dispatch), so client TTFT is prefill-bound,
@@ -454,7 +531,8 @@ def main():
                                             eos_id=eos_id,
                                             tenant=tenant,
                                             deadline=deadline,
-                                            priority=priority)
+                                            priority=priority,
+                                            adapter=adapter)
                 q = req.out
                 # Hold the status line for the FIRST queue item:
                 # admission (which fills the prefix-cache stats the
@@ -534,7 +612,8 @@ def main():
                                             eos_id=eos_id,
                                             tenant=tenant,
                                             deadline=deadline,
-                                            priority=priority)
+                                            priority=priority,
+                                            adapter=adapter)
                 out = []
                 err = None
                 while True:

@@ -1,0 +1,25 @@
+"""Multi-tenant LoRA multiplexing (the S-LoRA/Punica serve shape):
+one base model plus a long tail of per-tenant adapters sharing one
+batched engine at near-base throughput.
+
+Two pieces:
+
+- :mod:`registry` — adapter id -> checkpoint lineage dir, manifest-
+  validated (rank, target modules) and content-hash versioned;
+- :mod:`resident` — the device-resident set: adapters stacked into
+  ``[capacity+1, ...]`` A/B buffers (slot 0 = the all-zeros "no
+  adapter" identity), LRU-evicted with refcount pinning so an
+  adapter with in-flight requests is never evicted, and async cold
+  loads that admit the waiting request once weights land.
+
+The decode-side gather (each batch row picking its adapter's A/B
+matrices by index INSIDE the jitted step) lives in
+``serve/batching.py`` / ``models/decode.py`` next to the math it
+extends; docs/architecture.md "Multi-tenant LoRA multiplexing" has
+the exactness contract.
+"""
+from skypilot_tpu.serve.adapters.registry import (AdapterRegistry,
+                                                  AdapterSpec)
+from skypilot_tpu.serve.adapters.resident import ResidentAdapterSet
+
+__all__ = ['AdapterRegistry', 'AdapterSpec', 'ResidentAdapterSet']

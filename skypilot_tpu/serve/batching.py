@@ -75,6 +75,7 @@ from skypilot_tpu.models import decode, llama
 from skypilot_tpu.models.quant import matmul as _mm
 from skypilot_tpu.resilience import faults as faults_lib
 from skypilot_tpu.serve import kv_pool as kv_pool_lib
+from skypilot_tpu.serve import prefix_hash
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -319,11 +320,18 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             (k_cache, v_cache, k_scale, v_scale), pos)
 
 
+# Row-gathered LoRA delta (serve/adapters/) — ONE implementation,
+# shared with the prefill path so all three jitted steps attach the
+# identical adapter math.
+_lora_gather_delta = decode.lora_gather_delta
+
+
 def decode_steps_paged(params: Params, tokens: jax.Array,
                        caches, block_tables: jax.Array,
                        pos: jax.Array, active: jax.Array,
                        config: llama.LlamaConfig,
-                       num_steps: int, block_size: int):
+                       num_steps: int, block_size: int,
+                       adapters=None, adapter_idx=None):
     """Block-table-indirected twin of ``decode_steps_rows`` with
     identical numerics: the per-row [S] slab is replaced by gathers
     and scatters through ``block_tables`` [B, MB] into the shared
@@ -338,6 +346,14 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
     Writes go through ``kv_pool.write_index`` — parked rows (inactive
     lanes) and overrun positions land in the scratch block, never in
     a block another request owns.
+
+    Multi-adapter serving (serve/adapters/): ``adapters`` is the
+    resident set's stacked factor dict (leaves ``[L, C+1, ...]``,
+    scanned with the layer stack) and ``adapter_idx`` [B] maps each
+    row to its slot; row-gathered LoRA deltas attach to the q and v
+    projections (``_lora_gather_delta``). ``adapters=None`` (a
+    distinct jit executable — None is an empty pytree) keeps the
+    adapterless math byte-identical to before.
 
     Returns (out_tokens [B, num_steps], caches, new_pos).
     """
@@ -371,14 +387,22 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
 
         def layer(carry_x, scanned):
             xc, cur_ = carry_x
-            # None scale leaves pass through lax.scan as empty
-            # pytrees — one unpack serves both cache dtypes.
-            lp, kc, vc, ks, vs = scanned
+            # None scale leaves (and a None adapter set) pass
+            # through lax.scan as empty pytrees — one unpack serves
+            # both cache dtypes and both adapter modes.
+            lp, kc, vc, ks, vs, ad = scanned
             h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
                                 config.norm_offset)
             q = _mm(h, lp['wq'])
             k = _mm(h, lp['wk'])
             v = _mm(h, lp['wv'])
+            if ad is not None:
+                q = q + _lora_gather_delta(
+                    h, ad['wq_a'], ad['wq_b'],
+                    adapter_idx).astype(q.dtype)
+                v = v + _lora_gather_delta(
+                    h, ad['wv_a'], ad['wv_b'],
+                    adapter_idx).astype(v.dtype)
             if config.qkv_bias:
                 q = q + lp['bq']
                 k = k + lp['bk']
@@ -425,7 +449,8 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
 
         (x, _), rows = jax.lax.scan(
             layer, (x, cur),
-            (cparams['layers'], kp_all, vp_all, ks_all, vs_all))
+            (cparams['layers'], kp_all, vp_all, ks_all, vs_all,
+             adapters))
         # Persist the new rows: one merged scatter per token into the
         # carried (donated) flat pools.
         kp_all = kp_all.at[:, widx].set(rows[0])
@@ -588,7 +613,8 @@ def verify_step_paged(params: Params, tokens: jax.Array,
                       caches, block_tables: jax.Array,
                       pos: jax.Array, n_real: jax.Array,
                       config: llama.LlamaConfig,
-                      width: int, block_size: int):
+                      width: int, block_size: int,
+                      adapters=None, adapter_idx=None):
     """Batched multi-token VERIFY forward — the speculative twin of
     ``decode_steps_paged``: instead of scanning ``num_steps`` single
     tokens, ONE forward carries ``width`` = draft_k + 1 query
@@ -650,12 +676,22 @@ def verify_step_paged(params: Params, tokens: jax.Array,
     wflat = widx.reshape(-1)
 
     def layer(xc, scanned):
-        lp, kc, vc, ks, vs = scanned
+        lp, kc, vc, ks, vs, ad = scanned
         h = llama._rms_norm(xc, lp['attn_norm'], config.norm_eps,
                             config.norm_offset)
         q = _mm(h, lp['wq'])
         k = _mm(h, lp['wk'])
         v = _mm(h, lp['wv'])
+        if ad is not None:
+            # Same row-gathered LoRA attach as the decode twin —
+            # verify MUST apply the identical delta or speculation
+            # would accept drafts against a different model.
+            q = q + _lora_gather_delta(
+                h, ad['wq_a'], ad['wq_b'],
+                adapter_idx).astype(q.dtype)
+            v = v + _lora_gather_delta(
+                h, ad['wv_a'], ad['wv_b'],
+                adapter_idx).astype(v.dtype)
         if config.qkv_bias:
             q = q + lp['bq']
             k = k + lp['bk']
@@ -705,7 +741,7 @@ def verify_step_paged(params: Params, tokens: jax.Array,
             else vs_rows.reshape(b * width, nkv))
 
     x, rows = jax.lax.scan(
-        layer, x, (cparams['layers'], kp, vp, ksp, vsp))
+        layer, x, (cparams['layers'], kp, vp, ksp, vsp, adapters))
     kp = kp.at[:, wflat].set(rows[0])
     vp = vp.at[:, wflat].set(rows[1])
     if quantized:
@@ -754,10 +790,21 @@ class _Request:
                  eos_id: Optional[int] = None,
                  tenant: Optional[str] = None,
                  deadline: Optional[float] = None,
-                 priority: str = 'interactive'):
+                 priority: str = 'interactive',
+                 adapter: Optional[str] = None):
         self.prompt_ids = prompt_ids
         self.max_new = max_new
         self.eos_id = eos_id
+        # Multi-tenant LoRA (serve/adapters/): the adapter this
+        # request decodes under (None = base model). ``adapter_hit``
+        # is filled at admission — True when the adapter was already
+        # device-resident (no cold load stood between submit and
+        # admission), False when this request waited on a cold load;
+        # None for base-model requests. serve_model surfaces it as
+        # the X-Skytpu-Adapter-* response headers the LB folds into
+        # its per-endpoint adapter hit rate.
+        self.adapter = adapter
+        self.adapter_hit: Optional[bool] = None
         # Fair-share QoS key (None = the default tenant): the
         # admission loop splits the per-iteration prefill token
         # budget by weighted deficit round-robin over this field.
@@ -930,6 +977,43 @@ def _engine_metrics():
     }
 
 
+def _adapter_metrics():
+    """Adapter-serving metric families (serve/adapters/), registered
+    ONLY by engines built with an adapter registry — an engine
+    serving no adapters must not export fake zero series (the
+    hit-ratio-gauge precedent in _engine_metrics)."""
+    reg = metrics_lib.registry()
+    return {
+        'resident': reg.gauge(
+            'skytpu_batch_adapters_resident',
+            'LoRA adapters currently device-loaded in the stacked '
+            'gather buffers (slot 0, the base-model identity, not '
+            'counted).'),
+        'capacity': reg.gauge(
+            'skytpu_batch_adapters_capacity',
+            'Adapter slots in the stacked gather buffers (fixed at '
+            'engine build; resident == capacity means the next cold '
+            'load must evict).'),
+        'loads': reg.counter(
+            'skytpu_batch_adapter_loads_total',
+            'Adapter cold loads completed and installed into a '
+            'device slot (each one had requests waiting on it or '
+            'was an operator preload).'),
+        'evictions': reg.counter(
+            'skytpu_batch_adapter_evictions_total',
+            'Resident adapters evicted (LRU over refcount-0 '
+            'adapters only — a pinned, in-flight adapter is never '
+            'evicted) to make room for a cold load. A high rate '
+            'with a steady working set is thrash: capacity is too '
+            'small for the adapter mix (the adapter-thrash alert).'),
+        'load_seconds': reg.histogram(
+            'skytpu_batch_adapter_load_seconds',
+            'Cold-load wall time: ensure_loading kick to device '
+            'install — the latency a cold-adapter request pays on '
+            'top of normal queueing (its TTFT floor).'),
+    }
+
+
 class BatchingEngine:
     """Paged-KV continuous batching around ``decode_steps_paged``.
 
@@ -997,7 +1081,11 @@ class BatchingEngine:
                  tenant_weights: Optional[Dict[str, float]] = None,
                  max_queued_requests: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
-                 default_timeout_s: Optional[float] = None):
+                 default_timeout_s: Optional[float] = None,
+                 adapter_registry=None,
+                 adapter_capacity: int = 0,
+                 adapter_rank_bucket: int = 16,
+                 adapter_preload: Optional[List[str]] = None):
         self.params = params
         self.config = config
         self.slots = slots
@@ -1109,6 +1197,36 @@ class BatchingEngine:
         self.pending: 'collections.deque[_Request]' = \
             collections.deque()
         self._pending_lock = threading.Lock()
+        # Multi-tenant LoRA multiplexing (serve/adapters/): the
+        # device-resident adapter set, each row's CURRENT gather slot
+        # (0 = the all-zeros base-model identity), and the requests
+        # parked waiting for a cold load to land (engine-loop-only
+        # state — _poll_adapter_loads re-queues them the iteration
+        # their weights arrive).
+        self._adapters = None
+        self._adapter_metrics = None
+        self.slot_adapter = [0] * slots
+        self._adapter_wait: List[_Request] = []
+        if adapter_registry is not None and adapter_capacity > 0:
+            from skypilot_tpu.serve.adapters import ResidentAdapterSet
+            wq = params['layers']['wq']
+            wv = params['layers']['wv']
+            if isinstance(wq, dict):     # int8-quantized leaves
+                wq, wv = wq['q'], wv['q']
+            self._adapters = ResidentAdapterSet(
+                adapter_registry, adapter_capacity,
+                (wq.shape[0], wq.shape[1],
+                 wq.shape[2], wv.shape[2]),
+                rank_bucket=adapter_rank_bucket)
+            self._adapter_metrics = _adapter_metrics()
+            self._adapter_metrics['capacity'].set(adapter_capacity)
+            if adapter_preload:
+                # Synchronous, before the loop starts: a preload
+                # list names adapters the operator expects live at
+                # ready time — anything unusable raises HERE.
+                self._adapters.preload(adapter_preload)
+                self._adapter_metrics['loads'].inc(
+                    self._adapters.resident_count())
         # Overload control (docs/resilience.md, Overload control):
         # bounded admission + default deadline. _queued_tokens
         # mirrors the pending queue's token content (updated under
@@ -1165,7 +1283,8 @@ class BatchingEngine:
                 jnp.zeros((slots, self.draft_k + 1), jnp.int32),
                 self.caches, self.block_tables, self.pos,
                 jnp.zeros((slots,), jnp.int32), self.config,
-                self.draft_k + 1, self.block_size)
+                self.draft_k + 1, self.block_size,
+                *self._adapter_args())
         self._metrics = _engine_metrics()
         # Lazily created on first real traffic (MFU-gauge precedent):
         # an engine with caching off must not export a fake 0 ratio.
@@ -1185,7 +1304,8 @@ class BatchingEngine:
                eos_id: Optional[int] = None,
                tenant: Optional[str] = None,
                deadline: Optional[float] = None,
-               priority: str = 'interactive') -> 'queue.Queue':
+               priority: str = 'interactive',
+               adapter: Optional[str] = None) -> 'queue.Queue':
         """Returns a Queue yielding generated ids then None. With
         ``eos_id``, the row retires the moment it emits that id
         (the EOS itself is emitted, matching greedy_generate). A
@@ -1196,13 +1316,15 @@ class BatchingEngine:
         return self.submit_request(prompt_ids, max_new,
                                    eos_id=eos_id, tenant=tenant,
                                    deadline=deadline,
-                                   priority=priority).out
+                                   priority=priority,
+                                   adapter=adapter).out
 
     def submit_request(self, prompt_ids: List[int], max_new: int,
                        eos_id: Optional[int] = None,
                        tenant: Optional[str] = None,
                        deadline: Optional[float] = None,
-                       priority: str = 'interactive') -> _Request:
+                       priority: str = 'interactive',
+                       adapter: Optional[str] = None) -> _Request:
         """``submit`` returning the request object itself: ``.out``
         is the token queue, ``.id`` is the handle ``cancel()``
         takes, and after admission (i.e. by the first token)
@@ -1219,7 +1341,27 @@ class BatchingEngine:
                       self.max_seq - len(prompt_ids) - 1)
         req = _Request(list(prompt_ids), max(0, max_new),
                        eos_id=eos_id, tenant=tenant,
-                       deadline=deadline, priority=priority)
+                       deadline=deadline, priority=priority,
+                       adapter=adapter)
+        if adapter is not None:
+            # Typed refusal at submit for adapters this engine can
+            # NEVER serve: no adapter subsystem at all, an unknown
+            # id, or a rank over the gather bucket (serve_model maps
+            # AdapterNotFoundError to 404, AdapterCapacityError to
+            # 413). Residency is NOT required here — a known adapter
+            # cold-loads asynchronously and the request is admitted
+            # the iteration its weights land.
+            try:
+                if self._adapters is None:
+                    raise exceptions.AdapterCapacityError(
+                        'this engine serves no adapters (start it '
+                        'with an adapter registry and capacity >= 1 '
+                        'to serve LoRA requests)')
+                self._adapters.check_fits(adapter)
+            except exceptions.AdapterError as e:
+                self._fail_request(
+                    req, f'adapter {adapter!r} refused: {e}', exc=e)
+                return req
         if req.deadline is not None and time.time() >= req.deadline:
             # Already past its deadline at submit: refusing NOW is
             # strictly better than queueing work whose answer nobody
@@ -1370,6 +1512,21 @@ class BatchingEngine:
             self.pending.appendleft(req)
             self._queued_tokens += self._queue_cost(req)
 
+    def _adapter_args(self, idx: Optional[List[int]] = None) -> tuple:
+        """Trailing ``(adapters, adapter_idx)`` args for the jitted
+        decode/prefill/verify steps. EMPTY when adapter serving is
+        off — the calls then hit the ``adapters=None`` defaults and
+        the adapterless executables stay byte-identical to an engine
+        built without a registry (no gather, no numeric change).
+        ``idx`` defaults to the whole batch's per-row slots; prefill
+        passes its single row's ``[slot]``."""
+        if self._adapters is None:
+            return ()
+        if idx is None:
+            idx = self.slot_adapter
+        return (self._adapters.buffers(),
+                jnp.asarray(idx, jnp.int32))
+
     def _shed_reason(self, cost: int) -> Optional[str]:
         """Which admission bound a ``cost``-token arrival would
         trip (None = admit). Caller holds ``_pending_lock``. An
@@ -1435,6 +1592,16 @@ class BatchingEngine:
             jnp.asarray(padded, jnp.int32))
 
     def _release_row(self, row: int) -> None:
+        req = self.slot_req[row]
+        if self._adapters is not None and req is not None \
+                and req.adapter is not None \
+                and self.slot_adapter[row] != 0:
+            # Drop the admission-time pin: the last in-flight row of
+            # an adapter makes it evictable again (still resident —
+            # the warm end of the LRU, so repeat traffic re-pins it
+            # without a cold load).
+            self._adapters.unpin(req.adapter)
+        self.slot_adapter[row] = 0
         if self.slot_blocks[row]:
             # One decrement per held block — shared (pinned) prefix
             # blocks stay alive for their other holders. DEEPEST
@@ -1538,8 +1705,13 @@ class BatchingEngine:
             # changed) and recomputes.
             hashes = req.chain_hashes
         else:
-            hashes = kv_pool_lib.chain_hashes(tokens_all,
-                                              self.block_size)
+            # Adapter-salted root: KV content depends on the
+            # adapter (the v projection carries its LoRA delta), so
+            # per-adapter chains must never alias each other or the
+            # base model's (prefix_hash.adapter_root).
+            hashes = kv_pool_lib.chain_hashes(
+                tokens_all, self.block_size,
+                root=prefix_hash.adapter_root(req.adapter))
             req.chain_hashes = hashes
             req.chain_t0 = t0
         matched = self.pool.match(hashes)
@@ -1547,7 +1719,7 @@ class BatchingEngine:
         matched = matched[:max_reuse_blocks]
         cached_tokens = len(matched) * self.block_size
         parent = hashes[len(matched) - 1] if matched \
-            else kv_pool_lib.ROOT_HASH
+            else prefix_hash.adapter_root(req.adapter)
         cow = None
         rest = tokens_all[cached_tokens:
                           min(cached_tokens + self.block_size,
@@ -1567,6 +1739,69 @@ class BatchingEngine:
         if blocks:
             self.pool.free(list(reversed(blocks)))
         self._push_front(req)
+
+    def _poll_adapter_loads(self) -> None:
+        """Engine-loop tick for the adapter subsystem: install
+        completed cold loads into device slots, account
+        loads/evictions/latency, fail requests whose load failed
+        (typed), sweep cancelled/expired waiters, and re-queue the
+        requests whose adapter just became resident — at the FRONT,
+        preserving their order (they already waited once)."""
+        if self._adapters is None:
+            return
+        ready, evicted, durations = self._adapters.poll()
+        if ready:
+            self._adapter_metrics['loads'].inc(len(ready))
+            for s in durations:
+                self._adapter_metrics['load_seconds'].observe(s)
+            self.events.append(('adapter_load', tuple(ready)))
+        if evicted:
+            self._adapter_metrics['evictions'].inc(len(evicted))
+            self.events.append(('adapter_evict', tuple(evicted)))
+        if not self._adapter_wait:
+            return
+        now = time.time()
+        failures: Dict[str, BaseException] = {}
+        still_waiting: List[_Request] = []
+        admit: List[_Request] = []
+        for req in self._adapter_wait:
+            if req.cancelled:
+                self._metrics['cancelled'].inc()
+                req.out.put(None)
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._metrics['deadline_exceeded'].inc()
+                self._fail_request(
+                    req, 'deadline expired waiting for adapter '
+                    'cold load',
+                    exc=exceptions.DeadlineExceededError(
+                        'deadline expired waiting for adapter '
+                        f'{req.adapter!r} to load'))
+                continue
+            if req.adapter not in failures:
+                exc = self._adapters.take_failure(req.adapter)
+                if exc is not None:
+                    failures[req.adapter] = exc if isinstance(
+                        exc, exceptions.AdapterError) else \
+                        exceptions.AdapterError(
+                            f'adapter {req.adapter!r} failed to '
+                            f'load: {exc!r}')
+            if req.adapter in failures:
+                self._fail_request(
+                    req, f'adapter {req.adapter!r} cold load '
+                    'failed', exc=failures[req.adapter])
+                continue
+            if self._adapters.slot(req.adapter) is not None:
+                admit.append(req)
+            else:
+                # Not resident, not failed: either still loading or
+                # its parked install lost a slot race — re-kick
+                # (idempotent) and keep waiting.
+                self._adapters.ensure_loading(req.adapter)
+                still_waiting.append(req)
+        self._adapter_wait = still_waiting
+        for req in reversed(admit):
+            self._push_front(req)
 
     def _admit_pending(self) -> None:
         """Token-budget admission: a request is admitted when a
@@ -1601,6 +1836,18 @@ class BatchingEngine:
                     req, 'deadline expired before admission',
                     exc=exceptions.DeadlineExceededError(
                         'deadline expired before admission'))
+                continue
+            if req.adapter is not None and \
+                    self._adapters.slot(req.adapter) is None:
+                # Cold adapter: kick the async host load and park
+                # the request aside — admission (and everything
+                # behind it in the queue) keeps flowing while the
+                # weights stream in; _poll_adapter_loads re-queues
+                # it at the front the iteration they land.
+                if req.adapter_hit is None:
+                    req.adapter_hit = False
+                self._adapters.ensure_loading(req.adapter)
+                self._adapter_wait.append(req)
                 continue
             tokens_all = req.prompt_ids + req.generated
             t0 = len(tokens_all)
@@ -1675,6 +1922,21 @@ class BatchingEngine:
             # Drain-rate sample for the Retry-After estimate: every
             # admission (including re-admissions) moves the queue.
             self._admit_times.append(time.time())
+            if req.adapter is not None:
+                # Pin for the row's lifetime: a pinned adapter is
+                # never LRU-evicted, so the gather slot stays valid
+                # until _release_row unpins. No eviction can slip in
+                # between the residency check above and this pin —
+                # evictions only happen in _poll_adapter_loads /
+                # preload, on this same loop thread.
+                self.slot_adapter[row] = \
+                    self._adapters.pin(req.adapter)
+                if req.adapter_hit is None:
+                    # Never waited on a cold load: resident at
+                    # first admission.
+                    req.adapter_hit = True
+            else:
+                self.slot_adapter[row] = 0
             self.slot_req[row] = req
             self.slot_blocks[row] = blocks
             # Cache-hit tokens are ALREADY in the row's blocks —
@@ -1752,7 +2014,8 @@ class BatchingEngine:
             self.block_tables[row],
             jnp.asarray(off, jnp.int32),
             jnp.asarray(real, jnp.int32),
-            self.config, self.block_size)
+            self.config, self.block_size,
+            *self._adapter_args([self.slot_adapter[row]]))
         self.slot_off[row] = off + real
         self._prefill_chunks[row] += 1
         self.events.append(('prefill_chunk', row, off + real, t0))
@@ -1876,10 +2139,11 @@ class BatchingEngine:
             # does not grow between admission and prefill finish).
             hashes = req.chain_hashes
         else:
-            hashes = kv_pool_lib.chain_hashes(tokens_all,
-                                              self.block_size)
+            hashes = kv_pool_lib.chain_hashes(
+                tokens_all, self.block_size,
+                root=prefix_hash.adapter_root(req.adapter))
         blocks = self.slot_blocks[row]
-        parent = kv_pool_lib.ROOT_HASH
+        parent = prefix_hash.adapter_root(req.adapter)
         for i, h in enumerate(hashes):
             self.pool.register(
                 blocks[i], h, parent,
@@ -2098,7 +2362,7 @@ class BatchingEngine:
         toks, self.caches, self.pos = self._step_fn(
             self.params, self.tokens, self.caches,
             self.block_tables, self.pos, active, self.config, n,
-            self.block_size)
+            self.block_size, *self._adapter_args())
         self.tokens = toks[:, -1]
         for i in active_rows:
             if self.slot_left[i] > 0:
@@ -2193,7 +2457,7 @@ class BatchingEngine:
                 self.params, jnp.asarray(toks, jnp.int32),
                 self.caches, self.block_tables, self.pos,
                 jnp.asarray(n_real, jnp.int32), self.config, w,
-                self.block_size)
+                self.block_size, *self._adapter_args())
         host_preds, host_acc = jax.device_get((preds, accepted))
         dispatch_s = time.perf_counter() - t_dispatch
         t_chunk_end = time.time()
@@ -2300,6 +2564,12 @@ class BatchingEngine:
                     exc=exceptions.DeadlineExceededError(
                         'deadline expired after '
                         f'{len(req.generated)} generated tokens'))
+        # Requests parked waiting on an adapter cold load sit in
+        # neither a slot nor the pending queue — mark them here;
+        # _poll_adapter_loads (right after this sweep) drops them.
+        for req in self._adapter_wait:
+            if req.id in cancel_ids:
+                req.cancelled = True
         dropped: List[_Request] = []
         with self._pending_lock:
             if self.pending:
@@ -2348,6 +2618,9 @@ class BatchingEngine:
             self.pool.cached_blocks * self.pool.block_bytes)
         self._metrics['prefix_cached_blocks'].set(
             self.pool.cached_blocks)
+        if self._adapters is not None:
+            self._adapter_metrics['resident'].set(
+                self._adapters.resident_count())
         if self.prefix_caching:
             now = time.time()
             win = self._prefix_window
@@ -2464,6 +2737,11 @@ class BatchingEngine:
                     req.out.put(exc)
                 req.out.put(None)
                 self.slot_req[i] = None
+        waiting, self._adapter_wait = self._adapter_wait, []
+        for req in waiting:
+            if exc is not None:
+                req.out.put(exc)
+            req.out.put(None)
         while True:
             req = self._pop_pending()
             if req is None:
@@ -2494,6 +2772,7 @@ class BatchingEngine:
                 time.sleep(float(os.environ.get(
                     'SKYTPU_SERVE_STALL_SECONDS', '1.0')))
             self._sweep_overload()
+            self._poll_adapter_loads()
             self._admit_pending()
             progressed = self._run_prefill_chunks()
             ran = self._dispatch_decode()

@@ -61,31 +61,43 @@ ROUTING_PREFIX_BLOCKS = 4
 # them — re-exported here for the LB-side consumers.
 PREFIX_HITS_HEADER = prefix_hash.PREFIX_HITS_HEADER
 PREFIX_MISSES_HEADER = prefix_hash.PREFIX_MISSES_HEADER
+ADAPTER_HITS_HEADER = prefix_hash.ADAPTER_HITS_HEADER
+ADAPTER_LOADS_HEADER = prefix_hash.ADAPTER_LOADS_HEADER
 
 
 def request_prefix_key(body: Optional[bytes]) -> Optional[bytes]:
     """Routing key for a /generate-style JSON body: the chain hash
     of the prompt's leading complete routing blocks (capped at
-    ROUTING_PREFIX_BLOCKS). None for non-JSON bodies, missing or
-    too-short prompts — those route by least-load."""
+    ROUTING_PREFIX_BLOCKS), seeded by the request's adapter id —
+    the SAME (adapter, prefix) salting the replica's prefix cache
+    uses, so repeat (adapter, prefix) traffic lands where both its
+    KV blocks AND its adapter weights already live. An
+    adapter-carrying request whose prompt is too short for a block
+    still keys on the adapter alone (adapter affinity is worth a
+    cold load even without prefix reuse). None for non-JSON bodies
+    and short base-model prompts — those route by least-load."""
     if not body:
         return None
     try:
-        ids = json.loads(body).get('prompt_ids')
+        parsed = json.loads(body)
+        ids = parsed.get('prompt_ids')
+        adapter = parsed.get('adapter')
     except (ValueError, AttributeError):
         return None
+    root = prefix_hash.adapter_root(adapter) \
+        if isinstance(adapter, str) and adapter else prefix_hash.ROOT
     if not isinstance(ids, list):
-        return None
+        return root or None
     n_blocks = min(len(ids) // ROUTING_BLOCK_TOKENS,
                    ROUTING_PREFIX_BLOCKS)
     if n_blocks == 0:
-        return None
+        return root or None
     try:
         chain = prefix_hash.chain_hashes(
             ids[:n_blocks * ROUTING_BLOCK_TOKENS],
-            ROUTING_BLOCK_TOKENS)
+            ROUTING_BLOCK_TOKENS, root=root)
     except (TypeError, ValueError):
-        return None
+        return root or None
     return chain[-1]
 
 
@@ -365,6 +377,29 @@ class SkyServeLoadBalancer:
             'Cumulative per-endpoint block-hit-rate '
             '(hits / (hits + misses)).', ('endpoint',))
         self._prefix_totals: Dict[str, List[int]] = {}
+        # Per-endpoint adapter residency accounting, fed by the
+        # replicas' X-Skytpu-Adapter-* response headers (the same
+        # wire protocol and seqlock lifecycle as the prefix series
+        # above): the hit rate the (adapter, prefix)-salted
+        # affinity routing is trying to maximize — a low ratio
+        # under prefix_affinity means the adapter working set is
+        # being scattered or thrashed.
+        self._m_adapter_hits = reg.counter(
+            'skytpu_lb_adapter_hits_total',
+            'Adapter requests whose adapter was already '
+            'device-resident at the replica (no cold load), by '
+            'endpoint (from replica response headers).',
+            ('endpoint',))
+        self._m_adapter_loads = reg.counter(
+            'skytpu_lb_adapter_loads_total',
+            'Adapter requests that waited on a cold adapter load '
+            'at the replica, by endpoint (from replica response '
+            'headers).', ('endpoint',))
+        self._m_adapter_ratio = reg.gauge(
+            'skytpu_lb_adapter_hit_ratio',
+            'Cumulative per-endpoint adapter residency hit rate '
+            '(hits / (hits + loads)).', ('endpoint',))
+        self._adapter_totals: Dict[str, List[int]] = {}
         self._prefix_lock = threading.Lock()
         # Bumped by forget_endpoint under _prefix_lock: lets the
         # first-response create path in _note_prefix detect a forget
@@ -379,24 +414,32 @@ class SkyServeLoadBalancer:
             collections.deque(maxlen=16)
 
     def _note_prefix(self, endpoint: str, headers) -> None:
-        """Fold a replica response's prefix-cache headers into the
-        per-endpoint hit-rate exposition (absent headers — health
-        probes, non-engine replicas — are a no-op)."""
+        """Fold a replica response's prefix-cache AND adapter
+        residency headers into the per-endpoint hit-rate exposition
+        (absent headers — health probes, non-engine replicas,
+        base-model requests — are a no-op for their series)."""
         if headers is None:
             return
         raw_h = headers.get(PREFIX_HITS_HEADER)
         raw_m = headers.get(PREFIX_MISSES_HEADER)
-        if raw_h is None and raw_m is None:
+        raw_ah = headers.get(ADAPTER_HITS_HEADER)
+        raw_al = headers.get(ADAPTER_LOADS_HEADER)
+        if raw_h is None and raw_m is None and \
+                raw_ah is None and raw_al is None:
             return
         try:
             hits = int(raw_h or 0)
             misses = int(raw_m or 0)
+            a_hits = int(raw_ah or 0)
+            a_loads = int(raw_al or 0)
         except ValueError:
             return
-        if hits < 0 or misses < 0:
+        if hits < 0 or misses < 0 or a_hits < 0 or a_loads < 0:
             return
         if self._record_prefix(endpoint, hits, misses,
-                               create=False):
+                               create=False,
+                               adapter_hits=a_hits,
+                               adapter_loads=a_loads):
             return
         # First response from this endpoint: admit it only if it is
         # (still) ready. The ready-set read stays OUTSIDE
@@ -421,12 +464,16 @@ class SkyServeLoadBalancer:
                 # (series-removal contract).
                 return
             if self._record_prefix(endpoint, hits, misses,
-                                   create=True, only_if_gen=gen):
+                                   create=True, only_if_gen=gen,
+                                   adapter_hits=a_hits,
+                                   adapter_loads=a_loads):
                 return
 
     def _record_prefix(self, endpoint: str, hits: int, misses: int,
                        create: bool,
-                       only_if_gen: Optional[int] = None) -> bool:
+                       only_if_gen: Optional[int] = None,
+                       adapter_hits: int = 0,
+                       adapter_loads: int = 0) -> bool:
         """Fold one response's hit/miss counts into the endpoint's
         totals + series, atomically with forget_endpoint (same
         lock): a concurrent forget can't be resurrected by a
@@ -452,6 +499,23 @@ class SkyServeLoadBalancer:
             if denom:
                 self._m_prefix_ratio.labels(endpoint).set(
                     totals[0] / denom)
+            if adapter_hits or adapter_loads:
+                # Same entry lifecycle as the prefix totals (created
+                # under the same lock/generation, dropped together
+                # by forget_endpoint) — the ratio series can never
+                # outlive its endpoint.
+                a_tot = self._adapter_totals.setdefault(
+                    endpoint, [0, 0])
+                a_tot[0] += adapter_hits
+                a_tot[1] += adapter_loads
+                if adapter_hits:
+                    self._m_adapter_hits.labels(endpoint).inc(
+                        adapter_hits)
+                if adapter_loads:
+                    self._m_adapter_loads.labels(endpoint).inc(
+                        adapter_loads)
+                self._m_adapter_ratio.labels(endpoint).set(
+                    a_tot[0] / (a_tot[0] + a_tot[1]))
             return True
 
     def _note_error_exemplar(self, span) -> None:
@@ -511,6 +575,8 @@ class SkyServeLoadBalancer:
             self._prefix_forget_gen += 1
             self._prefix_totals.pop(endpoint, None)
             self._m_prefix_ratio.remove(endpoint)
+            self._adapter_totals.pop(endpoint, None)
+            self._m_adapter_ratio.remove(endpoint)
 
     def measured_qps(self) -> float:
         """MEASURED request rate over the trailing window — the

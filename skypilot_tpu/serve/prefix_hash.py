@@ -38,6 +38,29 @@ ROOT = b''
 PREFIX_HITS_HEADER = 'X-Skytpu-Prefix-Hits'
 PREFIX_MISSES_HEADER = 'X-Skytpu-Prefix-Misses'
 
+# Same wire protocol for the adapter-serving subsystem
+# (serve/adapters/): per-request resident-hit (the adapter was
+# already device-loaded at admission) vs cold-load accounting, folded
+# by the LB into its per-endpoint adapter hit rate.
+ADAPTER_HITS_HEADER = 'X-Skytpu-Adapter-Hits'
+ADAPTER_LOADS_HEADER = 'X-Skytpu-Adapter-Loads'
+
+
+def adapter_root(adapter_id) -> bytes:
+    """Chain seed for a request's prefix chain: ``ROOT`` for
+    base-model requests, an adapter-id digest otherwise.
+
+    KV content is adapter-dependent — the v projection carries the
+    adapter's LoRA delta, so a block prefilled under adapter X holds
+    DIFFERENT values than the same tokens under adapter Y (or the
+    base model). Salting the chain root keeps those blocks from ever
+    aliasing in the prefix cache, and gives the LB's affinity policy
+    a per-(adapter, prefix) routing key for free."""
+    if not adapter_id:
+        return ROOT
+    return hashlib.sha256(b'adapter:' +
+                          str(adapter_id).encode()).digest()
+
 
 def block_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
     """One chain link: commit ``tokens`` on top of ``parent``."""
@@ -47,12 +70,14 @@ def block_hash(parent: bytes, tokens: Sequence[int]) -> bytes:
 
 
 def chain_hashes(tokens: Sequence[int],
-                 block_size: int) -> List[bytes]:
+                 block_size: int,
+                 root: bytes = ROOT) -> List[bytes]:
     """Hash chain over the FULL blocks of ``tokens`` (the trailing
     partial block has no hash — only complete, immutable blocks are
-    ever shared)."""
+    ever shared). ``root`` seeds the chain — ``adapter_root`` for
+    adapter requests, so per-adapter KV never aliases."""
     out: List[bytes] = []
-    h = ROOT
+    h = root
     for i in range(len(tokens) // block_size):
         h = block_hash(h, tokens[i * block_size:(i + 1) * block_size])
         out.append(h)

@@ -1,6 +1,6 @@
 """Service spec: the ``service:`` YAML section (analog of
 ``sky/serve/service_spec.py``)."""
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 
@@ -35,6 +35,9 @@ class SkyServiceSpec:
         engine_prefix_caching: Optional[bool] = None,
         engine_speculative: Optional[bool] = None,
         engine_draft_k: Optional[int] = None,
+        engine_adapter_dir: Optional[str] = None,
+        engine_adapter_capacity: Optional[int] = None,
+        engine_adapter_preload: Optional[List[str]] = None,
         load_balancing_policy: Optional[str] = None,
         upgrade_drain_grace_seconds: Optional[float] = None,
         upgrade_soak_seconds: Optional[float] = None,
@@ -143,6 +146,55 @@ class SkyServiceSpec:
                 'engine.draft_k must be an integer >= 0')
         self.engine_speculative = engine_speculative
         self.engine_draft_k = engine_draft_k
+        # engine.adapters (dir / capacity / preload): multi-tenant
+        # LoRA multiplexing on the paged engine (serve/adapters/).
+        # ``dir`` is the adapter registry base dir (each
+        # subdirectory with a committed LoRA checkpoint is a
+        # servable adapter named by the subdirectory), ``capacity``
+        # the device-resident slot count (LRU + in-flight pinning),
+        # ``preload`` the ids loaded before readiness. None
+        # everywhere = adapter serving off.
+        if engine_adapter_dir is not None and (
+                not isinstance(engine_adapter_dir, str) or
+                not engine_adapter_dir):
+            raise exceptions.InvalidSpecError(
+                'engine.adapters.dir must be a non-empty string')
+        if engine_adapter_capacity is not None and (
+                not isinstance(engine_adapter_capacity, int) or
+                isinstance(engine_adapter_capacity, bool) or
+                engine_adapter_capacity < 1):
+            raise exceptions.InvalidSpecError(
+                'engine.adapters.capacity must be an integer >= 1')
+        if engine_adapter_preload is not None:
+            if (not isinstance(engine_adapter_preload, (list, tuple))
+                    or not all(isinstance(a, str) and a
+                               for a in engine_adapter_preload)):
+                raise exceptions.InvalidSpecError(
+                    'engine.adapters.preload must be a list of '
+                    'adapter-id strings')
+            if any(',' in a for a in engine_adapter_preload):
+                # The env stamp is comma-joined
+                # (SKYTPU_ENGINE_ADAPTER_PRELOAD) — an id with a
+                # comma would silently split into two bogus ids.
+                raise exceptions.InvalidSpecError(
+                    'engine.adapters.preload ids must not contain '
+                    'commas')
+            engine_adapter_preload = list(engine_adapter_preload)
+        if (engine_adapter_dir is None) != \
+                (engine_adapter_capacity is None):
+            raise exceptions.InvalidSpecError(
+                'engine.adapters needs BOTH dir and capacity (one '
+                'without the other serves nothing)')
+        if engine_adapter_preload and engine_adapter_capacity is not \
+                None and len(engine_adapter_preload) > \
+                engine_adapter_capacity:
+            raise exceptions.InvalidSpecError(
+                f'engine.adapters.preload lists '
+                f'{len(engine_adapter_preload)} adapters but '
+                f'capacity is {engine_adapter_capacity}')
+        self.engine_adapter_dir = engine_adapter_dir
+        self.engine_adapter_capacity = engine_adapter_capacity
+        self.engine_adapter_preload = engine_adapter_preload
         # LB policy knob (serve/load_balancer.py): least_load
         # (default), round_robin, or the KV-aware prefix_affinity
         # that concentrates repeat prefixes where their cached
@@ -225,6 +277,7 @@ class SkyServiceSpec:
         tls = dict(config.pop('tls', {}) or {})
         slo = dict(config.pop('slo', {}) or {})
         engine = dict(config.pop('engine', {}) or {})
+        adapters = dict(engine.get('adapters') or {})
         upgrade = dict(config.pop('upgrade', {}) or {})
         overload = dict(config.pop('overload', {}) or {})
         lb_policy = config.pop('load_balancing_policy', None)
@@ -262,6 +315,9 @@ class SkyServiceSpec:
             engine_prefix_caching=engine.get('prefix_caching'),
             engine_speculative=engine.get('speculative'),
             engine_draft_k=engine.get('draft_k'),
+            engine_adapter_dir=adapters.get('dir'),
+            engine_adapter_capacity=adapters.get('capacity'),
+            engine_adapter_preload=adapters.get('preload'),
             load_balancing_policy=lb_policy,
             upgrade_drain_grace_seconds=upgrade.get(
                 'drain_grace_seconds'),
@@ -297,6 +353,15 @@ class SkyServiceSpec:
                 '1' if self.engine_speculative else '0'
         if self.engine_draft_k is not None:
             env['SKYTPU_ENGINE_DRAFT_K'] = str(self.engine_draft_k)
+        if self.engine_adapter_dir is not None:
+            env['SKYTPU_ENGINE_ADAPTER_DIR'] = \
+                self.engine_adapter_dir
+        if self.engine_adapter_capacity is not None:
+            env['SKYTPU_ENGINE_ADAPTER_CAPACITY'] = \
+                str(self.engine_adapter_capacity)
+        if self.engine_adapter_preload:
+            env['SKYTPU_ENGINE_ADAPTER_PRELOAD'] = \
+                ','.join(self.engine_adapter_preload)
         if self.overload_max_queued_requests is not None:
             env['SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_REQUESTS'] = \
                 str(self.overload_max_queued_requests)
@@ -352,6 +417,15 @@ class SkyServiceSpec:
             engine['speculative'] = self.engine_speculative
         if self.engine_draft_k is not None:
             engine['draft_k'] = self.engine_draft_k
+        adapters = {}
+        if self.engine_adapter_dir is not None:
+            adapters['dir'] = self.engine_adapter_dir
+        if self.engine_adapter_capacity is not None:
+            adapters['capacity'] = self.engine_adapter_capacity
+        if self.engine_adapter_preload:
+            adapters['preload'] = list(self.engine_adapter_preload)
+        if adapters:
+            engine['adapters'] = adapters
         if engine:
             out['engine'] = engine
         if self.load_balancing_policy is not None:

@@ -162,6 +162,24 @@ SERVICE_SCHEMA = {
                 # multi-token verify; draft_k 0 == off.
                 'speculative': {'type': 'boolean'},
                 'draft_k': {'type': 'integer', 'minimum': 0},
+                # Multi-tenant LoRA multiplexing
+                # (serve/adapters/): registry base dir,
+                # device-resident slot count, and the ids loaded
+                # before readiness.
+                'adapters': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'dir': {'type': 'string', 'minLength': 1},
+                        'capacity': {'type': 'integer',
+                                     'minimum': 1},
+                        'preload': {
+                            'type': 'array',
+                            'items': {'type': 'string',
+                                      'minLength': 1},
+                        },
+                    },
+                },
             },
         },
         # KV-aware routing knob (serve/load_balancer.py).
