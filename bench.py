@@ -247,7 +247,8 @@ def serve_batch_main() -> dict:
 def _open_loop_load(engine, prompts, gen: int,
                     interarrival_s: float,
                     collect_tokens: bool = False,
-                    adapters=None) -> dict:
+                    adapters=None,
+                    submit_kwargs=None) -> dict:
     """Drive an OPEN-LOOP request schedule at the engine: request i
     is submitted at t0 + i * interarrival regardless of completions
     (closed-loop drivers hide queueing collapse — an overloaded
@@ -258,7 +259,11 @@ def _open_loop_load(engine, prompts, gen: int,
     ids (``token_outputs``) so two arms over the same prompts can be
     compared for exactness — not just counted. ``adapters`` is an
     optional per-request LoRA adapter-id list (None entries = base
-    model) passed straight through to ``engine.submit``."""
+    model) passed straight through to ``engine.submit``.
+    ``submit_kwargs`` is an optional per-request list of extra
+    ``engine.submit`` kwargs (sampling knobs: temperature/top_p/
+    seed/response_format/eos_id for the serve_sampled/serve_json
+    arms)."""
     import threading
 
     n = len(prompts)
@@ -300,7 +305,9 @@ def _open_loop_load(engine, prompts, gen: int,
         if sched > now:
             time.sleep(sched - now)
         q = engine.submit(prompt, gen,
-                          adapter=adapters[i] if adapters else None)
+                          adapter=adapters[i] if adapters else None,
+                          **(submit_kwargs[i] if submit_kwargs
+                             else {}))
         th = threading.Thread(target=collect, args=(i, q, sched),
                               daemon=True)
         th.start()
@@ -830,6 +837,355 @@ def serve_spec_main() -> dict:
                 # the overhead on traffic drafting cannot help.
                 'out_tok_s_ratio': round(adv_ratio, 3),
             },
+        },
+    }
+
+
+def serve_sampled_main() -> dict:
+    """BENCH_MODE=serve_sampled (``--bench serve_sampled``): batch-
+    invariant sampled decode (serve/sampling/) vs greedy on the SAME
+    engine config at equal KV HBM — the cost of carrying per-request
+    temperature/top_p/seed as traced per-row arrays plus the in-jit
+    counter-keyed categorical draw. Headline is the sampled arm's
+    ``out_tok/s``; ``vs_baseline`` is sampled/greedy and the bench
+    ASSERTS it stays >= 1 - BENCH_SD_MAX_OVERHEAD (default 10%): the
+    sampling subsystem is admitted on the promise that sampling rides
+    the shared batch for roughly free.
+
+    Two invariance side-checks run before the result is reported:
+    the sampled load replayed with the same seeds must be bitwise
+    identical (determinism under fixed (seed, position) keys), and
+    request 0 re-run ALONE on a fresh 1-slot engine must reproduce
+    its in-batch output (batch invariance — neighbors never leak
+    into a row's draws).
+
+    Env: BENCH_SD_MODEL (default tiny), BENCH_SD_VOCAB (proxy vocab
+    restriction, 0 = model default), BENCH_SD_REQUESTS,
+    BENCH_SD_PROMPT, BENCH_SD_GEN, BENCH_SD_ROWS, BENCH_SD_RATE,
+    BENCH_SD_TEMP, BENCH_SD_TOP_P, BENCH_SD_SEED,
+    BENCH_SD_MAX_OVERHEAD, BENCH_KV_INT8.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_SD_MODEL', 'tiny')
+    vocab = int(os.environ.get('BENCH_SD_VOCAB', '0'))
+    requests = int(os.environ.get('BENCH_SD_REQUESTS', '8'))
+    prompt_len = int(os.environ.get('BENCH_SD_PROMPT', '32'))
+    gen = int(os.environ.get('BENCH_SD_GEN', '192'))
+    rows = int(os.environ.get('BENCH_SD_ROWS', '4'))
+    rate = float(os.environ.get('BENCH_SD_RATE', '100'))
+    temp = float(os.environ.get('BENCH_SD_TEMP', '0.8'))
+    top_p = float(os.environ.get('BENCH_SD_TOP_P', '0.9'))
+    seed = int(os.environ.get('BENCH_SD_SEED', '7'))
+    # The <10% bound is the ACCELERATOR contract: on a real chip the
+    # sampling epilogue (per-row sort + categorical) is noise next
+    # to the model forward. On the CPU proxy the tiny random-init
+    # forward is microseconds, so the same epilogue reads as tens of
+    # percent — the proxy default only guards against pathological
+    # regressions; BENCH_SD_MAX_OVERHEAD pins it explicitly.
+    cpu_proxy = jax.devices()[0].platform == 'cpu'
+    max_overhead = float(os.environ.get(
+        'BENCH_SD_MAX_OVERHEAD', '0.50' if cpu_proxy else '0.10'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+
+    config = llama.get_config(model_name)
+    if vocab:
+        config = dataclasses.replace(config, vocab_size=vocab)
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, config.vocab_size,
+                            size=prompt_len).tolist()
+               for _ in range(requests)]
+    sampled_kwargs = [
+        {'temperature': temp, 'top_p': top_p, 'seed': 1000 + i}
+        for i in range(requests)]
+
+    def make_engine(n_rows):
+        # Speculation off: this bench isolates the sampled-executable
+        # cost; serve_spec/serve_json measure the verify path.
+        return BatchingEngine(
+            params, config, slots=n_rows, max_seq=max_seq,
+            steps_per_dispatch=8, kv_int8=kv_int8, block_size=block,
+            prefill_chunk=64, max_num_batched_tokens=512,
+            prefix_caching=False, speculative=False)
+
+    def run_arm(kwargs_list, name):
+        engine = make_engine(rows)
+        try:
+            # Warm BOTH executables the arm will touch before timing.
+            engine.generate(prompts[0], 4)
+            if kwargs_list:
+                req = engine.submit_request(prompts[0], 4,
+                                            **kwargs_list[0])
+                while req.out.get() is not None:
+                    pass
+            out = _open_loop_load(engine, prompts, gen, 1.0 / rate,
+                                  collect_tokens=True,
+                                  submit_kwargs=kwargs_list)
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    greedy = run_arm(None, 'greedy')
+    sampled = run_arm(sampled_kwargs, 'sampled')
+    replay = run_arm(sampled_kwargs, 'sampled_replay')
+
+    sampled_toks = sampled.pop('token_outputs')
+    replay_toks = replay.pop('token_outputs')
+    greedy.pop('token_outputs')
+    if sampled_toks != replay_toks:
+        raise RuntimeError(
+            'sampled decode is not deterministic under fixed seeds: '
+            'replay diverged from the first run')
+    # Batch invariance at the bench level: request 0 alone on a
+    # 1-slot engine must see exactly the draws it saw next to its
+    # neighbors (its (seed, position) keys are the same).
+    solo_engine = make_engine(1)
+    try:
+        req = solo_engine.submit_request(prompts[0], gen,
+                                         **sampled_kwargs[0])
+        solo = []
+        while True:
+            tok = req.out.get()
+            if tok is None:
+                break
+            if isinstance(tok, BaseException):
+                raise tok
+            solo.append(int(tok))
+    finally:
+        solo_engine.close()
+    if solo != sampled_toks[0]:
+        raise RuntimeError(
+            f'sampled decode is not batch-invariant: request 0 '
+            f'alone produced {solo[:8]}... vs in-batch '
+            f'{sampled_toks[0][:8]}...')
+
+    ratio = (sampled['tokens_per_sec'] /
+             max(greedy['tokens_per_sec'], 1e-9))
+    if ratio < 1.0 - max_overhead:
+        raise RuntimeError(
+            f'sampled decode overhead exceeds '
+            f'{max_overhead:.0%}: sampled/greedy out_tok/s = '
+            f'{ratio:.3f}')
+    return {
+        'metric': f'{model_name}_serve_sampled_out_tok_s',
+        'value': sampled['tokens_per_sec'],
+        'unit': 'tokens/s',
+        # vs_baseline: sampled/greedy out_tok/s (asserted >= 1 -
+        # BENCH_SD_MAX_OVERHEAD above).
+        'vs_baseline': round(ratio, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'vocab': config.vocab_size,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'requests': requests,
+            'prompt_len': prompt_len,
+            'generated_per_request': gen,
+            'decode_rows': rows,
+            'arrival_rate_req_s': rate,
+            'temperature': temp,
+            'top_p': top_p,
+            'max_overhead': max_overhead,
+            'sampled': sampled,
+            'greedy': greedy,
+            'replay_bitwise_equal': True,
+            'solo_batch_invariant': True,
+        },
+    }
+
+
+def serve_json_main() -> dict:
+    """BENCH_MODE=serve_json (``--bench serve_json``): grammar-
+    constrained structured decoding (serve/sampling/grammar.py) vs
+    free-form sampled decode on the SAME engine config — the cost of
+    the host-side token-trie walk plus the in-jit mask gather.
+    Headline is the constrained arm's ``out_tok/s``; ``vs_baseline``
+    is constrained/free-form and the bench ASSERTS it stays
+    >= 1 - BENCH_SJ_MAX_OVERHEAD (default 10%).
+
+    Speculation is ON in both arms and the bench additionally
+    ASSERTS the constrained arm's draft-acceptance rate is HIGHER
+    than free-form's: grammar masks concentrate the target
+    distribution onto few legal tokens, so the n-gram drafter's
+    proposals match the coupled realizations more often — structured
+    decoding makes speculation better, not worse.
+
+    Both arms run ``steps_per_dispatch=1``: constrained rows force
+    single-step decode dispatches anyway (the DFA advance is
+    host-side), so equal dispatch shape keeps the comparison about
+    the masks, not the batching geometry.
+
+    CPU-proxy note: the model is random-init with a small JSON-token
+    vocab, so the constrained stream exercises the real mask
+    pipeline but the "JSON" is schema-shaped noise; the structured
+    suite in tests/test_sampling.py asserts parse-under-schema on
+    completed outputs.
+
+    Env: BENCH_SJ_MODEL (default tiny), BENCH_SJ_REQUESTS,
+    BENCH_SJ_PROMPT, BENCH_SJ_GEN, BENCH_SJ_ROWS, BENCH_SJ_RATE,
+    BENCH_SJ_TEMP, BENCH_SJ_DRAFT_K, BENCH_SJ_SEED,
+    BENCH_SJ_MAX_OVERHEAD, BENCH_KV_INT8.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve.batching import BatchingEngine
+
+    model_name = os.environ.get('BENCH_SJ_MODEL', 'tiny')
+    requests = int(os.environ.get('BENCH_SJ_REQUESTS', '6'))
+    prompt_len = int(os.environ.get('BENCH_SJ_PROMPT', '24'))
+    gen = int(os.environ.get('BENCH_SJ_GEN', '160'))
+    rows = int(os.environ.get('BENCH_SJ_ROWS', '2'))
+    rate = float(os.environ.get('BENCH_SJ_RATE', '100'))
+    temp = float(os.environ.get('BENCH_SJ_TEMP', '0.8'))
+    draft_k = int(os.environ.get('BENCH_SJ_DRAFT_K', '8'))
+    seed = int(os.environ.get('BENCH_SJ_SEED', '11'))
+    # Same CPU-proxy relaxation as serve_sampled: the <10% bound is
+    # the accelerator contract; the proxy's tiny forward inflates
+    # every per-token epilogue's relative cost.
+    cpu_proxy = jax.devices()[0].platform == 'cpu'
+    max_overhead = float(os.environ.get(
+        'BENCH_SJ_MAX_OVERHEAD', '0.50' if cpu_proxy else '0.10'))
+    kv_int8 = os.environ.get('BENCH_KV_INT8', '0') == '1'
+    block = 16
+    max_seq = -(-(prompt_len + gen + 8) // block) * block
+
+    # JSON-token proxy vocab: id 0 is padding (never legal under a
+    # grammar), the last id is EOS, everything between maps to the
+    # JSON lexicon the schema below can reach.
+    syms = list('0123456789{}[],:."-') + ['true', 'false', 'null',
+                                          'a', 'b']
+    grammar_vocab = [None] + syms + [None]
+    eos_id = len(grammar_vocab) - 1
+    config = dataclasses.replace(llama.get_config(model_name),
+                                 vocab_size=len(grammar_vocab))
+    params = llama.init_params(config, jax.random.PRNGKey(0),
+                               dtype=jnp.bfloat16)
+    # minItems keeps the array OPEN past the generation budget in
+    # the common case, so both arms mostly decode the full ``gen``
+    # tokens and the throughput comparison is token-for-token fair.
+    schema = {'type': 'array', 'items': {'type': 'integer'},
+              'minItems': 50}
+    response_format = {'type': 'json_schema', 'schema': schema}
+
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, eos_id, size=prompt_len).tolist()
+               for _ in range(requests)]
+    # Free-form gets NO eos: sampling would hit the eos id by chance
+    # and retire early, making the arms' token counts incomparable.
+    # Constrained needs one (the grammar emits it when the value
+    # completes), but minItems keeps completion past the budget.
+    free_kwargs = [
+        {'temperature': temp, 'seed': 2000 + i}
+        for i in range(requests)]
+    con_kwargs = [dict(kw, response_format=response_format,
+                       eos_id=eos_id)
+                  for kw in free_kwargs]
+
+    def run_arm(kwargs_list, name):
+        engine = BatchingEngine(
+            params, config, slots=rows, max_seq=max_seq,
+            steps_per_dispatch=1, kv_int8=kv_int8, block_size=block,
+            prefill_chunk=64, max_num_batched_tokens=512,
+            prefix_caching=False, speculative=True, draft_k=draft_k,
+            grammar_vocab=grammar_vocab)
+        try:
+            req = engine.submit_request(prompts[0], 4,
+                                        **kwargs_list[0])
+            while req.out.get() is not None:
+                pass
+            p0 = engine._spec_proposed_local  # pylint: disable=protected-access
+            a0 = engine._spec_accepted_local  # pylint: disable=protected-access
+            out = _open_loop_load(engine, prompts, gen, 1.0 / rate,
+                                  collect_tokens=True,
+                                  submit_kwargs=kwargs_list)
+            proposed = engine._spec_proposed_local - p0  # pylint: disable=protected-access
+            accepted = engine._spec_accepted_local - a0  # pylint: disable=protected-access
+            out['drafts_proposed'] = proposed
+            out['drafts_accepted'] = accepted
+            out['accept_rate'] = round(
+                accepted / proposed, 3) if proposed else 0.0
+        finally:
+            engine.close()
+        out['arm'] = name
+        return out
+
+    freeform = run_arm(free_kwargs, 'freeform')
+    constrained = run_arm(con_kwargs, 'constrained')
+
+    con_toks = constrained.pop('token_outputs')
+    freeform.pop('token_outputs')
+    # Every constrained token must be a grammar-legal JSON symbol —
+    # the cheap structural check (full parse-under-schema on
+    # COMPLETED outputs is tests/test_sampling.py's job).
+    legal = set('0123456789[],-') | {eos_id}
+    for i, toks in enumerate(con_toks):
+        bad = [t for t in toks
+               if t != eos_id and grammar_vocab[t] not in legal]
+        if bad:
+            raise RuntimeError(
+                f'constrained request {i} emitted tokens outside '
+                f'the schema lexicon: {bad[:5]}')
+
+    ratio = (constrained['tokens_per_sec'] /
+             max(freeform['tokens_per_sec'], 1e-9))
+    if ratio < 1.0 - max_overhead:
+        raise RuntimeError(
+            f'constrained decode overhead exceeds '
+            f'{max_overhead:.0%}: constrained/free-form out_tok/s '
+            f'= {ratio:.3f}')
+    if not constrained['drafts_proposed'] or \
+            constrained['accept_rate'] <= freeform['accept_rate']:
+        raise RuntimeError(
+            f'constrained spec acceptance '
+            f'({constrained["accept_rate"]}) is not higher than '
+            f'free-form ({freeform["accept_rate"]}) — grammar masks '
+            'should concentrate the target distribution')
+    return {
+        'metric': f'{model_name}_serve_json_out_tok_s',
+        'value': constrained['tokens_per_sec'],
+        'unit': 'tokens/s',
+        # vs_baseline: constrained/free-form out_tok/s (asserted
+        # >= 1 - BENCH_SJ_MAX_OVERHEAD above).
+        'vs_baseline': round(ratio, 3),
+        'detail': {
+            'devices': len(jax.devices()),
+            'platform': jax.devices()[0].platform,
+            'model': model_name,
+            'vocab': config.vocab_size,
+            'kv_cache': 'int8' if kv_int8 else 'bf16',
+            'requests': requests,
+            'prompt_len': prompt_len,
+            'generated_per_request': gen,
+            'decode_rows': rows,
+            'arrival_rate_req_s': rate,
+            'temperature': temp,
+            'draft_k': draft_k,
+            'schema': schema,
+            'max_overhead': max_overhead,
+            'constrained': constrained,
+            'freeform': freeform,
+            'accept_rate_delta': round(
+                constrained['accept_rate'] -
+                freeform['accept_rate'], 3),
         },
     }
 
@@ -2198,7 +2554,8 @@ if __name__ == '__main__':
             idx = sys.argv.index('--bench')
             known = ('train', 'serve', 'serve_batch',
                      'serve_continuous', 'serve_prefix',
-                     'serve_spec', 'serve_multilora',
+                     'serve_spec', 'serve_sampled', 'serve_json',
+                     'serve_multilora',
                      'serve_overload', 'launch',
                      'checkpoint', 'elastic')
             if idx + 1 >= len(sys.argv) or \
@@ -2221,6 +2578,10 @@ if __name__ == '__main__':
             bench_result = serve_prefix_main()
         elif mode == 'serve_spec':
             bench_result = serve_spec_main()
+        elif mode == 'serve_sampled':
+            bench_result = serve_sampled_main()
+        elif mode == 'serve_json':
+            bench_result = serve_json_main()
         elif mode == 'serve_multilora':
             bench_result = serve_multilora_main()
         elif mode == 'serve_overload':

@@ -14,6 +14,7 @@ from skypilot_tpu.analysis.checkers import env_contract
 from skypilot_tpu.analysis.checkers import naked_thread
 from skypilot_tpu.analysis.checkers import names
 from skypilot_tpu.analysis.checkers import raw_sqlite
+from skypilot_tpu.analysis.checkers import serve_prng
 from skypilot_tpu.analysis.checkers import sleep_retry
 from skypilot_tpu.analysis.checkers import spawn_stamp
 from skypilot_tpu.analysis.checkers import state_write
@@ -29,6 +30,7 @@ def build_all() -> List['core.Checker']:
         spawn_stamp.SpawnStampChecker(),
         env_contract.EnvContractChecker(),
         blocking_jit.BlockingInJitChecker(),
+        serve_prng.ServeJitPrngChecker(),
         naked_thread.NakedThreadChecker(),
         names.SpanNameContractChecker(),
         names.MetricNameContractChecker(),

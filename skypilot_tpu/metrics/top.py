@@ -165,6 +165,12 @@ def _host_rows(families) -> List[Dict[str, Any]]:
         combine='sum')
     put('skytpu_batch_spec_accepted_total', 'spec_accepted',
         combine='sum')
+    # Sampling subsystem (serve/sampling/): sampled requests vs all
+    # admitted — the SAMPLED% column next to SPEC-ACC%.
+    put('skytpu_batch_sampled_requests_total', 'sampled_requests',
+        combine='sum')
+    put('skytpu_batch_requests_total', 'batch_requests',
+        combine='sum')
     # Multi-tenant LoRA multiplexing (serve/adapters/): device-
     # resident adapters vs slot capacity — the ADAPTERS column.
     put('skytpu_batch_adapters_resident', 'adapters_resident',
@@ -404,8 +410,8 @@ def render(snap: Dict[str, Any]) -> str:
     table = ux_utils.Table(['CLUSTER', 'HOST', 'LOAD', 'MEM', 'PROCS',
                             'HBM', 'TRAIN TOK/S', 'MFU', 'GOODPUT',
                             'SERVE TOK/S', 'BLOCKS', 'PREEMPT',
-                            'PREFIX-HIT%', 'SPEC-ACC%', 'ADAPTERS',
-                            'KV', 'ALERTS'])
+                            'PREFIX-HIT%', 'SPEC-ACC%', 'SAMPLED%',
+                            'ADAPTERS', 'KV', 'ALERTS'])
     rows = 0
     for cluster in snap['clusters']:
         alerts_cell = str(cluster.get('alerts_firing', 0) or '-')
@@ -415,7 +421,7 @@ def render(snap: Dict[str, Any]) -> str:
             # a row — partial fleet visibility beats none.
             table.add_row([cluster['name'], '(unreachable)', '-', '-',
                            '-', '-', '-', '-', '-', '-', '-', '-',
-                           '-', '-', '-', '-', alerts_cell])
+                           '-', '-', '-', '-', '-', alerts_cell])
             rows += 1
             continue
         for h in cluster['hosts']:
@@ -458,6 +464,13 @@ def render(snap: Dict[str, Any]) -> str:
             if h.get('spec_proposed'):
                 spec = _fmt_ratio(h.get('spec_accepted', 0.0) /
                                   h['spec_proposed'])
+            # Sampled-request share: temperature>0 admissions over
+            # all admissions (serve/sampling/).
+            sampled = '-'
+            if h.get('batch_requests'):
+                sampled = _fmt_ratio(
+                    h.get('sampled_requests', 0.0) /
+                    h['batch_requests'])
             # LoRA resident set: resident/capacity; '-' for engines
             # serving no adapters (the gauges are only registered
             # when multiplexing is on).
@@ -474,7 +487,7 @@ def render(snap: Dict[str, Any]) -> str:
                 _fmt_num(h.get('decode_tok_s'), '{:.0f}'),
                 blocks,
                 _fmt_num(h.get('preemptions'), '{:.0f}'),
-                prefix, spec, adapters, kv, alerts_cell,
+                prefix, spec, sampled, adapters, kv, alerts_cell,
             ])
             rows += 1
     out.append(table.get_string() if rows else 'No clusters.')

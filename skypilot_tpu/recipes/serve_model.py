@@ -2,7 +2,9 @@
 
 Port of the reference's serving recipes (``llm/vllm/service.yaml``,
 JetStream on v6e): a replica process exposing ``/`` (readiness) and
-``/generate`` (greedy decode) over the in-tree Llama implementation.
+``/generate`` (greedy, sampled and grammar-constrained decode — the
+latter two on the batching engine only) over the in-tree Llama
+implementation.
 Runs under ``x serve up`` — the service spec's port arrives via
 ``SKYTPU_REPLICA_PORT``.
 
@@ -39,9 +41,12 @@ def main():
                              'v5e)')
     parser.add_argument('--slots', type=int, default=0,
                         help='enable continuous batching with this '
-                             'many concurrent decode rows (greedy '
-                             'requests share one batch; sampling '
-                             'requests fall back to the serial path)')
+                             'many concurrent decode rows (greedy, '
+                             'sampled and grammar-constrained '
+                             'requests all share one batch; sampled '
+                             'and structured decoding REQUIRE the '
+                             'engine — there is no serial sampling '
+                             'path)')
     # Engine knobs default from the SKYTPU_ENGINE_* env stamps the
     # replica manager injects from the service YAML's `engine:`
     # section (SkyServiceSpec.engine_env) — explicit flags win.
@@ -146,6 +151,32 @@ def main():
                              'before readiness — their first '
                              'requests pay no cold load '
                              '(engine.adapters.preload)')
+    # Sampling subsystem (serve/sampling/): per-request temperature/
+    # top_p/seed ride the shared batch as traced arrays under the
+    # batch-invariance contract; response_format adds grammar-
+    # constrained structured decoding. Service YAML `engine.sampling:`
+    # stamps these as SKYTPU_ENGINE_SAMPLING*.
+    parser.add_argument('--sampling', choices=['on', 'off'],
+                        default=('on' if os.environ.get(
+                            'SKYTPU_ENGINE_SAMPLING', '1')
+                            not in ('0', 'off', 'false') else 'off'),
+                        help='batch-invariant sampled decode on the '
+                             'engine: per-request temperature/top_p/'
+                             'seed as traced per-row arrays, '
+                             'counter-keyed (seed, position) PRNG '
+                             '(engine.sampling.enabled; off pins the '
+                             'replica to the greedy-only '
+                             'executables)')
+    parser.add_argument('--grammar-vocab',
+                        default=os.environ.get(
+                            'SKYTPU_ENGINE_SAMPLING_GRAMMAR_VOCAB',
+                            ''),
+                        help='path to a JSON list mapping token id '
+                             '-> token string (null for ids with no '
+                             'text); enables response_format '
+                             'grammar-constrained decoding '
+                             '(engine.sampling.grammar_vocab; empty '
+                             '= structured requests are refused)')
     parser.add_argument('--checkpoint-dir', default=None,
                         help='restore the latest finetune checkpoint '
                              'from this dir (a TrainState as saved by '
@@ -254,6 +285,19 @@ def main():
                    (s.strip() for s in
                     args.preload_adapters.split(','))
                    if a] if args.preload_adapters else None
+        grammar_vocab = None
+        if args.grammar_vocab:
+            # Structured decoding needs token TEXT to walk grammars:
+            # a JSON list indexed by token id (null = no text, never
+            # legal under a grammar). Refuse a malformed file at
+            # startup, not on the first constrained request.
+            with open(args.grammar_vocab) as f:
+                grammar_vocab = json.load(f)
+            if not isinstance(grammar_vocab, list):
+                raise SystemExit(
+                    f'--grammar-vocab {args.grammar_vocab} must hold '
+                    f'a JSON list (token id -> string or null), got '
+                    f'{type(grammar_vocab).__name__}')
         engine = BatchingEngine(
             params, config, slots=args.slots, kv_int8=args.kv_int8,
             block_size=args.block_size,
@@ -267,7 +311,9 @@ def main():
             default_timeout_s=args.default_timeout_s or None,
             adapter_registry=adapter_registry,
             adapter_capacity=args.adapter_capacity,
-            adapter_preload=preload)
+            adapter_preload=preload,
+            sampling=args.sampling == 'on',
+            grammar_vocab=grammar_vocab)
 
     # Publish this replica's registry (batching queue/TTFT/KV-cache
     # gauges + device HBM) to the host agent's /metrics via the
@@ -277,34 +323,27 @@ def main():
     from skypilot_tpu.metrics import publish as publish_lib
     publish_lib.start_publisher('replica')
 
-    def generate(prompt_ids, max_new, temperature=None, top_p=None,
-                 seed=None, eos_id=None):
-        if (engine is not None and temperature is None
-                and top_p is None):
-            # Continuous batching: no lock — concurrent greedy
-            # requests share the decode batch (the engine clamps
-            # max_new itself and retires rows at eos_id).
+    def generate(prompt_ids, max_new, eos_id=None):
+        """Greedy generation. Sampled and grammar-constrained decode
+        live ONLY on the batching engine (submit_request with
+        temperature/top_p/seed/response_format) — the old serial
+        sampling fallback is gone: it allocated a whole extra
+        [L, 1, S] KV cache next to the engine's resident one and
+        broke batch invariance by keying randomness off a per-request
+        split chain instead of (seed, position)."""
+        if engine is not None:
+            # Continuous batching: no lock — concurrent requests
+            # share the decode batch (the engine clamps max_new
+            # itself and retires rows at eos_id).
             return engine.generate(prompt_ids, max_new,
                                    eos_id=eos_id)
-        # HBM headroom requirement: this serial path allocates a
-        # fresh [L, 1, S]-per-KV cache ON TOP of the engine's
-        # resident [L, slots, S] cache, so with --slots the chip must
-        # be sized to hold (slots + 1) cache rows — size --slots to
-        # leave one row's worth of HBM free, or a single temperature
-        # request can OOM a chip that exactly fits the engine.
-        return _generate_serial(prompt_ids, max_new,
-                                temperature=temperature, top_p=top_p,
-                                seed=seed, eos_id=eos_id)
-
-    def _generate_serial(prompt_ids, max_new, temperature=None,
-                         top_p=None, seed=None, eos_id=None):
+        # Engine-off replica (--slots 0): greedy-only serial path.
         # KV-cache decode: prefill once, then ONE device-side scan for
         # the whole generation (decode.decode_tokens_scan). The scan
         # length is a static compile parameter, so requested lengths
         # are bucketed to powers of two and truncated — otherwise
         # every distinct client max_new_tokens would pay a full-model
-        # recompile while holding the serve lock. (Bucketing prompt
-        # lengths the same way is the next optimization if needed.)
+        # recompile while holding the serve lock.
         tokens = jnp.asarray([prompt_ids], jnp.int32)
         max_new = min(max_new,
                       config.max_seq_len - tokens.shape[1])
@@ -315,28 +354,14 @@ def main():
             bucket *= 2
         bucket = min(bucket, config.max_seq_len - tokens.shape[1])
         with lock:
-            if temperature is not None or top_p is not None:
-                # temperature/top_p enter as ARRAYS, so every request
-                # value reuses one compiled executable. Unseeded
-                # requests draw a fresh key — identical requests must
-                # not return identical "samples".
-                if seed is None:
-                    seed = int.from_bytes(os.urandom(4), 'little')
-                out = decode.sample_generate(
-                    params, tokens, config, max_new_tokens=bucket,
-                    key=jax.random.PRNGKey(seed),
-                    temperature=(1.0 if temperature is None
-                                 else temperature),
-                    top_p=top_p, cache_sharding=cache_sh)
-            else:
-                # Deliberately NOT passing eos_id down: it would
-                # switch greedy_generate to its per-token loop (one
-                # host round-trip per token, lock held); the scan
-                # decodes the full bucket and the host-side
-                # truncation below yields identical output.
-                out = decode.greedy_generate(params, tokens, config,
-                                             max_new_tokens=bucket,
-                                             cache_sharding=cache_sh)
+            # Deliberately NOT passing eos_id down: it would
+            # switch greedy_generate to its per-token loop (one
+            # host round-trip per token, lock held); the scan
+            # decodes the full bucket and the host-side
+            # truncation below yields identical output.
+            out = decode.greedy_generate(params, tokens, config,
+                                         max_new_tokens=bucket,
+                                         cache_sharding=cache_sh)
         out = [int(t) for t in out[0][:max_new]]
         if eos_id is not None and eos_id in out:
             out = out[:out.index(eos_id) + 1]
@@ -371,6 +396,14 @@ def main():
             a replica fault and answers 500 so the 5xx alert sees
             it."""
             from skypilot_tpu import exceptions
+            from skypilot_tpu.serve.sampling import GrammarError
+            if isinstance(err, GrammarError):
+                # The grammar compiler refused the client's
+                # response_format (unsupported construct, bad
+                # schema, no grammar vocab on this replica): their
+                # request shape, not a replica fault.
+                self._json({'error': str(err)}, 400)
+                return
             if isinstance(err, exceptions.AdapterNotFoundError):
                 # Client named an adapter this replica cannot
                 # resolve: their error, not a replica fault.
@@ -439,15 +472,43 @@ def main():
                               for t in body['prompt_ids']]
                 max_new = min(int(body.get('max_new_tokens',
                                            args.max_new_tokens)), 512)
+                # Sampling knobs: typed 400s that NAME the offending
+                # field — the engine enforces the same bounds
+                # (submit_request), but refusing here answers before
+                # a queue slot is taken.
                 temperature = body.get('temperature')
                 if temperature is not None:
+                    if isinstance(temperature, bool) or \
+                            not isinstance(temperature, (int, float)):
+                        raise ValueError(
+                            f'temperature must be a number, got '
+                            f'{temperature!r}')
                     temperature = float(temperature)
+                    if temperature < 0.0:
+                        raise ValueError(
+                            f'temperature must be >= 0, got '
+                            f'{temperature}')
                 top_p = body.get('top_p')
                 if top_p is not None:
+                    if isinstance(top_p, bool) or \
+                            not isinstance(top_p, (int, float)):
+                        raise ValueError(
+                            f'top_p must be a number, got {top_p!r}')
                     top_p = float(top_p)
+                    if not 0.0 < top_p <= 1.0:
+                        raise ValueError(
+                            f'top_p must be in (0, 1], got {top_p}')
                 seed = body.get('seed')
-                if seed is not None:
-                    seed = int(seed)
+                if seed is not None and (isinstance(seed, bool)
+                                         or not isinstance(seed, int)):
+                    raise ValueError(
+                        f'seed must be an integer, got {seed!r}')
+                response_format = body.get('response_format')
+                if response_format is not None and \
+                        not isinstance(response_format, dict):
+                    raise ValueError(
+                        f'response_format must be an object, got '
+                        f'{type(response_format).__name__}')
                 eos_id = body.get('eos_id')
                 if eos_id is not None:
                     eos_id = int(eos_id)
@@ -503,23 +564,47 @@ def main():
                 self._generate_response(prompt_ids, max_new,
                                         temperature, top_p, seed,
                                         eos_id, stream, tenant,
-                                        deadline, priority, adapter)
+                                        deadline, priority, adapter,
+                                        response_format)
 
         def _generate_response(self, prompt_ids, max_new, temperature,
                                top_p, seed, eos_id, stream,
                                tenant=None, deadline=None,
-                               priority='interactive', adapter=None):
-            use_engine = (engine is not None and temperature is None
-                          and top_p is None)
+                               priority='interactive', adapter=None,
+                               response_format=None):
+            use_engine = engine is not None
+            sampled = ((temperature is not None and temperature > 0.0)
+                       or response_format is not None)
+            if sampled and not use_engine:
+                # There is no serial sampling path anymore: sampled
+                # and grammar-constrained decode run ONLY on the
+                # batching engine's shared batch.
+                self._json({'error': 'sampled/structured decoding '
+                            '(temperature > 0 or response_format) '
+                            'requires the batching engine — start '
+                            'the replica with --slots > 0'}, 400)
+                return
             if adapter is not None and not use_engine:
                 # Adapter decode lives on the batched engine's
-                # gather path only — the serial/sampling path has
-                # no adapter math.
+                # gather path only.
                 self._json({'error': 'adapter requests require the '
-                            'batching engine (--slots > 0) and '
-                            'greedy decoding (no temperature/'
-                            'top_p)'}, 400)
+                            'batching engine (--slots > 0)'}, 400)
                 return
+            if sampled and seed is None:
+                # Unseeded sampled requests draw a fresh seed at the
+                # HTTP edge (host-side, never inside jit — the
+                # serve-jit-prng lint): identical requests must not
+                # return identical "samples", while a client-pinned
+                # seed stays bitwise reproducible.
+                seed = int.from_bytes(os.urandom(4), 'little')
+            submit_kwargs = dict(
+                eos_id=eos_id, tenant=tenant, deadline=deadline,
+                priority=priority, adapter=adapter,
+                temperature=temperature if temperature is not None
+                else 0.0,
+                top_p=top_p if top_p is not None else 1.0,
+                seed=seed if seed is not None else 0,
+                response_format=response_format)
             if stream and use_engine:
                 # SSE: tokens leave as the engine produces them (per
                 # decode dispatch), so client TTFT is prefill-bound,
@@ -528,11 +613,7 @@ def main():
                 # _stream_response), end to end.
                 import queue as queue_mod
                 req = engine.submit_request(prompt_ids, max_new,
-                                            eos_id=eos_id,
-                                            tenant=tenant,
-                                            deadline=deadline,
-                                            priority=priority,
-                                            adapter=adapter)
+                                            **submit_kwargs)
                 q = req.out
                 # Hold the status line for the FIRST queue item:
                 # admission (which fills the prefix-cache stats the
@@ -609,11 +690,7 @@ def main():
                 return
             if use_engine:
                 req = engine.submit_request(prompt_ids, max_new,
-                                            eos_id=eos_id,
-                                            tenant=tenant,
-                                            deadline=deadline,
-                                            priority=priority,
-                                            adapter=adapter)
+                                            **submit_kwargs)
                 out = []
                 err = None
                 while True:
@@ -630,16 +707,15 @@ def main():
                 self._json({'output_ids': out},
                            extra_headers=self._prefix_headers(req))
                 return
-            out = generate(prompt_ids, max_new, temperature=temperature,
-                           top_p=top_p, seed=seed, eos_id=eos_id)
+            out = generate(prompt_ids, max_new, eos_id=eos_id)
             if stream:
                 self._stream_burst(out)
                 return
             self._json({'output_ids': out})
 
         def _stream_burst(self, out):
-            # No engine (or sampling): stream-compatible response
-            # with the whole generation as one event burst.
+            # No engine: stream-compatible response with the whole
+            # generation as one event burst.
             self.send_response(200)
             self.send_header('Content-Type', 'text/event-stream')
             payload = b''.join(f'data: {t}\n\n'.encode()
@@ -648,14 +724,19 @@ def main():
             self.end_headers()
             self.wfile.write(payload)
 
-    # Warm every decode variant's compile before declaring readiness
-    # (greedy, sampled, sampled+nucleus) — the first request would
-    # otherwise pay it while holding the serve lock. max_new=2 so the
-    # batching engine's decode step compiles too (a 1-token request
-    # retires at admission without ever dispatching it).
+    # Warm the decode compiles before declaring readiness — the first
+    # request would otherwise pay them. max_new=2 so the batching
+    # engine's decode step compiles too (a 1-token request retires at
+    # admission without ever dispatching it). Sampled warmup is
+    # engine-gated: sampled decode only exists on the engine, and its
+    # sampled executable is a SECOND compile (the greedy one stays
+    # byte-identical to the pre-sampling engine).
     generate([1, 2, 3], 2)
-    generate([1, 2, 3], 2, temperature=1.0, seed=0)
-    generate([1, 2, 3], 2, temperature=1.0, top_p=0.9, seed=0)
+    if engine is not None and engine.sampling:
+        req = engine.submit_request([1, 2, 3], 2, temperature=1.0,
+                                    top_p=0.9, seed=0)
+        while req.out.get() is not None:
+            pass
     server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
     print(f'serve_model ready on :{args.port} (model {args.model})')
     server.serve_forever()

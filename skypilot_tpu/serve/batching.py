@@ -66,6 +66,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import metrics as metrics_lib
@@ -76,6 +77,9 @@ from skypilot_tpu.models.quant import matmul as _mm
 from skypilot_tpu.resilience import faults as faults_lib
 from skypilot_tpu.serve import kv_pool as kv_pool_lib
 from skypilot_tpu.serve import prefix_hash
+from skypilot_tpu.serve.sampling import grammar as grammar_lib
+from skypilot_tpu.serve.sampling import sample as sample_lib
+from skypilot_tpu.serve.sampling.accept import accept_tokens
 
 logger = tpu_logging.init_logger(__name__)
 
@@ -171,8 +175,8 @@ def _attend_rows(q: jax.Array, k: jax.Array, v: jax.Array,
 def decode_steps_rows(params: Params, tokens: jax.Array,
                       caches, pos: jax.Array, active: jax.Array,
                       config: llama.LlamaConfig,
-                      num_steps: int):
-    """Greedy-decode ``num_steps`` tokens for every row at PER-ROW
+                      num_steps: int, sampling=None):
+    """Decode ``num_steps`` tokens for every row at PER-ROW
     positions, as one dispatch (inner ``lax.scan``).
 
     tokens [B] (each row's most recent token); ``caches`` =
@@ -187,6 +191,14 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
     This is the CONTIGUOUS-cache variant (one [S] slab per row) —
     the engine itself runs ``decode_steps_paged``, its block-table-
     indirected twin with identical numerics.
+
+    ``sampling`` (serve/sampling/): None keeps the greedy argmax
+    path byte-identical to before; otherwise a dict of TRACED
+    per-row knob arrays (``temps``/``top_ps``/``seeds`` [B]) plus
+    the grammar mask table (``mask_table`` [M, V] bool,
+    ``mask_idx`` [B] — row 0 is all-allowed) and each step's next
+    token is ``sample_rows`` keyed ``(seed, position)``;
+    ``temperature <= 0`` rows still reduce to the argmax.
 
     Returns (out_tokens [B, num_steps], caches, new_pos).
     """
@@ -304,7 +316,18 @@ def decode_steps_rows(params: Params, tokens: jax.Array,
             logits = (x @ llama.output_head(cparams, config))
         else:
             logits = _mm(x, cparams['lm_head'])
-        nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        if sampling is None:
+            nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        else:
+            # Counter-keyed per-row sampling: the draw at position
+            # ``cur`` (the index of the token these logits consumed)
+            # depends only on the row's own (seed, position) — batch
+            # invariance (serve/sampling/prng.py).
+            allowed = sample_lib.gather_masks(sampling['mask_table'],
+                                              sampling['mask_idx'])
+            nxt = sample_lib.sample_rows(
+                logits[:, -1], sampling['temps'], sampling['top_ps'],
+                sampling['seeds'], cur, allowed)
         # Inactive rows: hold the last token and do NOT advance, so
         # their next write overwrites the same parked cell.
         nxt = jnp.where(active, nxt, tok)
@@ -331,7 +354,8 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
                        pos: jax.Array, active: jax.Array,
                        config: llama.LlamaConfig,
                        num_steps: int, block_size: int,
-                       adapters=None, adapter_idx=None):
+                       adapters=None, adapter_idx=None,
+                       sampling=None):
     """Block-table-indirected twin of ``decode_steps_rows`` with
     identical numerics: the per-row [S] slab is replaced by gathers
     and scatters through ``block_tables`` [B, MB] into the shared
@@ -354,6 +378,11 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
     projections (``_lora_gather_delta``). ``adapters=None`` (a
     distinct jit executable — None is an empty pytree) keeps the
     adapterless math byte-identical to before.
+
+    ``sampling``: as in ``decode_steps_rows`` — None keeps the
+    greedy argmax executable byte-identical; a knob dict samples
+    each step's token per row, keyed ``(seed, position)``, with the
+    grammar mask gathered in-jit by traced index.
 
     Returns (out_tokens [B, num_steps], caches, new_pos).
     """
@@ -464,7 +493,17 @@ def decode_steps_paged(params: Params, tokens: jax.Array,
             logits = (x @ llama.output_head(cparams, config))
         else:
             logits = _mm(x, cparams['lm_head'])
-        nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        if sampling is None:
+            nxt = logits[:, -1].argmax(-1).astype(jnp.int32)
+        else:
+            # Counter-keyed per-row sampling at position ``cur`` —
+            # the row's draw never depends on batch neighbors
+            # (serve/sampling/prng.py batch-invariance contract).
+            allowed = sample_lib.gather_masks(sampling['mask_table'],
+                                              sampling['mask_idx'])
+            nxt = sample_lib.sample_rows(
+                logits[:, -1], sampling['temps'], sampling['top_ps'],
+                sampling['seeds'], cur, allowed)
         # Inactive rows: hold the last token and do NOT advance, so
         # their next (scratch-redirected) write stays parked.
         nxt = jnp.where(active, nxt, tok)
@@ -546,32 +585,6 @@ def propose_ngram_draft(tokens: List[int], k: int,
     return out
 
 
-def greedy_accept(tokens: jax.Array, preds: jax.Array,
-                  n_real: jax.Array) -> jax.Array:
-    """THE acceptance rule — the engine's single implementation
-    (lint-enforced: tests forbid draft-vs-argmax comparisons
-    anywhere else, so the exactness suite certifies every
-    acceptance decision the engine can make). Greedy speculation
-    accepts draft tokens while each equals the verify forward's
-    argmax at its position: ``preds[b, j]`` is the model's greedy
-    next token AFTER verify-input position j (``tokens`` [B, W] =
-    base token + drafts + pad), so draft ``tokens[b, j+1]`` is
-    correct iff it equals ``preds[b, j]``, and the accepted count
-    is the length of the leading all-correct run over the row's
-    ``n_real[b] - 1`` real draft lanes. Runs traced inside
-    ``verify_step_paged`` (the commit arithmetic stays on device —
-    no host round-trip decides an acceptance), works identically on
-    host int arrays in tests. The emission is ``preds[b, 0..a]`` —
-    exactly the a+1 tokens plain greedy decode would have produced
-    one forward at a time."""
-    w = tokens.shape[1]
-    ok = (tokens[:, 1:] == preds[:, :-1])
-    is_draft = (jnp.arange(w - 1)[None, :] <
-                (n_real - 1)[:, None])
-    lead = jnp.cumprod((ok & is_draft).astype(jnp.int32), axis=1)
-    return lead.sum(axis=1).astype(jnp.int32)      # [B] accepted
-
-
 def update_spec_k(cur_k: int, window, draft_k: int) -> int:
     """Adaptive per-request draft length from a trailing
     acceptance-rate window of (proposed, accepted) verify rounds:
@@ -614,7 +627,8 @@ def verify_step_paged(params: Params, tokens: jax.Array,
                       pos: jax.Array, n_real: jax.Array,
                       config: llama.LlamaConfig,
                       width: int, block_size: int,
-                      adapters=None, adapter_idx=None):
+                      adapters=None, adapter_idx=None,
+                      sampling=None):
     """Batched multi-token VERIFY forward — the speculative twin of
     ``decode_steps_paged``: instead of scanning ``num_steps`` single
     tokens, ONE forward carries ``width`` = draft_k + 1 query
@@ -637,14 +651,20 @@ def verify_step_paged(params: Params, tokens: jax.Array,
     intra-draft causal mask (query j attends [0, pos+j]).
 
     Returns (preds [B, W] int32, accepted [B] int32, new_pos [B],
-    new_tokens [B], caches): ``preds[b, j]`` is the greedy next
-    token after position pos[b]+j; ``accepted`` is
-    ``greedy_accept``'s per-row count (the ONE acceptance
-    implementation, traced here so the pos/tokens commit costs no
-    extra host round-trips); ``new_pos``/``new_tokens`` carry the
-    committed frontier — pos advances by accepted+1 for live rows
-    (the ROLLBACK: rejected positions simply stay past the new
-    frontier) and parked rows (n_real 0) are untouched.
+    new_tokens [B], caches): ``preds[b, j]`` is the target model's
+    token realization after position pos[b]+j — the argmax when
+    ``sampling`` is None, else ``sample_lib.verify_targets``'s
+    counter-keyed draw with the SAME key plain decode would use at
+    that position (``sampling`` also carries per-position grammar
+    masks, table [M, W, V] gathered by traced index). ``accepted``
+    is ``accept_tokens``'s per-row count (serve/sampling/accept.py
+    — the ONE acceptance implementation: the Chen et al. rejection
+    rule realized by maximal coupling, traced here so the
+    pos/tokens commit costs no extra host round-trips);
+    ``new_pos``/``new_tokens`` carry the committed frontier — pos
+    advances by accepted+1 for live rows (the ROLLBACK: rejected
+    positions simply stay past the new frontier) and parked rows
+    (n_real 0) are untouched.
     """
     from skypilot_tpu.ops import decode_attention as da
 
@@ -753,8 +773,18 @@ def verify_step_paged(params: Params, tokens: jax.Array,
         logits = (x @ llama.output_head(cparams, config))
     else:
         logits = _mm(x, cparams['lm_head'])
-    preds = logits.argmax(-1).astype(jnp.int32)       # [B, W]
-    accepted = greedy_accept(tokens, preds, n_real)   # [B]
+    if sampling is None:
+        preds = logits.argmax(-1).astype(jnp.int32)   # [B, W]
+    else:
+        # Target realizations drawn with the keys plain decode
+        # would use at each position — the maximal-coupling half of
+        # the speculative-sampling rule (serve/sampling/accept.py).
+        allowed = sample_lib.gather_masks(sampling['mask_table'],
+                                          sampling['mask_idx'])
+        preds = sample_lib.verify_targets(
+            logits, sampling['temps'], sampling['top_ps'],
+            sampling['seeds'], pos, allowed)          # [B, W]
+    accepted = accept_tokens(tokens, preds, n_real)   # [B]
     live = n_real > 0
     new_pos = jnp.where(live, pos + accepted + 1, pos)
     new_tok = jnp.where(
@@ -791,10 +821,29 @@ class _Request:
                  tenant: Optional[str] = None,
                  deadline: Optional[float] = None,
                  priority: str = 'interactive',
-                 adapter: Optional[str] = None):
+                 adapter: Optional[str] = None,
+                 temperature: float = 0.0,
+                 top_p: float = 1.0,
+                 seed: int = 0,
+                 response_format: Optional[dict] = None):
         self.prompt_ids = prompt_ids
         self.max_new = max_new
         self.eos_id = eos_id
+        # Sampling knobs (serve/sampling/): temperature 0 = greedy
+        # (bitwise the pre-sampling engine); every random draw this
+        # request ever sees is keyed (seed, absolute position) and
+        # nothing else — the batch-invariance contract. The compiled
+        # grammar (``response_format`` -> ``grammar``, filled at
+        # submit) walks host-side; ``grammar_state`` tracks the DFA
+        # state after every EMITTED token, recomputed from
+        # ``generated`` at (re-)admission so preempt-resume lands in
+        # the identical state.
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        self.response_format = response_format
+        self.grammar = None
+        self.grammar_state = None
         # Multi-tenant LoRA (serve/adapters/): the adapter this
         # request decodes under (None = base model). ``adapter_hit``
         # is filled at admission — True when the adapter was already
@@ -934,14 +983,36 @@ def _engine_metrics():
             'drafter and carried into a verify dispatch.'),
         'spec_accepted': reg.counter(
             'skytpu_batch_spec_accepted_total',
-            'Proposed draft tokens accepted by greedy verification '
-            '(each accepted draft is one decode forward the engine '
-            'did not have to run).'),
+            'Proposed draft tokens accepted by verification — the '
+            'argmax match for greedy rows, the speculative-'
+            'sampling rule for sampled rows (each accepted draft '
+            'is one decode forward the engine did not have to '
+            'run).'),
         'spec_tokens_per_forward': reg.gauge(
             'skytpu_batch_spec_tokens_per_forward',
             'Tokens emitted per row by the latest verify dispatch '
             '(accepted drafts + the bonus token; 1.0 == plain '
             'decode, draft_k+1 == full acceptance).'),
+        'spec_accept_rate': reg.histogram(
+            'skytpu_batch_spec_accept_rate',
+            'Per-row accepted/proposed fraction of each verify '
+            'round, labeled by decode mode — sampled rows accept '
+            'by the speculative-sampling rule '
+            '(serve/sampling/accept.py), greedy rows by argmax '
+            'match. A sampled-mode distribution sitting far below '
+            'greedy on the same traffic means drafts are being '
+            'rejected by randomness, not by model disagreement.',
+            ('mode',),
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0)),
+        'sampled_requests': reg.counter(
+            'skytpu_batch_sampled_requests_total',
+            'Admitted requests decoding with temperature > 0 '
+            '(counter-keyed sampled decode, serve/sampling/).'),
+        'constrained_requests': reg.counter(
+            'skytpu_batch_constrained_requests_total',
+            'Admitted requests decoding under a response_format '
+            'grammar (structured decoding, serve/sampling/'
+            'grammar.py).'),
         'shed': reg.counter(
             'skytpu_batch_shed_total',
             'Requests refused typed at submit() by bounded '
@@ -1044,7 +1115,9 @@ class BatchingEngine:
       under greedy decoding (kv_pool.py module docstring).
     - ``speculative``: self-speculative n-gram decoding (default
       on): rows with a prompt-lookup draft verify draft_k+1 tokens
-      in ONE forward (``verify_step_paged``); greedy acceptance
+      in ONE forward (``verify_step_paged``); the acceptance rule
+      (serve/sampling/accept.py — argmax match for greedy rows,
+      maximal-coupling speculative sampling for sampled ones)
       keeps outputs token-for-token equal to plain decode, and an
       adaptive per-request controller collapses the draft length to
       0 on low-repeat traffic (the batch then takes the plain scan
@@ -1065,6 +1138,17 @@ class BatchingEngine:
       carry none (None = no default). Expired requests abort typed
       (``DeadlineExceededError``) at admission or between decode
       iterations, blocks reclaimed.
+    - ``sampling``: sampled decode + structured decoding
+      (serve/sampling/, default on): per-request
+      temperature/top_p/seed ride the jitted steps as traced
+      per-row arrays under the batch-invariance contract — a
+      request's output depends only on its own (seed, position)
+      draws, never on batch neighbors, slot assignment, or
+      preempt-resume. While every admitted row is greedy, the
+      greedy executables stay byte-identical to sampling=False.
+    - ``grammar_vocab``: per-token-id decoded strings (None entries
+      = never-legal ids), required to serve ``response_format``
+      grammars; must match the model vocab size.
     """
 
     def __init__(self, params: Params, config: llama.LlamaConfig,
@@ -1085,7 +1169,10 @@ class BatchingEngine:
                  adapter_registry=None,
                  adapter_capacity: int = 0,
                  adapter_rank_bucket: int = 16,
-                 adapter_preload: Optional[List[str]] = None):
+                 adapter_preload: Optional[List[str]] = None,
+                 sampling: bool = True,
+                 grammar_vocab: Optional[List[Optional[str]]]
+                 = None):
         self.params = params
         self.config = config
         self.slots = slots
@@ -1144,6 +1231,27 @@ class BatchingEngine:
         # to scratch), so speculation adds exactly one executable.
         self.speculative = speculative and draft_k > 0
         self.draft_k = max(0, draft_k)
+        # Sampling subsystem (serve/sampling/): sampled decode +
+        # structured decoding are compiled into the SAME executables
+        # lazily — while every admitted row is greedy-unconstrained,
+        # ``_sampling_args`` returns None and the greedy executables
+        # stay byte-identical to a sampling-off engine. The mask
+        # table ([slots + 1, V] bool, row 0 all-allowed) is the
+        # device half of the grammar pipeline: host-side DFA walks
+        # refresh one row per constrained request per emitted token,
+        # the jitted steps gather rows by traced index.
+        self.sampling = bool(sampling)
+        self._grammar_vocab = (tuple(grammar_vocab)
+                               if grammar_vocab else None)
+        if self._grammar_vocab is not None and \
+                len(self._grammar_vocab) != config.vocab_size:
+            raise ValueError(
+                f'grammar_vocab has {len(self._grammar_vocab)} '
+                f'entries but the model vocab is '
+                f'{config.vocab_size}')
+        self._mask_table = jnp.ones(
+            (slots + 1, config.vocab_size), bool) \
+            if self.sampling else None
         # Engine-local cumulatives + trailing window for the
         # windowed accept-rate gauge (same shape as the prefix
         # hit-ratio window below).
@@ -1259,6 +1367,12 @@ class BatchingEngine:
         self._prefill_fn = jax.jit(decode.forward_paged,
                                    static_argnums=(6, 7),
                                    donate_argnums=(2,))
+        # First-token selection from the final prefill chunk's
+        # logits for sampled/constrained rows — keyed at position
+        # t0 - 1 (the last prompt token's index), so the
+        # prompt/decode boundary is invisible to the (seed,
+        # position) contract. Greedy rows keep the host argmax.
+        self._first_fn = jax.jit(sample_lib.sample_first)
         # COW primitive: duplicate a cached block before diverging
         # writes (src/dst traced — one executable for every copy).
         self._copy_fn = jax.jit(kv_pool_lib.copy_pool_block,
@@ -1305,26 +1419,46 @@ class BatchingEngine:
                tenant: Optional[str] = None,
                deadline: Optional[float] = None,
                priority: str = 'interactive',
-               adapter: Optional[str] = None) -> 'queue.Queue':
+               adapter: Optional[str] = None,
+               temperature: float = 0.0,
+               top_p: float = 1.0,
+               seed: int = 0,
+               response_format: Optional[dict] = None
+               ) -> 'queue.Queue':
         """Returns a Queue yielding generated ids then None. With
         ``eos_id``, the row retires the moment it emits that id
         (the EOS itself is emitted, matching greedy_generate). A
         request the pool can never hold yields a typed
         ``KVPoolExhaustedError`` before its None; a refused
         (bounded-admission) request a typed ``EngineOverloadedError``
-        and an expired one a typed ``DeadlineExceededError``."""
+        and an expired one a typed ``DeadlineExceededError``.
+        ``temperature > 0`` samples with counter-keyed randomness
+        ((seed, position) — batch-invariant, serve/sampling/);
+        ``response_format`` ({'type': 'json_schema'|'regex', ...})
+        constrains decoding to the grammar (requires the engine's
+        ``grammar_vocab`` and a ``eos_id``; a bad grammar yields a
+        typed ``GrammarError`` before the None)."""
         return self.submit_request(prompt_ids, max_new,
                                    eos_id=eos_id, tenant=tenant,
                                    deadline=deadline,
                                    priority=priority,
-                                   adapter=adapter).out
+                                   adapter=adapter,
+                                   temperature=temperature,
+                                   top_p=top_p, seed=seed,
+                                   response_format=response_format
+                                   ).out
 
     def submit_request(self, prompt_ids: List[int], max_new: int,
                        eos_id: Optional[int] = None,
                        tenant: Optional[str] = None,
                        deadline: Optional[float] = None,
                        priority: str = 'interactive',
-                       adapter: Optional[str] = None) -> _Request:
+                       adapter: Optional[str] = None,
+                       temperature: float = 0.0,
+                       top_p: float = 1.0,
+                       seed: int = 0,
+                       response_format: Optional[dict] = None
+                       ) -> _Request:
         """``submit`` returning the request object itself: ``.out``
         is the token queue, ``.id`` is the handle ``cancel()``
         takes, and after admission (i.e. by the first token)
@@ -1335,6 +1469,35 @@ class BatchingEngine:
         if priority not in PRIORITIES:
             raise ValueError(f'priority must be one of {PRIORITIES},'
                              f' got {priority!r}')
+        # Knob validation raises at the call site (caller bugs, the
+        # ``priority`` precedent) — serve_model validates the HTTP
+        # body itself so a bad field answers a typed 400 naming it.
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise ValueError(
+                f'seed must be an integer, got {seed!r}')
+        # The PRNG keys on uint32(seed) (serve/sampling/prng.py), so
+        # any Python int is taken mod 2**32 — stored as the int32
+        # two's-complement of that value because the per-row knob
+        # arrays pack as int32 (an unmasked 2**31+ seed would
+        # OverflowError INSIDE the scheduler thread and kill the
+        # engine; seeds < 2**31 keep their bit pattern, so existing
+        # outputs are unchanged).
+        seed &= 0xFFFFFFFF
+        if seed >= 1 << 31:
+            seed -= 1 << 32
+        temperature = float(temperature)
+        top_p = float(top_p)
+        if temperature < 0.0:
+            raise ValueError(
+                f'temperature must be >= 0, got {temperature}')
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(
+                f'top_p must be in (0, 1], got {top_p}')
+        if not self.sampling and (temperature > 0.0
+                                  or response_format is not None):
+            raise ValueError(
+                'this engine was built with sampling=False and '
+                'cannot serve sampled or constrained requests')
         if deadline is None and self.default_timeout_s is not None:
             deadline = time.time() + self.default_timeout_s
         max_new = min(max_new,
@@ -1342,7 +1505,31 @@ class BatchingEngine:
         req = _Request(list(prompt_ids), max(0, max_new),
                        eos_id=eos_id, tenant=tenant,
                        deadline=deadline, priority=priority,
-                       adapter=adapter)
+                       adapter=adapter, temperature=temperature,
+                       top_p=top_p, seed=seed,
+                       response_format=response_format)
+        if response_format is not None:
+            # Compile (cached by grammar hash) synchronously: a bad
+            # grammar must refuse typed at submit, before any KV is
+            # touched — the adapter-refusal precedent. serve_model
+            # maps GrammarError to 400.
+            try:
+                if self._grammar_vocab is None:
+                    raise grammar_lib.GrammarError(
+                        'this engine serves no structured decoding '
+                        '(start it with a grammar_vocab to serve '
+                        'response_format requests)')
+                if eos_id is None:
+                    raise grammar_lib.GrammarError(
+                        'response_format requires an eos_id (the '
+                        'grammar decides completion by allowing '
+                        'EOS only at accepting states)')
+                req.grammar = grammar_lib.compile_grammar(
+                    response_format, self._grammar_vocab, eos_id)
+            except grammar_lib.GrammarError as e:
+                self._fail_request(
+                    req, f'response_format refused: {e}', exc=e)
+                return req
         if adapter is not None:
             # Typed refusal at submit for adapters this engine can
             # NEVER serve: no adapter subsystem at all, an unknown
@@ -1526,6 +1713,106 @@ class BatchingEngine:
             idx = self.slot_adapter
         return (self._adapters.buffers(),
                 jnp.asarray(idx, jnp.int32))
+
+    def _sampling_needed(self) -> bool:
+        return self.sampling and any(
+            r is not None and (r.temperature > 0.0
+                               or r.grammar is not None)
+            for r in self.slot_req)
+
+    def _knob_rows(self):
+        """Per-slot (temps, top_ps, seeds) lists — empty rows get
+        greedy-neutral values; their lanes are inactive/parked so
+        the draws are never emitted."""
+        temps, tps, seeds = [], [], []
+        for req in self.slot_req:
+            temps.append(req.temperature if req is not None else 0.0)
+            tps.append(req.top_p if req is not None else 1.0)
+            seeds.append(req.seed if req is not None else 0)
+        return temps, tps, seeds
+
+    def _sampling_args(self):
+        """Traced ``sampling`` kwarg for the jitted decode steps —
+        None while every admitted row is greedy-unconstrained, so
+        the greedy executables stay byte-identical to a
+        sampling-off engine (the ``_adapter_args`` precedent).
+        Knobs are per-row DATA: one sampled executable serves every
+        request mix; constrained rows point ``mask_idx`` at their
+        slot's row of the persistent device mask table."""
+        if not self._sampling_needed():
+            return None
+        temps, tps, seeds = self._knob_rows()
+        idx = [i + 1 if self.slot_req[i] is not None
+               and self.slot_req[i].grammar is not None else 0
+               for i in range(self.slots)]
+        return {'temps': jnp.asarray(temps, jnp.float32),
+                'top_ps': jnp.asarray(tps, jnp.float32),
+                'seeds': jnp.asarray(seeds, jnp.int32),
+                'mask_table': self._mask_table,
+                'mask_idx': jnp.asarray(idx, jnp.int32)}
+
+    def _verify_sampling_args(self, toks: List[List[int]],
+                              n_real: List[int]):
+        """``sampling`` kwarg for the verify step: same knobs, but
+        grammar masks are PER-POSITION ([M, W, V]) — row r's mask
+        at lane j is the DFA state after consuming its drafts
+        1..j, walked host-side along the (grammar-filtered) draft
+        path. With no constrained row active the table collapses
+        to the shared all-allowed row ([1, W, V], every index 0)."""
+        if not self._sampling_needed():
+            return None
+        w = self.draft_k + 1
+        temps, tps, seeds = self._knob_rows()
+        con = [i for i in range(self.slots)
+               if self.slot_req[i] is not None
+               and self.slot_req[i].grammar is not None]
+        if not con:
+            table = np.ones((1, w, self.config.vocab_size), bool)
+            idx = [0] * self.slots
+        else:
+            table = np.ones(
+                (self.slots + 1, w, self.config.vocab_size), bool)
+            idx = [0] * self.slots
+            for i in con:
+                req = self.slot_req[i]
+                idx[i] = i + 1
+                if n_real[i] <= 0:
+                    continue
+                st = req.grammar_state
+                table[i + 1, 0] = req.grammar.allowed(st)
+                for j in range(1, n_real[i]):
+                    st = req.grammar.advance(st, toks[i][j])
+                    table[i + 1, j] = req.grammar.allowed(st)
+        return {'temps': jnp.asarray(temps, jnp.float32),
+                'top_ps': jnp.asarray(tps, jnp.float32),
+                'seeds': jnp.asarray(seeds, jnp.int32),
+                'mask_table': jnp.asarray(table),
+                'mask_idx': jnp.asarray(idx, jnp.int32)}
+
+    def _refresh_mask_row(self, row: int) -> None:
+        """Push the row's current grammar mask into the device mask
+        table (the host half of the structured-decoding pipeline —
+        one [V] upload per constrained row per emitted token)."""
+        req = self.slot_req[row]
+        if req is None or req.grammar is None:
+            return
+        self._mask_table = self._mask_table.at[row + 1].set(
+            jnp.asarray(req.grammar.allowed(req.grammar_state)))
+
+    def _filter_draft_grammar(self, req: _Request,
+                              draft: List[int]) -> List[int]:
+        """Truncate an n-gram draft at the first token the request's
+        grammar disallows — a disallowed draft could never be
+        emitted (the verify mask forces the target realization off
+        it), so carrying it would only burn verify lanes."""
+        st = req.grammar_state
+        out: List[int] = []
+        for t in draft:
+            if not req.grammar.allowed(st)[t]:
+                break
+            st = req.grammar.advance(st, t)
+            out.append(t)
+        return out
 
     def _shed_reason(self, cost: int) -> Optional[str]:
         """Which admission bound a ``cost``-token arrival would
@@ -1919,6 +2206,10 @@ class BatchingEngine:
                                       attrs={'slot': row})
                 req.admitted_once = True
                 self._metrics['requests'].inc()
+                if self.sampling and req.temperature > 0.0:
+                    self._metrics['sampled_requests'].inc()
+                if req.grammar is not None:
+                    self._metrics['constrained_requests'].inc()
             # Drain-rate sample for the Retry-After estimate: every
             # admission (including re-admissions) moves the queue.
             self._admit_times.append(time.time())
@@ -1950,6 +2241,17 @@ class BatchingEngine:
             self._admit_seq += 1
             self.slot_seq[row] = self._admit_seq
             self._set_table_row(row)
+            if req.grammar is not None:
+                # Re-derive the DFA state from the EMITTED stream
+                # (empty on first admission): a preempt-resume walks
+                # the identical tokens, so the resumed request
+                # constrains from the identical state — the grammar
+                # half of resume reproducibility.
+                st = req.grammar.start
+                for t in req.generated:
+                    st = req.grammar.advance(st, t)
+                req.grammar_state = st
+                self._refresh_mask_row(row)
             self.events.append(('admit', row, cached_tokens, t0))
             # Park the lane OUT OF RANGE until prefill finishes:
             # decode dispatches treat the row as inactive but still
@@ -2157,7 +2459,25 @@ class BatchingEngine:
         req = self.slot_req[row]
         t0 = self.slot_total[row]
         self._register_prefix(row)
-        first = int(jax.device_get(logits)[0].argmax())
+        if self.sampling and (req.temperature > 0.0
+                              or req.grammar is not None):
+            # Counter-keyed first token at position t0 - 1 (the
+            # index of the last prompt token these logits consumed)
+            # — the same key decode would use there, so the
+            # prefill/decode boundary is invisible to the (seed,
+            # position) contract. Greedy-unconstrained rows keep
+            # the host argmax below, byte-identical to before.
+            allowed = None
+            if req.grammar is not None:
+                allowed = jnp.asarray(
+                    req.grammar.allowed(req.grammar_state))
+            first = int(jax.device_get(self._first_fn(
+                logits, jnp.asarray(req.temperature, jnp.float32),
+                jnp.asarray(req.top_p, jnp.float32),
+                jnp.asarray(req.seed, jnp.int32),
+                jnp.asarray(t0 - 1, jnp.int32), allowed)))
+        else:
+            first = int(jax.device_get(logits)[0].argmax())
         # The int() above synchronizes, so these are real wall times.
         t_first = time.time()
         resumed = bool(req.generated)
@@ -2178,10 +2498,15 @@ class BatchingEngine:
         self._metrics['tokens'].inc()
         req.out.put(first)
         req.generated.append(first)
+        if req.grammar is not None:
+            req.grammar_state = req.grammar.advance(
+                req.grammar_state, first)
         self.slot_left[row] = req.max_new - len(req.generated)
         if self.slot_left[row] <= 0 or first == req.eos_id:
             req.out.put(None)
             self._retire(row)
+        elif req.grammar is not None:
+            self._refresh_mask_row(row)
 
     def _spec_k_for(self, req: _Request) -> int:
         """Current draft length for a request (adaptive controller
@@ -2253,6 +2578,8 @@ class BatchingEngine:
                 bar = SPEC_MIN_NGRAM
             d = propose_ngram_draft(draft_stream(req), cap,
                                     min_ngram=bar)
+            if d and req.grammar is not None:
+                d = self._filter_draft_grammar(req, d)
             if d:
                 drafts[row] = d
                 left -= len(d)
@@ -2281,6 +2608,8 @@ class BatchingEngine:
                 d = propose_ngram_draft(
                     draft_stream(req), cap,
                     min_ngram=SPEC_PROBE_MIN_NGRAM)
+                if d and req.grammar is not None:
+                    d = self._filter_draft_grammar(req, d)
                 if d:
                     drafts[row] = d
                     left -= len(d)
@@ -2320,6 +2649,15 @@ class BatchingEngine:
         drafts = self._collect_drafts(decode_rows()) \
             if self.speculative else {}
         n = self.steps
+        if any(self.slot_req[i] is not None
+               and self.slot_req[i].grammar is not None
+               for i in decode_rows()):
+            # Grammar masks advance HOST-side per emitted token — a
+            # multi-step scan cannot re-mask between its steps, so
+            # any constrained row forces 1-token dispatches (the
+            # structured-decoding throughput cost; unconstrained
+            # batches keep the full scan).
+            n = 1
         # Grow allocations for this dispatch's writes up front;
         # exhaustion preempts the youngest request (possibly a row in
         # this very list, which then simply sits the dispatch out —
@@ -2362,7 +2700,8 @@ class BatchingEngine:
         toks, self.caches, self.pos = self._step_fn(
             self.params, self.tokens, self.caches,
             self.block_tables, self.pos, active, self.config, n,
-            self.block_size, *self._adapter_args())
+            self.block_size, *self._adapter_args(),
+            sampling=self._sampling_args())
         self.tokens = toks[:, -1]
         for i in active_rows:
             if self.slot_left[i] > 0:
@@ -2408,6 +2747,13 @@ class BatchingEngine:
                 break
             req.out.put(int(t))
             req.generated.append(int(t))
+            if req.grammar is not None:
+                # Host half of structured decoding: walk the DFA
+                # over the emitted stream (device-side masks made
+                # the token legal; a None state falls back to
+                # unconstrained rather than poisoning the row).
+                req.grammar_state = req.grammar.advance(
+                    req.grammar_state, int(t))
             row_emitted += 1
             self.slot_left[row] -= 1
             if int(t) == req.eos_id:
@@ -2423,6 +2769,8 @@ class BatchingEngine:
         if done or self.slot_left[row] <= 0:
             req.out.put(None)
             self._retire(row)
+        elif row_emitted and req.grammar is not None:
+            self._refresh_mask_row(row)
         return row_emitted
 
     def _run_verify_dispatch(self, active_rows: List[int],
@@ -2457,7 +2805,8 @@ class BatchingEngine:
                 self.params, jnp.asarray(toks, jnp.int32),
                 self.caches, self.block_tables, self.pos,
                 jnp.asarray(n_real, jnp.int32), self.config, w,
-                self.block_size, *self._adapter_args())
+                self.block_size, *self._adapter_args(),
+                sampling=self._verify_sampling_args(toks, n_real))
         host_preds, host_acc = jax.device_get((preds, accepted))
         dispatch_s = time.perf_counter() - t_dispatch
         t_chunk_end = time.time()
@@ -2473,6 +2822,10 @@ class BatchingEngine:
             if d:
                 proposed_total += len(d)
                 accepted_total += a
+                self._metrics['spec_accept_rate'].labels(
+                    mode='sampled' if (self.sampling
+                                       and req.temperature > 0.0)
+                    else 'greedy').observe(a / len(d))
                 req.spec_window.append((len(d), a))
                 new_k = update_spec_k(req.spec_k, req.spec_window,
                                       self.draft_k)

@@ -75,7 +75,14 @@ def request_prefix_key(body: Optional[bytes]) -> Optional[bytes]:
     adapter-carrying request whose prompt is too short for a block
     still keys on the adapter alone (adapter affinity is worth a
     cold load even without prefix reuse). None for non-JSON bodies
-    and short base-model prompts — those route by least-load."""
+    and short base-model prompts — those route by least-load.
+
+    Sampling fields (temperature/top_p/seed/response_format) are
+    DELIBERATELY not part of the key: KV reuse depends only on the
+    (adapter, prompt-prefix) pair, and sampled output is
+    batch-invariant (serve/sampling/), so a seed or grammar change
+    must not move a warm-prefix request to a cold replica. The body
+    is relayed verbatim either way — the replica reads the knobs."""
     if not body:
         return None
     try:

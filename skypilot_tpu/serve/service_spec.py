@@ -38,6 +38,8 @@ class SkyServiceSpec:
         engine_adapter_dir: Optional[str] = None,
         engine_adapter_capacity: Optional[int] = None,
         engine_adapter_preload: Optional[List[str]] = None,
+        engine_sampling: Optional[bool] = None,
+        engine_sampling_grammar_vocab: Optional[str] = None,
         load_balancing_policy: Optional[str] = None,
         upgrade_drain_grace_seconds: Optional[float] = None,
         upgrade_soak_seconds: Optional[float] = None,
@@ -195,6 +197,33 @@ class SkyServiceSpec:
         self.engine_adapter_dir = engine_adapter_dir
         self.engine_adapter_capacity = engine_adapter_capacity
         self.engine_adapter_preload = engine_adapter_preload
+        # engine.sampling (enabled / grammar_vocab): the sampling
+        # subsystem (serve/sampling/) — batch-invariant per-request
+        # temperature/top_p/seed sampled decode, and (with a grammar
+        # vocab file) response_format structured decoding. ``enabled``
+        # off pins replicas to the greedy-only executables; None
+        # keeps the engine default (on). ``grammar_vocab`` is a
+        # replica-local path to a JSON list mapping token id -> token
+        # string (null for ids with no text).
+        if engine_sampling is not None and \
+                not isinstance(engine_sampling, bool):
+            raise exceptions.InvalidSpecError(
+                'engine.sampling.enabled must be a boolean (on|off)')
+        if engine_sampling_grammar_vocab is not None and (
+                not isinstance(engine_sampling_grammar_vocab, str) or
+                not engine_sampling_grammar_vocab):
+            raise exceptions.InvalidSpecError(
+                'engine.sampling.grammar_vocab must be a non-empty '
+                'path string')
+        if engine_sampling is False and \
+                engine_sampling_grammar_vocab is not None:
+            raise exceptions.InvalidSpecError(
+                'engine.sampling.grammar_vocab requires sampling '
+                'enabled (structured decoding rides the sampling '
+                'subsystem)')
+        self.engine_sampling = engine_sampling
+        self.engine_sampling_grammar_vocab = \
+            engine_sampling_grammar_vocab
         # LB policy knob (serve/load_balancer.py): least_load
         # (default), round_robin, or the KV-aware prefix_affinity
         # that concentrates repeat prefixes where their cached
@@ -278,6 +307,7 @@ class SkyServiceSpec:
         slo = dict(config.pop('slo', {}) or {})
         engine = dict(config.pop('engine', {}) or {})
         adapters = dict(engine.get('adapters') or {})
+        sampling = dict(engine.get('sampling') or {})
         upgrade = dict(config.pop('upgrade', {}) or {})
         overload = dict(config.pop('overload', {}) or {})
         lb_policy = config.pop('load_balancing_policy', None)
@@ -318,6 +348,9 @@ class SkyServiceSpec:
             engine_adapter_dir=adapters.get('dir'),
             engine_adapter_capacity=adapters.get('capacity'),
             engine_adapter_preload=adapters.get('preload'),
+            engine_sampling=sampling.get('enabled'),
+            engine_sampling_grammar_vocab=sampling.get(
+                'grammar_vocab'),
             load_balancing_policy=lb_policy,
             upgrade_drain_grace_seconds=upgrade.get(
                 'drain_grace_seconds'),
@@ -362,6 +395,12 @@ class SkyServiceSpec:
         if self.engine_adapter_preload:
             env['SKYTPU_ENGINE_ADAPTER_PRELOAD'] = \
                 ','.join(self.engine_adapter_preload)
+        if self.engine_sampling is not None:
+            env['SKYTPU_ENGINE_SAMPLING'] = \
+                '1' if self.engine_sampling else '0'
+        if self.engine_sampling_grammar_vocab is not None:
+            env['SKYTPU_ENGINE_SAMPLING_GRAMMAR_VOCAB'] = \
+                self.engine_sampling_grammar_vocab
         if self.overload_max_queued_requests is not None:
             env['SKYTPU_ENGINE_OVERLOAD_MAX_QUEUED_REQUESTS'] = \
                 str(self.overload_max_queued_requests)
@@ -426,6 +465,14 @@ class SkyServiceSpec:
             adapters['preload'] = list(self.engine_adapter_preload)
         if adapters:
             engine['adapters'] = adapters
+        sampling = {}
+        if self.engine_sampling is not None:
+            sampling['enabled'] = self.engine_sampling
+        if self.engine_sampling_grammar_vocab is not None:
+            sampling['grammar_vocab'] = \
+                self.engine_sampling_grammar_vocab
+        if sampling:
+            engine['sampling'] = sampling
         if engine:
             out['engine'] = engine
         if self.load_balancing_policy is not None:

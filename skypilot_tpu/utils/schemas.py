@@ -180,6 +180,19 @@ SERVICE_SCHEMA = {
                         },
                     },
                 },
+                # Sampling subsystem (serve/sampling/):
+                # batch-invariant sampled decode + (with a
+                # grammar vocab) response_format structured
+                # decoding.
+                'sampling': {
+                    'type': 'object',
+                    'additionalProperties': False,
+                    'properties': {
+                        'enabled': {'type': 'boolean'},
+                        'grammar_vocab': {'type': 'string',
+                                          'minLength': 1},
+                    },
+                },
             },
         },
         # KV-aware routing knob (serve/load_balancer.py).

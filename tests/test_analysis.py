@@ -173,6 +173,20 @@ FIXTURES = {
             step_fn = jax.jit(step)
         ''',
     }, None),
+    'serve-jit-prng': ({
+        # Scope-gated: serve/ outside serve/sampling/ — a jitted
+        # decode step that builds its own key chain, hidden behind
+        # a local helper (the call-graph pass catches it).
+        'serve/rogue_engine.py': '''
+            import jax
+            def _draw(logits, step):
+                key = jax.random.PRNGKey(step)
+                return jax.random.categorical(key, logits)
+            def step(logits, step_idx):
+                return _draw(logits, step_idx)
+            step_fn = jax.jit(step)
+        ''',
+    }, None),
     'naked-thread': ({
         'threads.py': '''
             import threading

@@ -1,13 +1,16 @@
 """Speculative decoding on the paged engine: n-gram drafting,
-batched multi-token verify, greedy acceptance, pos rollback,
-adaptive draft length, budget accounting, and the
+batched multi-token verify, the single acceptance rule, pos
+rollback, adaptive draft length, budget accounting, and the
 prefix-cache x speculation interaction (serve/batching.py
-verify_step_paged / propose_ngram_draft / greedy_accept,
+verify_step_paged / propose_ngram_draft,
+serve/sampling/accept.accept_tokens,
 ops/decode_attention.paged_verify_attention,
 serve/kv_pool.verify_write_indices).
 
 The non-negotiable contract everywhere: spec-on == spec-off ==
-single-stream greedy, token for token."""
+single-stream decode, token for token — at any temperature (the
+maximal-coupling acceptance in serve/sampling/accept.py;
+tests/test_sampling.py covers the sampled half)."""
 import dataclasses
 import os
 import re
@@ -22,9 +25,9 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.models import decode, llama
 from skypilot_tpu.serve import batching, kv_pool
 from skypilot_tpu.serve.batching import (BatchingEngine,
-                                         greedy_accept,
                                          propose_ngram_draft,
                                          update_spec_k)
+from skypilot_tpu.serve.sampling import accept_tokens
 
 
 @pytest.fixture(scope='module')
@@ -98,10 +101,13 @@ class TestProposer:
         assert propose_ngram_draft(toks, 3, min_ngram=4) == []
 
 
-class TestGreedyAccept:
+class TestAcceptTokens:
+    """The greedy specialization of ``accept_tokens``: when preds
+    are argmax realizations (temperature 0), the maximal-coupling
+    rule reduces to the old leading-run greedy acceptance."""
 
     def _accept(self, toks, preds, n_real):
-        out = greedy_accept(jnp.asarray(toks, jnp.int32),
+        out = accept_tokens(jnp.asarray(toks, jnp.int32),
                             jnp.asarray(preds, jnp.int32),
                             jnp.asarray(n_real, jnp.int32))
         return [int(a) for a in out]
@@ -573,12 +579,16 @@ class TestSpecMetrics:
 
 
 class TestAcceptanceLint:
-    """The greedy acceptance rule must have exactly ONE
-    implementation — ``batching.greedy_accept``, the function the
-    exactness suite certifies. Any other draft-vs-argmax comparison
-    in the serving stack is a second acceptance path the tests do
-    not cover (the slab-allocation lint's shape, applied to
-    acceptance logic)."""
+    """The speculative acceptance rule must have exactly ONE
+    implementation — ``serve/sampling/accept.accept_tokens``, the
+    maximal-coupling rule the exactness suite certifies at every
+    temperature. Any other draft-vs-realization comparison in the
+    serving stack is a second acceptance path the tests do not
+    cover, and the old ``greedy_accept`` must stay deleted (its
+    argmax semantics are accept_tokens' temperature-0
+    specialization)."""
+
+    _ACCEPT_PATH = os.path.join('serve', 'sampling', 'accept.py')
 
     def _py_files(self):
         import skypilot_tpu
@@ -590,25 +600,34 @@ class TestAcceptanceLint:
                 if fn.endswith('.py'):
                     yield os.path.join(dirpath, fn)
 
-    def test_single_greedy_accept_definition(self):
+    def test_single_accept_tokens_definition(self):
         defs = []
         for path in self._py_files():
             text = open(path, encoding='utf-8').read()
-            for m in re.finditer(r'^\s*def greedy_accept\(', text,
+            for _ in re.finditer(r'^\s*def accept_tokens\(', text,
                                  re.M):
                 defs.append(path)
         assert len(defs) == 1 and \
-            defs[0].endswith(os.path.join('serve', 'batching.py')), \
-            defs
+            defs[0].endswith(self._ACCEPT_PATH), defs
 
-    def test_no_draft_argmax_comparison_outside_the_function(self):
-        """No line outside serve/batching.py may compare drafted
-        tokens against verify predictions (the ``preds``/``draft``
-        comparison idiom), and batching.py itself must route the
-        engine's acceptance through greedy_accept."""
+    def test_greedy_accept_stays_deleted(self):
+        revivals = [
+            path for path in self._py_files()
+            if re.search(r'^\s*def greedy_accept\(',
+                         open(path, encoding='utf-8').read(), re.M)]
+        assert not revivals, (
+            'greedy_accept was reintroduced — the single acceptance '
+            'implementation is serve/sampling/accept.accept_tokens '
+            f'(temperature 0 IS the greedy rule): {revivals}')
+
+    def test_no_draft_comparison_outside_the_function(self):
+        """No line outside serve/sampling/accept.py may compare
+        drafted tokens against verify realizations (the
+        ``preds``/``draft`` comparison idiom), and batching.py must
+        route the engine's acceptance through accept_tokens."""
         offenders = []
         for path in self._py_files():
-            if path.endswith(os.path.join('serve', 'batching.py')):
+            if path.endswith(self._ACCEPT_PATH):
                 continue
             for i, line in enumerate(
                     open(path, encoding='utf-8'), 1):
@@ -620,12 +639,12 @@ class TestAcceptanceLint:
                     offenders.append(f'{path}:{i}')
         assert not offenders, (
             'draft-acceptance comparison outside '
-            'batching.greedy_accept: ' + ', '.join(offenders))
+            'sampling.accept_tokens: ' + ', '.join(offenders))
         text = open(next(p for p in self._py_files()
                          if p.endswith(os.path.join(
                              'serve', 'batching.py'))),
                     encoding='utf-8').read()
-        assert 'greedy_accept(tokens, preds, n_real)' in text
+        assert 'accept_tokens(tokens, preds, n_real)' in text
 
 
 # ---------------------------------------------------------------------
